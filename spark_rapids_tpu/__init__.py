@@ -1,0 +1,31 @@
+"""spark-rapids-tpu: a TPU-native columnar SQL execution framework.
+
+A ground-up rebuild of the capabilities of the RAPIDS Accelerator for Apache
+Spark (reference: /root/reference, spark-rapids ~v24.08) designed TPU-first:
+
+- Physical plans execute as columnar batches on TPU via JAX/XLA (whole-stage
+  expression fusion into single XLA programs, vs the reference's
+  kernel-at-a-time cuDF calls — see ``spark_rapids_tpu.exec``).
+- A plan-rewrite framework tags every operator/expression for TPU support and
+  falls back to a CPU columnar backend otherwise (reference:
+  sql-plugin/.../GpuOverrides.scala, RapidsMeta.scala).
+- Tiered HBM -> host-DRAM -> disk buffer catalog with spill, and a
+  retry/split-retry discipline with deterministic OOM injection (reference:
+  RapidsBufferCatalog.scala, RmmRapidsRetryIterator.scala).
+- Shuffle via hash/range/round-robin partitioning with a multithreaded local
+  transport and a mesh/ICI all-to-all device transport (reference:
+  RapidsShuffleInternalManagerBase.scala, shuffle-plugin/).
+- Differential CPU-vs-TPU testing as the correctness oracle (reference:
+  integration_tests/src/main/python/asserts.py).
+
+The package intentionally has no Spark/JVM dependency: it includes its own
+Catalyst-equivalent DataFrame/expression layer so the whole stack is
+self-contained and testable on a single host with a virtual device mesh.
+"""
+
+__version__ = "26.08.0"
+
+from spark_rapids_tpu.config import TpuConf  # noqa: F401
+from spark_rapids_tpu import types  # noqa: F401
+
+__all__ = ["TpuConf", "types", "__version__"]

@@ -1,0 +1,24 @@
+"""Columnar data plane: host (Arrow-layout) and device (JAX array) columns.
+
+Counterpart of the reference's GpuColumnVector.java / RapidsHostColumnVector /
+ColumnarBatch interop layer (sql-plugin/src/main/java/com/nvidia/spark/rapids/
+GpuColumnVector.java), rebuilt around TPU/XLA constraints:
+
+- Device batches are padded to power-of-two row buckets so every XLA program
+  is compiled once per (schema, bucket) rather than once per row count.
+- Strings on device are rectangular uint8 [rows, max_len] + lengths, because
+  TPU vector units want fixed-stride layouts (cuDF uses offsets+chars which
+  suits GPU byte kernels; that layout remains the host/wire form here).
+- Validity is a bool vector; padding rows are always invalid.
+"""
+
+from spark_rapids_tpu.columnar.column import (  # noqa: F401
+    DeviceColumn, HostColumn, bucket_rows)
+from spark_rapids_tpu.columnar.batch import (  # noqa: F401
+    ColumnarBatch, HostColumnarBatch, batch_from_arrow, batch_to_arrow,
+    batch_from_pydict)
+
+__all__ = [
+    "DeviceColumn", "HostColumn", "ColumnarBatch", "HostColumnarBatch",
+    "batch_from_arrow", "batch_to_arrow", "batch_from_pydict", "bucket_rows",
+]

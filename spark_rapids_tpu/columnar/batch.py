@@ -1,0 +1,170 @@
+"""Columnar batches (device Table / host RecordBatch equivalents).
+
+Reference counterparts: Spark's ``ColumnarBatch`` + cuDF ``Table`` interop in
+GpuColumnVector.java (from(Table), from(ColumnarBatch)), and host-side
+``RapidsHostColumnVector`` batches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import (DeviceColumn, HostColumn,
+                                              bucket_rows)
+
+
+@dataclasses.dataclass
+class ColumnarBatch:
+    """A device-resident batch: list of DeviceColumns + logical row count.
+
+    All columns share the same bucket (padded leading dim), so a whole batch
+    feeds a single jit'ed XLA program with static shapes.
+    """
+
+    columns: List[DeviceColumn]
+    row_count: int
+    names: Optional[List[str]] = None
+
+    def __post_init__(self):
+        for c in self.columns:
+            if c.row_count != self.row_count:
+                raise ValueError(
+                    f"column rows {c.row_count} != batch rows {self.row_count}")
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    @property
+    def schema(self) -> T.StructType:
+        names = self.names or [f"c{i}" for i in range(len(self.columns))]
+        return T.StructType([T.StructField(n, c.data_type)
+                             for n, c in zip(names, self.columns)])
+
+    @property
+    def bucket(self) -> int:
+        if not self.columns:
+            return bucket_rows(self.row_count)
+        return self.columns[0].bucket
+
+    def column(self, i: int) -> DeviceColumn:
+        return self.columns[i]
+
+    def nbytes(self) -> int:
+        return sum(c.nbytes() for c in self.columns)
+
+    def sized_nbytes(self) -> int:
+        """Unpadded logical size estimate (planner/coalesce sizing)."""
+        if self.bucket == 0:
+            return 0
+        return int(self.nbytes() * (self.row_count / max(self.bucket, 1)))
+
+    def to_host(self) -> "HostColumnarBatch":
+        return HostColumnarBatch([c.to_host() for c in self.columns],
+                                 self.row_count, self.names)
+
+    def select(self, indices: Sequence[int]) -> "ColumnarBatch":
+        names = None if self.names is None else [self.names[i] for i in indices]
+        return ColumnarBatch([self.columns[i] for i in indices],
+                             self.row_count, names)
+
+    def __repr__(self):
+        return (f"ColumnarBatch(rows={self.row_count}, "
+                f"cols=[{', '.join(str(c.data_type) for c in self.columns)}])")
+
+
+@dataclasses.dataclass
+class HostColumnarBatch:
+    """Host-resident batch over Arrow arrays (wire/spill/CPU-exec form)."""
+
+    columns: List[HostColumn]
+    row_count: int
+    names: Optional[List[str]] = None
+
+    def __post_init__(self):
+        for c in self.columns:
+            if len(c) != self.row_count:
+                raise ValueError(
+                    f"ragged batch: column has {len(c)} rows, batch has "
+                    f"{self.row_count}")
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    @property
+    def schema(self) -> T.StructType:
+        names = self.names or [f"c{i}" for i in range(len(self.columns))]
+        return T.StructType([T.StructField(n, c.data_type)
+                             for n, c in zip(names, self.columns)])
+
+    def to_device(self, bucket: Optional[int] = None) -> ColumnarBatch:
+        b = bucket or bucket_rows(self.row_count)
+        return ColumnarBatch([DeviceColumn.from_host(c, b) for c in self.columns],
+                             self.row_count, self.names)
+
+    def to_arrow(self):
+        import pyarrow as pa
+        names = self.names or [f"c{i}" for i in range(len(self.columns))]
+        return pa.record_batch([c.arrow for c in self.columns], names=names)
+
+    def nbytes(self) -> int:
+        return sum(c.nbytes() for c in self.columns)
+
+    def slice(self, offset: int, length: int) -> "HostColumnarBatch":
+        return HostColumnarBatch([c.slice(offset, length) for c in self.columns],
+                                 length, self.names)
+
+    def to_pydict(self):
+        names = self.names or [f"c{i}" for i in range(len(self.columns))]
+        return {n: c.to_pylist() for n, c in zip(names, self.columns)}
+
+    def __repr__(self):
+        return (f"HostColumnarBatch(rows={self.row_count}, "
+                f"cols=[{', '.join(str(c.data_type) for c in self.columns)}])")
+
+
+def batch_from_arrow(rb) -> HostColumnarBatch:
+    """From a pyarrow RecordBatch or Table."""
+    import pyarrow as pa
+    if isinstance(rb, pa.Table):
+        rb = rb.combine_chunks()
+        cols = [HostColumn(rb.column(i)) for i in range(rb.num_columns)]
+        return HostColumnarBatch(cols, rb.num_rows, list(rb.column_names))
+    cols = [HostColumn(rb.column(i)) for i in range(rb.num_columns)]
+    return HostColumnarBatch(cols, rb.num_rows, list(rb.schema.names))
+
+
+def batch_to_arrow(batch) -> "object":
+    if isinstance(batch, ColumnarBatch):
+        batch = batch.to_host()
+    return batch.to_arrow()
+
+
+def batch_from_pydict(d, schema: Optional[T.StructType] = None) -> HostColumnarBatch:
+    cols = []
+    names = []
+    n = None
+    for i, (name, values) in enumerate(d.items()):
+        dt = schema.types[i] if schema is not None else None
+        if isinstance(values, np.ndarray):
+            col = HostColumn.from_numpy(values, data_type=dt)
+        else:
+            col = HostColumn.from_pylist(list(values), dt)
+        if n is None:
+            n = len(col)
+        cols.append(col)
+        names.append(name)
+    return HostColumnarBatch(cols, n or 0, names)
+
+
+def concat_host_batches(batches: Iterable[HostColumnarBatch]) -> HostColumnarBatch:
+    import pyarrow as pa
+    batches = list(batches)
+    assert batches, "cannot concat zero batches"
+    tables = [pa.Table.from_batches([b.to_arrow()]) for b in batches]
+    return batch_from_arrow(pa.concat_tables(tables).combine_chunks())

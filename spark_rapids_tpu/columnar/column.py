@@ -1,0 +1,378 @@
+"""Host and device column vectors.
+
+Reference counterparts:
+- ``GpuColumnVector.java`` (device column over cuDF ColumnVector, type
+  mapping, batch<->Table) — here ``DeviceColumn`` over jax Arrays.
+- ``RapidsHostColumnVector.java`` / ``RapidsHostColumnBuilder.java`` — here
+  ``HostColumn`` over pyarrow Arrays (Arrow layout is the host/wire format,
+  as JCudfSerialization's host layout is for the reference).
+
+Design (TPU-first):
+- A device column is (data, validity, row_count) where ``data``/``validity``
+  are jax arrays whose leading dim is a *bucket* (next power of two >= rows,
+  min 1024).  All kernels mask by validity and by ``iota < row_count``.
+- Fixed-width types map 1:1 to a jax dtype.  float64 is kept f64 (XLA on TPU
+  emulates; ops that are f64-hot are planner-tagged).  decimal64 is int64 data
+  + scale in the DataType.  decimal128 is int64[bucket, 2] hi/lo limbs.
+- Strings/binary: uint8[bucket, max_len] + int32 lengths.  max_len is padded
+  to a power of two to bound compile cache size.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+
+MIN_ROW_BUCKET = 1024
+MIN_STR_BUCKET = 8
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def bucket_rows(n: int, minimum: int = MIN_ROW_BUCKET) -> int:
+    """Padded leading-dim for ``n`` logical rows (static-shape discipline)."""
+    return max(minimum, _next_pow2(n))
+
+
+def bucket_strlen(n: int) -> int:
+    return max(MIN_STR_BUCKET, _next_pow2(n))
+
+
+_X64_READY = False
+
+
+def _jnp():
+    """jax.numpy with 64-bit types enforced.
+
+    A SQL engine cannot live without int64/float64 (LongType, TimestampType,
+    decimal limbs), so x64 mode is a hard requirement of the runtime — the
+    reference equivalently requires 64-bit cuDF types throughout.
+    """
+    global _X64_READY
+    import jax
+    if not _X64_READY:
+        jax.config.update("jax_enable_x64", True)
+        _X64_READY = True
+    return jax.numpy
+
+
+def _validity_buffer(valid: np.ndarray):
+    """(packed-bits arrow validity buffer or None, null_count)."""
+    import pyarrow as pa
+    valid = np.asarray(valid, dtype=bool)
+    if valid.all():
+        return None, 0
+    return (pa.py_buffer(np.packbits(valid, bitorder="little").tobytes()),
+            int((~valid).sum()))
+
+
+def _decimal128_from_limbs(hi: np.ndarray, lo: np.ndarray, valid, dt):
+    """Builds an arrow decimal128 array from int64 hi/lo limbs (vectorized)."""
+    import pyarrow as pa
+    n = len(lo)
+    buf = np.empty((n, 2), dtype=np.int64)
+    buf[:, 0] = lo  # little-endian: low limb first
+    buf[:, 1] = hi
+    vbuf, nulls = (None, 0) if valid is None else _validity_buffer(valid)
+    return pa.Array.from_buffers(
+        pa.decimal128(dt.precision, dt.scale), n,
+        [vbuf, pa.py_buffer(buf.tobytes())], null_count=nulls)
+
+
+def _binary_from_rectangular(chars: np.ndarray, lens: np.ndarray,
+                             valid: np.ndarray):
+    """Builds an arrow binary array from uint8[n, w] + lengths (vectorized)."""
+    import pyarrow as pa
+    n = len(lens)
+    lens64 = np.where(valid, lens, 0).astype(np.int64)
+    offsets = np.zeros(n + 1, dtype=np.int32)
+    np.cumsum(lens64, out=offsets[1:])
+    total = int(lens64.sum())
+    if total:
+        row_idx = np.repeat(np.arange(n, dtype=np.int64), lens64)
+        head = np.repeat(np.cumsum(lens64) - lens64, lens64)
+        within = np.arange(total, dtype=np.int64) - head
+        flat = np.ascontiguousarray(chars[row_idx, within])
+    else:
+        flat = np.zeros(0, dtype=np.uint8)
+    vbuf, nulls = _validity_buffer(valid)
+    return pa.Array.from_buffers(
+        pa.binary(), n,
+        [vbuf, pa.py_buffer(offsets.tobytes()), pa.py_buffer(flat.tobytes())],
+        null_count=nulls)
+
+
+# ---------------------------------------------------------------------------
+# Host column
+# ---------------------------------------------------------------------------
+
+class HostColumn:
+    """A host column: pyarrow Array + our logical DataType.
+
+    The Arrow buffers are the host representation for IO, shuffle wire format
+    and CPU-fallback compute (the reference's analog is JCudfSerialization's
+    host columnar layout + RapidsHostColumnVector).
+    """
+
+    __slots__ = ("arrow", "data_type")
+
+    def __init__(self, arrow_array, data_type: Optional[T.DataType] = None):
+        import pyarrow as pa
+        if isinstance(arrow_array, pa.ChunkedArray):
+            arrow_array = arrow_array.combine_chunks()
+        self.arrow = arrow_array
+        self.data_type = data_type or T.from_arrow(arrow_array.type)
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def from_numpy(data: np.ndarray, validity: Optional[np.ndarray] = None,
+                   data_type: Optional[T.DataType] = None) -> "HostColumn":
+        import pyarrow as pa
+        dt = data_type or T.from_numpy_dtype(data.dtype)
+        if data.dtype.kind == "M":
+            # normalize datetime64 of any unit to our canonical physical repr
+            if isinstance(dt, T.DateType):
+                data = data.astype("datetime64[D]").astype(np.int32)
+            else:
+                data = data.astype("datetime64[us]").astype(np.int64)
+        mask = None if validity is None else ~np.asarray(validity, dtype=bool)
+        if isinstance(dt, T.NullType):
+            arr = pa.nulls(len(data))
+        elif isinstance(dt, T.DecimalType) and not dt.is_decimal128:
+            lo = data.astype(np.int64)
+            hi = np.where(lo < 0, np.int64(-1), np.int64(0))
+            arr = _decimal128_from_limbs(hi, lo,
+                                         None if mask is None else ~mask, dt)
+        elif isinstance(dt, T.TimestampType):
+            arr = pa.array(data.astype(np.int64), type=pa.int64(),
+                           mask=mask).cast(pa.timestamp("us", tz="UTC"))
+        elif isinstance(dt, T.DateType):
+            arr = pa.array(data.astype(np.int32), type=pa.int32(),
+                           mask=mask).cast(pa.date32())
+        else:
+            arr = pa.array(data, type=T.to_arrow(dt), mask=mask)
+        return HostColumn(arr, dt)
+
+    @staticmethod
+    def from_pylist(values, data_type: Optional[T.DataType] = None) -> "HostColumn":
+        import pyarrow as pa
+        if data_type is not None:
+            return HostColumn(pa.array(values, type=T.to_arrow(data_type)),
+                              data_type)
+        arr = pa.array(values)
+        return HostColumn(arr)
+
+    # -- accessors ----------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self.arrow)
+
+    @property
+    def null_count(self) -> int:
+        return self.arrow.null_count
+
+    def validity_np(self) -> np.ndarray:
+        """Returns bool[rows], True where valid."""
+        import pyarrow.compute as pc
+        if self.arrow.null_count == 0:
+            return np.ones(len(self.arrow), dtype=bool)
+        return pc.is_valid(self.arrow).to_numpy(zero_copy_only=False)
+
+    def data_np(self) -> np.ndarray:
+        """Dense data as numpy, nulls filled with zeros (use validity_np)."""
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        dt = self.data_type
+        if isinstance(dt, (T.StringType, T.BinaryType)):
+            raise TypeError("use string_np() for string columns")
+        if isinstance(dt, T.DecimalType):
+            # vectorized unscaled-limb extraction straight from the arrow
+            # 16-byte little-endian buffer (reference: cuDF DECIMAL64/128
+            # columns expose unscaled values the same way)
+            arr = self.arrow
+            if not pa.types.is_decimal128(arr.type):
+                arr = arr.cast(pa.decimal128(dt.precision, dt.scale))
+            n = len(arr)
+            raw = np.frombuffer(arr.buffers()[1], dtype=np.int64,
+                                offset=arr.offset * 16, count=2 * n).reshape(n, 2)
+            lo = raw[:, 0].copy()
+            hi = raw[:, 1].copy()
+            valid = self.validity_np()
+            lo[~valid] = 0
+            hi[~valid] = 0
+            if dt.is_decimal128:
+                return np.stack([hi, lo], axis=1)  # device layout is [hi, lo]
+            return lo
+        arr = self.arrow
+        if isinstance(dt, T.TimestampType):
+            arr = arr.cast("int64")
+        elif isinstance(dt, T.DateType):
+            arr = arr.cast("int32")
+        elif isinstance(dt, T.NullType):
+            return np.zeros(len(arr), dtype=np.int8)
+        if arr.null_count:
+            import pyarrow as pa
+            zero = pa.scalar(0, type=arr.type) if dt.np_dtype.kind != "b" \
+                else pa.scalar(False, type=arr.type)
+            arr = pc.fill_null(arr, zero)
+        return arr.to_numpy(zero_copy_only=False).astype(dt.np_dtype, copy=False)
+
+    def string_np(self, max_len: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+        """Rectangularizes to (uint8[rows, max_len], int32 lengths)."""
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        arr = self.arrow
+        if pa.types.is_string(arr.type):
+            arr = arr.cast(pa.binary())
+        elif pa.types.is_large_string(arr.type) or pa.types.is_large_binary(arr.type):
+            arr = arr.cast(pa.binary())
+        filled = pc.fill_null(arr, b"")
+        lens = pc.binary_length(filled).to_numpy(zero_copy_only=False).astype(np.int32)
+        ml = int(lens.max()) if len(lens) else 0
+        width = bucket_strlen(max(ml, 1) if max_len is None else max_len)
+        out = np.zeros((len(arr), width), dtype=np.uint8)
+        combined = filled.combine_chunks() if isinstance(filled, pa.ChunkedArray) else filled
+        buf = combined.buffers()
+        # arrow binary: buffers = [validity, offsets(int32), data]
+        offsets = np.frombuffer(buf[1], dtype=np.int32,
+                                count=len(arr) + 1, offset=combined.offset * 4)
+        databuf = np.frombuffer(buf[2], dtype=np.uint8) if buf[2] is not None \
+            else np.zeros(0, dtype=np.uint8)
+        np.minimum(lens, width, out=lens)
+        # vectorized ragged->rectangular scatter
+        total = int(lens.sum())
+        if total:
+            lens64 = lens.astype(np.int64)
+            row_idx = np.repeat(np.arange(len(arr), dtype=np.int64), lens64)
+            starts = np.repeat(offsets[:-1].astype(np.int64), lens64)
+            head = np.repeat(np.cumsum(lens64) - lens64, lens64)
+            within = np.arange(total, dtype=np.int64) - head
+            out[row_idx, within] = databuf[starts + within]
+        return out, lens
+
+    def to_pylist(self):
+        return self.arrow.to_pylist()
+
+    def slice(self, offset: int, length: int) -> "HostColumn":
+        return HostColumn(self.arrow.slice(offset, length), self.data_type)
+
+    def nbytes(self) -> int:
+        return sum(b.size for b in self.arrow.buffers() if b is not None)
+
+    def __repr__(self):
+        return f"HostColumn({self.data_type}, rows={len(self)}, nulls={self.null_count})"
+
+
+# ---------------------------------------------------------------------------
+# Device column
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class DeviceColumn:
+    """A device column vector (reference: GpuColumnVector over cudf).
+
+    Invariants:
+      - ``data.shape[0] == validity.shape[0] == bucket >= row_count``
+      - rows in ``[row_count, bucket)`` have ``validity == False``
+      - scalar types: data is 1-D jax array of the mapped dtype
+      - string/binary: data is uint8[bucket, strwidth]; ``lengths`` int32[bucket]
+      - decimal128: data is int64[bucket, 2] (hi limb, lo limb-as-int64-bits)
+    """
+
+    data: Any                      # jax Array
+    validity: Any                  # jax bool Array [bucket]
+    row_count: int
+    data_type: T.DataType
+    lengths: Any = None            # jax int32 Array [bucket] for strings
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def from_host(col: HostColumn, bucket: Optional[int] = None) -> "DeviceColumn":
+        jnp = _jnp()
+        n = len(col)
+        b = bucket or bucket_rows(n)
+        valid = np.zeros(b, dtype=bool)
+        valid[:n] = col.validity_np()
+        dt = col.data_type
+        if isinstance(dt, (T.StringType, T.BinaryType)):
+            chars, lens = col.string_np()
+            data = np.zeros((b, chars.shape[1]), dtype=np.uint8)
+            data[:n] = chars
+            lengths = np.zeros(b, dtype=np.int32)
+            lengths[:n] = lens
+            return DeviceColumn(jnp.asarray(data), jnp.asarray(valid), n, dt,
+                                lengths=jnp.asarray(lengths))
+        raw = col.data_np()
+        if isinstance(dt, T.DecimalType) and dt.is_decimal128:
+            data = np.zeros((b, 2), dtype=np.int64)
+            data[:n] = raw
+        else:
+            data = np.zeros((b,) + raw.shape[1:], dtype=raw.dtype)
+            data[:n] = raw
+        return DeviceColumn(jnp.asarray(data), jnp.asarray(valid), n, dt)
+
+    @staticmethod
+    def from_parts(data, validity, row_count: int, data_type: T.DataType,
+                   lengths=None) -> "DeviceColumn":
+        return DeviceColumn(data, validity, row_count, data_type, lengths)
+
+    # -- accessors ----------------------------------------------------------
+    @property
+    def bucket(self) -> int:
+        return int(self.data.shape[0])
+
+    def __len__(self) -> int:
+        return self.row_count
+
+    @property
+    def is_string(self) -> bool:
+        return isinstance(self.data_type, (T.StringType, T.BinaryType))
+
+    def nbytes(self) -> int:
+        n = self.data.size * self.data.dtype.itemsize + self.validity.size
+        if self.lengths is not None:
+            n += self.lengths.size * 4
+        return int(n)
+
+    def to_host(self) -> HostColumn:
+        import pyarrow as pa
+        n = self.row_count
+        valid = np.asarray(self.validity)[:n]
+        dt = self.data_type
+        if isinstance(dt, T.NullType):
+            return HostColumn(pa.nulls(n), dt)
+        if self.is_string:
+            chars = np.asarray(self.data)[:n]
+            lens = np.asarray(self.lengths)[:n]
+            binary = _binary_from_rectangular(chars, lens, valid)
+            if isinstance(dt, T.StringType):
+                try:
+                    return HostColumn(binary.cast(pa.string()), dt)
+                except pa.ArrowInvalid:
+                    # kernel produced non-UTF8 bytes; decode with replacement
+                    py = [None if v is None else v.decode("utf-8", "replace")
+                          for v in binary.to_pylist()]
+                    return HostColumn(pa.array(py, type=pa.string()), dt)
+            return HostColumn(binary, dt)
+        raw = np.asarray(self.data)[:n]
+        if isinstance(dt, T.DecimalType):
+            if dt.is_decimal128:
+                hi, lo = raw[:, 0], raw[:, 1]
+            else:
+                lo = raw.astype(np.int64)
+                hi = np.where(lo < 0, np.int64(-1), np.int64(0))
+            return HostColumn(_decimal128_from_limbs(hi, lo, valid, dt), dt)
+        return HostColumn.from_numpy(raw, valid, dt)
+
+    def with_row_count(self, n: int) -> "DeviceColumn":
+        return DeviceColumn(self.data, self.validity, n, self.data_type,
+                            self.lengths)
+
+    def __repr__(self):
+        return (f"DeviceColumn({self.data_type}, rows={self.row_count}, "
+                f"bucket={self.bucket})")

@@ -1,0 +1,408 @@
+"""Typed, self-registering configuration system.
+
+Mirrors the reference's ``RapidsConf.scala`` (sql-plugin/src/main/scala/com/
+nvidia/spark/rapids/RapidsConf.scala:121 ConfEntry, :260 ConfBuilder, :319
+registry): every config is a typed ``ConfEntry`` registered at import time in a
+global registry, with startup/commonly-used/internal levels, and user docs
+generated from the registry (reference generates docs/configs.md the same way).
+
+Keys use the ``spark.rapids.*`` namespace for drop-in familiarity for users of
+the reference plugin; TPU-specific keys live under ``spark.rapids.tpu.*``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+import threading
+from typing import Any, Callable, Dict, Generic, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+__all__ = ["ConfEntry", "TpuConf", "registry", "generate_docs", "ConfLevel"]
+
+
+class ConfLevel(enum.Enum):
+    STARTUP = "startup"          # read once at plugin init
+    COMMONLY_USED = "common"     # per-query tunables users touch
+    INTERNAL = "internal"        # test/debug knobs
+
+
+_REGISTRY: Dict[str, "ConfEntry"] = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+def registry() -> Dict[str, "ConfEntry"]:
+    return dict(_REGISTRY)
+
+
+@dataclasses.dataclass
+class ConfEntry(Generic[T]):
+    key: str
+    doc: str
+    default: T
+    converter: Callable[[str], T]
+    level: ConfLevel = ConfLevel.COMMONLY_USED
+    checker: Optional[Callable[[T], bool]] = None
+
+    def get(self, conf: "TpuConf") -> T:
+        return conf.get(self.key)
+
+    def __post_init__(self):
+        with _REGISTRY_LOCK:
+            if self.key in _REGISTRY:
+                raise ValueError(f"duplicate conf key {self.key}")
+            _REGISTRY[self.key] = self
+
+
+def _to_bool(s: str) -> bool:
+    if isinstance(s, bool):
+        return s
+    v = s.strip().lower()
+    if v in ("true", "1", "yes", "on"):
+        return True
+    if v in ("false", "0", "no", "off"):
+        return False
+    raise ValueError(f"not a boolean: {s!r}")
+
+
+def _bytes_conv(s: str) -> int:
+    """Parses byte sizes like '512m', '8g' (Spark-style suffixes)."""
+    if isinstance(s, int):
+        return s
+    v = s.strip().lower()
+    mult = 1
+    for suffix, m in (("k", 1 << 10), ("m", 1 << 20), ("g", 1 << 30), ("t", 1 << 40)):
+        if v.endswith(suffix + "b"):
+            v, mult = v[:-2], m
+            break
+        if v.endswith(suffix):
+            v, mult = v[:-1], m
+            break
+    if v.endswith("b"):
+        v = v[:-1]
+    return int(float(v) * mult)
+
+
+def conf_bool(key, doc, default, level=ConfLevel.COMMONLY_USED) -> ConfEntry[bool]:
+    return ConfEntry(key, doc, default, _to_bool, level)
+
+
+def conf_int(key, doc, default, level=ConfLevel.COMMONLY_USED,
+             checker=None) -> ConfEntry[int]:
+    return ConfEntry(key, doc, default, int, level, checker)
+
+
+def conf_float(key, doc, default, level=ConfLevel.COMMONLY_USED) -> ConfEntry[float]:
+    return ConfEntry(key, doc, default, float, level)
+
+
+def conf_str(key, doc, default, level=ConfLevel.COMMONLY_USED) -> ConfEntry[str]:
+    return ConfEntry(key, doc, default, str, level)
+
+
+def conf_bytes(key, doc, default, level=ConfLevel.COMMONLY_USED) -> ConfEntry[int]:
+    return ConfEntry(key, doc, default, _bytes_conv, level)
+
+
+# ---------------------------------------------------------------------------
+# Registered entries.  Counterparts cited to reference RapidsConf.scala keys.
+# ---------------------------------------------------------------------------
+
+SQL_ENABLED = conf_bool(
+    "spark.rapids.sql.enabled",
+    "Enable or disable TPU acceleration of SQL plans entirely.",
+    True)
+
+SQL_MODE = conf_str(
+    "spark.rapids.sql.mode",
+    "Operating mode: 'executeOnGPU' runs supported plans on the TPU; "
+    "'explainOnly' plans and logs what would run on TPU but executes on CPU "
+    "(reference RapidsConf 'spark.rapids.sql.mode').",
+    "executeOnGPU")
+
+EXPLAIN = conf_str(
+    "spark.rapids.sql.explain",
+    "What to log about plan placement: NONE, NOT_ON_GPU, ALL.",
+    "NOT_ON_GPU")
+
+TEST_ENABLED = conf_bool(
+    "spark.rapids.sql.test.enabled",
+    "Test mode: fail if any operator in the plan did not translate to the TPU "
+    "(reference 'spark.rapids.sql.test.enabled').",
+    False, ConfLevel.INTERNAL)
+
+TEST_ALLOWED_NONGPU = conf_str(
+    "spark.rapids.sql.test.allowedNonGpu",
+    "Comma-separated exec class names allowed to stay on CPU in test mode.",
+    "", ConfLevel.INTERNAL)
+
+INCOMPATIBLE_OPS = conf_bool(
+    "spark.rapids.sql.incompatibleOps.enabled",
+    "Enable operators whose TPU results can differ from CPU in documented "
+    "ways (float ordering, regex dialect...). Reference "
+    "'spark.rapids.sql.incompatibleOps.enabled'.",
+    True)
+
+HAS_NANS = conf_bool(
+    "spark.rapids.sql.hasNans",
+    "Assume floating point data may contain NaN (affects agg/join tagging).",
+    True)
+
+VARIABLE_FLOAT_AGG = conf_bool(
+    "spark.rapids.sql.variableFloatAgg.enabled",
+    "Allow float aggregations whose result can vary with evaluation order.",
+    True)
+
+IMPROVED_FLOAT_OPS = conf_bool(
+    "spark.rapids.sql.improvedFloatOps.enabled",
+    "Use float paths faster than, but not bit-identical to, CPU.",
+    True)
+
+BATCH_SIZE_BYTES = conf_bytes(
+    "spark.rapids.sql.batchSizeBytes",
+    "Target output batch size in bytes (CoalesceGoal TargetSize; reference "
+    "'spark.rapids.sql.batchSizeBytes' default 1g; TPU default smaller since "
+    "HBM per chip is smaller).",
+    512 << 20)
+
+MAX_READER_BATCH_SIZE_ROWS = conf_int(
+    "spark.rapids.sql.reader.batchSizeRows",
+    "Max rows a file reader produces per batch.",
+    1 << 20)
+
+MAX_READER_BATCH_SIZE_BYTES = conf_bytes(
+    "spark.rapids.sql.reader.batchSizeBytes",
+    "Soft max bytes a file reader produces per batch.",
+    512 << 20)
+
+CONCURRENT_TPU_TASKS = conf_int(
+    "spark.rapids.sql.concurrentGpuTasks",
+    "Number of tasks that may hold the device concurrently (TpuSemaphore; "
+    "reference 'spark.rapids.sql.concurrentGpuTasks', RapidsConf.scala:544).",
+    2)
+
+ROW_BUCKET_MIN = conf_int(
+    "spark.rapids.tpu.batch.rowBucketMin",
+    "Minimum padded row-count bucket for device batches. Device batches are "
+    "padded to power-of-two row buckets so XLA compiles once per bucket "
+    "rather than once per batch size (TPU-first static-shape discipline).",
+    1 << 10, ConfLevel.STARTUP)
+
+DEVICE_POOL_FRACTION = conf_float(
+    "spark.rapids.memory.gpu.allocFraction",
+    "Fraction of HBM to dedicate to the buffer pool at init "
+    "(reference 'spark.rapids.memory.gpu.allocFraction').",
+    0.8)
+
+DEVICE_POOL_SIZE = conf_bytes(
+    "spark.rapids.tpu.memory.pool.size",
+    "Absolute device pool size override for tests; 0 = use allocFraction of "
+    "detected HBM.",
+    0, ConfLevel.INTERNAL)
+
+HOST_SPILL_STORAGE_SIZE = conf_bytes(
+    "spark.rapids.memory.host.spillStorageSize",
+    "Bytes of host memory used to spill device buffers before disk "
+    "(reference 'spark.rapids.memory.host.spillStorageSize').",
+    1 << 30)
+
+PAGEABLE_POOL_SIZE = conf_bytes(
+    "spark.rapids.memory.host.pageablePool.size",
+    "Host allocation pool size.",
+    1 << 30, ConfLevel.STARTUP)
+
+OOM_RETRY_COUNT = conf_int(
+    "spark.rapids.memory.gpu.oomDumpRetryCount",
+    "How many synchronous spill-and-retry attempts on device alloc failure "
+    "before declaring OOM (reference DeviceMemoryEventHandler retry loop).",
+    10, ConfLevel.INTERNAL)
+
+OOM_INJECTION_MODE = conf_str(
+    "spark.rapids.sql.test.injectRetryOOM",
+    "Deterministic OOM fault injection for tests: 'false', 'true' (first "
+    "alloc of each task), or '<n>' to fault the n-th tracked allocation "
+    "(reference RapidsConf.scala:1541 TEST_RETRY_OOM_INJECTION_MODE).",
+    "false", ConfLevel.INTERNAL)
+
+SPILL_TO_DISK_DIR = conf_str(
+    "spark.rapids.tpu.spill.dir",
+    "Directory for the disk tier of the buffer catalog.",
+    "", ConfLevel.STARTUP)
+
+SHUFFLE_MANAGER_MODE = conf_str(
+    "spark.rapids.shuffle.mode",
+    "Shuffle mode: CACHE_ONLY | MULTITHREADED | ICI "
+    "(reference RapidsShuffleManagerMode UCX|CACHE_ONLY|MULTITHREADED).",
+    "MULTITHREADED")
+
+SHUFFLE_WRITER_THREADS = conf_int(
+    "spark.rapids.shuffle.multiThreaded.writer.threads",
+    "Thread pool size for multithreaded shuffle writes.",
+    8)
+
+SHUFFLE_READER_THREADS = conf_int(
+    "spark.rapids.shuffle.multiThreaded.reader.threads",
+    "Thread pool size for multithreaded shuffle reads.",
+    8)
+
+SHUFFLE_COMPRESSION_CODEC = conf_str(
+    "spark.rapids.shuffle.compression.codec",
+    "Codec for shuffle payloads: none | lz4 | zstd (reference nvcomp "
+    "LZ4/ZSTD; here host-side codecs from libtpucol / python-zstandard).",
+    "lz4")
+
+SHUFFLE_PARTITIONS = conf_int(
+    "spark.sql.shuffle.partitions",
+    "Default partition count for shuffles (Spark core conf, honored here).",
+    16)
+
+METRICS_LEVEL = conf_str(
+    "spark.rapids.sql.metrics.level",
+    "Metric verbosity: ESSENTIAL | MODERATE | DEBUG (reference GpuExec.scala:36).",
+    "MODERATE")
+
+STABLE_SORT = conf_bool(
+    "spark.rapids.sql.stableSort.enabled",
+    "Force stable full sorts (disables some out-of-core optimizations).",
+    False)
+
+AGG_FALLBACK_PARTITIONS = conf_int(
+    "spark.rapids.sql.agg.fallbackPartitions",
+    "Bucket count when merge-aggregation falls back to hash re-partitioning "
+    "(reference GpuAggregateExec repartition fallback).",
+    16, ConfLevel.INTERNAL)
+
+JOIN_SUBPARTITIONS = conf_int(
+    "spark.rapids.sql.join.subPartitions",
+    "Sub-partition count for oversized hash join inputs "
+    "(reference GpuSubPartitionHashJoin).",
+    16, ConfLevel.INTERNAL)
+
+ENABLE_FLOAT_CAST_STRING = conf_bool(
+    "spark.rapids.sql.castFloatToString.enabled",
+    "Enable float->string casts (formatting can differ from CPU in last ulp).",
+    True)
+
+ENABLE_REGEX = conf_bool(
+    "spark.rapids.sql.regexp.enabled",
+    "Enable regular expression acceleration via the transpiler "
+    "(reference 'spark.rapids.sql.regexp.enabled').",
+    True)
+
+CPU_ORACLE_X64 = conf_bool(
+    "spark.rapids.tpu.test.cpuOracleX64",
+    "Run the CPU differential-test oracle in 64-bit float mode.",
+    True, ConfLevel.INTERNAL)
+
+MULTITHREADED_READ_NUM_THREADS = conf_int(
+    "spark.rapids.sql.multiThreadedRead.numThreads",
+    "Thread pool size for MULTITHREADED file readers.",
+    8)
+
+READER_TYPE = conf_str(
+    "spark.rapids.sql.format.parquet.reader.type",
+    "Parquet reader strategy: AUTO | PERFILE | COALESCING | MULTITHREADED "
+    "(reference RapidsConf.scala:314 RapidsReaderType).",
+    "AUTO")
+
+DEVICE_STRING_MAX_LEN = conf_int(
+    "spark.rapids.tpu.string.maxDeviceLen",
+    "Strings longer than this stay on the host tier (device strings are "
+    "padded [rows, max_len] uint8; padding cost grows with max length).",
+    256)
+
+RMM_DEBUG = conf_bool(
+    "spark.rapids.memory.gpu.debug",
+    "Log every pool allocation/free (reference RapidsConf.scala:375).",
+    False, ConfLevel.INTERNAL)
+
+PROFILE_PATH = conf_str(
+    "spark.rapids.profile.pathPrefix",
+    "If set, write per-stage trace files under this path (reference profiler.scala).",
+    "", ConfLevel.INTERNAL)
+
+CBO_ENABLED = conf_bool(
+    "spark.rapids.sql.optimizer.enabled",
+    "Enable the transition cost-based optimizer (reference CostBasedOptimizer.scala).",
+    False)
+
+
+class TpuConf:
+    """Immutable snapshot of config values (reference: ``new RapidsConf(conf)``
+    re-read per query, GpuOverrides.scala:4564)."""
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        # (environment overrides are applied by default_conf(), which scans
+        # SPARK_RAPIDS_CONF_* env vars; a bare TpuConf() reads only `settings`)
+        self._values: Dict[str, Any] = {}
+        settings = dict(settings or {})
+        for k, entry in _REGISTRY.items():
+            if k in settings:
+                raw = settings.pop(k)
+                val = entry.converter(raw) if isinstance(raw, str) else raw
+                if entry.checker is not None and not entry.checker(val):
+                    raise ValueError(f"invalid value for {k}: {raw!r}")
+                self._values[k] = val
+            else:
+                self._values[k] = entry.default
+        self._extra = settings  # unregistered keys kept verbatim
+
+    def get(self, key: str, default: Any = None) -> Any:
+        if key in self._values:
+            return self._values[key]
+        return self._extra.get(key, default)
+
+    def with_overrides(self, **kv) -> "TpuConf":
+        merged = {**self._values, **self._extra}
+        merged.update({k.replace("__", "."): v for k, v in kv.items()})
+        return TpuConf(merged)
+
+    def set(self, key: str, value: Any) -> "TpuConf":
+        merged = {**self._values, **self._extra, key: value}
+        return TpuConf(merged)
+
+    # convenience accessors used on hot paths
+    @property
+    def is_sql_enabled(self) -> bool:
+        return self.get(SQL_ENABLED.key)
+
+    @property
+    def is_explain_only(self) -> bool:
+        return self.get(SQL_MODE.key).lower() == "explainonly"
+
+    @property
+    def batch_size_bytes(self) -> int:
+        return self.get(BATCH_SIZE_BYTES.key)
+
+    @property
+    def is_test_enabled(self) -> bool:
+        return self.get(TEST_ENABLED.key)
+
+    def __repr__(self):
+        non_default = {k: v for k, v in self._values.items()
+                       if v != _REGISTRY[k].default}
+        return f"TpuConf({non_default})"
+
+
+def generate_docs() -> str:
+    """Generates the configuration reference (reference: docs/configs.md is
+    generated from RapidsConf; RapidsConf.scala 'object RapidsConf' doc gen)."""
+    lines = ["# spark-rapids-tpu Configuration", "",
+             "| Key | Default | Level | Description |",
+             "|---|---|---|---|"]
+    for key in sorted(_REGISTRY):
+        e = _REGISTRY[key]
+        doc = " ".join(str(e.doc).split())
+        lines.append(f"| {e.key} | {e.default!r} | {e.level.value} | {doc} |")
+    return "\n".join(lines) + "\n"
+
+
+def default_conf() -> TpuConf:
+    overrides = {}
+    prefix = "SPARK_RAPIDS_CONF_"
+    for k, v in os.environ.items():
+        if k.startswith(prefix):
+            overrides[k[len(prefix):].replace("_", ".")] = v
+    return TpuConf(overrides)

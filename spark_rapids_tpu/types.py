@@ -1,0 +1,430 @@
+"""Data type system.
+
+Spark-SQL-equivalent logical types with mappings to both the host (numpy /
+Arrow) and device (JAX) representations.  Mirrors the role of Spark's
+``org.apache.spark.sql.types`` plus the Spark<->cuDF ``DType`` mapping in the
+reference's ``GpuColumnVector.java`` (sql-plugin/src/main/java/com/nvidia/
+spark/rapids/GpuColumnVector.java:1-200, getNonNestedRapidsType).
+
+TPU-first notes:
+- TPU has no native float64 ALU path worth using; float64 columns are kept as
+  float64 on host and computed as float64 via x64-enabled jax on CPU fallback,
+  or computed in float32 on device only when the op is tagged float-tolerant.
+  (The reference documents similar float compromises in docs/compatibility.md.)
+- DECIMAL(p<=18) is an int64 with a scale ("decimal64"); DECIMAL(p<=38) is a
+  (hi int64, lo uint64) limb pair ("decimal128") with arithmetic implemented in
+  jax integer ops (reference uses cuDF DECIMAL128 + DecimalUtils JNI).
+- Strings are variable-length on host (Arrow offsets+bytes) and padded 2-D
+  uint8 [rows, max_len] on device: TPU kernels want rectangular layouts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "DataType", "NullType", "BooleanType", "ByteType", "ShortType",
+    "IntegerType", "LongType", "FloatType", "DoubleType", "StringType",
+    "BinaryType", "DateType", "TimestampType", "DecimalType", "ArrayType",
+    "MapType", "StructField", "StructType", "NULL", "BOOLEAN", "BYTE",
+    "SHORT", "INT", "LONG", "FLOAT", "DOUBLE", "STRING", "BINARY", "DATE",
+    "TIMESTAMP", "from_numpy_dtype", "from_arrow", "to_arrow", "common_type",
+]
+
+
+class DataType:
+    """Base class of the logical type lattice."""
+
+    #: numpy dtype used for the host representation of the *data* buffer.
+    np_dtype: Optional[np.dtype] = None
+
+    @property
+    def simple_name(self) -> str:
+        return type(self).__name__.replace("Type", "").lower()
+
+    # -- classification helpers (used by TypeSig / planner tagging) ---------
+    @property
+    def is_numeric(self) -> bool:
+        return isinstance(self, (ByteType, ShortType, IntegerType, LongType,
+                                 FloatType, DoubleType, DecimalType))
+
+    @property
+    def is_integral(self) -> bool:
+        return isinstance(self, (ByteType, ShortType, IntegerType, LongType))
+
+    @property
+    def is_floating(self) -> bool:
+        return isinstance(self, (FloatType, DoubleType))
+
+    @property
+    def is_nested(self) -> bool:
+        return isinstance(self, (ArrayType, MapType, StructType))
+
+    @property
+    def default_size(self) -> int:
+        """Estimated per-row byte width (planner sizing, CoalesceGoal math)."""
+        if self.np_dtype is not None:
+            return int(np.dtype(self.np_dtype).itemsize)
+        return 8
+
+    def __eq__(self, other) -> bool:
+        return type(self) is type(other)
+
+    def __hash__(self) -> int:
+        return hash(type(self).__name__)
+
+    def __repr__(self) -> str:
+        return self.simple_name
+
+
+class NullType(DataType):
+    np_dtype = np.dtype(np.int8)  # carrier; every row is null
+
+
+class BooleanType(DataType):
+    np_dtype = np.dtype(np.bool_)
+
+
+class ByteType(DataType):
+    np_dtype = np.dtype(np.int8)
+
+
+class ShortType(DataType):
+    np_dtype = np.dtype(np.int16)
+
+
+class IntegerType(DataType):
+    np_dtype = np.dtype(np.int32)
+
+
+class LongType(DataType):
+    np_dtype = np.dtype(np.int64)
+
+
+class FloatType(DataType):
+    np_dtype = np.dtype(np.float32)
+
+
+class DoubleType(DataType):
+    np_dtype = np.dtype(np.float64)
+
+
+class StringType(DataType):
+    np_dtype = None  # variable length
+
+    @property
+    def default_size(self) -> int:
+        return 32
+
+
+class BinaryType(DataType):
+    np_dtype = None
+
+    @property
+    def default_size(self) -> int:
+        return 32
+
+
+class DateType(DataType):
+    """Days since unix epoch, int32 (Spark DateType semantics)."""
+    np_dtype = np.dtype(np.int32)
+
+
+class TimestampType(DataType):
+    """Microseconds since unix epoch UTC, int64 (Spark TimestampType)."""
+    np_dtype = np.dtype(np.int64)
+
+
+@dataclasses.dataclass(frozen=True)
+class DecimalType(DataType):
+    """Fixed-point decimal. precision<=18 -> int64 repr; <=38 -> 128-bit limbs.
+
+    Matches Spark's DecimalType bounds; the reference maps these to cuDF
+    DECIMAL64/DECIMAL128 (GpuColumnVector.java getNonNestedRapidsType).
+    """
+    precision: int = 10
+    scale: int = 0
+
+    MAX_PRECISION = 38
+    MAX_LONG_DIGITS = 18
+
+    def __post_init__(self):
+        if not (0 < self.precision <= self.MAX_PRECISION):
+            raise ValueError(f"decimal precision out of range: {self.precision}")
+        if not (0 <= self.scale <= self.precision):
+            raise ValueError(
+                f"decimal scale {self.scale} out of range for precision {self.precision}")
+
+    @property
+    def np_dtype(self):  # type: ignore[override]
+        # decimal128 host repr is a structured view handled by the column class
+        return np.dtype(np.int64) if self.precision <= self.MAX_LONG_DIGITS else None
+
+    @property
+    def is_decimal128(self) -> bool:
+        return self.precision > self.MAX_LONG_DIGITS
+
+    @property
+    def simple_name(self) -> str:
+        return f"decimal({self.precision},{self.scale})"
+
+    @property
+    def default_size(self) -> int:
+        return 8 if not self.is_decimal128 else 16
+
+    def bounded(self) -> "DecimalType":
+        return self
+
+    def __repr__(self) -> str:
+        return self.simple_name
+
+
+@dataclasses.dataclass(frozen=True)
+class ArrayType(DataType):
+    element_type: DataType = dataclasses.field(default_factory=IntegerType)
+    contains_null: bool = True
+
+    np_dtype = None
+
+    @property
+    def simple_name(self) -> str:
+        return f"array<{self.element_type.simple_name}>"
+
+    @property
+    def default_size(self) -> int:
+        return 4 * self.element_type.default_size
+
+    def __repr__(self) -> str:
+        return self.simple_name
+
+
+@dataclasses.dataclass(frozen=True)
+class MapType(DataType):
+    key_type: DataType = dataclasses.field(default_factory=StringType)
+    value_type: DataType = dataclasses.field(default_factory=StringType)
+    value_contains_null: bool = True
+
+    np_dtype = None
+
+    @property
+    def simple_name(self) -> str:
+        return f"map<{self.key_type.simple_name},{self.value_type.simple_name}>"
+
+    def __repr__(self) -> str:
+        return self.simple_name
+
+
+@dataclasses.dataclass(frozen=True)
+class StructField:
+    name: str
+    data_type: DataType
+    nullable: bool = True
+
+
+@dataclasses.dataclass(frozen=True, init=False)
+class StructType(DataType):
+    fields: Tuple[StructField, ...]
+
+    np_dtype = None
+
+    def __init__(self, fields=()):
+        object.__setattr__(self, "fields", tuple(fields))
+
+    def add(self, name: str, dt: DataType, nullable: bool = True) -> "StructType":
+        return StructType(self.fields + (StructField(name, dt, nullable),))
+
+    @property
+    def names(self):
+        return [f.name for f in self.fields]
+
+    @property
+    def types(self):
+        return [f.data_type for f in self.fields]
+
+    def field_index(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+    def __len__(self):
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    @property
+    def simple_name(self) -> str:
+        inner = ",".join(f"{f.name}:{f.data_type.simple_name}" for f in self.fields)
+        return f"struct<{inner}>"
+
+    @property
+    def default_size(self) -> int:
+        return sum(f.data_type.default_size for f in self.fields)
+
+    def __repr__(self) -> str:
+        return self.simple_name
+
+
+# Singletons for the non-parametric types
+NULL = NullType()
+BOOLEAN = BooleanType()
+BYTE = ByteType()
+SHORT = ShortType()
+INT = IntegerType()
+LONG = LongType()
+FLOAT = FloatType()
+DOUBLE = DoubleType()
+STRING = StringType()
+BINARY = BinaryType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+
+_NUMPY_TO_TYPE = {
+    np.dtype(np.bool_): BOOLEAN,
+    np.dtype(np.int8): BYTE,
+    np.dtype(np.int16): SHORT,
+    np.dtype(np.int32): INT,
+    np.dtype(np.int64): LONG,
+    np.dtype(np.uint8): SHORT,
+    np.dtype(np.uint16): INT,
+    np.dtype(np.uint32): LONG,
+    np.dtype(np.uint64): LONG,
+    np.dtype(np.float16): FLOAT,
+    np.dtype(np.float32): FLOAT,
+    np.dtype(np.float64): DOUBLE,
+}
+
+
+def from_numpy_dtype(dt) -> DataType:
+    dt = np.dtype(dt)
+    if dt in _NUMPY_TO_TYPE:
+        return _NUMPY_TO_TYPE[dt]
+    if dt.kind in ("U", "S", "O"):
+        return STRING
+    if dt.kind == "M":  # datetime64
+        return TIMESTAMP
+    raise TypeError(f"unsupported numpy dtype {dt}")
+
+
+# --- Arrow interop (host IO path uses pyarrow; lazy import keeps core light) --
+
+def from_arrow(at) -> DataType:
+    import pyarrow as pa
+    if pa.types.is_boolean(at):
+        return BOOLEAN
+    if pa.types.is_int8(at):
+        return BYTE
+    if pa.types.is_int16(at):
+        return SHORT
+    if pa.types.is_int32(at):
+        return INT
+    if pa.types.is_int64(at):
+        return LONG
+    if pa.types.is_uint8(at) or pa.types.is_uint16(at):
+        return INT
+    if pa.types.is_uint32(at) or pa.types.is_uint64(at):
+        return LONG
+    if pa.types.is_float16(at) or pa.types.is_float32(at):
+        return FLOAT
+    if pa.types.is_float64(at):
+        return DOUBLE
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return STRING
+    if pa.types.is_binary(at) or pa.types.is_large_binary(at):
+        return BINARY
+    if pa.types.is_date32(at):
+        return DATE
+    if pa.types.is_date64(at):
+        return DATE
+    if pa.types.is_timestamp(at):
+        return TIMESTAMP
+    if pa.types.is_decimal(at):
+        return DecimalType(at.precision, at.scale)
+    if pa.types.is_list(at) or pa.types.is_large_list(at):
+        return ArrayType(from_arrow(at.value_type))
+    if pa.types.is_map(at):
+        return MapType(from_arrow(at.key_type), from_arrow(at.item_type))
+    if pa.types.is_struct(at):
+        return StructType([StructField(f.name, from_arrow(f.type), f.nullable)
+                           for f in at])
+    if pa.types.is_null(at):
+        return NULL
+    if pa.types.is_dictionary(at):
+        return from_arrow(at.value_type)
+    raise TypeError(f"unsupported arrow type {at}")
+
+
+def to_arrow(dt: DataType):
+    import pyarrow as pa
+    if isinstance(dt, BooleanType):
+        return pa.bool_()
+    if isinstance(dt, ByteType):
+        return pa.int8()
+    if isinstance(dt, ShortType):
+        return pa.int16()
+    if isinstance(dt, IntegerType):
+        return pa.int32()
+    if isinstance(dt, LongType):
+        return pa.int64()
+    if isinstance(dt, FloatType):
+        return pa.float32()
+    if isinstance(dt, DoubleType):
+        return pa.float64()
+    if isinstance(dt, StringType):
+        return pa.string()
+    if isinstance(dt, BinaryType):
+        return pa.binary()
+    if isinstance(dt, DateType):
+        return pa.date32()
+    if isinstance(dt, TimestampType):
+        return pa.timestamp("us", tz="UTC")
+    if isinstance(dt, DecimalType):
+        return pa.decimal128(dt.precision, dt.scale)
+    if isinstance(dt, ArrayType):
+        return pa.list_(to_arrow(dt.element_type))
+    if isinstance(dt, MapType):
+        return pa.map_(to_arrow(dt.key_type), to_arrow(dt.value_type))
+    if isinstance(dt, StructType):
+        return pa.struct([(f.name, to_arrow(f.data_type)) for f in dt.fields])
+    if isinstance(dt, NullType):
+        return pa.null()
+    raise TypeError(f"unsupported type {dt}")
+
+
+_PROMOTION_ORDER = [ByteType(), ShortType(), IntegerType(), LongType(),
+                    FloatType(), DoubleType()]
+
+
+def common_type(a: DataType, b: DataType) -> DataType:
+    """Least common numeric promotion (Spark's findTightestCommonType-lite)."""
+    if a == b:
+        return a
+    if isinstance(a, NullType):
+        return b
+    if isinstance(b, NullType):
+        return a
+    if isinstance(a, DecimalType) and isinstance(b, DecimalType):
+        scale = max(a.scale, b.scale)
+        whole = max(a.precision - a.scale, b.precision - b.scale)
+        return DecimalType(min(whole + scale, DecimalType.MAX_PRECISION), scale)
+    if isinstance(a, DecimalType) and b.is_floating:
+        return DOUBLE  # Spark promotes decimal+fractional to double
+    if isinstance(b, DecimalType) and a.is_floating:
+        return DOUBLE
+    if isinstance(a, DecimalType) and b.is_integral:
+        return common_type(a, DecimalType(19 if isinstance(b, LongType) else 10, 0))
+    if isinstance(b, DecimalType) and a.is_integral:
+        return common_type(b, a)
+    if a.is_numeric and b.is_numeric:
+        ia = _PROMOTION_ORDER.index(a)
+        ib = _PROMOTION_ORDER.index(b)
+        return _PROMOTION_ORDER[max(ia, ib)]
+    if isinstance(a, (DateType, TimestampType)) and isinstance(b, (DateType, TimestampType)):
+        return TIMESTAMP
+    if isinstance(a, StringType) or isinstance(b, StringType):
+        return STRING
+    raise TypeError(f"no common type for {a} and {b}")

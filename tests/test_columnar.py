@@ -1,0 +1,128 @@
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import (ColumnarBatch, DeviceColumn, HostColumn,
+                                       HostColumnarBatch, batch_from_arrow,
+                                       batch_from_pydict, bucket_rows)
+from spark_rapids_tpu.columnar.batch import concat_host_batches
+
+
+def test_bucket_rows():
+    assert bucket_rows(0) == 1024
+    assert bucket_rows(1000) == 1024
+    assert bucket_rows(1025) == 2048
+    assert bucket_rows(1 << 20) == 1 << 20
+
+
+def test_host_column_numeric_roundtrip(rng):
+    data = rng.integers(-100, 100, size=500, dtype=np.int64)
+    valid = rng.random(500) > 0.3
+    col = HostColumn.from_numpy(data, valid, T.LONG)
+    assert len(col) == 500
+    assert col.null_count == int((~valid).sum())
+    np.testing.assert_array_equal(col.validity_np(), valid)
+    got = col.data_np()
+    np.testing.assert_array_equal(got[valid], data[valid])
+
+
+def test_host_column_strings():
+    col = HostColumn.from_pylist(["hello", None, "", "world!", "tpu"], T.STRING)
+    chars, lens = col.string_np()
+    assert chars.shape[1] >= 6
+    assert list(lens) == [5, 0, 0, 6, 3]
+    assert bytes(chars[0, :5]) == b"hello"
+    assert bytes(chars[3, :6]) == b"world!"
+
+
+def test_device_roundtrip_numeric(rng):
+    data = rng.standard_normal(300)
+    valid = rng.random(300) > 0.2
+    col = HostColumn.from_numpy(data, valid, T.DOUBLE)
+    dev = DeviceColumn.from_host(col)
+    assert dev.bucket == 1024
+    assert dev.row_count == 300
+    back = dev.to_host()
+    np.testing.assert_array_equal(back.validity_np(), valid)
+    np.testing.assert_allclose(back.data_np()[valid], data[valid])
+
+
+def test_device_roundtrip_strings():
+    vals = ["alpha", None, "betagamma", ""]
+    col = HostColumn.from_pylist(vals, T.STRING)
+    dev = DeviceColumn.from_host(col)
+    assert dev.is_string
+    assert dev.to_host().to_pylist() == vals
+
+
+def test_device_roundtrip_decimal64():
+    dt = T.DecimalType(12, 2)
+    col = HostColumn.from_numpy(np.array([12345, -999, 0], dtype=np.int64),
+                                np.array([True, True, False]), dt)
+    dev = DeviceColumn.from_host(col)
+    back = dev.to_host()
+    import decimal
+    assert back.to_pylist()[:2] == [decimal.Decimal("123.45"),
+                                    decimal.Decimal("-9.99")]
+    assert back.to_pylist()[2] is None
+
+
+def test_device_roundtrip_decimal128():
+    dt = T.DecimalType(30, 3)
+    import decimal
+    vals = [decimal.Decimal("123456789012345678901.234"),
+            decimal.Decimal("-0.001"), None]
+    col = HostColumn(pa.array(vals, type=pa.decimal128(30, 3)), dt)
+    dev = DeviceColumn.from_host(col)
+    assert dev.data.shape[1] == 2
+    assert dev.to_host().to_pylist() == vals
+
+
+def test_device_roundtrip_date_timestamp():
+    d = HostColumn.from_numpy(np.array([0, 19000, -1], dtype=np.int32),
+                              None, T.DATE)
+    dev = DeviceColumn.from_host(d)
+    assert dev.to_host().arrow.type == pa.date32()
+    ts = HostColumn.from_numpy(np.array([0, 1_600_000_000_000_000], dtype=np.int64),
+                               None, T.TIMESTAMP)
+    dev2 = DeviceColumn.from_host(ts)
+    np.testing.assert_array_equal(dev2.to_host().data_np(),
+                                  [0, 1_600_000_000_000_000])
+
+
+def test_batch_roundtrip(rng):
+    hb = batch_from_pydict({
+        "a": np.arange(100, dtype=np.int64),
+        "b": rng.standard_normal(100),
+        "s": [f"row{i}" if i % 3 else None for i in range(100)],
+    })
+    assert hb.row_count == 100
+    db = hb.to_device()
+    assert db.bucket == 1024
+    assert db.schema.names == ["a", "b", "s"]
+    back = db.to_host()
+    assert back.to_pydict()["a"] == list(range(100))
+    assert back.to_pydict()["s"][:4] == [None, "row1", "row2", None]
+
+
+def test_batch_from_arrow_table():
+    t = pa.table({"x": [1, 2, 3], "y": ["a", "b", None]})
+    hb = batch_from_arrow(t)
+    assert hb.row_count == 3
+    assert isinstance(hb.schema.types[1], T.StringType)
+
+
+def test_concat_and_slice():
+    b1 = batch_from_pydict({"x": np.arange(5, dtype=np.int64)})
+    b2 = batch_from_pydict({"x": np.arange(5, 9, dtype=np.int64)})
+    cat = concat_host_batches([b1, b2])
+    assert cat.row_count == 9
+    sl = cat.slice(3, 4)
+    assert sl.to_pydict()["x"] == [3, 4, 5, 6]
+
+
+def test_sized_nbytes_smaller_than_padded():
+    hb = batch_from_pydict({"x": np.arange(10, dtype=np.int64)})
+    db = hb.to_device()
+    assert db.sized_nbytes() < db.nbytes()

@@ -1,0 +1,54 @@
+import pytest
+
+from spark_rapids_tpu import config as C
+
+
+def test_defaults():
+    conf = C.TpuConf()
+    assert conf.is_sql_enabled
+    assert not conf.is_explain_only
+    assert conf.get("spark.rapids.sql.batchSizeBytes") == 512 << 20
+
+
+def test_string_conversion():
+    conf = C.TpuConf({"spark.rapids.sql.enabled": "false",
+                      "spark.rapids.sql.batchSizeBytes": "1g",
+                      "spark.rapids.sql.concurrentGpuTasks": "4"})
+    assert conf.is_sql_enabled is False
+    assert conf.get("spark.rapids.sql.batchSizeBytes") == 1 << 30
+    assert conf.get("spark.rapids.sql.concurrentGpuTasks") == 4
+
+
+def test_bytes_suffixes():
+    assert C._bytes_conv("512") == 512
+    assert C._bytes_conv("2k") == 2048
+    assert C._bytes_conv("1mb") == 1 << 20
+    assert C._bytes_conv("1.5g") == int(1.5 * (1 << 30))
+
+
+def test_unregistered_keys_kept():
+    conf = C.TpuConf({"some.random.key": "abc"})
+    assert conf.get("some.random.key") == "abc"
+    assert conf.get("missing", "dflt") == "dflt"
+
+
+def test_with_overrides_and_set():
+    conf = C.TpuConf().set("spark.rapids.sql.mode", "explainOnly")
+    assert conf.is_explain_only
+    conf2 = conf.set("spark.rapids.sql.mode", "executeOnGPU")
+    assert not conf2.is_explain_only
+    assert conf.is_explain_only  # immutable snapshots
+
+
+def test_duplicate_registration_rejected():
+    with pytest.raises(ValueError):
+        C.conf_bool("spark.rapids.sql.enabled", "dup", True)
+
+
+def test_docs_generation():
+    docs = C.generate_docs()
+    assert "spark.rapids.sql.enabled" in docs
+    assert docs.startswith("# spark-rapids-tpu Configuration")
+    # every registered key appears
+    for key in C.registry():
+        assert key in docs

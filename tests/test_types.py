@@ -1,0 +1,61 @@
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+
+
+def test_singletons_and_names():
+    assert T.INT.simple_name == "integer"
+    assert T.STRING.simple_name == "string"
+    assert T.DecimalType(12, 2).simple_name == "decimal(12,2)"
+    assert T.ArrayType(T.INT).simple_name == "array<integer>"
+
+
+def test_equality_and_hash():
+    assert T.IntegerType() == T.INT
+    assert T.DecimalType(10, 2) == T.DecimalType(10, 2)
+    assert T.DecimalType(10, 2) != T.DecimalType(11, 2)
+    assert hash(T.LongType()) == hash(T.LONG)
+    assert T.StructType([T.StructField("a", T.INT)]) == \
+        T.StructType([T.StructField("a", T.INT)])
+
+
+def test_classification():
+    assert T.INT.is_numeric and T.INT.is_integral
+    assert T.DOUBLE.is_floating and not T.DOUBLE.is_integral
+    assert T.DecimalType(20, 2).is_decimal128
+    assert not T.DecimalType(18, 2).is_decimal128
+    assert T.ArrayType(T.INT).is_nested
+
+
+def test_arrow_roundtrip():
+    for dt in [T.BOOLEAN, T.BYTE, T.SHORT, T.INT, T.LONG, T.FLOAT, T.DOUBLE,
+               T.STRING, T.BINARY, T.DATE, T.TIMESTAMP, T.DecimalType(20, 4),
+               T.ArrayType(T.LONG), T.MapType(T.STRING, T.INT),
+               T.StructType([T.StructField("x", T.INT)])]:
+        assert T.from_arrow(T.to_arrow(dt)) == dt
+
+
+def test_from_numpy():
+    assert T.from_numpy_dtype(np.int32) == T.INT
+    assert T.from_numpy_dtype(np.float64) == T.DOUBLE
+    assert T.from_numpy_dtype(np.bool_) == T.BOOLEAN
+
+
+def test_common_type():
+    assert T.common_type(T.INT, T.LONG) == T.LONG
+    assert T.common_type(T.INT, T.DOUBLE) == T.DOUBLE
+    assert T.common_type(T.NULL, T.STRING) == T.STRING
+    assert T.common_type(T.DecimalType(10, 2), T.DecimalType(12, 4)) == \
+        T.DecimalType(12, 4)
+    assert T.common_type(T.DATE, T.TIMESTAMP) == T.TIMESTAMP
+    with pytest.raises(TypeError):
+        T.common_type(T.ArrayType(T.INT), T.INT)
+
+
+def test_decimal_bounds():
+    with pytest.raises(ValueError):
+        T.DecimalType(39, 0)
+    with pytest.raises(ValueError):
+        T.DecimalType(5, 7)
