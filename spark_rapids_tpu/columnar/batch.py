@@ -34,6 +34,13 @@ class ColumnarBatch:
             if c.row_count != self.row_count:
                 raise ValueError(
                     f"column rows {c.row_count} != batch rows {self.row_count}")
+        if self.columns:
+            b0 = self.columns[0].bucket
+            for c in self.columns[1:]:
+                if c.bucket != b0:
+                    raise ValueError(
+                        f"mixed buckets in batch: {c.bucket} != {b0} "
+                        "(all columns must share one padded shape)")
 
     @property
     def num_columns(self) -> int:
@@ -149,8 +156,10 @@ def batch_from_pydict(d, schema: Optional[T.StructType] = None) -> HostColumnarB
     cols = []
     names = []
     n = None
-    for i, (name, values) in enumerate(d.items()):
-        dt = schema.types[i] if schema is not None else None
+    for name, values in d.items():
+        dt = None
+        if schema is not None:
+            dt = schema.types[schema.field_index(name)]  # match by name
         if isinstance(values, np.ndarray):
             col = HostColumn.from_numpy(values, data_type=dt)
         else:

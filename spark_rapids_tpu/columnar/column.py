@@ -62,6 +62,17 @@ def _jnp():
     return jax.numpy
 
 
+def _ragged_indices(lens64: np.ndarray):
+    """For per-row lengths, returns (row_idx, within) flat coordinates of
+    every payload byte — shared by the rectangularize (scatter) and
+    flatten (gather) directions."""
+    total = int(lens64.sum())
+    row_idx = np.repeat(np.arange(len(lens64), dtype=np.int64), lens64)
+    head = np.repeat(np.cumsum(lens64) - lens64, lens64)
+    within = np.arange(total, dtype=np.int64) - head
+    return row_idx, within
+
+
 def _validity_buffer(valid: np.ndarray):
     """(packed-bits arrow validity buffer or None, null_count)."""
     import pyarrow as pa
@@ -93,11 +104,8 @@ def _binary_from_rectangular(chars: np.ndarray, lens: np.ndarray,
     lens64 = np.where(valid, lens, 0).astype(np.int64)
     offsets = np.zeros(n + 1, dtype=np.int32)
     np.cumsum(lens64, out=offsets[1:])
-    total = int(lens64.sum())
-    if total:
-        row_idx = np.repeat(np.arange(n, dtype=np.int64), lens64)
-        head = np.repeat(np.cumsum(lens64) - lens64, lens64)
-        within = np.arange(total, dtype=np.int64) - head
+    if lens64.sum():
+        row_idx, within = _ragged_indices(lens64)
         flat = np.ascontiguousarray(chars[row_idx, within])
     else:
         flat = np.zeros(0, dtype=np.uint8)
@@ -126,6 +134,9 @@ class HostColumn:
         import pyarrow as pa
         if isinstance(arrow_array, pa.ChunkedArray):
             arrow_array = arrow_array.combine_chunks()
+        if pa.types.is_date64(arrow_array.type):
+            # canonical date repr is date32 (days); date64 (ms) is ingested
+            arrow_array = arrow_array.cast(pa.date32())
         self.arrow = arrow_array
         self.data_type = data_type or T.from_arrow(arrow_array.type)
 
@@ -144,9 +155,13 @@ class HostColumn:
         mask = None if validity is None else ~np.asarray(validity, dtype=bool)
         if isinstance(dt, T.NullType):
             arr = pa.nulls(len(data))
-        elif isinstance(dt, T.DecimalType) and not dt.is_decimal128:
-            lo = data.astype(np.int64)
-            hi = np.where(lo < 0, np.int64(-1), np.int64(0))
+        elif isinstance(dt, T.DecimalType):
+            # unscaled repr: int64 for decimal64, [n,2] (hi,lo) limbs for 128
+            if dt.is_decimal128 and data.ndim == 2:
+                hi, lo = data[:, 0].astype(np.int64), data[:, 1].astype(np.int64)
+            else:
+                lo = data.astype(np.int64)
+                hi = np.where(lo < 0, np.int64(-1), np.int64(0))
             arr = _decimal128_from_limbs(hi, lo,
                                          None if mask is None else ~mask, dt)
         elif isinstance(dt, T.TimestampType):
@@ -245,13 +260,10 @@ class HostColumn:
             else np.zeros(0, dtype=np.uint8)
         np.minimum(lens, width, out=lens)
         # vectorized ragged->rectangular scatter
-        total = int(lens.sum())
-        if total:
+        if lens.sum():
             lens64 = lens.astype(np.int64)
-            row_idx = np.repeat(np.arange(len(arr), dtype=np.int64), lens64)
+            row_idx, within = _ragged_indices(lens64)
             starts = np.repeat(offsets[:-1].astype(np.int64), lens64)
-            head = np.repeat(np.cumsum(lens64) - lens64, lens64)
-            within = np.arange(total, dtype=np.int64) - head
             out[row_idx, within] = databuf[starts + within]
         return out, lens
 
@@ -295,7 +307,12 @@ class DeviceColumn:
     def from_host(col: HostColumn, bucket: Optional[int] = None) -> "DeviceColumn":
         jnp = _jnp()
         n = len(col)
-        b = bucket or bucket_rows(n)
+        b = bucket_rows(n) if bucket is None else bucket
+        if b < n:
+            raise ValueError(f"bucket {b} smaller than row count {n}")
+        if b & (b - 1):
+            raise ValueError(f"bucket {b} must be a power of two "
+                             "(static-shape compile-cache discipline)")
         valid = np.zeros(b, dtype=bool)
         valid[:n] = col.validity_np()
         dt = col.data_type

@@ -341,7 +341,7 @@ class TpuConf:
         for k, entry in _REGISTRY.items():
             if k in settings:
                 raw = settings.pop(k)
-                val = entry.converter(raw) if isinstance(raw, str) else raw
+                val = entry.converter(raw)  # converters accept non-strings too
                 if entry.checker is not None and not entry.checker(val):
                     raise ValueError(f"invalid value for {k}: {raw!r}")
                 self._values[k] = val

@@ -1,0 +1,28 @@
+"""Memory & device runtime: the framework's hardest-won layer.
+
+Reference counterparts (SURVEY.md §2.4):
+- ``GpuDeviceManager.scala`` — device acquisition + RMM pool init  → ``device_manager``
+- ``RapidsBufferCatalog.scala`` + stores — tiered buffer registry  → ``catalog``
+- ``DeviceMemoryEventHandler.scala`` — spill-on-OOM callback       → ``pool`` event hook
+- ``RmmRapidsRetryIterator.scala`` — retry/split-retry discipline  → ``retry``
+- ``SpillableColumnarBatch.scala``                                 → ``spillable``
+- ``GpuSemaphore.scala`` — device admission control                → ``semaphore``
+- ``GpuTaskMetrics.scala``                                         → ``metrics``
+
+TPU-first note: XLA/PJRT owns the physical HBM allocator, so the pool here is
+an *accounting & admission* layer over tracked buffers (the same role RMM's
+limiting/tracking adapters play): every catalog-registered device buffer
+counts against a budget; exceeding it triggers synchronous spill of the
+lowest-priority spillable buffers, then deterministic Retry/SplitAndRetry
+signaling to the task that asked.
+"""
+
+from spark_rapids_tpu.memory.retry import (  # noqa: F401
+    RetryOOM, SplitAndRetryOOM, task_context, with_retry, with_retry_no_split,
+    force_retry_oom, force_split_and_retry_oom)
+from spark_rapids_tpu.memory.catalog import (  # noqa: F401
+    BufferCatalog, StorageTier, SpillPriority)
+from spark_rapids_tpu.memory.spillable import SpillableColumnarBatch  # noqa: F401
+from spark_rapids_tpu.memory.device_manager import (  # noqa: F401
+    DeviceManager, initialize, shutdown, get_runtime)
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore  # noqa: F401

@@ -1,0 +1,85 @@
+"""Per-task accounting (reference: GpuTaskMetrics.scala — semaphore wait,
+retry counts, spill sizes/times, max device memory, surfaced as accumulators).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass
+class TaskMetrics:
+    task_id: int = -1
+    semaphore_wait_seconds: float = 0.0
+    retry_count: int = 0
+    split_retry_count: int = 0
+    oom_count: int = 0
+    spill_count: int = 0
+    spill_bytes: int = 0
+    op_time_seconds: Dict[str, float] = dataclasses.field(default_factory=dict)
+    max_device_bytes: int = 0
+
+    def observe_device_bytes(self, n: int) -> None:
+        if n > self.max_device_bytes:
+            self.max_device_bytes = n
+
+    @contextlib.contextmanager
+    def time_op(self, name: str):
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.op_time_seconds[name] = (self.op_time_seconds.get(name, 0.0) +
+                                          time.monotonic() - t0)
+
+    def merge(self, other: "TaskMetrics") -> None:
+        self.semaphore_wait_seconds += other.semaphore_wait_seconds
+        self.retry_count += other.retry_count
+        self.split_retry_count += other.split_retry_count
+        self.oom_count += other.oom_count
+        self.spill_count += other.spill_count
+        self.spill_bytes += other.spill_bytes
+        for k, v in other.op_time_seconds.items():
+            self.op_time_seconds[k] = self.op_time_seconds.get(k, 0.0) + v
+        self.max_device_bytes = max(self.max_device_bytes, other.max_device_bytes)
+
+
+class MetricsRegistry:
+    """Aggregates finished tasks' metrics (driver-side accumulator analog)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = TaskMetrics()
+        self.finished_tasks = 0
+
+    def report(self, m: TaskMetrics) -> None:
+        with self._lock:
+            self.total.merge(m)
+            self.finished_tasks += 1
+
+
+@contextlib.contextmanager
+def task_scope(task_id: int, registry: Optional[MetricsRegistry] = None):
+    """Binds a task id + metrics to the current thread for the duration of a
+    task (reference: RmmSpark thread-to-task registration + onTaskCompletion
+    listeners in ScalableTaskCompletion)."""
+    from spark_rapids_tpu.memory.retry import task_context
+    ctx = task_context()
+    prev_id, prev_metrics = ctx.task_id, ctx.metrics
+    ctx.task_id = task_id
+    ctx.metrics = TaskMetrics(task_id=task_id)
+    try:
+        yield ctx.metrics
+    finally:
+        if registry is not None:
+            registry.report(ctx.metrics)
+        # release the semaphore if the task still holds it (completion listener)
+        from spark_rapids_tpu.memory.device_manager import get_runtime
+        rt = get_runtime()
+        if rt is not None:
+            rt.semaphore.release_if_necessary(task_id)
+        ctx.task_id, ctx.metrics = prev_id, prev_metrics

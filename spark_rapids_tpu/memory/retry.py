@@ -1,0 +1,210 @@
+"""OOM retry / split-and-retry discipline with deterministic fault injection.
+
+Reference: ``RmmRapidsRetryIterator.scala`` (withRetry/withRetryNoSplit/
+withSplitAndRetry, :33-757) + the ``RmmSpark`` JNI per-thread state machine
+that throws ``GpuRetryOOM`` / ``GpuSplitAndRetryOOM`` and supports
+``forceRetryOOM`` / ``forceSplitAndRetryOOM`` test injection
+(tests/.../RmmSparkRetrySuiteBase.scala:27-53, GpuSortRetrySuite.scala:183).
+
+Semantics:
+- ``RetryOOM``: the work may succeed if re-run after other tasks release
+  memory / inputs are spilled.  The retry loop makes inputs spillable, spills
+  the catalog, optionally blocks, and re-runs.
+- ``SplitAndRetryOOM``: re-running alone won't help; the input must be split
+  into smaller pieces first.  Only the *top-most* retry frame of a thread
+  splits (nested frames re-raise), matching the reference.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterable, Iterator, List, Optional, TypeVar
+
+X = TypeVar("X")
+K = TypeVar("K")
+
+
+class RetryOOM(MemoryError):
+    """Work should be retried after memory pressure is relieved
+    (reference: com.nvidia.spark.rapids.jni.GpuRetryOOM)."""
+
+
+class SplitAndRetryOOM(MemoryError):
+    """Work must be split smaller and retried
+    (reference: com.nvidia.spark.rapids.jni.GpuSplitAndRetryOOM)."""
+
+
+class CpuRetryOOM(MemoryError):
+    """Host-memory flavor of RetryOOM (reference CpuRetryOOM)."""
+
+
+class _TaskContext(threading.local):
+    """Per-thread task state (reference: RmmSpark thread registration)."""
+
+    def __init__(self):
+        self.task_id: Optional[int] = None
+        self.retry_count = 0
+        self.split_retry_count = 0
+        self.retry_frame_depth = 0
+        # fault injection counters: fire RetryOOM on the next N tracked allocs
+        # after skipping `skip` of them
+        self.inject_retry_oom = 0
+        self.inject_retry_skip = 0
+        self.inject_split_oom = 0
+        self.inject_split_skip = 0
+        self.metrics = None  # TaskMetrics, attached by task_context()
+
+
+_TL = _TaskContext()
+
+
+def task_context() -> _TaskContext:
+    return _TL
+
+
+def force_retry_oom(num_ooms: int = 1, skip: int = 0) -> None:
+    """Arms deterministic RetryOOM injection for this thread
+    (reference: RmmSpark.forceRetryOOM)."""
+    _TL.inject_retry_oom = num_ooms
+    _TL.inject_retry_skip = skip
+
+
+def force_split_and_retry_oom(num_ooms: int = 1, skip: int = 0) -> None:
+    """Arms deterministic SplitAndRetryOOM injection for this thread
+    (reference: RmmSpark.forceSplitAndRetryOOM)."""
+    _TL.inject_split_oom = num_ooms
+    _TL.inject_split_skip = skip
+
+
+def maybe_inject_oom() -> None:
+    """Called at tracked allocation points (catalog adds, kernel staging).
+    Mirrors the allocation-hook injection in the RmmSpark state machine."""
+    if _TL.inject_retry_oom > 0:
+        if _TL.inject_retry_skip > 0:
+            _TL.inject_retry_skip -= 1
+        else:
+            _TL.inject_retry_oom -= 1
+            raise RetryOOM("injected RetryOOM")
+    if _TL.inject_split_oom > 0:
+        if _TL.inject_split_skip > 0:
+            _TL.inject_split_skip -= 1
+        else:
+            _TL.inject_split_oom -= 1
+            raise SplitAndRetryOOM("injected SplitAndRetryOOM")
+
+
+class AutoCloseableTargetSize:
+    """A target size that can be halved on split-retry, with a floor
+    (reference: RmmRapidsRetryIterator.scala AutoCloseableTargetSize)."""
+
+    def __init__(self, target: int, minimum: int):
+        self.target = max(target, minimum)
+        self.minimum = minimum
+
+    def split(self) -> "AutoCloseableTargetSize":
+        halved = self.target // 2
+        if halved < self.minimum:
+            raise SplitAndRetryOOM(
+                f"cannot split target {self.target} below minimum {self.minimum}")
+        return AutoCloseableTargetSize(halved, self.minimum)
+
+
+def split_half_by_rows(spillable) -> List:
+    """Default split policy: split a SpillableColumnarBatch in half by rows
+    (reference: RmmRapidsRetryIterator.splitSpillableInHalfByRows)."""
+    batch = spillable.get_host_batch()
+    n = batch.row_count
+    if n < 2:
+        raise SplitAndRetryOOM("cannot split a batch with fewer than 2 rows")
+    spillable.close()
+    mid = n // 2
+    from spark_rapids_tpu.memory.spillable import SpillableColumnarBatch
+    return [SpillableColumnarBatch.from_host(batch.slice(0, mid),
+                                             spillable.priority),
+            SpillableColumnarBatch.from_host(batch.slice(mid, n - mid),
+                                             spillable.priority)]
+
+
+def _relieve_pressure(caused_by: BaseException) -> None:
+    """Between attempts: spill catalog buffers and give other tasks a chance
+    (reference blocks the thread in RmmSpark until memory frees; here we
+    synchronously spill, which is deterministic and single-process-friendly)."""
+    from spark_rapids_tpu.memory.device_manager import get_runtime
+    rt = get_runtime()
+    if rt is not None:
+        rt.catalog.synchronous_spill(target_free_bytes=None)
+    if _TL.metrics is not None:
+        _TL.metrics.retry_count += 1
+    time.sleep(0)  # yield
+
+
+def with_retry_no_split(spillable_or_none, fn: Callable[..., X],
+                        max_retries: int = 100) -> X:
+    """Runs ``fn(spillable)`` (or ``fn()``) retrying on RetryOOM; a
+    SplitAndRetryOOM is fatal here (reference: withRetryNoSplit)."""
+    _TL.retry_frame_depth += 1
+    try:
+        attempts = 0
+        while True:
+            try:
+                if spillable_or_none is None:
+                    return fn()
+                return fn(spillable_or_none)
+            except RetryOOM as e:
+                attempts += 1
+                _TL.retry_count += 1
+                if attempts > max_retries:
+                    raise MemoryError(
+                        f"giving up after {attempts} RetryOOMs") from e
+                _relieve_pressure(e)
+    finally:
+        _TL.retry_frame_depth -= 1
+
+
+def with_retry(spillables, fn: Callable[..., X],
+               split_policy: Callable = split_half_by_rows,
+               max_retries: int = 100) -> Iterator[X]:
+    """Runs ``fn`` over each spillable input, retrying on RetryOOM and
+    splitting inputs on SplitAndRetryOOM (reference: withRetry + withSplitAndRetry).
+
+    Only a top-level retry frame may split; nested frames re-raise so the
+    outermost owner of the inputs decides (reference semantics).
+    """
+    if not isinstance(spillables, (list, tuple)):
+        spillables = [spillables]
+    queue: List = list(spillables)
+    top_level = _TL.retry_frame_depth == 0
+    _TL.retry_frame_depth += 1
+    try:
+        while queue:
+            item = queue.pop(0)
+            attempts = 0
+            while True:
+                try:
+                    yield fn(item)
+                    break
+                except RetryOOM as e:
+                    attempts += 1
+                    _TL.retry_count += 1
+                    if attempts > max_retries:
+                        raise MemoryError(
+                            f"giving up after {attempts} RetryOOMs") from e
+                    _relieve_pressure(e)
+                except SplitAndRetryOOM as e:
+                    if not top_level:
+                        raise
+                    _TL.split_retry_count += 1
+                    if _TL.metrics is not None:
+                        _TL.metrics.split_retry_count += 1
+                    pieces = split_policy(item)
+                    queue = pieces + queue
+                    break
+    finally:
+        _TL.retry_frame_depth -= 1
+
+
+def drain_with_retry(spillables, fn: Callable[..., X],
+                     split_policy: Callable = split_half_by_rows) -> List[X]:
+    """Eager list-returning form of ``with_retry``."""
+    return list(with_retry(spillables, fn, split_policy))

@@ -1,0 +1,70 @@
+"""Device admission semaphore (reference: GpuSemaphore.scala:51-120).
+
+Limits how many tasks may hold the device concurrently
+(``spark.rapids.sql.concurrentGpuTasks``).  Tasks acquire before their first
+device section and release at completion; re-entrant per task.  Holders can
+be dumped for debugging (reference: dumpActiveStackTracesToLog :120).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Dict, Optional
+
+
+class TpuSemaphore:
+    def __init__(self, max_concurrent: int):
+        self.max_concurrent = max_concurrent
+        self._sem = threading.Semaphore(max_concurrent)
+        self._holders: Dict[int, dict] = {}
+        self._lock = threading.Lock()
+
+    def acquire_if_necessary(self, task_id: Optional[int] = None) -> None:
+        """Idempotent per-task acquire (reference: acquireIfNecessary :100)."""
+        from spark_rapids_tpu.memory.retry import task_context
+        tid = task_id if task_id is not None else (task_context().task_id or
+                                                   threading.get_ident())
+        with self._lock:
+            if tid in self._holders:
+                self._holders[tid]["depth"] += 1
+                return
+        t0 = time.monotonic()
+        self._sem.acquire()
+        wait = time.monotonic() - t0
+        mt = task_context().metrics
+        if mt is not None:
+            mt.semaphore_wait_seconds += wait
+        with self._lock:
+            self._holders[tid] = {"depth": 1, "since": time.monotonic(),
+                                  "thread": threading.current_thread().name}
+
+    def release_if_necessary(self, task_id: Optional[int] = None) -> None:
+        from spark_rapids_tpu.memory.retry import task_context
+        tid = task_id if task_id is not None else (task_context().task_id or
+                                                   threading.get_ident())
+        with self._lock:
+            entry = self._holders.get(tid)
+            if entry is None:
+                return
+            entry["depth"] -= 1
+            if entry["depth"] > 0:
+                return
+            del self._holders[tid]
+        self._sem.release()
+
+    def held_by(self, task_id: int) -> bool:
+        with self._lock:
+            return task_id in self._holders
+
+    def dump_active_holders(self) -> str:
+        """reference: GpuSemaphore.dumpActiveStackTracesToLog"""
+        lines = []
+        with self._lock:
+            for tid, entry in self._holders.items():
+                held = time.monotonic() - entry["since"]
+                lines.append(f"task {tid} thread={entry['thread']} "
+                             f"held={held:.1f}s depth={entry['depth']}")
+        frames = traceback.format_stack()
+        return "\n".join(lines) + "\n" + "".join(frames[-3:])

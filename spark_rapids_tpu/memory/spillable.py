@@ -1,0 +1,90 @@
+"""Spillable columnar batches (reference: SpillableColumnarBatch.scala:29
+trait, :90 device impl, :178 host impl).
+
+A ``SpillableColumnarBatch`` owns a catalog handle; holding one instead of a
+raw ``ColumnarBatch`` makes the data movable by the catalog between attempts
+of a retry frame — the core contract of the out-of-core discipline.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, HostColumnarBatch
+from spark_rapids_tpu.memory.catalog import BufferCatalog, SpillPriority
+
+
+def _default_catalog() -> BufferCatalog:
+    from spark_rapids_tpu.memory.device_manager import get_runtime, initialize
+    rt = get_runtime()
+    if rt is None:
+        rt = initialize()
+    return rt.catalog
+
+
+class SpillableColumnarBatch:
+    """Owns a buffer via the catalog; ``get_batch()`` materializes on device
+    (unspilling if needed), ``close()`` releases."""
+
+    def __init__(self, handle, catalog: BufferCatalog,
+                 row_count: int, sized_nbytes: int, priority: int):
+        self._handle = handle
+        self._catalog = catalog
+        self.row_count = row_count
+        self.sized_nbytes = sized_nbytes
+        self.priority = priority
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def from_device(batch: ColumnarBatch,
+                    priority: int = SpillPriority.ACTIVE_BATCHING,
+                    catalog: Optional[BufferCatalog] = None
+                    ) -> "SpillableColumnarBatch":
+        cat = catalog or _default_catalog()
+        handle = cat.add_device_batch(batch, priority)
+        return SpillableColumnarBatch(handle, cat, batch.row_count,
+                                      batch.sized_nbytes(), priority)
+
+    @staticmethod
+    def from_host(batch: HostColumnarBatch,
+                  priority: int = SpillPriority.HOST_MEMORY,
+                  catalog: Optional[BufferCatalog] = None
+                  ) -> "SpillableColumnarBatch":
+        cat = catalog or _default_catalog()
+        handle = cat.add_host_batch(batch, priority)
+        return SpillableColumnarBatch(handle, cat, batch.row_count,
+                                      batch.nbytes(), priority)
+
+    # -- access -------------------------------------------------------------
+    def get_batch(self) -> ColumnarBatch:
+        """Device batch; unspills if it was pushed down a tier
+        (reference: SpillableColumnarBatchImpl.getColumnarBatch)."""
+        return self._catalog.get_device_batch(self._handle)
+
+    def get_host_batch(self) -> HostColumnarBatch:
+        return self._catalog.get_host_batch(self._handle)
+
+    def make_unspillable(self) -> None:
+        """Pin while actively computing (reference setSpillable(false))."""
+        self._catalog.set_spillable(self._handle, False)
+
+    def make_spillable(self) -> None:
+        self._catalog.set_spillable(self._handle, True)
+
+    @property
+    def closed(self) -> bool:
+        return self._handle.closed
+
+    def close(self) -> None:
+        if not self._handle.closed:
+            self._catalog.remove(self._handle)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __repr__(self):
+        return (f"SpillableColumnarBatch(rows={self.row_count}, "
+                f"bytes={self.sized_nbytes}, closed={self.closed})")

@@ -13,6 +13,14 @@ os.environ.setdefault("XLA_FLAGS",
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("JAX_ENABLE_X64", "true")
 
+import jax  # noqa: E402
+
+# Force the pure-CPU backend regardless of what any site hook selected (a
+# TPU-tunnel site plugin may pin its own platform list; tests must be
+# hermetic and run on the virtual 8-device CPU mesh).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

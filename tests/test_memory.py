@@ -1,0 +1,234 @@
+"""Memory runtime tests.
+
+Mirrors the reference's test approach (SURVEY.md §4): RmmSparkRetrySuiteBase
+initializes a real allocator with a small pool, wires device->host->disk
+stores, and injects deterministic OOM faults via forceRetryOOM /
+forceSplitAndRetryOOM (tests/.../GpuSortRetrySuite.scala:183-209).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import batch_from_pydict
+from spark_rapids_tpu.memory import retry as R
+from spark_rapids_tpu.memory.catalog import (BufferCatalog, SpillPriority,
+                                             StorageTier)
+from spark_rapids_tpu.memory.metrics import MetricsRegistry, task_scope
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.memory.spillable import SpillableColumnarBatch
+
+
+def make_batch(n=2048, seed=0):
+    rng = np.random.default_rng(seed)
+    return batch_from_pydict({
+        "a": rng.integers(0, 1000, n).astype(np.int64),
+        "b": rng.standard_normal(n),
+    }).to_device()
+
+
+@pytest.fixture
+def catalog(tmp_path):
+    return BufferCatalog(device_limit_bytes=1 << 20,
+                         host_limit_bytes=64 << 10,
+                         disk_dir=str(tmp_path))
+
+
+class TestCatalog:
+    def test_add_get_remove(self, catalog):
+        b = make_batch()
+        h = catalog.add_device_batch(b)
+        assert catalog.tier_of(h) == StorageTier.DEVICE
+        got = catalog.get_device_batch(h)
+        assert got.row_count == b.row_count
+        catalog.remove(h)
+        with pytest.raises(KeyError):
+            catalog.get_device_batch(h)
+        assert catalog.device_bytes == 0
+
+    def test_spill_device_to_host_on_pressure(self, catalog):
+        # each batch ~ 2048*(8+8+1+1) ~ 36KB padded; 1MB pool fits ~28
+        handles = [catalog.add_device_batch(make_batch(seed=i))
+                   for i in range(40)]
+        tiers = [catalog.tier_of(h) for h in handles]
+        assert StorageTier.DEVICE in tiers
+        assert any(t != StorageTier.DEVICE for t in tiers), \
+            "expected some buffers to spill under pressure"
+        assert catalog.device_bytes <= catalog.device_limit
+        # spilled-first should be the earliest (same priority, FIFO by id)
+        assert tiers[0] != StorageTier.DEVICE
+
+    def test_host_overflows_to_disk(self, catalog, tmp_path):
+        handles = [catalog.add_device_batch(make_batch(seed=i))
+                   for i in range(40)]
+        tiers = [catalog.tier_of(h) for h in handles]
+        assert StorageTier.DISK in tiers, "host limit 64KB must push to disk"
+        assert any(f.startswith("spill-") for f in os.listdir(tmp_path))
+
+    def test_unspill_roundtrip(self, catalog):
+        b = make_batch(seed=7)
+        expect = b.to_host().to_pydict()
+        h = catalog.add_device_batch(b)
+        catalog.synchronous_spill(None)  # push everything off device
+        assert catalog.tier_of(h) != StorageTier.DEVICE
+        got = catalog.get_device_batch(h)  # unspill
+        assert catalog.tier_of(h) == StorageTier.DEVICE
+        assert got.to_host().to_pydict() == expect
+
+    def test_priority_order(self, catalog):
+        low = catalog.add_device_batch(make_batch(seed=1),
+                                       SpillPriority.INPUT_FROM_SHUFFLE)
+        high = catalog.add_device_batch(make_batch(seed=2),
+                                        SpillPriority.ACTIVE_ON_DECK)
+        # ask for enough free space that exactly one batch must spill
+        catalog.synchronous_spill(catalog.device_limit - (40 << 10))
+        assert catalog.tier_of(low) != StorageTier.DEVICE
+        assert catalog.tier_of(high) == StorageTier.DEVICE
+
+    def test_unspillable_not_spilled(self, catalog):
+        h = catalog.add_device_batch(make_batch(seed=3), spillable=False)
+        catalog.synchronous_spill(None)
+        assert catalog.tier_of(h) == StorageTier.DEVICE
+
+    def test_reserve_raises_when_unsatisfiable(self, catalog):
+        h = catalog.add_device_batch(make_batch(seed=4), spillable=False)
+        with pytest.raises(R.RetryOOM):
+            catalog.reserve(2 << 20)  # more than the whole pool
+
+
+class TestRetry:
+    def setup_method(self):
+        ctx = R.task_context()
+        ctx.inject_retry_oom = ctx.inject_split_oom = 0
+        ctx.retry_count = ctx.split_retry_count = 0
+
+    def test_with_retry_no_split_recovers(self):
+        R.force_retry_oom(2)
+        calls = []
+
+        def work():
+            R.maybe_inject_oom()
+            calls.append(1)
+            return 42
+
+        assert R.with_retry_no_split(None, lambda: work()) == 42
+        assert len(calls) == 1  # two faulted attempts never reached append
+        assert R.task_context().retry_count == 2
+
+    def test_split_oom_fatal_in_no_split(self):
+        R.force_split_and_retry_oom(1)
+        with pytest.raises(R.SplitAndRetryOOM):
+            R.with_retry_no_split(None, lambda: R.maybe_inject_oom())
+
+    def test_with_retry_splits_batch(self, catalog):
+        sb = SpillableColumnarBatch.from_device(make_batch(1000, seed=5),
+                                                catalog=catalog)
+        R.force_split_and_retry_oom(1)
+
+        rows = []
+
+        def work(s):
+            R.maybe_inject_oom()
+            rows.append(s.row_count)
+            return s.row_count
+
+        out = list(R.with_retry(sb, work))
+        assert sum(out) == 1000
+        assert len(out) == 2  # split in half once
+        assert R.task_context().split_retry_count == 1
+
+    def test_split_to_exhaustion_raises(self, catalog):
+        sb = SpillableColumnarBatch.from_device(make_batch(1, seed=6),
+                                                catalog=catalog)
+        R.force_split_and_retry_oom(10)
+
+        with pytest.raises(R.SplitAndRetryOOM):
+            list(R.with_retry(sb, lambda s: R.maybe_inject_oom()))
+
+    def test_nested_frame_does_not_split(self, catalog):
+        sb = SpillableColumnarBatch.from_device(make_batch(100, seed=8),
+                                                catalog=catalog)
+
+        def inner(s):
+            R.force_split_and_retry_oom(1)
+            return list(R.with_retry(s, lambda x: R.maybe_inject_oom()))
+
+        def outer():
+            with pytest.raises(R.SplitAndRetryOOM):
+                R.with_retry_no_split(sb, inner)
+
+        outer()
+
+    def test_auto_closeable_target_size(self):
+        t = R.AutoCloseableTargetSize(1000, 300)
+        t2 = t.split()
+        assert t2.target == 500
+        with pytest.raises(R.SplitAndRetryOOM):
+            t2.split()  # 250 < 300
+
+
+class TestSpillable:
+    def test_lifecycle(self, catalog):
+        b = make_batch(seed=9)
+        expect = b.to_host().to_pydict()
+        with SpillableColumnarBatch.from_device(b, catalog=catalog) as sb:
+            assert sb.row_count == 2048
+            assert sb.get_batch().to_host().to_pydict() == expect
+            sb.make_unspillable()
+            catalog.synchronous_spill(None)
+            assert catalog.tier_of(sb._handle) == StorageTier.DEVICE
+            sb.make_spillable()
+            catalog.synchronous_spill(None)
+            got = sb.get_host_batch()
+            assert got.to_pydict() == expect
+        assert sb.closed
+
+    def test_from_host(self, catalog):
+        hb = batch_from_pydict({"x": np.arange(10, dtype=np.int64)})
+        sb = SpillableColumnarBatch.from_host(hb, catalog=catalog)
+        assert sb.get_batch().row_count == 10
+        sb.close()
+
+
+class TestSemaphore:
+    def test_reentrant_and_limiting(self):
+        sem = TpuSemaphore(1)
+        sem.acquire_if_necessary(task_id=1)
+        sem.acquire_if_necessary(task_id=1)  # re-entrant, no deadlock
+        assert sem.held_by(1)
+        import threading
+        acquired = []
+
+        def t2():
+            sem.acquire_if_necessary(task_id=2)
+            acquired.append(2)
+            sem.release_if_necessary(task_id=2)
+
+        th = threading.Thread(target=t2, daemon=True)
+        th.start()
+        th.join(timeout=0.2)
+        assert not acquired  # task 1 holds (depth 2)
+        sem.release_if_necessary(task_id=1)
+        th.join(timeout=0.2)
+        assert not acquired
+        sem.release_if_necessary(task_id=1)
+        th.join(timeout=2)
+        assert acquired == [2]
+
+    def test_dump(self):
+        sem = TpuSemaphore(2)
+        sem.acquire_if_necessary(task_id=5)
+        dump = sem.dump_active_holders()
+        assert "task 5" in dump
+
+
+class TestTaskScope:
+    def test_metrics_collection(self, catalog):
+        reg = MetricsRegistry()
+        with task_scope(77, reg) as m:
+            R.force_retry_oom(1)
+            R.with_retry_no_split(None, lambda: R.maybe_inject_oom() or 1)
+        assert reg.finished_tasks == 1
+        assert reg.total.retry_count == 1
