@@ -1,0 +1,25 @@
+"""Expression layer: SQL expressions with dual TPU/CPU backends.
+
+Reference counterparts (SURVEY.md §2.6 "Cast & expressions" row):
+``GpuExpression.columnarEval`` over cuDF ColumnVectors — here ``eval_tpu``
+building jax ops over (data, validity) pairs, so an entire projection/filter
+expression tree traces into ONE fused XLA program (a structural advantage
+over the reference's kernel-at-a-time cuDF dispatch).
+
+``eval_cpu`` is an independent numpy/pyarrow implementation used both as the
+CPU fallback execution path and as the differential-test oracle (the
+reference's oracle is Spark-on-CPU; SURVEY.md §4).
+"""
+
+from spark_rapids_tpu.expressions.base import (  # noqa: F401
+    Expression, Literal, BoundReference, AttributeReference, Alias, TCol,
+    bind_references, lit, col)
+from spark_rapids_tpu.expressions import arithmetic  # noqa: F401
+from spark_rapids_tpu.expressions import predicates  # noqa: F401
+from spark_rapids_tpu.expressions import conditional  # noqa: F401
+from spark_rapids_tpu.expressions import mathexprs  # noqa: F401
+from spark_rapids_tpu.expressions import cast  # noqa: F401
+from spark_rapids_tpu.expressions import strings  # noqa: F401
+from spark_rapids_tpu.expressions import datetime_exprs  # noqa: F401
+from spark_rapids_tpu.expressions import hashing  # noqa: F401
+from spark_rapids_tpu.expressions import bitwise  # noqa: F401

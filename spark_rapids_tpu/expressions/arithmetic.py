@@ -1,0 +1,233 @@
+"""Arithmetic expressions (reference: org/apache/spark/sql/rapids/
+arithmetic.scala — GpuAdd/GpuSubtract/GpuMultiply/GpuDivide/GpuRemainder/
+GpuPmod/GpuIntegralDivide/GpuUnaryMinus/GpuAbs...).
+
+Semantics follow Spark non-ANSI mode: integer overflow wraps; division and
+remainder by zero yield NULL (not an error).  Divide on integral/float
+operands returns double (Spark true division).
+
+TPU note: these are pure elementwise jnp ops; when evaluated under the
+projection jit they fuse with neighbors into one XLA kernel.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import (Expression, EvalContext, TCol,
+                                               both_valid, jnp, materialize,
+                                               valid_array)
+
+
+class BinaryExpr(Expression):
+    symbol = "?"
+
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__([left, right])
+
+    @property
+    def left(self):
+        return self.children[0]
+
+    @property
+    def right(self):
+        return self.children[1]
+
+    def sql(self):
+        return f"({self.left.sql()} {self.symbol} {self.right.sql()})"
+
+
+def _coerce(c: TCol, dtype: T.DataType, ctx: EvalContext, xp):
+    """Casts a numeric TCol to the result dtype (cheap numeric widen only)."""
+    nd = dtype.np_dtype
+    if c.is_scalar:
+        if c.data is None:
+            return TCol.scalar(None, dtype)
+        v = c.data
+        if nd is not None:
+            v = nd.type(v)
+        return TCol.scalar(v, dtype)
+    data = c.data
+    if nd is not None and data.dtype != nd:
+        data = data.astype(nd)
+    return TCol(data, c.valid, dtype)
+
+
+class BinaryArithmetic(BinaryExpr):
+    """Shared scaffolding: numeric coercion, null propagation, wrap-on-overflow."""
+
+    null_on_zero_divisor = False
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.common_type(self.left.data_type, self.right.data_type)
+
+    def tpu_supported(self, conf):
+        if isinstance(self.data_type, T.DecimalType):
+            return "decimal arithmetic not yet on device"
+        return None
+
+    def _apply(self, a, b, xp):
+        raise NotImplementedError
+
+    def _eval(self, ctx: EvalContext, xp) -> TCol:
+        rt = self.data_type
+        a = _coerce(self.left.eval(ctx), rt, ctx, xp)
+        b = _coerce(self.right.eval(ctx), rt, ctx, xp)
+        valid = both_valid(a, b, ctx)
+        if a.is_scalar and b.is_scalar:
+            if not valid or (self.null_on_zero_divisor and not b.data):
+                return TCol.scalar(None, rt)
+            out = self._apply(np.asarray(a.data), np.asarray(b.data), np)
+            return TCol.scalar(out[()], rt)
+        ad = materialize(a, ctx, rt.np_dtype)
+        bd = materialize(b, ctx, rt.np_dtype)
+        if self.null_on_zero_divisor:
+            zero = bd == 0
+            valid = valid & ~zero  # at least one input is an array here
+            bd = xp.where(zero, xp.ones_like(bd), bd)  # avoid div warnings
+        out = self._apply(ad, bd, xp)
+        return TCol(out, valid, rt)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        with np.errstate(all="ignore"):
+            return self._eval(ctx, np)
+
+
+class Add(BinaryArithmetic):
+    symbol = "+"
+
+    def _apply(self, a, b, xp):
+        return a + b
+
+
+class Subtract(BinaryArithmetic):
+    symbol = "-"
+
+    def _apply(self, a, b, xp):
+        return a - b
+
+
+class Multiply(BinaryArithmetic):
+    symbol = "*"
+
+    def _apply(self, a, b, xp):
+        return a * b
+
+
+class Divide(BinaryArithmetic):
+    """Spark Divide: result is double; x/0 -> NULL (non-ANSI)."""
+    symbol = "/"
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    @property
+    def null_on_zero_divisor(self):
+        return True
+
+    def _apply(self, a, b, xp):
+        return a / b
+
+
+class IntegralDivide(BinaryArithmetic):
+    """Spark `div`: long result, x div 0 -> NULL."""
+    symbol = "div"
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    @property
+    def null_on_zero_divisor(self):
+        return True
+
+    def _apply(self, a, b, xp):
+        # exact int64 truncate-toward-zero (Spark/Java semantics); plain
+        # floor-div then adjust when signs differ and division is inexact.
+        # (a/b via float would lose precision past 2^53.)  Zero divisors were
+        # already replaced by 1 and nulled in _eval.
+        q = a // b
+        inexact = (a - q * b) != 0
+        adjust = inexact & ((a < 0) ^ (b < 0))
+        return (q + adjust).astype(np.int64)
+
+
+class Remainder(BinaryArithmetic):
+    """Spark %: sign follows the dividend (fmod); x%0 -> NULL."""
+    symbol = "%"
+
+    @property
+    def null_on_zero_divisor(self):
+        return True
+
+    def _apply(self, a, b, xp):
+        return xp.fmod(a, b)
+
+
+class Pmod(BinaryArithmetic):
+    """Positive modulus (reference GpuPmod)."""
+    symbol = "pmod"
+
+    @property
+    def null_on_zero_divisor(self):
+        return True
+
+    def _apply(self, a, b, xp):
+        r = xp.fmod(a, b)
+        return xp.where(r < 0, r + xp.abs(b), r)
+
+
+class UnaryExpr(Expression):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def child(self):
+        return self.children[0]
+
+
+class UnaryMinus(UnaryExpr):
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def tpu_supported(self, conf):
+        if isinstance(self.data_type, T.DecimalType):
+            return "decimal negate not yet on device"
+        return None
+
+    def _eval(self, ctx, xp):
+        c = self.child.eval(ctx)
+        if c.is_scalar:
+            return TCol.scalar(None if c.data is None else -c.data, c.dtype)
+        return TCol(-c.data, c.valid, c.dtype)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx, np)
+
+
+class Abs(UnaryExpr):
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def _eval(self, ctx, xp):
+        c = self.child.eval(ctx)
+        if c.is_scalar:
+            return TCol.scalar(None if c.data is None else abs(c.data), c.dtype)
+        return TCol(xp.abs(c.data), c.valid, c.dtype)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx, np)

@@ -1,0 +1,325 @@
+"""Expression base classes and evaluation contexts.
+
+Reference: the GpuExpression hierarchy (sql-plugin/.../GpuExpressions.scala)
+and Spark Catalyst's Expression tree.  Two evaluation paths:
+
+- ``eval_tpu(ctx)``: builds jax ops on ``TCol`` values.  Called inside a
+  traced function, so the whole tree compiles into one XLA program and XLA
+  fuses everything (TPU-first whole-stage fusion).
+- ``eval_cpu(ctx)``: independent numpy/pyarrow implementation with the same
+  SQL semantics; the CPU fallback path and the differential-test oracle.
+
+Value representations:
+- TPU: ``TCol(data, valid, dtype, lengths)`` of jax arrays.  Strings are
+  uint8[bucket, width] + lengths.  Scalars use ``is_scalar=True`` with
+  python/0-d values (broadcast lazily by kernels).
+- CPU: ``TCol`` of numpy arrays; strings are object arrays of ``str``.
+
+SQL null semantics: every value carries ``valid``; kernels must propagate
+nulls per-operator (null-propagating by default; Kleene logic for AND/OR).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+
+
+def jnp():
+    from spark_rapids_tpu.columnar.column import _jnp
+    return _jnp()
+
+
+@dataclasses.dataclass
+class TCol:
+    """A columnar value during evaluation (device or host backend)."""
+    data: Any
+    valid: Any                 # bool array, or True/False for scalars
+    dtype: T.DataType
+    lengths: Any = None        # string columns only (device rep)
+    is_scalar: bool = False
+
+    @staticmethod
+    def scalar(value, dtype: T.DataType) -> "TCol":
+        return TCol(value, value is not None, dtype, is_scalar=True)
+
+    @property
+    def is_string(self) -> bool:
+        return isinstance(self.dtype, (T.StringType, T.BinaryType))
+
+
+class EvalContext:
+    """Holds the input columns for BoundReference + backend selector.
+
+    ``row_count`` is the PHYSICAL length of the column arrays — the padded
+    bucket on the device backend, the logical row count on the CPU backend.
+    Kernels always produce physical-length outputs; the exec layer tracks the
+    logical count and masks padding via validity.
+    """
+
+    __slots__ = ("cols", "backend", "row_count")
+
+    def __init__(self, cols: Sequence[TCol], backend: str, row_count: int):
+        self.cols = list(cols)
+        self.backend = backend  # "tpu" | "cpu"
+        self.row_count = row_count
+
+
+class Expression:
+    """Base expression node."""
+
+    def __init__(self, children: Sequence["Expression"] = ()):
+        self.children: List[Expression] = list(children)
+
+    # -- static info --------------------------------------------------------
+    @property
+    def data_type(self) -> T.DataType:
+        raise NotImplementedError
+
+    @property
+    def nullable(self) -> bool:
+        return any(c.nullable for c in self.children) if self.children else True
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def sql(self) -> str:
+        args = ", ".join(c.sql() for c in self.children)
+        return f"{self.name}({args})"
+
+    # -- evaluation ---------------------------------------------------------
+    def eval(self, ctx: EvalContext) -> TCol:
+        if ctx.backend == "tpu":
+            return self.eval_tpu(ctx)
+        return self.eval_cpu(ctx)
+
+    def eval_tpu(self, ctx: EvalContext) -> TCol:
+        raise NotImplementedError(f"{self.name}.eval_tpu")
+
+    def eval_cpu(self, ctx: EvalContext) -> TCol:
+        raise NotImplementedError(f"{self.name}.eval_cpu")
+
+    # -- planner hooks ------------------------------------------------------
+    def tpu_supported(self, conf) -> Optional[str]:
+        """None if supported on device; else a reason string (used by the
+        meta layer to tag fallback, reference RapidsMeta.willNotWorkOnGpu)."""
+        return None
+
+    def transform_up(self, fn: Callable[["Expression"], "Expression"]) -> "Expression":
+        node = self.with_children([c.transform_up(fn) for c in self.children])
+        return fn(node)
+
+    def with_children(self, children: List["Expression"]) -> "Expression":
+        if not self.children and not children:
+            return self
+        import copy
+        node = copy.copy(self)
+        node.children = list(children)
+        return node
+
+    def collect(self, pred) -> List["Expression"]:
+        out = [self] if pred(self) else []
+        for c in self.children:
+            out.extend(c.collect(pred))
+        return out
+
+    def __repr__(self):
+        return self.sql()
+
+
+# ---------------------------------------------------------------------------
+# Leaves
+# ---------------------------------------------------------------------------
+
+class Literal(Expression):
+    def __init__(self, value, dtype: Optional[T.DataType] = None):
+        super().__init__()
+        self.value = value
+        self._dtype = dtype or _infer_literal_type(value)
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self.value is None
+
+    def sql(self):
+        return repr(self.value)
+
+    def _as_tcol(self) -> TCol:
+        return TCol.scalar(self.value, self._dtype)
+
+    def eval_tpu(self, ctx):
+        return self._as_tcol()
+
+    def eval_cpu(self, ctx):
+        return self._as_tcol()
+
+
+class BoundReference(Expression):
+    def __init__(self, ordinal: int, dtype: T.DataType, nullable: bool = True,
+                 ref_name: str = ""):
+        super().__init__()
+        self.ordinal = ordinal
+        self._dtype = dtype
+        self._nullable = nullable
+        self.ref_name = ref_name
+
+    @property
+    def data_type(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self._nullable
+
+    def sql(self):
+        return self.ref_name or f"input[{self.ordinal}]"
+
+    def eval_tpu(self, ctx):
+        return ctx.cols[self.ordinal]
+
+    eval_cpu = eval_tpu
+
+
+class AttributeReference(Expression):
+    """Named column reference, resolved to BoundReference at bind time."""
+
+    def __init__(self, ref_name: str):
+        super().__init__()
+        self.ref_name = ref_name
+
+    @property
+    def data_type(self):
+        raise TypeError(f"unresolved attribute {self.ref_name!r}")
+
+    def sql(self):
+        return self.ref_name
+
+
+class Alias(Expression):
+    def __init__(self, child: Expression, alias_name: str):
+        super().__init__([child])
+        self.alias_name = alias_name
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    @property
+    def nullable(self):
+        return self.children[0].nullable
+
+    def sql(self):
+        return f"{self.children[0].sql()} AS {self.alias_name}"
+
+    def eval_tpu(self, ctx):
+        return self.children[0].eval(ctx)
+
+    eval_cpu = eval_tpu
+
+
+def _infer_literal_type(value) -> T.DataType:
+    import datetime
+    import decimal
+    if value is None:
+        return T.NULL
+    if isinstance(value, bool):
+        return T.BOOLEAN
+    if isinstance(value, int):
+        return T.INT if -(2**31) <= value < 2**31 else T.LONG
+    if isinstance(value, float):
+        return T.DOUBLE
+    if isinstance(value, str):
+        return T.STRING
+    if isinstance(value, bytes):
+        return T.BINARY
+    if isinstance(value, decimal.Decimal):
+        sign, digits, exp = value.as_tuple()
+        scale = max(0, -exp)
+        return T.DecimalType(max(len(digits), scale + 1), scale)
+    if isinstance(value, datetime.datetime):
+        return T.TIMESTAMP
+    if isinstance(value, datetime.date):
+        return T.DATE
+    raise TypeError(f"cannot infer literal type of {value!r}")
+
+
+# ---------------------------------------------------------------------------
+# Binding & helpers
+# ---------------------------------------------------------------------------
+
+def bind_references(expr: Expression, schema: T.StructType) -> Expression:
+    """Resolves AttributeReference names to ordinals (reference:
+    GpuBindReferences.bindGpuReferences)."""
+
+    def fix(node: Expression) -> Expression:
+        if isinstance(node, AttributeReference):
+            i = schema.field_index(node.ref_name)
+            f = schema.fields[i]
+            return BoundReference(i, f.data_type, f.nullable, f.name)
+        return node
+
+    return expr.transform_up(fix)
+
+
+def col(name: str) -> AttributeReference:
+    return AttributeReference(name)
+
+
+def lit(value, dtype: Optional[T.DataType] = None) -> Literal:
+    return Literal(value, dtype)
+
+
+# -- broadcast/validity helpers shared by kernels ---------------------------
+
+def both_valid(a: TCol, b: TCol, ctx: EvalContext):
+    """Combined validity of two inputs; returns array or scalar bool."""
+    av, bv = a.valid, b.valid
+    if a.is_scalar and b.is_scalar:
+        return bool(av) and bool(bv)
+    xp = jnp() if ctx.backend == "tpu" else np
+    if a.is_scalar:
+        return bv if av else xp.zeros_like(bv)
+    if b.is_scalar:
+        return av if bv else xp.zeros_like(av)
+    return av & bv
+
+
+def all_valid(cols: Sequence[TCol], ctx: EvalContext):
+    out = cols[0]
+    acc = out.valid
+    for c in cols[1:]:
+        nxt = TCol(None, acc, out.dtype)
+        acc = both_valid(nxt, c, ctx)
+    return acc
+
+
+def materialize(c: TCol, ctx: EvalContext, np_dtype=None) -> Any:
+    """Densifies a scalar TCol to a full column when a kernel needs arrays."""
+    xp = jnp() if ctx.backend == "tpu" else np
+    if not c.is_scalar:
+        return c.data
+    dt = np_dtype or (c.dtype.np_dtype or np.dtype(object))
+    n = ctx.row_count
+    if c.data is None:
+        if dt == np.dtype(object):
+            return np.full(n, None, dtype=object)
+        return xp.zeros(n, dtype=dt)
+    if dt == np.dtype(object):
+        return np.full(n, c.data, dtype=object)
+    return xp.full(n, c.data, dtype=dt)
+
+
+def valid_array(c: TCol, ctx: EvalContext):
+    xp = jnp() if ctx.backend == "tpu" else np
+    if not c.is_scalar:
+        return c.valid
+    return xp.full(ctx.row_count, bool(c.valid), dtype=bool)

@@ -1,0 +1,107 @@
+"""Bitwise expressions (reference: org/apache/spark/sql/rapids/bitwise.scala —
+GpuBitwiseAnd/Or/Xor/Not, GpuShiftLeft/Right/RightUnsigned)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import TCol, both_valid, jnp, materialize
+from spark_rapids_tpu.expressions.arithmetic import (BinaryArithmetic,
+                                                     UnaryExpr)
+
+
+class BitwiseAnd(BinaryArithmetic):
+    symbol = "&"
+
+    def _apply(self, a, b, xp):
+        return a & b
+
+
+class BitwiseOr(BinaryArithmetic):
+    symbol = "|"
+
+    def _apply(self, a, b, xp):
+        return a | b
+
+
+class BitwiseXor(BinaryArithmetic):
+    symbol = "^"
+
+    def _apply(self, a, b, xp):
+        return a ^ b
+
+
+class BitwiseNot(UnaryExpr):
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def _eval(self, ctx, xp):
+        c = self.child.eval(ctx)
+        if c.is_scalar:
+            return TCol.scalar(None if c.data is None else ~c.data, c.dtype)
+        return TCol(~c.data, c.valid, c.dtype)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx, np)
+
+
+class _Shift(BinaryArithmetic):
+    """Java shift semantics: shift amount masked to the value's bit width."""
+
+    @property
+    def data_type(self):
+        return self.left.data_type
+
+    def _mask(self):
+        return 63 if isinstance(self.left.data_type, T.LongType) else 31
+
+    def _eval(self, ctx, xp):
+        a = self.left.eval(ctx)
+        b = self.right.eval(ctx)
+        valid = both_valid(a, b, ctx)
+        dt = self.data_type
+        if a.is_scalar and b.is_scalar:
+            if not valid:
+                return TCol.scalar(None, dt)
+            out = self._apply(np.asarray(a.data), np.asarray(b.data), np)
+            return TCol.scalar(out[()].item(), dt)
+        ad = materialize(a, ctx, dt.np_dtype)
+        bd = materialize(b, ctx, np.dtype(np.int32))
+        return TCol(self._apply(ad, bd, xp), valid, dt)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx, np)
+
+
+class ShiftLeft(_Shift):
+    symbol = "<<"
+
+    def _apply(self, a, b, xp):
+        return a << (b & self._mask())
+
+
+class ShiftRight(_Shift):
+    symbol = ">>"
+
+    def _apply(self, a, b, xp):
+        return a >> (b & self._mask())
+
+
+class ShiftRightUnsigned(_Shift):
+    symbol = ">>>"
+
+    def _apply(self, a, b, xp):
+        shift = b & self._mask()
+        if isinstance(self.left.data_type, T.LongType):
+            u = a.astype(np.uint64) >> shift.astype(np.uint64)
+            return u.astype(np.int64)
+        u = a.astype(np.uint32) >> shift.astype(np.uint32)
+        return u.astype(np.int32)

@@ -1,0 +1,368 @@
+"""Cast expression and the castability matrix.
+
+Reference: ``GpuCast.scala`` (1903 LoC) + ``CastStrings`` JNI + the
+``CastChecks`` table (TypeChecks.scala:1277).  Spark non-ANSI semantics:
+invalid string parses yield NULL; float->int saturates at the target range
+(Java semantics) with NaN -> 0.
+
+Device support notes (TPU-first):
+- numeric<->numeric, bool<->numeric, date<->timestamp: pure jnp, fuse freely.
+- int->string and string->int run on device with digit kernels over the
+  padded string rectangle (vectorizes on VPU lanes).
+- float<->string and timestamp/date<->string parse/format on host (tagged via
+  ``tpu_supported``), mirroring the reference's choice to keep the hairiest
+  string casts behind flags (docs/compatibility.md "%g formatting" caveats).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import (Expression, EvalContext, TCol,
+                                               jnp, materialize, valid_array)
+
+_SECONDS_TO_MICROS = 1_000_000
+_DAY_MICROS = 86_400 * _SECONDS_TO_MICROS
+
+
+class Cast(Expression):
+    def __init__(self, child: Expression, to: T.DataType):
+        super().__init__([child])
+        self.to = to
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def data_type(self):
+        return self.to
+
+    def sql(self):
+        return f"CAST({self.child.sql()} AS {self.to.simple_name})"
+
+    def with_children(self, children):
+        return Cast(children[0], self.to)
+
+    # -- planner tagging ----------------------------------------------------
+    def tpu_supported(self, conf):
+        src, dst = self.child.data_type, self.to
+        if isinstance(src, T.NullType):
+            return None
+        if src.is_numeric and dst.is_numeric and not (
+                isinstance(src, T.DecimalType) or isinstance(dst, T.DecimalType)):
+            return None
+        if isinstance(src, T.BooleanType) or isinstance(dst, T.BooleanType):
+            return None
+        if isinstance(src, (T.DateType, T.TimestampType)) and \
+                isinstance(dst, (T.DateType, T.TimestampType)):
+            return None
+        if src.is_integral and isinstance(dst, T.StringType):
+            return None
+        if isinstance(src, T.StringType) and dst.is_integral:
+            return None
+        if src == dst:
+            return None
+        return f"cast {src} -> {dst} runs on host"
+
+    # -- evaluation ---------------------------------------------------------
+    def _eval(self, ctx: EvalContext, xp) -> TCol:
+        c = self.child.eval(ctx)
+        src, dst = self.child.data_type, self.to
+        if src == dst or isinstance(dst, T.NullType):
+            return c
+        if c.is_scalar:
+            return self._cast_scalar(c, src, dst)
+        if isinstance(src, T.NullType):
+            nd = dst.np_dtype or np.dtype(object)
+            if ctx.backend == "tpu" and isinstance(dst, (T.StringType, T.BinaryType)):
+                z = xp.zeros((ctx.row_count, 8), dtype=np.uint8)
+                zl = xp.zeros(ctx.row_count, dtype=np.int32)
+                return TCol(z, xp.zeros(ctx.row_count, dtype=bool), dst, lengths=zl)
+            data = (np.full(ctx.row_count, None, dtype=object)
+                    if nd == np.dtype(object)
+                    else xp.zeros(ctx.row_count, dtype=nd))
+            return TCol(data, xp.zeros(ctx.row_count, dtype=bool), dst)
+        if ctx.backend == "tpu":
+            return self._cast_device(c, src, dst, ctx, xp)
+        return self._cast_host(c, src, dst, ctx)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        with np.errstate(all="ignore"):
+            return self._eval(ctx, np)
+
+    # -- scalar -------------------------------------------------------------
+    def _cast_scalar(self, c: TCol, src, dst) -> TCol:
+        if not c.valid or c.data is None:
+            return TCol.scalar(None, dst)
+        v = c.data
+        out = _cast_py_value(v, src, dst)
+        return TCol.scalar(out, dst)
+
+    # -- device kernels -----------------------------------------------------
+    def _cast_device(self, c: TCol, src, dst, ctx, xp) -> TCol:
+        if src.is_numeric and dst.is_numeric:
+            return TCol(_numeric_cast_dev(c.data, src, dst, xp), c.valid, dst)
+        if isinstance(src, T.BooleanType) and dst.is_numeric:
+            return TCol(c.data.astype(dst.np_dtype), c.valid, dst)
+        if src.is_numeric and isinstance(dst, T.BooleanType):
+            return TCol(c.data != 0, c.valid, dst)
+        if isinstance(src, T.BooleanType) and isinstance(dst, T.StringType):
+            return _bool_to_string_dev(c, ctx, xp)
+        if isinstance(src, T.TimestampType) and isinstance(dst, T.DateType):
+            days = xp.floor_divide(c.data, _DAY_MICROS).astype(np.int32)
+            return TCol(days, c.valid, dst)
+        if isinstance(src, T.DateType) and isinstance(dst, T.TimestampType):
+            return TCol(c.data.astype(np.int64) * _DAY_MICROS, c.valid, dst)
+        if src.is_numeric and isinstance(dst, T.TimestampType):
+            micros = (c.data.astype(np.float64) * _SECONDS_TO_MICROS) \
+                if src.is_floating else (c.data.astype(np.int64) * _SECONDS_TO_MICROS)
+            return TCol(xp.asarray(micros).astype(np.int64), c.valid, dst)
+        if isinstance(src, T.TimestampType) and dst.is_numeric:
+            secs = xp.floor_divide(c.data, _SECONDS_TO_MICROS)
+            return TCol(_numeric_cast_dev(secs, T.LONG, dst, xp), c.valid, dst)
+        if src.is_integral and isinstance(dst, T.StringType):
+            return _int_to_string_dev(c, dst, xp)
+        if isinstance(src, T.StringType) and dst.is_integral:
+            return _string_to_int_dev(c, dst, xp)
+        raise NotImplementedError(f"device cast {src} -> {dst}")
+
+    # -- host path (oracle + fallback for hairy casts) ----------------------
+    def _cast_host(self, c: TCol, src, dst, ctx) -> TCol:
+        data, valid = c.data, valid_array(c, ctx)
+        n = len(valid)
+        if src.is_numeric and dst.is_numeric:
+            return TCol(_numeric_cast_dev(data, src, dst, np), c.valid, dst)
+        if isinstance(src, T.BooleanType) and dst.is_numeric:
+            return TCol(data.astype(dst.np_dtype), c.valid, dst)
+        if src.is_numeric and isinstance(dst, T.BooleanType):
+            return TCol(data != 0, c.valid, dst)
+        if isinstance(src, T.TimestampType) and isinstance(dst, T.DateType):
+            return TCol(np.floor_divide(data, _DAY_MICROS).astype(np.int32),
+                        c.valid, dst)
+        if isinstance(src, T.DateType) and isinstance(dst, T.TimestampType):
+            return TCol(data.astype(np.int64) * _DAY_MICROS, c.valid, dst)
+        if src.is_numeric and isinstance(dst, T.TimestampType):
+            return TCol((data.astype(np.float64) * _SECONDS_TO_MICROS)
+                        .astype(np.int64), c.valid, dst)
+        if isinstance(src, T.TimestampType) and dst.is_numeric:
+            secs = np.floor_divide(data, _SECONDS_TO_MICROS)
+            return TCol(_numeric_cast_dev(secs, T.LONG, dst, np), c.valid, dst)
+        if isinstance(dst, T.StringType):
+            out = np.empty(n, dtype=object)
+            for i in range(n):
+                out[i] = _cast_py_value(_host_value(data, i, src), src, dst) \
+                    if valid[i] else None
+            return TCol(out, valid, dst)
+        if isinstance(src, T.StringType):
+            out_nd = dst.np_dtype or np.dtype(object)
+            out = np.zeros(n, dtype=out_nd)
+            ok = np.zeros(n, dtype=bool)
+            for i in range(n):
+                if not valid[i] or data[i] is None:
+                    continue
+                v = _cast_py_value(data[i], src, dst)
+                if v is not None:
+                    out[i] = v
+                    ok[i] = True
+            return TCol(out, ok, dst)
+        raise NotImplementedError(f"host cast {src} -> {dst}")
+
+
+def _host_value(data, i, src):
+    return data[i]
+
+
+def _numeric_cast_dev(data, src: T.DataType, dst: T.DataType, xp):
+    nd = dst.np_dtype
+    if src.is_floating and dst.is_integral:
+        # Java semantics: NaN -> 0, saturate at target bounds, trunc toward 0
+        info = np.iinfo(nd)
+        x = xp.nan_to_num(data, nan=0.0, posinf=float(info.max),
+                          neginf=float(info.min))
+        x = xp.clip(xp.trunc(x), float(info.min), float(info.max))
+        return x.astype(nd)
+    return data.astype(nd)
+
+
+def _cast_py_value(v, src: T.DataType, dst: T.DataType):
+    """Python-level single-value cast (scalars + host string paths)."""
+    import datetime
+    if isinstance(dst, T.StringType):
+        if isinstance(src, T.BooleanType):
+            return "true" if v else "false"
+        if isinstance(src, T.FloatType) or isinstance(src, T.DoubleType):
+            return _format_float(float(v))
+        if isinstance(src, T.DateType):
+            if isinstance(v, (int, np.integer)):
+                v = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(v))
+            return v.isoformat()
+        if isinstance(src, T.TimestampType):
+            if isinstance(v, (int, np.integer)):
+                v = (datetime.datetime(1970, 1, 1) +
+                     datetime.timedelta(microseconds=int(v)))
+            s = v.strftime("%Y-%m-%d %H:%M:%S")
+            if v.microsecond:
+                s += f".{v.microsecond:06d}".rstrip("0")
+            return s
+        if isinstance(src, T.DecimalType):
+            return str(v)
+        return str(v)
+    if isinstance(src, T.StringType):
+        s = str(v).strip()
+        try:
+            if isinstance(dst, T.BooleanType):
+                low = s.lower()
+                if low in ("t", "true", "y", "yes", "1"):
+                    return True
+                if low in ("f", "false", "n", "no", "0"):
+                    return False
+                return None
+            if dst.is_integral:
+                if not s:
+                    return None
+                # Spark accepts trailing .0 forms via decimal parse
+                iv = int(s, 10) if _INT_RE.match(s) else None
+                if iv is None:
+                    return None
+                info = np.iinfo(dst.np_dtype)
+                return iv if info.min <= iv <= info.max else None
+            if dst.is_floating:
+                return float(s)
+            if isinstance(dst, T.DateType):
+                return datetime.date.fromisoformat(s[:10])
+            if isinstance(dst, T.TimestampType):
+                return datetime.datetime.fromisoformat(s)
+            if isinstance(dst, T.DecimalType):
+                import decimal
+                return decimal.Decimal(s)
+        except (ValueError, ArithmeticError):
+            return None
+    if src.is_numeric and dst.is_numeric:
+        arr = _numeric_cast_dev(np.asarray(v), src, dst, np)
+        out = arr[()]
+        return out.item() if hasattr(out, "item") else out
+    if isinstance(src, T.BooleanType) and dst.is_numeric:
+        return dst.np_dtype.type(1 if v else 0).item()
+    if src.is_numeric and isinstance(dst, T.BooleanType):
+        return bool(v)
+    if isinstance(src, T.TimestampType) and isinstance(dst, T.DateType):
+        return int(v) // _DAY_MICROS if isinstance(v, (int, np.integer)) \
+            else v.date()
+    if isinstance(src, T.DateType) and isinstance(dst, T.TimestampType):
+        return int(v) * _DAY_MICROS if isinstance(v, (int, np.integer)) else v
+    if src.is_numeric and isinstance(dst, T.TimestampType):
+        return int(float(v) * _SECONDS_TO_MICROS)
+    if isinstance(src, T.TimestampType) and dst.is_numeric:
+        return int(v) // _SECONDS_TO_MICROS
+    raise NotImplementedError(f"scalar cast {src} -> {dst}")
+
+
+import re  # noqa: E402
+
+_INT_RE = re.compile(r"^[+-]?\d+$")
+
+
+def _format_float(f: float) -> str:
+    """Approximates Java Double.toString (documented deviation like the
+    reference's castFloatToString, docs/compatibility.md)."""
+    import math
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "Infinity" if f > 0 else "-Infinity"
+    if f == int(f) and abs(f) < 1e16:
+        return f"{f:.1f}"
+    return repr(f)
+
+
+# ---------------------------------------------------------------------------
+# Device string cast kernels
+# ---------------------------------------------------------------------------
+
+_MAX_INT_DIGITS = 20  # int64 min has 19 digits + sign
+
+
+def _int_to_string_dev(c: TCol, dst, xp) -> TCol:
+    """int -> decimal string, fully vectorized digit extraction."""
+    v = c.data.astype(np.int64)
+    neg = v < 0
+    # magnitude as uint64 (handles int64 min without overflow)
+    mag = xp.where(neg, (~v).astype(np.uint64) + np.uint64(1),
+                   v.astype(np.uint64))
+    pows = np.power(np.uint64(10), np.arange(_MAX_INT_DIGITS - 1, -1, -1,
+                                             dtype=np.uint64),
+                    dtype=np.uint64)
+    digits = (mag[:, None] // pows[None, :]) % np.uint64(10)  # [n, 20] MSD first
+    nonzero = digits != 0
+    has_any = xp.any(nonzero, axis=1)
+    first = xp.argmax(nonzero, axis=1)
+    ndig = xp.where(has_any, _MAX_INT_DIGITS - first, 1)
+    total_len = ndig + neg.astype(np.int32)
+    width = _MAX_INT_DIGITS + 1
+    # output position j takes digit at (first + j - neg_offset)
+    j = xp.arange(width)[None, :]
+    src_idx = first[:, None] + j - neg[:, None].astype(np.int32)
+    src_idx_c = xp.clip(src_idx, 0, _MAX_INT_DIGITS - 1)
+    gathered = xp.take_along_axis(digits.astype(np.uint8), src_idx_c, axis=1)
+    chars = gathered + np.uint8(ord("0"))
+    chars = xp.where((j == 0) & neg[:, None], np.uint8(ord("-")), chars)
+    in_range = j < total_len[:, None]
+    chars = xp.where(in_range, chars, np.uint8(0))
+    return TCol(chars, c.valid, dst, lengths=total_len.astype(np.int32))
+
+
+def _bool_to_string_dev(c: TCol, ctx, xp) -> TCol:
+    tmpl_true = np.frombuffer(b"true\x00\x00\x00\x00", dtype=np.uint8)
+    tmpl_false = np.frombuffer(b"false\x00\x00\x00", dtype=np.uint8)
+    chars = xp.where(c.data[:, None], xp.asarray(tmpl_true)[None, :],
+                     xp.asarray(tmpl_false)[None, :])
+    lens = xp.where(c.data, 4, 5).astype(np.int32)
+    return TCol(chars, c.valid, T.STRING, lengths=lens)
+
+
+def _string_to_int_dev(c: TCol, dst, xp) -> TCol:
+    """string -> integer parse with NULL on invalid, vectorized.
+
+    Handles optional leading +/-, ASCII digits, surrounding spaces.  Overflow
+    beyond int64 is not detected (wraps), matching our non-ANSI contract.
+    """
+    chars = c.data
+    lens = c.lengths
+    n, w = chars.shape
+    pos = xp.arange(w)[None, :]
+    in_len = pos < lens[:, None]
+    is_space = (chars == 32) | (chars == 9)
+    # strip: leading spaces before sign/digits, trailing spaces after
+    non_space = (~is_space) & in_len
+    any_ns = xp.any(non_space, axis=1)
+    start = xp.argmax(non_space, axis=1)
+    # last non-space: argmax over reversed
+    rev_ns = non_space[:, ::-1]
+    last = w - 1 - xp.argmax(rev_ns, axis=1)
+    sign_char = xp.take_along_axis(chars, start[:, None], axis=1)[:, 0]
+    neg = sign_char == ord("-")
+    signed = neg | (sign_char == ord("+"))
+    dstart = start + signed.astype(np.int32)
+    is_digit = (chars >= ord("0")) & (chars <= ord("9"))
+    in_num = (pos >= dstart[:, None]) & (pos <= last[:, None])
+    valid_parse = any_ns & (last >= dstart) & \
+        xp.all(is_digit | ~in_num, axis=1)
+    digit_vals = xp.where(in_num & is_digit, (chars - ord("0")).astype(np.int64),
+                          xp.zeros_like(chars, dtype=np.int64))
+    # place value: 10^(last - pos) for positions within the number
+    exp = xp.clip(last[:, None] - pos, 0, _MAX_INT_DIGITS - 1)
+    pows = np.power(np.int64(10), np.arange(_MAX_INT_DIGITS, dtype=np.int64))
+    place = xp.asarray(pows)[exp]
+    total = xp.sum(digit_vals * place * in_num, axis=1)
+    total = xp.where(neg, -total, total)
+    valid = c.valid & valid_parse
+    info = np.iinfo(dst.np_dtype)
+    if dst.np_dtype != np.dtype(np.int64):
+        in_range = (total >= info.min) & (total <= info.max)
+        valid = valid & in_range
+    return TCol(total.astype(dst.np_dtype), valid, dst)

@@ -1,0 +1,240 @@
+"""Conditional expressions (reference: conditionalExpressions.scala —
+GpuIf/GpuCaseWhen; nullExpressions.scala — GpuCoalesce/GpuNvl;
+GpuGreatest/GpuLeast in GpuOverrides registrations).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import (Expression, EvalContext, TCol,
+                                               jnp, materialize, valid_array)
+
+
+def _result_type(exprs) -> T.DataType:
+    out = None
+    for e in exprs:
+        dt = e.data_type
+        if out is None or isinstance(out, T.NullType):
+            out = dt
+        elif not isinstance(dt, T.NullType):
+            out = T.common_type(out, dt)
+    return out or T.NULL
+
+
+def _widen_strings(a: TCol, b: TCol, xp):
+    """Pads two device string rectangles to a common width."""
+    wa, wb = a.data.shape[1], b.data.shape[1]
+    w = max(wa, wb)
+    ad = xp.pad(a.data, ((0, 0), (0, w - wa))) if wa < w else a.data
+    bd = xp.pad(b.data, ((0, 0), (0, w - wb))) if wb < w else b.data
+    return ad, bd
+
+
+def select(cond, a: TCol, b: TCol, ctx: EvalContext, xp, dtype) -> TCol:
+    """Row-wise select: cond ? a : b with validity merge (vectorized)."""
+    if isinstance(dtype, (T.StringType, T.BinaryType)) and ctx.backend == "tpu":
+        from spark_rapids_tpu.expressions.predicates import _densify_string
+        a = _densify_string(a, ctx, xp)
+        b = _densify_string(b, ctx, xp)
+        ad, bd = _widen_strings(a, b, xp)
+        data = xp.where(cond[:, None], ad, bd)
+        lengths = xp.where(cond, a.lengths, b.lengths)
+        valid = xp.where(cond, valid_array(a, ctx), valid_array(b, ctx))
+        return TCol(data, valid, dtype, lengths=lengths)
+    nd = dtype.np_dtype if not isinstance(dtype, (T.StringType, T.BinaryType)) \
+        else np.dtype(object)
+    ad = materialize(_cast_tcol(a, dtype), ctx, nd)
+    bd = materialize(_cast_tcol(b, dtype), ctx, nd)
+    data = xp.where(cond, ad, bd) if nd != np.dtype(object) else \
+        np.where(cond, ad, bd)
+    valid = xp.where(cond, valid_array(a, ctx), valid_array(b, ctx))
+    return TCol(data, valid, dtype)
+
+
+def _cast_tcol(c: TCol, dtype: T.DataType) -> TCol:
+    """Numeric widen of an evaluated TCol to the select's result type."""
+    if c.dtype == dtype or c.is_string or dtype.np_dtype is None:
+        return c
+    if c.is_scalar:
+        v = c.data
+        return TCol.scalar(None if v is None else dtype.np_dtype.type(v), dtype)
+    if c.data.dtype != dtype.np_dtype:
+        return TCol(c.data.astype(dtype.np_dtype), c.valid, dtype)
+    return c
+
+
+class If(Expression):
+    def __init__(self, predicate, a, b):
+        super().__init__([predicate, a, b])
+
+    @property
+    def data_type(self):
+        return _result_type(self.children[1:])
+
+    def sql(self):
+        p, a, b = self.children
+        return f"if({p.sql()}, {a.sql()}, {b.sql()})"
+
+    def _eval(self, ctx, xp):
+        p = self.children[0].eval(ctx)
+        a = self.children[1].eval(ctx)
+        b = self.children[2].eval(ctx)
+        dt = self.data_type
+        if p.is_scalar:
+            chosen = a if (p.valid and p.data) else b
+            if chosen.is_scalar:
+                return TCol.scalar(chosen.data if chosen.valid else None, dt)
+            return _cast_tcol(chosen, dt)
+        cond = p.data & valid_array(p, ctx)  # null predicate -> else branch
+        return select(cond, a, b, ctx, xp, dt)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx, np)
+
+
+class CaseWhen(Expression):
+    """CASE WHEN p1 THEN v1 [WHEN p2 THEN v2]... [ELSE e] END.
+
+    Evaluates as a right-fold of If selects — on TPU every branch is computed
+    and blended with `where` (branchless, XLA-friendly); the reference's cuDF
+    path similarly computes all branches for columnar CASE WHEN.
+    """
+
+    def __init__(self, branches, else_value=None):
+        from spark_rapids_tpu.expressions.base import Literal
+        self.branches = [(p, v) for p, v in branches]
+        self.else_value = else_value if else_value is not None else Literal(None)
+        kids = [e for pv in self.branches for e in pv] + [self.else_value]
+        super().__init__(kids)
+
+    def with_children(self, children):
+        n = len(self.branches)
+        branches = [(children[2 * i], children[2 * i + 1]) for i in range(n)]
+        return CaseWhen(branches, children[-1])
+
+    @property
+    def data_type(self):
+        return _result_type([v for _, v in self.branches] + [self.else_value])
+
+    def _eval(self, ctx, xp):
+        expr = self.else_value
+        for p, v in reversed(self.branches):
+            expr = If(p, v, expr)
+        return expr.eval(ctx)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx, np)
+
+
+class Coalesce(Expression):
+    def __init__(self, *exprs):
+        super().__init__(list(exprs))
+
+    @property
+    def data_type(self):
+        return _result_type(self.children)
+
+    def _eval(self, ctx, xp):
+        from spark_rapids_tpu.expressions.predicates import IsNotNull
+        expr = self.children[-1]
+        for c in reversed(self.children[:-1]):
+            expr = If(IsNotNull(c), c, expr)
+        return expr.eval(ctx)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx, np)
+
+
+class NaNvl(Expression):
+    """nanvl(a, b): b where a is NaN else a (reference GpuNaNvl)."""
+
+    def __init__(self, a, b):
+        super().__init__([a, b])
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def _eval(self, ctx, xp):
+        from spark_rapids_tpu.expressions.predicates import IsNan
+        return If(IsNan(self.children[0]), self.children[1],
+                  self.children[0]).eval(ctx)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx, np)
+
+
+class _MinMaxN(Expression):
+    """greatest/least: null-skipping n-ary extremum (NaN loses to numbers in
+    Spark's greatest? No — Spark treats NaN as largest; we follow that)."""
+
+    take_max = True
+
+    def __init__(self, *exprs):
+        super().__init__(list(exprs))
+
+    @property
+    def data_type(self):
+        return _result_type(self.children)
+
+    def _eval(self, ctx, xp):
+        from spark_rapids_tpu.expressions.predicates import (GreaterThan,
+                                                             LessThan, IsNull)
+        dt = self.data_type
+        cols = [c.eval(ctx) for c in self.children]
+        # all-scalar fast path
+        if all(c.is_scalar for c in cols):
+            vals = [c.data for c in cols if c.valid and c.data is not None]
+            if not vals:
+                return TCol.scalar(None, dt)
+            return TCol.scalar(max(vals) if self.take_max else min(vals), dt)
+        nd = dt.np_dtype
+        acc_data = None
+        acc_valid = None
+        for c in cols:
+            d = materialize(_cast_tcol(c, dt), ctx, nd)
+            v = valid_array(c, ctx)
+            if acc_data is None:
+                acc_data, acc_valid = d, v
+                continue
+            if nd is not None and nd.kind == "f":
+                # Spark orders NaN as largest: max prefers NaN, min avoids it
+                if self.take_max:
+                    better = (d > acc_data) | xp.isnan(d)
+                else:
+                    better = (d < acc_data) | xp.isnan(acc_data)
+            else:
+                better = (d > acc_data) if self.take_max else (d < acc_data)
+            take_new = v & (~acc_valid | better)
+            acc_data = xp.where(take_new, d, acc_data)
+            acc_valid = acc_valid | v
+        return TCol(acc_data, acc_valid, dt)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        with np.errstate(all="ignore"):
+            return self._eval(ctx, np)
+
+
+class Greatest(_MinMaxN):
+    take_max = True
+
+
+class Least(_MinMaxN):
+    take_max = False

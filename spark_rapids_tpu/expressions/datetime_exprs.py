@@ -1,0 +1,271 @@
+"""Date/time expressions (reference: datetimeExpressions.scala — GpuYear/
+GpuMonth/GpuDayOfMonth/GpuHour/GpuMinute/GpuSecond, GpuDateAdd/GpuDateSub/
+GpuDateDiff, GpuLastDay, GpuDayOfWeek/GpuDayOfYear/GpuQuarter,
+GpuUnixTimestamp family; TimeZoneDB.scala for non-UTC).
+
+Device kernels derive civil fields from epoch days with pure integer
+arithmetic (Euclidean-affine days->y/m/d conversion), so they trace into the
+fused XLA program.  All timestamps are UTC micros; non-UTC session timezones
+are a later milestone (the reference gates non-UTC behind GpuTimeZoneDB).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import (Expression, TCol, both_valid,
+                                               jnp, materialize, valid_array)
+from spark_rapids_tpu.expressions.arithmetic import BinaryExpr, UnaryExpr
+
+_DAY_MICROS = 86_400_000_000
+
+
+def _civil_from_days(days, xp):
+    """Epoch days -> (year, month, day); branch-free integer algorithm
+    (public-domain civil-calendar arithmetic), valid for +-32k years."""
+    z = days + 719468
+    era = xp.floor_divide(z, 146097)
+    doe = z - era * 146097                                   # [0, 146096]
+    yoe = xp.floor_divide(doe - xp.floor_divide(doe, 1460)
+                          + xp.floor_divide(doe, 36524)
+                          - xp.floor_divide(doe, 146096), 365)
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + xp.floor_divide(yoe, 4)
+                 - xp.floor_divide(yoe, 100))                # [0, 365]
+    mp = xp.floor_divide(5 * doy + 2, 153)                   # [0, 11]
+    d = doy - xp.floor_divide(153 * mp + 2, 5) + 1           # [1, 31]
+    m = mp + xp.where(mp < 10, 3, -9)                        # [1, 12]
+    year = y + (m <= 2)
+    return year.astype(np.int32), m.astype(np.int32), d.astype(np.int32)
+
+
+def _days_from_civil(y, m, d, xp):
+    y = y - (m <= 2)
+    era = xp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = m + xp.where(m > 2, -3, 9)
+    doy = xp.floor_divide(153 * mp + 2, 5) + d - 1
+    doe = yoe * 365 + xp.floor_divide(yoe, 4) - xp.floor_divide(yoe, 100) + doy
+    return era * 146097 + doe - 719468
+
+
+def _to_days(c: TCol, src: T.DataType, xp):
+    if isinstance(src, T.DateType):
+        return c.data.astype(np.int64)
+    return xp.floor_divide(c.data, _DAY_MICROS)
+
+
+class _DateField(UnaryExpr):
+    """Extracts a civil field from a date/timestamp column."""
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def _field(self, y, m, d, days, xp):
+        raise NotImplementedError
+
+    def _eval(self, ctx, xp):
+        c = self.child.eval(ctx)
+        src = self.child.data_type
+        if c.is_scalar:
+            import datetime
+            v = c.data if c.valid else None
+            if v is None:
+                return TCol.scalar(None, T.INT)
+            if isinstance(v, (int, np.integer)):
+                days = np.asarray(int(v) if isinstance(src, T.DateType)
+                                  else int(v) // _DAY_MICROS)
+            else:
+                epoch = datetime.date(1970, 1, 1)
+                dd = v.date() if isinstance(v, datetime.datetime) else v
+                days = np.asarray((dd - epoch).days)
+            y, m, d = _civil_from_days(days, np)
+            return TCol.scalar(int(self._field(y, m, d, days, np)[()]), T.INT)
+        days = _to_days(c, src, xp)
+        y, m, d = _civil_from_days(days, xp)
+        return TCol(self._field(y, m, d, days, xp).astype(np.int32),
+                    c.valid, T.INT)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx, np)
+
+
+class Year(_DateField):
+    def _field(self, y, m, d, days, xp):
+        return y
+
+
+class Month(_DateField):
+    def _field(self, y, m, d, days, xp):
+        return m
+
+
+class DayOfMonth(_DateField):
+    def _field(self, y, m, d, days, xp):
+        return d
+
+
+class Quarter(_DateField):
+    def _field(self, y, m, d, days, xp):
+        return xp.floor_divide(m - 1, 3) + 1
+
+
+class DayOfWeek(_DateField):
+    """1 = Sunday ... 7 = Saturday (Spark semantics)."""
+
+    def _field(self, y, m, d, days, xp):
+        return ((days + 4) % 7 + 1).astype(np.int32)
+
+
+class WeekDay(_DateField):
+    """0 = Monday ... 6 = Sunday."""
+
+    def _field(self, y, m, d, days, xp):
+        return ((days + 3) % 7).astype(np.int32)
+
+
+class DayOfYear(_DateField):
+    def _field(self, y, m, d, days, xp):
+        jan1 = _days_from_civil(y, xp.ones_like(m), xp.ones_like(d), xp)
+        return (days - jan1 + 1).astype(np.int32)
+
+
+class LastDay(UnaryExpr):
+    """Last day of the month as a date (reference GpuLastDay)."""
+
+    @property
+    def data_type(self):
+        return T.DATE
+
+    def _eval(self, ctx, xp):
+        c = self.child.eval(ctx)
+        days = _to_days(c, self.child.data_type, xp)
+        y, m, _ = _civil_from_days(days, xp)
+        ny = xp.where(m == 12, y + 1, y)
+        nm = xp.where(m == 12, 1, m + 1)
+        first_next = _days_from_civil(ny, nm, xp.ones_like(nm), xp)
+        return TCol((first_next - 1).astype(np.int32), c.valid, T.DATE)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx, np)
+
+
+class _TimeField(UnaryExpr):
+    divisor = 1
+    modulus = 60
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def _eval(self, ctx, xp):
+        c = self.child.eval(ctx)
+        micros = c.data
+        # Euclidean mod keeps pre-epoch timestamps correct
+        day_micros = micros - xp.floor_divide(micros, _DAY_MICROS) * _DAY_MICROS
+        secs = xp.floor_divide(day_micros, 1_000_000)
+        out = xp.floor_divide(secs, self.divisor) % self.modulus
+        return TCol(out.astype(np.int32), c.valid, T.INT)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx, np)
+
+
+class Hour(_TimeField):
+    divisor = 3600
+    modulus = 24
+
+
+class Minute(_TimeField):
+    divisor = 60
+    modulus = 60
+
+
+class Second(_TimeField):
+    divisor = 1
+    modulus = 60
+
+
+class DateAdd(BinaryExpr):
+    symbol = "date_add"
+
+    @property
+    def data_type(self):
+        return T.DATE
+
+    def _eval(self, ctx, xp):
+        a = self.left.eval(ctx)
+        b = self.right.eval(ctx)
+        valid = both_valid(a, b, ctx)
+        ad = materialize(a, ctx, np.dtype(np.int32))
+        bd = materialize(b, ctx, np.dtype(np.int32))
+        return TCol((ad + bd).astype(np.int32), valid, T.DATE)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx, np)
+
+
+class DateSub(DateAdd):
+    symbol = "date_sub"
+
+    def _eval(self, ctx, xp):
+        a = self.left.eval(ctx)
+        b = self.right.eval(ctx)
+        valid = both_valid(a, b, ctx)
+        ad = materialize(a, ctx, np.dtype(np.int32))
+        bd = materialize(b, ctx, np.dtype(np.int32))
+        return TCol((ad - bd).astype(np.int32), valid, T.DATE)
+
+
+class DateDiff(BinaryExpr):
+    symbol = "datediff"
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def _eval(self, ctx, xp):
+        a = self.left.eval(ctx)
+        b = self.right.eval(ctx)
+        valid = both_valid(a, b, ctx)
+        ad = materialize(a, ctx, np.dtype(np.int32))
+        bd = materialize(b, ctx, np.dtype(np.int32))
+        return TCol((ad - bd).astype(np.int32), valid, T.INT)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx, np)
+
+
+class UnixTimestampFromTs(UnaryExpr):
+    """to_unix_timestamp on a timestamp column -> long seconds."""
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    def _eval(self, ctx, xp):
+        c = self.child.eval(ctx)
+        return TCol(xp.floor_divide(c.data, 1_000_000), c.valid, T.LONG)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx, np)

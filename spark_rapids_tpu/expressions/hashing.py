@@ -1,0 +1,375 @@
+"""Hash expressions: Spark-compatible murmur3_x86_32 and xxhash64.
+
+Reference: HashFunctions.scala + the ``Hash`` JNI kernels (SURVEY.md §2.16);
+Spark's Murmur3Hash (seed 42) drives hash partitioning, so bit-exact parity
+here is what makes our shuffle placement agree with Spark's.
+
+Spark quirks implemented:
+- murmur3 processes the byte tail ONE SIGNED BYTE at a time (unlike standard
+  murmur3's little-endian tail accumulation).
+- long/double hash as two 32-bit halves (low first); float/double normalize
+  -0.0 to 0.0 and NaN to the canonical NaN bits.
+- NULL fields leave the running hash unchanged.
+
+Device kernels: statically-unrolled masked loops over the padded string
+rectangle — each step is a full-width vector op, fusable by XLA.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import (Expression, EvalContext, TCol,
+                                               jnp, materialize, valid_array)
+
+_U32 = np.uint32
+_C1 = _U32(0xCC9E2D51)
+_C2 = _U32(0x1B873593)
+
+
+def _bitcast(x, target, xp):
+    """Bit-reinterpret (numpy .view / jax.lax.bitcast_convert_type)."""
+    if xp is np:
+        return np.asarray(x).view(target)
+    import jax
+    return jax.lax.bitcast_convert_type(x, target)
+
+
+def _rotl32(x, r, xp):
+    r = _U32(r)
+    return ((x << r) | (x >> _U32(32 - r))).astype(_U32)
+
+
+def _mix_k1(k1, xp):
+    k1 = (k1 * _C1).astype(_U32)
+    k1 = _rotl32(k1, 15, xp)
+    return (k1 * _C2).astype(_U32)
+
+
+def _mix_h1(h1, k1, xp):
+    h1 = (h1 ^ k1).astype(_U32)
+    h1 = _rotl32(h1, 13, xp)
+    return (h1 * _U32(5) + _U32(0xE6546B64)).astype(_U32)
+
+
+def _fmix(h1, length, xp):
+    h1 = (h1 ^ length).astype(_U32)
+    h1 = h1 ^ (h1 >> _U32(16))
+    h1 = (h1 * _U32(0x85EBCA6B)).astype(_U32)
+    h1 = h1 ^ (h1 >> _U32(13))
+    h1 = (h1 * _U32(0xC2B2AE35)).astype(_U32)
+    return h1 ^ (h1 >> _U32(16))
+
+
+def _hash_int(values_u32, seed_u32, xp):
+    k1 = _mix_k1(values_u32.astype(_U32), xp)
+    h1 = _mix_h1(seed_u32, k1, xp)
+    return _fmix(h1, _U32(4), xp)
+
+
+def _hash_long(values_i64, seed_u32, xp):
+    v = values_i64.astype(np.uint64)
+    low = (v & np.uint64(0xFFFFFFFF)).astype(_U32)
+    high = (v >> np.uint64(32)).astype(_U32)
+    h1 = _mix_h1(seed_u32, _mix_k1(low, xp), xp)
+    h1 = _mix_h1(h1, _mix_k1(high, xp), xp)
+    return _fmix(h1, _U32(8), xp)
+
+
+def _normalize_float_bits(data, xp, double: bool):
+    if double:
+        d = data.astype(np.float64)
+        d = xp.where(d == 0.0, 0.0, d)          # -0.0 -> 0.0
+        d = xp.where(xp.isnan(d), np.float64("nan"), d)  # canonical NaN
+        return _bitcast(d, np.int64, xp)
+    f = data.astype(np.float32)
+    f = xp.where(f == 0.0, np.float32(0.0), f)
+    f = xp.where(xp.isnan(f), np.float32("nan"), f)
+    return _bitcast(f, np.int32, xp)
+
+
+def _hash_string_murmur(chars, lens, seed_u32, xp):
+    """Spark hashUnsafeBytes over the padded rectangle.
+
+    Blocks of 4 bytes little-endian for the aligned prefix, then each tail
+    byte hashed individually as a SIGNED int (the Spark quirk).
+    """
+    n, w = chars.shape
+    h1 = xp.broadcast_to(seed_u32, (n,)).astype(_U32) if np.ndim(seed_u32) == 0 \
+        else seed_u32.astype(_U32)
+    nblocks = lens // 4
+    max_blocks = w // 4
+    c = chars.astype(_U32)
+    for b in range(max_blocks):
+        k = (c[:, 4 * b] | (c[:, 4 * b + 1] << _U32(8)) |
+             (c[:, 4 * b + 2] << _U32(16)) | (c[:, 4 * b + 3] << _U32(24)))
+        nh = _mix_h1(h1, _mix_k1(k, xp), xp)
+        h1 = xp.where(b < nblocks, nh, h1)
+    # tail: at most 3 bytes, each as signed int
+    signed = chars.astype(np.int8).astype(np.int32).astype(_U32)
+    base = (nblocks * 4).astype(np.int32)
+    for t in range(3):
+        pos = base + t
+        idx = xp.clip(pos, 0, w - 1)
+        byte = xp.take_along_axis(signed, idx[:, None], axis=1)[:, 0]
+        nh = _mix_h1(h1, _mix_k1(byte, xp), xp)
+        h1 = xp.where(pos < lens, nh, h1)
+    return _fmix(h1, lens.astype(_U32), xp)
+
+
+def murmur3_col(c: TCol, dtype: T.DataType, seed, ctx: EvalContext, xp):
+    """Running murmur3 update for one column; returns uint32 array."""
+    seed = seed.astype(_U32) if hasattr(seed, "astype") else _U32(seed)
+    valid = valid_array(c, ctx)
+    if isinstance(dtype, (T.StringType, T.BinaryType)):
+        if ctx.backend == "cpu":
+            data = materialize(c, ctx, np.dtype(object))
+            out = np.broadcast_to(np.asarray(seed, dtype=_U32),
+                              (len(data),)).copy()
+            for i in range(len(data)):
+                if valid[i] and data[i] is not None:
+                    raw = data[i].encode() if isinstance(data[i], str) else data[i]
+                    out[i] = _murmur_bytes_py(raw, int(out[i]))
+            return out
+        from spark_rapids_tpu.expressions.predicates import _densify_string
+        c = _densify_string(c, ctx, xp)
+        h = _hash_string_murmur(c.data, c.lengths, seed, xp)
+    elif isinstance(dtype, T.BooleanType):
+        d = materialize(c, ctx, np.dtype(bool))
+        h = _hash_int(d.astype(np.int32).astype(_U32), seed, xp)
+    elif isinstance(dtype, (T.LongType, T.TimestampType)):
+        h = _hash_long(materialize(c, ctx, np.dtype(np.int64)), seed, xp)
+    elif isinstance(dtype, T.DoubleType):
+        bits = _normalize_float_bits(materialize(c, ctx, np.dtype(np.float64)),
+                                     xp, True)
+        h = _hash_long(bits, seed, xp)
+    elif isinstance(dtype, T.FloatType):
+        bits = _normalize_float_bits(materialize(c, ctx, np.dtype(np.float32)),
+                                     xp, False)
+        h = _hash_int(bits.astype(np.int64).astype(_U32), seed, xp)
+    elif isinstance(dtype, T.DecimalType) and not dtype.is_decimal128:
+        h = _hash_long(materialize(c, ctx, np.dtype(np.int64)), seed, xp)
+    else:  # byte/short/int/date
+        d = materialize(c, ctx, np.dtype(np.int32))
+        h = _hash_int(d.astype(np.int64).astype(_U32), seed, xp)
+    seed_arr = xp.broadcast_to(seed, h.shape) if np.ndim(seed) == 0 else seed
+    return xp.where(valid, h, seed_arr).astype(_U32)
+
+
+def _murmur_bytes_py(raw: bytes, seed: int) -> int:
+    """Reference scalar implementation (CPU oracle for strings)."""
+
+    def mixk1(k1):
+        k1 = (k1 * 0xCC9E2D51) & 0xFFFFFFFF
+        k1 = ((k1 << 15) | (k1 >> 17)) & 0xFFFFFFFF
+        return (k1 * 0x1B873593) & 0xFFFFFFFF
+
+    def mixh1(h1, k1):
+        h1 ^= k1
+        h1 = ((h1 << 13) | (h1 >> 19)) & 0xFFFFFFFF
+        return (h1 * 5 + 0xE6546B64) & 0xFFFFFFFF
+
+    h1 = seed & 0xFFFFFFFF
+    nblocks = len(raw) // 4
+    for b in range(nblocks):
+        k = int.from_bytes(raw[4 * b:4 * b + 4], "little")
+        h1 = mixh1(h1, mixk1(k))
+    for t in range(nblocks * 4, len(raw)):
+        byte = raw[t] - 256 if raw[t] >= 128 else raw[t]  # signed
+        h1 = mixh1(h1, mixk1(byte & 0xFFFFFFFF))
+    h1 ^= len(raw)
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & 0xFFFFFFFF
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & 0xFFFFFFFF
+    h1 ^= h1 >> 16
+    return h1
+
+
+def murmur3_hash_cols(cols: Sequence[TCol], dtypes: Sequence[T.DataType],
+                      seed: int, ctx: EvalContext, xp):
+    """Chained multi-column murmur3 (Spark Murmur3Hash of a struct)."""
+    h = _U32(seed)
+    for c, dt in zip(cols, dtypes):
+        h = murmur3_col(c, dt, h, ctx, xp)
+    return h
+
+
+class Murmur3Hash(Expression):
+    """hash(cols...) -> int32, seed 42 (Spark `hash` function)."""
+
+    def __init__(self, *exprs, seed: int = 42):
+        super().__init__(list(exprs))
+        self.seed = seed
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+    def _eval(self, ctx, xp):
+        cols = [c.eval(ctx) for c in self.children]
+        dtypes = [c.data_type for c in self.children]
+        h = murmur3_hash_cols(cols, dtypes, self.seed, ctx, xp)
+        n = ctx.row_count
+        ones = xp.ones(n, dtype=bool)
+        return TCol(_bitcast(h, np.int32, xp), ones, T.INT)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx, np)
+
+
+# ---------------------------------------------------------------------------
+# xxhash64 (seed 42) — Spark XxHash64
+# ---------------------------------------------------------------------------
+
+_U64 = np.uint64
+_P1 = _U64(0x9E3779B185EBCA87)
+_P2 = _U64(0xC2B2AE3D27D4EB4F)
+_P3 = _U64(0x165667B19E3779F9)
+_P4 = _U64(0x85EBCA77C2B2AE63)
+_P5 = _U64(0x27D4EB2F165667C5)
+
+
+def _rotl64(x, r, xp):
+    r = _U64(r)
+    return ((x << r) | (x >> _U64(64 - r))).astype(_U64)
+
+
+def _xx_round(acc, inp, xp):
+    acc = (acc + inp * _P2).astype(_U64)
+    acc = _rotl64(acc, 31, xp)
+    return (acc * _P1).astype(_U64)
+
+
+def _xx_fmix(h, xp):
+    h = h ^ (h >> _U64(33))
+    h = (h * _P2).astype(_U64)
+    h = h ^ (h >> _U64(29))
+    h = (h * _P3).astype(_U64)
+    return h ^ (h >> _U64(32))
+
+
+def _xx_hash_long(v_u64, seed_u64, xp):
+    h = (seed_u64 + _P5 + _U64(8)).astype(_U64)
+    h = (h ^ _xx_round(xp.zeros_like(v_u64), v_u64, xp)).astype(_U64)
+    h = (_rotl64(h, 27, xp) * _P1 + _P4).astype(_U64)
+    return _xx_fmix(h, xp)
+
+
+def xxhash64_col(c: TCol, dtype: T.DataType, seed, ctx: EvalContext, xp):
+    seed = seed.astype(_U64) if hasattr(seed, "astype") else _U64(seed)
+    valid = valid_array(c, ctx)
+    if isinstance(dtype, (T.StringType, T.BinaryType)):
+        # string xxhash on device: later milestone; CPU scalar loop here
+        data = materialize(c, ctx, np.dtype(object))
+        out = np.broadcast_to(np.asarray(seed, dtype=_U64),
+                              (len(data),)).copy()
+        for i in range(len(data)):
+            if valid[i] and data[i] is not None:
+                raw = data[i].encode() if isinstance(data[i], str) else data[i]
+                out[i] = _xx_bytes_py(raw, int(out[i]))
+        return xp.asarray(out) if ctx.backend == "tpu" else out
+    if isinstance(dtype, T.DoubleType):
+        bits = _normalize_float_bits(materialize(c, ctx, np.dtype(np.float64)),
+                                     xp, True)
+        v = bits.astype(_U64)
+    elif isinstance(dtype, T.FloatType):
+        bits = _normalize_float_bits(materialize(c, ctx, np.dtype(np.float32)),
+                                     xp, False)
+        v = bits.astype(np.int64).astype(np.uint64)
+    elif isinstance(dtype, T.BooleanType):
+        v = materialize(c, ctx, np.dtype(bool)).astype(np.uint64)
+    else:
+        v = materialize(c, ctx, np.dtype(np.int64)).astype(np.uint64)
+    h = _xx_hash_long(v, seed, xp)
+    seed_arr = xp.broadcast_to(seed, h.shape) if np.ndim(seed) == 0 else seed
+    return xp.where(valid, h, seed_arr).astype(_U64)
+
+
+def _xx_bytes_py(raw: bytes, seed: int) -> int:
+    M = (1 << 64) - 1
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (64 - r))) & M
+
+    def rnd(acc, inp):
+        acc = (acc + inp * int(_P2)) & M
+        return (rotl(acc, 31) * int(_P1)) & M
+
+    n = len(raw)
+    if n >= 32:
+        v1 = (seed + int(_P1) + int(_P2)) & M
+        v2 = (seed + int(_P2)) & M
+        v3 = seed
+        v4 = (seed - int(_P1)) & M
+        i = 0
+        while i <= n - 32:
+            v1 = rnd(v1, int.from_bytes(raw[i:i + 8], "little"))
+            v2 = rnd(v2, int.from_bytes(raw[i + 8:i + 16], "little"))
+            v3 = rnd(v3, int.from_bytes(raw[i + 16:i + 24], "little"))
+            v4 = rnd(v4, int.from_bytes(raw[i + 24:i + 32], "little"))
+            i += 32
+        h = (rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18)) & M
+        for v in (v1, v2, v3, v4):
+            h = ((h ^ rnd(0, v)) * int(_P1) + int(_P4)) & M
+    else:
+        h = (seed + int(_P5)) & M
+        i = 0
+    h = (h + n) & M
+    while i <= n - 8:
+        h = ((rotl(h ^ rnd(0, int.from_bytes(raw[i:i + 8], "little")), 27)
+              * int(_P1)) + int(_P4)) & M
+        i += 8
+    if i <= n - 4:
+        k = int.from_bytes(raw[i:i + 4], "little")
+        h = ((rotl(h ^ ((k * int(_P1)) & M), 23) * int(_P2)) + int(_P3)) & M
+        i += 4
+    while i < n:
+        h = (rotl(h ^ ((raw[i] * int(_P5)) & M), 11) * int(_P1)) & M
+        i += 1
+    h ^= h >> 33
+    h = (h * int(_P2)) & M
+    h ^= h >> 29
+    h = (h * int(_P3)) & M
+    h ^= h >> 32
+    return h
+
+
+class XxHash64(Expression):
+    """xxhash64(cols...) -> long, seed 42."""
+
+    def __init__(self, *exprs, seed: int = 42):
+        super().__init__(list(exprs))
+        self.seed = seed
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+    def _eval(self, ctx, xp):
+        h = _U64(self.seed)
+        for c, dt in zip([c.eval(ctx) for c in self.children],
+                         [c.data_type for c in self.children]):
+            h = xxhash64_col(c, dt, h, ctx, xp)
+        ones = xp.ones(ctx.row_count, dtype=bool)
+        return TCol(_bitcast(h, np.int64, xp), ones, T.LONG)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx, np)

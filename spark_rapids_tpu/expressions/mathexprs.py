@@ -1,0 +1,263 @@
+"""Math expressions (reference: mathExpressions.scala — GpuSqrt, GpuExp,
+GpuLog variants, trig family, GpuFloor/GpuCeil, GpuRound/GpuBRound, GpuSignum,
+GpuAtan2, GpuHypot, GpuPow...).
+
+Spark deviations followed: log of non-positive returns NULL (Hive semantics);
+round uses HALF_UP, bround HALF_EVEN.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import (Expression, TCol, jnp,
+                                               materialize, valid_array)
+from spark_rapids_tpu.expressions.arithmetic import BinaryExpr, UnaryExpr
+
+
+class UnaryMath(UnaryExpr):
+    """double -> double elementwise math with null propagation."""
+
+    null_on_domain_error = False  # e.g. log(-1) -> NULL per Spark/Hive
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def _fn(self, x, xp):
+        raise NotImplementedError
+
+    def _domain_ok(self, x, xp):
+        return None
+
+    def _eval(self, ctx, xp):
+        c = self.child.eval(ctx)
+        if c.is_scalar:
+            if not c.valid or c.data is None:
+                return TCol.scalar(None, T.DOUBLE)
+            x = np.float64(c.data)
+            ok = self._domain_ok(np.asarray(x), np)
+            if ok is not None and not bool(ok[()]):
+                return TCol.scalar(None, T.DOUBLE)
+            with np.errstate(all="ignore"):
+                return TCol.scalar(float(self._fn(np.asarray(x), np)[()]),
+                                   T.DOUBLE)
+        data = c.data.astype(np.float64)
+        valid = c.valid
+        ok = self._domain_ok(data, xp)
+        if ok is not None:
+            valid = valid & ok
+        out = self._fn(data, xp)
+        return TCol(out, valid, T.DOUBLE)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        with np.errstate(all="ignore"):
+            return self._eval(ctx, np)
+
+
+def _unary(name, fn, domain=None, doc=""):
+    cls = type(name, (UnaryMath,), {
+        "_fn": staticmethod(lambda x, xp, _f=fn: _f(x, xp)),
+        "_domain_ok": (staticmethod(lambda x, xp, _d=domain: _d(x, xp))
+                       if domain else UnaryMath._domain_ok),
+        "__doc__": doc,
+    })
+    # staticmethod wrappers lose `self`; rebind as plain methods
+    cls._fn = lambda self, x, xp, _f=fn: _f(x, xp)
+    if domain:
+        cls._domain_ok = lambda self, x, xp, _d=domain: _d(x, xp)
+    return cls
+
+
+Sqrt = _unary("Sqrt", lambda x, xp: xp.sqrt(xp.where(x < 0, xp.nan, x)))
+Exp = _unary("Exp", lambda x, xp: xp.exp(x))
+Expm1 = _unary("Expm1", lambda x, xp: xp.expm1(x))
+Log = _unary("Log", lambda x, xp: xp.log(x), domain=lambda x, xp: x > 0)
+Log2 = _unary("Log2", lambda x, xp: xp.log2(x), domain=lambda x, xp: x > 0)
+Log10 = _unary("Log10", lambda x, xp: xp.log10(x), domain=lambda x, xp: x > 0)
+Log1p = _unary("Log1p", lambda x, xp: xp.log1p(x), domain=lambda x, xp: x > -1)
+Sin = _unary("Sin", lambda x, xp: xp.sin(x))
+Cos = _unary("Cos", lambda x, xp: xp.cos(x))
+Tan = _unary("Tan", lambda x, xp: xp.tan(x))
+Asin = _unary("Asin", lambda x, xp: xp.arcsin(x))
+Acos = _unary("Acos", lambda x, xp: xp.arccos(x))
+Atan = _unary("Atan", lambda x, xp: xp.arctan(x))
+Sinh = _unary("Sinh", lambda x, xp: xp.sinh(x))
+Cosh = _unary("Cosh", lambda x, xp: xp.cosh(x))
+Tanh = _unary("Tanh", lambda x, xp: xp.tanh(x))
+Asinh = _unary("Asinh", lambda x, xp: xp.arcsinh(x))
+Acosh = _unary("Acosh", lambda x, xp: xp.arccosh(x))
+Atanh = _unary("Atanh", lambda x, xp: xp.arctanh(x))
+Cbrt = _unary("Cbrt", lambda x, xp: xp.cbrt(x))
+Rint = _unary("Rint", lambda x, xp: xp.rint(x))
+ToRadians = _unary("ToRadians", lambda x, xp: x * (np.pi / 180.0))
+ToDegrees = _unary("ToDegrees", lambda x, xp: x * (180.0 / np.pi))
+
+
+class Signum(UnaryMath):
+    def _fn(self, x, xp):
+        return xp.sign(x)
+
+
+class Floor(UnaryExpr):
+    @property
+    def data_type(self):
+        dt = self.child.data_type
+        return dt if dt.is_integral else T.LONG
+
+    def _eval(self, ctx, xp):
+        c = self.child.eval(ctx)
+        if self.child.data_type.is_integral:
+            return c
+        if c.is_scalar:
+            import math
+            v = c.data if c.valid else None
+            return TCol.scalar(None if v is None else math.floor(v), T.LONG)
+        return TCol(xp.floor(c.data).astype(np.int64), c.valid, T.LONG)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx, np)
+
+
+class Ceil(UnaryExpr):
+    @property
+    def data_type(self):
+        dt = self.child.data_type
+        return dt if dt.is_integral else T.LONG
+
+    def _eval(self, ctx, xp):
+        c = self.child.eval(ctx)
+        if self.child.data_type.is_integral:
+            return c
+        if c.is_scalar:
+            import math
+            v = c.data if c.valid else None
+            return TCol.scalar(None if v is None else math.ceil(v), T.LONG)
+        return TCol(xp.ceil(c.data).astype(np.int64), c.valid, T.LONG)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx, np)
+
+
+class Round(Expression):
+    """round(x, d): HALF_UP (away from zero at .5), Spark default."""
+
+    half_even = False
+
+    def __init__(self, child, scale=0):
+        from spark_rapids_tpu.expressions.base import Literal
+        if not isinstance(scale, Expression):
+            scale = Literal(int(scale))
+        super().__init__([child, scale])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def _eval(self, ctx, xp):
+        c = self.children[0].eval(ctx)
+        s = self.children[1].eval(ctx)
+        assert s.is_scalar, "round scale must be a literal"
+        d = int(s.data)
+        dt = self.data_type
+        factor = 10.0 ** d
+        if c.is_scalar:
+            v = c.data if c.valid else None
+            if v is None:
+                return TCol.scalar(None, dt)
+            arr = np.asarray(float(v))
+            out = self._round(arr * factor, np) / factor
+            if dt.is_integral:
+                return TCol.scalar(int(out[()]), dt)
+            return TCol.scalar(float(out[()]), dt)
+        if dt.is_integral and d >= 0:
+            return c
+        data = c.data.astype(np.float64) * factor
+        out = self._round(data, xp) / factor
+        if dt.is_integral:
+            out = out.astype(dt.np_dtype)
+        elif dt.np_dtype is not None:
+            out = out.astype(dt.np_dtype)
+        return TCol(out, c.valid, dt)
+
+    def _round(self, x, xp):
+        if self.half_even:
+            return xp.rint(x)
+        # HALF_UP: away from zero
+        return xp.sign(x) * xp.floor(xp.abs(x) + 0.5)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx, np)
+
+
+class BRound(Round):
+    """bround: HALF_EVEN (banker's rounding)."""
+    half_even = True
+
+
+class BinaryMath(BinaryExpr):
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def _fn(self, a, b, xp):
+        raise NotImplementedError
+
+    def _eval(self, ctx, xp):
+        from spark_rapids_tpu.expressions.base import both_valid
+        a = self.left.eval(ctx)
+        b = self.right.eval(ctx)
+        valid = both_valid(a, b, ctx)
+        if a.is_scalar and b.is_scalar:
+            if not valid:
+                return TCol.scalar(None, T.DOUBLE)
+            out = self._fn(np.float64(a.data), np.float64(b.data), np)
+            return TCol.scalar(float(out), T.DOUBLE)
+        ad = materialize(a, ctx, np.dtype(np.float64))
+        bd = materialize(b, ctx, np.dtype(np.float64))
+        if hasattr(ad, "astype"):
+            ad = ad.astype(np.float64)
+        if hasattr(bd, "astype"):
+            bd = bd.astype(np.float64)
+        return TCol(self._fn(ad, bd, xp), valid, T.DOUBLE)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        with np.errstate(all="ignore"):
+            return self._eval(ctx, np)
+
+
+class Pow(BinaryMath):
+    symbol = "pow"
+
+    def _fn(self, a, b, xp):
+        return xp.power(a, b)
+
+
+class Atan2(BinaryMath):
+    symbol = "atan2"
+
+    def _fn(self, a, b, xp):
+        return xp.arctan2(a, b)
+
+
+class Hypot(BinaryMath):
+    symbol = "hypot"
+
+    def _fn(self, a, b, xp):
+        return xp.hypot(a, b)

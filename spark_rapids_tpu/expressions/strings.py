@@ -1,0 +1,421 @@
+"""String expressions (reference: stringFunctions.scala ~3k LoC — GpuLength,
+GpuUpper/GpuLower, GpuConcat, GpuSubstring, GpuStartsWith/EndsWith/Contains,
+GpuLike, GpuStringTrim family...).
+
+TPU-first design: device strings are uint8[rows, width] + lengths, so string
+kernels are 2-D elementwise/reduction ops that vectorize across the padded
+rectangle on VPU lanes — a different shape from cuDF's offsets+chars byte
+kernels, chosen because TPU wants fixed strides.
+
+CPU path operates on object arrays of python str and is the oracle.
+Deviations (documented, mirroring reference docs/compatibility.md): device
+Upper/Lower transform ASCII only (non-ASCII passes through).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import (Expression, EvalContext, TCol,
+                                               both_valid, jnp, materialize,
+                                               valid_array)
+from spark_rapids_tpu.expressions.arithmetic import BinaryExpr, UnaryExpr
+from spark_rapids_tpu.expressions.predicates import _densify_string
+
+
+def _dev_inputs(c: TCol, ctx, xp):
+    c = _densify_string(c, ctx, xp)
+    return c.data, c.lengths, valid_array(c, ctx)
+
+
+def _cpu_str_map(c: TCol, ctx, fn):
+    """Applies fn over a CPU object array with null passthrough."""
+    data = materialize(c, ctx, np.dtype(object))
+    valid = valid_array(c, ctx)
+    out = np.empty(len(data), dtype=object)
+    for i in range(len(data)):
+        out[i] = fn(data[i]) if valid[i] and data[i] is not None else None
+    return out, valid
+
+
+class Length(UnaryExpr):
+    """Character (not byte) length, per Spark semantics."""
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def eval_tpu(self, ctx):
+        xp = jnp()
+        c = self.child.eval(ctx)
+        chars, lens, valid = _dev_inputs(c, ctx, xp)
+        w = chars.shape[1]
+        pos = xp.arange(w)[None, :]
+        in_len = pos < lens[:, None]
+        # UTF-8 char count = bytes that are not continuation bytes (10xxxxxx)
+        not_cont = (chars & 0xC0) != 0x80
+        count = xp.sum((not_cont & in_len).astype(np.int32), axis=1)
+        return TCol(count, valid, T.INT)
+
+    def eval_cpu(self, ctx):
+        c = self.child.eval(ctx)
+        out, valid = _cpu_str_map(c, ctx, len)
+        data = np.array([0 if v is None else v for v in out], dtype=np.int32)
+        return TCol(data, valid, T.INT)
+
+
+class _AsciiMap(UnaryExpr):
+    """ASCII case transform on device; full unicode on CPU oracle for ASCII
+    inputs they agree (documented deviation otherwise)."""
+
+    lower = False
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def eval_tpu(self, ctx):
+        xp = jnp()
+        c = self.child.eval(ctx)
+        chars, lens, valid = _dev_inputs(c, ctx, xp)
+        if self.lower:
+            is_tgt = (chars >= ord("A")) & (chars <= ord("Z"))
+            out = xp.where(is_tgt, chars + 32, chars)
+        else:
+            is_tgt = (chars >= ord("a")) & (chars <= ord("z"))
+            out = xp.where(is_tgt, chars - 32, chars)
+        return TCol(out, valid, T.STRING, lengths=lens)
+
+    def eval_cpu(self, ctx):
+        c = self.child.eval(ctx)
+        fn = str.lower if self.lower else str.upper
+        out, valid = _cpu_str_map(c, ctx, fn)
+        return TCol(out, valid, T.STRING)
+
+
+class Upper(_AsciiMap):
+    lower = False
+
+
+class Lower(_AsciiMap):
+    lower = True
+
+
+class Concat(Expression):
+    """concat(...): NULL if any input is NULL (Spark semantics)."""
+
+    def __init__(self, *exprs):
+        super().__init__(list(exprs))
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def eval_tpu(self, ctx):
+        xp = jnp()
+        cols = [self.children[0].eval(ctx)]
+        for c in self.children[1:]:
+            cols.append(c.eval(ctx))
+        parts = [_dev_inputs(c, ctx, xp) for c in cols]
+        total_w = sum(p[0].shape[1] for p in parts)
+        n = parts[0][0].shape[0]
+        out = xp.zeros((n, total_w), dtype=np.uint8)
+        acc_len = xp.zeros(n, dtype=np.int32)
+        valid = xp.ones(n, dtype=bool)
+        j = xp.arange(total_w)[None, :]
+        for chars, lens, v in parts:
+            w = chars.shape[1]
+            # scatter this part at offset acc_len: out[r, acc_len+k] = chars[r, k]
+            src_idx = j - acc_len[:, None]
+            in_part = (src_idx >= 0) & (src_idx < lens[:, None])
+            gathered = xp.take_along_axis(
+                chars, xp.clip(src_idx, 0, w - 1).astype(np.int32), axis=1)
+            out = xp.where(in_part, gathered, out)
+            acc_len = acc_len + lens
+            valid = valid & v
+        return TCol(out, valid, T.STRING, lengths=acc_len)
+
+    def eval_cpu(self, ctx):
+        cols = [c.eval(ctx) for c in self.children]
+        datas = [materialize(c, ctx, np.dtype(object)) for c in cols]
+        valids = [valid_array(c, ctx) for c in cols]
+        n = len(datas[0])
+        out = np.empty(n, dtype=object)
+        valid = np.ones(n, dtype=bool)
+        for v in valids:
+            valid &= v
+        for i in range(n):
+            if valid[i] and all(d[i] is not None for d in datas):
+                out[i] = "".join(d[i] for d in datas)
+            else:
+                out[i] = None
+                valid[i] = False
+        return TCol(out, valid, T.STRING)
+
+
+class Substring(Expression):
+    """substring(str, pos, len): 1-based pos; negative pos counts from end.
+
+    NOTE: device kernel operates on BYTES; Spark semantics are characters.
+    For ASCII they agree; multi-byte inputs are tagged incompat (reference
+    documents similar unicode caveats for some string ops).
+    """
+
+    def __init__(self, child, pos, length=None):
+        from spark_rapids_tpu.expressions.base import Literal
+        pos = pos if isinstance(pos, Expression) else Literal(int(pos))
+        kids = [child, pos]
+        if length is not None:
+            length = length if isinstance(length, Expression) else \
+                Literal(int(length))
+            kids.append(length)
+        super().__init__(kids)
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def eval_tpu(self, ctx):
+        xp = jnp()
+        c = self.children[0].eval(ctx)
+        chars, lens, valid = _dev_inputs(c, ctx, xp)
+        p = self.children[1].eval(ctx)
+        pos = materialize(p, ctx, np.dtype(np.int32))
+        valid = valid & valid_array(p, ctx) if not p.is_scalar else valid
+        if len(self.children) > 2:
+            le = self.children[2].eval(ctx)
+            slen = materialize(le, ctx, np.dtype(np.int32))
+        else:
+            slen = xp.full(chars.shape[0], 2**30, dtype=np.int32)
+        start = xp.where(pos > 0, pos - 1,
+                         xp.where(pos < 0, xp.maximum(lens + pos, 0), 0))
+        start = xp.minimum(start.astype(np.int32), lens)
+        out_len = xp.clip(xp.minimum(slen, lens - start), 0, None)
+        w = chars.shape[1]
+        j = xp.arange(w)[None, :]
+        src = j + start[:, None]
+        gathered = xp.take_along_axis(chars, xp.clip(src, 0, w - 1), axis=1)
+        out = xp.where(j < out_len[:, None], gathered, 0)
+        return TCol(out, valid, T.STRING, lengths=out_len.astype(np.int32))
+
+    def eval_cpu(self, ctx):
+        c = self.children[0].eval(ctx)
+        p = self.children[1].eval(ctx)
+        pos = materialize(p, ctx, np.dtype(np.int32))
+        if len(self.children) > 2:
+            slen = materialize(self.children[2].eval(ctx), ctx,
+                               np.dtype(np.int32))
+        else:
+            slen = np.full(ctx.row_count, 2**30, dtype=np.int32)
+        data = materialize(c, ctx, np.dtype(object))
+        valid = valid_array(c, ctx)
+        out = np.empty(len(data), dtype=object)
+        for i in range(len(data)):
+            if not valid[i] or data[i] is None:
+                out[i] = None
+                continue
+            s = data[i]
+            po = int(pos[i])
+            start = po - 1 if po > 0 else (max(len(s) + po, 0) if po < 0 else 0)
+            out[i] = s[start:start + max(int(slen[i]), 0)] if start >= 0 else ""
+        return TCol(out, valid, T.STRING)
+
+
+class _FixedCompare(BinaryExpr):
+    """startswith/endswith/contains with an arbitrary string RHS."""
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def eval_tpu(self, ctx):
+        xp = jnp()
+        a = self.left.eval(ctx)
+        b = self.right.eval(ctx)
+        valid = both_valid(a, b, ctx)
+        if a.is_scalar and b.is_scalar:
+            if not valid:
+                return TCol.scalar(None, T.BOOLEAN)
+            return TCol.scalar(self._py(a.data, b.data), T.BOOLEAN)
+        achars, alens, av = _dev_inputs(a, ctx, xp)
+        bchars, blens, bv = _dev_inputs(b, ctx, xp)
+        out = self._dev(achars, alens, bchars, blens, xp)
+        return TCol(out, av & bv, T.BOOLEAN)
+
+    def eval_cpu(self, ctx):
+        a = self.left.eval(ctx)
+        b = self.right.eval(ctx)
+        ad = materialize(a, ctx, np.dtype(object))
+        bd = materialize(b, ctx, np.dtype(object))
+        valid = valid_array(a, ctx) & valid_array(b, ctx)
+        out = np.zeros(len(ad), dtype=bool)
+        for i in range(len(ad)):
+            if valid[i] and ad[i] is not None and bd[i] is not None:
+                out[i] = self._py(ad[i], bd[i])
+        return TCol(out, valid, T.BOOLEAN)
+
+
+class StartsWith(_FixedCompare):
+    symbol = "startswith"
+
+    def _py(self, s, p):
+        return s.startswith(p)
+
+    def _dev(self, ac, al, bc, bl, xp):
+        w = min(ac.shape[1], bc.shape[1])
+        eq = ac[:, :w] == bc[:, :w]
+        pos = xp.arange(w)[None, :]
+        in_pat = pos < bl[:, None]
+        return xp.all(eq | ~in_pat, axis=1) & (bl <= al)
+
+
+class EndsWith(_FixedCompare):
+    symbol = "endswith"
+
+    def _py(self, s, p):
+        return s.endswith(p)
+
+    def _dev(self, ac, al, bc, bl, xp):
+        w = bc.shape[1]
+        j = xp.arange(w)[None, :]
+        src = al[:, None] - bl[:, None] + j
+        gathered = xp.take_along_axis(
+            ac, xp.clip(src, 0, ac.shape[1] - 1), axis=1) \
+            if ac.shape[1] else ac
+        in_pat = j < bl[:, None]
+        eq = gathered == bc[:, :w]
+        return xp.all(eq | ~in_pat, axis=1) & (bl <= al)
+
+
+class Contains(_FixedCompare):
+    symbol = "contains"
+
+    def _py(self, s, p):
+        return p in s
+
+    def _dev(self, ac, al, bc, bl, xp):
+        wa, wb = ac.shape[1], bc.shape[1]
+        # sliding window compare: for each start s in [0, wa), check pattern
+        j = xp.arange(wb)[None, None, :]           # [1,1,wb]
+        starts = xp.arange(wa)[None, :, None]      # [1,wa,1]
+        src = starts + j                           # [1,wa,wb]
+        src_c = xp.broadcast_to(xp.clip(src, 0, wa - 1),
+                                (ac.shape[0], wa, wb))
+        gathered = xp.take_along_axis(ac[:, None, :], src_c, axis=2)
+        in_pat = j < bl[:, None, None]
+        eq = gathered == bc[:, None, :]
+        match_at = xp.all(eq | ~in_pat, axis=2)    # [n, wa]
+        starts_ok = starts[0, :, 0][None, :] <= (al - bl)[:, None]
+        return xp.any(match_at & starts_ok, axis=1)
+
+
+class Like(BinaryExpr):
+    """SQL LIKE with % and _ (reference GpuLike; escapes default '\\').
+
+    Device: handled by the planner rewriting pure-prefix/suffix/infix
+    patterns to StartsWith/EndsWith/Contains (the reference's
+    RegexRewriteUtils does the same trick); general patterns run on CPU.
+    """
+    symbol = "like"
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def tpu_supported(self, conf):
+        return "general LIKE runs on host (planner rewrites simple patterns)"
+
+    def _match(self, s, pattern):
+        import re
+        regex = "^"
+        i = 0
+        while i < len(pattern):
+            ch = pattern[i]
+            if ch == "\\" and i + 1 < len(pattern):
+                regex += re.escape(pattern[i + 1])
+                i += 2
+                continue
+            if ch == "%":
+                regex += ".*"
+            elif ch == "_":
+                regex += "."
+            else:
+                regex += re.escape(ch)
+            i += 1
+        return re.match(regex + "$", s, flags=re.DOTALL) is not None
+
+    def eval_cpu(self, ctx):
+        a = self.left.eval(ctx)
+        b = self.right.eval(ctx)
+        ad = materialize(a, ctx, np.dtype(object))
+        bd = materialize(b, ctx, np.dtype(object))
+        valid = valid_array(a, ctx) & valid_array(b, ctx)
+        out = np.zeros(len(ad), dtype=bool)
+        for i in range(len(ad)):
+            if valid[i] and ad[i] is not None and bd[i] is not None:
+                out[i] = self._match(ad[i], bd[i])
+        return TCol(out, valid, T.BOOLEAN)
+
+    eval_tpu = eval_cpu  # host fallback even when called on device path
+
+
+class _Trim(UnaryExpr):
+    trim_left = True
+    trim_right = True
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def eval_tpu(self, ctx):
+        xp = jnp()
+        c = self.child.eval(ctx)
+        chars, lens, valid = _dev_inputs(c, ctx, xp)
+        w = chars.shape[1]
+        pos = xp.arange(w)[None, :]
+        in_len = pos < lens[:, None]
+        is_space = (chars == 32) & in_len
+        non_space = (~is_space) & in_len
+        any_ns = xp.any(non_space, axis=1)
+        first = xp.where(any_ns, xp.argmax(non_space, axis=1), 0) \
+            if self.trim_left else xp.zeros_like(lens)
+        if self.trim_right:
+            last = xp.where(any_ns,
+                            w - 1 - xp.argmax(non_space[:, ::-1], axis=1),
+                            -1)
+        else:
+            last = lens - 1
+        # all-space input trims to empty in every mode
+        new_len = xp.clip(xp.where(any_ns, last - first + 1, 0), 0, None)
+        j = xp.arange(w)[None, :]
+        src = j + first[:, None]
+        gathered = xp.take_along_axis(chars, xp.clip(src, 0, w - 1), axis=1)
+        out = xp.where(j < new_len[:, None], gathered, 0)
+        return TCol(out, valid, T.STRING, lengths=new_len.astype(np.int32))
+
+    def eval_cpu(self, ctx):
+        c = self.child.eval(ctx)
+        if self.trim_left and self.trim_right:
+            fn = lambda s: s.strip(" ")
+        elif self.trim_left:
+            fn = lambda s: s.lstrip(" ")
+        else:
+            fn = lambda s: s.rstrip(" ")
+        out, valid = _cpu_str_map(c, ctx, fn)
+        return TCol(out, valid, T.STRING)
+
+
+class Trim(_Trim):
+    trim_left = True
+    trim_right = True
+
+
+class LTrim(_Trim):
+    trim_left = True
+    trim_right = False
+
+
+class RTrim(_Trim):
+    trim_left = False
+    trim_right = True

@@ -162,17 +162,31 @@ class BufferCatalog:
             if buf.tier == StorageTier.DEVICE:
                 return buf.device_batch
             host = self._host_batch_locked(buf)
-            dev = host.to_device()
-            nbytes = dev.nbytes()
-        # reserve outside the per-buffer state change to allow spilling others
-        self.reserve(nbytes)
+        # admission BEFORE materializing on device (the estimate is exact for
+        # fixed-width data and a safe upper bound for strings: pow2 bucket
+        # padding is < 2x the host payload + validity/length vectors)
+        est = 2 * host.nbytes() + 16 * max(host.row_count, 1024)
+        self.reserve(est)
+        dev = host.to_device()
+        nbytes = dev.nbytes()
         with self._lock:
-            buf = self._require(handle)
+            buf = self._buffers.get(handle.id)
+            if buf is None:  # removed concurrently
+                _delete_device_batch(dev)
+                raise KeyError(f"unknown or closed buffer handle {handle}")
             if buf.tier != StorageTier.DEVICE:
                 buf.device_batch = dev
                 buf.device_nbytes = nbytes
                 self.device_bytes += nbytes
+                # single-tier ownership: promotion drops the host copy and its
+                # charge (prevents double-count on the next spill cycle)
+                if buf.host_batch is not None:
+                    self.host_bytes -= buf.host_nbytes
+                    buf.host_batch = None
+                    buf.host_nbytes = 0
                 buf.tier = StorageTier.DEVICE
+            else:
+                _delete_device_batch(dev)  # raced with another unspiller
             return buf.device_batch
 
     def get_host_batch(self, handle: BufferHandle) -> HostColumnarBatch:

@@ -174,7 +174,14 @@ def with_retry(spillables, fn: Callable[..., X],
     if not isinstance(spillables, (list, tuple)):
         spillables = [spillables]
     queue: List = list(spillables)
+    # capture the nesting decision at CALL time, not at first next(): a
+    # generator created at top level but drained inside another retry frame
+    # must still be allowed to split (generator bodies run lazily)
     top_level = _TL.retry_frame_depth == 0
+    return _with_retry_gen(queue, fn, split_policy, max_retries, top_level)
+
+
+def _with_retry_gen(queue, fn, split_policy, max_retries, top_level):
     _TL.retry_frame_depth += 1
     try:
         while queue:

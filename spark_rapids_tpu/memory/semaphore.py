@@ -21,11 +21,18 @@ class TpuSemaphore:
         self._holders: Dict[int, dict] = {}
         self._lock = threading.Lock()
 
+    @staticmethod
+    def _tid(task_id: Optional[int]) -> int:
+        from spark_rapids_tpu.memory.retry import task_context
+        if task_id is not None:
+            return task_id
+        ctx_id = task_context().task_id
+        return ctx_id if ctx_id is not None else threading.get_ident()
+
     def acquire_if_necessary(self, task_id: Optional[int] = None) -> None:
         """Idempotent per-task acquire (reference: acquireIfNecessary :100)."""
         from spark_rapids_tpu.memory.retry import task_context
-        tid = task_id if task_id is not None else (task_context().task_id or
-                                                   threading.get_ident())
+        tid = self._tid(task_id)
         with self._lock:
             if tid in self._holders:
                 self._holders[tid]["depth"] += 1
@@ -37,13 +44,18 @@ class TpuSemaphore:
         if mt is not None:
             mt.semaphore_wait_seconds += wait
         with self._lock:
+            entry = self._holders.get(tid)
+            if entry is not None:
+                # raced with another thread of the same task: count the
+                # acquire as a depth and return the duplicate permit
+                entry["depth"] += 1
+                self._sem.release()
+                return
             self._holders[tid] = {"depth": 1, "since": time.monotonic(),
                                   "thread": threading.current_thread().name}
 
     def release_if_necessary(self, task_id: Optional[int] = None) -> None:
-        from spark_rapids_tpu.memory.retry import task_context
-        tid = task_id if task_id is not None else (task_context().task_id or
-                                                   threading.get_ident())
+        tid = self._tid(task_id)
         with self._lock:
             entry = self._holders.get(tid)
             if entry is None:
