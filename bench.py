@@ -1,0 +1,72 @@
+"""Benchmark entry point (driver contract: prints ONE JSON line).
+
+Measures the representative columnar pipeline of the minimum end-to-end
+slice (BASELINE.md milestone config #1: single-node filter+project over
+generated data): scan -> filter -> project(arith + hash) on the device
+engine, against the CPU fallback engine as baseline (the reference's own
+baseline is Spark-CPU; SURVEY.md §6).
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def _build_data(n_rows: int):
+    import numpy as np
+    rng = np.random.default_rng(7)
+    return {
+        "k": rng.integers(0, 1 << 20, n_rows).astype(np.int64),
+        "v": rng.standard_normal(n_rows),
+        "w": rng.integers(-1000, 1000, n_rows).astype(np.int32),
+    }
+
+
+def _pipeline(s, data, parts):
+    from spark_rapids_tpu.expressions import arithmetic as A
+    from spark_rapids_tpu.expressions import hashing as H
+    from spark_rapids_tpu.expressions import predicates as P
+    from spark_rapids_tpu.expressions.base import Alias, col, lit
+    return (s.create_dataframe(data, num_partitions=parts)
+            .filter(P.GreaterThan(col("w"), lit(0)))
+            .select(Alias(A.Add(col("k"), lit(1)), "k1"),
+                    Alias(A.Multiply(col("v"), lit(2.0)), "v2"),
+                    Alias(H.Murmur3Hash(col("k"), col("w")), "h")))
+
+
+def main():
+    n_rows = int(os.environ.get("BENCH_ROWS", 4_000_000))
+    parts = 4
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.session import TpuSession
+
+    data = _build_data(n_rows)
+
+    def run(session):
+        df = _pipeline(session, data, parts)
+        t0 = time.perf_counter()
+        total = df.count()
+        dt = time.perf_counter() - t0
+        return total, dt
+
+    # warm + measure TPU engine
+    tpu = TpuSession(TpuConf({"spark.rapids.sql.enabled": "true"}))
+    run(tpu)  # warm-up: compile cache
+    best_tpu = min(run(tpu)[1] for _ in range(3))
+
+    cpu = TpuSession(TpuConf({"spark.rapids.sql.enabled": "false"}),
+                     init_device=False)
+    best_cpu = min(run(cpu)[1] for _ in range(2))
+
+    rows_per_sec = n_rows / best_tpu
+    print(json.dumps({
+        "metric": "filter_project_hash_rows_per_sec",
+        "value": round(rows_per_sec),
+        "unit": "rows/s",
+        "vs_baseline": round(best_cpu / best_tpu, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

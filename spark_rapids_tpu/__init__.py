@@ -28,4 +28,15 @@ __version__ = "26.08.0"
 from spark_rapids_tpu.config import TpuConf  # noqa: F401
 from spark_rapids_tpu import types  # noqa: F401
 
-__all__ = ["TpuConf", "types", "__version__"]
+
+def connect(conf=None):
+    """Creates a TpuSession (SparkSession + plugin-init analog).
+
+    Named ``connect`` (not ``session``) because the ``session`` submodule
+    would shadow a package-level function of the same name after import.
+    """
+    from spark_rapids_tpu.session import TpuSession
+    return TpuSession(conf)
+
+
+__all__ = ["TpuConf", "types", "connect", "__version__"]

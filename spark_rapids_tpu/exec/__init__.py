@@ -1,0 +1,13 @@
+"""Physical operators: host (CPU fallback engine) and device (Tpu*) pairs.
+
+Reference: SURVEY.md §2.6 operator families.  Naming mirrors the reference's
+Gpu*Exec classes as Tpu*Exec; the Cpu*Exec side plays the role of Spark's CPU
+operators (the fallback tier and the differential-test oracle).
+"""
+
+from spark_rapids_tpu.exec.basic import (  # noqa: F401
+    CpuFilterExec, CpuInMemoryScanExec, CpuLimitExec, CpuProjectExec,
+    CpuRangeExec, CpuSampleExec, CpuUnionExec, DeviceToHostExec,
+    HostToDeviceExec, TpuCoalesceBatchesExec, TpuFilterExec,
+    TpuInMemoryScanExec, TpuLimitExec, TpuProjectExec, TpuRangeExec,
+    TpuSampleExec, TpuUnionExec)

@@ -1,0 +1,444 @@
+"""Basic physical operators.
+
+Reference: basicPhysicalOperators.scala (GpuProjectExec :350 tiered
+projection, GpuFilterExec :795, GpuRangeExec), limit.scala, GpuUnionExec,
+GpuSampleExec in GpuOverrides registrations; transitions
+GpuRowToColumnarExec.scala / GpuColumnarToRowExec.scala / HostColumnarToGpu.scala.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import (ColumnarBatch, HostColumnarBatch,
+                                             batch_from_arrow)
+from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
+                                               Expression)
+from spark_rapids_tpu.expressions.evaluator import (eval_exprs_cpu,
+                                                    eval_exprs_tpu, _out_names)
+from spark_rapids_tpu.plan.base import Exec, LeafExec, UnaryExec
+
+
+def _project_schema(exprs: Sequence[Expression]) -> T.StructType:
+    names = _out_names(exprs)
+    return T.StructType([T.StructField(n, e.data_type, e.nullable)
+                         for n, e in zip(names, exprs)])
+
+
+# ---------------------------------------------------------------------------
+# Scans
+# ---------------------------------------------------------------------------
+
+class CpuInMemoryScanExec(LeafExec):
+    """Scan over in-memory arrow batches, pre-split into partitions."""
+
+    def __init__(self, partitions: List[List[HostColumnarBatch]],
+                 schema: T.StructType):
+        super().__init__()
+        self.partitions = partitions
+        self._schema = schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    @property
+    def num_partitions(self):
+        return max(1, len(self.partitions))
+
+    def execute_partition(self, pidx):
+        if pidx < len(self.partitions):
+            yield from self.partitions[pidx]
+
+    def node_desc(self):
+        return f"InMemoryScan[{self.num_partitions}p]"
+
+
+class TpuInMemoryScanExec(CpuInMemoryScanExec):
+    is_device = True
+
+    def __init__(self, cpu: CpuInMemoryScanExec):
+        super().__init__(cpu.partitions, cpu.schema)
+
+    def execute_partition(self, pidx):
+        from spark_rapids_tpu.memory.device_manager import get_runtime
+        rt = get_runtime()
+        for hb in self.partitions[pidx] if pidx < len(self.partitions) else ():
+            if rt is not None:
+                rt.semaphore.acquire_if_necessary()
+            yield hb.to_device()
+
+    def node_desc(self):
+        return f"TpuInMemoryScan[{self.num_partitions}p]"
+
+
+# ---------------------------------------------------------------------------
+# Project / Filter
+# ---------------------------------------------------------------------------
+
+class CpuProjectExec(UnaryExec):
+    def __init__(self, exprs: Sequence[Expression], child: Exec):
+        super().__init__(child)
+        self.exprs = list(exprs)
+
+    @property
+    def schema(self):
+        return _project_schema(self.exprs)
+
+    def execute_partition(self, pidx):
+        for b in self.child.execute_partition(pidx):
+            yield eval_exprs_cpu(self.exprs, b)
+
+    def node_desc(self):
+        return f"Project[{', '.join(e.sql() for e in self.exprs)}]"
+
+
+class TpuProjectExec(UnaryExec):
+    """Whole-stage-fused device projection (reference: GpuProjectExec with
+    tiered project; here the whole expr list is one XLA program)."""
+
+    is_device = True
+
+    def __init__(self, exprs: Sequence[Expression], child: Exec):
+        super().__init__(child)
+        self.exprs = list(exprs)
+
+    @property
+    def schema(self):
+        return _project_schema(self.exprs)
+
+    def execute_partition(self, pidx):
+        for b in self.child.execute_partition(pidx):
+            yield eval_exprs_tpu(self.exprs, b)
+
+    def node_desc(self):
+        return f"TpuProject[{', '.join(e.sql() for e in self.exprs)}]"
+
+
+class CpuFilterExec(UnaryExec):
+    def __init__(self, condition: Expression, child: Exec):
+        super().__init__(child)
+        self.condition = condition
+
+    def execute_partition(self, pidx):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        from spark_rapids_tpu.expressions.evaluator import (host_batch_tcols,
+                                                            tcol_to_host_column)
+        from spark_rapids_tpu.expressions.base import EvalContext
+        for b in self.child.execute_partition(pidx):
+            cols = host_batch_tcols(b)
+            ctx = EvalContext(cols, "cpu", b.row_count)
+            pred = self.condition.eval_cpu(ctx)
+            keep_col = tcol_to_host_column(pred, b.row_count)
+            mask = pc.fill_null(keep_col.arrow.cast(pa.bool_()), False)
+            rb = b.to_arrow().filter(mask)
+            yield batch_from_arrow(pa.Table.from_batches([rb]))
+
+    def node_desc(self):
+        return f"Filter[{self.condition.sql()}]"
+
+
+class TpuFilterExec(UnaryExec):
+    """Filter = fused predicate eval + stable compaction gather; bucket is
+    preserved so no recompilation across batches (see ops.batch_ops)."""
+
+    is_device = True
+
+    def __init__(self, condition: Expression, child: Exec):
+        super().__init__(child)
+        self.condition = condition
+
+    def execute_partition(self, pidx):
+        from spark_rapids_tpu.expressions.base import EvalContext, valid_array
+        from spark_rapids_tpu.expressions.evaluator import device_batch_tcols
+        from spark_rapids_tpu.ops import compact_batch
+        from spark_rapids_tpu.columnar.column import _jnp
+        import jax
+        jnp = _jnp()
+        for b in self.child.execute_partition(pidx):
+            cols = device_batch_tcols(b)
+            ctx = EvalContext(cols, "tpu", b.bucket)
+            pred = self.condition.eval_tpu(ctx)
+            keep = valid_array(pred, ctx)
+            if not pred.is_scalar:
+                keep = keep & pred.data
+            else:
+                keep = keep & bool(pred.data)
+            # padding rows must never be kept
+            rowpos = jnp.arange(b.bucket)
+            keep = keep & (rowpos < b.row_count)
+            yield compact_batch(b, keep)
+
+    def node_desc(self):
+        return f"TpuFilter[{self.condition.sql()}]"
+
+
+# ---------------------------------------------------------------------------
+# Range
+# ---------------------------------------------------------------------------
+
+class CpuRangeExec(LeafExec):
+    """SELECT id FROM range(start, end, step) (reference GpuRangeExec)."""
+
+    def __init__(self, start: int, end: int, step: int = 1,
+                 num_partitions: int = 1, batch_rows: int = 1 << 20):
+        super().__init__()
+        self.start, self.end, self.step = start, end, step
+        self._parts = max(1, num_partitions)
+        self.batch_rows = batch_rows
+
+    @property
+    def schema(self):
+        return T.StructType([T.StructField("id", T.LONG, False)])
+
+    @property
+    def num_partitions(self):
+        return self._parts
+
+    def _partition_range(self, pidx):
+        total = max(0, -(-(self.end - self.start) // self.step))
+        per = -(-total // self._parts)
+        lo = min(pidx * per, total)
+        hi = min(lo + per, total)
+        return lo, hi
+
+    def execute_partition(self, pidx):
+        from spark_rapids_tpu.columnar.batch import batch_from_pydict
+        lo, hi = self._partition_range(pidx)
+        pos = lo
+        while pos < hi:
+            n = min(self.batch_rows, hi - pos)
+            vals = self.start + (pos + np.arange(n, dtype=np.int64)) * self.step
+            yield batch_from_pydict({"id": vals}, self.schema)
+            pos += n
+
+    def node_desc(self):
+        return f"Range({self.start}, {self.end}, {self.step})"
+
+
+class TpuRangeExec(CpuRangeExec):
+    is_device = True
+
+    def __init__(self, cpu: CpuRangeExec):
+        super().__init__(cpu.start, cpu.end, cpu.step, cpu._parts,
+                         cpu.batch_rows)
+
+    def execute_partition(self, pidx):
+        from spark_rapids_tpu.columnar.column import (DeviceColumn, _jnp,
+                                                      bucket_rows)
+        jnp = _jnp()
+        lo, hi = self._partition_range(pidx)
+        pos = lo
+        while pos < hi:
+            n = min(self.batch_rows, hi - pos)
+            b = bucket_rows(n)
+            vals = self.start + (pos + jnp.arange(b, dtype=np.int64)) * self.step
+            valid = jnp.arange(b) < n
+            col = DeviceColumn(vals, valid, n, T.LONG)
+            yield ColumnarBatch([col], n, ["id"])
+            pos += n
+
+    def node_desc(self):
+        return f"TpuRange({self.start}, {self.end}, {self.step})"
+
+
+# ---------------------------------------------------------------------------
+# Limit / Union / Sample
+# ---------------------------------------------------------------------------
+
+class CpuLimitExec(UnaryExec):
+    """Local limit per partition; with single-partition input it is global
+    (reference: Local/Global/CollectLimitExec trio)."""
+
+    def __init__(self, n: int, child: Exec):
+        super().__init__(child)
+        self.n = n
+
+    def execute_partition(self, pidx):
+        left = self.n
+        for b in self.child.execute_partition(pidx):
+            if left <= 0:
+                break
+            if b.row_count <= left:
+                left -= b.row_count
+                yield b
+            else:
+                yield b.slice(0, left)
+                left = 0
+
+    def node_desc(self):
+        return f"Limit[{self.n}]"
+
+
+class TpuLimitExec(UnaryExec):
+    is_device = True
+
+    def __init__(self, n: int, child: Exec):
+        super().__init__(child)
+        self.n = n
+
+    def execute_partition(self, pidx):
+        from spark_rapids_tpu.ops import take_front
+        left = self.n
+        for b in self.child.execute_partition(pidx):
+            if left <= 0:
+                break
+            if b.row_count <= left:
+                left -= b.row_count
+                yield b
+            else:
+                yield take_front(b, left)
+                left = 0
+
+    def node_desc(self):
+        return f"TpuLimit[{self.n}]"
+
+
+class CpuUnionExec(Exec):
+    def __init__(self, children: Sequence[Exec]):
+        super().__init__(children)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    @property
+    def num_partitions(self):
+        return sum(c.num_partitions for c in self.children)
+
+    def _locate(self, pidx):
+        for c in self.children:
+            if pidx < c.num_partitions:
+                return c, pidx
+            pidx -= c.num_partitions
+        raise IndexError(pidx)
+
+    def execute_partition(self, pidx):
+        child, sub = self._locate(pidx)
+        yield from child.execute_partition(sub)
+
+    def node_desc(self):
+        return f"Union[{len(self.children)}]"
+
+
+class TpuUnionExec(CpuUnionExec):
+    is_device = True
+
+    def node_desc(self):
+        return f"TpuUnion[{len(self.children)}]"
+
+
+class CpuSampleExec(UnaryExec):
+    """Bernoulli sample (reference GpuSampleExec)."""
+
+    def __init__(self, fraction: float, seed: int, child: Exec):
+        super().__init__(child)
+        self.fraction = fraction
+        self.seed = seed
+
+    def execute_partition(self, pidx):
+        import pyarrow as pa
+        rng = np.random.default_rng(self.seed + pidx)
+        for b in self.child.execute_partition(pidx):
+            mask = rng.random(b.row_count) < self.fraction
+            rb = b.to_arrow().filter(pa.array(mask))
+            yield batch_from_arrow(pa.Table.from_batches([rb]))
+
+    def node_desc(self):
+        return f"Sample[{self.fraction}]"
+
+
+class TpuSampleExec(UnaryExec):
+    is_device = True
+
+    def __init__(self, fraction: float, seed: int, child: Exec):
+        super().__init__(child)
+        self.fraction = fraction
+        self.seed = seed
+
+    def execute_partition(self, pidx):
+        import jax
+        from spark_rapids_tpu.ops import compact_batch
+        from spark_rapids_tpu.columnar.column import _jnp
+        jnp = _jnp()
+        key = jax.random.PRNGKey(self.seed + pidx)
+        for i, b in enumerate(self.child.execute_partition(pidx)):
+            key, sub = jax.random.split(key)
+            u = jax.random.uniform(sub, (b.bucket,))
+            keep = (u < self.fraction) & (jnp.arange(b.bucket) < b.row_count)
+            yield compact_batch(b, keep)
+
+    def node_desc(self):
+        return f"TpuSample[{self.fraction}]"
+
+
+# ---------------------------------------------------------------------------
+# Transitions (reference: GpuRowToColumnarExec / GpuColumnarToRowExec /
+# HostColumnarToGpu; ours collapse to host<->device batch copies)
+# ---------------------------------------------------------------------------
+
+class HostToDeviceExec(UnaryExec):
+    is_device = True
+
+    def execute_partition(self, pidx):
+        from spark_rapids_tpu.memory.device_manager import get_runtime
+        rt = get_runtime()
+        for b in self.child.execute_partition(pidx):
+            if rt is not None:
+                rt.semaphore.acquire_if_necessary()
+            yield b.to_device()
+
+    def node_desc(self):
+        return "HostToDevice"
+
+
+class DeviceToHostExec(UnaryExec):
+    is_device = False
+
+    def execute_partition(self, pidx):
+        from spark_rapids_tpu.memory.device_manager import get_runtime
+        rt = get_runtime()
+        for b in self.child.execute_partition(pidx):
+            hb = b.to_host()
+            if rt is not None:
+                rt.semaphore.release_if_necessary()
+            yield hb
+
+    def node_desc(self):
+        return "DeviceToHost"
+
+
+class TpuCoalesceBatchesExec(UnaryExec):
+    """Concatenates small device batches up to a target size (reference:
+    GpuCoalesceBatches.scala CoalesceGoal/TargetSize)."""
+
+    is_device = True
+
+    def __init__(self, child: Exec, target_bytes: int = 512 << 20,
+                 require_single_batch: bool = False):
+        super().__init__(child)
+        self.target_bytes = target_bytes
+        self.require_single_batch = require_single_batch
+
+    def execute_partition(self, pidx):
+        from spark_rapids_tpu.ops import concat_batches
+        pending: List[ColumnarBatch] = []
+        pending_bytes = 0
+        for b in self.child.execute_partition(pidx):
+            pending.append(b)
+            pending_bytes += b.sized_nbytes()
+            if not self.require_single_batch and \
+                    pending_bytes >= self.target_bytes:
+                yield concat_batches(pending)
+                pending, pending_bytes = [], 0
+        if pending:
+            yield concat_batches(pending)
+
+    def node_desc(self):
+        goal = "RequireSingleBatch" if self.require_single_batch else \
+            f"TargetSize({self.target_bytes})"
+        return f"TpuCoalesceBatches[{goal}]"

@@ -1,0 +1,13 @@
+"""IO formats: scans and writers (reference: SURVEY.md §2.7).
+
+TPU-first stance on file decode: the reference decodes parquet/orc ON the
+GPU (cuDF readers) after host-side footer filtering.  Byte-wrangling decode
+is TPU-hostile, so here decode happens on host (arrow readers play the role
+of the reference's host-side footer/chunk stage) and decoded columns upload
+to the device as padded batches — the admission point mirrors
+GpuParquetScan's semaphore acquisition before device work
+(GpuParquetScan.scala:1282 readToTable -> GpuSemaphore.acquireIfNecessary).
+"""
+
+from spark_rapids_tpu.io.parquet import (  # noqa: F401
+    CpuParquetScanExec, write_parquet)

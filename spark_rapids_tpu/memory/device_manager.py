@@ -28,7 +28,19 @@ _runtime: Optional["DeviceManager"] = None
 
 class DeviceManager:
     def __init__(self, conf: TpuConf):
+        import os
         import jax
+        # honor an explicit JAX_PLATFORMS=cpu request even when a site hook
+        # pinned a different platform list in-process (hermetic CPU runs);
+        # any other value is left to jax/site configuration untouched
+        if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+            from jax._src import xla_bridge as _xb
+            if _xb._backends and "cpu" not in _xb._backends:
+                log.warning(
+                    "JAX_PLATFORMS=cpu requested but jax backends were "
+                    "already initialized (%s); the request cannot take "
+                    "effect in this process", list(_xb._backends))
+            jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_enable_x64", True)
         self.conf = conf
         self.device = jax.devices()[0]
@@ -72,6 +84,18 @@ def initialize(conf: Optional[TpuConf] = None) -> DeviceManager:
     with _runtime_lock:
         if _runtime is None:
             _runtime = DeviceManager(conf or C.default_conf())
+        elif conf is not None and conf is not _runtime.conf:
+            # device/memory settings are startup-scoped (reference: RapidsConf
+            # STARTUP level); a second session cannot re-shape the pool
+            for key in (C.DEVICE_POOL_SIZE.key, C.DEVICE_POOL_FRACTION.key,
+                        C.HOST_SPILL_STORAGE_SIZE.key, C.SPILL_TO_DISK_DIR.key,
+                        C.CONCURRENT_TPU_TASKS.key):
+                if conf.get(key) != _runtime.conf.get(key):
+                    log.warning(
+                        "runtime already initialized; startup conf %s=%r is "
+                        "ignored (active value %r). Call shutdown() first to "
+                        "re-shape the device runtime.", key, conf.get(key),
+                        _runtime.conf.get(key))
         return _runtime
 
 

@@ -1,0 +1,9 @@
+"""Device batch kernels (gather/compact/concat/slice) shared by execs.
+
+Counterpart of cuDF Table-level primitives the reference leans on
+(SURVEY.md §2.16: gather maps, contiguous split/pack, concat) — here
+implemented as jnp ops over padded batches so XLA owns scheduling/fusion.
+"""
+
+from spark_rapids_tpu.ops.batch_ops import (  # noqa: F401
+    gather_batch, compact_batch, concat_batches, slice_batch, take_front)

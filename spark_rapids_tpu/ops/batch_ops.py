@@ -1,0 +1,173 @@
+"""Batch-level device kernels.
+
+Key TPU-first decisions:
+- ``compact_batch`` implements filtering as a stable argsort on the keep
+  mask + gather — dynamic-shape-free, so the same compiled program serves
+  every batch; only the resulting row COUNT syncs to host (one scalar).
+  (cuDF's apply_boolean_mask materializes a shorter column; XLA wants the
+  static shape kept and the logical length tracked separately.)
+- ``concat_batches`` re-packs several padded batches into one bigger padded
+  bucket with a single jit'ed copy per (shapes, bucket) signature.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn, bucket_rows
+
+
+def _jx():
+    from spark_rapids_tpu.columnar.column import _jnp
+    return _jnp()
+
+
+_COMPACT_CACHE: Dict[Tuple, object] = {}
+_CONCAT_CACHE: Dict[Tuple, object] = {}
+
+
+def _col_sig(c: DeviceColumn) -> Tuple:
+    return (str(c.data.dtype), tuple(c.data.shape), c.lengths is not None)
+
+
+def gather_batch(batch: ColumnarBatch, idx, row_count: int,
+                 idx_valid=None) -> ColumnarBatch:
+    """Gathers rows by index (device gather-map application; reference:
+    cuDF Table.gather via JoinGatherer).  ``idx`` may exceed row bounds for
+    padding positions; callers pass ``idx_valid`` to invalidate those rows."""
+    jnp = _jx()
+    out = []
+    n = idx.shape[0]
+    safe = jnp.clip(idx, 0, batch.bucket - 1)
+    for c in batch.columns:
+        data = jnp.take(c.data, safe, axis=0)
+        valid = jnp.take(c.validity, safe, axis=0)
+        if idx_valid is not None:
+            valid = valid & idx_valid
+        lengths = None if c.lengths is None else jnp.take(c.lengths, safe, axis=0)
+        out.append(DeviceColumn(data, valid, row_count, c.data_type, lengths))
+    return ColumnarBatch(out, row_count, batch.names)
+
+
+def compact_batch(batch: ColumnarBatch, keep) -> ColumnarBatch:
+    """Moves kept rows to the front (stable), returns batch with new count.
+
+    One host sync for the scalar count; the data never leaves the device and
+    the bucket (and therefore the compiled program) is unchanged.
+    """
+    import jax
+    jnp = _jx()
+    key = ("compact", tuple(_col_sig(c) for c in batch.columns))
+    fn = _COMPACT_CACHE.get(key)
+    if fn is None:
+        def run(arrs, keep):
+            # stable argsort: kept rows (False<True on ~keep) keep order
+            order = jnp.argsort(~keep, stable=True)
+            outs = []
+            for d, v, ln in arrs:
+                nd = jnp.take(d, order, axis=0)
+                # rows that were filtered out become padding: invalid
+                nv = jnp.take(v & keep, order, axis=0)
+                nl = None if ln is None else jnp.take(ln, order, axis=0)
+                outs.append((nd, nv, nl))
+            return outs, jnp.sum(keep)
+
+        fn = jax.jit(run)
+        _COMPACT_CACHE[key] = fn
+    arrs = [(c.data, c.validity, c.lengths) for c in batch.columns]
+    outs, cnt = fn(arrs, keep)
+    row_count = int(cnt)
+    cols = [DeviceColumn(d, v, row_count, c.data_type, ln)
+            for (d, v, ln), c in zip(outs, batch.columns)]
+    return ColumnarBatch(cols, row_count, batch.names)
+
+
+def slice_batch(batch: ColumnarBatch, start: int, length: int) -> ColumnarBatch:
+    """Logical slice via gather (static shapes preserved)."""
+    jnp = _jx()
+    idx = jnp.arange(batch.bucket) + start
+    valid_rows = jnp.arange(batch.bucket) < length
+    return gather_batch(batch, idx, length, idx_valid=valid_rows)
+
+
+def take_front(batch: ColumnarBatch, n: int) -> ColumnarBatch:
+    """First n rows (limit); no data movement, just count + validity mask."""
+    jnp = _jx()
+    n = min(n, batch.row_count)
+    keep = jnp.arange(batch.bucket) < n
+    cols = [DeviceColumn(c.data, c.validity & keep, n, c.data_type, c.lengths)
+            for c in batch.columns]
+    return ColumnarBatch(cols, n, batch.names)
+
+
+def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
+    """Concatenates device batches into one padded batch (coalesce).
+
+    reference: GpuCoalesceBatches/ConcatAndConsumeAll use cudf concat; here
+    one jitted scatter per (input shapes) signature.
+    """
+    batches = [b for b in batches if b.row_count > 0] or list(batches[:1])
+    if len(batches) == 1:
+        return batches[0]
+    import jax
+    jnp = _jx()
+    total = sum(b.row_count for b in batches)
+    out_bucket = bucket_rows(total)
+    ncols = batches[0].num_columns
+    # per-column max string width across inputs
+    widths = []
+    for ci in range(ncols):
+        w = 0
+        for b in batches:
+            c = b.columns[ci]
+            if c.lengths is not None:
+                w = max(w, c.data.shape[1])
+        widths.append(w)
+    key = ("concat", out_bucket,
+           tuple(tuple(_col_sig(c) for c in b.columns) for b in batches))
+    fn = _CONCAT_CACHE.get(key)
+    counts = [b.row_count for b in batches]  # dynamic: passed as traced array
+    if fn is None:
+        def run(all_arrs, offsets, counts_arr):
+            outs = []
+            for ci in range(ncols):
+                tgt_rows = out_bucket
+                acc_d = None
+                for bi in range(len(all_arrs)):
+                    d, v, ln = all_arrs[bi][ci]
+                    w = widths[ci]
+                    if ln is not None and d.shape[1] < w:
+                        d = jnp.pad(d, ((0, 0), (0, w - d.shape[1])))
+                    rowpos = jnp.arange(d.shape[0])
+                    valid_rows = rowpos < counts_arr[bi]
+                    # padding rows scatter out of range -> dropped
+                    dest = jnp.where(valid_rows, rowpos + offsets[bi], tgt_rows)
+                    if acc_d is None:
+                        shape = (tgt_rows,) + d.shape[1:] if ln is None else \
+                            (tgt_rows, w)
+                        acc_d = jnp.zeros(shape, dtype=d.dtype)
+                        acc_v = jnp.zeros(tgt_rows, dtype=bool)
+                        acc_l = None if ln is None else \
+                            jnp.zeros(tgt_rows, dtype=np.int32)
+                    acc_d = acc_d.at[dest].set(d, mode="drop")
+                    acc_v = acc_v.at[dest].set(v & valid_rows, mode="drop")
+                    if acc_l is not None:
+                        acc_l = acc_l.at[dest].set(ln, mode="drop")
+                outs.append((acc_d, acc_v, acc_l))
+            return outs
+
+        fn = jax.jit(run)
+        _CONCAT_CACHE[key] = fn
+    offsets = np.zeros(len(batches), dtype=np.int64)
+    offsets[1:] = np.cumsum(counts)[:-1]
+    all_arrs = [[(c.data, c.validity, c.lengths) for c in b.columns]
+                for b in batches]
+    outs = fn(all_arrs, jnp.asarray(offsets), jnp.asarray(np.asarray(counts)))
+    cols = []
+    for (d, v, ln), proto in zip(outs, batches[0].columns):
+        cols.append(DeviceColumn(d, v, total, proto.data_type, ln))
+    return ColumnarBatch(cols, total, batches[0].names)

@@ -1,0 +1,20 @@
+"""Plan layer: physical plans, the TPU plan-rewrite framework, transitions.
+
+Reference counterparts (SURVEY.md §2.3):
+- ``GpuOverrides.scala`` rule registries + applyWithContext  -> ``overrides``
+- ``RapidsMeta.scala`` wrap/tag/convert                      -> ``meta``
+- ``TypeChecks.scala`` TypeSig                               -> ``typechecks``
+- ``GpuTransitionOverrides.scala`` transitions/coalesce      -> ``transitions``
+- ``ExplainPlan.scala`` + explainOnly mode                   -> ``overrides.explain``
+
+Architectural note: the reference plugs into Spark, whose CPU operators are
+row-based; its transitions are row<->columnar AND host<->device.  This
+framework ships its own columnar CPU engine (arrow-backed) as the fallback
+tier, so transitions collapse to host<->device copies (``HostToDeviceExec`` /
+``DeviceToHostExec`` mirroring GpuRowToColumnarExec/GpuColumnarToRowExec).
+"""
+
+from spark_rapids_tpu.plan.base import (  # noqa: F401
+    Exec, LeafExec, UnaryExec, BinaryExec, is_device_exec)
+from spark_rapids_tpu.plan.meta import PlanMeta, tag_and_convert  # noqa: F401
+from spark_rapids_tpu.plan.overrides import TpuOverrides  # noqa: F401

@@ -1,0 +1,140 @@
+"""Physical plan node base classes.
+
+Reference: ``GpuExec.scala`` (trait GpuExec :214 internalDoExecuteColumnar)
+and Spark's SparkPlan.  Every exec produces an iterator of columnar batches
+per partition:
+
+- device execs ("Tpu*Exec") yield ``ColumnarBatch`` (jax arrays, padded)
+- host execs (the CPU fallback engine) yield ``HostColumnarBatch`` (arrow)
+
+Partitioning model: a plan executes as ``num_partitions`` independent
+partitions (Spark task analog); sources define the count, narrow ops
+preserve it, exchanges change it (shuffle layer).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, HostColumnarBatch
+
+
+class Exec:
+    """Physical operator."""
+
+    #: True when this exec runs on the device and yields ColumnarBatch
+    is_device = False
+
+    def __init__(self, children: Sequence["Exec"] = ()):
+        self.children: List[Exec] = list(children)
+        self.metrics = {}
+
+    # -- static shape -------------------------------------------------------
+    @property
+    def schema(self) -> T.StructType:
+        raise NotImplementedError
+
+    @property
+    def num_partitions(self) -> int:
+        if self.children:
+            return self.children[0].num_partitions
+        return 1
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def node_desc(self) -> str:
+        return self.name
+
+    # -- execution ----------------------------------------------------------
+    def execute_partition(self, pidx: int):
+        """Yields batches for one partition (host or device per is_device)."""
+        raise NotImplementedError
+
+    def execute_all(self):
+        for p in range(self.num_partitions):
+            yield from self.execute_partition(p)
+
+    def collect_host(self) -> HostColumnarBatch:
+        """Gathers every partition to one host batch (driver collect)."""
+        from spark_rapids_tpu.columnar.batch import (batch_from_pydict,
+                                                     concat_host_batches)
+        out = []
+        for b in self.execute_all():
+            if isinstance(b, ColumnarBatch):
+                b = b.to_host()
+            out.append(b)
+        if not out:
+            import pyarrow as pa
+            empty = pa.table({f.name: pa.array([], type=T.to_arrow(f.data_type))
+                              for f in self.schema})
+            from spark_rapids_tpu.columnar.batch import batch_from_arrow
+            return batch_from_arrow(empty)
+        return concat_host_batches(out)
+
+    # -- tree utilities -----------------------------------------------------
+    def with_children(self, children: List["Exec"]) -> "Exec":
+        import copy
+        node = copy.copy(self)
+        node.children = list(children)
+        return node
+
+    def transform_up(self, fn) -> "Exec":
+        node = self.with_children([c.transform_up(fn) for c in self.children])
+        return fn(node)
+
+    def collect_nodes(self, pred=lambda n: True) -> List["Exec"]:
+        out = []
+        for c in self.children:
+            out.extend(c.collect_nodes(pred))
+        if pred(self):
+            out.append(self)
+        return out
+
+    def tree_string(self, indent: int = 0) -> str:
+        pad = "  " * indent
+        mark = "*" if self.is_device else " "
+        lines = [f"{pad}{mark}{self.node_desc()}"]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    def __repr__(self):
+        return self.node_desc()
+
+
+class LeafExec(Exec):
+    def __init__(self):
+        super().__init__([])
+
+
+class UnaryExec(Exec):
+    def __init__(self, child: Exec):
+        super().__init__([child])
+
+    @property
+    def child(self) -> Exec:
+        return self.children[0]
+
+    @property
+    def schema(self) -> T.StructType:
+        return self.child.schema
+
+
+class BinaryExec(Exec):
+    def __init__(self, left: Exec, right: Exec):
+        super().__init__([left, right])
+
+    @property
+    def left(self) -> Exec:
+        return self.children[0]
+
+    @property
+    def right(self) -> Exec:
+        return self.children[1]
+
+
+def is_device_exec(node: Exec) -> bool:
+    return node.is_device

@@ -1,0 +1,142 @@
+"""Meta wrappers: wrap -> tag -> convert (reference: RapidsMeta.scala:83
+RapidsMeta[INPUT,BASE,OUTPUT], SparkPlanMeta :598, BaseExprMeta :1058;
+tagging API willNotWorkOnGpu / tagForGpu / convertIfNeeded).
+
+Every CPU plan node is wrapped in a ``PlanMeta``; its expressions in
+``ExprMeta``s.  ``tag()`` records every reason the node cannot run on the
+device; ``convert_if_needed()`` emits the Tpu exec when clean, else keeps the
+CPU node (partial plans are the point — reference README "transparent CPU
+fallback").
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.expressions.base import Expression
+from spark_rapids_tpu.plan import typechecks as TS
+from spark_rapids_tpu.plan.base import Exec
+
+
+class BaseMeta:
+    def __init__(self):
+        self.reasons: List[str] = []
+
+    def will_not_work(self, reason: str) -> None:
+        """reference: RapidsMeta.willNotWorkOnGpu"""
+        if reason not in self.reasons:
+            self.reasons.append(reason)
+
+    @property
+    def can_run_on_device(self) -> bool:
+        return not self.reasons
+
+
+class ExprMeta(BaseMeta):
+    def __init__(self, expr: Expression, conf: TpuConf,
+                 sig: Optional[TS.TypeSig] = None):
+        super().__init__()
+        self.expr = expr
+        self.conf = conf
+        self.sig = sig
+        self.child_metas = [ExprMeta(c, conf, sig) for c in expr.children]
+
+    def tag(self) -> None:
+        from spark_rapids_tpu.plan.overrides import expr_rule_for
+        for cm in self.child_metas:
+            cm.tag()
+            for r in cm.reasons:
+                self.will_not_work(r)
+        rule = expr_rule_for(type(self.expr))
+        if rule is None:
+            self.will_not_work(
+                f"expression {self.expr.name} has no TPU implementation")
+            return
+        sig = rule.sig or self.sig or TS.ALL_BASIC
+        try:
+            dt = self.expr.data_type
+        except Exception as e:  # unresolved attribute etc.
+            self.will_not_work(f"{self.expr.name}: {e}")
+            return
+        r = sig.check(dt)
+        if r is not None:
+            self.will_not_work(f"expression {self.expr.name}: {r}")
+        reason = self.expr.tpu_supported(self.conf)
+        if reason is not None:
+            self.will_not_work(f"expression {self.expr.name}: {reason}")
+        if rule.extra_tag is not None:
+            rule.extra_tag(self)
+
+
+class PlanMeta(BaseMeta):
+    def __init__(self, plan: Exec, conf: TpuConf):
+        super().__init__()
+        self.plan = plan
+        self.conf = conf
+        self.child_metas = [PlanMeta(c, conf) for c in plan.children]
+        self.rule = None
+        self.expr_metas: List[ExprMeta] = []
+        self.converted: Optional[Exec] = None
+
+    def tag(self) -> None:
+        from spark_rapids_tpu.plan.overrides import exec_rule_for
+        for cm in self.child_metas:
+            cm.tag()
+        if not self.conf.is_sql_enabled:
+            self.will_not_work("spark.rapids.sql.enabled is false")
+            return
+        self.rule = exec_rule_for(type(self.plan))
+        if self.rule is None:
+            self.will_not_work(
+                f"exec {self.plan.name} has no TPU implementation")
+            return
+        sig = self.rule.sig or TS.ALL_BASIC
+        r = TS.check_output_types(self.plan.schema, sig)
+        if r is not None:
+            self.will_not_work(f"{self.plan.name}: {r}")
+        for expr in self.rule.exprs_of(self.plan):
+            em = ExprMeta(expr, self.conf, self.rule.expr_sig)
+            em.tag()
+            self.expr_metas.append(em)
+            for reason in em.reasons:
+                self.will_not_work(reason)
+        if self.rule.extra_tag is not None:
+            self.rule.extra_tag(self)
+
+    def convert_if_needed(self) -> Exec:
+        """reference: RapidsMeta.convertIfNeeded — device exec when tagging
+        passed, original CPU exec otherwise; children converted first."""
+        new_children = [cm.convert_if_needed() for cm in self.child_metas]
+        base = self.plan.with_children(new_children)
+        if self.can_run_on_device and self.rule is not None:
+            out = self.rule.convert(base, self)
+            self.converted = out
+            return out
+        self.converted = base
+        return base
+
+    # -- explain ------------------------------------------------------------
+    def explain(self, all_nodes: bool = False, indent: int = 0) -> str:
+        """reference: GpuOverrides explain output / ExplainPlan API."""
+        pad = "  " * indent
+        lines = []
+        if self.can_run_on_device:
+            if all_nodes:
+                lines.append(f"{pad}*{self.plan.name} will run on TPU")
+        else:
+            why = "; ".join(self.reasons)
+            lines.append(f"{pad}!{self.plan.name} cannot run on TPU: {why}")
+        for cm in self.child_metas:
+            sub = cm.explain(all_nodes, indent + 1)
+            if sub:
+                lines.append(sub)
+        return "\n".join(l for l in lines if l)
+
+
+def tag_and_convert(plan: Exec, conf: TpuConf):
+    meta = PlanMeta(plan, conf)
+    meta.tag()
+    converted = meta.convert_if_needed()
+    return meta, converted

@@ -1,0 +1,265 @@
+"""Rule registries + the main plan-rewrite entry point.
+
+Reference: ``GpuOverrides.scala`` — ExprRule :222 / ExecRule :278 registries,
+``applyWithContext`` :4562 (wrap -> tag -> convert), explain-only mode
+:4578, and ``GpuTransitionOverrides.scala`` for transition insertion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Callable, Dict, List, Optional, Type
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.expressions import (arithmetic as A, bitwise as B,
+                                          cast as CA, conditional as K,
+                                          datetime_exprs as D, hashing as H,
+                                          mathexprs as M, predicates as P,
+                                          strings as S)
+from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
+                                               Expression, Literal)
+from spark_rapids_tpu.plan import typechecks as TS
+from spark_rapids_tpu.plan.base import Exec
+from spark_rapids_tpu.plan.meta import PlanMeta, tag_and_convert
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class ExprRule:
+    """reference: GpuOverrides.ExprRule — here expressions are dual-backend,
+    so the rule carries support metadata rather than a conversion."""
+    cls: Type[Expression]
+    sig: Optional[TS.TypeSig] = None
+    desc: str = ""
+    extra_tag: Optional[Callable] = None
+
+
+@dataclasses.dataclass
+class ExecRule:
+    cls: Type[Exec]
+    convert: Callable[[Exec, PlanMeta], Exec]
+    sig: Optional[TS.TypeSig] = None
+    expr_sig: Optional[TS.TypeSig] = None
+    desc: str = ""
+    exprs_of: Callable[[Exec], List[Expression]] = lambda p: []
+    extra_tag: Optional[Callable] = None
+
+
+_EXPR_RULES: Dict[type, ExprRule] = {}
+_EXEC_RULES: Dict[type, ExecRule] = {}
+
+
+def register_expr(cls, sig=None, desc="", extra_tag=None):
+    _EXPR_RULES[cls] = ExprRule(cls, sig, desc, extra_tag)
+
+
+def register_exec(cls, convert, sig=None, expr_sig=None, desc="",
+                  exprs_of=lambda p: [], extra_tag=None):
+    _EXEC_RULES[cls] = ExecRule(cls, convert, sig, expr_sig, desc, exprs_of,
+                                extra_tag)
+
+
+def expr_rule_for(cls) -> Optional[ExprRule]:
+    for k in cls.__mro__:
+        if k in _EXPR_RULES:
+            return _EXPR_RULES[k]
+    return None
+
+
+def exec_rule_for(cls) -> Optional[ExecRule]:
+    return _EXEC_RULES.get(cls)
+
+
+def expr_registry() -> Dict[type, ExprRule]:
+    return dict(_EXPR_RULES)
+
+
+def exec_registry() -> Dict[type, ExecRule]:
+    return dict(_EXEC_RULES)
+
+
+# ---------------------------------------------------------------------------
+# Expression registrations (reference: commonExpressions, GpuOverrides.scala:904
+# — 219 registrations; ours grows with each expression milestone)
+# ---------------------------------------------------------------------------
+
+for _cls in (Literal, BoundReference, Alias):
+    register_expr(_cls, TS.ALL_BASIC)
+
+for _cls in (A.Add, A.Subtract, A.Multiply, A.Divide, A.IntegralDivide,
+             A.Remainder, A.Pmod, A.UnaryMinus, A.Abs):
+    register_expr(_cls, TS.NUMERIC_128)
+
+for _cls in (P.EqualTo, P.NotEqual, P.LessThan, P.LessThanOrEqual,
+             P.GreaterThan, P.GreaterThanOrEqual, P.EqualNullSafe):
+    register_expr(_cls, TS.COMPARABLE)
+
+for _cls in (P.And, P.Or, P.Not):
+    register_expr(_cls, TS.BOOLEAN)
+
+for _cls in (P.IsNull, P.IsNotNull, P.IsNan, P.In):
+    register_expr(_cls, TS.ALL_BASIC)
+
+for _cls in (K.If, K.CaseWhen, K.Coalesce, K.NaNvl, K.Greatest, K.Least):
+    register_expr(_cls, TS.ALL_BASIC)
+
+for _cls in (M.UnaryMath, M.Floor, M.Ceil, M.Round, M.BRound, M.Pow,
+             M.Atan2, M.Hypot, M.Signum):
+    register_expr(_cls, TS.NUMERIC)
+
+for _cls in (B.BitwiseAnd, B.BitwiseOr, B.BitwiseXor, B.BitwiseNot,
+             B.ShiftLeft, B.ShiftRight, B.ShiftRightUnsigned):
+    register_expr(_cls, TS.INTEGRAL)
+
+register_expr(CA.Cast, TS.ALL_BASIC)
+
+for _cls in (S.Length, S.Upper, S.Lower, S.Concat, S.Substring, S.StartsWith,
+             S.EndsWith, S.Contains, S.Trim, S.LTrim, S.RTrim, S.Like):
+    register_expr(_cls, TS.ALL_BASIC)
+
+for _cls in (D._DateField, D._TimeField, D.DateAdd, D.DateSub, D.DateDiff,
+             D.LastDay, D.UnixTimestampFromTs):
+    register_expr(_cls, TS.ALL_BASIC)
+
+register_expr(H.Murmur3Hash, TS.ALL_BASIC)
+register_expr(H.XxHash64, TS.ALL_BASIC,
+              extra_tag=lambda m: None)
+
+
+# ---------------------------------------------------------------------------
+# Exec registrations (reference: commonExecs GpuOverrides.scala:3999-4311)
+# ---------------------------------------------------------------------------
+
+def _register_basic_execs():
+    from spark_rapids_tpu.exec import basic as X
+
+    register_exec(X.CpuProjectExec,
+                  convert=lambda p, m: X.TpuProjectExec(p.exprs, p.children[0]),
+                  exprs_of=lambda p: p.exprs,
+                  desc="columnar projection")
+    register_exec(X.CpuFilterExec,
+                  convert=lambda p, m: X.TpuFilterExec(p.condition,
+                                                       p.children[0]),
+                  exprs_of=lambda p: [p.condition],
+                  desc="columnar filter")
+    register_exec(X.CpuRangeExec,
+                  convert=lambda p, m: X.TpuRangeExec(p),
+                  desc="range source")
+    register_exec(X.CpuInMemoryScanExec,
+                  convert=lambda p, m: X.TpuInMemoryScanExec(p),
+                  desc="in-memory scan")
+    register_exec(X.CpuLimitExec,
+                  convert=lambda p, m: X.TpuLimitExec(p.n, p.children[0]),
+                  desc="limit")
+    register_exec(X.CpuUnionExec,
+                  convert=lambda p, m: X.TpuUnionExec(p.children),
+                  desc="union")
+    register_exec(X.CpuSampleExec,
+                  convert=lambda p, m: X.TpuSampleExec(p.fraction, p.seed,
+                                                       p.children[0]),
+                  desc="bernoulli sample",
+                  extra_tag=lambda m: m.will_not_work(
+                      "TPU sample uses a different RNG than CPU")
+                  if m.conf.get(C.TEST_ENABLED.key) else None)
+
+
+_register_basic_execs()
+
+
+# ---------------------------------------------------------------------------
+# Transition insertion (reference: GpuTransitionOverrides.scala:46)
+# ---------------------------------------------------------------------------
+
+def insert_transitions(plan: Exec, conf: TpuConf) -> Exec:
+    from spark_rapids_tpu.exec.basic import (DeviceToHostExec,
+                                             HostToDeviceExec,
+                                             TpuCoalesceBatchesExec)
+
+    def fix(node: Exec) -> Exec:
+        new_children = []
+        for c in node.children:
+            if node.is_device and not c.is_device:
+                c = HostToDeviceExec(c)
+            elif not node.is_device and c.is_device:
+                c = DeviceToHostExec(c)
+            new_children.append(c)
+        return node.with_children(new_children)
+
+    out = plan.transform_up(fix)
+    return out
+
+
+def validate_all_on_device(plan: Exec, conf: TpuConf) -> None:
+    """Test-mode assertion (reference: GpuTransitionOverrides
+    assertIsOnTheGpu :616 + spark.rapids.sql.test.enabled)."""
+    from spark_rapids_tpu.exec.basic import DeviceToHostExec, HostToDeviceExec
+    allowed = {s.strip() for s in
+               conf.get(C.TEST_ALLOWED_NONGPU.key).split(",") if s.strip()}
+    bad = [n for n in plan.collect_nodes()
+           if not n.is_device
+           and not isinstance(n, DeviceToHostExec)
+           and n.name not in allowed]
+    # the root DeviceToHost is always fine; host leaves feeding H2D are not
+    if bad:
+        names = ", ".join(sorted({n.name for n in bad}))
+        raise AssertionError(
+            f"Part of the plan is not columnar/TPU: {names}\n{plan.tree_string()}")
+
+
+class TpuOverrides:
+    """The ColumnarRule analog: applies wrap->tag->convert + transitions.
+
+    reference: GpuOverrides.applyWithContext (GpuOverrides.scala:4562) wired
+    through ColumnarOverrideRules (Plugin.scala:52).
+    """
+
+    def __init__(self, conf: TpuConf):
+        self.conf = conf
+        self.last_meta: Optional[PlanMeta] = None
+
+    def apply(self, plan: Exec) -> Exec:
+        conf = self.conf
+        if not conf.is_sql_enabled:
+            return plan
+        meta, converted = tag_and_convert(plan, conf)
+        self.last_meta = meta
+        explain_mode = conf.get(C.EXPLAIN.key, "NOT_ON_GPU").upper()
+        if explain_mode != "NONE":
+            text = meta.explain(all_nodes=(explain_mode == "ALL"))
+            if text:
+                log.info("TPU plan overview:\n%s", text)
+        if conf.is_explain_only:
+            # plan and log only; execute entirely on CPU
+            return plan
+        out = insert_transitions(converted, conf)
+        out = self._coalesce_after_device_sources(out)
+        if conf.is_test_enabled:
+            validate_all_on_device(out, conf)
+        return out
+
+    def _coalesce_after_device_sources(self, plan: Exec) -> Exec:
+        """Insert batch coalescing where ops want bigger batches
+        (reference: GpuTransitionOverrides insertCoalesce per CoalesceGoal)."""
+        from spark_rapids_tpu.exec.basic import (HostToDeviceExec,
+                                                 TpuCoalesceBatchesExec)
+        target = self.conf.batch_size_bytes
+
+        def fix(node: Exec) -> Exec:
+            # put a coalesce above any host->device boundary feeding compute
+            new_children = []
+            for c in node.children:
+                if isinstance(c, HostToDeviceExec) and node.is_device and \
+                        not isinstance(node, TpuCoalesceBatchesExec):
+                    c = TpuCoalesceBatchesExec(c, target)
+                new_children.append(c)
+            return node.with_children(new_children)
+
+        return plan.transform_up(fix)
+
+    def explain(self) -> str:
+        if self.last_meta is None:
+            return ""
+        return self.last_meta.explain(all_nodes=True)

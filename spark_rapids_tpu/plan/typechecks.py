@@ -1,0 +1,76 @@
+"""Type signature checks (reference: TypeChecks.scala — TypeSig bitmask
+:138, ExecChecks/ExprChecks :932/:1057, and the generated supported_ops.md).
+
+A ``TypeSig`` names which DataTypes an operator/expression supports on the
+device; tagging produces human-readable reasons for fallback, and the same
+tables generate ``docs/supported_ops.md`` (see docsgen)."""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Set, Type
+
+from spark_rapids_tpu import types as T
+
+
+class TypeSig:
+    def __init__(self, classes: Iterable[type], allow_decimal128: bool = False,
+                 note: str = ""):
+        self.classes = tuple(classes)
+        self.allow_decimal128 = allow_decimal128
+        self.note = note
+
+    def check(self, dt: T.DataType) -> Optional[str]:
+        """None when supported, reason string otherwise."""
+        if isinstance(dt, T.DecimalType):
+            if T.DecimalType not in self.classes:
+                return f"{dt.simple_name} is not supported"
+            if dt.is_decimal128 and not self.allow_decimal128:
+                return f"{dt.simple_name}: precision > 18 not supported here"
+            return None
+        if isinstance(dt, tuple(c for c in self.classes
+                                if c is not T.DecimalType)):
+            return None
+        return f"{dt.simple_name} is not supported" + \
+            (f" ({self.note})" if self.note else "")
+
+    def __add__(self, other: "TypeSig") -> "TypeSig":
+        return TypeSig(set(self.classes) | set(other.classes),
+                       self.allow_decimal128 or other.allow_decimal128)
+
+    def names(self) -> str:
+        return ", ".join(sorted(c.__name__.replace("Type", "")
+                                for c in self.classes))
+
+
+_INTEGRAL = [T.ByteType, T.ShortType, T.IntegerType, T.LongType]
+_FRACTIONAL = [T.FloatType, T.DoubleType]
+
+INTEGRAL = TypeSig(_INTEGRAL)
+NUMERIC = TypeSig(_INTEGRAL + _FRACTIONAL + [T.DecimalType])
+NUMERIC_128 = TypeSig(_INTEGRAL + _FRACTIONAL + [T.DecimalType], True)
+BOOLEAN = TypeSig([T.BooleanType])
+STRING = TypeSig([T.StringType])
+BINARY = TypeSig([T.BinaryType])
+DATETIME = TypeSig([T.DateType, T.TimestampType])
+NULL = TypeSig([T.NullType])
+
+#: everything the device data plane can represent today (nested types are
+#: host-only until the nested milestone — reference grew these over years)
+ALL_BASIC = TypeSig(_INTEGRAL + _FRACTIONAL +
+                    [T.BooleanType, T.StringType, T.BinaryType, T.DateType,
+                     T.TimestampType, T.NullType, T.DecimalType], True)
+
+COMPARABLE = TypeSig(_INTEGRAL + _FRACTIONAL +
+                     [T.BooleanType, T.StringType, T.DateType,
+                      T.TimestampType, T.DecimalType], True)
+
+ORDERABLE = COMPARABLE
+NESTED = TypeSig([T.ArrayType, T.MapType, T.StructType])
+
+
+def check_output_types(schema: T.StructType, sig: TypeSig) -> Optional[str]:
+    for f in schema.fields:
+        r = sig.check(f.data_type)
+        if r is not None:
+            return f"column {f.name!r}: {r}"
+    return None

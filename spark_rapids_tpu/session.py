@@ -1,0 +1,214 @@
+"""User-facing session + DataFrame API.
+
+Plays the combined role of SparkSession and the plugin lifecycle (reference:
+SQLPlugin -> RapidsDriverPlugin/RapidsExecutorPlugin, Plugin.scala:426/496):
+constructing a session initializes the device runtime (device manager, buffer
+catalog, semaphore) and installs the plan-rewrite rule; every action re-reads
+the conf and applies TpuOverrides to the CPU plan (reference re-reads SQLConf
+per query, GpuOverrides.scala:4564).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence, Union
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.columnar.batch import (HostColumnarBatch,
+                                             batch_from_arrow,
+                                             batch_from_pydict)
+from spark_rapids_tpu.expressions.base import (Alias, AttributeReference,
+                                               Expression, bind_references,
+                                               col, lit)
+from spark_rapids_tpu.plan.base import Exec
+from spark_rapids_tpu.plan.overrides import TpuOverrides
+
+
+class TpuSession:
+    _active: Optional["TpuSession"] = None
+
+    def __init__(self, conf: Optional[Union[TpuConf, Dict]] = None,
+                 init_device: bool = True):
+        if isinstance(conf, dict):
+            conf = TpuConf(conf)
+        self.conf = conf or C.default_conf()
+        if init_device and self.conf.is_sql_enabled:
+            from spark_rapids_tpu.memory.device_manager import initialize
+            self.runtime = initialize(self.conf)
+        else:
+            self.runtime = None
+        TpuSession._active = self
+
+    # -- conf ---------------------------------------------------------------
+    def set_conf(self, key: str, value) -> "TpuSession":
+        self.conf = self.conf.set(key, value)
+        return self
+
+    # -- dataframe constructors --------------------------------------------
+    def create_dataframe(self, data, schema: Optional[T.StructType] = None,
+                         num_partitions: int = 1) -> "DataFrame":
+        import pyarrow as pa
+        from spark_rapids_tpu.exec.basic import CpuInMemoryScanExec
+        if isinstance(data, dict):
+            hb = batch_from_pydict(data, schema)
+        elif isinstance(data, (pa.Table, pa.RecordBatch)):
+            hb = batch_from_arrow(data)
+        elif isinstance(data, HostColumnarBatch):
+            hb = data
+        else:
+            try:
+                import pandas as pd
+                if isinstance(data, pd.DataFrame):
+                    hb = batch_from_arrow(pa.Table.from_pandas(data))
+                else:
+                    raise TypeError
+            except TypeError:
+                raise TypeError(f"cannot create DataFrame from {type(data)}")
+        n = hb.row_count
+        per = -(-n // num_partitions) if n else 1
+        parts = [[hb.slice(i * per, min(per, n - i * per))]
+                 for i in range(num_partitions) if i * per < n] or [[hb]]
+        return DataFrame(CpuInMemoryScanExec(parts, hb.schema), self)
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1,
+              num_partitions: int = 1) -> "DataFrame":
+        from spark_rapids_tpu.exec.basic import CpuRangeExec
+        if end is None:
+            start, end = 0, start
+        return DataFrame(CpuRangeExec(start, end, step, num_partitions), self)
+
+    class _Reader:
+        def __init__(self, session):
+            self._s = session
+
+        def parquet(self, *paths, columns=None) -> "DataFrame":
+            from spark_rapids_tpu.io.parquet import CpuParquetScanExec
+            return DataFrame(CpuParquetScanExec(list(paths), columns), self._s)
+
+    @property
+    def read(self) -> "_Reader":
+        return TpuSession._Reader(self)
+
+    def stop(self):
+        from spark_rapids_tpu.memory.device_manager import shutdown
+        shutdown()
+        if TpuSession._active is self:
+            TpuSession._active = None
+
+
+def _to_expr(e) -> Expression:
+    if isinstance(e, Expression):
+        return e
+    if isinstance(e, str):
+        return col(e)
+    return lit(e)
+
+
+class DataFrame:
+    """Lazy plan builder over CPU physical execs; actions run the rewrite."""
+
+    def __init__(self, plan: Exec, session: TpuSession):
+        self._plan = plan
+        self._session = session
+
+    @property
+    def schema(self) -> T.StructType:
+        return self._plan.schema
+
+    @property
+    def columns(self) -> List[str]:
+        return self._plan.schema.names
+
+    # -- transformations ----------------------------------------------------
+    def select(self, *exprs) -> "DataFrame":
+        from spark_rapids_tpu.exec.basic import CpuProjectExec
+        bound = [bind_references(_to_expr(e), self.schema) for e in exprs]
+        return DataFrame(CpuProjectExec(bound, self._plan), self._session)
+
+    def filter(self, condition) -> "DataFrame":
+        from spark_rapids_tpu.exec.basic import CpuFilterExec
+        cond = bind_references(_to_expr(condition), self.schema)
+        return DataFrame(CpuFilterExec(cond, self._plan), self._session)
+
+    where = filter
+
+    def with_column(self, name: str, expr) -> "DataFrame":
+        from spark_rapids_tpu.exec.basic import CpuProjectExec
+        exprs = []
+        replaced = False
+        for f in self.schema.fields:
+            if f.name == name:
+                exprs.append(Alias(_to_expr(expr), name))
+                replaced = True
+            else:
+                exprs.append(col(f.name))
+        if not replaced:
+            exprs.append(Alias(_to_expr(expr), name))
+        bound = [bind_references(e, self.schema) for e in exprs]
+        return DataFrame(CpuProjectExec(bound, self._plan), self._session)
+
+    def limit(self, n: int) -> "DataFrame":
+        from spark_rapids_tpu.exec.basic import CpuLimitExec
+        return DataFrame(CpuLimitExec(n, self._plan), self._session)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        from spark_rapids_tpu.exec.basic import CpuUnionExec
+        return DataFrame(CpuUnionExec([self._plan, other._plan]),
+                         self._session)
+
+    def sample(self, fraction: float, seed: int = 0) -> "DataFrame":
+        from spark_rapids_tpu.exec.basic import CpuSampleExec
+        return DataFrame(CpuSampleExec(fraction, seed, self._plan),
+                         self._session)
+
+    # -- actions ------------------------------------------------------------
+    def _executed_plan(self) -> Exec:
+        overrides = TpuOverrides(self._session.conf)
+        return overrides.apply(self._plan)
+
+    def collect_batch(self) -> HostColumnarBatch:
+        return self._executed_plan().collect_host()
+
+    def to_pydict(self) -> Dict[str, list]:
+        return self.collect_batch().to_pydict()
+
+    def to_arrow(self):
+        import pyarrow as pa
+        return pa.Table.from_batches([self.collect_batch().to_arrow()])
+
+    def to_pandas(self):
+        return self.to_arrow().to_pandas()
+
+    def collect(self) -> List[dict]:
+        d = self.to_pydict()
+        names = list(d.keys())
+        return [dict(zip(names, row)) for row in zip(*d.values())] \
+            if names else []
+
+    def count(self) -> int:
+        total = 0
+        for b in self._executed_plan().execute_all():
+            total += b.row_count
+        return total
+
+    def write_parquet(self, path: str) -> None:
+        from spark_rapids_tpu.io.parquet import write_parquet
+        write_parquet(self._executed_plan().execute_all(), path, self.schema)
+
+    # -- introspection ------------------------------------------------------
+    def explain(self, mode: str = "formatted") -> str:
+        """Shows CPU plan, TPU-rewritten plan, and fallback reasons
+        (reference: ExplainPlan.explainPotentialGpuPlan)."""
+        overrides = TpuOverrides(self._session.conf)
+        final = overrides.apply(self._plan)
+        reasons = overrides.last_meta.explain(all_nodes=True) \
+            if overrides.last_meta else ""
+        out = (f"== Physical Plan (input) ==\n{self._plan.tree_string()}\n"
+               f"== TPU Plan ==\n{final.tree_string()}\n"
+               f"== Placement ==\n{reasons}")
+        return out
+
+    def __repr__(self):
+        return f"DataFrame[{self.schema.simple_name}]"

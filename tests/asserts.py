@@ -1,0 +1,73 @@
+"""Differential assertion helpers.
+
+The direct analog of the reference's integration test core
+(integration_tests/src/main/python/asserts.py:579
+assert_gpu_and_cpu_are_equal_collect): run the same DataFrame recipe under a
+CPU-only session and a TPU session and deep-compare collected rows; plus
+fallback assertions (asserts.py:439 assert_gpu_fallback_collect).
+"""
+
+import math
+
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.session import TpuSession
+
+
+def cpu_session() -> TpuSession:
+    return TpuSession(TpuConf({"spark.rapids.sql.enabled": "false"}),
+                      init_device=False)
+
+
+def tpu_session(extra=None) -> TpuSession:
+    conf = {"spark.rapids.sql.enabled": "true",
+            "spark.rapids.sql.test.enabled": "true"}
+    conf.update(extra or {})
+    return TpuSession(TpuConf(conf))
+
+
+def _val_eq(a, b, approx):
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) and isinstance(b, float):
+        if math.isnan(a) or math.isnan(b):
+            return math.isnan(a) and math.isnan(b)
+        if approx:
+            return a == b or abs(a - b) <= max(1e-9, 1e-6 * max(abs(a), abs(b)))
+        return a == b
+    return a == b
+
+
+def assert_tpu_and_cpu_are_equal_collect(df_fn, ignore_order=False,
+                                         approx_float=True, conf=None):
+    """df_fn(session) -> DataFrame; runs under both engines and compares."""
+    cpu_df = df_fn(cpu_session())
+    cpu_rows = cpu_df.collect()
+    tpu_s = tpu_session(conf)
+    tpu_df = df_fn(tpu_s)
+    tpu_rows = tpu_df.collect()
+    assert len(cpu_rows) == len(tpu_rows), \
+        f"row count differs: cpu={len(cpu_rows)} tpu={len(tpu_rows)}"
+    if ignore_order:
+        keyfn = lambda r: tuple(str(v) for v in r.values())
+        cpu_rows = sorted(cpu_rows, key=keyfn)
+        tpu_rows = sorted(tpu_rows, key=keyfn)
+    for i, (cr, tr) in enumerate(zip(cpu_rows, tpu_rows)):
+        assert cr.keys() == tr.keys(), f"row {i}: columns differ"
+        for k in cr:
+            assert _val_eq(cr[k], tr[k], approx_float), \
+                f"row {i} col {k!r}: cpu={cr[k]!r} tpu={tr[k]!r}"
+
+
+def assert_tpu_fallback_collect(df_fn, fallback_exec_name: str):
+    """Asserts the plan kept `fallback_exec_name` on CPU yet results match
+    (reference: assert_gpu_fallback_collect)."""
+    from spark_rapids_tpu.plan.overrides import TpuOverrides
+    s = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+    df = df_fn(s)
+    overrides = TpuOverrides(s.conf)
+    final = overrides.apply(df._plan)
+    names = {n.name for n in final.collect_nodes()}
+    assert fallback_exec_name in names, \
+        f"expected {fallback_exec_name} on CPU; plan:\n{final.tree_string()}"
+    assert_tpu_and_cpu_are_equal_collect(
+        df_fn, conf={"spark.rapids.sql.test.enabled": "false"})

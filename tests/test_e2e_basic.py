@@ -1,0 +1,145 @@
+"""End-to-end plan tests: the minimum slice (SURVEY.md §7 phase 3 gate —
+filter+project over parquet, CPU vs TPU differential)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions import arithmetic as A
+from spark_rapids_tpu.expressions import predicates as P
+from spark_rapids_tpu.expressions import strings as S
+from spark_rapids_tpu.expressions.base import Alias, col, lit
+
+from tests.asserts import (assert_tpu_and_cpu_are_equal_collect,
+                           assert_tpu_fallback_collect, tpu_session)
+
+RNG = np.random.default_rng(99)
+N = 5000
+
+
+def _data():
+    return {
+        "a": RNG.integers(-100, 100, N).astype(np.int64),
+        "b": RNG.standard_normal(N),
+        "s": [None if i % 17 == 0 else f"val-{i % 23}" for i in range(N)],
+    }
+
+
+_DATA = _data()
+
+
+def test_project_filter_differential():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_DATA, num_partitions=3)
+        .filter(P.GreaterThan(col("a"), lit(0)))
+        .select(col("a"), Alias(A.Multiply(col("a"), lit(2)), "a2"),
+                col("s")))
+
+
+def test_string_ops_differential():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_DATA)
+        .filter(P.IsNotNull(col("s")))
+        .select(Alias(S.Upper(col("s")), "u"),
+                Alias(S.Length(col("s")), "n")))
+
+
+def test_range_limit_union():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.range(0, 1000, 3, num_partitions=2).limit(100))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.range(0, 50).union(s.range(100, 150)),
+        ignore_order=True)
+
+
+def test_with_column_chain():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_DATA)
+        .with_column("c", A.Add(col("a"), lit(10)))
+        .with_column("d", A.Divide(col("c"), col("a")))
+        .filter(P.IsNotNull(col("d"))))
+
+
+def test_parquet_roundtrip_differential(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table(_DATA), path)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(path)
+        .filter(P.LessThan(col("a"), lit(50)))
+        .select(col("a"), col("s")))
+
+
+def test_parquet_predicate_pushdown(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    path = str(tmp_path / "t2.parquet")
+    pq.write_table(pa.table(_DATA), path)
+    s = tpu_session()
+    df = s.read.parquet(path).filter(
+        P.And(P.GreaterThan(col("a"), lit(0)), P.IsNotNull(col("s"))))
+    rows = df.collect()
+    assert all(r["a"] > 0 and r["s"] is not None for r in rows)
+
+
+def test_explain_shows_tpu_plan():
+    s = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+    df = s.create_dataframe(_DATA).filter(P.GreaterThan(col("a"), lit(0)))
+    text = df.explain()
+    assert "TpuFilter" in text
+    assert "HostToDevice" in text or "TpuInMemoryScan" in text
+
+
+def test_explain_only_mode_stays_on_cpu():
+    s = tpu_session({"spark.rapids.sql.mode": "explainOnly",
+                     "spark.rapids.sql.test.enabled": "false"})
+    df = s.create_dataframe(_DATA).filter(P.GreaterThan(col("a"), lit(0)))
+    plan = df._executed_plan()
+    assert not any(n.is_device for n in plan.collect_nodes()), \
+        plan.tree_string()
+    assert df.count() == sum(1 for v in _DATA["a"] if v > 0)
+
+
+def test_fallback_on_unsupported_expression():
+    # LIKE is registered but tagged host-only -> filter falls back, rest runs
+    assert_tpu_fallback_collect(
+        lambda s: s.create_dataframe(_DATA)
+        .filter(S.Like(col("s"), lit("val-1%")))
+        .select(col("a"), col("s")),
+        "CpuFilterExec")
+
+
+def test_disable_sql_runs_pure_cpu():
+    s = tpu_session({"spark.rapids.sql.enabled": "false",
+                     "spark.rapids.sql.test.enabled": "false"})
+    df = s.create_dataframe(_DATA).filter(P.GreaterThan(col("a"), lit(0)))
+    plan = df._executed_plan()
+    assert not any(n.is_device for n in plan.collect_nodes())
+
+
+def test_test_mode_asserts_on_fallback():
+    s = tpu_session()  # test.enabled = true
+    df = s.create_dataframe(_DATA).filter(S.Like(col("s"), lit("x%")))
+    with pytest.raises(AssertionError, match="not columnar"):
+        df.collect()
+
+
+def test_sample_counts_roughly():
+    s = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+    n = s.range(0, 10000).sample(0.1, seed=42).count()
+    assert 700 < n < 1300
+
+
+def test_empty_result():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_DATA).filter(lit(False)))
+
+
+def test_write_parquet_roundtrip(tmp_path):
+    s = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+    out = str(tmp_path / "out.parquet")
+    s.create_dataframe(_DATA).filter(
+        P.GreaterThan(col("a"), lit(0))).write_parquet(out)
+    back = s.read.parquet(out).count()
+    assert back == sum(1 for v in _DATA["a"] if v > 0)
