@@ -57,6 +57,17 @@ class CpuInMemoryScanExec(LeafExec):
         return f"InMemoryScan[{self.num_partitions}p]"
 
 
+def upload_batches(batches):
+    """Host->device upload with device admission (the semaphore is acquired
+    before the first device use; released by run_task at task completion)."""
+    from spark_rapids_tpu.memory.device_manager import get_runtime
+    rt = get_runtime()
+    for hb in batches:
+        if rt is not None:
+            rt.semaphore.acquire_if_necessary()
+        yield hb.to_device()
+
+
 class TpuInMemoryScanExec(CpuInMemoryScanExec):
     is_device = True
 
@@ -64,12 +75,8 @@ class TpuInMemoryScanExec(CpuInMemoryScanExec):
         super().__init__(cpu.partitions, cpu.schema)
 
     def execute_partition(self, pidx):
-        from spark_rapids_tpu.memory.device_manager import get_runtime
-        rt = get_runtime()
-        for hb in self.partitions[pidx] if pidx < len(self.partitions) else ():
-            if rt is not None:
-                rt.semaphore.acquire_if_necessary()
-            yield hb.to_device()
+        if pidx < len(self.partitions):
+            yield from upload_batches(self.partitions[pidx])
 
     def node_desc(self):
         return f"TpuInMemoryScan[{self.num_partitions}p]"
@@ -298,6 +305,53 @@ class TpuLimitExec(UnaryExec):
         return f"TpuLimit[{self.n}]"
 
 
+class CpuGlobalLimitExec(UnaryExec):
+    """Single-output-partition global limit: streams child partitions in
+    order until n rows are emitted (reference: CollectLimit/GlobalLimit
+    trio, limit.scala; in-process, the 'shuffle to one partition' collapses
+    to sequentially draining child partitions)."""
+
+    def __init__(self, n: int, child: Exec):
+        super().__init__(child)
+        self.n = n
+
+    @property
+    def num_partitions(self):
+        return 1
+
+    def _limited(self, slicer):
+        left = self.n
+        for cp in range(self.child.num_partitions):
+            if left <= 0:
+                return
+            for b in self.child.execute_partition(cp):
+                if left <= 0:
+                    return
+                if b.row_count <= left:
+                    left -= b.row_count
+                    yield b
+                else:
+                    yield slicer(b, left)
+                    left = 0
+
+    def execute_partition(self, pidx):
+        yield from self._limited(lambda b, k: b.slice(0, k))
+
+    def node_desc(self):
+        return f"GlobalLimit[{self.n}]"
+
+
+class TpuGlobalLimitExec(CpuGlobalLimitExec):
+    is_device = True
+
+    def execute_partition(self, pidx):
+        from spark_rapids_tpu.ops import take_front
+        yield from self._limited(take_front)
+
+    def node_desc(self):
+        return f"TpuGlobalLimit[{self.n}]"
+
+
 class CpuUnionExec(Exec):
     def __init__(self, children: Sequence[Exec]):
         super().__init__(children)
@@ -385,28 +439,21 @@ class HostToDeviceExec(UnaryExec):
     is_device = True
 
     def execute_partition(self, pidx):
-        from spark_rapids_tpu.memory.device_manager import get_runtime
-        rt = get_runtime()
-        for b in self.child.execute_partition(pidx):
-            if rt is not None:
-                rt.semaphore.acquire_if_necessary()
-            yield b.to_device()
+        yield from upload_batches(self.child.execute_partition(pidx))
 
     def node_desc(self):
         return "HostToDevice"
 
 
 class DeviceToHostExec(UnaryExec):
+    """Device->host copy; the semaphore stays held until task completion
+    (run_task), matching the reference's completion-listener release."""
+
     is_device = False
 
     def execute_partition(self, pidx):
-        from spark_rapids_tpu.memory.device_manager import get_runtime
-        rt = get_runtime()
         for b in self.child.execute_partition(pidx):
-            hb = b.to_host()
-            if rt is not None:
-                rt.semaphore.release_if_necessary()
-            yield hb
+            yield b.to_host()
 
     def node_desc(self):
         return "DeviceToHost"

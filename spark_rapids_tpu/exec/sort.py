@@ -1,0 +1,168 @@
+"""Sort operator.
+
+Reference: GpuSortExec.scala (633 LoC) — full/partial sort with out-of-core
+merge; SortUtils.scala lowers to cuDF sortOrder+gather.  Here the device
+path is ops.sort_ops (one fused lax.sort); the per-partition iterator
+coalesces input batches and sorts once (the reference's full-sort path
+similarly concatenates-then-sorts, spilling when pressured — our spill hook
+is the memory catalog, wired by the exec when batches exceed budget).
+
+Global total order = RangePartitioning exchange below this exec (planner's
+job), matching Spark's SortExec(global=true) requiring range-partitioned
+input.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, concat_host_batches
+from spark_rapids_tpu.expressions.base import BoundReference, Expression
+from spark_rapids_tpu.plan.base import Exec, UnaryExec
+
+
+@dataclasses.dataclass(frozen=True)
+class SortSpec:
+    """Sort key at the expression level (Spark SortOrder)."""
+    expr: Expression
+    ascending: bool = True
+    nulls_first: Optional[bool] = None  # default: asc->first, desc->last
+
+    @property
+    def effective_nulls_first(self) -> bool:
+        if self.nulls_first is None:
+            return self.ascending
+        return self.nulls_first
+
+
+def _split_keys(specs: Sequence[SortSpec], n_cols: int):
+    """Maps sort specs onto batch ordinals; non-reference keys are appended
+    as projected columns after the originals."""
+    from spark_rapids_tpu.ops.sort_ops import SortOrder
+    extra: List[Expression] = []
+    orders: List[SortOrder] = []
+    for s in specs:
+        if isinstance(s.expr, BoundReference):
+            orders.append(SortOrder(s.expr.ordinal, s.ascending,
+                                    s.effective_nulls_first))
+        else:
+            orders.append(SortOrder(n_cols + len(extra), s.ascending,
+                                    s.effective_nulls_first))
+            extra.append(s.expr)
+    return orders, extra
+
+
+class CpuSortExec(UnaryExec):
+    """Per-partition host sort; iterative stable pandas sort (general
+    per-key null placement)."""
+
+    def __init__(self, specs: Sequence[SortSpec], child: Exec,
+                 global_sort: bool = False):
+        super().__init__(child)
+        self.specs = list(specs)
+        self.global_sort = global_sort
+
+    def execute_partition(self, pidx):
+        import numpy as np
+        import pyarrow as pa
+        from spark_rapids_tpu.columnar.batch import batch_from_arrow
+        from spark_rapids_tpu.expressions.evaluator import (host_batch_tcols,
+                                                            tcol_to_host_column)
+        from spark_rapids_tpu.expressions.base import EvalContext
+        batches = list(self.child.execute_partition(pidx))
+        if not batches:
+            return
+        b = concat_host_batches(batches)
+        keys = []
+        cols = host_batch_tcols(b)
+        ctx = EvalContext(cols, "cpu", b.row_count)
+        for s in self.specs:
+            kc = tcol_to_host_column(s.expr.eval_cpu(ctx), b.row_count)
+            keys.append(kc.arrow)
+        perm = np.arange(b.row_count)
+        import pandas as pd
+
+        def key_series(arr):
+            # floats: pandas conflates NaN with NA; map to IEEE-sortable
+            # ints (NaN > +inf, Spark order) keeping true nulls as NA
+            if pa.types.is_floating(arr.type):
+                isnull = arr.is_null().to_numpy(zero_copy_only=False)
+                v = arr.fill_null(0).to_numpy(zero_copy_only=False)
+                v = np.where(v == 0.0, 0.0, v)  # -0.0 -> 0.0
+                v = np.where(np.isnan(v), np.nan, v)
+                u = v.astype(np.float64).view(np.uint64)
+                sign = np.uint64(1) << np.uint64(63)
+                key = np.where(u & sign != 0, u ^ ~np.uint64(0), u | sign)
+                ser = pd.Series(key, dtype="UInt64")
+                ser[isnull] = pd.NA
+                return ser
+            return pd.Series(arr.to_pandas())
+
+        for s, arr in zip(reversed(self.specs), reversed(keys)):
+            ser = key_series(arr.take(pa.array(perm)))
+            na = "first" if s.effective_nulls_first else "last"
+            idx = ser.sort_values(kind="stable", ascending=s.ascending,
+                                  na_position=na).index.to_numpy()
+            perm = perm[idx]
+        tab = pa.Table.from_batches([b.to_arrow()]).take(pa.array(perm))
+        yield batch_from_arrow(tab)
+
+    def node_desc(self):
+        ks = ", ".join(f"{s.expr.sql()} {'ASC' if s.ascending else 'DESC'}"
+                       for s in self.specs)
+        return f"Sort[{ks}]"
+
+
+class TpuSortExec(UnaryExec):
+    """Device sort (reference: GpuSortExec full-sort path)."""
+
+    is_device = True
+
+    def __init__(self, specs: Sequence[SortSpec], child: Exec,
+                 global_sort: bool = False):
+        super().__init__(child)
+        self.specs = list(specs)
+        self.global_sort = global_sort
+
+    def execute_partition(self, pidx):
+        from spark_rapids_tpu.expressions.evaluator import eval_exprs_tpu
+        from spark_rapids_tpu.expressions.base import (Alias, BoundReference
+                                                       as BR)
+        from spark_rapids_tpu.ops import concat_batches
+        from spark_rapids_tpu.ops.sort_ops import sort_batch
+        from spark_rapids_tpu.memory.retry import with_retry_no_split
+        batches = list(self.child.execute_partition(pidx))
+        if not batches:
+            return
+        b = concat_batches(batches)
+        n_cols = b.num_columns
+        orders, extra = _split_keys(self.specs, n_cols)
+        if extra:
+            names = b.names or [f"c{i}" for i in range(n_cols)]
+            proj = [Alias(BR(i, c.data_type, True), names[i])
+                    for i, c in enumerate(b.columns)]
+            keys = [Alias(e, f"__sortkey{i}") for i, e in enumerate(extra)]
+            aug = eval_exprs_tpu(proj + keys, b)
+        else:
+            aug = b
+        out = with_retry_no_split(None, lambda: sort_batch(aug, orders))
+        if extra:
+            out = out.select(list(range(n_cols)))
+        yield out
+
+    def node_desc(self):
+        ks = ", ".join(f"{s.expr.sql()} {'ASC' if s.ascending else 'DESC'}"
+                       for s in self.specs)
+        return f"TpuSort[{ks}]"
+
+
+# plan-rewrite registration (reference: GpuOverrides SortExec rule :4210)
+from spark_rapids_tpu.plan.overrides import register_exec  # noqa: E402
+
+register_exec(CpuSortExec,
+              convert=lambda p, m: TpuSortExec(p.specs, p.children[0],
+                                               p.global_sort),
+              exprs_of=lambda p: [s.expr for s in p.specs],
+              desc="device sort (fused lax.sort over sortable key words)")

@@ -229,8 +229,11 @@ class Alias(Expression):
 def _infer_literal_type(value) -> T.DataType:
     import datetime
     import decimal
+    import numpy as _np
     if value is None:
         return T.NULL
+    if isinstance(value, _np.generic):
+        return T.from_numpy_dtype(value.dtype)
     if isinstance(value, bool):
         return T.BOOLEAN
     if isinstance(value, int):

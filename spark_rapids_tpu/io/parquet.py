@@ -158,12 +158,8 @@ class TpuParquetScanExec(CpuParquetScanExec):
         self._fragments = cpu._fragments
 
     def execute_partition(self, pidx):
-        from spark_rapids_tpu.memory.device_manager import get_runtime
-        rt = get_runtime()
-        for hb in super().execute_partition(pidx):
-            if rt is not None:
-                rt.semaphore.acquire_if_necessary()
-            yield hb.to_device()
+        from spark_rapids_tpu.exec.basic import upload_batches
+        yield from upload_batches(super().execute_partition(pidx))
 
     def node_desc(self):
         return "Tpu" + super().node_desc()

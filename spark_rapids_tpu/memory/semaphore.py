@@ -66,6 +66,15 @@ class TpuSemaphore:
             del self._holders[tid]
         self._sem.release()
 
+    def release_all(self, task_id: Optional[int] = None) -> None:
+        """Drops the task's hold entirely regardless of depth (task
+        completion listener analog — reference: GpuSemaphore completeTask)."""
+        tid = self._tid(task_id)
+        with self._lock:
+            if self._holders.pop(tid, None) is None:
+                return
+        self._sem.release()
+
     def held_by(self, task_id: int) -> bool:
         with self._lock:
             return task_id in self._holders

@@ -1,0 +1,168 @@
+"""Device sort kernels: multi-key lexicographic sort.
+
+Reference: GpuSortExec.scala + SortUtils.scala lower sorting to cuDF
+``Table.sortOrder``/``gather``.  TPU-first redesign: every key column is
+normalized into one or more integer "sortable words" such that plain
+ascending integer order == the SQL order (nulls-first/last, asc/desc, NaN
+ordering, string lexicographic order), then a single ``jax.lax.sort`` over
+all words (variadic operands, ``num_keys``) yields the permutation.  This
+keeps the whole sort one fused XLA op on static shapes — no comparator
+callbacks, no dynamic shapes.
+
+Normalization rules:
+- padding rows (>= row_count) sort last via a leading global rank word
+- null rank word per key: 0/1 per nulls_first
+- floats: IEEE bit trick (flip all bits when negative, flip sign bit when
+  positive) -> unsigned order; NaN canonicalized positive (sorts after +inf,
+  Spark semantics), -0.0 normalized to 0.0
+- strings/binary: bytes+1 packed 7-per-uint64 big-endian (pad rank 0) so a
+  prefix sorts first and embedded NULs stay ordered; exact, not truncated
+- decimal128: hi limb signed word, lo limb unsigned word
+- descending: bitwise-NOT of the word (monotone order reversal, no overflow)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+
+
+def _jx():
+    from spark_rapids_tpu.columnar.column import _jnp
+    return _jnp()
+
+
+@dataclasses.dataclass(frozen=True)
+class SortOrder:
+    """One sort key (reference: Spark SortOrder child/direction/nullOrdering).
+
+    ``ordinal`` indexes the batch being sorted; exec layers project key
+    expressions into leading columns first.
+    """
+    ordinal: int
+    ascending: bool = True
+    nulls_first: bool = True   # Spark default: NULLS FIRST for ASC, LAST for DESC
+
+    @staticmethod
+    def asc(ordinal: int) -> "SortOrder":
+        return SortOrder(ordinal, True, True)
+
+    @staticmethod
+    def desc(ordinal: int) -> "SortOrder":
+        return SortOrder(ordinal, False, False)
+
+
+def _float_sortable(x, jnp, bits_dtype, ubits_dtype):
+    import jax
+    # canonicalize: -0.0 -> 0.0, NaN -> positive canonical NaN
+    zero = jnp.asarray(0, dtype=x.dtype)
+    x = jnp.where(x == zero, zero, x)         # collapses -0.0 (NaN != 0 safe)
+    x = jnp.where(jnp.isnan(x), jnp.asarray(np.nan, dtype=x.dtype), x)
+    u = jax.lax.bitcast_convert_type(x, ubits_dtype)
+    sign = np.dtype(ubits_dtype).type(1) << np.dtype(ubits_dtype).type(
+        np.dtype(ubits_dtype).itemsize * 8 - 1)
+    allbits = ~np.dtype(ubits_dtype).type(0)
+    return jnp.where((u & sign) != 0, u ^ allbits, u | sign)
+
+
+def _string_words(col: DeviceColumn, jnp) -> List:
+    """Packs bytes+1 (pad=0) 7-per-word big-endian -> uint64 words."""
+    data = col.data          # uint8 [bucket, w]
+    lens = col.lengths
+    w = int(data.shape[1]) if data.ndim == 2 else 0
+    if w == 0:
+        return [jnp.zeros(data.shape[0], dtype=np.uint64)]
+    pos = jnp.arange(w, dtype=np.int32)
+    vals = jnp.where(pos[None, :] < lens[:, None],
+                     data.astype(np.uint64) + 1, 0)
+    words = []
+    for start in range(0, w, 7):
+        chunk = vals[:, start:start + 7]
+        word = jnp.zeros(data.shape[0], dtype=np.uint64)
+        k = chunk.shape[1]
+        for j in range(k):
+            word = word | (chunk[:, j] << np.uint64(9 * (6 - j)))
+        words.append(word)
+    return words
+
+
+def sortable_words(col: DeviceColumn, jnp) -> List:
+    """Key words in ascending-SQL order; nulls carry garbage (rank separates).
+
+    Used both by sort (with null-rank words) and by group-boundary detection
+    (with null masking)."""
+    import jax
+    dt = col.data_type
+    if isinstance(dt, (T.StringType, T.BinaryType)):
+        return _string_words(col, jnp)
+    if isinstance(dt, T.DecimalType) and dt.is_decimal128:
+        hi = col.data[:, 0]
+        lo = jax.lax.bitcast_convert_type(col.data[:, 1], np.uint64)
+        return [hi, lo]
+    if isinstance(dt, T.FloatType):
+        return [_float_sortable(col.data, jnp, np.int32, np.uint32)]
+    if isinstance(dt, T.DoubleType):
+        return [_float_sortable(col.data, jnp, np.int64, np.uint64)]
+    if isinstance(dt, T.BooleanType):
+        return [col.data.astype(np.int8)]
+    # integral / date / timestamp / decimal64: native integer order
+    return [col.data]
+
+
+def _order_words(col: DeviceColumn, order: SortOrder, jnp) -> List:
+    """null-rank word + (possibly flipped) value words for one sort key."""
+    rank_null = np.int8(0 if order.nulls_first else 1)
+    rank_val = np.int8(1 if order.nulls_first else 0)
+    words = [jnp.where(col.validity, rank_val, rank_null)]
+    for w in sortable_words(col, jnp):
+        if not order.ascending:
+            w = ~w
+        words.append(w)
+    return words
+
+
+_SORT_CACHE: Dict[Tuple, object] = {}
+
+
+def _col_sig(c: DeviceColumn) -> Tuple:
+    return (str(c.data.dtype), tuple(c.data.shape), c.lengths is not None)
+
+
+def sort_permutation(batch: ColumnarBatch, orders: Sequence[SortOrder]):
+    """Returns int32[bucket] permutation placing rows in SQL order,
+    padding rows last.  One jitted program per (shapes, orders) signature."""
+    import jax
+    jnp = _jx()
+    orders = tuple(orders)
+    key = ("perm", tuple(_col_sig(c) for c in batch.columns), orders)
+    fn = _SORT_CACHE.get(key)
+    if fn is None:
+        bucket = batch.bucket
+
+        def run(arrs, row_count):
+            cols = [DeviceColumn(d, v, bucket, batch.columns[i].data_type, ln)
+                    for i, (d, v, ln) in enumerate(arrs)]
+            rowpos = jnp.arange(bucket, dtype=np.int32)
+            words = [(rowpos >= row_count).astype(np.int8)]  # padding last
+            for o in orders:
+                words.extend(_order_words(cols[o.ordinal], o, jnp))
+            out = jax.lax.sort(tuple(words) + (rowpos,),
+                               num_keys=len(words), is_stable=True)
+            return out[-1]
+
+        fn = jax.jit(run)
+        _SORT_CACHE[key] = fn
+    arrs = [(c.data, c.validity, c.lengths) for c in batch.columns]
+    return fn(arrs, batch.row_count)
+
+
+def sort_batch(batch: ColumnarBatch, orders: Sequence[SortOrder]) -> ColumnarBatch:
+    from spark_rapids_tpu.ops.batch_ops import gather_batch
+    perm = sort_permutation(batch, orders)
+    return gather_batch(batch, perm, batch.row_count)

@@ -16,5 +16,15 @@ tier, so transitions collapse to host<->device copies (``HostToDeviceExec`` /
 
 from spark_rapids_tpu.plan.base import (  # noqa: F401
     Exec, LeafExec, UnaryExec, BinaryExec, is_device_exec)
-from spark_rapids_tpu.plan.meta import PlanMeta, tag_and_convert  # noqa: F401
-from spark_rapids_tpu.plan.overrides import TpuOverrides  # noqa: F401
+
+
+def __getattr__(name):
+    # lazy: overrides imports exec modules, which import plan.base; an eager
+    # import here would make `import spark_rapids_tpu.exec.basic` circular
+    if name in ("TpuOverrides",):
+        from spark_rapids_tpu.plan.overrides import TpuOverrides
+        return TpuOverrides
+    if name in ("PlanMeta", "tag_and_convert"):
+        from spark_rapids_tpu.plan import meta
+        return getattr(meta, name)
+    raise AttributeError(name)

@@ -55,7 +55,7 @@ class Exec:
 
     def execute_all(self):
         for p in range(self.num_partitions):
-            yield from self.execute_partition(p)
+            yield from run_task(self, p)
 
     def collect_host(self) -> HostColumnarBatch:
         """Gathers every partition to one host batch (driver collect)."""
@@ -103,6 +103,19 @@ class Exec:
 
     def __repr__(self):
         return self.node_desc()
+
+
+def run_task(plan: "Exec", pidx: int):
+    """Drives one partition as a task: the device semaphore (acquired by any
+    device section during execution) is fully released at completion, like
+    the reference's task-completion listener (GpuSemaphore.scala:51-120)."""
+    try:
+        yield from plan.execute_partition(pidx)
+    finally:
+        from spark_rapids_tpu.memory.device_manager import get_runtime
+        rt = get_runtime()
+        if rt is not None:
+            rt.semaphore.release_all()
 
 
 class LeafExec(Exec):

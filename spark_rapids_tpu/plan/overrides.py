@@ -154,6 +154,10 @@ def _register_basic_execs():
     register_exec(X.CpuLimitExec,
                   convert=lambda p, m: X.TpuLimitExec(p.n, p.children[0]),
                   desc="limit")
+    register_exec(X.CpuGlobalLimitExec,
+                  convert=lambda p, m: X.TpuGlobalLimitExec(p.n,
+                                                            p.children[0]),
+                  desc="global limit")
     register_exec(X.CpuUnionExec,
                   convert=lambda p, m: X.TpuUnionExec(p.children),
                   desc="union")
@@ -220,7 +224,9 @@ class TpuOverrides:
         self.conf = conf
         self.last_meta: Optional[PlanMeta] = None
 
-    def apply(self, plan: Exec) -> Exec:
+    def apply(self, plan: Exec, for_explain: bool = False) -> Exec:
+        """``for_explain`` produces the would-be plan without the test-mode
+        all-on-device assertion (introspection must not raise on fallback)."""
         conf = self.conf
         if not conf.is_sql_enabled:
             return plan
@@ -236,7 +242,7 @@ class TpuOverrides:
             return plan
         out = insert_transitions(converted, conf)
         out = self._coalesce_after_device_sources(out)
-        if conf.is_test_enabled:
+        if conf.is_test_enabled and not for_explain:
             validate_all_on_device(out, conf)
         return out
 

@@ -150,8 +150,12 @@ class DataFrame:
         return DataFrame(CpuProjectExec(bound, self._plan), self._session)
 
     def limit(self, n: int) -> "DataFrame":
-        from spark_rapids_tpu.exec.basic import CpuLimitExec
-        return DataFrame(CpuLimitExec(n, self._plan), self._session)
+        from spark_rapids_tpu.exec.basic import (CpuGlobalLimitExec,
+                                                 CpuLimitExec)
+        plan = CpuLimitExec(n, self._plan)  # local limit per partition
+        if self._plan.num_partitions > 1:
+            plan = CpuGlobalLimitExec(n, plan)
+        return DataFrame(plan, self._session)
 
     def union(self, other: "DataFrame") -> "DataFrame":
         from spark_rapids_tpu.exec.basic import CpuUnionExec
@@ -202,7 +206,7 @@ class DataFrame:
         """Shows CPU plan, TPU-rewritten plan, and fallback reasons
         (reference: ExplainPlan.explainPotentialGpuPlan)."""
         overrides = TpuOverrides(self._session.conf)
-        final = overrides.apply(self._plan)
+        final = overrides.apply(self._plan, for_explain=True)
         reasons = overrides.last_meta.explain(all_nodes=True) \
             if overrides.last_meta else ""
         out = (f"== Physical Plan (input) ==\n{self._plan.tree_string()}\n"

@@ -37,25 +37,30 @@ def _val_eq(a, b, approx):
     return a == b
 
 
+def _compare_rows(expected_rows, actual_rows, check_order, approx_float,
+                  labels=("expected", "actual")):
+    assert len(expected_rows) == len(actual_rows), \
+        (f"row count differs: {labels[0]}={len(expected_rows)} "
+         f"{labels[1]}={len(actual_rows)}")
+    if not check_order:
+        keyfn = lambda r: tuple(str(v) for v in r.values())
+        expected_rows = sorted(expected_rows, key=keyfn)
+        actual_rows = sorted(actual_rows, key=keyfn)
+    for i, (er, ar) in enumerate(zip(expected_rows, actual_rows)):
+        assert er.keys() == ar.keys(), f"row {i}: columns differ"
+        for k in er:
+            assert _val_eq(er[k], ar[k], approx_float), \
+                (f"row {i} col {k!r}: {labels[0]}={er[k]!r} "
+                 f"{labels[1]}={ar[k]!r}")
+
+
 def assert_tpu_and_cpu_are_equal_collect(df_fn, ignore_order=False,
                                          approx_float=True, conf=None):
     """df_fn(session) -> DataFrame; runs under both engines and compares."""
-    cpu_df = df_fn(cpu_session())
-    cpu_rows = cpu_df.collect()
-    tpu_s = tpu_session(conf)
-    tpu_df = df_fn(tpu_s)
-    tpu_rows = tpu_df.collect()
-    assert len(cpu_rows) == len(tpu_rows), \
-        f"row count differs: cpu={len(cpu_rows)} tpu={len(tpu_rows)}"
-    if ignore_order:
-        keyfn = lambda r: tuple(str(v) for v in r.values())
-        cpu_rows = sorted(cpu_rows, key=keyfn)
-        tpu_rows = sorted(tpu_rows, key=keyfn)
-    for i, (cr, tr) in enumerate(zip(cpu_rows, tpu_rows)):
-        assert cr.keys() == tr.keys(), f"row {i}: columns differ"
-        for k in cr:
-            assert _val_eq(cr[k], tr[k], approx_float), \
-                f"row {i} col {k!r}: cpu={cr[k]!r} tpu={tr[k]!r}"
+    cpu_rows = df_fn(cpu_session()).collect()
+    tpu_rows = df_fn(tpu_session(conf)).collect()
+    _compare_rows(cpu_rows, tpu_rows, check_order=not ignore_order,
+                  approx_float=approx_float, labels=("cpu", "tpu"))
 
 
 def assert_tpu_fallback_collect(df_fn, fallback_exec_name: str):
@@ -71,3 +76,17 @@ def assert_tpu_fallback_collect(df_fn, fallback_exec_name: str):
         f"expected {fallback_exec_name} on CPU; plan:\n{final.tree_string()}"
     assert_tpu_and_cpu_are_equal_collect(
         df_fn, conf={"spark.rapids.sql.test.enabled": "false"})
+
+
+def _batch_rows(b):
+    d = b.to_pydict()
+    names = list(d.keys())
+    return [dict(zip(names, row)) for row in zip(*d.values())] if names else []
+
+
+def assert_batches_equal(expected, actual, check_order=False,
+                         approx_float=True):
+    """Deep-compares two HostColumnarBatch results (exec-level differential
+    tests that bypass the session layer)."""
+    _compare_rows(_batch_rows(expected), _batch_rows(actual), check_order,
+                  approx_float)

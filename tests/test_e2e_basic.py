@@ -143,3 +143,18 @@ def test_write_parquet_roundtrip(tmp_path):
         P.GreaterThan(col("a"), lit(0))).write_parquet(out)
     back = s.read.parquet(out).count()
     assert back == sum(1 for v in _DATA["a"] if v > 0)
+
+
+def test_limit_is_global_across_partitions():
+    from tests.asserts import cpu_session, tpu_session
+    for s in (cpu_session(), tpu_session()):
+        df = s.range(0, 1000, 3, num_partitions=4).limit(100)
+        assert df.count() == 100
+
+
+def test_explain_does_not_raise_on_fallback():
+    from tests.asserts import tpu_session
+    s = tpu_session()  # test-mode on: execution would assert all-on-device
+    df = s.create_dataframe({"a": list(range(10))}).sample(0.5, seed=1)
+    text = df.explain()
+    assert "Placement" in text

@@ -1,0 +1,137 @@
+"""Sort tests: device sort vs CPU oracle (differential, reference
+methodology: assert_gpu_and_cpu_are_equal_collect)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import batch_from_pydict
+from spark_rapids_tpu.exec.basic import CpuInMemoryScanExec
+from spark_rapids_tpu.exec.sort import CpuSortExec, SortSpec, TpuSortExec
+from spark_rapids_tpu.expressions.base import BoundReference, col, lit
+from tests.asserts import assert_batches_equal
+
+
+def _scan(d, schema=None):
+    hb = batch_from_pydict(d, schema)
+    return CpuInMemoryScanExec([[hb]], hb.schema)
+
+
+def _run_both(scan, specs):
+    cpu = CpuSortExec(specs, scan).collect_host()
+    tpu_plan = TpuSortExec(specs, scan)
+    from spark_rapids_tpu.plan.overrides import insert_transitions
+    from spark_rapids_tpu.config import default_conf
+    tpu = insert_transitions(tpu_plan, default_conf()).collect_host()
+    assert_batches_equal(cpu, tpu, check_order=True)
+    return cpu
+
+
+def _ref(i, dt=T.LONG):
+    return BoundReference(i, dt, True)
+
+
+def test_sort_ints_asc_desc(rng):
+    vals = rng.integers(-1000, 1000, 5000)
+    scan = _scan({"a": vals, "b": np.arange(5000)})
+    _run_both(scan, [SortSpec(_ref(0), ascending=True)])
+    _run_both(scan, [SortSpec(_ref(0), ascending=False)])
+
+
+def test_sort_with_nulls():
+    a = pa.array([5, None, 3, None, 1, 2, None, 4], type=pa.int64())
+    tbl = pa.table({"a": a, "b": pa.array(range(8), type=pa.int64())})
+    from spark_rapids_tpu.columnar.batch import batch_from_arrow
+    hb = batch_from_arrow(tbl)
+    scan = CpuInMemoryScanExec([[hb]], hb.schema)
+    out = _run_both(scan, [SortSpec(_ref(0), True)])   # nulls first
+    assert out.to_pydict()["a"][:3] == [None, None, None]
+    out = _run_both(scan, [SortSpec(_ref(0), False)])  # desc: nulls last
+    assert out.to_pydict()["a"][-3:] == [None, None, None]
+    _run_both(scan, [SortSpec(_ref(0), True, nulls_first=False)])
+    _run_both(scan, [SortSpec(_ref(0), False, nulls_first=True)])
+
+
+def test_sort_multi_key_stable(rng):
+    a = rng.integers(0, 10, 3000)
+    b = rng.integers(-50, 50, 3000)
+    c = np.arange(3000)
+    scan = _scan({"a": a, "b": b, "c": c})
+    _run_both(scan, [SortSpec(_ref(0), True), SortSpec(_ref(1), False)])
+    _run_both(scan, [SortSpec(_ref(0), False), SortSpec(_ref(1), True)])
+
+
+def test_sort_floats_nan_inf(rng):
+    vals = np.array([1.5, -0.0, 0.0, np.nan, np.inf, -np.inf, -2.25, np.nan,
+                     3.75, -1e300])
+    scan = _scan({"a": vals, "i": np.arange(10)},
+                 T.StructType([T.StructField("a", T.DOUBLE),
+                               T.StructField("i", T.LONG)]))
+    out = _run_both(scan, [SortSpec(_ref(0, T.DOUBLE), True)])
+    d = out.to_pydict()["a"]
+    # Spark: NaN sorts greater than +inf
+    assert np.isnan(d[-1]) and np.isnan(d[-2])
+    assert d[-3] == np.inf
+
+
+def test_sort_float32(rng):
+    vals = rng.normal(size=2000).astype(np.float32)
+    scan = _scan({"a": vals},
+                 T.StructType([T.StructField("a", T.FLOAT)]))
+    _run_both(scan, [SortSpec(_ref(0, T.FLOAT), True)])
+    _run_both(scan, [SortSpec(_ref(0, T.FLOAT), False)])
+
+
+def test_sort_strings():
+    strs = ["banana", "", "apple", "app", "apples", "cherry", None, "a",
+            "Banana", "\x00zero", "zz", None]
+    tbl = pa.table({"s": pa.array(strs, type=pa.string()),
+                    "i": pa.array(range(len(strs)), type=pa.int64())})
+    from spark_rapids_tpu.columnar.batch import batch_from_arrow
+    hb = batch_from_arrow(tbl)
+    scan = CpuInMemoryScanExec([[hb]], hb.schema)
+    out = _run_both(scan, [SortSpec(_ref(0, T.STRING), True)])
+    got = [s for s in out.to_pydict()["s"] if s is not None]
+    assert got == sorted(s for s in strs if s is not None)
+    _run_both(scan, [SortSpec(_ref(0, T.STRING), False)])
+
+
+def test_sort_long_strings():
+    # strings wider than one 7-byte word: exact (not truncated) ordering
+    strs = ["x" * 20 + "a", "x" * 20 + "b", "x" * 20, "x" * 19 + "y",
+            "x" * 30, "w" * 30]
+    tbl = pa.table({"s": pa.array(strs)})
+    from spark_rapids_tpu.columnar.batch import batch_from_arrow
+    hb = batch_from_arrow(tbl)
+    scan = CpuInMemoryScanExec([[hb]], hb.schema)
+    out = _run_both(scan, [SortSpec(_ref(0, T.STRING), True)])
+    assert out.to_pydict()["s"] == sorted(strs)
+
+
+def test_sort_bool_and_dates():
+    tbl = pa.table({
+        "b": pa.array([True, False, None, True, False]),
+        "d": pa.array([18000, 17000, 19000, None, 16000], type=pa.date32()),
+    })
+    from spark_rapids_tpu.columnar.batch import batch_from_arrow
+    hb = batch_from_arrow(tbl)
+    scan = CpuInMemoryScanExec([[hb]], hb.schema)
+    _run_both(scan, [SortSpec(_ref(0, T.BOOLEAN), True),
+                     SortSpec(_ref(1, T.DATE), False)])
+
+
+def test_sort_by_expression(rng):
+    from spark_rapids_tpu.expressions.arithmetic import Multiply
+    vals = rng.integers(-100, 100, 1000)
+    scan = _scan({"a": vals})
+    expr = Multiply(_ref(0), lit(np.int64(-1)))
+    _run_both(scan, [SortSpec(expr, True)])
+
+
+def test_sort_empty_and_single():
+    scan = _scan({"a": np.array([], dtype=np.int64)})
+    _run_both(scan, [SortSpec(_ref(0), True)])
+    scan = _scan({"a": np.array([7], dtype=np.int64)})
+    out = _run_both(scan, [SortSpec(_ref(0), True)])
+    assert out.to_pydict()["a"] == [7]
