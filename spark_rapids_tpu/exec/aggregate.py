@@ -1,0 +1,403 @@
+"""Hash-aggregate operator (partial / final / complete modes).
+
+Reference: GpuAggregateExec.scala — first-pass iterator (:549) does
+per-batch partial aggregation, GpuMergeAggregateIterator (:711) concats and
+re-aggregates (with spill + re-partition fallback), final-pass (:578)
+applies result projections.  GpuHashAggregateExec :1711.
+
+TPU path: each batch runs through ops/agg_ops.segmented_aggregate (sort +
+segmented reductions, one fused XLA program); cross-batch merge re-runs the
+same kernel with merge kinds.  CPU oracle: pyarrow TableGroupBy with the
+same declarative buffer algebra.
+
+Two-stage planning (partial -> hash exchange -> final) is assembled by the
+DataFrame layer, mirroring Spark's physical aggregation pattern.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import (ColumnarBatch, HostColumnarBatch,
+                                             concat_host_batches)
+from spark_rapids_tpu.expressions.aggregates import (AggregateExpression,
+                                                     BufferSpec)
+from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
+                                               Expression)
+from spark_rapids_tpu.plan.base import Exec, UnaryExec
+
+PARTIAL, FINAL, COMPLETE = "partial", "final", "complete"
+
+
+class _AggLayout:
+    """Buffer layout shared by both engines and both stages."""
+
+    def __init__(self, grouping: Sequence[Expression],
+                 aggs: Sequence[AggregateExpression]):
+        self.grouping = list(grouping)
+        self.aggs = list(aggs)
+        self.num_keys = len(self.grouping)
+        # flattened buffers with (agg_idx, spec)
+        self.flat: List[Tuple[int, BufferSpec]] = []
+        for ai, a in enumerate(self.aggs):
+            for spec in a.func.buffers():
+                self.flat.append((ai, spec))
+
+    def key_name(self, i: int) -> str:
+        e = self.grouping[i]
+        return getattr(e, "alias_name", None) or e.sql()
+
+    def buffer_name(self, j: int) -> str:
+        ai, spec = self.flat[j]
+        return f"{self.aggs[ai].out_name}#{spec.name}"
+
+    @property
+    def buffer_schema(self) -> T.StructType:
+        fields = [T.StructField(self.key_name(i),
+                                self.grouping[i].data_type,
+                                self.grouping[i].nullable)
+                  for i in range(self.num_keys)]
+        fields += [T.StructField(self.buffer_name(j), spec.dtype, True)
+                   for j, (ai, spec) in enumerate(self.flat)]
+        return T.StructType(fields)
+
+    @property
+    def result_schema(self) -> T.StructType:
+        fields = [T.StructField(self.key_name(i),
+                                self.grouping[i].data_type,
+                                self.grouping[i].nullable)
+                  for i in range(self.num_keys)]
+        fields += [T.StructField(a.out_name, a.func.data_type,
+                                 a.func.nullable) for a in self.aggs]
+        return T.StructType(fields)
+
+    def update_input_exprs(self) -> List[Expression]:
+        """Pre-step projection: keys then one column per buffer (inputs
+        cast so reduction dtype == buffer dtype — reference: cudfUpdate
+        input projections)."""
+        from spark_rapids_tpu.expressions.cast import Cast
+        out = list(self.grouping)
+        for ai, spec in self.flat:
+            e = self.aggs[ai].func.inputs()[spec.input_ordinal]
+            if spec.update_kind == "sum" and e.data_type != spec.dtype:
+                e = Cast(e, spec.dtype)
+            out.append(e)
+        return out
+
+    def update_specs(self):
+        return [(self.num_keys + j, spec.update_kind, spec.count_valid_only,
+                 spec.dtype) for j, (_ai, spec) in enumerate(self.flat)]
+
+    def merge_specs(self):
+        return [(self.num_keys + j, spec.merge_kind, spec.count_valid_only,
+                 spec.dtype) for j, (_ai, spec) in enumerate(self.flat)]
+
+    def final_exprs(self) -> List[Expression]:
+        """Projection from buffer layout to results."""
+        exprs: List[Expression] = []
+        for i in range(self.num_keys):
+            exprs.append(Alias(
+                BoundReference(i, self.grouping[i].data_type,
+                               self.grouping[i].nullable),
+                self.key_name(i)))
+        j = 0
+        for a in self.aggs:
+            refs = []
+            for spec in a.func.buffers():
+                refs.append(BoundReference(self.num_keys + j, spec.dtype,
+                                           True))
+                j += 1
+            exprs.append(Alias(a.func.evaluate(refs), a.out_name))
+        return exprs
+
+
+class CpuHashAggregateExec(UnaryExec):
+    """Arrow-groupby based oracle/fallback with the same buffer algebra."""
+
+    def __init__(self, grouping, aggs, mode, child: Exec):
+        super().__init__(child)
+        self.layout = _AggLayout(grouping, aggs)
+        self.mode = mode
+
+    @property
+    def schema(self):
+        return self.layout.buffer_schema if self.mode == PARTIAL else \
+            self.layout.result_schema
+
+    # ------------------------------------------------------------------
+    def _project_update_input(self, hb: HostColumnarBatch):
+        from spark_rapids_tpu.expressions.evaluator import eval_exprs_cpu
+        exprs = []
+        for i, e in enumerate(self.layout.update_input_exprs()):
+            nm = self.layout.key_name(i) if i < self.layout.num_keys else \
+                f"v{i - self.layout.num_keys}"
+            exprs.append(Alias(e, nm))
+        return eval_exprs_cpu(exprs, hb)
+
+    def _arrow_groupby(self, table, key_names, specs):
+        """specs: list of (src_col_name, kind, count_valid_only).  Returns
+        arrow table with key cols then one col per spec, in order."""
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        aggs = []
+        post = []  # names in output, spec order
+        for col_name, kind, cvo in specs:
+            if kind == "count":
+                opt = pc.CountOptions(mode="only_valid" if cvo else "all")
+                aggs.append((col_name, "count", opt))
+            elif kind in ("sum", "min", "max", "mean"):
+                opt = pc.ScalarAggregateOptions(skip_nulls=True, min_count=0)
+                aggs.append((col_name, kind, opt))
+            elif kind in ("first", "last"):
+                opt = pc.ScalarAggregateOptions(skip_nulls=False, min_count=0)
+                aggs.append((col_name, kind, opt))
+            elif kind in ("first_valid", "last_valid"):
+                opt = pc.ScalarAggregateOptions(skip_nulls=True, min_count=0)
+                aggs.append((col_name, kind.split("_")[0], opt))
+            else:
+                raise ValueError(kind)
+        if key_names:
+            gb = table.group_by(key_names, use_threads=False)
+            res = gb.aggregate(aggs)
+        else:
+            # reduction: aggregate to one row
+            res = table.group_by([], use_threads=False).aggregate(aggs)
+        # output order: aggregate cols are named f"{col}_{fn}"; build in
+        # spec order (duplicate (col, fn) pairs collapse to one output col)
+        out_cols, out_names = [], []
+        for (col_name, kind, cvo), (src, fn, _o) in zip(specs, aggs):
+            res_name = f"{src}_{fn}"
+            out_cols.append(res.column(res_name))
+            out_names.append(res_name)
+        keys = [res.column(k) for k in key_names]
+        return keys, out_cols, res.num_rows
+
+    def _update(self, hb: HostColumnarBatch) -> HostColumnarBatch:
+        """Raw input -> buffer layout (CPU m2 via sum-of-squares algebra)."""
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        from spark_rapids_tpu.columnar.batch import batch_from_arrow
+        lay = self.layout
+        proj = self._project_update_input(hb)
+        table = pa.Table.from_batches([proj.to_arrow()])
+        key_names = [lay.key_name(i) for i in range(lay.num_keys)]
+        specs = []
+        extra_sq = {}
+        for j, (_ai, spec) in enumerate(lay.flat):
+            k = spec.update_kind
+            if k == "m2":
+                # m2 = sum(x^2) - sum(x)^2 / n  (post-computed)
+                sq_name = f"v{j}__sq"
+                if sq_name not in extra_sq:
+                    x = table.column(f"v{j}")
+                    table = table.append_column(sq_name, pc.multiply(x, x))
+                    extra_sq[sq_name] = True
+                specs.append((sq_name, "sum", True))
+            else:
+                specs.append((f"v{j}", k, spec.count_valid_only))
+        keys, cols, nrows = self._arrow_groupby(table, key_names, specs)
+        # post: m2 needs n & mean of the same input — find sibling buffers
+        out = []
+        for j, (_ai, spec) in enumerate(lay.flat):
+            c = cols[j]
+            if spec.update_kind == "m2":
+                n, mean = cols[j - 2], cols[j - 1]
+                sumx = pc.multiply(n, mean)
+                corr = pc.if_else(pc.greater(n, 0.0),
+                                  pc.divide(pc.multiply(sumx, sumx),
+                                            pc.if_else(pc.greater(n, 0.0),
+                                                       n, 1.0)),
+                                  0.0)
+                c = pc.fill_null(pc.subtract(pc.fill_null(c, 0.0), corr), 0.0)
+                c = pc.max_element_wise(c, 0.0)  # clamp fp negatives
+            at = T.to_arrow(spec.dtype)
+            c = pc.cast(c, at, safe=False) if c.type != at else c
+            out.append(c)
+        arrs = keys + out
+        names = key_names + [lay.buffer_name(j) for j in range(len(out))]
+        combined = [a.combine_chunks() if isinstance(a, pa.ChunkedArray)
+                    else a for a in arrs]
+        return batch_from_arrow(pa.table(dict(zip(names, combined))))
+
+    def _merge(self, hb: HostColumnarBatch) -> HostColumnarBatch:
+        """Buffer layout -> merged buffer layout."""
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        from spark_rapids_tpu.columnar.batch import batch_from_arrow
+        lay = self.layout
+        table = pa.Table.from_batches([hb.to_arrow()])
+        key_names = [lay.key_name(i) for i in range(lay.num_keys)]
+        specs = []
+        j = 0
+        renames = {}
+        while j < len(lay.flat):
+            _ai, spec = lay.flat[j]
+            k = spec.merge_kind
+            bn = lay.buffer_name(j)
+            if k == "m2_cnt":
+                # decompose to sums: n, wsum = n*mu, sq = m2 + wsum^2/n
+                n = table.column(bn)
+                mu = table.column(lay.buffer_name(j + 1))
+                m2 = table.column(lay.buffer_name(j + 2))
+                wsum = pc.multiply(n, mu)
+                sq = pc.add(m2, pc.if_else(
+                    pc.greater(n, 0.0),
+                    pc.divide(pc.multiply(wsum, wsum),
+                              pc.if_else(pc.greater(n, 0.0), n, 1.0)), 0.0))
+                table = table.append_column(f"__w{j}", wsum)
+                table = table.append_column(f"__q{j}", sq)
+                specs.append((bn, "sum", True))
+                specs.append((f"__w{j}", "sum", True))
+                specs.append((f"__q{j}", "sum", True))
+                renames[j + 1] = "recompute_mean"
+                renames[j + 2] = "recompute_m2"
+                j += 3
+                continue
+            specs.append((bn, k, spec.count_valid_only))
+            j += 1
+        keys, cols, nrows = self._arrow_groupby(table, key_names, specs)
+        out = []
+        for j, (_ai, spec) in enumerate(lay.flat):
+            c = cols[j]
+            if renames.get(j) == "recompute_mean":
+                n, w = cols[j - 1], cols[j]
+                c = pc.if_else(pc.greater(n, 0.0),
+                               pc.divide(w, pc.if_else(pc.greater(n, 0.0),
+                                                       n, 1.0)), 0.0)
+            elif renames.get(j) == "recompute_m2":
+                n, w, q = cols[j - 2], cols[j - 1], cols[j]
+                wsum2 = pc.if_else(pc.greater(n, 0.0),
+                                   pc.divide(pc.multiply(w, w),
+                                             pc.if_else(pc.greater(n, 0.0),
+                                                        n, 1.0)), 0.0)
+                c = pc.max_element_wise(pc.subtract(q, wsum2), 0.0)
+            at = T.to_arrow(spec.dtype)
+            c = pc.cast(c, at, safe=False) if c.type != at else c
+            out.append(c)
+        arrs = keys + out
+        names = key_names + [lay.buffer_name(k2) for k2 in range(len(out))]
+        combined = [a.combine_chunks() if isinstance(a, pa.ChunkedArray)
+                    else a for a in arrs]
+        return batch_from_arrow(pa.table(dict(zip(names, combined))))
+
+    def _finalize(self, hb: HostColumnarBatch) -> HostColumnarBatch:
+        from spark_rapids_tpu.expressions.evaluator import eval_exprs_cpu
+        return eval_exprs_cpu(self.layout.final_exprs(), hb)
+
+    def execute_partition(self, pidx):
+        batches = list(self.child.execute_partition(pidx))
+        lay = self.layout
+        if not batches:
+            if lay.num_keys == 0 and self.mode in (COMPLETE, FINAL) and \
+                    self.child.num_partitions == 1:
+                yield self._empty_reduction()
+            return
+        hb = concat_host_batches(batches)
+        if self.mode in (PARTIAL, COMPLETE):
+            buf = self._update(hb)
+        else:
+            buf = self._merge(hb)
+        if self.mode == PARTIAL:
+            yield buf
+        else:
+            yield self._finalize(buf)
+
+    def _empty_reduction(self) -> HostColumnarBatch:
+        """Global aggregation over zero rows still yields one row
+        (count=0, sum=null ...)."""
+        import pyarrow as pa
+        from spark_rapids_tpu.columnar.batch import batch_from_arrow
+        lay = self.layout
+        cols = {}
+        for j, (_ai, spec) in enumerate(lay.flat):
+            k = spec.update_kind if self.mode == COMPLETE else spec.merge_kind
+            zero = 0 if k == "count" or k.startswith("m2") else None
+            if spec.dtype == T.DOUBLE and zero == 0:
+                zero = 0.0
+            cols[lay.buffer_name(j)] = pa.array([zero],
+                                                type=T.to_arrow(spec.dtype))
+        buf = batch_from_arrow(pa.table(cols))
+        return self._finalize(buf)
+
+    def node_desc(self):
+        ks = ", ".join(e.sql() for e in self.layout.grouping)
+        asym = ", ".join(a.func.sql() for a in self.layout.aggs)
+        return f"HashAggregate[{self.mode}]({ks})[{asym}]"
+
+
+class TpuHashAggregateExec(CpuHashAggregateExec):
+    is_device = True
+
+    def execute_partition(self, pidx):
+        from spark_rapids_tpu.expressions.evaluator import eval_exprs_tpu
+        from spark_rapids_tpu.memory.retry import with_retry_no_split
+        from spark_rapids_tpu.ops.agg_ops import segmented_aggregate
+        from spark_rapids_tpu.ops.batch_ops import concat_batches
+        lay = self.layout
+        partials: List[ColumnarBatch] = []
+        for b in self.child.execute_partition(pidx):
+            if self.mode in (PARTIAL, COMPLETE):
+                exprs = []
+                for i, e in enumerate(lay.update_input_exprs()):
+                    nm = lay.key_name(i) if i < lay.num_keys else \
+                        f"v{i - lay.num_keys}"
+                    exprs.append(Alias(e, nm))
+                proj = eval_exprs_tpu(exprs, b)
+                p = with_retry_no_split(None, lambda: segmented_aggregate(
+                    proj, lay.num_keys, lay.update_specs()))
+            else:
+                p = b  # already in buffer layout (post-shuffle)
+            partials.append(p)
+        if not partials:
+            if lay.num_keys == 0 and self.mode in (COMPLETE, FINAL) and \
+                    self.child.num_partitions == 1:
+                yield self._empty_reduction().to_device()
+            return
+        merged = partials[0]
+        if len(partials) > 1 or self.mode == FINAL:
+            big = concat_batches(partials)
+            merged = with_retry_no_split(None, lambda: segmented_aggregate(
+                big, lay.num_keys, lay.merge_specs()))
+        if self.mode == PARTIAL:
+            merged.names = [lay.key_name(i) for i in range(lay.num_keys)] + \
+                [lay.buffer_name(j) for j in range(len(lay.flat))]
+            yield merged
+        elif lay.num_keys == 0 and merged.row_count == 0:
+            # global aggregation over zero rows still yields one row
+            yield self._empty_reduction().to_device()
+        else:
+            yield eval_exprs_tpu(lay.final_exprs(), merged)
+
+    def node_desc(self):
+        return "Tpu" + super().node_desc()
+
+
+def _tag_aggregate(meta) -> None:
+    """Rejects device-unsupported agg shapes (planner fallback instead of
+    wrong results — reference: GpuHashAggregateMeta.tagPlanForGpu)."""
+    lay = meta.plan.layout
+    for j, (_ai, spec) in enumerate(lay.flat):
+        dt = spec.dtype
+        if isinstance(dt, (T.StringType, T.BinaryType)) and \
+                spec.update_kind in ("min", "max"):
+            meta.will_not_work(f"min/max over strings not on device yet "
+                               f"(buffer {lay.buffer_name(j)})")
+        if isinstance(dt, T.DecimalType) and dt.is_decimal128:
+            meta.will_not_work(f"decimal128 aggregation buffer "
+                               f"{lay.buffer_name(j)} not on device yet")
+
+
+from spark_rapids_tpu.plan.overrides import register_exec  # noqa: E402
+
+register_exec(
+    CpuHashAggregateExec,
+    convert=lambda p, m: TpuHashAggregateExec(p.layout.grouping,
+                                              p.layout.aggs, p.mode,
+                                              p.children[0]),
+    exprs_of=lambda p: list(p.layout.grouping) +
+    [a.func for a in p.layout.aggs],
+    extra_tag=_tag_aggregate,
+    desc="hash aggregate (sort + segmented reduction)")

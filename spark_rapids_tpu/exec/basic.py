@@ -352,6 +352,37 @@ class TpuGlobalLimitExec(CpuGlobalLimitExec):
         return f"TpuGlobalLimit[{self.n}]"
 
 
+class CpuCoalescePartitionsExec(UnaryExec):
+    """Shuffle-free partition-count reduction: merges adjacent child
+    partitions (Spark coalesce() contract — never increases count, keeps
+    per-partition order, no data movement)."""
+
+    def __init__(self, n: int, child: Exec):
+        super().__init__(child)
+        self.n = n
+
+    @property
+    def num_partitions(self):
+        return max(1, min(self.n, self.child.num_partitions))
+
+    def execute_partition(self, pidx):
+        total = self.child.num_partitions
+        outs = self.num_partitions
+        per = -(-total // outs)
+        for cp in range(pidx * per, min((pidx + 1) * per, total)):
+            yield from self.child.execute_partition(cp)
+
+    def node_desc(self):
+        return f"CoalescePartitions[{self.num_partitions}]"
+
+
+class TpuCoalescePartitionsExec(CpuCoalescePartitionsExec):
+    is_device = True
+
+    def node_desc(self):
+        return f"TpuCoalescePartitions[{self.num_partitions}]"
+
+
 class CpuUnionExec(Exec):
     def __init__(self, children: Sequence[Exec]):
         super().__init__(children)

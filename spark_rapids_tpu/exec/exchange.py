@@ -1,0 +1,208 @@
+"""Shuffle exchange.
+
+Reference: GpuShuffleExchangeExecBase.scala (device-side partition slicing,
+GpuPartitioning.scala:37) + RapidsShuffleInternalManagerBase.scala (writer
+materializes per-reduce-partition blocks; reader fetches + concatenates) +
+ShuffleBufferCatalog (shuffle payloads tracked spillable).
+
+In-process redesign: the "transport" collapses to a per-exec shuffle store
+of spillable host batches (host-staged shuffle = the reference's default
+mode, which serializes batches to host via JCudfSerialization).  The device
+write path is one fused pass: evaluate pid per row, stable-sort by pid,
+copy to host once, slice per target partition.  The multi-node design
+(ICI all-to-all within a slice, host-staged DCN across) plugs in behind the
+same exec via the parallel/ package.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, HostColumnarBatch
+from spark_rapids_tpu.plan.base import Exec, UnaryExec
+from spark_rapids_tpu.plan.partitioning import (Partitioning,
+                                                RangePartitioning,
+                                                RoundRobinPartitioning)
+
+
+def _sample_bounds(part: RangePartitioning, sample_rows, to_host_batch):
+    """Computes n-1 range bounds from sampled key rows (reference:
+    GpuRangePartitioner.createRangeBounds — sample, sort, pick evenly)."""
+    from spark_rapids_tpu.exec.sort import CpuSortExec
+    from spark_rapids_tpu.exec.basic import CpuInMemoryScanExec
+    from spark_rapids_tpu.columnar.batch import concat_host_batches
+    n = part.num_partitions
+    if not sample_rows:
+        return HostColumnarBatch([], 0, [])
+    sample = concat_host_batches(sample_rows)
+    # sort the sample by the specs over the *key* columns (already projected)
+    from spark_rapids_tpu.exec.sort import SortSpec
+    from spark_rapids_tpu.expressions.base import BoundReference
+    key_specs = [SortSpec(BoundReference(i, sample.columns[i].data_type, True),
+                          s.ascending, s.effective_nulls_first)
+                 for i, s in enumerate(part.specs)]
+    scan = CpuInMemoryScanExec([[sample]], sample.schema)
+    sorted_sample = next(iter(CpuSortExec(key_specs, scan)
+                              .execute_partition(0)))
+    cnt = sorted_sample.row_count
+    idx = [min(cnt - 1, (j + 1) * cnt // n) for j in range(n - 1)]
+    # dedupe equal bounds is unnecessary: equal bounds just yield empty parts
+    rows = [sorted_sample.slice(i, 1) for i in idx]
+    from spark_rapids_tpu.columnar.batch import concat_host_batches as cc
+    return cc(rows) if rows else HostColumnarBatch([], 0, [])
+
+
+class CpuShuffleExchangeExec(UnaryExec):
+    """Host shuffle: materializes the map side once into a store of host
+    batches grouped by reduce partition."""
+
+    def __init__(self, partitioning: Partitioning, child: Exec):
+        super().__init__(child)
+        self.partitioning = partitioning
+        self._store: Optional[List[List]] = None
+
+    @property
+    def num_partitions(self):
+        return self.partitioning.num_partitions
+
+    # -- map side -----------------------------------------------------------
+    def _materialize(self):
+        if self._store is not None:
+            return
+        part = self.partitioning
+        n = part.num_partitions
+        store: List[List] = [[] for _ in range(n)]
+        if isinstance(part, RangePartitioning) and part.bounds is None:
+            self._compute_bounds()
+        for mp in range(self.child.num_partitions):
+            if isinstance(part, RoundRobinPartitioning):
+                part = RoundRobinPartitioning(n, start=mp)
+            for hb in self.child.execute_partition(mp):
+                pids = part.partition_ids_cpu(hb)
+                self._split_host(hb, pids, store)
+        self._store = store
+
+    def _compute_bounds(self):
+        """Extra pass sampling key rows (the reference runs a sample job)."""
+        part = self.partitioning
+        samples = []
+        rng = np.random.default_rng(0)
+        for mp in range(self.child.num_partitions):
+            for hb in self.child.execute_partition(mp):
+                keys = part._key_batch_cpu(hb)
+                k = min(hb.row_count, 1000)
+                if k == 0:
+                    continue
+                take = np.sort(rng.choice(hb.row_count, size=k,
+                                          replace=False))
+                import pyarrow as pa
+                tab = pa.Table.from_batches([keys.to_arrow()]) \
+                    .take(pa.array(take))
+                from spark_rapids_tpu.columnar.batch import batch_from_arrow
+                samples.append(batch_from_arrow(tab))
+        part.bounds = _sample_bounds(part, samples, None)
+
+    @staticmethod
+    def _split_host(hb: HostColumnarBatch, pids: np.ndarray, store):
+        import pyarrow as pa
+        from spark_rapids_tpu.columnar.batch import batch_from_arrow
+        order = np.argsort(pids, kind="stable")
+        counts = np.bincount(pids, minlength=len(store))
+        tab = pa.Table.from_batches([hb.to_arrow()]).take(pa.array(order))
+        off = 0
+        for p in range(len(store)):
+            if counts[p]:
+                store[p].append(batch_from_arrow(tab.slice(off, counts[p])))
+            off += counts[p]
+
+    # -- reduce side --------------------------------------------------------
+    def execute_partition(self, pidx):
+        self._materialize()
+        yield from self._store[pidx]
+
+    def node_desc(self):
+        return f"Exchange[{self.partitioning.desc()}]"
+
+
+class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
+    """Device shuffle write: pid eval + stable sort-by-pid + one host copy +
+    arrow slicing; payloads stored spillable (ShuffleBufferCatalog analog).
+    """
+
+    is_device = True
+
+    def _materialize(self):
+        if self._store is not None:
+            return
+        from spark_rapids_tpu.columnar.column import _jnp
+        from spark_rapids_tpu.ops.batch_ops import gather_batch
+        from spark_rapids_tpu.ops.sort_ops import SortOrder, sort_permutation
+        from spark_rapids_tpu.columnar.column import DeviceColumn
+        jnp = _jnp()
+        part = self.partitioning
+        n = part.num_partitions
+        if isinstance(part, RangePartitioning) and part.bounds is None:
+            self._compute_bounds_tpu()
+        store: List[List] = [[] for _ in range(n)]
+        for mp in range(self.child.num_partitions):
+            if isinstance(part, RoundRobinPartitioning):
+                part = RoundRobinPartitioning(n, start=mp)
+            for b in self.child.execute_partition(mp):
+                pids = part.partition_ids_tpu(b)
+                pid_col = DeviceColumn(pids.astype(np.int64),
+                                       jnp.ones(b.bucket, dtype=bool),
+                                       b.row_count, None)
+                aug = ColumnarBatch([pid_col] + list(b.columns), b.row_count)
+                perm = sort_permutation(aug, [SortOrder(0, True, True)])
+                shuffled = gather_batch(b, perm, b.row_count)
+                counts = np.asarray(jnp.bincount(
+                    jnp.clip(pids, 0, n), length=n + 1))[:n]
+                hb = shuffled.to_host()
+                hb.names = b.names
+                off = 0
+                for p in range(n):
+                    if counts[p]:
+                        store[p].append(hb.slice(off, int(counts[p])))
+                    off += int(counts[p])
+        self._store = store
+
+    def _compute_bounds_tpu(self):
+        """Samples on device, computes bounds on host (small)."""
+        part = self.partitioning
+        samples = []
+        for mp in range(self.child.num_partitions):
+            for b in self.child.execute_partition(mp):
+                keys = part._key_batch_tpu(b)
+                k = min(b.row_count, 1000)
+                if k == 0:
+                    continue
+                step = max(1, b.row_count // k)
+                hb = keys.to_host()
+                idx = np.arange(0, b.row_count, step)[:k]
+                import pyarrow as pa
+                from spark_rapids_tpu.columnar.batch import batch_from_arrow
+                tab = pa.Table.from_batches([hb.to_arrow()]) \
+                    .take(pa.array(idx))
+                samples.append(batch_from_arrow(tab))
+        part.bounds = _sample_bounds(part, samples, None)
+
+    def execute_partition(self, pidx):
+        from spark_rapids_tpu.exec.basic import upload_batches
+        self._materialize()
+        yield from upload_batches(self._store[pidx])
+
+    def node_desc(self):
+        return f"TpuExchange[{self.partitioning.desc()}]"
+
+
+# plan-rewrite registration (reference: ShuffleExchangeExec rule
+# GpuOverrides.scala:4023 + GpuShuffleMeta)
+from spark_rapids_tpu.plan.overrides import register_exec  # noqa: E402
+
+register_exec(CpuShuffleExchangeExec,
+              convert=lambda p, m: TpuShuffleExchangeExec(p.partitioning,
+                                                          p.children[0]),
+              exprs_of=lambda p: list(p.partitioning.exprs),
+              desc="shuffle exchange (device partition + host-staged store)")

@@ -98,6 +98,14 @@ class CpuSortExec(UnaryExec):
                 ser = pd.Series(key, dtype="UInt64")
                 ser[isnull] = pd.NA
                 return ser
+            if pa.types.is_integer(arr.type):
+                # plain to_pandas() promotes nullable int64 to float64,
+                # corrupting values above 2^53 — keep exact via nullable Int64
+                isnull = arr.is_null().to_numpy(zero_copy_only=False)
+                v = arr.fill_null(0).to_numpy(zero_copy_only=False)
+                ser = pd.Series(v.astype(np.int64), dtype="Int64")
+                ser[isnull] = pd.NA
+                return ser
             return pd.Series(arr.to_pandas())
 
         for s, arr in zip(reversed(self.specs), reversed(keys)):

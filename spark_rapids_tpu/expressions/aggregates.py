@@ -1,0 +1,288 @@
+"""Declarative aggregate functions.
+
+Reference: aggregate/aggregateFunctions.scala (2025 LoC) — each function
+declares input projections, an update aggregation, a merge aggregation, and
+a final ("evaluate") projection; GpuAggregateExec pipelines these through
+cuDF groupby.
+
+TPU-first redesign: every aggregate lowers to a small set of *segmented
+reduction kinds* (sum/min/max/first/last/count over sorted segments —
+ops/agg_ops.py) instead of cuDF's hash groupby.  A function contributes:
+
+- ``inputs()``: expressions evaluated against the child batch (pre-step)
+- ``buffers()``: (name, dtype, update_kind, merge_kind) partial columns
+- ``evaluate(refs)``: final expression over the merged buffers
+
+count/sum/avg/variance compose buffers algebraically (reference: e.g.
+GpuAverage = sum+count); min/max/first/last map 1:1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import (BoundReference, Expression,
+                                               Literal)
+
+
+@dataclasses.dataclass(frozen=True)
+class BufferSpec:
+    name: str
+    dtype: T.DataType
+    update_kind: str   # segmented reduction over the input column
+    merge_kind: str    # segmented reduction over partial buffers
+    input_ordinal: int = 0      # which of inputs() feeds the update
+    count_valid_only: bool = True
+
+
+class AggregateFunction(Expression):
+    """Base; children are the raw input expressions."""
+
+    is_aggregate = True
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def inputs(self) -> List[Expression]:
+        """Pre-step projections (default: the children)."""
+        return list(self.children)
+
+    def buffers(self) -> List[BufferSpec]:
+        raise NotImplementedError
+
+    def evaluate(self, refs: List[Expression]) -> Expression:
+        """Final projection over buffer refs (order matches buffers())."""
+        raise NotImplementedError
+
+    def sql(self):
+        args = ", ".join(c.sql() for c in self.children)
+        return f"{type(self).__name__.lower()}({args})"
+
+
+def _sum_result_type(dt: T.DataType) -> T.DataType:
+    if isinstance(dt, T.DecimalType):
+        return T.DecimalType(min(38, dt.precision + 10), dt.scale)
+    if isinstance(dt, (T.DoubleType, T.FloatType)):
+        return T.DOUBLE
+    return T.LONG
+
+
+class Sum(AggregateFunction):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return _sum_result_type(self.children[0].data_type)
+
+    def buffers(self):
+        return [BufferSpec("sum", self.data_type, "sum", "sum"),
+                BufferSpec("cnt", T.LONG, "count", "sum")]
+
+    def evaluate(self, refs):
+        # Spark: sum of empty/all-null group is NULL, not 0
+        from spark_rapids_tpu.expressions.conditional import If
+        from spark_rapids_tpu.expressions.predicates import GreaterThan
+        return If(GreaterThan(refs[1], Literal(0, T.LONG)),
+                  refs[0], Literal(None, self.data_type))
+
+
+class Count(AggregateFunction):
+    """count(expr) — non-null count; count(lit(1)) == count(*)."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+    def buffers(self):
+        count_all = isinstance(self.children[0], Literal) and \
+            self.children[0].value is not None
+        return [BufferSpec("cnt", T.LONG, "count", "sum",
+                           count_valid_only=not count_all)]
+
+    def evaluate(self, refs):
+        return refs[0]
+
+
+class Min(AggregateFunction):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def buffers(self):
+        return [BufferSpec("min", self.data_type, "min", "min")]
+
+    def evaluate(self, refs):
+        return refs[0]
+
+
+class Max(AggregateFunction):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def buffers(self):
+        return [BufferSpec("max", self.data_type, "max", "max")]
+
+    def evaluate(self, refs):
+        return refs[0]
+
+
+class Average(AggregateFunction):
+    """reference: GpuAverage — sum+count buffers, final divide."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        dt = self.children[0].data_type
+        if isinstance(dt, T.DecimalType):
+            return T.DecimalType(min(38, dt.precision + 4),
+                                 min(dt.scale + 4, 38))
+        return T.DOUBLE
+
+    def inputs(self):
+        from spark_rapids_tpu.expressions.cast import Cast
+        dt = self.children[0].data_type
+        if isinstance(dt, T.DecimalType):
+            return [self.children[0]]
+        return [Cast(self.children[0], T.DOUBLE)]
+
+    def buffers(self):
+        sdt = T.DOUBLE if not isinstance(self.children[0].data_type,
+                                         T.DecimalType) else \
+            _sum_result_type(self.children[0].data_type)
+        return [BufferSpec("sum", sdt, "sum", "sum"),
+                BufferSpec("cnt", T.LONG, "count", "sum")]
+
+    def evaluate(self, refs):
+        from spark_rapids_tpu.expressions.arithmetic import Divide
+        from spark_rapids_tpu.expressions.cast import Cast
+        from spark_rapids_tpu.expressions.conditional import If
+        from spark_rapids_tpu.expressions.predicates import GreaterThan
+        div = Divide(Cast(refs[0], T.DOUBLE), Cast(refs[1], T.DOUBLE))
+        if isinstance(self.data_type, T.DecimalType):
+            div = Cast(div, self.data_type)
+        return If(GreaterThan(refs[1], Literal(0, T.LONG)),
+                  div, Literal(None, self.data_type))
+
+
+class First(AggregateFunction):
+    def __init__(self, child: Expression, ignore_nulls: bool = False):
+        super().__init__([child])
+        self.ignore_nulls = ignore_nulls
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def buffers(self):
+        kind = "first_valid" if self.ignore_nulls else "first"
+        return [BufferSpec("first", self.data_type, kind, kind)]
+
+    def evaluate(self, refs):
+        return refs[0]
+
+
+class Last(AggregateFunction):
+    def __init__(self, child: Expression, ignore_nulls: bool = False):
+        super().__init__([child])
+        self.ignore_nulls = ignore_nulls
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def buffers(self):
+        kind = "last_valid" if self.ignore_nulls else "last"
+        return [BufferSpec("last", self.data_type, kind, kind)]
+
+    def evaluate(self, refs):
+        return refs[0]
+
+
+class _CentralMoment(AggregateFunction):
+    """Variance family via (count, mean, M2) — numerically-stable merge
+    (Chan et al.), the same decomposition cuDF's groupby VAR/STD uses."""
+
+    ddof = 1
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def inputs(self):
+        from spark_rapids_tpu.expressions.cast import Cast
+        return [Cast(self.children[0], T.DOUBLE)]
+
+    def buffers(self):
+        # m2 update/merge are special kinds handled by the kernel
+        return [BufferSpec("cnt", T.DOUBLE, "count", "m2_cnt"),
+                BufferSpec("mean", T.DOUBLE, "mean", "m2_mean"),
+                BufferSpec("m2", T.DOUBLE, "m2", "m2_m2")]
+
+    def _final(self, refs):
+        raise NotImplementedError
+
+    def evaluate(self, refs):
+        return self._final(refs)
+
+
+class VarianceSamp(_CentralMoment):
+    def _final(self, refs):
+        from spark_rapids_tpu.expressions.arithmetic import Divide, Subtract
+        from spark_rapids_tpu.expressions.conditional import If
+        from spark_rapids_tpu.expressions.predicates import GreaterThan
+        n, m2 = refs[0], refs[2]
+        return If(GreaterThan(n, Literal(1.0, T.DOUBLE)),
+                  Divide(m2, Subtract(n, Literal(1.0, T.DOUBLE))),
+                  Literal(None, T.DOUBLE))
+
+
+class VariancePop(_CentralMoment):
+    def _final(self, refs):
+        from spark_rapids_tpu.expressions.arithmetic import Divide
+        from spark_rapids_tpu.expressions.conditional import If
+        from spark_rapids_tpu.expressions.predicates import GreaterThan
+        n, m2 = refs[0], refs[2]
+        return If(GreaterThan(n, Literal(0.0, T.DOUBLE)),
+                  Divide(m2, n), Literal(None, T.DOUBLE))
+
+
+class StddevSamp(_CentralMoment):
+    def _final(self, refs):
+        from spark_rapids_tpu.expressions.mathexprs import Sqrt
+        return Sqrt(VarianceSamp(self.children[0])._final(refs))
+
+
+class StddevPop(_CentralMoment):
+    def _final(self, refs):
+        from spark_rapids_tpu.expressions.mathexprs import Sqrt
+        return Sqrt(VariancePop(self.children[0])._final(refs))
+
+
+@dataclasses.dataclass
+class AggregateExpression:
+    """An aggregate + its output name (Alias analog for agg results)."""
+    func: AggregateFunction
+    out_name: str

@@ -122,6 +122,9 @@ class Expression:
         node.children = list(children)
         return node
 
+    def alias(self, name: str) -> "Alias":
+        return Alias(self, name)
+
     def collect(self, pred) -> List["Expression"]:
         out = [self] if pred(self) else []
         for c in self.children:

@@ -1,0 +1,94 @@
+"""User-facing expression constructors (pyspark.sql.functions analog).
+
+The reference exposes Spark's own function surface; this module is the
+standalone equivalent for our DataFrame API.  Grows with each expression /
+aggregate milestone.
+"""
+
+from __future__ import annotations
+
+from spark_rapids_tpu.expressions.base import (Alias, Expression,  # noqa: F401
+                                               col, lit)
+
+
+def _expr(e) -> Expression:
+    if isinstance(e, Expression):
+        return e
+    if isinstance(e, str):
+        return col(e)
+    return lit(e)
+
+
+def asc(e, nulls_first: bool = True):
+    from spark_rapids_tpu.exec.sort import SortSpec
+    return SortSpec(_expr(e), True, nulls_first)
+
+
+def desc(e, nulls_first: bool = False):
+    from spark_rapids_tpu.exec.sort import SortSpec
+    return SortSpec(_expr(e), False, nulls_first)
+
+
+# -- aggregates --------------------------------------------------------------
+
+def sum(e):  # noqa: A001 - mirrors pyspark.sql.functions naming
+    from spark_rapids_tpu.expressions.aggregates import Sum
+    return Sum(_expr(e))
+
+
+def count(e="*"):
+    from spark_rapids_tpu.expressions.aggregates import Count
+    if e == "*":
+        return Count(lit(1))
+    return Count(_expr(e))
+
+
+def min(e):  # noqa: A001
+    from spark_rapids_tpu.expressions.aggregates import Min
+    return Min(_expr(e))
+
+
+def max(e):  # noqa: A001
+    from spark_rapids_tpu.expressions.aggregates import Max
+    return Max(_expr(e))
+
+
+def avg(e):
+    from spark_rapids_tpu.expressions.aggregates import Average
+    return Average(_expr(e))
+
+
+mean = avg
+
+
+def first(e, ignore_nulls=False):
+    from spark_rapids_tpu.expressions.aggregates import First
+    return First(_expr(e), ignore_nulls)
+
+
+def last(e, ignore_nulls=False):
+    from spark_rapids_tpu.expressions.aggregates import Last
+    return Last(_expr(e), ignore_nulls)
+
+
+def var_samp(e):
+    from spark_rapids_tpu.expressions.aggregates import VarianceSamp
+    return VarianceSamp(_expr(e))
+
+
+def var_pop(e):
+    from spark_rapids_tpu.expressions.aggregates import VariancePop
+    return VariancePop(_expr(e))
+
+
+def stddev(e):
+    from spark_rapids_tpu.expressions.aggregates import StddevSamp
+    return StddevSamp(_expr(e))
+
+
+stddev_samp = stddev
+
+
+def stddev_pop(e):
+    from spark_rapids_tpu.expressions.aggregates import StddevPop
+    return StddevPop(_expr(e))

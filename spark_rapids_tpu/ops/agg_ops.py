@@ -1,0 +1,266 @@
+"""Segmented groupby kernels.
+
+Reference: GpuAggregateExec.scala AggHelper (:175) pipelines cuDF hash
+groupby.  TPU-first redesign: XLA has no hash tables but excels at sort +
+segmented reductions — groupby = stable sort by keys (ops/sort_ops), detect
+segment boundaries, ``jax.ops.segment_*`` with ``num_segments = bucket``
+(static shape; group count is the only host sync).  The whole
+sort+boundaries+N-reductions pipeline is one jitted program per
+(shapes, spec) signature.
+
+Reduction kinds (update & merge lower to the same set):
+  sum, count, min, max, first, last, first_valid, last_valid, mean, m2,
+  m2_cnt/m2_mean/m2_m2 (joint Chan-merge of variance partials)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+
+
+def _jx():
+    from spark_rapids_tpu.columnar.column import _jnp
+    return _jnp()
+
+
+_AGG_CACHE: Dict[Tuple, object] = {}
+
+
+def _col_sig(c: DeviceColumn) -> Tuple:
+    return (str(c.data.dtype), tuple(c.data.shape), c.lengths is not None)
+
+
+def _masked_group_words(col: DeviceColumn, jnp) -> List:
+    """Words where equal-group rows compare equal: nulls grouped together
+    (rank word) with data masked to 0 so null garbage doesn't split groups."""
+    from spark_rapids_tpu.ops.sort_ops import sortable_words
+    words = [col.validity.astype(np.int8)]
+    for w in sortable_words(col, jnp):
+        if w.ndim == 1:
+            words.append(jnp.where(col.validity, w, jnp.zeros_like(w)))
+        else:
+            words.append(jnp.where(col.validity[:, None], w,
+                                   jnp.zeros_like(w)))
+    return words
+
+
+def _segment_reduce(kind: str, x, valid, seg, inrow, bucket, jnp,
+                    count_valid_only=True):
+    """One reduction -> (data[bucket], valid[bucket]) per segment id."""
+    import jax
+    present = valid & inrow
+    any_valid = jax.ops.segment_max(present.astype(np.int32), seg,
+                                    num_segments=bucket) > 0
+    if kind == "count":
+        src = present if count_valid_only else inrow
+        cnt = jax.ops.segment_sum(src.astype(np.int64), seg,
+                                  num_segments=bucket)
+        return cnt, jnp.ones(bucket, dtype=bool)
+    if kind == "sum":
+        z = jnp.where(present, x, jnp.zeros_like(x))
+        return jax.ops.segment_sum(z, seg, num_segments=bucket), any_valid
+    if kind in ("min", "max"):
+        if jnp.issubdtype(x.dtype, jnp.inexact):
+            ident = jnp.asarray(np.inf if kind == "min" else -np.inf, x.dtype)
+        else:
+            info = jnp.iinfo(x.dtype)
+            ident = jnp.asarray(info.max if kind == "min" else info.min,
+                                x.dtype)
+        z = jnp.where(present, x, ident)
+        f = jax.ops.segment_min if kind == "min" else jax.ops.segment_max
+        return f(z, seg, num_segments=bucket), any_valid
+    if kind in ("first", "last", "first_valid", "last_valid"):
+        want_valid = kind.endswith("_valid")
+        cond = present if want_valid else inrow
+        pos = jnp.arange(x.shape[0], dtype=np.int64)
+        if kind.startswith("first"):
+            p = jnp.where(cond, pos, x.shape[0])
+            idx = jax.ops.segment_min(p, seg, num_segments=bucket)
+            found = idx < x.shape[0]
+        else:
+            p = jnp.where(cond, pos, -1)
+            idx = jax.ops.segment_max(p, seg, num_segments=bucket)
+            found = idx >= 0
+        safe = jnp.clip(idx, 0, x.shape[0] - 1)
+        data = jnp.take(x, safe, axis=0)
+        v = found & jnp.take(valid, safe, axis=0)
+        return data, v
+    if kind == "mean":
+        z = jnp.where(present, x, jnp.zeros_like(x))
+        s = jax.ops.segment_sum(z, seg, num_segments=bucket)
+        n = jax.ops.segment_sum(present.astype(x.dtype), seg,
+                                num_segments=bucket)
+        return jnp.where(n > 0, s / jnp.where(n > 0, n, 1), 0.0), any_valid
+    raise ValueError(f"unknown reduction kind {kind!r}")
+
+
+def _lengths_reduce(kind, col, valid, seg, inrow, bucket, jnp):
+    """first/last variants for string columns carry data+lengths."""
+    import jax
+    want_valid = kind.endswith("_valid")
+    present = col.validity & inrow
+    cond = present if want_valid else inrow
+    pos = jnp.arange(col.data.shape[0], dtype=np.int64)
+    if kind.startswith("first"):
+        p = jnp.where(cond, pos, col.data.shape[0])
+        idx = jax.ops.segment_min(p, seg, num_segments=bucket)
+        found = idx < col.data.shape[0]
+    else:
+        p = jnp.where(cond, pos, -1)
+        idx = jax.ops.segment_max(p, seg, num_segments=bucket)
+        found = idx >= 0
+    safe = jnp.clip(idx, 0, col.data.shape[0] - 1)
+    data = jnp.take(col.data, safe, axis=0)
+    lens = jnp.take(col.lengths, safe, axis=0)
+    v = found & jnp.take(col.validity, safe, axis=0)
+    return data, v, lens
+
+
+def segmented_aggregate(batch: ColumnarBatch, num_keys: int,
+                        specs: Sequence[Tuple[int, str, bool, T.DataType]],
+                        ) -> ColumnarBatch:
+    """Groups ``batch`` by its first ``num_keys`` columns and reduces the
+    remaining columns per ``specs``: (value_ordinal, kind, count_valid_only,
+    out_dtype).  Returns keys+results, one row per group.
+
+    The full pipeline (sort, boundaries, reductions) is one jit per
+    signature; only the group count syncs to host.
+    """
+    import jax
+    jnp = _jx()
+    from spark_rapids_tpu.ops.sort_ops import SortOrder, sortable_words
+    bucket = batch.bucket
+    spec_key = tuple((o, k, cv, str(dt)) for o, k, cv, dt in specs)
+    key = ("segagg", tuple(_col_sig(c) for c in batch.columns), num_keys,
+           spec_key)
+    fn = _AGG_CACHE.get(key)
+    if fn is None:
+        orders = [SortOrder(i, True, True) for i in range(num_keys)]
+        # capture only scalars/types, never the batch (module-cache pinning)
+        dtypes = [c.data_type for c in batch.columns]
+
+        def run(arrs, row_count):
+            from spark_rapids_tpu.ops.sort_ops import _order_words
+            cols = [DeviceColumn(d, v, bucket, dtypes[i], ln)
+                    for i, (d, v, ln) in enumerate(arrs)]
+            rowpos = jnp.arange(bucket, dtype=np.int32)
+            inrow = rowpos < row_count
+            # ---- sort by keys (padding last) ----
+            words = [(~inrow).astype(np.int8)]
+            for o in orders:
+                words.extend(_order_words(cols[o.ordinal], o, jnp))
+            perm = jax.lax.sort(tuple(words) + (rowpos,),
+                                num_keys=len(words), is_stable=True)[-1]
+            scols = []
+            for c in cols:
+                d = jnp.take(c.data, perm, axis=0)
+                v = jnp.take(c.validity, perm, axis=0)
+                ln = None if c.lengths is None else jnp.take(c.lengths, perm,
+                                                             axis=0)
+                scols.append(DeviceColumn(d, v, bucket, c.data_type, ln))
+            inrow_s = jnp.take(inrow, perm, axis=0)  # still a prefix
+            # ---- segment boundaries over masked key words ----
+            boundary = jnp.zeros(bucket, dtype=bool).at[0].set(True)
+            for kcol in scols[:num_keys]:
+                for w in _masked_group_words(kcol, jnp):
+                    if w.ndim == 1:
+                        diff = w[1:] != w[:-1]
+                    else:
+                        diff = jnp.any(w[1:] != w[:-1], axis=-1)
+                    boundary = boundary.at[1:].max(diff)
+            # first padding row opens its own (discarded) segment
+            boundary = boundary | (rowpos == row_count)
+            seg = jnp.cumsum(boundary.astype(np.int32)) - 1
+            num_groups = jnp.max(jnp.where(inrow_s, seg, -1)) + 1
+            # ---- unique keys: value at each segment's first row ----
+            outs = []
+            first_pos = jax.ops.segment_min(
+                jnp.where(inrow_s, rowpos.astype(np.int64), bucket), seg,
+                num_segments=bucket)
+            safe_first = jnp.clip(first_pos, 0, bucket - 1)
+            gvalid = jnp.arange(bucket) < num_groups
+            for kcol in scols[:num_keys]:
+                d = jnp.take(kcol.data, safe_first, axis=0)
+                v = jnp.take(kcol.validity, safe_first, axis=0) & gvalid
+                ln = None if kcol.lengths is None else \
+                    jnp.take(kcol.lengths, safe_first, axis=0)
+                outs.append((d, v, ln))
+            # ---- reductions ----
+            i = 0
+            while i < len(specs):
+                o, kind, cvo, _dt = specs[i]
+                c = scols[o]
+                if kind == "m2_cnt":
+                    # joint Chan merge over partial (cnt, mean, m2) triples
+                    oc, om, o2 = specs[i][0], specs[i + 1][0], specs[i + 2][0]
+                    cnt_c, mean_c, m2_c = scols[oc], scols[om], scols[o2]
+                    pres = cnt_c.validity & inrow_s
+                    n_i = jnp.where(pres, cnt_c.data, 0.0)
+                    mu_i = jnp.where(pres, mean_c.data, 0.0)
+                    m2_i = jnp.where(pres, m2_c.data, 0.0)
+                    tot = jax.ops.segment_sum(n_i, seg, num_segments=bucket)
+                    wsum = jax.ops.segment_sum(n_i * mu_i, seg,
+                                               num_segments=bucket)
+                    mu = jnp.where(tot > 0, wsum / jnp.where(tot > 0, tot, 1),
+                                   0.0)
+                    dev = mu_i - jnp.take(mu, seg)
+                    m2 = jax.ops.segment_sum(m2_i + n_i * dev * dev, seg,
+                                             num_segments=bucket)
+                    ok = jnp.ones(bucket, dtype=bool)
+                    outs.append((tot, ok, None))
+                    outs.append((mu, ok, None))
+                    outs.append((m2, ok, None))
+                    i += 3
+                    continue
+                if kind == "m2":
+                    # update: needs this input's per-segment mean first
+                    x = c.data
+                    pres = c.validity & inrow_s
+                    z = jnp.where(pres, x, 0.0)
+                    n = jax.ops.segment_sum(pres.astype(x.dtype), seg,
+                                            num_segments=bucket)
+                    s = jax.ops.segment_sum(z, seg, num_segments=bucket)
+                    mu = jnp.where(n > 0, s / jnp.where(n > 0, n, 1), 0.0)
+                    d = jnp.where(pres, x - jnp.take(mu, seg), 0.0)
+                    m2 = jax.ops.segment_sum(d * d, seg, num_segments=bucket)
+                    outs.append((m2, jnp.ones(bucket, dtype=bool), None))
+                    i += 1
+                    continue
+                if c.lengths is not None and kind != "count":
+                    d, v, ln = _lengths_reduce(kind, c, c.validity, seg,
+                                               inrow_s, bucket, jnp)
+                    outs.append((d, v, ln))
+                else:
+                    d, v = _segment_reduce(kind, c.data, c.validity, seg,
+                                           inrow_s, bucket, jnp,
+                                           count_valid_only=cvo)
+                    outs.append((d, v, None))
+                i += 1
+            return outs, num_groups
+
+        fn = jax.jit(run)
+        _AGG_CACHE[key] = fn
+    arrs = [(c.data, c.validity, c.lengths) for c in batch.columns]
+    outs, ng = fn(arrs, batch.row_count)
+    n = int(ng)
+    names = (batch.names or [f"c{i}" for i in range(batch.num_columns)])
+    out_names = names[:num_keys] + [f"a{j}" for j in range(len(specs))]
+    cols = []
+    jnp = _jx()
+    for j, (d, v, ln) in enumerate(outs):
+        if j < num_keys:
+            dt = batch.columns[j].data_type
+        else:
+            dt = specs[j - num_keys][3]
+            if ln is None and dt.np_dtype is not None and \
+                    d.dtype != np.dtype(dt.np_dtype):
+                d = d.astype(dt.np_dtype)
+        gvalid = jnp.arange(d.shape[0]) < n
+        cols.append(DeviceColumn(d, v & gvalid, n, dt, ln))
+    return ColumnarBatch(cols, n, out_names)

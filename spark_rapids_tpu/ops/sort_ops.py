@@ -58,7 +58,7 @@ class SortOrder:
         return SortOrder(ordinal, False, False)
 
 
-def _float_sortable(x, jnp, bits_dtype, ubits_dtype):
+def _float_sortable(x, jnp, ubits_dtype):
     import jax
     # canonicalize: -0.0 -> 0.0, NaN -> positive canonical NaN
     zero = jnp.asarray(0, dtype=x.dtype)
@@ -106,9 +106,9 @@ def sortable_words(col: DeviceColumn, jnp) -> List:
         lo = jax.lax.bitcast_convert_type(col.data[:, 1], np.uint64)
         return [hi, lo]
     if isinstance(dt, T.FloatType):
-        return [_float_sortable(col.data, jnp, np.int32, np.uint32)]
+        return [_float_sortable(col.data, jnp, np.uint32)]
     if isinstance(dt, T.DoubleType):
-        return [_float_sortable(col.data, jnp, np.int64, np.uint64)]
+        return [_float_sortable(col.data, jnp, np.uint64)]
     if isinstance(dt, T.BooleanType):
         return [col.data.astype(np.int8)]
     # integral / date / timestamp / decimal64: native integer order
@@ -144,9 +144,12 @@ def sort_permutation(batch: ColumnarBatch, orders: Sequence[SortOrder]):
     fn = _SORT_CACHE.get(key)
     if fn is None:
         bucket = batch.bucket
+        # capture only scalars/types, never the batch itself: the jitted
+        # closure lives in the module cache and would pin device buffers
+        dtypes = [c.data_type for c in batch.columns]
 
         def run(arrs, row_count):
-            cols = [DeviceColumn(d, v, bucket, batch.columns[i].data_type, ln)
+            cols = [DeviceColumn(d, v, bucket, dtypes[i], ln)
                     for i, (d, v, ln) in enumerate(arrs)]
             rowpos = jnp.arange(bucket, dtype=np.int32)
             words = [(rowpos >= row_count).astype(np.int8)]  # padding last

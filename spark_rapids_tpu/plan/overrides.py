@@ -128,6 +128,15 @@ register_expr(H.Murmur3Hash, TS.ALL_BASIC)
 register_expr(H.XxHash64, TS.ALL_BASIC,
               extra_tag=lambda m: None)
 
+# aggregate functions (reference: GpuOverrides aggExprs — Sum/Count/Min/Max/
+# Average/First/Last/StddevSamp/... registrations)
+from spark_rapids_tpu.expressions import aggregates as AG  # noqa: E402
+
+for _cls in (AG.Sum, AG.Count, AG.Min, AG.Max, AG.Average, AG.First,
+             AG.Last, AG.VarianceSamp, AG.VariancePop, AG.StddevSamp,
+             AG.StddevPop):
+    register_expr(_cls, TS.ALL_BASIC)
+
 
 # ---------------------------------------------------------------------------
 # Exec registrations (reference: commonExecs GpuOverrides.scala:3999-4311)
@@ -154,6 +163,10 @@ def _register_basic_execs():
     register_exec(X.CpuLimitExec,
                   convert=lambda p, m: X.TpuLimitExec(p.n, p.children[0]),
                   desc="limit")
+    register_exec(X.CpuCoalescePartitionsExec,
+                  convert=lambda p, m: X.TpuCoalescePartitionsExec(
+                      p.n, p.children[0]),
+                  desc="shuffle-free partition merge")
     register_exec(X.CpuGlobalLimitExec,
                   convert=lambda p, m: X.TpuGlobalLimitExec(p.n,
                                                             p.children[0]),
@@ -248,16 +261,19 @@ class TpuOverrides:
 
     def _coalesce_after_device_sources(self, plan: Exec) -> Exec:
         """Insert batch coalescing where ops want bigger batches
-        (reference: GpuTransitionOverrides insertCoalesce per CoalesceGoal)."""
+        (reference: GpuTransitionOverrides insertCoalesce per CoalesceGoal;
+        post-shuffle coalesce = GpuShuffleCoalesceExec :519)."""
         from spark_rapids_tpu.exec.basic import (HostToDeviceExec,
                                                  TpuCoalesceBatchesExec)
+        from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
         target = self.conf.batch_size_bytes
 
         def fix(node: Exec) -> Exec:
             # put a coalesce above any host->device boundary feeding compute
             new_children = []
             for c in node.children:
-                if isinstance(c, HostToDeviceExec) and node.is_device and \
+                if isinstance(c, (HostToDeviceExec, TpuShuffleExchangeExec)) \
+                        and node.is_device and \
                         not isinstance(node, TpuCoalesceBatchesExec):
                     c = TpuCoalesceBatchesExec(c, target)
                 new_children.append(c)

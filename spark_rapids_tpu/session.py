@@ -167,6 +167,75 @@ class DataFrame:
         return DataFrame(CpuSampleExec(fraction, seed, self._plan),
                          self._session)
 
+    def repartition(self, n: int, *cols) -> "DataFrame":
+        """Round-robin repartition, or hash repartition when keys given."""
+        from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
+        from spark_rapids_tpu.plan.partitioning import (HashPartitioning,
+                                                        RoundRobinPartitioning)
+        if cols:
+            keys = [bind_references(_to_expr(c), self.schema) for c in cols]
+            part = HashPartitioning(keys, n)
+        else:
+            part = RoundRobinPartitioning(n)
+        return DataFrame(CpuShuffleExchangeExec(part, self._plan),
+                         self._session)
+
+    def coalesce(self, n: int) -> "DataFrame":
+        """Shuffle-free partition merge (Spark coalesce contract)."""
+        from spark_rapids_tpu.exec.basic import CpuCoalescePartitionsExec
+        return DataFrame(CpuCoalescePartitionsExec(n, self._plan),
+                         self._session)
+
+    def _sort_specs(self, cols, kw_ascending):
+        from spark_rapids_tpu.exec.sort import SortSpec
+        specs = []
+        for c in cols:
+            if isinstance(c, SortSpec):
+                specs.append(SortSpec(
+                    bind_references(c.expr, self.schema), c.ascending,
+                    c.nulls_first))
+            else:
+                specs.append(SortSpec(
+                    bind_references(_to_expr(c), self.schema), kw_ascending))
+        return specs
+
+    def order_by(self, *cols, ascending: bool = True) -> "DataFrame":
+        """Global total-order sort: range-partition then per-partition sort
+        (Spark SortExec(global=true) over RangePartitioning)."""
+        from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
+        from spark_rapids_tpu.exec.sort import CpuSortExec
+        from spark_rapids_tpu.plan.partitioning import RangePartitioning
+        specs = self._sort_specs(cols, ascending)
+        plan = self._plan
+        if plan.num_partitions > 1:
+            plan = CpuShuffleExchangeExec(
+                RangePartitioning(specs, plan.num_partitions), plan)
+        return DataFrame(CpuSortExec(specs, plan, global_sort=True),
+                         self._session)
+
+    sort = order_by
+
+    def sort_within_partitions(self, *cols, ascending: bool = True
+                               ) -> "DataFrame":
+        from spark_rapids_tpu.exec.sort import CpuSortExec
+        return DataFrame(CpuSortExec(self._sort_specs(cols, ascending),
+                                     self._plan), self._session)
+
+    def group_by(self, *cols) -> "GroupedData":
+        keys = [bind_references(_to_expr(c), self.schema) for c in cols]
+        return GroupedData(self, keys)
+
+    groupBy = group_by
+
+    def agg(self, *agg_exprs) -> "DataFrame":
+        """Global aggregation (no grouping keys)."""
+        return GroupedData(self, []).agg(*agg_exprs)
+
+    def distinct(self) -> "DataFrame":
+        return self.group_by(*self.columns).agg()
+
+    drop_duplicates = distinct
+
     # -- actions ------------------------------------------------------------
     def _executed_plan(self) -> Exec:
         overrides = TpuOverrides(self._session.conf)
@@ -216,3 +285,79 @@ class DataFrame:
 
     def __repr__(self):
         return f"DataFrame[{self.schema.simple_name}]"
+
+
+class GroupedData:
+    """df.group_by(keys) -> .agg(...); assembles the two-stage physical
+    aggregation (partial -> hash exchange -> final), Spark's
+    EnsureRequirements pattern for aggregation."""
+
+    def __init__(self, df: DataFrame, keys):
+        self._df = df
+        self._keys = keys
+
+    def agg(self, *agg_exprs) -> "DataFrame":
+        from spark_rapids_tpu.exec.aggregate import (COMPLETE, FINAL,
+                                                     PARTIAL,
+                                                     CpuHashAggregateExec)
+        from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
+        from spark_rapids_tpu.expressions.aggregates import (
+            AggregateExpression, AggregateFunction)
+        from spark_rapids_tpu.plan.partitioning import (HashPartitioning,
+                                                        SinglePartitioning)
+        schema = self._df.schema
+        aggs = []
+        for e in agg_exprs:
+            name = None
+            if isinstance(e, Alias):
+                name, e = e.alias_name, e.children[0]
+            if not isinstance(e, AggregateFunction):
+                raise TypeError(f"not an aggregate expression: {e}")
+            e = bind_references(e, schema)
+            aggs.append(AggregateExpression(e, name or e.sql()))
+        child = self._df._plan
+        if child.num_partitions == 1:
+            plan = CpuHashAggregateExec(self._keys, aggs, COMPLETE, child)
+        else:
+            partial = CpuHashAggregateExec(self._keys, aggs, PARTIAL, child)
+            nk = len(self._keys)
+            if nk:
+                key_refs = [_bound_ref(i, partial.schema) for i in range(nk)]
+                part = HashPartitioning(key_refs, child.num_partitions)
+            else:
+                part = SinglePartitioning()
+            exchange = CpuShuffleExchangeExec(part, partial)
+            final_keys = [_bound_ref(i, partial.schema) for i in range(nk)]
+            plan = CpuHashAggregateExec(final_keys, aggs, FINAL, exchange)
+        return DataFrame(plan, self._df._session)
+
+    # sugar
+    def count(self) -> "DataFrame":
+        from spark_rapids_tpu.expressions.aggregates import Count
+        return self.agg(Alias(Count(lit(1)), "count"))
+
+    def sum(self, *cols) -> "DataFrame":
+        from spark_rapids_tpu.expressions.aggregates import Sum
+        return self.agg(*[Alias(Sum(_to_expr(c)), f"sum({c})")
+                          for c in cols])
+
+    def avg(self, *cols) -> "DataFrame":
+        from spark_rapids_tpu.expressions.aggregates import Average
+        return self.agg(*[Alias(Average(_to_expr(c)), f"avg({c})")
+                          for c in cols])
+
+    def min(self, *cols) -> "DataFrame":
+        from spark_rapids_tpu.expressions.aggregates import Min
+        return self.agg(*[Alias(Min(_to_expr(c)), f"min({c})")
+                          for c in cols])
+
+    def max(self, *cols) -> "DataFrame":
+        from spark_rapids_tpu.expressions.aggregates import Max
+        return self.agg(*[Alias(Max(_to_expr(c)), f"max({c})")
+                          for c in cols])
+
+
+def _bound_ref(i: int, schema: T.StructType):
+    f = schema.fields[i]
+    from spark_rapids_tpu.expressions.base import BoundReference
+    return Alias(BoundReference(i, f.data_type, f.nullable), f.name)

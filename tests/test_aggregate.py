@@ -1,0 +1,165 @@
+"""Aggregation tests: differential CPU-vs-TPU (reference methodology) plus
+oracle checks against plain pandas groupby."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import functions as F
+from tests.asserts import (assert_tpu_and_cpu_are_equal_collect, cpu_session,
+                           tpu_session)
+
+
+def _df(s, n=20_000, parts=4, nkeys=37, with_nulls=True):
+    rng = np.random.default_rng(11)
+    k = rng.integers(0, nkeys, n)
+    v = rng.normal(size=n) * 10
+    i = rng.integers(-100, 100, n)
+    if with_nulls:
+        vmask = rng.random(n) < 0.1
+        varr = pa.array(np.where(vmask, np.nan, v), type=pa.float64(),
+                        mask=vmask)
+        imask = rng.random(n) < 0.1
+        iarr = pa.array(i, type=pa.int64(), mask=imask)
+    else:
+        varr, iarr = pa.array(v), pa.array(i)
+    tbl = pa.table({"k": pa.array(k), "v": varr, "i": iarr})
+    return s.create_dataframe(tbl, num_partitions=parts)
+
+
+@pytest.mark.parametrize("parts", [1, 4])
+def test_groupby_sum_count(parts):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, parts=parts).group_by("k").agg(
+            F.sum("v").alias("sv"), F.sum("i").alias("si"),
+            F.count("i").alias("ci"), F.count().alias("c")),
+        ignore_order=True)
+
+
+def test_groupby_min_max():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).group_by("k").agg(
+            F.min("v").alias("mnv"), F.max("v").alias("mxv"),
+            F.min("i").alias("mni"), F.max("i").alias("mxi")),
+        ignore_order=True)
+
+
+def test_groupby_avg():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).group_by("k").agg(F.avg("v").alias("av"),
+                                           F.avg("i").alias("ai")),
+        ignore_order=True)
+
+
+def test_groupby_variance_stddev():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).group_by("k").agg(
+            F.var_samp("v").alias("vs"), F.var_pop("v").alias("vp"),
+            F.stddev("v").alias("sd"), F.stddev_pop("v").alias("sp")),
+        ignore_order=True)
+
+
+def test_groupby_oracle_pandas():
+    """Cross-check against pandas (not just CPU engine)."""
+    s = tpu_session()
+    df = _df(s, with_nulls=False)
+    got = df.group_by("k").agg(F.sum("v").alias("sv"),
+                               F.count().alias("c")).to_pandas()
+    import pandas as pd
+    src = _df(cpu_session(), with_nulls=False).to_pandas()
+    exp = src.groupby("k").agg(sv=("v", "sum"), c=("v", "size")).reset_index()
+    got = got.sort_values("k").reset_index(drop=True)
+    exp = exp.sort_values("k").reset_index(drop=True)
+    assert (got["k"] == exp["k"]).all()
+    assert np.allclose(got["sv"], exp["sv"])
+    assert (got["c"] == exp["c"]).all()
+
+
+def test_global_agg():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).agg(F.sum("i").alias("si"),
+                             F.count().alias("c"),
+                             F.avg("v").alias("av")),
+        ignore_order=True)
+
+
+def test_global_agg_empty_input():
+    def f(s):
+        df = s.create_dataframe({"a": np.array([], dtype=np.int64)})
+        return df.agg(F.sum("a").alias("sa"), F.count("a").alias("ca"))
+    assert_tpu_and_cpu_are_equal_collect(f)
+
+
+def test_groupby_null_keys():
+    def f(s):
+        tbl = pa.table({
+            "k": pa.array([1, None, 2, None, 1, 2, None], type=pa.int64()),
+            "v": pa.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0, None]),
+        })
+        return s.create_dataframe(tbl, num_partitions=2) \
+            .group_by("k").agg(F.sum("v").alias("sv"),
+                               F.count("v").alias("cv"))
+    assert_tpu_and_cpu_are_equal_collect(f, ignore_order=True)
+
+
+def test_groupby_string_keys():
+    def f(s):
+        n = 5000
+        rng = np.random.default_rng(5)
+        ks = [f"key_{int(x)}" if x % 11 else None
+              for x in rng.integers(0, 40, n)]
+        tbl = pa.table({"k": pa.array(ks),
+                        "v": pa.array(rng.normal(size=n))})
+        return s.create_dataframe(tbl, num_partitions=3) \
+            .group_by("k").agg(F.sum("v").alias("sv"),
+                               F.count().alias("c"))
+    assert_tpu_and_cpu_are_equal_collect(f, ignore_order=True)
+
+
+def test_groupby_multiple_keys():
+    def f(s):
+        n = 8000
+        rng = np.random.default_rng(6)
+        tbl = pa.table({"a": pa.array(rng.integers(0, 8, n)),
+                        "b": pa.array(rng.integers(0, 7, n)),
+                        "v": pa.array(rng.normal(size=n))})
+        return s.create_dataframe(tbl, num_partitions=3) \
+            .group_by("a", "b").agg(F.sum("v").alias("sv"))
+    assert_tpu_and_cpu_are_equal_collect(f, ignore_order=True)
+
+
+def test_first_last_single_partition():
+    # order is deterministic only within one partition
+    def f(s):
+        tbl = pa.table({"k": pa.array([1, 1, 2, 2, 1]),
+                        "v": pa.array([None, 10, 20, None, 30],
+                                      type=pa.int64())})
+        return s.create_dataframe(tbl).group_by("k").agg(
+            F.first("v", ignore_nulls=True).alias("fv"),
+            F.last("v", ignore_nulls=True).alias("lv"))
+    assert_tpu_and_cpu_are_equal_collect(f, ignore_order=True)
+
+
+def test_distinct():
+    def f(s):
+        rng = np.random.default_rng(8)
+        tbl = pa.table({"a": pa.array(rng.integers(0, 10, 3000)),
+                        "b": pa.array(rng.integers(0, 5, 3000))})
+        return s.create_dataframe(tbl, num_partitions=4).distinct()
+    assert_tpu_and_cpu_are_equal_collect(f, ignore_order=True)
+
+
+def test_groupby_count_sugar():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).group_by("k").count(), ignore_order=True)
+
+
+def test_min_max_string_falls_back():
+    from tests.asserts import assert_tpu_fallback_collect
+
+    def f(s):
+        tbl = pa.table({"k": pa.array([1, 1, 2]),
+                        "s": pa.array(["b", "a", "c"])})
+        return s.create_dataframe(tbl).group_by("k").agg(
+            F.min("s").alias("mn"))
+    assert_tpu_fallback_collect(f, "CpuHashAggregateExec")

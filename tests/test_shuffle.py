@@ -1,0 +1,102 @@
+"""Shuffle exchange + partitioning tests (differential CPU vs TPU, the
+reference methodology; multi-partition placement correctness)."""
+
+import numpy as np
+import pytest
+
+from tests.asserts import (assert_tpu_and_cpu_are_equal_collect, cpu_session,
+                           tpu_session)
+
+
+def _df(s, n=10_000, parts=4):
+    rng = np.random.default_rng(3)
+    return s.create_dataframe(
+        {"k": rng.integers(0, 50, n), "v": rng.normal(size=n),
+         "s": [f"r{i % 97}" for i in range(n)]},
+        num_partitions=parts)
+
+
+def test_hash_repartition_preserves_rows():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).repartition(8, "k"), ignore_order=True)
+
+
+def test_hash_repartition_groups_keys_together():
+    s = tpu_session()
+    df = _df(s).repartition(8, "k")
+    from spark_rapids_tpu.plan.overrides import TpuOverrides
+    plan = TpuOverrides(s.conf).apply(df._plan)
+    seen = {}
+    for p in range(plan.num_partitions):
+        from spark_rapids_tpu.plan.base import run_task
+        for b in run_task(plan, p):
+            from spark_rapids_tpu.columnar.batch import ColumnarBatch
+            hb = b.to_host() if isinstance(b, ColumnarBatch) else b
+            for k in set(hb.to_pydict()["k"]):
+                assert seen.setdefault(k, p) == p, \
+                    f"key {k} split across partitions {seen[k]} and {p}"
+
+
+def test_round_robin_repartition():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).repartition(5), ignore_order=True)
+
+
+def test_coalesce_to_one():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).coalesce(1), ignore_order=True)
+
+
+def test_global_order_by_ints():
+    def f(s):
+        return _df(s).order_by("k")
+    cpu = f(cpu_session()).to_pydict()["k"]
+    tpu = f(tpu_session()).to_pydict()["k"]
+    assert cpu == sorted(cpu)
+    assert tpu == cpu
+
+
+def test_global_order_by_desc_strings():
+    from spark_rapids_tpu.functions import desc
+
+    def f(s):
+        return _df(s, n=3000).order_by(desc("s"))
+    cpu = f(cpu_session()).to_pydict()["s"]
+    tpu = f(tpu_session()).to_pydict()["s"]
+    assert cpu == sorted(cpu, reverse=True)
+    assert tpu == cpu
+
+
+def test_global_order_by_floats_with_secondary_key():
+    from spark_rapids_tpu.functions import asc, desc
+
+    def f(s):
+        return _df(s, n=5000, parts=3).order_by(asc("k"), desc("v"))
+    cpu = f(cpu_session()).collect()
+    tpu = f(tpu_session()).collect()
+    assert cpu == tpu
+
+
+def test_exchange_empty_input():
+    def f(s):
+        df = s.create_dataframe({"a": np.array([], dtype=np.int64)})
+        return df.repartition(4, "a")
+    assert_tpu_and_cpu_are_equal_collect(f, ignore_order=True)
+
+
+def test_order_by_single_partition_input():
+    def f(s):
+        return _df(s, n=500, parts=1).order_by("k")
+    cpu = f(cpu_session()).to_pydict()["k"]
+    tpu = f(tpu_session()).to_pydict()["k"]
+    assert tpu == cpu == sorted(cpu)
+
+
+def test_coalesce_is_shuffle_free_merge():
+    s = tpu_session()
+    df = _df(s, parts=8).coalesce(3)
+    assert df._plan.num_partitions == 3
+    # never increases the count (Spark contract)
+    assert _df(s, parts=2).coalesce(8)._plan.num_partitions == 2
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s2: _df(s2, parts=8).coalesce(3), ignore_order=True)

@@ -135,3 +135,15 @@ def test_sort_empty_and_single():
     scan = _scan({"a": np.array([7], dtype=np.int64)})
     out = _run_both(scan, [SortSpec(_ref(0), True)])
     assert out.to_pydict()["a"] == [7]
+
+
+def test_cpu_sort_large_int64_with_nulls():
+    # to_pandas float64 promotion would corrupt values above 2^53
+    big = 2**53
+    a = pa.array([big + 1, big, None, big + 3, big + 2], type=pa.int64())
+    tbl = pa.table({"a": a})
+    from spark_rapids_tpu.columnar.batch import batch_from_arrow
+    hb = batch_from_arrow(tbl)
+    scan = CpuInMemoryScanExec([[hb]], hb.schema)
+    out = _run_both(scan, [SortSpec(_ref(0), True)])
+    assert out.to_pydict()["a"] == [None, big, big + 1, big + 2, big + 3]
