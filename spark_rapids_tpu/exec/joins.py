@@ -1,0 +1,509 @@
+"""Join execs: shuffled hash join, broadcast hash join, nested-loop join.
+
+Reference: GpuShuffledHashJoinExec / GpuBroadcastHashJoinExecBase /
+GpuBroadcastNestedLoopJoinExec over the common core GpuHashJoin
+(org/apache/spark/sql/rapids/execution/GpuHashJoin.scala) + JoinGatherer.
+The reference streams the probe side against a built hash table and
+supports an extra non-equi ``condition`` evaluated per candidate pair (its
+AST path); ours evaluates the condition as a fused XLA program over the
+padded candidate-pair table (ops/join_ops.py).
+
+Structure per partition (TPU path):
+  build side  = concat of the build child's batches, sorted by key hash once
+  probe side  = streamed; per batch: candidate ranges -> pair expand+verify
+                -> optional condition -> finalize per join type
+  right/full outer: build-row matched flags accumulate across probe batches;
+                unmatched build rows are emitted after the stream drains
+                (correct per-partition because the shuffle hash-partitions
+                both sides by the same keys).
+
+Sort-merge join: not built — the reference itself prefers converting SMJ to
+shuffled hash join (GpuSortMergeJoinMeta.scala); we always plan hash joins.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import (ColumnarBatch, HostColumnarBatch,
+                                             batch_from_arrow,
+                                             concat_host_batches)
+from spark_rapids_tpu.expressions.base import EvalContext, Expression
+from spark_rapids_tpu.ops import join_ops as J
+from spark_rapids_tpu.plan.base import BinaryExec, Exec
+
+_PAIR_TYPES = (J.INNER, J.LEFT_OUTER, J.RIGHT_OUTER, J.FULL_OUTER, J.CROSS)
+
+
+def _normalize_how(how: str) -> str:
+    h = how.lower().replace("_", "").replace(" ", "")
+    return {
+        "inner": J.INNER,
+        "left": J.LEFT_OUTER, "leftouter": J.LEFT_OUTER,
+        "right": J.RIGHT_OUTER, "rightouter": J.RIGHT_OUTER,
+        "full": J.FULL_OUTER, "fullouter": J.FULL_OUTER, "outer": J.FULL_OUTER,
+        "semi": J.LEFT_SEMI, "leftsemi": J.LEFT_SEMI,
+        "anti": J.LEFT_ANTI, "leftanti": J.LEFT_ANTI,
+        "cross": J.CROSS,
+    }[h]
+
+
+class _JoinBase(BinaryExec):
+    """Shared schema/condition plumbing for all join execs."""
+
+    def __init__(self, left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression], join_type: str,
+                 condition: Optional[Expression], left: Exec, right: Exec,
+                 null_safe: Optional[Sequence[bool]] = None):
+        super().__init__(left, right)
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.join_type = join_type
+        self.condition = condition
+        self.null_safe = tuple(null_safe or [False] * len(self.left_keys))
+        if len(self.left_keys) != len(self.right_keys):
+            raise ValueError("left/right key counts differ")
+        for lk, rk in zip(self.left_keys, self.right_keys):
+            if str(lk.data_type) != str(rk.data_type):
+                raise ValueError(
+                    f"join key type mismatch: {lk.data_type} vs "
+                    f"{rk.data_type}; add explicit casts")
+
+    @property
+    def schema(self) -> T.StructType:
+        ls, rs = self.left.schema, self.right.schema
+        if self.join_type in (J.LEFT_SEMI, J.LEFT_ANTI):
+            return ls
+        lnull = self.join_type in (J.RIGHT_OUTER, J.FULL_OUTER)
+        rnull = self.join_type in (J.LEFT_OUTER, J.FULL_OUTER)
+        fields = [T.StructField(f.name, f.data_type, f.nullable or lnull)
+                  for f in ls.fields]
+        fields += [T.StructField(f.name, f.data_type, f.nullable or rnull)
+                   for f in rs.fields]
+        return T.StructType(fields)
+
+    @property
+    def _out_names(self) -> List[str]:
+        return self.schema.names
+
+    def node_desc(self):
+        keys = ", ".join(k.sql() for k in self.left_keys)
+        cond = f", cond={self.condition.sql()}" if self.condition is not None \
+            else ""
+        return (f"{self.name}[{self.join_type}, keys=[{keys}]{cond}]")
+
+
+# ---------------------------------------------------------------------------
+# CPU core (the differential oracle): arrow hash join for the pair set,
+# numpy for finalization
+# ---------------------------------------------------------------------------
+
+def _empty_host(schema: T.StructType) -> HostColumnarBatch:
+    import pyarrow as pa
+    arrays = [pa.array([], type=T.to_arrow(f.data_type))
+              for f in schema.fields]
+    return batch_from_arrow(pa.Table.from_arrays(arrays, names=schema.names))
+
+
+def _concat_or_empty(batches: List[HostColumnarBatch],
+                     schema: T.StructType) -> HostColumnarBatch:
+    batches = [b for b in batches if b.row_count > 0]
+    if not batches:
+        return _empty_host(schema)
+    return concat_host_batches(batches)
+
+
+def _encode_key_array(hc, null_safe: bool):
+    """HostColumn -> arrow array usable as an Acero hash-join key with Spark
+    match semantics (NaN==NaN, -0.0==0.0 via bit canonicalization)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    dt = hc.data_type
+    arr = hc.arrow
+    if isinstance(dt, (T.FloatType, T.DoubleType)):
+        bits = np.dtype(np.int32) if isinstance(dt, T.FloatType) \
+            else np.dtype(np.int64)
+        x = hc.data_np().copy()
+        x[x == 0] = 0.0                       # -0.0 -> 0.0
+        x[np.isnan(x)] = np.nan               # canonical NaN bits
+        arr = pa.array(x.view(bits), mask=~hc.validity_np())
+    if null_safe:
+        nulls = pc.is_null(arr)
+        if pa.types.is_string(arr.type) or pa.types.is_binary(arr.type):
+            filler = pa.scalar("", type=arr.type)
+        else:
+            filler = pa.scalar(0, type=pa.int8()).cast(arr.type)
+        return pc.coalesce(arr, filler), nulls
+    return arr, None
+
+
+def _cpu_key_pairs(lkb: HostColumnarBatch, rkb: HostColumnarBatch,
+                   null_safe: Tuple[bool, ...]):
+    """All key-equal (lidx, ridx) pairs via an arrow inner hash join."""
+    import pyarrow as pa
+    key_names: List[str] = []
+
+    def key_table(kb, idx_name):
+        arrays, names = [], []
+        for i, c in enumerate(kb.columns):
+            arr, nulls = _encode_key_array(c, null_safe[i])
+            arrays.append(arr)
+            names.append(f"k{i}")
+            if nulls is not None:
+                arrays.append(nulls)
+                names.append(f"k{i}n")
+        arrays.append(pa.array(np.arange(kb.row_count, dtype=np.int64)))
+        names.append(idx_name)
+        return pa.Table.from_arrays(arrays, names=names), \
+            [n for n in names if n != idx_name]
+
+    lt, key_names = key_table(lkb, "__lidx")
+    rt, _ = key_table(rkb, "__ridx")
+    joined = lt.join(rt, keys=key_names, join_type="inner")
+    lidx = joined.column("__lidx").to_numpy(zero_copy_only=False)
+    ridx = joined.column("__ridx").to_numpy(zero_copy_only=False)
+    return lidx.astype(np.int64), ridx.astype(np.int64)
+
+
+def _take_with_nulls(hb: HostColumnarBatch, idx: np.ndarray,
+                     names: List[str]):
+    """Arrow take where a negative index produces an all-null row."""
+    import pyarrow as pa
+    mask = idx < 0
+    safe = np.where(mask, 0, idx)
+    indices = pa.array(safe, mask=mask)
+    tab = pa.Table.from_arrays([c.arrow for c in hb.columns],
+                               names=[f"c{i}" for i in
+                                      range(hb.num_columns)])
+    taken = tab.take(indices)
+    cols = [batch_from_arrow(taken).columns[i]
+            for i in range(hb.num_columns)]
+    return cols
+
+
+def _cpu_assemble(left: HostColumnarBatch, right: HostColumnarBatch,
+                  lmap: np.ndarray, rmap: np.ndarray,
+                  names: List[str]) -> HostColumnarBatch:
+    cols = _take_with_nulls(left, lmap, names) + \
+        _take_with_nulls(right, rmap, names)
+    return HostColumnarBatch(cols, len(lmap), names)
+
+
+class _CpuJoinCore(_JoinBase):
+    """Join over fully-materialized host sides (per partition)."""
+
+    def _pair_condition_keep(self, left, right, lidx, ridx):
+        from spark_rapids_tpu.expressions.evaluator import host_batch_tcols
+        pair = _cpu_assemble(left, right, lidx, ridx,
+                             [f"p{i}" for i in
+                              range(left.num_columns + right.num_columns)])
+        cols = host_batch_tcols(pair)
+        ctx = EvalContext(cols, "cpu", pair.row_count)
+        pred = self.condition.eval_cpu(ctx)
+        if pred.is_scalar:
+            ok = bool(pred.valid) and bool(pred.data)
+            return np.full(len(lidx), ok)
+        keep = np.asarray(pred.data, dtype=bool) & np.asarray(pred.valid)
+        return keep[:len(lidx)]
+
+    def _join_host(self, left: HostColumnarBatch,
+                   right: HostColumnarBatch) -> HostColumnarBatch:
+        from spark_rapids_tpu.expressions.evaluator import eval_exprs_cpu
+        jt = self.join_type
+        nl, nr = left.row_count, right.row_count
+        if jt == J.CROSS or not self.left_keys:
+            lidx = np.repeat(np.arange(nl, dtype=np.int64), nr)
+            ridx = np.tile(np.arange(nr, dtype=np.int64), nl)
+        else:
+            lkb = eval_exprs_cpu(self.left_keys, left,
+                                 [f"k{i}" for i in
+                                  range(len(self.left_keys))])
+            rkb = eval_exprs_cpu(self.right_keys, right,
+                                 [f"k{i}" for i in
+                                  range(len(self.right_keys))])
+            lidx, ridx = _cpu_key_pairs(lkb, rkb, self.null_safe)
+        if self.condition is not None and len(lidx):
+            keep = self._pair_condition_keep(left, right, lidx, ridx)
+            lidx, ridx = lidx[keep], ridx[keep]
+        names = self._out_names
+        if jt in (J.INNER, J.CROSS):
+            return _cpu_assemble(left, right, lidx, ridx, names)
+        if jt in (J.LEFT_SEMI, J.LEFT_ANTI):
+            matched = np.zeros(nl, dtype=bool)
+            matched[lidx] = True
+            rows = np.flatnonzero(matched if jt == J.LEFT_SEMI else ~matched)
+            cols = _take_with_nulls(left, rows.astype(np.int64), names)
+            return HostColumnarBatch(cols, len(rows), names)
+        parts_l, parts_r = [lidx], [ridx]
+        if jt in (J.LEFT_OUTER, J.FULL_OUTER):
+            matched = np.zeros(nl, dtype=bool)
+            matched[lidx] = True
+            ul = np.flatnonzero(~matched).astype(np.int64)
+            parts_l.append(ul)
+            parts_r.append(np.full(len(ul), -1, dtype=np.int64))
+        if jt in (J.RIGHT_OUTER, J.FULL_OUTER):
+            matched = np.zeros(nr, dtype=bool)
+            matched[ridx] = True
+            ur = np.flatnonzero(~matched).astype(np.int64)
+            parts_l.append(np.full(len(ur), -1, dtype=np.int64))
+            parts_r.append(ur)
+        lmap = np.concatenate(parts_l)
+        rmap = np.concatenate(parts_r)
+        return _cpu_assemble(left, right, lmap, rmap, names)
+
+
+# ---------------------------------------------------------------------------
+# TPU core
+# ---------------------------------------------------------------------------
+
+def _empty_device(schema: T.StructType) -> ColumnarBatch:
+    return _empty_host(schema).to_device()
+
+
+class _TpuJoinCore(_JoinBase):
+    """Streamed probe vs built side on device (see module docstring)."""
+
+    is_device = True
+
+    def _augment_keys(self, batch: ColumnarBatch, keys) -> ColumnarBatch:
+        """Appends evaluated key columns; returns (augmented, ordinals)."""
+        from spark_rapids_tpu.expressions.evaluator import eval_exprs_tpu
+        if not keys:
+            return batch, ()
+        kb = eval_exprs_tpu(keys, batch)
+        aug = ColumnarBatch(list(batch.columns) + list(kb.columns),
+                            batch.row_count)
+        ords = tuple(range(batch.num_columns,
+                           batch.num_columns + len(keys)))
+        return aug, ords
+
+    def _condition_keep(self, probe_pay, build_pay, l_idx, r_idx, keep,
+                        pair_bucket):
+        """Applies the non-equi condition over the padded pair table."""
+        from spark_rapids_tpu.expressions.base import valid_array
+        from spark_rapids_tpu.expressions.evaluator import device_batch_tcols
+        pair = J.gather_join_output(probe_pay, build_pay, l_idx, r_idx,
+                                    pair_bucket)
+        cols = device_batch_tcols(pair)
+        ctx = EvalContext(cols, "tpu", pair.bucket)
+        pred = self.condition.eval_tpu(ctx)
+        ok = valid_array(pred, ctx)
+        if pred.is_scalar:
+            ok = ok & bool(pred.data)
+        else:
+            ok = ok & pred.data
+        # pair table rows map 1:1 to pair positions (same bucket)
+        return keep & ok[:keep.shape[0]]
+
+    def _join_device(self, probe_batches: Iterator[ColumnarBatch],
+                     build_batches: List[ColumnarBatch],
+                     build_cache: Optional[dict] = None):
+        """Yields output batches for one partition.  ``build_cache`` (dict)
+        carries the concatenated/keyed/sorted build side across calls —
+        broadcast joins pass a per-exec dict so the build work happens once
+        for all probe partitions."""
+        from spark_rapids_tpu.ops.batch_ops import concat_batches
+        jt = self.join_type
+        names = self._out_names
+        ls, rs = self.left.schema, self.right.schema
+        cache = build_cache if build_cache is not None else {}
+        use_hash = bool(self.left_keys) and jt != J.CROSS
+        if "build" in cache:
+            build, build_aug, build_ords = cache["build"]
+        else:
+            build_batches = [b for b in build_batches if b.row_count]
+            build = concat_batches(build_batches) if build_batches else \
+                _empty_device(rs)
+            build.names = None
+            build_aug, build_ords = (build, ())
+            if use_hash:
+                build_aug, build_ords = self._augment_keys(build,
+                                                           self.right_keys)
+            cache["build"] = (build, build_aug, build_ords)
+        # string-key word widths depend on the probe batch -> keyed sub-cache
+        built_by_widths = cache.setdefault("built_by_widths", {})
+        build_matched = None
+        semi_anti = jt in (J.LEFT_SEMI, J.LEFT_ANTI)
+        empty_right = ColumnarBatch([], 0) if semi_anti else None
+        for probe in probe_batches:
+            if probe.row_count == 0:
+                continue
+            if use_hash:
+                probe_aug, probe_ords = self._augment_keys(probe,
+                                                           self.left_keys)
+                pk = [probe_aug.columns[i] for i in probe_ords]
+                wkey = tuple(J._n_value_words(c) for c in pk)
+                built = built_by_widths.get(wkey)
+                if built is None:
+                    built = J.build_side(build_aug, build_ords, pk)
+                    built_by_widths[wkey] = built
+                lo, counts, offsets, total = J._probe_ranges(
+                    [probe_aug.columns[i] for i in probe_ords], built)
+                l_idx, r_idx, keep, pair_bucket = J._expand_verify(
+                    probe_aug, probe_ords, built, self.null_safe, lo,
+                    offsets, total)
+            else:
+                l_idx, r_idx, keep, pair_bucket = J.cross_pairs(probe, build)
+            probe_pay = probe
+            build_pay = build
+            if self.condition is not None:
+                keep = self._condition_keep(probe_pay, build_pay, l_idx,
+                                            r_idx, keep, pair_bucket)
+            if jt in (J.RIGHT_OUTER, J.FULL_OUTER):
+                bm = J.matched_flags(r_idx, keep, build.bucket)
+                build_matched = bm if build_matched is None \
+                    else build_matched | bm
+            if semi_anti:
+                flags = J.matched_flags(l_idx, keep, probe.bucket)
+                if jt == J.LEFT_ANTI:
+                    rows, n = J.unmatched_positions(flags, probe.row_count)
+                else:
+                    rows, n = J.unmatched_positions(~flags, probe.row_count)
+                rmap = np.full(n, -1, dtype=np.int64)
+                yield J.gather_join_output(probe_pay, empty_right,
+                                           np.asarray(rows)[:n], rmap, n,
+                                           names)
+                continue
+            l, r, n = J.compact_pairs(l_idx, r_idx, keep)
+            parts = [(l, r, n)]
+            if jt in (J.LEFT_OUTER, J.FULL_OUTER):
+                flags = J.matched_flags(l_idx, keep, probe.bucket)
+                ul, un = J.unmatched_positions(flags, probe.row_count)
+                parts.append((ul, np.full(un, -1, dtype=np.int64), un))
+            lmap, rmap, total_out = J.concat_index_maps(parts)
+            yield J.gather_join_output(probe_pay, build_pay, lmap, rmap,
+                                       total_out, names)
+        # outer-join: unmatched build rows after the probe stream drains
+        if jt in (J.RIGHT_OUTER, J.FULL_OUTER):
+            if build_matched is None:
+                from spark_rapids_tpu.columnar.column import _jnp
+                jnp = _jnp()
+                build_matched = jnp.zeros(build.bucket, dtype=bool)
+            ub, un = J.unmatched_positions(build_matched, build.row_count)
+            if un:
+                probe_empty = _empty_device(ls)
+                lmap = np.full(un, -1, dtype=np.int64)
+                yield J.gather_join_output(probe_empty, build, lmap,
+                                           np.asarray(ub)[:un], un, names)
+
+
+# ---------------------------------------------------------------------------
+# Concrete execs
+# ---------------------------------------------------------------------------
+
+class CpuShuffledHashJoinExec(_CpuJoinCore):
+    """Both children hash-partitioned by the join keys; joins partition-wise
+    (reference: GpuShuffledHashJoinExec)."""
+
+    @property
+    def num_partitions(self):
+        return self.left.num_partitions
+
+    def execute_partition(self, pidx):
+        left = _concat_or_empty(list(self.left.execute_partition(pidx)),
+                                self.left.schema)
+        right = _concat_or_empty(list(self.right.execute_partition(pidx)),
+                                 self.right.schema)
+        out = self._join_host(left, right)
+        if out.row_count:
+            yield out
+
+
+class TpuShuffledHashJoinExec(_TpuJoinCore):
+    @property
+    def num_partitions(self):
+        return self.left.num_partitions
+
+    def execute_partition(self, pidx):
+        build = list(self.right.execute_partition(pidx))
+        yield from self._join_device(self.left.execute_partition(pidx),
+                                     build)
+
+
+class CpuBroadcastHashJoinExec(_CpuJoinCore):
+    """Build side = every partition of the right child, materialized once
+    (reference: GpuBroadcastHashJoinExecBase; the broadcast is a no-op
+    in-process).  Right/full outer are not planned broadcast (the build side
+    match flags would span probe partitions), matching Spark's rule that the
+    broadcast side must not be the outer side."""
+
+    @property
+    def num_partitions(self):
+        return self.left.num_partitions
+
+    def _build_all(self):
+        if getattr(self, "_built_host", None) is None:
+            bs = []
+            for p in range(self.right.num_partitions):
+                bs.extend(self.right.execute_partition(p))
+            self._built_host = _concat_or_empty(bs, self.right.schema)
+        return self._built_host
+
+    def execute_partition(self, pidx):
+        left = _concat_or_empty(list(self.left.execute_partition(pidx)),
+                                self.left.schema)
+        out = self._join_host(left, self._build_all())
+        if out.row_count:
+            yield out
+
+
+class TpuBroadcastHashJoinExec(_TpuJoinCore):
+    @property
+    def num_partitions(self):
+        return self.left.num_partitions
+
+    def execute_partition(self, pidx):
+        # the build cache persists across probe partitions: the broadcast
+        # side is concatenated, keyed, and hash-sorted exactly once
+        cache = getattr(self, "_build_cache", None)
+        if cache is None:
+            cache = self._build_cache = {}
+        if "batches" not in cache:
+            bs = []
+            for p in range(self.right.num_partitions):
+                bs.extend(self.right.execute_partition(p))
+            cache["batches"] = bs
+        yield from self._join_device(self.left.execute_partition(pidx),
+                                     cache["batches"], cache)
+
+
+class CpuBroadcastNestedLoopJoinExec(CpuBroadcastHashJoinExec):
+    """Condition-only / cross joins (reference:
+    GpuBroadcastNestedLoopJoinExecBase): no keys, every pair considered."""
+
+
+class TpuBroadcastNestedLoopJoinExec(TpuBroadcastHashJoinExec):
+    pass
+
+
+# plan-rewrite registration (reference: GpuOverrides BroadcastHashJoinExec /
+# ShuffledHashJoinExec / BroadcastNestedLoopJoinExec rules :4117-4260)
+from spark_rapids_tpu.plan.overrides import register_exec  # noqa: E402
+
+
+def _join_exprs(p: _JoinBase):
+    out = list(p.left_keys) + list(p.right_keys)
+    if p.condition is not None:
+        out.append(p.condition)
+    return out
+
+
+def _reg(cpu_cls, tpu_cls, desc):
+    register_exec(
+        cpu_cls,
+        convert=lambda p, m: tpu_cls(p.left_keys, p.right_keys, p.join_type,
+                                     p.condition, p.children[0],
+                                     p.children[1], p.null_safe),
+        exprs_of=_join_exprs,
+        desc=desc)
+
+
+_reg(CpuShuffledHashJoinExec, TpuShuffledHashJoinExec,
+     "hash join over shuffled children")
+_reg(CpuBroadcastHashJoinExec, TpuBroadcastHashJoinExec,
+     "broadcast hash join")
+_reg(CpuBroadcastNestedLoopJoinExec, TpuBroadcastNestedLoopJoinExec,
+     "broadcast nested loop join")

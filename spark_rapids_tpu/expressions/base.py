@@ -134,6 +134,101 @@ class Expression:
     def __repr__(self):
         return self.sql()
 
+    # -- pyspark-Column-style operator sugar --------------------------------
+    @staticmethod
+    def _wrap(v) -> "Expression":
+        return v if isinstance(v, Expression) else lit(v)
+
+    def _bin(self, other, cls, flip=False):
+        a, b = Expression._wrap(other), self
+        if not flip:
+            a, b = b, a
+        return cls(a, b)
+
+    def __add__(self, o):
+        from spark_rapids_tpu.expressions.arithmetic import Add
+        return self._bin(o, Add)
+
+    def __radd__(self, o):
+        from spark_rapids_tpu.expressions.arithmetic import Add
+        return self._bin(o, Add, True)
+
+    def __sub__(self, o):
+        from spark_rapids_tpu.expressions.arithmetic import Subtract
+        return self._bin(o, Subtract)
+
+    def __rsub__(self, o):
+        from spark_rapids_tpu.expressions.arithmetic import Subtract
+        return self._bin(o, Subtract, True)
+
+    def __mul__(self, o):
+        from spark_rapids_tpu.expressions.arithmetic import Multiply
+        return self._bin(o, Multiply)
+
+    def __rmul__(self, o):
+        from spark_rapids_tpu.expressions.arithmetic import Multiply
+        return self._bin(o, Multiply, True)
+
+    def __truediv__(self, o):
+        from spark_rapids_tpu.expressions.arithmetic import Divide
+        return self._bin(o, Divide)
+
+    def __rtruediv__(self, o):
+        from spark_rapids_tpu.expressions.arithmetic import Divide
+        return self._bin(o, Divide, True)
+
+    def __mod__(self, o):
+        from spark_rapids_tpu.expressions.arithmetic import Remainder
+        return self._bin(o, Remainder)
+
+    def __neg__(self):
+        from spark_rapids_tpu.expressions.arithmetic import UnaryMinus
+        return UnaryMinus(self)
+
+    def __lt__(self, o):
+        from spark_rapids_tpu.expressions.predicates import LessThan
+        return self._bin(o, LessThan)
+
+    def __le__(self, o):
+        from spark_rapids_tpu.expressions.predicates import LessThanOrEqual
+        return self._bin(o, LessThanOrEqual)
+
+    def __gt__(self, o):
+        from spark_rapids_tpu.expressions.predicates import GreaterThan
+        return self._bin(o, GreaterThan)
+
+    def __ge__(self, o):
+        from spark_rapids_tpu.expressions.predicates import GreaterThanOrEqual
+        return self._bin(o, GreaterThanOrEqual)
+
+    def __eq__(self, o):
+        from spark_rapids_tpu.expressions.predicates import EqualTo
+        return self._bin(o, EqualTo)
+
+    def __ne__(self, o):
+        from spark_rapids_tpu.expressions.predicates import NotEqual
+        return self._bin(o, NotEqual)
+
+    __hash__ = object.__hash__  # __eq__ builds an expression, not a bool
+
+    def __bool__(self):
+        raise ValueError(
+            "Cannot convert an Expression to a bool: use '&' for AND, '|' "
+            "for OR, '~' for NOT, and avoid chained comparisons "
+            "(a < col < b)")
+
+    def __and__(self, o):
+        from spark_rapids_tpu.expressions.predicates import And
+        return self._bin(o, And)
+
+    def __or__(self, o):
+        from spark_rapids_tpu.expressions.predicates import Or
+        return self._bin(o, Or)
+
+    def __invert__(self):
+        from spark_rapids_tpu.expressions.predicates import Not
+        return Not(self)
+
 
 # ---------------------------------------------------------------------------
 # Leaves

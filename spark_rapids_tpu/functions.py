@@ -38,7 +38,7 @@ def sum(e):  # noqa: A001 - mirrors pyspark.sql.functions naming
 
 def count(e="*"):
     from spark_rapids_tpu.expressions.aggregates import Count
-    if e == "*":
+    if isinstance(e, str) and e == "*":
         return Count(lit(1))
     return Count(_expr(e))
 
@@ -92,3 +92,14 @@ stddev_samp = stddev
 def stddev_pop(e):
     from spark_rapids_tpu.expressions.aggregates import StddevPop
     return StddevPop(_expr(e))
+
+
+# -- hints -------------------------------------------------------------------
+
+def broadcast(df):
+    """Marks a DataFrame as broadcastable for joins (pyspark
+    functions.broadcast analog; reference: GpuBroadcastHashJoinExec)."""
+    import copy
+    out = copy.copy(df)
+    out._broadcast_hint = True
+    return out

@@ -1,0 +1,398 @@
+"""Device join kernels: equi-joins and nested-loop pair generation.
+
+Reference: GpuHashJoin (execution/GpuHashJoin.scala) lowers joins to cuDF
+hash-table gather maps; JoinGatherer.scala applies them.  TPU-first
+redesign — XLA has no device hash tables, but is excellent at sort +
+binary search, so an equi-join becomes:
+
+1. hash every row's key columns into one uint64 word (padding/invalid rows
+   get a sentinel hash);
+2. sort the BUILD side by hash (``jax.lax.sort``, one fused op);
+3. ``searchsorted`` each PROBE hash into the sorted build hashes -> a
+   candidate range [lo, hi) per probe row (static shapes throughout);
+4. expand candidate pairs into a padded pair table (the only host syncs are
+   the candidate total and the final row count);
+5. VERIFY true key equality per pair (hash collisions and null semantics are
+   resolved here, on masked sortable words), and
+6. finalize per join type: compact kept pairs, append null-extended
+   unmatched rows for outer joins, or reduce to per-row match flags for
+   semi/anti.
+
+Nested-loop (cross / condition-only) joins reuse steps 4-6 with the
+candidate set = the full cartesian product of in-row positions.
+
+Null semantics match Spark: null keys never match (unless the key is
+null-safe, i.e. ``<=>``); NaN == NaN and -0.0 == 0.0 for join keys (the
+sortable-word normalization gives this for free, sort_ops.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn, bucket_rows
+
+
+def _jx():
+    from spark_rapids_tpu.columnar.column import _jnp
+    return _jnp()
+
+
+# Join types (reference: Spark JoinType; GpuHashJoin supports all of these)
+INNER = "inner"
+LEFT_OUTER = "left_outer"
+RIGHT_OUTER = "right_outer"
+FULL_OUTER = "full_outer"
+LEFT_SEMI = "left_semi"
+LEFT_ANTI = "left_anti"
+CROSS = "cross"
+
+_SENTINEL = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def _mix64(h, jnp):
+    """murmur3 fmix64 — avalanches a uint64 word."""
+    h = h ^ (h >> np.uint64(33))
+    h = h * np.uint64(0xFF51AFD7ED558CCD)
+    h = h ^ (h >> np.uint64(33))
+    h = h * np.uint64(0xC4CEB9FE1A85EC53)
+    h = h ^ (h >> np.uint64(33))
+    return h
+
+
+def _key_words(col: DeviceColumn, jnp, width_words: Optional[int] = None):
+    """(validity-rank word, masked value words) for one key column; equal
+    keys (with both-null == both-null) produce identical word tuples.
+    ``width_words`` pads string word lists so both sides agree."""
+    from spark_rapids_tpu.ops.sort_ops import sortable_words
+    words = []
+    for w in sortable_words(col, jnp):
+        words.append(jnp.where(col.validity, w, jnp.zeros_like(w)))
+    if width_words is not None:
+        while len(words) < width_words:
+            words.append(jnp.zeros(col.bucket, dtype=np.uint64))
+    return [col.validity.astype(np.int8)] + words
+
+
+def _n_value_words(col: DeviceColumn) -> int:
+    """How many value words _key_words yields for this column (static)."""
+    dt = col.data_type
+    if isinstance(dt, (T.StringType, T.BinaryType)):
+        w = int(col.data.shape[1]) if col.data.ndim == 2 else 0
+        return max(1, -(-w // 7))
+    if isinstance(dt, T.DecimalType) and dt.is_decimal128:
+        return 2
+    return 1
+
+
+def _hash_rows(cols: List[DeviceColumn], widths: List[int], inrow, jnp):
+    """uint64 hash per row over all key columns; padding rows -> sentinel."""
+    h = jnp.full(cols[0].bucket if cols else inrow.shape[0], 0x9E3779B97F4A7C15,
+                 dtype=np.uint64)
+    for c, w in zip(cols, widths):
+        for word in _key_words(c, jnp, w):
+            u = word.astype(np.uint64) if word.dtype != np.uint64 else word
+            h = _mix64(h ^ _mix64(u, jnp), jnp)
+    return jnp.where(inrow, h, _SENTINEL)
+
+
+def _col_sig(c: DeviceColumn) -> Tuple:
+    return (str(c.data.dtype), tuple(c.data.shape), c.lengths is not None)
+
+
+@dataclasses.dataclass
+class BuiltSide:
+    """The build (hash) side, sorted by key hash — reusable across many
+    probe batches (reference: the build-side hash table in GpuHashJoin)."""
+    batch: ColumnarBatch          # original build batch
+    key_ordinals: Tuple[int, ...]
+    hashes_sorted: object         # uint64[bucket] ascending
+    perm: object                  # int32[bucket]: sorted pos -> original row
+    widths: List[int]             # string word widths agreed with probe side
+
+
+_BUILD_CACHE: Dict[Tuple, object] = {}
+_PROBE_CACHE: Dict[Tuple, object] = {}
+_PAIR_CACHE: Dict[Tuple, object] = {}
+_FINAL_CACHE: Dict[Tuple, object] = {}
+_GATHER_CACHE: Dict[Tuple, object] = {}
+
+
+def build_side(batch: ColumnarBatch, key_ordinals: Sequence[int],
+               probe_key_cols: Sequence[DeviceColumn]) -> BuiltSide:
+    """Sorts the build side by key hash (one jitted program)."""
+    import jax
+    jnp = _jx()
+    key_ordinals = tuple(key_ordinals)
+    kcols = [batch.columns[i] for i in key_ordinals]
+    widths = [max(_n_value_words(b), _n_value_words(p))
+              for b, p in zip(kcols, probe_key_cols)]
+    key = ("build", tuple(_col_sig(c) for c in kcols), tuple(widths))
+    fn = _BUILD_CACHE.get(key)
+    if fn is None:
+        bucket = kcols[0].bucket if kcols else batch.bucket
+        dtypes = [c.data_type for c in kcols]
+
+        def run(arrs, row_count):
+            cols = [DeviceColumn(d, v, bucket, dtypes[i], ln)
+                    for i, (d, v, ln) in enumerate(arrs)]
+            rowpos = jnp.arange(bucket, dtype=np.int32)
+            inrow = rowpos < row_count
+            h = _hash_rows(cols, widths, inrow, jnp)
+            hs, perm = jax.lax.sort((h, rowpos), num_keys=1, is_stable=True)
+            return hs, perm
+
+        fn = jax.jit(run)
+        _BUILD_CACHE[key] = fn
+    arrs = [(c.data, c.validity, c.lengths) for c in kcols]
+    hs, perm = fn(arrs, batch.row_count)
+    return BuiltSide(batch, key_ordinals, hs, perm, widths)
+
+
+def _probe_ranges(probe_keys: List[DeviceColumn], built: BuiltSide):
+    """Per-probe-row candidate range in the sorted build hashes.
+    Returns (lo, counts, offsets, total) — total is the one host sync."""
+    import jax
+    jnp = _jx()
+    key = ("probe", tuple(_col_sig(c) for c in probe_keys),
+           built.hashes_sorted.shape, tuple(built.widths))
+    fn = _PROBE_CACHE.get(key)
+    if fn is None:
+        bucket = probe_keys[0].bucket
+        dtypes = [c.data_type for c in probe_keys]
+        widths = built.widths
+
+        def run(arrs, row_count, hs):
+            cols = [DeviceColumn(d, v, bucket, dtypes[i], ln)
+                    for i, (d, v, ln) in enumerate(arrs)]
+            rowpos = jnp.arange(bucket, dtype=np.int32)
+            inrow = rowpos < row_count
+            h = _hash_rows(cols, widths, inrow, jnp)
+            lo = jnp.searchsorted(hs, h, side="left").astype(np.int64)
+            hi = jnp.searchsorted(hs, h, side="right").astype(np.int64)
+            # sentinel probe rows (padding) must not match sentinel build pad
+            counts = jnp.where(inrow & (h != _SENTINEL), hi - lo, 0)
+            offsets = jnp.cumsum(counts) - counts
+            return lo, counts, offsets, jnp.sum(counts)
+
+        fn = jax.jit(run)
+        _PROBE_CACHE[key] = fn
+    arrs = [(c.data, c.validity, c.lengths) for c in probe_keys]
+    lo, counts, offsets, total = fn(arrs, probe_keys[0].row_count,
+                                    built.hashes_sorted)
+    return lo, counts, offsets, int(total)
+
+
+def _expand_verify(probe: ColumnarBatch, probe_ordinals, built: BuiltSide,
+                   null_safe: Tuple[bool, ...], lo, offsets, total: int):
+    """Expands candidate ranges to a padded pair table and verifies true key
+    equality.  Returns (l_idx, r_idx, keep, pair_bucket)."""
+    import jax
+    jnp = _jx()
+    out_bucket = bucket_rows(max(total, 1))
+    pkeys = [probe.columns[i] for i in probe_ordinals]
+    bkeys = [built.batch.columns[i] for i in built.key_ordinals]
+    key = ("pairs", out_bucket, tuple(_col_sig(c) for c in pkeys),
+           tuple(_col_sig(c) for c in bkeys), null_safe, tuple(built.widths))
+    fn = _PAIR_CACHE.get(key)
+    if fn is None:
+        p_bucket = probe.bucket
+        b_bucket = built.batch.bucket
+        pdt = [c.data_type for c in pkeys]
+        bdt = [c.data_type for c in bkeys]
+        widths = built.widths
+
+        def run(parrs, barrs, lo, offsets, total, perm, p_count, b_count):
+            pcols = [DeviceColumn(d, v, p_bucket, pdt[i], ln)
+                     for i, (d, v, ln) in enumerate(parrs)]
+            bcols = [DeviceColumn(d, v, b_bucket, bdt[i], ln)
+                     for i, (d, v, ln) in enumerate(barrs)]
+            r = jnp.arange(out_bucket, dtype=np.int64)
+            # probe row for each output pair: last offset <= r
+            p = jnp.searchsorted(offsets, r, side="right").astype(np.int64) - 1
+            p = jnp.clip(p, 0, p_bucket - 1)
+            j = r - jnp.take(offsets, p)
+            spos = jnp.take(lo, p) + j          # position in sorted build
+            spos = jnp.clip(spos, 0, b_bucket - 1)
+            b = jnp.take(perm, spos).astype(np.int64)   # original build row
+            live = r < total
+            keep = live & (p < p_count) & (b < b_count)
+            # verify true equality on masked words (collisions + nulls)
+            for ki, (pc, bc) in enumerate(zip(pcols, bcols)):
+                pw = _key_words(pc, jnp, widths[ki])
+                bw = _key_words(bc, jnp, widths[ki])
+                eq = jnp.ones(out_bucket, dtype=bool)
+                for a, bword in zip(pw, bw):
+                    av = jnp.take(a, p, axis=0)
+                    bv = jnp.take(bword, b, axis=0)
+                    eq = eq & (av == bv)
+                if not null_safe[ki]:
+                    eq = eq & jnp.take(pc.validity, p) & \
+                        jnp.take(bc.validity, b)
+                keep = keep & eq
+            return p, b, keep
+
+        fn = jax.jit(run)
+        _PAIR_CACHE[key] = fn
+    parrs = [(c.data, c.validity, c.lengths) for c in pkeys]
+    barrs = [(c.data, c.validity, c.lengths) for c in bkeys]
+    l_idx, r_idx, keep = fn(parrs, barrs, lo, offsets, total, built.perm,
+                            probe.row_count, built.batch.row_count)
+    return l_idx, r_idx, keep, out_bucket
+
+
+def cross_pairs(probe: ColumnarBatch, build: ColumnarBatch):
+    """Candidate set for nested-loop joins: full cartesian product.
+    Returns (l_idx, r_idx, keep, pair_bucket)."""
+    import jax
+    jnp = _jx()
+    total = probe.row_count * build.row_count
+    out_bucket = bucket_rows(max(total, 1))
+    key = ("cross", out_bucket)
+    fn = _PAIR_CACHE.get(key)
+    if fn is None:
+        def run(total, b_count):
+            r = jnp.arange(out_bucket, dtype=np.int64)
+            bc = jnp.maximum(b_count, 1)
+            p = r // bc
+            b = r % bc
+            keep = r < total
+            return p, b, keep
+
+        fn = jax.jit(run)
+        _PAIR_CACHE[key] = fn
+    l_idx, r_idx, keep = fn(total, build.row_count)
+    return l_idx, r_idx, keep, out_bucket
+
+
+def matched_flags(idx, keep, side_bucket: int):
+    """Per-row "has >= 1 kept pair" flags (semi/anti/outer bookkeeping)."""
+    import jax
+    jnp = _jx()
+    key = ("flags", int(idx.shape[0]), side_bucket)
+    fn = _FINAL_CACHE.get(key)
+    if fn is None:
+        def run(idx, keep):
+            safe = jnp.clip(idx, 0, side_bucket - 1)
+            return jnp.zeros(side_bucket, dtype=bool).at[safe].max(keep)
+
+        fn = jax.jit(run)
+        _FINAL_CACHE[key] = fn
+    return fn(idx, keep)
+
+
+def compact_pairs(l_idx, r_idx, keep):
+    """Moves kept pairs to the front; returns (l, r, count)."""
+    import jax
+    jnp = _jx()
+    key = ("cpairs", int(l_idx.shape[0]))
+    fn = _FINAL_CACHE.get(key)
+    if fn is None:
+        def run(l_idx, r_idx, keep):
+            order = jnp.argsort(~keep, stable=True)
+            return (jnp.take(l_idx, order), jnp.take(r_idx, order),
+                    jnp.sum(keep))
+
+        fn = jax.jit(run)
+        _FINAL_CACHE[key] = fn
+    l, r, n = fn(l_idx, r_idx, keep)
+    return l, r, int(n)
+
+
+def unmatched_positions(flags, row_count: int):
+    """Row positions with no kept match, compacted; returns (idx, count)."""
+    import jax
+    jnp = _jx()
+    bucket = int(flags.shape[0])
+    key = ("unmatched", bucket)
+    fn = _FINAL_CACHE.get(key)
+    if fn is None:
+        def run(flags, row_count):
+            rowpos = jnp.arange(bucket, dtype=np.int64)
+            want = (~flags) & (rowpos < row_count)
+            order = jnp.argsort(~want, stable=True)
+            return jnp.take(rowpos, order), jnp.sum(want)
+
+        fn = jax.jit(run)
+        _FINAL_CACHE[key] = fn
+    idx, n = fn(flags, row_count)
+    return idx, int(n)
+
+
+def gather_join_output(probe: ColumnarBatch, build: ColumnarBatch,
+                       l_map, r_map, count: int,
+                       names: Optional[List[str]] = None) -> ColumnarBatch:
+    """Materializes join output rows: probe columns gathered by ``l_map``,
+    build columns by ``r_map``; a negative map entry yields a null row for
+    that side (outer-join null extension).  Maps may be longer than the
+    output bucket — they are truncated/padded to ``bucket_rows(count)``."""
+    import jax
+    jnp = _jx()
+    out_bucket = bucket_rows(max(count, 1))
+    # pad maps to a bucketed length so the program caches across batches
+    maps_bucket = bucket_rows(max(int(l_map.shape[0]), 1))
+    if int(l_map.shape[0]) != maps_bucket:
+        pad = maps_bucket - int(l_map.shape[0])
+        l_map = jnp.pad(jnp.asarray(l_map), (0, pad), constant_values=-1)
+        r_map = jnp.pad(jnp.asarray(r_map), (0, pad), constant_values=-1)
+    key = ("jgather", out_bucket, maps_bucket,
+           tuple(_col_sig(c) for c in probe.columns),
+           tuple(_col_sig(c) for c in build.columns))
+    fn = _GATHER_CACHE.get(key)
+    if fn is None:
+        p_bucket, b_bucket = probe.bucket, build.bucket
+        pdt = [c.data_type for c in probe.columns]
+        bdt = [c.data_type for c in build.columns]
+
+        def run(parrs, barrs, l_map, r_map, count):
+            r = jnp.arange(out_bucket, dtype=np.int64)
+            live = r < count
+            safe_r = jnp.clip(r, 0, maps_bucket - 1)
+            lm = jnp.take(l_map, safe_r)
+            rm = jnp.take(r_map, safe_r)
+            outs = []
+            for (d, v, ln) in parrs:
+                sl = jnp.clip(lm, 0, p_bucket - 1)
+                nd = jnp.take(d, sl, axis=0)
+                nv = jnp.take(v, sl, axis=0) & (lm >= 0) & live
+                nl = None if ln is None else jnp.take(ln, sl, axis=0)
+                outs.append((nd, nv, nl))
+            for (d, v, ln) in barrs:
+                sr = jnp.clip(rm, 0, b_bucket - 1)
+                nd = jnp.take(d, sr, axis=0)
+                nv = jnp.take(v, sr, axis=0) & (rm >= 0) & live
+                nl = None if ln is None else jnp.take(ln, sr, axis=0)
+                outs.append((nd, nv, nl))
+            return outs
+
+        fn = jax.jit(run)
+        _GATHER_CACHE[key] = fn
+    parrs = [(c.data, c.validity, c.lengths) for c in probe.columns]
+    barrs = [(c.data, c.validity, c.lengths) for c in build.columns]
+    outs = fn(parrs, barrs, l_map, r_map, count)
+    cols = []
+    all_dt = [c.data_type for c in probe.columns] + \
+        [c.data_type for c in build.columns]
+    for (d, v, ln), dt in zip(outs, all_dt):
+        cols.append(DeviceColumn(d, v, count, dt, ln))
+    return ColumnarBatch(cols, count, names)
+
+
+def concat_index_maps(parts: Sequence[Tuple[object, object, int]]):
+    """Concatenates (l_map, r_map, count) fragments into one pair of host
+    numpy maps + total (small index arrays; host assembly is fine)."""
+    ls, rs, total = [], [], 0
+    for l, r, n in parts:
+        if n <= 0:
+            continue
+        ls.append(np.asarray(l)[:n])
+        rs.append(np.asarray(r)[:n])
+        total += n
+    if not ls:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64), 0
+    return np.concatenate(ls), np.concatenate(rs), total

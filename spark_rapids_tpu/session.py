@@ -221,6 +221,97 @@ class DataFrame:
         return DataFrame(CpuSortExec(self._sort_specs(cols, ascending),
                                      self._plan), self._session)
 
+    def join(self, other: "DataFrame", on=None, how: str = "inner",
+             condition=None, null_safe: bool = False) -> "DataFrame":
+        """Equi-join on column names (USING semantics: key columns emitted
+        once), with an optional extra non-equi ``condition`` over the
+        combined row; ``on=None`` with a condition = nested-loop join.
+        Wrap the right side in functions.broadcast() to force a broadcast
+        hash join (reference: GpuBroadcastHashJoinExec rule)."""
+        from spark_rapids_tpu.exec.joins import (
+            CpuBroadcastHashJoinExec, CpuBroadcastNestedLoopJoinExec,
+            CpuShuffledHashJoinExec, _normalize_how)
+        from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
+        from spark_rapids_tpu.expressions.base import BoundReference
+        from spark_rapids_tpu.expressions.conditional import Coalesce
+        from spark_rapids_tpu.plan.partitioning import HashPartitioning
+        import spark_rapids_tpu.ops.join_ops as J
+        jt = _normalize_how(how)
+        lplan, rplan = self._plan, other._plan
+        lschema, rschema = lplan.schema, rplan.schema
+        combined = T.StructType(list(lschema.fields) + list(rschema.fields))
+        cond = None
+        if condition is not None:
+            cond = bind_references(_to_expr(condition), combined)
+        if on is None or jt == J.CROSS:
+            if jt in (J.RIGHT_OUTER, J.FULL_OUTER):
+                raise NotImplementedError(
+                    f"{jt} without equi-join keys is not supported; "
+                    "provide `on` columns")
+            plan = CpuBroadcastNestedLoopJoinExec([], [], jt, cond, lplan,
+                                                  rplan)
+            return DataFrame(plan, self._session)
+        names = [on] if isinstance(on, str) else list(on)
+        lkeys = [bind_references(col(n), lschema) for n in names]
+        rkeys = [bind_references(col(n), rschema) for n in names]
+        ns = [null_safe] * len(names)
+        broadcastable = getattr(other, "_broadcast_hint", False) and \
+            jt in (J.INNER, J.LEFT_OUTER, J.LEFT_SEMI, J.LEFT_ANTI)
+        if broadcastable:
+            plan = CpuBroadcastHashJoinExec(lkeys, rkeys, jt, cond, lplan,
+                                            rplan, ns)
+        else:
+            nparts = max(lplan.num_partitions, rplan.num_partitions)
+            if nparts > 1:
+                lplan = CpuShuffleExchangeExec(
+                    HashPartitioning(lkeys, nparts), lplan)
+                rplan = CpuShuffleExchangeExec(
+                    HashPartitioning(rkeys, nparts), rplan)
+                # keys bind identically post-shuffle (same child schema)
+            plan = CpuShuffledHashJoinExec(lkeys, rkeys, jt, cond, lplan,
+                                           rplan, ns)
+        df = DataFrame(plan, self._session)
+        if jt in (J.LEFT_SEMI, J.LEFT_ANTI):
+            return df
+        # USING projection: key cols once (left / right / coalesced per join
+        # type, Spark semantics), then remaining left cols, then right cols
+        nl = len(lschema.fields)
+        out_schema = plan.schema
+        key_l = {lschema.field_index(n) for n in names}
+        key_r = {rschema.field_index(n) for n in names}
+        exprs = []
+        for n in names:
+            li = lschema.field_index(n)
+            ri = nl + rschema.field_index(n)
+            lf = out_schema.fields[li]
+            rf = out_schema.fields[ri]
+            lref = BoundReference(li, lf.data_type, lf.nullable)
+            rref = BoundReference(ri, rf.data_type, rf.nullable)
+            if jt == J.FULL_OUTER:
+                exprs.append(Alias(Coalesce(lref, rref), n))
+            elif jt == J.RIGHT_OUTER:
+                exprs.append(Alias(rref, n))
+            else:
+                exprs.append(Alias(lref, n))
+        for i, f in enumerate(lschema.fields):
+            if i not in key_l:
+                of = out_schema.fields[i]
+                exprs.append(Alias(
+                    BoundReference(i, of.data_type, of.nullable), f.name))
+        for i, f in enumerate(rschema.fields):
+            if i not in key_r:
+                of = out_schema.fields[nl + i]
+                exprs.append(Alias(
+                    BoundReference(nl + i, of.data_type, of.nullable),
+                    f.name))
+        from spark_rapids_tpu.exec.basic import CpuProjectExec
+        return DataFrame(CpuProjectExec(exprs, plan), self._session)
+
+    def cross_join(self, other: "DataFrame") -> "DataFrame":
+        return self.join(other, on=None, how="cross")
+
+    crossJoin = cross_join
+
     def group_by(self, *cols) -> "GroupedData":
         keys = [bind_references(_to_expr(c), self.schema) for c in cols]
         return GroupedData(self, keys)

@@ -1,0 +1,168 @@
+"""Differential join tests: every join type, nulls, duplicates, strings,
+conditions, broadcast vs shuffled (reference: integration_tests
+join_test.py patterns over assert_gpu_and_cpu_are_equal_collect)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+
+from tests.asserts import assert_tpu_and_cpu_are_equal_collect
+
+
+def _left_data():
+    return {
+        "k": [1, 2, 2, 3, None, 5, None, 7, 8, 2],
+        "lv": [10.0, 20.0, 21.0, 30.0, 40.0, None, 60.0, 70.0, 80.0, 22.0],
+    }
+
+
+def _right_data():
+    return {
+        "k": [2, 2, 3, 4, None, 6, 8, 8, None],
+        "rv": [200.0, 201.0, 300.0, 400.0, None, 600.0, 800.0, 801.0, 900.0],
+    }
+
+
+JOIN_TYPES = ["inner", "left", "right", "full", "semi", "anti"]
+
+
+@pytest.mark.parametrize("how", JOIN_TYPES)
+@pytest.mark.parametrize("nparts", [1, 3])
+def test_join_basic(how, nparts):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_left_data(), num_partitions=nparts)
+        .join(s.create_dataframe(_right_data(), num_partitions=2), on="k",
+              how=how),
+        ignore_order=True)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+def test_broadcast_join(how):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_left_data(), num_partitions=3)
+        .join(F.broadcast(s.create_dataframe(_right_data())), on="k",
+              how=how),
+        ignore_order=True)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "full"])
+def test_join_multi_key(how):
+    left = {"a": [1, 1, 2, 2, None, 3], "b": [1, 2, 1, None, 1, 3],
+            "lv": [1, 2, 3, 4, 5, 6]}
+    right = {"a": [1, 2, 2, None, 3, 4], "b": [2, 1, 1, 1, 3, 4],
+             "rv": [10, 20, 21, 30, 40, 50]}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(left, num_partitions=2)
+        .join(s.create_dataframe(right, num_partitions=2), on=["a", "b"],
+              how=how),
+        ignore_order=True)
+
+
+def test_join_null_safe():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_left_data())
+        .join(s.create_dataframe(_right_data()), on="k", how="inner",
+              null_safe=True),
+        ignore_order=True)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+def test_join_string_keys(how):
+    left = {"k": ["apple", "pear", None, "fig", "apple", ""],
+            "lv": [1, 2, 3, 4, 5, 6]}
+    right = {"k": ["apple", "fig", "fig", None, "", "plum"],
+             "rv": [10, 20, 21, 30, 40, 50]}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(left, num_partitions=2)
+        .join(s.create_dataframe(right, num_partitions=2), on="k", how=how),
+        ignore_order=True)
+
+
+def test_join_float_keys_nan_negzero():
+    # Spark join keys: NaN == NaN, -0.0 == 0.0
+    left = {"k": [float("nan"), -0.0, 1.5, 2.5, None],
+            "lv": [1, 2, 3, 4, 5]}
+    right = {"k": [float("nan"), 0.0, 1.5, 3.5, None],
+             "rv": [10, 20, 30, 40, 50]}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(left)
+        .join(s.create_dataframe(right), on="k", how="inner"),
+        ignore_order=True)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "full"])
+def test_join_with_condition(how):
+    # extra non-equi condition over the pair (reference: AST join conditions)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_left_data(), num_partitions=2)
+        .join(s.create_dataframe(_right_data(), num_partitions=2), on="k",
+              how=how, condition=F.col("lv") * 10 < F.col("rv")),
+        ignore_order=True)
+
+
+def test_cross_join():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe({"a": [1, 2, 3]})
+        .cross_join(s.create_dataframe({"b": [10, 20]})),
+        ignore_order=True)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+def test_nested_loop_condition_join(how):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe({"a": [1, 2, 3, 4, None]},
+                                     num_partitions=2)
+        .join(s.create_dataframe({"b": [2, 3, 3, 9]}), on=None, how=how,
+              condition=F.col("a") < F.col("b")),
+        ignore_order=True)
+
+
+@pytest.mark.parametrize("how", JOIN_TYPES)
+def test_join_empty_sides(how):
+    empty = {"k": np.array([], dtype=np.int64),
+             "rv": np.array([], dtype=np.float64)}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_left_data())
+        .join(s.create_dataframe(empty), on="k", how=how),
+        ignore_order=True)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(empty, num_partitions=1)
+        .select(F.col("k"), F.Alias(F.col("rv"), "lv"))
+        .join(s.create_dataframe(_right_data()), on="k", how=how),
+        ignore_order=True)
+
+
+def test_join_duplicate_key_explosion():
+    # many-to-many: 4x3 matches for k=1
+    left = {"k": [1, 1, 1, 1, 2], "lv": [1, 2, 3, 4, 5]}
+    right = {"k": [1, 1, 1, 3], "rv": [10, 20, 30, 40]}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(left)
+        .join(s.create_dataframe(right), on="k", how="inner"),
+        ignore_order=True)
+
+
+def test_join_then_aggregate():
+    # joins compose with downstream device aggregation
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_left_data(), num_partitions=2)
+        .join(s.create_dataframe(_right_data(), num_partitions=2), on="k",
+              how="inner")
+        .group_by("k").agg(F.Alias(F.sum("rv"), "s"),
+                           F.Alias(F.count("*"), "c")),
+        ignore_order=True)
+
+
+def test_join_larger_random():
+    rng = np.random.default_rng(42)
+    n, m = 5000, 3000
+    left = {"k": rng.integers(0, 500, n), "lv": rng.normal(size=n)}
+    right = {"k": rng.integers(0, 500, m), "rv": rng.normal(size=m)}
+    for how in ("inner", "left", "full"):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: s.create_dataframe(left, num_partitions=3)
+            .join(s.create_dataframe(right, num_partitions=2), on="k",
+                  how=how),
+            ignore_order=True)
