@@ -1,0 +1,387 @@
+"""Window exec: ranking, offset, and frame aggregations over partitions.
+
+Reference: window/GpuWindowExec.scala + GpuWindowExecMeta (673) pick among
+batched running / double-pass / bounded algorithms; GpuWindowExpression.scala
+lowers frames to cuDF rolling/scan aggs.  Our device path fuses the whole
+spec group into ONE XLA program (sort + boundaries + every window column,
+ops/window_ops.py); the CPU path is a deliberately-simple python oracle
+(sort with a comparator, per-group loops) for differential testing.
+
+Contract (like Spark's WindowExec): the child is hash-partitioned by the
+partition keys (the session layer inserts the exchange) and this exec
+concatenates each partition to one batch before computing.  Output rows are
+in (partition, order) sorted order.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, HostColumnarBatch
+from spark_rapids_tpu.expressions.base import Expression
+from spark_rapids_tpu.expressions.window_exprs import (Lag, Lead, NTile,
+                                                       DenseRank, Rank,
+                                                       RowNumber,
+                                                       WindowExpression)
+from spark_rapids_tpu.ops.window_ops import MAX_UNROLLED_FRAME
+from spark_rapids_tpu.plan.base import Exec, UnaryExec
+
+
+class LoweredWindow:
+    """One window output column lowered to a kernel func spec.
+
+    ``func`` holds a placeholder -1 where the value ordinal goes (filled in
+    by the exec once input columns are laid out)."""
+
+    def __init__(self, func: Tuple, inputs: List[Expression],
+                 dtype: T.DataType):
+        self.func = func
+        self.inputs = inputs
+        self.dtype = dtype
+
+
+def lower_window_expr(wexpr: WindowExpression) -> LoweredWindow:
+    from spark_rapids_tpu.expressions import aggregates as AG
+    from spark_rapids_tpu.expressions.cast import Cast
+    f = wexpr.function
+    if isinstance(f, RowNumber):
+        return LoweredWindow(("row_number",), [], T.INT)
+    if isinstance(f, Rank):
+        return LoweredWindow(("rank",), [], T.INT)
+    if isinstance(f, DenseRank):
+        return LoweredWindow(("dense_rank",), [], T.INT)
+    if isinstance(f, NTile):
+        return LoweredWindow(("ntile", f.n), [], T.INT)
+    if isinstance(f, (Lag, Lead)):
+        from spark_rapids_tpu.expressions.base import Literal
+        off = f.offset * f.direction
+        dflt = None
+        if f.default is not None:
+            if not isinstance(f.default, Literal) or isinstance(
+                    f.children[0].data_type, (T.StringType, T.BinaryType)):
+                raise NotImplementedError(
+                    "lag/lead default must be a scalar literal over a "
+                    "non-string column")
+            dflt = f.default.value
+        return LoweredWindow(("offset", -1, off, dflt), [f.children[0]],
+                             f.data_type)
+    if isinstance(f, AG.AggregateFunction):
+        frame = wexpr.spec.effective_frame()
+        lo = None if frame.lo_unbounded else int(frame.lo)
+        hi = None if frame.hi_unbounded else int(frame.hi)
+        fk = frame.kind
+        if fk == "range" and not (lo is None and hi in (None, 0)):
+            raise NotImplementedError(
+                "bounded RANGE frames are not supported; use ROWS BETWEEN "
+                "(Spark's value-based RANGE frames need a single numeric "
+                "order key)")
+        child = f.children[0] if f.children else None
+        if isinstance(f, AG.Sum):
+            dt = f.data_type
+            return LoweredWindow(("agg", "sum", -1, fk, lo, hi, True),
+                                 [Cast(child, dt)], dt)
+        if isinstance(f, AG.Count):
+            from spark_rapids_tpu.expressions.base import Literal
+            count_all = isinstance(child, Literal) and \
+                child.value is not None        # count(*) counts every row
+            return LoweredWindow(("agg", "count", -1, fk, lo, hi,
+                                  not count_all), [child], T.LONG)
+        if isinstance(f, AG.Average):
+            return LoweredWindow(("agg", "mean", -1, fk, lo, hi, True),
+                                 [Cast(child, T.DOUBLE)], T.DOUBLE)
+        if isinstance(f, AG.Min):
+            return LoweredWindow(("agg", "min", -1, fk, lo, hi, True),
+                                 [child], f.data_type)
+        if isinstance(f, AG.Max):
+            return LoweredWindow(("agg", "max", -1, fk, lo, hi, True),
+                                 [child], f.data_type)
+    raise NotImplementedError(f"window function {f!r}")
+
+
+def device_unsupported_reason(wexpr: WindowExpression) -> Optional[str]:
+    """Why this window expression cannot run on device (meta tagging;
+    reference: GpuWindowExpressionMeta.tagExprForGpu)."""
+    try:
+        low = lower_window_expr(wexpr)
+    except NotImplementedError as e:
+        return str(e)
+    if low.func[0] != "agg":
+        return None
+    _, agg, _, fk, lo, hi, _ = low.func
+    if agg in ("min", "max"):
+        if low.inputs and isinstance(low.inputs[0].data_type,
+                                     (T.StringType, T.BinaryType)):
+            return "string min/max window frames not on device yet"
+        if lo is not None and hi is not None and \
+                (hi - lo + 1) > MAX_UNROLLED_FRAME:
+            return (f"bounded min/max frame wider than "
+                    f"{MAX_UNROLLED_FRAME} rows")
+        if lo is not None and hi is None:
+            if lo != 0:
+                return "min/max over (N preceding/following, unbounded)"
+        if lo is None and hi is not None and hi != 0:
+            return "min/max over (unbounded, N following)"
+    return None
+
+
+class CpuWindowExec(UnaryExec):
+    """window_cols: [(output_name, WindowExpression)] sharing one
+    partition/order spec; appends one column per entry."""
+
+    def __init__(self, window_cols: List[Tuple[str, WindowExpression]],
+                 child: Exec):
+        super().__init__(child)
+        self.window_cols = list(window_cols)
+        self.spec = window_cols[0][1].spec
+        self.lowered = [lower_window_expr(w) for _, w in window_cols]
+
+    @property
+    def schema(self) -> T.StructType:
+        fields = list(self.child.schema.fields)
+        for (name, w), low in zip(self.window_cols, self.lowered):
+            fields.append(T.StructField(name, low.dtype, True))
+        return T.StructType(fields)
+
+    def node_desc(self):
+        cols = ", ".join(w.sql() for _, w in self.window_cols)
+        return f"{self.name}[{cols}]"
+
+    # -- CPU oracle ---------------------------------------------------------
+    def execute_partition(self, pidx):
+        from spark_rapids_tpu.columnar.batch import batch_from_pydict
+        from spark_rapids_tpu.exec.joins import _concat_or_empty
+        from spark_rapids_tpu.expressions.evaluator import eval_exprs_cpu
+        batch = _concat_or_empty(list(self.child.execute_partition(pidx)),
+                                 self.child.schema)
+        if batch.row_count == 0:
+            return
+        n = batch.row_count
+        pvals = self._col_lists(eval_exprs_cpu(
+            self.spec.partition_exprs, batch,
+            [f"p{i}" for i in range(len(self.spec.partition_exprs))]))
+        ovals = self._col_lists(eval_exprs_cpu(
+            [e for e, _, _ in self.spec.order_specs], batch,
+            [f"o{i}" for i in range(len(self.spec.order_specs))]))
+        # sort rows: partition keys (any order groups them), then order keys
+        dirs = [(a, nf) for _, a, nf in self.spec.order_specs]
+        idx = sorted(range(n), key=functools.cmp_to_key(
+            lambda i, j: self._cmp(pvals, ovals, dirs, i, j)))
+        # group boundaries
+        groups: List[List[int]] = []
+        for k, i in enumerate(idx):
+            if k == 0 or any(
+                    self._cmp_val(c[i], c[idx[k - 1]], True, True) != 0
+                    for c in pvals):       # NaN == NaN, like the device
+                groups.append([])
+            groups[-1].append(i)
+        # evaluate inputs per lowered func
+        in_cols = []
+        for low in self.lowered:
+            vals = self._col_lists(eval_exprs_cpu(
+                low.inputs, batch,
+                [f"v{i}" for i in range(len(low.inputs))])) \
+                if low.inputs else []
+            in_cols.append(vals[0] if vals else None)
+        outs: List[List] = [[None] * n for _ in self.lowered]
+        for g in groups:
+            okeys = [[c[i] for c in ovals] for i in g]
+            for li, low in enumerate(self.lowered):
+                self._cpu_one(low, g, okeys, in_cols[li], outs[li], dirs)
+        # assemble: payload rows in sorted order + window cols
+        import pyarrow as pa
+        tab = pa.Table.from_arrays(
+            [c.arrow for c in batch.columns],
+            names=[f"c{i}" for i in range(batch.num_columns)])
+        taken = tab.take(pa.array(np.asarray(idx, dtype=np.int64)))
+        from spark_rapids_tpu.columnar.batch import batch_from_arrow
+        payload = batch_from_arrow(taken)
+        cols = list(payload.columns)
+        names = list(batch.names or payload.names)
+        for (name, _), low, out in zip(self.window_cols, self.lowered,
+                                       outs):
+            ordered = [out[i] for i in idx]
+            from spark_rapids_tpu.columnar.column import HostColumn
+            cols.append(HostColumn(pa.array(
+                ordered, type=T.to_arrow(low.dtype)), low.dtype))
+            names.append(name)
+        yield HostColumnarBatch(cols, n, names)
+
+    @staticmethod
+    def _col_lists(hb: HostColumnarBatch) -> List[List]:
+        return [c.to_pylist() for c in hb.columns]
+
+    @staticmethod
+    def _cmp_val(a, b, ascending, nulls_first):
+        if a is None or b is None:
+            if a is None and b is None:
+                return 0
+            first = -1 if nulls_first else 1
+            return first if a is None else -first
+        an = isinstance(a, float) and math.isnan(a)
+        bn = isinstance(b, float) and math.isnan(b)
+        if an or bn:                 # Spark: NaN sorts greatest
+            c = 0 if an and bn else (1 if an else -1)
+        else:
+            c = 0 if a == b else (1 if a > b else -1)
+        return c if ascending else -c
+
+    @classmethod
+    def _peers(cls, okeys, i, j, dirs):
+        """Order-key equality with Spark semantics (NaN == NaN)."""
+        return all(cls._cmp_val(a, b, asc, nf) == 0
+                   for a, b, (asc, nf) in zip(okeys[i], okeys[j], dirs))
+
+    def _cmp(self, pvals, ovals, dirs, i, j):
+        for c in pvals:
+            r = self._cmp_val(c[i], c[j], True, True)
+            if r:
+                return r
+        for c, (a, nf) in zip(ovals, dirs):
+            r = self._cmp_val(c[i], c[j], a, nf)
+            if r:
+                return r
+        return 0
+
+    def _cpu_one(self, low: LoweredWindow, g: List[int], okeys, vals, out,
+                 dirs):
+        kind = low.func[0]
+        cnt = len(g)
+        if kind == "row_number":
+            for k, i in enumerate(g):
+                out[i] = k + 1
+            return
+        if kind in ("rank", "dense_rank"):
+            rank = drank = 0
+            for k, i in enumerate(g):
+                if k == 0 or not self._peers(okeys, k, k - 1, dirs):
+                    rank = k + 1
+                    drank += 1
+                out[i] = rank if kind == "rank" else drank
+            return
+        if kind == "ntile":
+            ntiles = low.func[1]
+            base, rem = cnt // ntiles, cnt % ntiles
+            pos = 0
+            for t in range(ntiles):
+                size = base + (1 if t < rem else 0)
+                for _ in range(size):
+                    if pos < cnt:
+                        out[g[pos]] = t + 1
+                        pos += 1
+            return
+        if kind == "offset":
+            off, dflt = low.func[2], low.func[3]
+            for k, i in enumerate(g):
+                j = k + off
+                out[i] = vals[g[j]] if 0 <= j < cnt else dflt
+            return
+        _, agg, _, fk, lo, hi, cvo = low.func
+        # peer-group end (RANGE frames include peers of the current row):
+        # scan backward keeping the end of each equal-okey run
+        peer_end = [0] * cnt
+        k = cnt - 1
+        while k >= 0:
+            j = k
+            while j > 0 and self._peers(okeys, j - 1, k, dirs):
+                j -= 1
+            for m in range(j, k + 1):
+                peer_end[m] = k
+            k = j - 1
+        for k, i in enumerate(g):
+            if fk == "range":
+                a = 0 if lo is None else None
+                b = peer_end[k] if hi == 0 else cnt - 1 if hi is None \
+                    else None
+            else:
+                a = 0 if lo is None else max(0, k + lo)
+                b = cnt - 1 if hi is None else min(cnt - 1, k + hi)
+            window = [vals[g[m]] for m in range(a, b + 1)] if a <= b else []
+            if agg == "count":
+                out[i] = len(window) if not cvo else \
+                    len([v for v in window if v is not None])
+                continue
+            wv = [v for v in window if v is not None]
+            if not wv:
+                out[i] = None
+                continue
+            if agg == "sum":
+                out[i] = type(wv[0])(np.sum(np.asarray(wv)).item()) \
+                    if not isinstance(wv[0], float) else float(np.sum(wv))
+            elif agg == "mean":
+                out[i] = float(np.sum(wv) / len(wv))
+            elif agg == "min":
+                out[i] = min(wv)
+            elif agg == "max":
+                out[i] = max(wv)
+
+
+class TpuWindowExec(CpuWindowExec):
+    is_device = True
+
+    def execute_partition(self, pidx):
+        from spark_rapids_tpu.exec.joins import _empty_device
+        from spark_rapids_tpu.expressions.evaluator import eval_exprs_tpu
+        from spark_rapids_tpu.ops.batch_ops import concat_batches
+        from spark_rapids_tpu.ops.window_ops import compute_windows
+        batches = [b for b in self.child.execute_partition(pidx)
+                   if b.row_count]
+        if not batches:
+            return
+        batch = concat_batches(batches)
+        np_ = batch.num_columns
+        pkeys = self.spec.partition_exprs
+        okeys = [e for e, _, _ in self.spec.order_specs]
+        extra = list(pkeys) + list(okeys)
+        val_base = np_ + len(extra)
+        funcs = []
+        next_val = val_base
+        for low in self.lowered:
+            f = list(low.func)
+            if low.inputs:
+                f[f.index(-1)] = next_val
+                next_val += len(low.inputs)
+            funcs.append(tuple(f))
+        # evaluate pkeys+okeys+inputs once, append to the batch
+        all_inputs = [x for low in self.lowered for x in low.inputs]
+        aug_cols = list(batch.columns)
+        if extra or all_inputs:
+            kb = eval_exprs_tpu(extra + all_inputs, batch)
+            aug_cols += list(kb.columns)
+        aug = ColumnarBatch(aug_cols, batch.row_count)
+        order_specs = [(np_ + len(pkeys) + i, a, nf)
+                       for i, (_, a, nf) in
+                       enumerate(self.spec.order_specs)]
+        out = compute_windows(aug, np_, len(pkeys), order_specs, funcs,
+                              [low.dtype for low in self.lowered])
+        out.names = list(batch.names or
+                         [f.name for f in self.child.schema.fields]) + \
+            [name for name, _ in self.window_cols]
+        yield out
+
+
+# plan-rewrite registration (reference: GpuOverrides WindowExec rule +
+# GpuWindowExecMeta tagging)
+from spark_rapids_tpu.plan.overrides import register_exec  # noqa: E402
+
+
+def _window_tag(meta):
+    p = meta.plan
+    for _, w in p.window_cols:
+        reason = device_unsupported_reason(w)
+        if reason:
+            meta.will_not_work(reason)
+
+
+register_exec(
+    CpuWindowExec,
+    convert=lambda p, m: TpuWindowExec(p.window_cols, p.children[0]),
+    exprs_of=lambda p: (list(p.spec.partition_exprs) +
+                        [e for e, _, _ in p.spec.order_specs] +
+                        [x for low in p.lowered for x in low.inputs]),
+    extra_tag=_window_tag,
+    desc="window functions (fused sort + segmented scans)")

@@ -125,6 +125,16 @@ class Expression:
     def alias(self, name: str) -> "Alias":
         return Alias(self, name)
 
+    def over(self, spec) -> "Expression":
+        """agg_function.over(window_spec) -> WindowExpression (valid for
+        aggregate functions; ranking functions override on their class)."""
+        from spark_rapids_tpu.expressions.window_exprs import (
+            WindowExpression, _to_spec)
+        if not getattr(self, "is_aggregate", False):
+            raise TypeError(f"{self.name} cannot be used as a window "
+                            "function")
+        return WindowExpression(self, _to_spec(spec))
+
     def collect(self, pred) -> List["Expression"]:
         out = [self] if pred(self) else []
         for c in self.children:

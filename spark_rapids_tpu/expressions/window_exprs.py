@@ -1,0 +1,226 @@
+"""Window expressions: specs, frames, ranking/offset functions.
+
+Reference: GpuWindowExpression.scala (2133 LoC) maps Spark window specs to
+cuDF rolling/scan aggregations; GpuWindowExec variants pick batched
+algorithms (window/GpuWindowExecMeta).  Here a window expression =
+``WindowExpression(function, WindowSpecDef)`` where the function is either
+a ranking/offset function (RowNumber/Rank/DenseRank/Lag/Lead) or a regular
+AggregateFunction evaluated over a frame; the device lowering is one fused
+sort + segmented-scan program per spec (ops/window_ops.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import Expression, Literal
+
+# frame bound sentinels (Spark Window.unboundedPreceding/Following analogs)
+UNBOUNDED_PRECEDING = -(1 << 62)
+UNBOUNDED_FOLLOWING = (1 << 62)
+CURRENT_ROW = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowFrame:
+    """kind: "rows" or "range"; bounds are row/peer offsets with the
+    sentinels above.  Spark default with an ORDER BY: RANGE BETWEEN
+    UNBOUNDED PRECEDING AND CURRENT ROW (peer rows included); without:
+    the whole partition."""
+    kind: str = "range"
+    lo: int = UNBOUNDED_PRECEDING
+    hi: int = CURRENT_ROW
+
+    @property
+    def lo_unbounded(self) -> bool:
+        return self.lo <= UNBOUNDED_PRECEDING
+
+    @property
+    def hi_unbounded(self) -> bool:
+        return self.hi >= UNBOUNDED_FOLLOWING
+
+    def sig(self) -> Tuple:
+        lo = None if self.lo_unbounded else int(self.lo)
+        hi = None if self.hi_unbounded else int(self.hi)
+        return (self.kind, lo, hi)
+
+    def desc(self) -> str:
+        def b(v, side):
+            if v <= UNBOUNDED_PRECEDING:
+                return "UNBOUNDED PRECEDING"
+            if v >= UNBOUNDED_FOLLOWING:
+                return "UNBOUNDED FOLLOWING"
+            if v == 0:
+                return "CURRENT ROW"
+            return f"{-v} PRECEDING" if v < 0 else f"{v} FOLLOWING"
+        return f"{self.kind.upper()} BETWEEN {b(self.lo, 0)} AND {b(self.hi, 1)}"
+
+
+WHOLE_PARTITION = WindowFrame("range", UNBOUNDED_PRECEDING,
+                              UNBOUNDED_FOLLOWING)
+
+
+@dataclasses.dataclass
+class WindowSpecDef:
+    """partition_exprs + order (expr, ascending, nulls_first) + frame."""
+    partition_exprs: List[Expression]
+    order_specs: List[Tuple[Expression, bool, bool]]
+    frame: Optional[WindowFrame] = None
+
+    def effective_frame(self) -> WindowFrame:
+        if self.frame is not None:
+            return self.frame
+        if self.order_specs:
+            return WindowFrame("range", UNBOUNDED_PRECEDING, CURRENT_ROW)
+        return WHOLE_PARTITION
+
+    def group_key(self) -> Tuple:
+        """Specs with the same partition/order share one WindowExec pass
+        (structural equality; the frame may differ per expression)."""
+        return (tuple(e.sql() for e in self.partition_exprs),
+                tuple((e.sql(), a, nf) for e, a, nf in self.order_specs))
+
+    def desc(self) -> str:
+        p = ", ".join(e.sql() for e in self.partition_exprs)
+        o = ", ".join(f"{e.sql()} {'ASC' if a else 'DESC'}"
+                      for e, a, nf in self.order_specs)
+        return (f"PARTITION BY {p} ORDER BY {o} "
+                f"{self.effective_frame().desc()}")
+
+
+class WindowExpression(Expression):
+    """function OVER spec — the planner extracts these from projections and
+    lowers each spec group to one WindowExec (reference: Spark's
+    ExtractWindowExpressions + GpuWindowExecMeta).
+
+    The spec's partition/order expressions ARE children (after the
+    function) so generic tree transforms — reference binding above all —
+    reach them; ``with_children`` rebuilds the spec from the new list."""
+
+    def __init__(self, function: Expression, spec: WindowSpecDef):
+        super().__init__([function] + list(spec.partition_exprs) +
+                         [e for e, _, _ in spec.order_specs])
+        self._n_part = len(spec.partition_exprs)
+        self._order_dirs = [(a, nf) for _, a, nf in spec.order_specs]
+        self._frame = spec.frame
+
+    @property
+    def function(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def spec(self) -> WindowSpecDef:
+        pk = self.children[1:1 + self._n_part]
+        ok = self.children[1 + self._n_part:]
+        return WindowSpecDef(list(pk),
+                             [(e, a, nf) for e, (a, nf) in
+                              zip(ok, self._order_dirs)], self._frame)
+
+    @property
+    def data_type(self):
+        return self.function.data_type
+
+    def sql(self):
+        return f"{self.function.sql()} OVER ({self.spec.desc()})"
+
+
+class WindowFunction(Expression):
+    """Ranking/offset functions valid only inside a window spec."""
+
+    is_window_function = True
+
+    def over(self, spec) -> WindowExpression:
+        return WindowExpression(self, _to_spec(spec))
+
+
+def _to_spec(spec) -> WindowSpecDef:
+    from spark_rapids_tpu.functions import WindowBuilder
+    if isinstance(spec, WindowSpecDef):
+        return spec
+    if isinstance(spec, WindowBuilder):
+        return spec._spec
+    raise TypeError(f"not a window spec: {spec!r}")
+
+
+class RowNumber(WindowFunction):
+    @property
+    def data_type(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+    def sql(self):
+        return "row_number()"
+
+
+class Rank(WindowFunction):
+    @property
+    def data_type(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+    def sql(self):
+        return "rank()"
+
+
+class DenseRank(WindowFunction):
+    @property
+    def data_type(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+    def sql(self):
+        return "dense_rank()"
+
+
+class NTile(WindowFunction):
+    def __init__(self, n: int):
+        super().__init__([])
+        self.n = int(n)
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def sql(self):
+        return f"ntile({self.n})"
+
+
+class _OffsetFunction(WindowFunction):
+    """lag/lead: value at a fixed row offset within the partition; out of
+    range yields the default (reference: GpuLag/GpuLead in
+    GpuWindowExpression.scala)."""
+
+    direction = 0
+
+    def __init__(self, child: Expression, offset: int = 1,
+                 default: Optional[Expression] = None):
+        super().__init__([child])
+        self.offset = int(offset)
+        self.default = default
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def sql(self):
+        return (f"{type(self).__name__.lower()}({self.children[0].sql()}, "
+                f"{self.offset})")
+
+
+class Lag(_OffsetFunction):
+    direction = -1
+
+
+class Lead(_OffsetFunction):
+    direction = 1

@@ -103,3 +103,118 @@ def broadcast(df):
     out = copy.copy(df)
     out._broadcast_hint = True
     return out
+
+
+# -- window functions --------------------------------------------------------
+
+class WindowBuilder:
+    """pyspark.sql.Window analog: Window.partition_by("k").order_by("v")
+    [.rows_between(a, b) | .range_between(a, b)]."""
+
+    def __init__(self, spec=None):
+        from spark_rapids_tpu.expressions.window_exprs import WindowSpecDef
+        self._spec = spec or WindowSpecDef([], [], None)
+
+    def partition_by(self, *cols) -> "WindowBuilder":
+        from spark_rapids_tpu.expressions.window_exprs import WindowSpecDef
+        return WindowBuilder(WindowSpecDef(
+            [_expr(c) for c in cols], self._spec.order_specs,
+            self._spec.frame))
+
+    partitionBy = partition_by
+
+    def order_by(self, *cols) -> "WindowBuilder":
+        from spark_rapids_tpu.exec.sort import SortSpec
+        from spark_rapids_tpu.expressions.window_exprs import WindowSpecDef
+        specs = []
+        for c in cols:
+            if isinstance(c, SortSpec):
+                specs.append((c.expr, c.ascending, c.effective_nulls_first))
+            else:
+                specs.append((_expr(c), True, True))
+        return WindowBuilder(WindowSpecDef(
+            self._spec.partition_exprs, specs, self._spec.frame))
+
+    orderBy = order_by
+
+    def rows_between(self, start: int, end: int) -> "WindowBuilder":
+        from spark_rapids_tpu.expressions.window_exprs import (WindowFrame,
+                                                               WindowSpecDef)
+        return WindowBuilder(WindowSpecDef(
+            self._spec.partition_exprs, self._spec.order_specs,
+            WindowFrame("rows", int(start), int(end))))
+
+    rowsBetween = rows_between
+
+    def range_between(self, start: int, end: int) -> "WindowBuilder":
+        from spark_rapids_tpu.expressions.window_exprs import (WindowFrame,
+                                                               WindowSpecDef)
+        return WindowBuilder(WindowSpecDef(
+            self._spec.partition_exprs, self._spec.order_specs,
+            WindowFrame("range", int(start), int(end))))
+
+    rangeBetween = range_between
+
+
+class _WindowNamespace:
+    """The class-level entry points: Window.partition_by(...), plus the
+    frame-bound sentinels."""
+
+    @property
+    def unboundedPreceding(self):
+        from spark_rapids_tpu.expressions import window_exprs as W
+        return W.UNBOUNDED_PRECEDING
+
+    unbounded_preceding = unboundedPreceding
+
+    @property
+    def unboundedFollowing(self):
+        from spark_rapids_tpu.expressions import window_exprs as W
+        return W.UNBOUNDED_FOLLOWING
+
+    unbounded_following = unboundedFollowing
+
+    currentRow = current_row = 0
+
+    def partition_by(self, *cols):
+        return WindowBuilder().partition_by(*cols)
+
+    partitionBy = partition_by
+
+    def order_by(self, *cols):
+        return WindowBuilder().order_by(*cols)
+
+    orderBy = order_by
+
+
+Window = _WindowNamespace()
+
+
+def row_number():
+    from spark_rapids_tpu.expressions.window_exprs import RowNumber
+    return RowNumber([])
+
+
+def rank():
+    from spark_rapids_tpu.expressions.window_exprs import Rank
+    return Rank([])
+
+
+def dense_rank():
+    from spark_rapids_tpu.expressions.window_exprs import DenseRank
+    return DenseRank([])
+
+
+def ntile(n: int):
+    from spark_rapids_tpu.expressions.window_exprs import NTile
+    return NTile(n)
+
+
+def lag(e, offset: int = 1, default=None):
+    from spark_rapids_tpu.expressions.window_exprs import Lag
+    return Lag(_expr(e), offset, None if default is None else lit(default))
+
+
+def lead(e, offset: int = 1, default=None):
+    from spark_rapids_tpu.expressions.window_exprs import Lead
+    return Lead(_expr(e), offset, None if default is None else lit(default))

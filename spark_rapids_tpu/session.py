@@ -125,11 +125,72 @@ class DataFrame:
     def select(self, *exprs) -> "DataFrame":
         from spark_rapids_tpu.exec.basic import CpuProjectExec
         bound = [bind_references(_to_expr(e), self.schema) for e in exprs]
-        return DataFrame(CpuProjectExec(bound, self._plan), self._session)
+        plan, bound = self._plan_windows(bound)
+        return DataFrame(CpuProjectExec(bound, plan), self._session)
+
+    def _plan_windows(self, bound_exprs):
+        """Extracts WindowExpressions from a projection: one CpuWindowExec
+        per (partition, order) spec group appending columns, then rewrites
+        the projection to reference them (Spark's ExtractWindowExpressions
+        + the reference's GpuWindowExecMeta grouping)."""
+        from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
+        from spark_rapids_tpu.exec.window import CpuWindowExec
+        from spark_rapids_tpu.expressions.base import BoundReference
+        from spark_rapids_tpu.expressions.window_exprs import WindowExpression
+        from spark_rapids_tpu.plan.partitioning import (HashPartitioning,
+                                                        SinglePartitioning)
+        wexprs = []
+        for e in bound_exprs:
+            wexprs.extend(e.collect(
+                lambda x: isinstance(x, WindowExpression)))
+        if not wexprs:
+            return self._plan, bound_exprs
+        groups = {}
+        for w in wexprs:
+            groups.setdefault(w.spec.group_key(), []).append(w)
+        plan = self._plan
+        replacement = {}
+        for key, ws in groups.items():
+            spec = ws[0].spec
+            if plan.num_partitions > 1:
+                if spec.partition_exprs:
+                    part = HashPartitioning(spec.partition_exprs,
+                                            plan.num_partitions)
+                else:
+                    part = SinglePartitioning()
+                plan = CpuShuffleExchangeExec(part, plan)
+            base = len(plan.schema.fields)
+            cols = [(f"_w{base + i}", w) for i, w in enumerate(ws)]
+            plan = CpuWindowExec(cols, plan)
+            for i, w in enumerate(ws):
+                f = plan.schema.fields[base + i]
+                replacement[id(w)] = BoundReference(base + i, f.data_type,
+                                                    f.nullable)
+
+        def rewrite(e):
+            # top-down identity rewrite (transform_up copies nodes before
+            # visiting, which would defeat the id() lookup)
+            if id(e) in replacement:
+                return replacement[id(e)]
+            if not e.children:
+                return e
+            return e.with_children([rewrite(c) for c in e.children])
+
+        return plan, [rewrite(e) for e in bound_exprs]
+
+    @staticmethod
+    def _no_windows(expr, where: str):
+        from spark_rapids_tpu.expressions.window_exprs import WindowExpression
+        if expr.collect(lambda x: isinstance(x, WindowExpression)):
+            raise ValueError(
+                f"window expressions are not allowed in {where}; compute "
+                "them in a select()/with_column() first")
+        return expr
 
     def filter(self, condition) -> "DataFrame":
         from spark_rapids_tpu.exec.basic import CpuFilterExec
         cond = bind_references(_to_expr(condition), self.schema)
+        self._no_windows(cond, "filter()")
         return DataFrame(CpuFilterExec(cond, self._plan), self._session)
 
     where = filter
@@ -147,7 +208,8 @@ class DataFrame:
         if not replaced:
             exprs.append(Alias(_to_expr(expr), name))
         bound = [bind_references(e, self.schema) for e in exprs]
-        return DataFrame(CpuProjectExec(bound, self._plan), self._session)
+        plan, bound = self._plan_windows(bound)
+        return DataFrame(CpuProjectExec(bound, plan), self._session)
 
     def limit(self, n: int) -> "DataFrame":
         from spark_rapids_tpu.exec.basic import (CpuGlobalLimitExec,
@@ -197,6 +259,8 @@ class DataFrame:
             else:
                 specs.append(SortSpec(
                     bind_references(_to_expr(c), self.schema), kw_ascending))
+        for s in specs:
+            self._no_windows(s.expr, "sort keys")
         return specs
 
     def order_by(self, *cols, ascending: bool = True) -> "DataFrame":
@@ -313,7 +377,8 @@ class DataFrame:
     crossJoin = cross_join
 
     def group_by(self, *cols) -> "GroupedData":
-        keys = [bind_references(_to_expr(c), self.schema) for c in cols]
+        keys = [self._no_windows(bind_references(_to_expr(c), self.schema),
+                                 "grouping keys") for c in cols]
         return GroupedData(self, keys)
 
     groupBy = group_by
@@ -405,6 +470,7 @@ class GroupedData:
             if not isinstance(e, AggregateFunction):
                 raise TypeError(f"not an aggregate expression: {e}")
             e = bind_references(e, schema)
+            DataFrame._no_windows(e, "aggregations")
             aggs.append(AggregateExpression(e, name or e.sql()))
         child = self._df._plan
         if child.num_partitions == 1:

@@ -1,0 +1,231 @@
+"""Differential window function tests (reference: integration_tests
+window_function_test.py over assert_gpu_and_cpu_are_equal_collect)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.functions import Window
+
+from tests.asserts import assert_tpu_and_cpu_are_equal_collect
+
+
+def _data():
+    return {
+        "g": [1, 1, 1, 2, 2, None, 3, 3, 3, 3],
+        "o": [3, 1, 2, 5, 5, 1, None, 2, 9, 4],
+        "v": [1.0, 2.0, None, 4.0, 5.0, 6.0, 7.0, None, 9.0, 10.0],
+    }
+
+
+W_GO = lambda: Window.partition_by("g").order_by("o")
+
+
+@pytest.mark.parametrize("fn", [F.row_number, F.rank, F.dense_rank],
+                         ids=["row_number", "rank", "dense_rank"])
+@pytest.mark.parametrize("nparts", [1, 3])
+def test_ranking(fn, nparts):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_data(), num_partitions=nparts)
+        .select(F.col("g"), F.col("o"),
+                F.Alias(fn().over(W_GO()), "r")),
+        ignore_order=True)
+
+
+def test_rank_with_ties():
+    # o has duplicates within g=2: rank skips, dense_rank doesn't
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_data(), num_partitions=2)
+        .select(F.col("g"), F.col("o"),
+                F.Alias(F.rank().over(W_GO()), "r"),
+                F.Alias(F.dense_rank().over(W_GO()), "dr"),
+                F.Alias(F.row_number().over(W_GO()), "rn")),
+        ignore_order=True)
+
+
+def test_ntile():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_data(), num_partitions=2)
+        .select(F.col("g"), F.col("o"),
+                F.Alias(F.ntile(3).over(W_GO()), "t")),
+        ignore_order=True)
+
+
+@pytest.mark.parametrize("off", [1, 2])
+def test_lag_lead(off):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_data(), num_partitions=2)
+        .select(F.col("g"), F.col("o"), F.col("v"),
+                F.Alias(F.lag("v", off).over(W_GO()), "lg"),
+                F.Alias(F.lead("v", off).over(W_GO()), "ld")),
+        ignore_order=True)
+
+
+@pytest.mark.parametrize("agg", [F.sum, F.min, F.max, F.count, F.avg],
+                         ids=["sum", "min", "max", "count", "avg"])
+def test_running_agg_default_frame(agg):
+    # default frame with ORDER BY: RANGE unbounded-preceding..current row
+    # (peers included — o=5 is duplicated in g=2)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_data(), num_partitions=2)
+        .select(F.col("g"), F.col("o"), F.col("v"),
+                F.Alias(agg("v").over(W_GO()), "a")),
+        ignore_order=True)
+
+
+@pytest.mark.parametrize("agg", [F.sum, F.min, F.max, F.count, F.avg],
+                         ids=["sum", "min", "max", "count", "avg"])
+def test_whole_partition_agg(agg):
+    # no ORDER BY -> whole partition frame
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_data(), num_partitions=2)
+        .select(F.col("g"), F.col("v"),
+                F.Alias(agg("v").over(Window.partition_by("g")), "a")),
+        ignore_order=True)
+
+
+@pytest.mark.parametrize("frame", [(-1, 1), (-2, 0), (0, 2), (-3, -1),
+                                   (1, 3)])
+@pytest.mark.parametrize("agg", [F.sum, F.min, F.max, F.count, F.avg],
+                         ids=["sum", "min", "max", "count", "avg"])
+def test_bounded_rows_frames(agg, frame):
+    lo, hi = frame
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_data(), num_partitions=2)
+        .select(F.col("g"), F.col("o"), F.col("v"),
+                F.Alias(agg("v").over(
+                    W_GO().rows_between(lo, hi)), "a")),
+        ignore_order=True)
+
+
+@pytest.mark.parametrize("agg", [F.sum, F.min, F.max],
+                         ids=["sum", "min", "max"])
+def test_rows_unbounded_frames(agg):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_data(), num_partitions=2)
+        .select(F.col("g"), F.col("o"), F.col("v"),
+                F.Alias(agg("v").over(W_GO().rows_between(
+                    Window.unboundedPreceding, Window.currentRow)), "run"),
+                F.Alias(agg("v").over(W_GO().rows_between(
+                    0, Window.unboundedFollowing)), "rev"),
+                F.Alias(agg("v").over(W_GO().rows_between(
+                    Window.unboundedPreceding,
+                    Window.unboundedFollowing)), "all")),
+        ignore_order=True)
+
+
+def test_multiple_specs_one_select():
+    # two different partition/order specs => two chained WindowExecs
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_data(), num_partitions=2)
+        .select(F.col("g"), F.col("o"), F.col("v"),
+                F.Alias(F.row_number().over(W_GO()), "rn"),
+                F.Alias(F.sum("v").over(
+                    Window.partition_by("o").order_by("g")), "s2")),
+        ignore_order=True)
+
+
+def test_window_no_partition():
+    # global window: single partition ordering over everything
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_data(), num_partitions=3)
+        .select(F.col("o"), F.col("v"),
+                F.Alias(F.row_number().over(Window.order_by("o", "v")),
+                        "rn")),
+        ignore_order=True)
+
+
+def test_window_string_partition_keys():
+    data = {"g": ["a", "a", "b", None, "b", "a"],
+            "o": [3, 1, 2, 5, 4, 2],
+            "v": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(data, num_partitions=2)
+        .select(F.col("g"), F.col("o"),
+                F.Alias(F.row_number().over(W_GO()), "rn"),
+                F.Alias(F.sum("v").over(W_GO()), "rs")),
+        ignore_order=True)
+
+
+def test_window_desc_order():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_data(), num_partitions=2)
+        .select(F.col("g"), F.col("o"),
+                F.Alias(F.row_number().over(
+                    Window.partition_by("g").order_by(F.desc("o"))), "rn")),
+        ignore_order=True)
+
+
+def test_window_with_column_and_expr():
+    # window result used inside a bigger projection expression
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_data(), num_partitions=2)
+        .with_column("pct", F.col("v") / F.sum("v").over(
+            Window.partition_by("g"))),
+        ignore_order=True)
+
+
+def test_window_int_sum_types():
+    data = {"g": [1, 1, 2, 2], "o": [1, 2, 1, 2],
+            "i": np.array([5, 6, 7, 8], dtype=np.int32)}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(data, num_partitions=2)
+        .select(F.col("g"),
+                F.Alias(F.sum("i").over(W_GO()), "s"),
+                F.Alias(F.count("*").over(W_GO()), "c")),
+        ignore_order=True)
+
+
+def test_window_larger_random():
+    rng = np.random.default_rng(7)
+    n = 4000
+    data = {"g": rng.integers(0, 50, n), "o": rng.integers(0, 1000, n),
+            "v": rng.normal(size=n)}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(data, num_partitions=3)
+        .select(F.col("g"), F.col("o"),
+                F.Alias(F.row_number().over(W_GO()), "rn"),
+                F.Alias(F.sum("v").over(W_GO().rows_between(-3, 3)), "s")),
+        ignore_order=True)
+
+
+def test_lag_lead_default():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_data(), num_partitions=2)
+        .select(F.col("g"), F.col("o"),
+                F.Alias(F.lag("v", 1, -99.0).over(W_GO()), "lg"),
+                F.Alias(F.lead("v", 2, -1.0).over(W_GO()), "ld")),
+        ignore_order=True)
+
+
+def test_window_nan_order_key_peers():
+    # NaN order keys are peers of each other (Spark: NaN == NaN in ordering)
+    data = {"g": [1, 1, 1, 1], "o": [float("nan"), float("nan"), 1.0, 2.0],
+            "v": [1.0, 2.0, 3.0, 4.0]}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(data)
+        .select(F.col("o"), F.Alias(F.rank().over(W_GO()), "r"),
+                F.Alias(F.sum("v").over(W_GO()), "rs")),
+        ignore_order=True)
+
+
+def test_window_rejected_outside_projection():
+    import pytest as _pt
+    from tests.asserts import cpu_session
+    s = cpu_session()
+    df = s.create_dataframe(_data())
+    w = F.row_number().over(W_GO())
+    with _pt.raises(ValueError, match="window expressions"):
+        df.filter(w <= 1)
+    with _pt.raises(ValueError, match="window expressions"):
+        df.order_by(w)
+
+
+def test_bounded_range_frame_rejected():
+    import pytest as _pt
+    from tests.asserts import cpu_session
+    s = cpu_session()
+    df = s.create_dataframe(_data())
+    with _pt.raises(NotImplementedError, match="RANGE"):
+        df.select(F.Alias(F.sum("v").over(
+            W_GO().range_between(-1, 0)), "a")).collect()
