@@ -67,6 +67,11 @@ def lower_window_expr(wexpr: WindowExpression) -> LoweredWindow:
                 raise NotImplementedError(
                     "lag/lead default must be a scalar literal over a "
                     "non-string column")
+            if str(f.default.data_type) != str(f.children[0].data_type):
+                raise TypeError(
+                    f"lag/lead default type {f.default.data_type} does not "
+                    f"match column type {f.children[0].data_type}; cast "
+                    "the default explicitly")
             dflt = f.default.value
         return LoweredWindow(("offset", -1, off, dflt), [f.children[0]],
                              f.data_type)
@@ -115,8 +120,9 @@ def device_unsupported_reason(wexpr: WindowExpression) -> Optional[str]:
     _, agg, _, fk, lo, hi, _ = low.func
     if agg in ("min", "max"):
         if low.inputs and isinstance(low.inputs[0].data_type,
-                                     (T.StringType, T.BinaryType)):
-            return "string min/max window frames not on device yet"
+                                     (T.StringType, T.BinaryType,
+                                      T.BooleanType)):
+            return "string/boolean min/max window frames not on device yet"
         if lo is not None and hi is not None and \
                 (hi - lo + 1) > MAX_UNROLLED_FRAME:
             return (f"bounded min/max frame wider than "

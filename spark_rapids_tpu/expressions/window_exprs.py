@@ -186,6 +186,8 @@ class DenseRank(WindowFunction):
 class NTile(WindowFunction):
     def __init__(self, n: int):
         super().__init__([])
+        if int(n) < 1:
+            raise ValueError(f"ntile() requires n >= 1, got {n}")
         self.n = int(n)
 
     @property

@@ -1,0 +1,15 @@
+"""Distributed execution: device meshes and collective data movement.
+
+Reference: SURVEY.md §2.8 — the reference's distributed backend is a UCX
+RDMA peer-to-peer shuffle (shuffle-plugin/, RapidsShuffleClient/Server,
+bounce buffers, heartbeats).  The TPU-native equivalent replaces the whole
+transport stack with XLA collectives over ICI (within a slice) / DCN
+(across slices): a hash shuffle is ONE fused program — partition, pack,
+``all_to_all``, compact — with no serialization, no bounce buffers, and no
+control-plane protocol (the collective is the protocol).
+"""
+
+from spark_rapids_tpu.parallel.mesh import (MeshContext,  # noqa: F401
+                                            data_mesh)
+from spark_rapids_tpu.parallel.collective import (  # noqa: F401
+    collective_hash_shuffle, shard_batch, unshard_batch)

@@ -1,0 +1,59 @@
+"""Device mesh management (the GpuDeviceManager analog for multi-chip).
+
+Reference: GpuDeviceManager.scala picks ONE device per executor process;
+on TPU the executor instead owns a ``jax.sharding.Mesh`` slice and SPMD
+programs span it.  The canonical SQL-engine mesh is 1-D over a ``data``
+axis (partition data-parallelism, SURVEY.md §2.9); multi-host pods keep
+the same mesh with devices spanning hosts — XLA routes collectives over
+ICI within a slice and DCN across slices without code changes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+
+@dataclasses.dataclass
+class MeshContext:
+    mesh: object                 # jax.sharding.Mesh
+    data_axis: str = "data"
+
+    @property
+    def num_devices(self) -> int:
+        return int(self.mesh.devices.size)
+
+    def data_sharding(self, *extra_dims_replicated: int):
+        """NamedSharding placing axis 0 on the data axis."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        spec = PartitionSpec(self.data_axis)
+        return NamedSharding(self.mesh, spec)
+
+    def replicated(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+        return NamedSharding(self.mesh, PartitionSpec())
+
+
+_ACTIVE: Optional[MeshContext] = None
+
+
+def data_mesh(n_devices: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> MeshContext:
+    """Builds the 1-D data-parallel mesh over available devices."""
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    mesh = Mesh(np.asarray(devs), ("data",))
+    return MeshContext(mesh)
+
+
+def set_active_mesh(ctx: Optional[MeshContext]) -> None:
+    global _ACTIVE
+    _ACTIVE = ctx
+
+
+def active_mesh() -> Optional[MeshContext]:
+    return _ACTIVE
