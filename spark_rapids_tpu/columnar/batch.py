@@ -30,8 +30,16 @@ class ColumnarBatch:
     names: Optional[List[str]] = None
 
     def __post_init__(self):
+        from spark_rapids_tpu.columnar.column import DeferredCount
+        deferred = isinstance(self.row_count, DeferredCount)
         for c in self.columns:
-            if c.row_count != self.row_count:
+            if deferred or isinstance(c.row_count, DeferredCount):
+                # identity check only — never force a device sync here
+                if c.row_count is not self.row_count:
+                    raise ValueError(
+                        "deferred-count batch requires every column to "
+                        "share the batch's count object")
+            elif c.row_count != self.row_count:
                 raise ValueError(
                     f"column rows {c.row_count} != batch rows {self.row_count}")
         if self.columns:

@@ -44,6 +44,134 @@ def bucket_strlen(n: int) -> int:
     return max(MIN_STR_BUCKET, _next_pow2(n))
 
 
+class DeferredCount:
+    """A row count living on device until the host actually needs it.
+
+    Host round-trips dominate accelerator latency (a scalar fetch over the
+    device tunnel costs ~10-100ms — far more than dispatching a 1M-row
+    kernel), so filters/aggregations keep their output row counts as 0-d
+    device arrays.  Chained device kernels read ``traceable()`` (no sync);
+    any host-side use (int conversion, comparisons, arithmetic) forces ONE
+    cached sync.  The reference has no analog: cuDF kernels return counts
+    synchronously because CUDA launch+sync latency is microseconds.
+    """
+
+    __slots__ = ("_dev", "_val")
+
+    def __init__(self, dev, val=None):
+        self._dev = dev
+        self._val = val
+
+    def traceable(self):
+        """What device kernels should consume (0-d array; no sync)."""
+        return self._dev if self._val is None else self._val
+
+    @property
+    def is_forced(self) -> bool:
+        return self._val is not None
+
+    def _force(self) -> int:
+        if self._val is None:
+            self._val = int(self._dev)
+        return self._val
+
+    # device-side interop (jnp ops accept this without a sync)
+    def __jax_array__(self):
+        return _jnp().asarray(self.traceable())
+
+    # host-side interop (forces the sync, once)
+    def __int__(self):
+        return self._force()
+
+    def __index__(self):
+        return self._force()
+
+    def __bool__(self):
+        return self._force() != 0
+
+    def __hash__(self):
+        return hash(self._force())
+
+    def __repr__(self):
+        return str(self._val) if self._val is not None else "<deferred>"
+
+    @staticmethod
+    def _v(o):
+        return o._force() if isinstance(o, DeferredCount) else o
+
+    def __eq__(self, o):
+        if self is o:
+            return True             # same deferred count: no sync needed
+        return self._force() == DeferredCount._v(o)
+
+    def __ne__(self, o):
+        return not self.__eq__(o)
+
+    def __lt__(self, o):
+        return self._force() < DeferredCount._v(o)
+
+    def __le__(self, o):
+        return self._force() <= DeferredCount._v(o)
+
+    def __gt__(self, o):
+        return self._force() > DeferredCount._v(o)
+
+    def __ge__(self, o):
+        return self._force() >= DeferredCount._v(o)
+
+    def __add__(self, o):
+        return self._force() + DeferredCount._v(o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._force() - DeferredCount._v(o)
+
+    def __rsub__(self, o):
+        return DeferredCount._v(o) - self._force()
+
+    def __mul__(self, o):
+        return self._force() * DeferredCount._v(o)
+
+    __rmul__ = __mul__
+
+    def __floordiv__(self, o):
+        return self._force() // DeferredCount._v(o)
+
+    def __truediv__(self, o):
+        return self._force() / DeferredCount._v(o)
+
+    def __rtruediv__(self, o):
+        return DeferredCount._v(o) / self._force()
+
+    def __mod__(self, o):
+        return self._force() % DeferredCount._v(o)
+
+
+def rc_traceable(rc):
+    """Row count as a jit argument: device scalar if deferred (no sync)."""
+    return rc.traceable() if isinstance(rc, DeferredCount) else rc
+
+
+def sum_counts(rcs) -> int:
+    """Totals row counts with at most ONE device sync (batches already
+    forced contribute host-side; the rest are summed on device first)."""
+    jnp = _jnp()
+    static = 0
+    deferred = []
+    for rc in rcs:
+        if isinstance(rc, DeferredCount) and not rc.is_forced:
+            deferred.append(rc.traceable())
+        else:
+            static += int(rc)
+    if deferred:
+        total = deferred[0]
+        for d in deferred[1:]:
+            total = total + d
+        static += int(total)
+    return static
+
+
 _X64_READY = False
 
 

@@ -246,9 +246,10 @@ def segmented_aggregate(batch: ColumnarBatch, num_keys: int,
 
         fn = jax.jit(run)
         _AGG_CACHE[key] = fn
+    from spark_rapids_tpu.columnar.column import DeferredCount, rc_traceable
     arrs = [(c.data, c.validity, c.lengths) for c in batch.columns]
-    outs, ng = fn(arrs, batch.row_count)
-    n = int(ng)
+    outs, ng = fn(arrs, rc_traceable(batch.row_count))
+    n = DeferredCount(ng)      # group count stays on device
     names = (batch.names or [f"c{i}" for i in range(batch.num_columns)])
     out_names = names[:num_keys] + [f"a{j}" for j in range(len(specs))]
     cols = []
@@ -261,6 +262,6 @@ def segmented_aggregate(batch: ColumnarBatch, num_keys: int,
             if ln is None and dt.np_dtype is not None and \
                     d.dtype != np.dtype(dt.np_dtype):
                 d = d.astype(dt.np_dtype)
-        gvalid = jnp.arange(d.shape[0]) < n
+        gvalid = jnp.arange(d.shape[0]) < ng
         cols.append(DeviceColumn(d, v & gvalid, n, dt, ln))
     return ColumnarBatch(cols, n, out_names)

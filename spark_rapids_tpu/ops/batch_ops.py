@@ -18,7 +18,9 @@ import numpy as np
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
-from spark_rapids_tpu.columnar.column import DeviceColumn, bucket_rows
+from spark_rapids_tpu.columnar.column import (DeferredCount, DeviceColumn,
+                                              bucket_rows, rc_traceable,
+                                              sum_counts)
 
 
 def _jx():
@@ -80,7 +82,8 @@ def compact_batch(batch: ColumnarBatch, keep) -> ColumnarBatch:
         _COMPACT_CACHE[key] = fn
     arrs = [(c.data, c.validity, c.lengths) for c in batch.columns]
     outs, cnt = fn(arrs, keep)
-    row_count = int(cnt)
+    # count stays on device: chained kernels consume it sync-free
+    row_count = DeferredCount(cnt)
     cols = [DeviceColumn(d, v, row_count, c.data_type, ln)
             for (d, v, ln), c in zip(outs, batch.columns)]
     return ColumnarBatch(cols, row_count, batch.names)
@@ -97,8 +100,14 @@ def slice_batch(batch: ColumnarBatch, start: int, length: int) -> ColumnarBatch:
 def take_front(batch: ColumnarBatch, n: int) -> ColumnarBatch:
     """First n rows (limit); no data movement, just count + validity mask."""
     jnp = _jx()
-    n = min(n, batch.row_count)
-    keep = jnp.arange(batch.bucket) < n
+    rc = batch.row_count
+    if isinstance(rc, DeferredCount) and not rc.is_forced:
+        n_t = jnp.minimum(jnp.asarray(n), rc.traceable())
+        n = DeferredCount(n_t)
+    else:
+        n = min(n, int(rc))
+        n_t = n
+    keep = jnp.arange(batch.bucket) < n_t
     cols = [DeviceColumn(c.data, c.validity & keep, n, c.data_type, c.lengths)
             for c in batch.columns]
     return ColumnarBatch(cols, n, batch.names)
@@ -110,12 +119,17 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
     reference: GpuCoalesceBatches/ConcatAndConsumeAll use cudf concat; here
     one jitted scatter per (input shapes) signature.
     """
-    batches = [b for b in batches if b.row_count > 0] or list(batches[:1])
+    batches = list(batches)
+    if len(batches) > 1:
+        # drop known-empty batches without forcing deferred counts
+        kept = [b for b in batches
+                if isinstance(b.row_count, DeferredCount) or b.row_count > 0]
+        batches = kept or batches[:1]
     if len(batches) == 1:
         return batches[0]
     import jax
     jnp = _jx()
-    total = sum(b.row_count for b in batches)
+    total = sum_counts([b.row_count for b in batches])   # one sync at most
     out_bucket = bucket_rows(total)
     ncols = batches[0].num_columns
     # per-column max string width across inputs
@@ -130,9 +144,9 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
     key = ("concat", out_bucket,
            tuple(tuple(_col_sig(c) for c in b.columns) for b in batches))
     fn = _CONCAT_CACHE.get(key)
-    counts = [b.row_count for b in batches]  # dynamic: passed as traced array
     if fn is None:
-        def run(all_arrs, offsets, counts_arr):
+        def run(all_arrs, counts_arr):
+            offsets = jnp.cumsum(counts_arr) - counts_arr
             outs = []
             for ci in range(ncols):
                 tgt_rows = out_bucket
@@ -162,11 +176,11 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
 
         fn = jax.jit(run)
         _CONCAT_CACHE[key] = fn
-    offsets = np.zeros(len(batches), dtype=np.int64)
-    offsets[1:] = np.cumsum(counts)[:-1]
+    counts_arr = jnp.stack([jnp.asarray(rc_traceable(b.row_count),
+                                        dtype=np.int64) for b in batches])
     all_arrs = [[(c.data, c.validity, c.lengths) for c in b.columns]
                 for b in batches]
-    outs = fn(all_arrs, jnp.asarray(offsets), jnp.asarray(np.asarray(counts)))
+    outs = fn(all_arrs, counts_arr)
     cols = []
     for (d, v, ln), proto in zip(outs, batches[0].columns):
         cols.append(DeviceColumn(d, v, total, proto.data_type, ln))

@@ -149,8 +149,9 @@ def build_side(batch: ColumnarBatch, key_ordinals: Sequence[int],
 
         fn = jax.jit(run)
         _BUILD_CACHE[key] = fn
+    from spark_rapids_tpu.columnar.column import rc_traceable
     arrs = [(c.data, c.validity, c.lengths) for c in kcols]
-    hs, perm = fn(arrs, batch.row_count)
+    hs, perm = fn(arrs, rc_traceable(batch.row_count))
     return BuiltSide(batch, key_ordinals, hs, perm, widths)
 
 
@@ -183,7 +184,8 @@ def _probe_ranges(probe_keys: List[DeviceColumn], built: BuiltSide):
         fn = jax.jit(run)
         _PROBE_CACHE[key] = fn
     arrs = [(c.data, c.validity, c.lengths) for c in probe_keys]
-    lo, counts, offsets, total = fn(arrs, probe_keys[0].row_count,
+    from spark_rapids_tpu.columnar.column import rc_traceable
+    lo, counts, offsets, total = fn(arrs, rc_traceable(probe_keys[0].row_count),
                                     built.hashes_sorted)
     return lo, counts, offsets, int(total)
 
@@ -241,8 +243,9 @@ def _expand_verify(probe: ColumnarBatch, probe_ordinals, built: BuiltSide,
         _PAIR_CACHE[key] = fn
     parrs = [(c.data, c.validity, c.lengths) for c in pkeys]
     barrs = [(c.data, c.validity, c.lengths) for c in bkeys]
+    from spark_rapids_tpu.columnar.column import rc_traceable as _rt
     l_idx, r_idx, keep = fn(parrs, barrs, lo, offsets, total, built.perm,
-                            probe.row_count, built.batch.row_count)
+                            _rt(probe.row_count), _rt(built.batch.row_count))
     return l_idx, r_idx, keep, out_bucket
 
 
@@ -320,7 +323,8 @@ def unmatched_positions(flags, row_count: int):
 
         fn = jax.jit(run)
         _FINAL_CACHE[key] = fn
-    idx, n = fn(flags, row_count)
+    from spark_rapids_tpu.columnar.column import rc_traceable as _rt2
+    idx, n = fn(flags, _rt2(row_count))
     return idx, int(n)
 
 

@@ -161,8 +161,9 @@ def sort_permutation(batch: ColumnarBatch, orders: Sequence[SortOrder]):
 
         fn = jax.jit(run)
         _SORT_CACHE[key] = fn
+    from spark_rapids_tpu.columnar.column import rc_traceable
     arrs = [(c.data, c.validity, c.lengths) for c in batch.columns]
-    return fn(arrs, batch.row_count)
+    return fn(arrs, rc_traceable(batch.row_count))
 
 
 def sort_batch(batch: ColumnarBatch, orders: Sequence[SortOrder]) -> ColumnarBatch:

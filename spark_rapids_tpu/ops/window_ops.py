@@ -155,8 +155,9 @@ def compute_windows(batch: ColumnarBatch, num_payload: int, num_pkeys: int,
 
         fn = jax.jit(run)
         _WINDOW_CACHE[key] = fn
+    from spark_rapids_tpu.columnar.column import rc_traceable
     arrs = [(c.data, c.validity, c.lengths) for c in batch.columns]
-    payload, outs = fn(arrs, batch.row_count)
+    payload, outs = fn(arrs, rc_traceable(batch.row_count))
     cols = []
     for (d, v, ln), proto in zip(payload, batch.columns[:num_payload]):
         cols.append(DeviceColumn(d, v, batch.row_count, proto.data_type, ln))

@@ -27,12 +27,11 @@ one entry per device).
 
 from __future__ import annotations
 
-import functools
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from spark_rapids_tpu.columnar.batch import ColumnarBatch, HostColumnarBatch
+from spark_rapids_tpu.columnar.batch import HostColumnarBatch
 from spark_rapids_tpu.columnar.column import DeviceColumn, bucket_rows
 from spark_rapids_tpu.parallel.mesh import MeshContext
 
@@ -105,15 +104,11 @@ def unshard_batch(ctx: MeshContext, cols, counts,
         for (d, v, ln), dt in zip(host, dtypes):
             vv = v[lo:lo + cnt]
             if isinstance(dt, (T.StringType, T.BinaryType)):
-                dd, ll = d[lo:lo + cnt], ln[lo:lo + cnt]
-                vals = [bytes(dd[i, :ll[i]]) if vv[i] else None
-                        for i in range(cnt)]
-                if isinstance(dt, T.StringType):
-                    vals = [None if b is None else b.decode("utf-8")
-                            for b in vals]
-                dev_cols.append(HostColumn(pa.array(vals,
-                                                    type=T.to_arrow(dt)),
-                                           dt))
+                # packed-bytes repr: reuse the device column decoder
+                dc = DeviceColumn(_jx().asarray(d[lo:lo + B]),
+                                  _jx().asarray(v[lo:lo + B]), cnt, dt,
+                                  _jx().asarray(ln[lo:lo + B]))
+                dev_cols.append(dc.to_host())
             elif isinstance(dt, T.DecimalType) and dt.is_decimal128:
                 # two-limb physical repr: reuse the device column decoder
                 dc = DeviceColumn(_jx().asarray(d[lo:lo + B]),
@@ -145,7 +140,8 @@ def collective_hash_shuffle(ctx: MeshContext, cols, counts, pids):
     B = total // n
     sig = tuple((str(d.dtype), tuple(d.shape), ln is not None)
                 for d, v, ln in cols)
-    key = ("cshuffle", n, B, sig)
+    mesh_key = tuple(d.id for d in ctx.mesh.devices.flat)
+    key = ("cshuffle", mesh_key, n, B, sig)
     fn = _SHUFFLE_CACHE.get(key)
     if fn is None:
         axis = ctx.data_axis

@@ -417,10 +417,10 @@ class DataFrame:
             if names else []
 
     def count(self) -> int:
-        total = 0
-        for b in self._executed_plan().execute_all():
-            total += b.row_count
-        return total
+        from spark_rapids_tpu.columnar.column import sum_counts
+        # deferred device counts are summed on device: ONE sync total
+        return sum_counts([b.row_count for b in
+                           self._executed_plan().execute_all()])
 
     def write_parquet(self, path: str) -> None:
         from spark_rapids_tpu.io.parquet import write_parquet
