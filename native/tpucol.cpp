@@ -1,0 +1,508 @@
+// libtpucol: native host runtime for the TPU columnar engine.
+//
+// Reference counterparts (SURVEY.md §2.16): the reference consumes native
+// C++/CUDA code for its host/device runtime — RMM host/pinned pools,
+// JCudfSerialization's host wire layout, nvcomp LZ4 batch codecs, the
+// spark-rapids-jni Hash kernels (murmur3/xxhash64) and RowConversion
+// (row⇄column). This library provides the TPU-native equivalents for the
+// *host* side of the engine: the device side is XLA/Pallas via JAX.
+//
+// Exposed via a C ABI consumed by ctypes (spark_rapids_tpu/native.py).
+// Everything is thread-safe unless noted; the pool uses a mutex (shuffle
+// writer threads allocate concurrently).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <mutex>
+#include <new>
+
+#if defined(_WIN32)
+#define TPUCOL_API extern "C" __declspec(dllexport)
+#else
+#define TPUCOL_API extern "C" __attribute__((visibility("default")))
+#endif
+
+// ---------------------------------------------------------------------------
+// Host memory pool with accounting (RMM analog).
+//
+// A tracking allocator: malloc-backed, but every allocation is accounted
+// against a configurable limit so the Python retry layer (memory/retry.py)
+// can observe pressure and spill — mirroring how RmmSpark's per-thread state
+// machine turns allocator pressure into retry/split-retry signals.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Pool {
+    std::mutex mu;
+    uint64_t limit = 0;        // 0 = unlimited
+    uint64_t in_use = 0;
+    uint64_t peak = 0;
+    uint64_t total_allocs = 0;
+    uint64_t failed_allocs = 0;
+};
+
+struct AllocHeader {
+    Pool *pool;
+    uint64_t size;
+    uint64_t magic;
+};
+constexpr uint64_t kMagic = 0x747075636f6c5f31ULL;  // "tpucol_1"
+
+}  // namespace
+
+TPUCOL_API void *tpucol_pool_create(uint64_t limit_bytes) {
+    Pool *p = new (std::nothrow) Pool();
+    if (p) p->limit = limit_bytes;
+    return p;
+}
+
+TPUCOL_API void tpucol_pool_destroy(void *pool) {
+    delete static_cast<Pool *>(pool);
+}
+
+TPUCOL_API void *tpucol_pool_alloc(void *pool, uint64_t size) {
+    Pool *p = static_cast<Pool *>(pool);
+    {
+        std::lock_guard<std::mutex> g(p->mu);
+        if (p->limit && p->in_use + size > p->limit) {
+            p->failed_allocs++;
+            return nullptr;  // Python side raises RetryOOM -> spill/retry
+        }
+        p->in_use += size;
+        if (p->in_use > p->peak) p->peak = p->in_use;
+        p->total_allocs++;
+    }
+    void *raw = std::malloc(sizeof(AllocHeader) + size);
+    if (!raw) {
+        std::lock_guard<std::mutex> g(p->mu);
+        p->in_use -= size;
+        p->failed_allocs++;
+        return nullptr;
+    }
+    AllocHeader *h = static_cast<AllocHeader *>(raw);
+    h->pool = p;
+    h->size = size;
+    h->magic = kMagic;
+    return h + 1;
+}
+
+TPUCOL_API int tpucol_pool_free(void *ptr) {
+    if (!ptr) return 0;
+    AllocHeader *h = static_cast<AllocHeader *>(ptr) - 1;
+    if (h->magic != kMagic) return -1;
+    h->magic = 0;
+    {
+        std::lock_guard<std::mutex> g(h->pool->mu);
+        h->pool->in_use -= h->size;
+    }
+    std::free(h);
+    return 0;
+}
+
+// stats: [in_use, peak, total_allocs, failed_allocs, limit]
+TPUCOL_API void tpucol_pool_stats(void *pool, uint64_t *out5) {
+    Pool *p = static_cast<Pool *>(pool);
+    std::lock_guard<std::mutex> g(p->mu);
+    out5[0] = p->in_use;
+    out5[1] = p->peak;
+    out5[2] = p->total_allocs;
+    out5[3] = p->failed_allocs;
+    out5[4] = p->limit;
+}
+
+TPUCOL_API void tpucol_pool_set_limit(void *pool, uint64_t limit_bytes) {
+    Pool *p = static_cast<Pool *>(pool);
+    std::lock_guard<std::mutex> g(p->mu);
+    p->limit = limit_bytes;
+}
+
+// ---------------------------------------------------------------------------
+// LZ4 block codec (nvcomp LZ4 analog, host-side).
+//
+// Standard LZ4 block format (token | literals | offset | matchlen...), so
+// payloads are interoperable with any LZ4 implementation. Compressor uses a
+// 16-bit hash chainless table (LZ4-fast equivalent); decompressor is fully
+// bounds-checked (shuffle payloads cross trust boundaries between workers).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr int kMinMatch = 4;
+constexpr int kHashLog = 16;
+constexpr int kLastLiterals = 5;   // spec: last 5 bytes always literals
+constexpr int kMfLimit = 12;       // spec: no match within 12 bytes of end
+
+static inline uint32_t read32(const uint8_t *p) {
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    return v;
+}
+
+static inline uint32_t hash4(uint32_t v) {
+    return (v * 2654435761U) >> (32 - kHashLog);
+}
+
+}  // namespace
+
+TPUCOL_API uint64_t tpucol_lz4_max_compressed(uint64_t n) {
+    return n + n / 255 + 16;
+}
+
+// returns compressed size, or 0 if dst too small / input empty
+TPUCOL_API uint64_t tpucol_lz4_compress(const uint8_t *src, uint64_t src_len,
+                                        uint8_t *dst, uint64_t dst_cap) {
+    if (src_len == 0 || dst_cap < tpucol_lz4_max_compressed(src_len))
+        return 0;
+    const uint8_t *ip = src;
+    const uint8_t *const iend = src + src_len;
+    const uint8_t *const mflimit = iend - kMfLimit;
+    const uint8_t *anchor = src;
+    uint8_t *op = dst;
+
+    if (src_len >= kMfLimit) {
+        // 32-bit positions: callers block the input at <= 4MB per frame
+        uint32_t table[1 << kHashLog];
+        std::memset(table, 0, sizeof(table));
+        // position 0 sentinel: store pos+1, 0 means empty
+        while (ip < mflimit) {
+            uint32_t seq = read32(ip);
+            uint32_t h = hash4(seq);
+            const uint8_t *match = src + table[h] - 1;
+            bool hit = table[h] != 0 && read32(match) == seq &&
+                       (uint64_t)(ip - match) <= 0xFFFF && match < ip;
+            table[h] = (uint32_t)(ip - src) + 1;
+            if (!hit) {
+                ip++;
+                continue;
+            }
+            // extend match forward
+            const uint8_t *mp = match + kMinMatch;
+            const uint8_t *cp = ip + kMinMatch;
+            while (cp < iend - kLastLiterals && *cp == *mp) { cp++; mp++; }
+            uint64_t mlen = (uint64_t)(cp - ip) - kMinMatch;
+            uint64_t litlen = (uint64_t)(ip - anchor);
+            // token
+            uint8_t *token = op++;
+            if (litlen >= 15) {
+                *token = (uint8_t)(15 << 4);
+                uint64_t l = litlen - 15;
+                while (l >= 255) { *op++ = 255; l -= 255; }
+                *op++ = (uint8_t)l;
+            } else {
+                *token = (uint8_t)(litlen << 4);
+            }
+            std::memcpy(op, anchor, litlen);
+            op += litlen;
+            // offset (little-endian 16-bit)
+            uint16_t off = (uint16_t)(ip - match);
+            *op++ = (uint8_t)off;
+            *op++ = (uint8_t)(off >> 8);
+            // match length
+            if (mlen >= 15) {
+                *token |= 15;
+                uint64_t m = mlen - 15;
+                while (m >= 255) { *op++ = 255; m -= 255; }
+                *op++ = (uint8_t)m;
+            } else {
+                *token |= (uint8_t)mlen;
+            }
+            ip = cp;
+            anchor = ip;
+        }
+    }
+    // trailing literals
+    uint64_t litlen = (uint64_t)(iend - anchor);
+    uint8_t *token = op++;
+    if (litlen >= 15) {
+        *token = (uint8_t)(15 << 4);
+        uint64_t l = litlen - 15;
+        while (l >= 255) { *op++ = 255; l -= 255; }
+        *op++ = (uint8_t)l;
+    } else {
+        *token = (uint8_t)(litlen << 4);
+    }
+    std::memcpy(op, anchor, litlen);
+    op += litlen;
+    return (uint64_t)(op - dst);
+}
+
+// returns decompressed size, or 0 on malformed input / overflow
+TPUCOL_API uint64_t tpucol_lz4_decompress(const uint8_t *src, uint64_t src_len,
+                                          uint8_t *dst, uint64_t dst_cap) {
+    const uint8_t *ip = src;
+    const uint8_t *const iend = src + src_len;
+    uint8_t *op = dst;
+    uint8_t *const oend = dst + dst_cap;
+    while (ip < iend) {
+        uint8_t token = *ip++;
+        // literals
+        uint64_t litlen = token >> 4;
+        if (litlen == 15) {
+            uint8_t b;
+            do {
+                if (ip >= iend) return 0;
+                b = *ip++;
+                litlen += b;
+            } while (b == 255);
+        }
+        if ((uint64_t)(iend - ip) < litlen || (uint64_t)(oend - op) < litlen)
+            return 0;
+        std::memcpy(op, ip, litlen);
+        ip += litlen;
+        op += litlen;
+        if (ip >= iend) break;  // last sequence has no match
+        // offset
+        if (iend - ip < 2) return 0;
+        uint16_t off = (uint16_t)(ip[0] | (ip[1] << 8));
+        ip += 2;
+        if (off == 0 || (uint64_t)(op - dst) < off) return 0;
+        // match length
+        uint64_t mlen = (token & 15) + kMinMatch;
+        if ((token & 15) == 15) {
+            uint8_t b;
+            do {
+                if (ip >= iend) return 0;
+                b = *ip++;
+                mlen += b;
+            } while (b == 255);
+        }
+        if ((uint64_t)(oend - op) < mlen) return 0;
+        const uint8_t *mp = op - off;
+        // overlapping copy must be byte-wise
+        for (uint64_t i = 0; i < mlen; i++) op[i] = mp[i];
+        op += mlen;
+    }
+    return (uint64_t)(op - dst);
+}
+
+// ---------------------------------------------------------------------------
+// Hash kernels (spark-rapids-jni Hash analog): murmur3_x86_32 with Spark's
+// seed/tail handling, and xxhash64, both bulk over fixed-width column data.
+// Used for host-side shuffle partitioning; the device path has its own JAX
+// implementation (expressions/hashing.py) — these must agree bit-for-bit.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+static inline uint32_t rotl32(uint32_t x, int r) {
+    return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t mmh3_mix_k1(uint32_t k1) {
+    k1 *= 0xcc9e2d51U;
+    k1 = rotl32(k1, 15);
+    k1 *= 0x1b873593U;
+    return k1;
+}
+
+static inline uint32_t mmh3_mix_h1(uint32_t h1, uint32_t k1) {
+    h1 ^= k1;
+    h1 = rotl32(h1, 13);
+    return h1 * 5 + 0xe6546b64U;
+}
+
+static inline uint32_t mmh3_fmix(uint32_t h1, uint32_t len) {
+    h1 ^= len;
+    h1 ^= h1 >> 16;
+    h1 *= 0x85ebca6bU;
+    h1 ^= h1 >> 13;
+    h1 *= 0xc2b2ae35U;
+    h1 ^= h1 >> 16;
+    return h1;
+}
+
+// Spark's Murmur3: ints/longs hashed as 4/8-byte ints (hashInt/hashLong),
+// byte payloads hashed bytewise-as-ints (hashUnsafeBytes2 lenient mode).
+static inline uint32_t mmh3_int(uint32_t v, uint32_t seed) {
+    return mmh3_fmix(mmh3_mix_h1(seed, mmh3_mix_k1(v)), 4);
+}
+
+static inline uint32_t mmh3_long(uint64_t v, uint32_t seed) {
+    uint32_t h1 = mmh3_mix_h1(seed, mmh3_mix_k1((uint32_t)v));
+    h1 = mmh3_mix_h1(h1, mmh3_mix_k1((uint32_t)(v >> 32)));
+    return mmh3_fmix(h1, 8);
+}
+
+static inline uint32_t mmh3_bytes(const uint8_t *data, uint32_t len,
+                                  uint32_t seed) {
+    // Spark hashUnsafeBytes: 4-byte blocks then per-byte tail mixing
+    uint32_t h1 = seed;
+    uint32_t nblocks = len / 4;
+    for (uint32_t i = 0; i < nblocks; i++)
+        h1 = mmh3_mix_h1(h1, mmh3_mix_k1(read32(data + i * 4)));
+    for (uint32_t i = nblocks * 4; i < len; i++)
+        h1 = mmh3_mix_h1(h1, mmh3_mix_k1((uint32_t)(int32_t)(int8_t)data[i]));
+    return mmh3_fmix(h1, len);
+}
+
+constexpr uint64_t kXxPrime1 = 0x9E3779B185EBCA87ULL;
+constexpr uint64_t kXxPrime2 = 0xC2B2AE3D27D4EB4FULL;
+constexpr uint64_t kXxPrime3 = 0x165667B19E3779F9ULL;
+constexpr uint64_t kXxPrime4 = 0x85EBCA77C2B2AE63ULL;
+constexpr uint64_t kXxPrime5 = 0x27D4EB2F165667C5ULL;
+
+static inline uint64_t rotl64(uint64_t x, int r) {
+    return (x << r) | (x >> (64 - r));
+}
+
+static inline uint64_t xx64_long(uint64_t v, uint64_t seed) {
+    // Spark XXH64.hashLong: one 8-byte chunk folded into seed+P5+len
+    uint64_t h = seed + kXxPrime5 + 8;
+    h ^= rotl64(v * kXxPrime2, 31) * kXxPrime1;
+    h = rotl64(h, 27) * kXxPrime1 + kXxPrime4;
+    h ^= h >> 33;
+    h *= kXxPrime2;
+    h ^= h >> 29;
+    h *= kXxPrime3;
+    h ^= h >> 32;
+    return h;
+}
+
+}  // namespace
+
+// hash n int64 values, combining into existing seeds[] (Spark chains column
+// hashes: seed of column k+1 is the hash of column k)
+TPUCOL_API void tpucol_murmur3_i64(const int64_t *vals, const uint8_t *valid,
+                                   uint64_t n, uint32_t *seeds_io) {
+    for (uint64_t i = 0; i < n; i++) {
+        if (valid && !valid[i]) continue;  // Spark: null leaves seed as-is
+        seeds_io[i] = mmh3_long((uint64_t)vals[i], seeds_io[i]);
+    }
+}
+
+TPUCOL_API void tpucol_murmur3_i32(const int32_t *vals, const uint8_t *valid,
+                                   uint64_t n, uint32_t *seeds_io) {
+    for (uint64_t i = 0; i < n; i++) {
+        if (valid && !valid[i]) continue;
+        seeds_io[i] = mmh3_int((uint32_t)vals[i], seeds_io[i]);
+    }
+}
+
+// strings: rectangular uint8[n, width] + int32 lengths (the engine's host
+// string layout)
+TPUCOL_API void tpucol_murmur3_bytes(const uint8_t *chars, const int32_t *lens,
+                                     const uint8_t *valid, uint64_t n,
+                                     uint64_t width, uint32_t *seeds_io) {
+    for (uint64_t i = 0; i < n; i++) {
+        if (valid && !valid[i]) continue;
+        seeds_io[i] = mmh3_bytes(chars + i * width, (uint32_t)lens[i],
+                                 seeds_io[i]);
+    }
+}
+
+TPUCOL_API void tpucol_xxhash64_i64(const int64_t *vals, const uint8_t *valid,
+                                    uint64_t n, uint64_t *seeds_io) {
+    for (uint64_t i = 0; i < n; i++) {
+        if (valid && !valid[i]) continue;
+        seeds_io[i] = xx64_long((uint64_t)vals[i], seeds_io[i]);
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Row ⇄ columnar conversion (RowConversion JNI analog).
+//
+// Fixed-width schema: rows are tightly packed records of the given column
+// byte-widths (1/2/4/8) plus a leading null bitmap of ceil(ncols/8) bytes —
+// the layout GpuRowToColumnarExec's generated code uses, minus padding.
+// ---------------------------------------------------------------------------
+
+TPUCOL_API int tpucol_rows_to_cols(const uint8_t *rows, uint64_t n_rows,
+                                   const uint32_t *widths, uint32_t n_cols,
+                                   uint8_t **col_data, uint8_t **col_valid) {
+    uint64_t bitmap_bytes = (n_cols + 7) / 8;
+    uint64_t row_size = bitmap_bytes;
+    for (uint32_t c = 0; c < n_cols; c++) row_size += widths[c];
+    for (uint64_t r = 0; r < n_rows; r++) {
+        const uint8_t *rec = rows + r * row_size;
+        const uint8_t *fld = rec + bitmap_bytes;
+        for (uint32_t c = 0; c < n_cols; c++) {
+            uint32_t w = widths[c];
+            bool is_valid = (rec[c / 8] >> (c % 8)) & 1;
+            col_valid[c][r] = is_valid ? 1 : 0;
+            std::memcpy(col_data[c] + r * w, fld, w);
+            fld += w;
+        }
+    }
+    return 0;
+}
+
+TPUCOL_API int tpucol_cols_to_rows(uint8_t *rows, uint64_t n_rows,
+                                   const uint32_t *widths, uint32_t n_cols,
+                                   const uint8_t *const *col_data,
+                                   const uint8_t *const *col_valid) {
+    uint64_t bitmap_bytes = (n_cols + 7) / 8;
+    uint64_t row_size = bitmap_bytes;
+    for (uint32_t c = 0; c < n_cols; c++) row_size += widths[c];
+    for (uint64_t r = 0; r < n_rows; r++) {
+        uint8_t *rec = rows + r * row_size;
+        std::memset(rec, 0, bitmap_bytes);
+        uint8_t *fld = rec + bitmap_bytes;
+        for (uint32_t c = 0; c < n_cols; c++) {
+            uint32_t w = widths[c];
+            if (!col_valid[c] || col_valid[c][r])
+                rec[c / 8] |= (uint8_t)(1 << (c % 8));
+            std::memcpy(fld, col_data[c] + r * w, w);
+            fld += w;
+        }
+    }
+    return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Shuffle split: scatter row indices into per-partition index lists in one
+// pass (the host half of GpuPartitioning.sliceInternalOnGpu). Python computes
+// partition ids (on device or via the hash kernels above); this builds the
+// gather lists the serializer consumes.
+// ---------------------------------------------------------------------------
+
+TPUCOL_API int tpucol_partition_indices(const int32_t *pids, uint64_t n,
+                                        uint32_t n_parts, uint32_t *offsets,
+                                        uint32_t *indices) {
+    // counting pass
+    for (uint32_t p = 0; p <= n_parts; p++) offsets[p] = 0;
+    for (uint64_t i = 0; i < n; i++) {
+        int32_t p = pids[i];
+        if (p < 0 || (uint32_t)p >= n_parts) return -1;
+        offsets[p + 1]++;
+    }
+    for (uint32_t p = 0; p < n_parts; p++) offsets[p + 1] += offsets[p];
+    // scatter pass (stable within partition)
+    uint32_t *cursor = new (std::nothrow) uint32_t[n_parts];
+    if (!cursor) return -2;
+    std::memcpy(cursor, offsets, n_parts * sizeof(uint32_t));
+    for (uint64_t i = 0; i < n; i++)
+        indices[cursor[pids[i]]++] = (uint32_t)i;
+    delete[] cursor;
+    return 0;
+}
+
+// gather fixed-width column data by row indices (serializer hot loop)
+TPUCOL_API void tpucol_gather(const uint8_t *src, const uint32_t *indices,
+                              uint64_t n, uint32_t width, uint8_t *dst) {
+    switch (width) {
+    case 1:
+        for (uint64_t i = 0; i < n; i++) dst[i] = src[indices[i]];
+        break;
+    case 2:
+        for (uint64_t i = 0; i < n; i++)
+            ((uint16_t *)dst)[i] = ((const uint16_t *)src)[indices[i]];
+        break;
+    case 4:
+        for (uint64_t i = 0; i < n; i++)
+            ((uint32_t *)dst)[i] = ((const uint32_t *)src)[indices[i]];
+        break;
+    case 8:
+        for (uint64_t i = 0; i < n; i++)
+            ((uint64_t *)dst)[i] = ((const uint64_t *)src)[indices[i]];
+        break;
+    default:
+        for (uint64_t i = 0; i < n; i++)
+            std::memcpy(dst + i * width, src + (uint64_t)indices[i] * width,
+                        width);
+    }
+}
+
+TPUCOL_API int tpucol_abi_version() { return 1; }

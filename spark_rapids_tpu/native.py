@@ -1,0 +1,615 @@
+"""ctypes bindings for libtpucol, the native C++ host runtime.
+
+The reference's engine is JVM code calling into native C++/CUDA through JNI
+(cuDF Java bindings + spark-rapids-jni; SURVEY.md §2.16).  Here the engine is
+Python calling into native C++ through ctypes: ``native/tpucol.cpp`` provides
+the host memory pool (RMM analog), the LZ4 block codec (nvcomp analog), bulk
+murmur3/xxhash64 row hashing (jni ``Hash`` analog), row⇄columnar conversion
+(jni ``RowConversion`` analog) and the shuffle partition/gather hot loops
+(``GpuPartitioning`` host half).
+
+The library is compiled on first use (single translation unit, ~1s) and
+cached next to the source.  Every entry point has a pure-numpy fallback so
+the engine still runs where no C++ toolchain exists; ``HAVE_NATIVE`` tells
+callers (and tests) which path is live.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libtpucol.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_attempted = False
+
+
+def _try_build() -> bool:
+    global _build_attempted
+    if _build_attempted:
+        return os.path.exists(_SO_PATH)
+    _build_attempted = True
+    src = os.path.join(_NATIVE_DIR, "tpucol.cpp")
+    if not os.path.exists(src):
+        return False
+    try:
+        subprocess.run(["make", "-s", "-C", _NATIVE_DIR],
+                       check=True, capture_output=True, timeout=120)
+        return os.path.exists(_SO_PATH)
+    except Exception:
+        return False
+
+
+def _bind(lib):
+    u64, u32, i64, i32, u8 = (ctypes.c_uint64, ctypes.c_uint32,
+                              ctypes.c_int64, ctypes.c_int32, ctypes.c_uint8)
+    vp = ctypes.c_void_p
+    p = ctypes.POINTER
+    lib.tpucol_abi_version.restype = ctypes.c_int
+    lib.tpucol_pool_create.restype = vp
+    lib.tpucol_pool_create.argtypes = [u64]
+    lib.tpucol_pool_destroy.argtypes = [vp]
+    lib.tpucol_pool_alloc.restype = vp
+    lib.tpucol_pool_alloc.argtypes = [vp, u64]
+    lib.tpucol_pool_free.restype = ctypes.c_int
+    lib.tpucol_pool_free.argtypes = [vp]
+    lib.tpucol_pool_stats.argtypes = [vp, p(u64)]
+    lib.tpucol_pool_set_limit.argtypes = [vp, u64]
+    lib.tpucol_lz4_max_compressed.restype = u64
+    lib.tpucol_lz4_max_compressed.argtypes = [u64]
+    lib.tpucol_lz4_compress.restype = u64
+    lib.tpucol_lz4_compress.argtypes = [p(u8), u64, p(u8), u64]
+    lib.tpucol_lz4_decompress.restype = u64
+    lib.tpucol_lz4_decompress.argtypes = [p(u8), u64, p(u8), u64]
+    lib.tpucol_murmur3_i64.argtypes = [p(i64), p(u8), u64, p(u32)]
+    lib.tpucol_murmur3_i32.argtypes = [p(i32), p(u8), u64, p(u32)]
+    lib.tpucol_murmur3_bytes.argtypes = [p(u8), p(i32), p(u8), u64, u64, p(u32)]
+    lib.tpucol_xxhash64_i64.argtypes = [p(i64), p(u8), u64, p(u64)]
+    lib.tpucol_rows_to_cols.restype = ctypes.c_int
+    lib.tpucol_rows_to_cols.argtypes = [p(u8), u64, p(u32), u32,
+                                        p(vp), p(vp)]
+    lib.tpucol_cols_to_rows.restype = ctypes.c_int
+    lib.tpucol_cols_to_rows.argtypes = [p(u8), u64, p(u32), u32,
+                                        p(vp), p(vp)]
+    lib.tpucol_partition_indices.restype = ctypes.c_int
+    lib.tpucol_partition_indices.argtypes = [p(i32), u64, u32, p(u32), p(u32)]
+    lib.tpucol_gather.argtypes = [p(u8), p(u32), u64, u32, p(u8)]
+    return lib
+
+
+def get_lib():
+    """The loaded native library, or None (fallbacks engage)."""
+    global _lib
+    if _lib is not None:
+        return _lib if _lib is not False else None
+    with _lib_lock:
+        if _lib is not None:
+            return _lib if _lib is not False else None
+        if os.environ.get("SPARK_RAPIDS_TPU_DISABLE_NATIVE") == "1":
+            _lib = False
+            return None
+        if not os.path.exists(_SO_PATH) and not _try_build():
+            _lib = False
+            return None
+        try:
+            lib = _bind(ctypes.CDLL(_SO_PATH))
+            if lib.tpucol_abi_version() != 1:
+                _lib = False
+                return None
+            _lib = lib
+        except OSError:
+            _lib = False
+            return None
+    return _lib
+
+
+def have_native() -> bool:
+    return get_lib() is not None
+
+
+def _u8p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+# ---------------------------------------------------------------------------
+# Host memory pool (RMM analog) — accounting + limit, feeding the retry layer
+# ---------------------------------------------------------------------------
+
+class NativeHostPool:
+    """Tracking host allocator.  With the native lib, allocations live in C++
+    with header-tagged accounting; otherwise a Python-accounted dict of numpy
+    buffers.  A failed allocation returns None — callers translate that into
+    the engine's RetryOOM discipline (memory/retry.py)."""
+
+    def __init__(self, limit_bytes: int = 0):
+        self._lib = get_lib()
+        self._limit = limit_bytes
+        # liveness is owned HERE, not by the C++ header magic: a handle is a
+        # plain int, and probing freed memory for a magic value is UB.
+        self._live = set()
+        self._live_mu = threading.Lock()
+        if self._lib is not None:
+            self._pool = self._lib.tpucol_pool_create(limit_bytes)
+        else:
+            self._pool = None
+            self._in_use = 0
+            self._peak = 0
+            self._total = 0
+            self._failed = 0
+            self._bufs = {}
+            self._mu = threading.Lock()
+
+    def alloc(self, size: int) -> Optional[int]:
+        """Returns an opaque handle (address) or None on OOM."""
+        if self._lib is not None:
+            ptr = self._lib.tpucol_pool_alloc(self._pool, size)
+            if ptr:
+                with self._live_mu:
+                    self._live.add(ptr)
+            return ptr or None
+        with self._mu:
+            if self._limit and self._in_use + size > self._limit:
+                self._failed += 1
+                return None
+            buf = np.empty(size, dtype=np.uint8)
+            addr = buf.ctypes.data
+            self._bufs[addr] = (buf, size)
+            self._in_use += size
+            self._peak = max(self._peak, self._in_use)
+            self._total += 1
+            return addr
+
+    def free(self, handle: Optional[int]) -> None:
+        if handle is None:
+            return
+        if self._lib is not None:
+            with self._live_mu:
+                if handle not in self._live:
+                    raise ValueError(
+                        "bad free: not a live pool allocation (double free?)")
+                self._live.discard(handle)
+            if self._lib.tpucol_pool_free(ctypes.c_void_p(handle)) != 0:
+                raise ValueError("bad free: not a pool allocation")
+            return
+        with self._mu:
+            if handle not in self._bufs:
+                raise ValueError(
+                    "bad free: not a live pool allocation (double free?)")
+            _, size = self._bufs.pop(handle)
+            self._in_use -= size
+
+    def view(self, handle: int, size: int) -> np.ndarray:
+        """uint8 view of an allocation (zero-copy)."""
+        if self._lib is not None:
+            return np.ctypeslib.as_array(
+                ctypes.cast(handle, ctypes.POINTER(ctypes.c_uint8)),
+                shape=(size,))
+        return self._bufs[handle][0][:size]
+
+    def stats(self) -> dict:
+        if self._lib is not None:
+            out = (ctypes.c_uint64 * 5)()
+            self._lib.tpucol_pool_stats(self._pool, out)
+            return {"in_use": out[0], "peak": out[1], "total_allocs": out[2],
+                    "failed_allocs": out[3], "limit": out[4]}
+        with self._mu:
+            return {"in_use": self._in_use, "peak": self._peak,
+                    "total_allocs": self._total, "failed_allocs": self._failed,
+                    "limit": self._limit}
+
+    def set_limit(self, limit_bytes: int) -> None:
+        self._limit = limit_bytes
+        if self._lib is not None:
+            self._lib.tpucol_pool_set_limit(self._pool, limit_bytes)
+
+    def close(self) -> None:
+        if self._lib is not None and self._pool:
+            self._lib.tpucol_pool_destroy(self._pool)
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# LZ4 block codec (nvcomp analog)
+# ---------------------------------------------------------------------------
+
+_FRAME_HDR = 14  # tag(2) + raw_len(8) + crc32(4)
+
+
+def lz4_compress(data: bytes | np.ndarray) -> bytes:
+    """LZ4 block compression with a crc32 of the raw payload in the frame
+    header (shuffle payloads cross worker boundaries; LZ4 blocks have no
+    integrity check of their own).  Falls back to zlib framing when the
+    native lib is absent — the tag byte tells the decoder which it got."""
+    import zlib
+    if isinstance(data, (bytes, bytearray, memoryview)):
+        src = np.frombuffer(data, dtype=np.uint8)
+    else:
+        # reinterpret the array's BYTES (a value-cast would corrupt payloads)
+        src = np.ascontiguousarray(data).reshape(-1).view(np.uint8)
+    n = src.size
+    crc = zlib.crc32(src)
+    lib = get_lib()
+    if lib is not None and n:
+        cap = int(lib.tpucol_lz4_max_compressed(n))
+        dst = np.empty(cap, dtype=np.uint8)
+        out = int(lib.tpucol_lz4_compress(_u8p(src), n, _u8p(dst), cap))
+        if out:
+            return (b"L4" + n.to_bytes(8, "little") +
+                    crc.to_bytes(4, "little") + dst[:out].tobytes())
+    return (b"ZL" + n.to_bytes(8, "little") + crc.to_bytes(4, "little") +
+            zlib.compress(src.tobytes(), 1))
+
+
+def lz4_decompress(frame: bytes) -> bytes:
+    import zlib
+    tag = frame[:2]
+    raw_len = int.from_bytes(frame[2:10], "little")
+    crc = int.from_bytes(frame[10:14], "little")
+    payload = frame[_FRAME_HDR:]
+    if tag == b"ZL":
+        out = zlib.decompress(payload)
+    elif tag == b"L4":
+        lib = get_lib()
+        if lib is None:
+            out = _lz4_decompress_py(payload, raw_len)
+        else:
+            src = np.frombuffer(payload, dtype=np.uint8)
+            dst = np.empty(raw_len, dtype=np.uint8)
+            got = int(lib.tpucol_lz4_decompress(_u8p(src), src.size,
+                                                _u8p(dst), raw_len))
+            if got != raw_len:
+                raise ValueError(
+                    f"corrupt LZ4 frame: got {got}, want {raw_len}")
+            out = dst.tobytes()
+    else:
+        raise ValueError(f"unknown codec frame tag {tag!r}")
+    if len(out) != raw_len or zlib.crc32(out) != crc:
+        raise ValueError("corrupt frame: checksum mismatch")
+    return out
+
+
+def _lz4_decompress_py(src: bytes, raw_len: int) -> bytes:
+    """Pure-python LZ4 block decoder (interop path when native is absent).
+    Fully bounds-checked: truncated/malformed frames raise ValueError, the
+    same contract the native decoder keeps."""
+    try:
+        return _lz4_decompress_py_inner(src, raw_len)
+    except IndexError:
+        raise ValueError("corrupt LZ4 frame: truncated input") from None
+
+
+def _lz4_decompress_py_inner(src: bytes, raw_len: int) -> bytes:
+    out = bytearray()
+    i, n = 0, len(src)
+    while i < n:
+        token = src[i]
+        i += 1
+        litlen = token >> 4
+        if litlen == 15:
+            while True:
+                b = src[i]
+                i += 1
+                litlen += b
+                if b != 255:
+                    break
+        out += src[i:i + litlen]
+        i += litlen
+        if i >= n:
+            break
+        off = src[i] | (src[i + 1] << 8)
+        i += 2
+        mlen = (token & 15) + 4
+        if (token & 15) == 15:
+            while True:
+                b = src[i]
+                i += 1
+                mlen += b
+                if b != 255:
+                    break
+        start = len(out) - off
+        if start < 0:
+            raise ValueError("corrupt LZ4 frame: bad offset")
+        for k in range(mlen):
+            out.append(out[start + k])
+    if len(out) != raw_len:
+        raise ValueError("corrupt LZ4 frame: length mismatch")
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# Bulk hash kernels (host-side partitioning path)
+# ---------------------------------------------------------------------------
+
+def murmur3_bulk(columns, seed: int = 42) -> np.ndarray:
+    """Spark-compatible murmur3_x86_32 over rows of fixed-width/string
+    columns.  ``columns`` is a list of (data, validity) where data is a numpy
+    array (int/float/bool; or (chars uint8[n,w], lens int32[n]) tuple for
+    strings).  Returns int32[n] hashes; must agree with the device
+    implementation in expressions/hashing.py."""
+    first = columns[0][0]
+    n = len(first[1]) if isinstance(first, tuple) else len(first)
+    seeds = np.full(n, seed, dtype=np.uint32)
+    lib = get_lib()
+    for data, valid in columns:
+        v8 = None if valid is None else \
+            np.ascontiguousarray(valid, dtype=np.uint8)
+        vp = None if v8 is None else _u8p(v8)
+        if isinstance(data, tuple):  # string: (chars, lens)
+            chars, lens = data
+            chars = np.ascontiguousarray(chars, dtype=np.uint8)
+            lens = np.ascontiguousarray(lens, dtype=np.int32)
+            if lib is not None:
+                lib.tpucol_murmur3_bytes(
+                    _u8p(chars), lens.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_int32)),
+                    vp, n, chars.shape[1],
+                    seeds.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+            else:
+                _murmur3_bytes_py(chars, lens, v8, seeds)
+            continue
+        data = np.asarray(data)
+        if data.dtype == np.bool_:
+            words = data.astype(np.int32)
+        elif data.dtype in (np.dtype(np.int8), np.dtype(np.int16)):
+            words = data.astype(np.int32)
+        elif data.dtype == np.dtype(np.float32):
+            # Spark hashes floatToIntBits: -0.0 -> +0.0, NaN -> canonical NaN
+            f = data.astype(np.float32, copy=True)
+            f[f == 0.0] = 0.0
+            f[np.isnan(f)] = np.float32(np.nan)
+            words = f.view(np.int32)
+        elif data.dtype == np.dtype(np.float64):
+            f = data.astype(np.float64, copy=True)
+            f[f == 0.0] = 0.0
+            f[np.isnan(f)] = np.nan
+            words = f.view(np.int64)
+        else:
+            words = data
+        words = np.ascontiguousarray(words)
+        if words.dtype.itemsize == 8:
+            w64 = words.view(np.int64) if words.dtype != np.int64 else words
+            if lib is not None:
+                lib.tpucol_murmur3_i64(
+                    w64.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), vp, n,
+                    seeds.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+            else:
+                _murmur3_i64_py(w64, v8, seeds)
+        else:
+            w32 = np.ascontiguousarray(words, dtype=np.int32)
+            if lib is not None:
+                lib.tpucol_murmur3_i32(
+                    w32.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), vp, n,
+                    seeds.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+            else:
+                _murmur3_i32_py(w32, v8, seeds)
+    return seeds.view(np.int32)
+
+
+def _mmh3_mix_k1(k1):
+    k1 = (k1 * np.uint32(0xcc9e2d51)).astype(np.uint32)
+    k1 = (k1 << np.uint32(15)) | (k1 >> np.uint32(17))
+    return (k1 * np.uint32(0x1b873593)).astype(np.uint32)
+
+
+def _mmh3_mix_h1(h1, k1):
+    h1 = h1 ^ k1
+    h1 = (h1 << np.uint32(13)) | (h1 >> np.uint32(19))
+    return (h1 * np.uint32(5) + np.uint32(0xe6546b64)).astype(np.uint32)
+
+
+def _mmh3_fmix(h1, length):
+    h1 = h1 ^ np.uint32(length)
+    h1 ^= h1 >> np.uint32(16)
+    h1 = (h1 * np.uint32(0x85ebca6b)).astype(np.uint32)
+    h1 ^= h1 >> np.uint32(13)
+    h1 = (h1 * np.uint32(0xc2b2ae35)).astype(np.uint32)
+    h1 ^= h1 >> np.uint32(16)
+    return h1
+
+
+def _murmur3_i32_py(vals, valid, seeds):
+    with np.errstate(over="ignore"):
+        h = _mmh3_fmix(_mmh3_mix_h1(seeds.copy(),
+                                    _mmh3_mix_k1(vals.view(np.uint32))), 4)
+    mask = slice(None) if valid is None else valid.astype(bool)
+    seeds[mask] = h[mask]
+
+
+def _murmur3_i64_py(vals, valid, seeds):
+    u = vals.view(np.uint64)
+    with np.errstate(over="ignore"):
+        h1 = _mmh3_mix_h1(seeds.copy(),
+                          _mmh3_mix_k1(u.astype(np.uint32)))
+        h1 = _mmh3_mix_h1(h1, _mmh3_mix_k1((u >> np.uint64(32)).astype(np.uint32)))
+        h = _mmh3_fmix(h1, 8)
+    mask = slice(None) if valid is None else valid.astype(bool)
+    seeds[mask] = h[mask]
+
+
+def _murmur3_bytes_py(chars, lens, valid, seeds):
+    with np.errstate(over="ignore"):
+        for i in range(len(seeds)):
+            if valid is not None and not valid[i]:
+                continue
+            data = chars[i, :lens[i]]
+            h1 = np.uint32(seeds[i])
+            nb = len(data) // 4
+            if nb:
+                blocks = data[:nb * 4].view(np.uint32)
+                for b in blocks:
+                    h1 = _mmh3_mix_h1(h1, _mmh3_mix_k1(b))
+            for b in data[nb * 4:]:
+                h1 = _mmh3_mix_h1(
+                    h1, _mmh3_mix_k1(np.uint32(np.int32(np.int8(b)))))
+            seeds[i] = _mmh3_fmix(h1, len(data))
+
+
+def xxhash64_bulk_i64(vals: np.ndarray, valid, seed: int = 42) -> np.ndarray:
+    """Spark-compatible xxhash64 over an int64 column."""
+    n = len(vals)
+    seeds = np.full(n, seed, dtype=np.uint64)
+    vals = np.ascontiguousarray(vals, dtype=np.int64)
+    lib = get_lib()
+    if lib is not None:
+        v8 = None if valid is None else np.ascontiguousarray(valid, np.uint8)
+        lib.tpucol_xxhash64_i64(
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            None if v8 is None else _u8p(v8), n,
+            seeds.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+        return seeds.view(np.int64)
+    P1 = np.uint64(0x9E3779B185EBCA87)
+    P2 = np.uint64(0xC2B2AE3D27D4EB4F)
+    P3 = np.uint64(0x165667B19E3779F9)
+    P4 = np.uint64(0x85EBCA77C2B2AE63)
+    P5 = np.uint64(0x27D4EB2F165667C5)
+    with np.errstate(over="ignore"):
+        u = vals.view(np.uint64)
+        h = seeds + P5 + np.uint64(8)
+        k = (u * P2).astype(np.uint64)
+        k = ((k << np.uint64(31)) | (k >> np.uint64(33))) * P1
+        h = h ^ k
+        h = ((h << np.uint64(27)) | (h >> np.uint64(37))) * P1 + P4
+        h ^= h >> np.uint64(33)
+        h = (h * P2).astype(np.uint64)
+        h ^= h >> np.uint64(29)
+        h = (h * P3).astype(np.uint64)
+        h ^= h >> np.uint64(32)
+    if valid is not None:
+        h = np.where(np.asarray(valid, dtype=bool), h, seeds)
+    return h.view(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# Row ⇄ columnar conversion (RowConversion analog)
+# ---------------------------------------------------------------------------
+
+def rows_to_columns(rows: np.ndarray, widths) -> Tuple[list, list]:
+    """Unpacks tightly packed records (leading null bitmap + fixed-width
+    fields) into per-column (uint8[n*w] data, uint8[n] validity)."""
+    widths = np.asarray(widths, dtype=np.uint32)
+    ncols = len(widths)
+    bitmap = (ncols + 7) // 8
+    row_size = bitmap + int(widths.sum())
+    n = rows.size // row_size
+    rows = np.ascontiguousarray(rows, dtype=np.uint8)
+    datas = [np.empty(n * int(w), dtype=np.uint8) for w in widths]
+    valids = [np.empty(n, dtype=np.uint8) for _ in widths]
+    lib = get_lib()
+    if lib is not None and n:
+        dptr = (ctypes.c_void_p * ncols)(*[d.ctypes.data for d in datas])
+        vptr = (ctypes.c_void_p * ncols)(*[v.ctypes.data for v in valids])
+        lib.tpucol_rows_to_cols(
+            _u8p(rows), n,
+            widths.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)), ncols,
+            dptr, vptr)
+        return datas, valids
+    rec = rows[:n * row_size].reshape(n, row_size)
+    off = bitmap
+    for c, w in enumerate(widths):
+        w = int(w)
+        datas[c][:] = rec[:, off:off + w].reshape(-1)
+        valids[c][:] = (rec[:, c // 8] >> (c % 8)) & 1
+        off += w
+    return datas, valids
+
+
+def columns_to_rows(datas, valids, widths) -> np.ndarray:
+    """Packs per-column buffers into tight records (inverse of
+    rows_to_columns)."""
+    widths = np.asarray(widths, dtype=np.uint32)
+    ncols = len(widths)
+    bitmap = (ncols + 7) // 8
+    row_size = bitmap + int(widths.sum())
+    n = len(valids[0]) if valids and valids[0] is not None else \
+        (datas[0].size // int(widths[0]))
+    out = np.zeros(n * row_size, dtype=np.uint8)
+    datas = [np.ascontiguousarray(d, dtype=np.uint8) for d in datas]
+    valids = [None if v is None else np.ascontiguousarray(v, dtype=np.uint8)
+              for v in valids]
+    lib = get_lib()
+    if lib is not None and n:
+        ones = np.ones(n, dtype=np.uint8)
+        dptr = (ctypes.c_void_p * ncols)(*[d.ctypes.data for d in datas])
+        vptr = (ctypes.c_void_p * ncols)(
+            *[(ones if v is None else v).ctypes.data for v in valids])
+        lib.tpucol_cols_to_rows(
+            _u8p(out), n,
+            widths.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)), ncols,
+            dptr, vptr)
+        return out
+    rec = out.reshape(n, row_size)
+    off = bitmap
+    for c, w in enumerate(widths):
+        w = int(w)
+        rec[:, off:off + w] = datas[c].reshape(n, w)
+        v = valids[c]
+        bit = np.uint8(1 << (c % 8))
+        if v is None:
+            rec[:, c // 8] |= bit
+        else:
+            rec[:, c // 8] |= np.where(v.astype(bool), bit, 0).astype(np.uint8)
+        off += w
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Shuffle split hot loops
+# ---------------------------------------------------------------------------
+
+def partition_indices(pids: np.ndarray, n_parts: int
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """Stable counting-sort of row indices by partition id.  Returns
+    (offsets uint32[n_parts+1], indices uint32[n]): partition p's rows are
+    ``indices[offsets[p]:offsets[p+1]]``."""
+    pids = np.ascontiguousarray(pids, dtype=np.int32)
+    n = pids.size
+    lib = get_lib()
+    if lib is not None:
+        offsets = np.empty(n_parts + 1, dtype=np.uint32)
+        indices = np.empty(n, dtype=np.uint32)
+        rc = lib.tpucol_partition_indices(
+            pids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n, n_parts,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+        if rc != 0:
+            raise ValueError(f"partition id out of range [0, {n_parts})")
+        return offsets, indices
+    if n and (pids.min() < 0 or pids.max() >= n_parts):
+        raise ValueError(f"partition id out of range [0, {n_parts})")
+    order = np.argsort(pids, kind="stable").astype(np.uint32)
+    counts = np.bincount(pids, minlength=n_parts).astype(np.uint32)
+    offsets = np.zeros(n_parts + 1, dtype=np.uint32)
+    np.cumsum(counts, out=offsets[1:])
+    return offsets, order
+
+
+def gather_fixed(src: np.ndarray, indices: np.ndarray, width: int
+                 ) -> np.ndarray:
+    """Gathers fixed-width elements by row index from a flat byte buffer."""
+    indices = np.ascontiguousarray(indices, dtype=np.uint32)
+    src = np.ascontiguousarray(src, dtype=np.uint8)
+    n = indices.size
+    lib = get_lib()
+    if lib is not None:
+        dst = np.empty(n * width, dtype=np.uint8)
+        lib.tpucol_gather(_u8p(src),
+                          indices.ctypes.data_as(
+                              ctypes.POINTER(ctypes.c_uint32)),
+                          n, width, _u8p(dst))
+        return dst
+    return src.reshape(-1, width)[indices].reshape(-1)

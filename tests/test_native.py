@@ -1,0 +1,332 @@
+"""Tests for the native C++ host runtime (native/tpucol.cpp via native.py).
+
+Covers the four native subsystems plus their pure-Python fallbacks, and
+verifies the host hash kernels agree bit-for-bit with the device (JAX)
+implementations — the same contract the reference has between its JNI Hash
+kernels and Spark's Murmur3 (spark-rapids-jni Hash, SURVEY.md §2.16).
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import native as N
+
+
+def _py_fallback(monkeypatch):
+    """Forces the pure-Python path regardless of whether the .so built."""
+    monkeypatch.setattr(N, "get_lib", lambda: None)
+
+
+# ---------------------------------------------------------------------------
+# memory pool
+# ---------------------------------------------------------------------------
+
+class TestHostPool:
+    def test_alloc_free_accounting(self):
+        p = N.NativeHostPool(limit_bytes=4096)
+        h1 = p.alloc(1000)
+        h2 = p.alloc(2000)
+        assert h1 and h2
+        s = p.stats()
+        assert s["in_use"] == 3000 and s["peak"] == 3000
+        p.free(h1)
+        assert p.stats()["in_use"] == 2000
+        p.free(h2)
+        assert p.stats()["in_use"] == 0
+        p.close()
+
+    def test_limit_returns_none(self):
+        p = N.NativeHostPool(limit_bytes=1024)
+        h = p.alloc(1024)
+        assert h is not None
+        assert p.alloc(1) is None
+        assert p.stats()["failed_allocs"] == 1
+        p.free(h)
+        assert p.alloc(1) is not None
+        p.close()
+
+    def test_view_roundtrip(self):
+        p = N.NativeHostPool()
+        h = p.alloc(64)
+        v = p.view(h, 64)
+        v[:] = np.arange(64, dtype=np.uint8)
+        assert (p.view(h, 64) == np.arange(64, dtype=np.uint8)).all()
+        p.free(h)
+        p.close()
+
+    def test_double_free_raises(self):
+        p = N.NativeHostPool()
+        h = p.alloc(32)
+        p.free(h)
+        with pytest.raises(ValueError):
+            p.free(h)
+        p.close()
+
+    def test_set_limit(self):
+        p = N.NativeHostPool()
+        p.set_limit(10)
+        assert p.alloc(11) is None
+        p.close()
+
+    def test_python_fallback_pool(self, monkeypatch):
+        _py_fallback(monkeypatch)
+        p = N.NativeHostPool(limit_bytes=100)
+        h = p.alloc(60)
+        assert p.alloc(60) is None
+        p.view(h, 60)[:] = 7
+        p.free(h)
+        assert p.stats()["in_use"] == 0
+
+
+# ---------------------------------------------------------------------------
+# LZ4 codec
+# ---------------------------------------------------------------------------
+
+class TestLz4:
+    CASES = [
+        b"",
+        b"a",
+        b"hello world, hello world, hello world!",
+        b"x" * 100_000,
+        bytes(np.random.default_rng(0).integers(0, 256, 64_000,
+                                                dtype=np.uint8)),
+        np.arange(50_000, dtype=np.int64).tobytes(),
+    ]
+
+    @pytest.mark.parametrize("i", range(len(CASES)))
+    def test_roundtrip(self, i):
+        data = self.CASES[i]
+        frame = N.lz4_compress(data)
+        assert N.lz4_decompress(frame) == data
+
+    def test_compresses_redundant_data(self):
+        data = b"spark rapids tpu " * 5000
+        frame = N.lz4_compress(data)
+        assert len(frame) < len(data) // 10
+
+    def test_python_decoder_interop(self):
+        # native-compressed frames must decode with the pure-python decoder
+        if not N.have_native():
+            pytest.skip("native lib unavailable")
+        data = b"abcabcabc" * 1000 + b"tail"
+        frame = N.lz4_compress(data)
+        assert frame[:2] == b"L4"
+        assert N._lz4_decompress_py(frame[N._FRAME_HDR:], len(data)) == data
+
+    def test_fallback_roundtrip(self, monkeypatch):
+        _py_fallback(monkeypatch)
+        data = b"fallback data " * 100
+        frame = N.lz4_compress(data)
+        assert frame[:2] == b"ZL"
+        assert N.lz4_decompress(frame) == data
+
+    def test_corrupt_frame_raises(self):
+        data = b"some data to compress, repeated " * 10
+        frame = bytearray(N.lz4_compress(data))
+        frame[-1] ^= 0xFF
+        with pytest.raises(ValueError):
+            N.lz4_decompress(bytes(frame))
+
+    def test_unknown_tag_raises(self):
+        with pytest.raises(ValueError):
+            N.lz4_decompress(b"XX" + (0).to_bytes(12, "little"))
+
+    def test_typed_array_compressed_as_bytes(self):
+        arr = np.arange(1000, dtype=np.int64)
+        frame = N.lz4_compress(arr)
+        assert N.lz4_decompress(frame) == arr.tobytes()
+
+    def test_truncated_frame_python_decoder(self):
+        data = b"truncation test payload " * 50
+        frame = N.lz4_compress(data)
+        with pytest.raises(ValueError):
+            N._lz4_decompress_py(frame[N._FRAME_HDR:-3], len(data))
+
+
+# ---------------------------------------------------------------------------
+# hash kernels
+# ---------------------------------------------------------------------------
+
+class TestHashes:
+    def test_murmur3_known_spark_values(self):
+        # values of Spark 3.5 `SELECT hash(CAST(v AS INT/BIGINT))` (Spark's
+        # Murmur3_x86_32.hashInt/hashLong with seed 42)
+        assert N.murmur3_bulk([(np.array([1], np.int32), None)])[0] == -559580957
+        assert N.murmur3_bulk([(np.array([0], np.int32), None)])[0] == 933211791
+        assert N.murmur3_bulk([(np.array([1], np.int64), None)])[0] == -1712319331
+        assert N.murmur3_bulk([(np.array([42], np.int64), None)])[0] == 1316951768
+
+    def test_murmur3_matches_device_impl(self):
+        from spark_rapids_tpu import types as T
+        from spark_rapids_tpu.expressions.base import (EvalContext, TCol,
+                                                       BoundReference)
+        from spark_rapids_tpu.expressions.hashing import Murmur3Hash
+        rng = np.random.default_rng(5)
+        n = 512
+        i64 = rng.integers(-2**62, 2**62, n)
+        i32 = rng.integers(-2**31, 2**31 - 1, n).astype(np.int32)
+        valid = rng.integers(0, 2, n).astype(bool)
+        host = N.murmur3_bulk([(i64, valid), (i32, None)])
+        expr = Murmur3Hash(BoundReference(0, T.LONG, True),
+                           BoundReference(1, T.INT, False))
+        ctx = EvalContext([TCol(i64, valid, T.LONG),
+                           TCol(i32, np.ones(n, bool), T.INT)], "cpu", n)
+        dev = np.asarray(expr.eval_cpu(ctx).data)
+        assert (host == dev).all()
+
+    def test_murmur3_string_matches_device_impl(self):
+        from spark_rapids_tpu import types as T
+        from spark_rapids_tpu.columnar.column import HostColumn
+        from spark_rapids_tpu.expressions.base import (EvalContext, TCol,
+                                                       BoundReference)
+        from spark_rapids_tpu.expressions.hashing import Murmur3Hash
+        vals = ["", "a", "hello", "spark rapids tpu", "日本語テキスト",
+                "x" * 100, None, "tail7"]
+        hc = HostColumn.from_pylist(vals)
+        chars, lens = hc.string_np()
+        valid = hc.validity_np()
+        host = N.murmur3_bulk([((chars, lens), valid)])
+        # CPU oracle path hashes python strings via the scalar reference impl
+        ctx = EvalContext(
+            [TCol(np.array([v for v in vals], dtype=object), valid, T.STRING)],
+            "cpu", len(vals))
+        dev = np.asarray(
+            Murmur3Hash(BoundReference(0, T.STRING, True)).eval_cpu(ctx).data)
+        assert (host == dev).all()
+
+    def test_murmur3_null_keeps_seed(self):
+        v = np.array([7, 7], np.int64)
+        valid = np.array([True, False])
+        h = N.murmur3_bulk([(v, valid)], seed=42)
+        assert h[1] == 42 and h[0] != 42
+
+    def test_murmur3_float_negzero(self):
+        h = N.murmur3_bulk([(np.array([-0.0], np.float64), None)])
+        h2 = N.murmur3_bulk([(np.array([0.0], np.float64), None)])
+        assert h[0] == h2[0]
+
+    def test_murmur3_nan_canonicalized(self):
+        # any NaN bit pattern must hash like the canonical quiet NaN
+        weird = np.array([0x7FF0000000000001], np.uint64).view(np.float64)
+        canon = np.array([np.nan], np.float64)
+        assert (N.murmur3_bulk([(weird, None)]) ==
+                N.murmur3_bulk([(canon, None)])).all()
+        weird32 = np.array([0x7F800001], np.uint32).view(np.float32)
+        canon32 = np.array([np.nan], np.float32)
+        assert (N.murmur3_bulk([(weird32, None)]) ==
+                N.murmur3_bulk([(canon32, None)])).all()
+
+    def test_native_python_parity(self, monkeypatch):
+        rng = np.random.default_rng(11)
+        n = 300
+        i64 = rng.integers(-2**62, 2**62, n)
+        f32 = rng.standard_normal(n).astype(np.float32)
+        b = rng.integers(0, 2, n).astype(bool)
+        valid = rng.integers(0, 2, n).astype(bool)
+        cols = [(i64, valid), (f32, None), (b, None)]
+        native = N.murmur3_bulk(cols)
+        xx_native = N.xxhash64_bulk_i64(i64, valid)
+        _py_fallback(monkeypatch)
+        assert (N.murmur3_bulk(cols) == native).all()
+        assert (N.xxhash64_bulk_i64(i64, valid) == xx_native).all()
+
+    def test_xxhash64_matches_device_impl(self):
+        from spark_rapids_tpu import types as T
+        from spark_rapids_tpu.expressions.base import (EvalContext, TCol,
+                                                       BoundReference)
+        from spark_rapids_tpu.expressions.hashing import XxHash64
+        rng = np.random.default_rng(6)
+        n = 256
+        i64 = rng.integers(-2**62, 2**62, n)
+        valid = rng.integers(0, 2, n).astype(bool)
+        host = N.xxhash64_bulk_i64(i64, valid)
+        ctx = EvalContext([TCol(i64, valid, T.LONG)], "cpu", n)
+        dev = np.asarray(
+            XxHash64(BoundReference(0, T.LONG, True)).eval_cpu(ctx).data)
+        assert (host == dev).all()
+
+
+# ---------------------------------------------------------------------------
+# row <-> columnar
+# ---------------------------------------------------------------------------
+
+class TestRowConversion:
+    @pytest.mark.parametrize("native", [True, False])
+    def test_roundtrip(self, native, monkeypatch):
+        if not native:
+            _py_fallback(monkeypatch)
+        elif not N.have_native():
+            pytest.skip("native lib unavailable")
+        rng = np.random.default_rng(3)
+        n = 1000
+        c_i32 = rng.integers(-100, 100, n).astype(np.int32)
+        c_f64 = rng.standard_normal(n)
+        c_i8 = rng.integers(-5, 5, n).astype(np.int8)
+        v1 = rng.integers(0, 2, n).astype(np.uint8)
+        widths = [4, 8, 1]
+        rows = N.columns_to_rows(
+            [c_i32.view(np.uint8), c_f64.view(np.uint8), c_i8.view(np.uint8)],
+            [v1, None, v1], widths)
+        assert rows.size == n * (1 + 4 + 8 + 1)
+        datas, valids = N.rows_to_columns(rows, widths)
+        assert (datas[0].view(np.int32) == c_i32).all()
+        assert (datas[1].view(np.float64) == c_f64).all()
+        assert (datas[2].view(np.int8) == c_i8).all()
+        assert (valids[0] == v1).all()
+        assert (valids[1] == 1).all()
+        assert (valids[2] == v1).all()
+
+    def test_many_columns_bitmap(self):
+        # >8 columns exercises multi-byte null bitmaps
+        n, ncols = 17, 11
+        datas = [np.full(n, c, dtype=np.uint8) for c in range(ncols)]
+        valids = [np.array([(r + c) % 2 for r in range(n)], np.uint8)
+                  for c in range(ncols)]
+        rows = N.columns_to_rows(datas, valids, [1] * ncols)
+        d2, v2 = N.rows_to_columns(rows, [1] * ncols)
+        for c in range(ncols):
+            assert (d2[c] == datas[c]).all()
+            assert (v2[c] == valids[c]).all()
+
+
+# ---------------------------------------------------------------------------
+# partition split + gather
+# ---------------------------------------------------------------------------
+
+class TestPartitionSplit:
+    @pytest.mark.parametrize("native", [True, False])
+    def test_stable_partition(self, native, monkeypatch):
+        if not native:
+            _py_fallback(monkeypatch)
+        elif not N.have_native():
+            pytest.skip("native lib unavailable")
+        rng = np.random.default_rng(4)
+        pids = rng.integers(0, 13, 5000).astype(np.int32)
+        offs, idx = N.partition_indices(pids, 13)
+        assert offs[0] == 0 and offs[-1] == 5000
+        assert (np.sort(idx) == np.arange(5000)).all()
+        for pp in range(13):
+            sel = idx[offs[pp]:offs[pp + 1]]
+            assert (pids[sel] == pp).all()
+            assert (np.diff(sel.astype(np.int64)) > 0).all()  # stable
+
+    def test_out_of_range_raises(self):
+        with pytest.raises(ValueError):
+            N.partition_indices(np.array([0, 5], np.int32), 3)
+        with pytest.raises(ValueError):
+            N.partition_indices(np.array([-1], np.int32), 3)
+
+    def test_gather_widths(self):
+        src64 = np.arange(100, dtype=np.int64)
+        idx = np.array([9, 0, 42, 42], np.uint32)
+        for width, arr in [(8, src64), (4, src64.astype(np.int32)),
+                           (2, src64.astype(np.int16)),
+                           (1, src64.astype(np.int8))]:
+            out = N.gather_fixed(arr.view(np.uint8), idx, width)
+            assert (out.view(arr.dtype) == [9, 0, 42, 42]).all()
+
+    def test_gather_wide_records(self):
+        src = np.arange(160, dtype=np.uint8)  # 10 records of 16 bytes
+        out = N.gather_fixed(src, np.array([3, 1], np.uint32), 16)
+        assert (out[:16] == np.arange(48, 64)).all()
+        assert (out[16:] == np.arange(16, 32)).all()
