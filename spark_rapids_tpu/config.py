@@ -307,6 +307,26 @@ READER_TYPE = conf_str(
     "(reference RapidsConf.scala:314 RapidsReaderType).",
     "AUTO")
 
+CSV_READER_TYPE = conf_str(
+    "spark.rapids.sql.format.csv.reader.type",
+    "CSV reader strategy (same values as the parquet key).",
+    "AUTO")
+
+JSON_READER_TYPE = conf_str(
+    "spark.rapids.sql.format.json.reader.type",
+    "JSON reader strategy (same values as the parquet key).",
+    "AUTO")
+
+ORC_READER_TYPE = conf_str(
+    "spark.rapids.sql.format.orc.reader.type",
+    "ORC reader strategy (same values as the parquet key).",
+    "AUTO")
+
+AVRO_READER_TYPE = conf_str(
+    "spark.rapids.sql.format.avro.reader.type",
+    "Avro reader strategy (same values as the parquet key).",
+    "AUTO")
+
 DEVICE_STRING_MAX_LEN = conf_int(
     "spark.rapids.tpu.string.maxDeviceLen",
     "Strings longer than this stay on the host tier (device strings are "

@@ -11,3 +11,7 @@ GpuParquetScan's semaphore acquisition before device work
 
 from spark_rapids_tpu.io.parquet import (  # noqa: F401
     CpuParquetScanExec, write_parquet)
+from spark_rapids_tpu.io.text import (  # noqa: F401
+    CpuCsvScanExec, CpuJsonScanExec, write_csv, write_json)
+from spark_rapids_tpu.io.orc import CpuOrcScanExec, write_orc  # noqa: F401
+from spark_rapids_tpu.io.writer import DataFrameWriter  # noqa: F401

@@ -1,0 +1,284 @@
+"""Multi-file reader strategies shared by every format scan.
+
+Reference: ``GpuMultiFileReader.scala`` (1271 LoC) — three strategies chosen
+by ``spark.rapids.sql.format.<fmt>.reader.type`` (RapidsConf.scala:314
+RapidsReaderType AUTO/COALESCING/MULTITHREADED/PERFILE):
+
+- PERFILE: one partition per file, read lazily
+  (reference: FilePartitionReaderFactory default path).
+- COALESCING: bin-pack small files into partitions and stitch their batches
+  into target-sized output batches
+  (reference: MultiFileCoalescingPartitionReaderBase, GpuMultiFileReader.scala:827).
+- MULTITHREADED: pipelined background reads on a shared thread pool, yielded
+  in order (reference: MultiFileCloudPartitionReaderBase, :342).
+- AUTO: MULTITHREADED for cloud-scheme paths (s3://...), COALESCING locally
+  (reference: AUTO picks by cloud-vs-local path).
+
+TPU note: everything here is host-side IO staging; the device never sees a
+file byte.  Scans subclass ``MultiFileScanBase`` and provide ``read_file``.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import glob as _glob
+import os
+import threading
+from typing import Iterator, List, Optional, Sequence
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import (HostColumnarBatch,
+                                             concat_host_batches)
+from spark_rapids_tpu.plan.base import LeafExec
+
+PERFILE = "PERFILE"
+COALESCING = "COALESCING"
+MULTITHREADED = "MULTITHREADED"
+AUTO = "AUTO"
+
+_CLOUD_SCHEMES = ("s3://", "s3a://", "gs://", "abfs://", "abfss://",
+                  "wasb://", "http://", "https://")
+
+# shared background-read pool (reference: MultiFileReaderThreadPool)
+_POOL: Optional[concurrent.futures.ThreadPoolExecutor] = None
+_POOL_SIZE = 0
+_RETIRED_POOLS: List[concurrent.futures.ThreadPoolExecutor] = []
+_POOL_LOCK = threading.Lock()
+
+
+def reader_pool(num_threads: int) -> concurrent.futures.ThreadPoolExecutor:
+    global _POOL, _POOL_SIZE
+    with _POOL_LOCK:
+        if _POOL is None or _POOL_SIZE < num_threads:
+            if _POOL is not None:
+                # in-flight scans still hold the old pool; retiring (not
+                # shutting down) keeps their submits valid until they drain
+                _RETIRED_POOLS.append(_POOL)
+            _POOL = concurrent.futures.ThreadPoolExecutor(
+                max_workers=num_threads,
+                thread_name_prefix="tpu-multifile-read")
+            _POOL_SIZE = num_threads
+        return _POOL
+
+
+def expand_paths(paths: Sequence[str], ext: str) -> List[str]:
+    """Expands dirs/globs into a sorted file list (FilePartition planning)."""
+    expanded: List[str] = []
+    for p in paths:
+        if any(p.startswith(s) for s in _CLOUD_SCHEMES):
+            expanded.append(p)  # remote paths pass through unexpanded
+        elif os.path.isdir(p):
+            hits = sorted(
+                _glob.glob(os.path.join(p, "**", f"*{ext}"), recursive=True))
+            expanded.extend(h for h in hits
+                            if not os.path.basename(h).startswith((".", "_")))
+        elif any(ch in p for ch in "*?["):
+            expanded.extend(sorted(_glob.glob(p)))
+        else:
+            if not os.path.exists(p):
+                raise FileNotFoundError(f"input path does not exist: {p}")
+            expanded.append(p)
+    if not expanded:
+        raise FileNotFoundError(f"no input files in {list(paths)}")
+    return expanded
+
+
+def is_cloud_path(path: str) -> bool:
+    return any(path.startswith(s) for s in _CLOUD_SCHEMES)
+
+
+class MultiFileScanBase(LeafExec):
+    """Base for file-format scans: owns path expansion, the reader-strategy
+    partition planning, and batch stitching.  Subclasses implement
+    ``read_file(path)`` (host decode) and ``infer_schema()``."""
+
+    format_name = "file"
+    file_ext = ""
+
+    def __init__(self, paths: Sequence[str],
+                 reader_type: str = AUTO,
+                 batch_rows: int = 1 << 20,
+                 batch_bytes: int = 512 << 20,
+                 coalesce_target_bytes: int = 128 << 20,
+                 num_threads: int = 8):
+        super().__init__()
+        self.paths = expand_paths(paths, self.file_ext)
+        self.reader_type = reader_type.upper()
+        if self.reader_type not in (PERFILE, COALESCING, MULTITHREADED, AUTO):
+            raise ValueError(f"unknown reader type {reader_type!r}")
+        self.batch_rows = batch_rows
+        self.batch_bytes = batch_bytes
+        self.coalesce_target_bytes = coalesce_target_bytes
+        self.num_threads = num_threads
+        self._schema: Optional[T.StructType] = None
+        self._partitions: Optional[List[List[str]]] = None
+
+    # -- subclass surface ---------------------------------------------------
+    def read_file(self, path: str) -> Iterator[HostColumnarBatch]:
+        raise NotImplementedError
+
+    def infer_schema(self) -> T.StructType:
+        raise NotImplementedError
+
+    # -- planning -----------------------------------------------------------
+    @property
+    def schema(self) -> T.StructType:
+        if self._schema is None:
+            self._schema = self.infer_schema()
+        return self._schema
+
+    def _effective_type(self) -> str:
+        if self.reader_type != AUTO:
+            return self.reader_type
+        if any(is_cloud_path(p) for p in self.paths):
+            return MULTITHREADED
+        return COALESCING if len(self.paths) > 1 else PERFILE
+
+    def _file_size(self, path: str) -> int:
+        try:
+            return os.path.getsize(path)
+        except OSError:
+            return self.coalesce_target_bytes  # unknown: assume large
+
+    def _plan_partitions(self) -> List[List[str]]:
+        if self._partitions is not None:
+            return self._partitions
+        eff = self._effective_type()
+        if eff == PERFILE:
+            parts = [[p] for p in self.paths]
+        else:
+            # bin-pack consecutive files up to the coalesce target
+            # (reference coalescing reader groups by total chunk bytes)
+            parts, cur, cur_bytes = [], [], 0
+            for p in self.paths:
+                sz = self._file_size(p)
+                if cur and cur_bytes + sz > self.coalesce_target_bytes:
+                    parts.append(cur)
+                    cur, cur_bytes = [], 0
+                cur.append(p)
+                cur_bytes += sz
+            if cur:
+                parts.append(cur)
+        self._partitions = parts
+        return parts
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self._plan_partitions())
+
+    # -- execution ----------------------------------------------------------
+    def execute_partition(self, pidx: int):
+        files = self._plan_partitions()[pidx]
+        eff = self._effective_type()
+        if eff == MULTITHREADED:
+            it = self._read_pipelined(files)
+        else:
+            it = self._read_sequential(files)
+        if eff in (COALESCING, MULTITHREADED) and len(files) > 1:
+            yield from self._stitch(it)
+        else:
+            yield from it
+
+    def _read_sequential(self, files):
+        for p in files:
+            yield from self.read_file(p)
+
+    def _read_pipelined(self, files):
+        """Background reads with bounded lookahead, yielded in file order
+        (reference: MultiFileCloudPartitionReaderBase pipelining)."""
+        pool = reader_pool(self.num_threads)
+        lookahead = max(1, min(self.num_threads, len(files)))
+        futures = {}
+        nxt = 0
+        for i in range(min(lookahead, len(files))):
+            futures[i] = pool.submit(lambda p=files[i]: list(self.read_file(p)))
+        for i in range(len(files)):
+            batches = futures.pop(i).result()
+            j = i + lookahead
+            if j < len(files):
+                futures[j] = pool.submit(
+                    lambda p=files[j]: list(self.read_file(p)))
+            yield from batches
+
+    def _stitch(self, batches):
+        """Concats small batches up to the row/byte targets so downstream
+        device kernels see large batches (COALESCING semantics)."""
+        pending: List[HostColumnarBatch] = []
+        rows = 0
+        nbytes = 0
+        for b in batches:
+            if b.row_count == 0:
+                continue
+            pending.append(b)
+            rows += b.row_count
+            nbytes += b.nbytes()
+            if rows >= self.batch_rows or nbytes >= self.batch_bytes:
+                yield concat_host_batches(pending) if len(pending) > 1 \
+                    else pending[0]
+                pending, rows, nbytes = [], 0, 0
+        if pending:
+            yield concat_host_batches(pending) if len(pending) > 1 \
+                else pending[0]
+
+    def node_desc(self):
+        base = os.path.basename(self.paths[0])
+        extra = f"+{len(self.paths) - 1}" if len(self.paths) > 1 else ""
+        return (f"{self.format_name.capitalize()}Scan[{base}{extra}]"
+                f"({self._effective_type().lower()})")
+
+
+# -- device-feeding variants (host decode -> semaphore -> upload) -----------
+
+class _TpuFileScanMixin:
+    is_device = True
+
+    def execute_partition(self, pidx):
+        from spark_rapids_tpu.exec.basic import upload_batches
+        yield from upload_batches(super().execute_partition(pidx))
+
+    def node_desc(self):
+        return "Tpu" + super().node_desc()
+
+
+def tpu_scan_of(cls):
+    """Builds the Tpu* scan class + plan-rewrite convert fn for a Cpu* scan
+    (shares all fields; the device variant only adds the upload stage)."""
+    tpu = type("Tpu" + cls.__name__[3:], (_TpuFileScanMixin, cls), {})
+
+    def convert(cpu, meta):
+        import copy
+        dev = copy.copy(cpu)
+        dev.__class__ = tpu
+        return dev
+
+    return tpu, convert
+
+
+def chunked_write(batches, path: str, schema, open_writer, write_batch):
+    """Shared writer loop: lazy writer creation from the first batch, host
+    download of device batches, empty-dataset schema fallback, close on
+    every path (reference: ColumnarOutputWriter chunked TableWriter)."""
+    import pyarrow as pa
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    writer = None
+    try:
+        for b in batches:
+            if isinstance(b, ColumnarBatch):
+                b = b.to_host()
+            rb = b.to_arrow()
+            if writer is None:
+                writer = open_writer(path, rb.schema)
+            write_batch(writer, rb)
+        if writer is None:
+            if schema is None:
+                raise ValueError("cannot write empty dataset without schema")
+            from spark_rapids_tpu import types as _T
+            empty = pa.table(
+                {f.name: pa.array([], type=_T.to_arrow(f.data_type))
+                 for f in schema})
+            writer = open_writer(path, empty.schema)
+            for rb in empty.to_batches(max_chunksize=1):
+                write_batch(writer, rb)
+    finally:
+        if writer is not None:
+            writer.close()

@@ -6,22 +6,20 @@ Here: pyarrow handles footer/row-group plumbing (the host stage), decode is
 host-side (see io/__init__ docstring for why that is the TPU-first choice),
 and predicate pushdown maps our Expressions to arrow dataset filters.
 
-Partitioning: one partition per row-group span (reference coalesces small
-files/row-groups; the COALESCING/MULTITHREADED strategies land with the
-multi-file reader milestone, RapidsConf READER_TYPE).
+Multi-file strategies (PERFILE/COALESCING/MULTITHREADED/AUTO) come from
+``io.multifile`` (reference: GpuMultiFileReader.scala, RapidsConf READER_TYPE).
 """
 
 from __future__ import annotations
 
-import glob as _glob
 import os
 from typing import List, Optional, Sequence
 
 from spark_rapids_tpu import types as T
-from spark_rapids_tpu.columnar.batch import (HostColumnarBatch,
-                                             batch_from_arrow)
+from spark_rapids_tpu.columnar.batch import batch_from_arrow
 from spark_rapids_tpu.expressions.base import Expression
-from spark_rapids_tpu.plan.base import LeafExec
+from spark_rapids_tpu.io.multifile import (AUTO, MultiFileScanBase,
+                                           chunked_write, tpu_scan_of)
 
 
 def _expr_to_arrow_filter(expr: Expression):
@@ -62,63 +60,34 @@ def _expr_to_arrow_filter(expr: Expression):
     return None
 
 
-class CpuParquetScanExec(LeafExec):
+class CpuParquetScanExec(MultiFileScanBase):
+    format_name = "parquet"
+    file_ext = ".parquet"
+
     def __init__(self, paths: Sequence[str],
                  columns: Optional[List[str]] = None,
                  predicate: Optional[Expression] = None,
-                 batch_rows: int = 1 << 20):
-        super().__init__()
-        expanded = []
-        for p in paths:
-            if os.path.isdir(p):
-                expanded.extend(sorted(
-                    _glob.glob(os.path.join(p, "**", "*.parquet"),
-                               recursive=True)))
-            elif any(ch in p for ch in "*?["):
-                expanded.extend(sorted(_glob.glob(p)))
-            else:
-                if not os.path.exists(p):
-                    raise FileNotFoundError(f"parquet path does not exist: {p}")
-                expanded.append(p)
-        if not expanded:
-            raise FileNotFoundError(f"no parquet files in {list(paths)}")
-        self.paths = expanded
+                 batch_rows: int = 1 << 20,
+                 reader_type: str = AUTO, num_threads: int = 8):
+        super().__init__(paths, reader_type=reader_type,
+                         batch_rows=batch_rows, num_threads=num_threads)
         self.columns = columns
         self.predicate = predicate
-        self.batch_rows = batch_rows
-        self._schema = None
-        self._fragments = None
 
     # -- planning-time metadata (host footer stage) -------------------------
-    @property
-    def schema(self) -> T.StructType:
-        if self._schema is None:
-            import pyarrow.parquet as pq
-            arrow_schema = pq.read_schema(self.paths[0])
-            fields = []
-            for f in arrow_schema:
-                if self.columns is not None and f.name not in self.columns:
-                    continue
-                fields.append(T.StructField(f.name, T.from_arrow(f.type)))
-            self._schema = T.StructType(fields)
-        return self._schema
+    def infer_schema(self) -> T.StructType:
+        import pyarrow.parquet as pq
+        arrow_schema = pq.read_schema(self.paths[0])
+        fields = []
+        for f in arrow_schema:
+            if self.columns is not None and f.name not in self.columns:
+                continue
+            fields.append(T.StructField(f.name, T.from_arrow(f.type)))
+        return T.StructType(fields)
 
-    def _plan_fragments(self):
-        """One partition per file (row-group spans within a file stream as
-        batches).  reference: FilePartition planning in GpuFileSourceScanExec."""
-        if self._fragments is None:
-            self._fragments = list(self.paths)
-        return self._fragments
-
-    @property
-    def num_partitions(self):
-        return len(self._plan_fragments())
-
-    def execute_partition(self, pidx):
+    def read_file(self, path: str):
         import pyarrow as pa
         import pyarrow.parquet as pq
-        path = self._plan_fragments()[pidx]
-        f = pq.ParquetFile(path)
         flt = None if self.predicate is None else \
             _expr_to_arrow_filter(self.predicate)
         cols = self.columns
@@ -131,45 +100,19 @@ class CpuParquetScanExec(LeafExec):
                 if rb.num_rows:
                     yield batch_from_arrow(pa.Table.from_batches([rb]))
             return
+        f = pq.ParquetFile(path)
         for rb in f.iter_batches(batch_size=self.batch_rows, columns=cols):
             if rb.num_rows:
                 yield batch_from_arrow(pa.Table.from_batches([rb]))
 
-    def node_desc(self):
-        base = os.path.basename(self.paths[0])
-        extra = f"+{len(self.paths)-1}" if len(self.paths) > 1 else ""
-        cols = "*" if self.columns is None else ",".join(self.columns)
-        return f"ParquetScan[{base}{extra}]({cols})"
 
-
-class TpuParquetScanExec(CpuParquetScanExec):
-    """Device-feeding parquet scan: host decode -> semaphore -> upload
-    (reference call stack SURVEY.md §3.2)."""
-
-    is_device = True
-
-    def __init__(self, cpu: CpuParquetScanExec):
-        LeafExec.__init__(self)
-        self.paths = cpu.paths
-        self.columns = cpu.columns
-        self.predicate = cpu.predicate
-        self.batch_rows = cpu.batch_rows
-        self._schema = cpu._schema
-        self._fragments = cpu._fragments
-
-    def execute_partition(self, pidx):
-        from spark_rapids_tpu.exec.basic import upload_batches
-        yield from upload_batches(super().execute_partition(pidx))
-
-    def node_desc(self):
-        return "Tpu" + super().node_desc()
-
+TpuParquetScanExec, _pq_convert = tpu_scan_of(CpuParquetScanExec)
 
 # plan-rewrite registration (reference: ScanRule registry GpuOverrides.scala:3864)
 from spark_rapids_tpu.plan.overrides import register_exec  # noqa: E402
 
 register_exec(CpuParquetScanExec,
-              convert=lambda p, m: TpuParquetScanExec(p),
+              convert=_pq_convert,
               exprs_of=lambda p: [p.predicate] if p.predicate is not None else [],
               desc="parquet scan (host decode + device upload)")
 
@@ -177,24 +120,8 @@ register_exec(CpuParquetScanExec,
 def write_parquet(batches, path: str, schema: Optional[T.StructType] = None):
     """Writer (reference: GpuParquetFileFormat + ColumnarOutputWriter chunked
     TableWriter; host-side arrow writer here)."""
-    import pyarrow as pa
     import pyarrow.parquet as pq
-    from spark_rapids_tpu.columnar.batch import ColumnarBatch
-    writer = None
-    try:
-        for b in batches:
-            if isinstance(b, ColumnarBatch):
-                b = b.to_host()
-            rb = b.to_arrow()
-            if writer is None:
-                writer = pq.ParquetWriter(path, rb.schema)
-            writer.write_batch(rb)
-        if writer is None:
-            if schema is None:
-                raise ValueError("cannot write empty dataset without schema")
-            empty = pa.table({f.name: pa.array([], type=T.to_arrow(f.data_type))
-                              for f in schema})
-            pq.write_table(empty, path)
-    finally:
-        if writer is not None:
-            writer.close()
+    chunked_write(
+        batches, path, schema,
+        open_writer=lambda p, sch: pq.ParquetWriter(p, sch),
+        write_batch=lambda w, rb: w.write_batch(rb))

@@ -1,0 +1,125 @@
+"""DataFrame writer: Spark-style directory output with part files.
+
+Reference: the write path through ``GpuDataWritingCommandExec`` +
+``ColumnarOutputWriter.scala`` — one output file per task/partition under the
+target directory, a ``_SUCCESS`` marker on commit, and SaveMode semantics
+(error/overwrite/append/ignore).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import uuid
+from typing import Optional
+
+from spark_rapids_tpu import types as T
+
+_FORMATS = {}
+
+
+def _register(fmt):
+    def deco(fn):
+        _FORMATS[fmt] = fn
+        return fn
+    return deco
+
+
+@_register("parquet")
+def _write_parquet(batches, path, schema):
+    from spark_rapids_tpu.io.parquet import write_parquet
+    write_parquet(batches, path, schema)
+
+
+@_register("csv")
+def _write_csv(batches, path, schema, **opts):
+    from spark_rapids_tpu.io.text import write_csv
+    write_csv(batches, path, schema, **opts)
+
+
+@_register("json")
+def _write_json(batches, path, schema):
+    from spark_rapids_tpu.io.text import write_json
+    write_json(batches, path, schema)
+
+
+@_register("orc")
+def _write_orc(batches, path, schema):
+    from spark_rapids_tpu.io.orc import write_orc
+    write_orc(batches, path, schema)
+
+
+_EXT = {"parquet": ".parquet", "csv": ".csv", "json": ".json", "orc": ".orc"}
+
+
+class DataFrameWriter:
+    """``df.write.mode("overwrite").parquet(path)`` — directory output."""
+
+    def __init__(self, df):
+        self._df = df
+        self._mode = "error"
+        self._options = {}
+
+    def mode(self, m: str) -> "DataFrameWriter":
+        m = m.lower()
+        if m not in ("error", "errorifexists", "overwrite", "append",
+                     "ignore"):
+            raise ValueError(f"unknown save mode {m!r}")
+        self._mode = "error" if m == "errorifexists" else m
+        return self
+
+    def option(self, key: str, value) -> "DataFrameWriter":
+        self._options[key] = value
+        return self
+
+    # -- format entry points -------------------------------------------------
+    def parquet(self, path: str):
+        self._save(path, "parquet")
+
+    def csv(self, path: str):
+        self._save(path, "csv")
+
+    def json(self, path: str):
+        self._save(path, "json")
+
+    def orc(self, path: str):
+        self._save(path, "orc")
+
+    # -- machinery ----------------------------------------------------------
+    def _save(self, path: str, fmt: str):
+        write_one = _FORMATS[fmt]
+        exists = os.path.exists(path)
+        if exists and self._mode == "error":
+            raise FileExistsError(
+                f"path {path} already exists (mode=error)")
+        if exists and self._mode == "ignore":
+            return
+        if exists and self._mode == "overwrite":
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            else:
+                os.remove(path)
+        os.makedirs(path, exist_ok=True)
+        plan = self._df._executed_plan()
+        schema = self._df.schema
+        job_id = uuid.uuid4().hex[:8]
+        from spark_rapids_tpu.plan.base import run_task
+        kw = {}
+        if fmt == "csv":
+            kw = {k: v for k, v in self._options.items()
+                  if k in ("header", "sep")}
+        wrote = 0
+        for pidx in range(plan.num_partitions):
+            batches = list(run_task(plan, pidx))
+            if not batches and plan.num_partitions > 1:
+                continue  # empty partition: no part file (Spark behavior)
+            part = os.path.join(
+                path, f"part-{pidx:05d}-{job_id}{_EXT[fmt]}")
+            write_one(iter(batches), part, schema, **kw)
+            wrote += 1
+        if wrote == 0:
+            # all-empty dataset still gets one (empty) part file
+            part = os.path.join(path, f"part-00000-{job_id}{_EXT[fmt]}")
+            write_one(iter(()), part, schema, **kw)
+        with open(os.path.join(path, "_SUCCESS"), "w"):
+            pass

@@ -148,9 +148,14 @@ class NativeHostPool:
             self._bufs = {}
             self._mu = threading.Lock()
 
+    def _require_open(self):
+        if self._lib is not None and self._pool is None:
+            raise ValueError("pool is closed")
+
     def alloc(self, size: int) -> Optional[int]:
         """Returns an opaque handle (address) or None on OOM."""
         if self._lib is not None:
+            self._require_open()
             ptr = self._lib.tpucol_pool_alloc(self._pool, size)
             if ptr:
                 with self._live_mu:
@@ -172,6 +177,7 @@ class NativeHostPool:
         if handle is None:
             return
         if self._lib is not None:
+            self._require_open()
             with self._live_mu:
                 if handle not in self._live:
                     raise ValueError(
@@ -197,6 +203,7 @@ class NativeHostPool:
 
     def stats(self) -> dict:
         if self._lib is not None:
+            self._require_open()
             out = (ctypes.c_uint64 * 5)()
             self._lib.tpucol_pool_stats(self._pool, out)
             return {"in_use": out[0], "peak": out[1], "total_allocs": out[2],
@@ -209,6 +216,7 @@ class NativeHostPool:
     def set_limit(self, limit_bytes: int) -> None:
         self._limit = limit_bytes
         if self._lib is not None:
+            self._require_open()
             self._lib.tpucol_pool_set_limit(self._pool, limit_bytes)
 
     def close(self) -> None:

@@ -80,12 +80,57 @@ class TpuSession:
         return DataFrame(CpuRangeExec(start, end, step, num_partitions), self)
 
     class _Reader:
+        """``session.read.option(...).csv(path)`` (DataFrameReader analog).
+
+        Reader strategy + thread count come from the session conf
+        (reference: RapidsConf READER_TYPE / MULTITHREAD_READ_NUM_THREADS)."""
+
         def __init__(self, session):
             self._s = session
+            self._schema = None
+            self._options = {}
+
+        def schema(self, s) -> "TpuSession._Reader":
+            self._schema = s
+            return self
+
+        def option(self, key, value) -> "TpuSession._Reader":
+            self._options[key] = value
+            return self
+
+        def _common(self, type_entry):
+            conf = self._s.conf
+            return dict(
+                reader_type=conf.get(type_entry.key),
+                batch_rows=conf.get(C.MAX_READER_BATCH_SIZE_ROWS.key),
+                num_threads=conf.get(C.MULTITHREADED_READ_NUM_THREADS.key))
 
         def parquet(self, *paths, columns=None) -> "DataFrame":
             from spark_rapids_tpu.io.parquet import CpuParquetScanExec
-            return DataFrame(CpuParquetScanExec(list(paths), columns), self._s)
+            return DataFrame(
+                CpuParquetScanExec(list(paths), columns,
+                                   **self._common(C.READER_TYPE)), self._s)
+
+        def csv(self, *paths, columns=None) -> "DataFrame":
+            from spark_rapids_tpu.io.text import CpuCsvScanExec
+            opts = {k: v for k, v in self._options.items()
+                    if k in ("header", "sep", "quote", "escape", "comment",
+                             "null_value")}
+            return DataFrame(CpuCsvScanExec(
+                list(paths), user_schema=self._schema, columns=columns,
+                **opts, **self._common(C.CSV_READER_TYPE)), self._s)
+
+        def json(self, *paths, columns=None) -> "DataFrame":
+            from spark_rapids_tpu.io.text import CpuJsonScanExec
+            return DataFrame(CpuJsonScanExec(
+                list(paths), user_schema=self._schema, columns=columns,
+                **self._common(C.JSON_READER_TYPE)), self._s)
+
+        def orc(self, *paths, columns=None) -> "DataFrame":
+            from spark_rapids_tpu.io.orc import CpuOrcScanExec
+            return DataFrame(
+                CpuOrcScanExec(list(paths), columns=columns,
+                               **self._common(C.ORC_READER_TYPE)), self._s)
 
     @property
     def read(self) -> "_Reader":
@@ -425,6 +470,12 @@ class DataFrame:
     def write_parquet(self, path: str) -> None:
         from spark_rapids_tpu.io.parquet import write_parquet
         write_parquet(self._executed_plan().execute_all(), path, self.schema)
+
+    @property
+    def write(self):
+        """Directory-style writer: ``df.write.mode("overwrite").parquet(p)``."""
+        from spark_rapids_tpu.io.writer import DataFrameWriter
+        return DataFrameWriter(self)
 
     # -- introspection ------------------------------------------------------
     def explain(self, mode: str = "formatted") -> str:

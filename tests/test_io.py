@@ -1,0 +1,193 @@
+"""IO format tests: CSV/JSON/ORC scans, writers, multi-file strategies.
+
+Reference test analogs: integration_tests csv_test.py / json_test.py /
+orc_test.py / parquet_test.py and the multi-file reader matrix
+(read_parquet_test reader_types parametrization).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import col, lit
+
+from tests.asserts import (assert_tpu_and_cpu_are_equal_collect,
+                           cpu_session, tpu_session)
+
+RNG = np.random.default_rng(7)
+N = 2000
+
+
+def _data(n=N):
+    return {
+        "i": RNG.integers(-1000, 1000, n).astype(np.int64),
+        "f": np.round(RNG.standard_normal(n), 6),
+        "s": [None if k % 13 == 0 else f"row-{k % 31}" for k in range(n)],
+        "b": RNG.integers(0, 2, n).astype(bool),
+    }
+
+
+@pytest.fixture(scope="module")
+def datasets(tmp_path_factory):
+    """Writes one dataset in each format (single file + multi-file dir)."""
+    root = tmp_path_factory.mktemp("io")
+    s = cpu_session()
+    df = s.create_dataframe(_data())
+    paths = {}
+    paths["parquet"] = str(root / "t.parquet")
+    df.write_parquet(paths["parquet"])
+    import pyarrow as pa
+    import pyarrow.csv as pcsv
+    import pyarrow.orc as porc
+    tbl = df.to_arrow()
+    paths["csv"] = str(root / "t.csv")
+    pcsv.write_csv(tbl, paths["csv"])
+    paths["orc"] = str(root / "t.orc")
+    porc.write_table(tbl, paths["orc"])
+    paths["json"] = str(root / "t.json")
+    from spark_rapids_tpu.io.text import write_json
+    write_json([df.collect_batch()], paths["json"])
+    # multi-file parquet directory (8 small files)
+    mdir = root / "many"
+    mdir.mkdir()
+    for k in range(8):
+        part = s.create_dataframe(_data(200))
+        part.write_parquet(str(mdir / f"f{k}.parquet"))
+    paths["parquet_dir"] = str(mdir)
+    return paths
+
+
+def test_csv_roundtrip_differential(datasets):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.csv(datasets["csv"])
+        .filter(col("i") > 0).select("i", "f", "s"),
+        ignore_order=True)
+
+
+def test_csv_explicit_schema(datasets):
+    schema = T.StructType([
+        T.StructField("i", T.LONG), T.StructField("f", T.DOUBLE),
+        T.StructField("s", T.STRING), T.StructField("b", T.BOOLEAN)])
+    s = tpu_session()
+    got = s.read.schema(schema).csv(datasets["csv"])
+    assert got.schema.names == ["i", "f", "s", "b"]
+    assert got.count() == N
+
+
+def test_json_roundtrip_differential(datasets):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.json(datasets["json"]).select("i", "f"),
+        ignore_order=True)
+
+
+def test_orc_roundtrip_differential(datasets):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.orc(datasets["orc"])
+        .filter(col("b")).select("i", "s"),
+        ignore_order=True)
+
+
+def test_orc_column_pruning(datasets):
+    s = tpu_session()
+    df = s.read.orc(datasets["orc"], columns=["i"])
+    assert df.schema.names == ["i"]
+    assert df.count() == N
+
+
+@pytest.mark.parametrize("reader_type",
+                         ["PERFILE", "COALESCING", "MULTITHREADED", "AUTO"])
+def test_multifile_reader_strategies(datasets, reader_type):
+    """All strategies must produce identical data (reference:
+    read_parquet_test reader list parametrization)."""
+    s = tpu_session({"spark.rapids.sql.format.parquet.reader.type":
+                     reader_type})
+    df = s.read.parquet(datasets["parquet_dir"])
+    assert df.count() == 8 * 200
+    got = sorted(r["i"] for r in df.select("i").collect())
+    base = tpu_session().read.parquet(datasets["parquet_dir"])
+    assert got == sorted(r["i"] for r in base.select("i").collect())
+
+
+def test_coalescing_stitches_small_files(datasets):
+    """COALESCING must merge 8 small files into fewer partitions/batches."""
+    from spark_rapids_tpu.io.parquet import CpuParquetScanExec
+    scan = CpuParquetScanExec([datasets["parquet_dir"]],
+                              reader_type="COALESCING")
+    assert scan.num_partitions == 1  # tiny files bin-pack into one partition
+    batches = list(scan.execute_partition(0))
+    assert len(batches) == 1  # stitched into one output batch
+    assert batches[0].row_count == 8 * 200
+    perfile = CpuParquetScanExec([datasets["parquet_dir"]],
+                                 reader_type="PERFILE")
+    assert perfile.num_partitions == 8
+
+
+def test_writer_directory_roundtrip(tmp_path):
+    s = tpu_session()
+    data = {"i": np.arange(500, dtype=np.int64)}
+    df = s.create_dataframe(data, num_partitions=3)
+    out = str(tmp_path / "out_pq")
+    df.write.parquet(out)
+    assert os.path.exists(os.path.join(out, "_SUCCESS"))
+    parts = [f for f in os.listdir(out) if f.endswith(".parquet")]
+    assert len(parts) == 3
+    back = s.read.parquet(out)
+    assert back.count() == 500
+    assert sorted(r["i"] for r in back.select("i").collect()) == \
+        list(range(500))
+
+
+def test_writer_modes(tmp_path):
+    s = tpu_session()
+    df = s.create_dataframe({"x": np.arange(10)})
+    out = str(tmp_path / "m")
+    df.write.parquet(out)
+    with pytest.raises(FileExistsError):
+        df.write.parquet(out)
+    df.write.mode("ignore").parquet(out)       # no-op
+    df.write.mode("overwrite").parquet(out)    # replaces
+    assert s.read.parquet(out).count() == 10
+
+
+def test_writer_csv_json_orc(tmp_path):
+    s = tpu_session()
+    data = {"x": np.arange(50, dtype=np.int64),
+            "y": np.round(np.linspace(0, 1, 50), 4)}
+    df = s.create_dataframe(data)
+    for fmt in ("csv", "json", "orc"):
+        out = str(tmp_path / f"w_{fmt}")
+        getattr(df.write, fmt)(out)
+        back = getattr(s.read, fmt)(out)
+        rows = back.collect()
+        assert len(rows) == 50
+        assert sorted(r["x"] for r in rows) == list(range(50))
+
+
+def test_csv_options(tmp_path):
+    p = str(tmp_path / "opt.csv")
+    with open(p, "w") as f:
+        f.write("# a comment line\n")
+        f.write("1|one\n2|two\n3|\n")
+    schema = T.StructType([T.StructField("n", T.INT),
+                           T.StructField("w", T.STRING)])
+    s = tpu_session()
+    df = (s.read.schema(schema).option("header", False).option("sep", "|")
+          .option("comment", "#").csv(p))
+    rows = df.collect()
+    assert [r["n"] for r in rows] == [1, 2, 3]
+    assert rows[2]["w"] is None
+
+
+def test_parquet_predicate_pushdown_still_works(datasets):
+    from spark_rapids_tpu.expressions import predicates as P
+    from spark_rapids_tpu.io.parquet import CpuParquetScanExec
+    from spark_rapids_tpu.expressions.base import AttributeReference, Literal
+    pred = P.GreaterThan(AttributeReference("i"), Literal(500))
+    scan = CpuParquetScanExec([datasets["parquet"]], predicate=pred)
+    total = sum(int(b.row_count) for p in range(scan.num_partitions)
+                for b in scan.execute_partition(p))
+    expected = sum(1 for r in cpu_session().read
+                   .parquet(datasets["parquet"]).collect() if r["i"] > 500)
+    assert total == expected
