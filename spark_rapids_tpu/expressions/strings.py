@@ -419,3 +419,237 @@ class LTrim(_Trim):
 class RTrim(_Trim):
     trim_left = False
     trim_right = True
+
+
+# ---------------------------------------------------------------------------
+# Regular expressions (reference: GpuRLike/GpuRegExpReplace/GpuRegExpExtract
+# in stringFunctions.scala + the RegexParser.scala transpiler).
+#
+# The Java-dialect pattern is transpiled once at planning time
+# (spark_rapids_tpu/regexp.py).  Patterns that reduce to fixed-string
+# prefix/suffix/contains/equals run as device kernels (the reference's
+# RegexRewriteUtils rewrite); everything else runs on the host tier with
+# honest fallback tagging.
+# ---------------------------------------------------------------------------
+
+class _RegexExpr(Expression):
+    """Shared machinery: literal-pattern requirement + cached transpile."""
+
+    mode = "FIND"
+
+    def _pattern_literal(self):
+        from spark_rapids_tpu.expressions.base import Literal
+        p = self.children[1]
+        if isinstance(p, Literal) and isinstance(p.value, str):
+            return p.value
+        return None
+
+    def _transpiled(self):
+        from spark_rapids_tpu import regexp as RX
+        if not hasattr(self, "_tx_cache"):
+            pat = self._pattern_literal()
+            self._tx_cache = None if pat is None else RX.transpile(
+                pat, self.mode)
+        return self._tx_cache
+
+    @staticmethod
+    def _best_effort_compile(pattern: str):
+        """Transpiled when possible; raw host-dialect otherwise.  The CPU
+        fallback path must execute even transpiler-rejected patterns (the
+        reference's CPU fallback runs Java regex natively); divergences for
+        exotic escapes are documented compatibility deviations."""
+        import re
+        from spark_rapids_tpu import regexp as RX
+        try:
+            return re.compile(RX.transpile(pattern).pattern)
+        except RX.RegexUnsupported:
+            return re.compile(pattern)
+
+    def _compiled(self):
+        if not hasattr(self, "_re_cache"):
+            self._re_cache = self._best_effort_compile(self._pattern_literal())
+        return self._re_cache
+
+    def _pattern_regexes(self, ctx, n):
+        """Per-row compiled patterns: the cached literal regex, or per-row
+        compilation when the pattern is itself a column (Spark recompiles
+        non-foldable patterns per row)."""
+        if self._pattern_literal() is not None:
+            rx = self._compiled()
+            return [rx] * n
+        pats = self.children[1].eval(ctx)
+        data = materialize(pats, ctx, np.dtype(object))
+        cache = {}
+        out = []
+        for p in data:
+            if p is None:
+                out.append(None)
+            else:
+                if p not in cache:
+                    cache[p] = self._best_effort_compile(p)
+                out.append(cache[p])
+        return out
+
+    def tpu_supported(self, conf):
+        from spark_rapids_tpu import config as C
+        from spark_rapids_tpu import regexp as RX
+        if not conf.get(C.ENABLE_REGEX.key):
+            return "regular expressions disabled by spark.rapids.sql.regexp.enabled"
+        if self._pattern_literal() is None:
+            return "only literal regex patterns are supported"
+        try:
+            tx = self._transpiled()
+        except RX.RegexUnsupported as e:
+            return f"regex not supported: {e}"
+        r = self._extra_checks(tx)
+        if r is not None:
+            return r
+        return self._tag_transpiled(tx)
+
+    def _extra_checks(self, tx):
+        """Subclass validation that should surface before the generic
+        host-tier reason (mirrors the reference's per-op tag rules)."""
+        return None
+
+    def _tag_transpiled(self, tx):
+        return "general regex runs on host (planner rewrites simple patterns)"
+
+
+class RLike(_RegexExpr):
+    """str RLIKE pattern (reference: GpuRLike; Java Pattern.find semantics)."""
+
+    def __init__(self, subject: Expression, pattern: Expression):
+        super().__init__([subject, pattern])
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def sql(self):
+        return f"{self.children[0].sql()} RLIKE {self.children[1].sql()}"
+
+    def _tag_transpiled(self, tx):
+        if tx.rewrite is not None:
+            return None  # runs as a fixed-string device kernel
+        return super()._tag_transpiled(tx)
+
+    def _rewritten(self):
+        """The device-kernel equivalent for simple patterns."""
+        from spark_rapids_tpu.expressions.base import Literal
+        from spark_rapids_tpu.expressions.predicates import EqualTo
+        kind, lit = self._transpiled().rewrite
+        subject = self.children[0]
+        litex = Literal(lit, T.STRING)
+        return {"equals": EqualTo, "prefix": StartsWith,
+                "suffix": EndsWith, "contains": Contains}[kind](subject, litex)
+
+    def eval_tpu(self, ctx):
+        tx = self._transpiled()
+        if tx is not None and tx.rewrite is not None:
+            return self._rewritten().eval(ctx)
+        return self.eval_cpu(ctx)
+
+    def eval_cpu(self, ctx):
+        c = self.children[0].eval(ctx)
+        data = materialize(c, ctx, np.dtype(object))
+        rxs = self._pattern_regexes(ctx, len(data))
+        valid = valid_array(c, ctx) & valid_array(
+            self.children[1].eval(ctx), ctx)
+        out = np.zeros(len(data), dtype=bool)
+        for i in range(len(data)):
+            if valid[i] and data[i] is not None and rxs[i] is not None:
+                out[i] = rxs[i].search(data[i]) is not None
+        return TCol(out, valid, T.BOOLEAN)
+
+
+class RegExpReplace(_RegexExpr):
+    """regexp_replace(str, pattern, replacement)
+    (reference: GpuRegExpReplace + GpuRegExpUtils.backrefConversion)."""
+
+    mode = "REPLACE"
+
+    def __init__(self, subject, pattern, replacement):
+        super().__init__([subject, pattern, replacement])
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _extra_checks(self, tx):
+        from spark_rapids_tpu.expressions.base import Literal
+        repl = self.children[2]
+        if not (isinstance(repl, Literal) and isinstance(repl.value, str)):
+            return "only literal replacement strings are supported"
+        return None
+
+    def _py_replacement(self):
+        from spark_rapids_tpu import regexp as RX
+        from spark_rapids_tpu.expressions.base import Literal
+        repl = self.children[2]
+        if not (isinstance(repl, Literal) and isinstance(repl.value, str)):
+            raise NotImplementedError(
+                "regexp_replace requires a literal replacement string")
+        return RX.transpile_replacement(repl.value)
+
+    def eval_cpu(self, ctx):
+        repl = self._py_replacement()
+        c = self.children[0].eval(ctx)
+        data = materialize(c, ctx, np.dtype(object))
+        rxs = self._pattern_regexes(ctx, len(data))
+        valid = valid_array(c, ctx)
+        out = np.empty(len(data), dtype=object)
+        for i in range(len(data)):
+            if valid[i] and data[i] is not None and rxs[i] is not None:
+                out[i] = rxs[i].sub(repl, data[i])
+            else:
+                out[i] = None
+        return TCol(out, valid, T.STRING)
+
+    eval_tpu = eval_cpu  # host tier (tagging routes here only on fallback)
+
+
+class RegExpExtract(_RegexExpr):
+    """regexp_extract(str, pattern, idx) — group idx of the first match,
+    empty string when no match (Spark semantics; reference GpuRegExpExtract)."""
+
+    def __init__(self, subject, pattern, idx: Expression = None):
+        from spark_rapids_tpu.expressions.base import Literal
+        if idx is None:
+            idx = Literal(1, T.INT)
+        super().__init__([subject, pattern, idx])
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _extra_checks(self, tx):
+        from spark_rapids_tpu.expressions.base import Literal
+        idx = self.children[2]
+        if not (isinstance(idx, Literal) and isinstance(idx.value, int)):
+            return "group index must be a literal integer"
+        if not (0 <= idx.value <= tx.num_groups):
+            return (f"group index {idx.value} out of range "
+                    f"(pattern has {tx.num_groups} groups)")
+        return None
+
+    def eval_cpu(self, ctx):
+        from spark_rapids_tpu.expressions.base import Literal
+        if not isinstance(self.children[2], Literal):
+            raise NotImplementedError(
+                "regexp_extract requires a literal group index")
+        idx = self.children[2].value
+        c = self.children[0].eval(ctx)
+        data = materialize(c, ctx, np.dtype(object))
+        rxs = self._pattern_regexes(ctx, len(data))
+        valid = valid_array(c, ctx)
+        out = np.empty(len(data), dtype=object)
+        for i in range(len(data)):
+            if valid[i] and data[i] is not None and rxs[i] is not None:
+                m = rxs[i].search(data[i])
+                g = m.group(idx) if m is not None else ""
+                out[i] = "" if g is None else g
+            else:
+                out[i] = None
+        return TCol(out, valid, T.STRING)
+
+    eval_tpu = eval_cpu

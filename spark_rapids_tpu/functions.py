@@ -218,3 +218,23 @@ def lag(e, offset: int = 1, default=None):
 def lead(e, offset: int = 1, default=None):
     from spark_rapids_tpu.expressions.window_exprs import Lead
     return Lead(_expr(e), offset, None if default is None else lit(default))
+
+
+# -- regular expressions (reference: RLike/RegExpReplace/RegExpExtract rules) --
+
+def rlike(e, pattern: str):
+    from spark_rapids_tpu.expressions.strings import RLike
+    from spark_rapids_tpu.expressions.base import lit
+    return RLike(_expr(e), lit(pattern))
+
+
+def regexp_replace(e, pattern: str, replacement: str):
+    from spark_rapids_tpu.expressions.strings import RegExpReplace
+    from spark_rapids_tpu.expressions.base import lit
+    return RegExpReplace(_expr(e), lit(pattern), lit(replacement))
+
+
+def regexp_extract(e, pattern: str, idx: int = 1):
+    from spark_rapids_tpu.expressions.strings import RegExpExtract
+    from spark_rapids_tpu.expressions.base import lit
+    return RegExpExtract(_expr(e), lit(pattern), lit(idx))

@@ -273,12 +273,15 @@ def chunked_write(batches, path: str, schema, open_writer, write_batch):
             if schema is None:
                 raise ValueError("cannot write empty dataset without schema")
             from spark_rapids_tpu import types as _T
-            empty = pa.table(
-                {f.name: pa.array([], type=_T.to_arrow(f.data_type))
-                 for f in schema})
-            writer = open_writer(path, empty.schema)
-            for rb in empty.to_batches(max_chunksize=1):
-                write_batch(writer, rb)
+            arrays = [pa.array([], type=_T.to_arrow(f.data_type))
+                      for f in schema]
+            names = [f.name for f in schema]
+            writer = open_writer(path, pa.schema(
+                [(n, a.type) for n, a in zip(names, arrays)]))
+            # one explicit 0-row batch: some writers (ORC) emit no footer
+            # metadata at all unless at least one write happens
+            write_batch(writer, pa.RecordBatch.from_arrays(arrays,
+                                                           names=names))
     finally:
         if writer is not None:
             writer.close()

@@ -105,17 +105,30 @@ class CpuCsvScanExec(MultiFileScanBase):
         return sch
 
     @staticmethod
-    def _strip_comments(data: bytes, comment: bytes, quote: bytes) -> bytes:
+    def _strip_comments(data: bytes, comment: bytes, quote: bytes,
+                        escape: bytes) -> bytes:
         """Drops comment lines, but never a physical line inside an open
-        quoted field (multi-line values).  Doubled quotes ("") contribute 2
-        to the count, so parity is unchanged — correct for RFC-4180 escaping."""
+        quoted field (multi-line values).  Quote parity counts only
+        unescaped quotes: doubled quotes ("") contribute 2 (parity
+        unchanged, RFC-4180), and escape-char-prefixed quotes are skipped."""
+        q = quote[0] if quote else None
+        e = escape[0] if escape and escape != quote else None
         out = []
         in_quote = False
         for ln in data.split(b"\n"):
             if not in_quote and ln.lstrip().startswith(comment):
                 continue
             out.append(ln)
-            if ln.count(quote) % 2 == 1:
+            cnt = 0
+            skip = False
+            for b in ln:
+                if skip:
+                    skip = False
+                elif e is not None and b == e:
+                    skip = True
+                elif b == q:
+                    cnt += 1
+            if cnt % 2 == 1:
                 in_quote = not in_quote
         return b"\n".join(out)
 
@@ -128,8 +141,9 @@ class CpuCsvScanExec(MultiFileScanBase):
             # (full in-memory read — the comment option trades streaming for
             # correctness; omit it for large files)
             with open(path, "rb") as f:
-                data = self._strip_comments(f.read(), self.comment.encode(),
-                                            self.quote.encode())
+                data = self._strip_comments(
+                    f.read(), self.comment.encode(), self.quote.encode(),
+                    self.escape.encode() if self.escape else b"")
             stripped = io.BytesIO(data)
         with pcsv.open_csv(stripped or path, read_options=read,
                            parse_options=parse, convert_options=conv) as rdr:
@@ -166,17 +180,33 @@ class CpuJsonScanExec(MultiFileScanBase):
         if self.user_schema is not None:
             sch = self.user_schema
         else:
+            import io as _io
             import pyarrow.json as pjson
-            tbl = pjson.read_json(self.paths[0])
-            sch = T.StructType([T.StructField(f.name, T.from_arrow(f.type))
-                                for f in tbl.schema])
+            # infer from the leading block only (cut at the last complete
+            # line) — planning-time schema access must stay cheap
+            with open(self.paths[0], "rb") as f:
+                head = f.read(1 << 20)
+                if len(head) == (1 << 20):
+                    cut = head.rfind(b"\n")
+                    if cut > 0:
+                        head = head[:cut]
+            if not head.strip():
+                sch = T.StructType([])  # empty file: zero-column schema
+            else:
+                tbl = pjson.read_json(_io.BytesIO(head))
+                sch = T.StructType([
+                    T.StructField(f.name, T.from_arrow(f.type))
+                    for f in tbl.schema])
         if self.columns is not None:
             sch = T.StructType([f for f in sch.fields
                                 if f.name in self.columns])
         return sch
 
     def read_file(self, path: str) -> Iterator[HostColumnarBatch]:
+        import os as _os
         import pyarrow.json as pjson
+        if _os.path.getsize(path) == 0:
+            return  # empty part file
         opts = None
         if self.user_schema is not None:
             import pyarrow as pa

@@ -117,7 +117,8 @@ for _cls in (B.BitwiseAnd, B.BitwiseOr, B.BitwiseXor, B.BitwiseNot,
 register_expr(CA.Cast, TS.ALL_BASIC)
 
 for _cls in (S.Length, S.Upper, S.Lower, S.Concat, S.Substring, S.StartsWith,
-             S.EndsWith, S.Contains, S.Trim, S.LTrim, S.RTrim, S.Like):
+             S.EndsWith, S.Contains, S.Trim, S.LTrim, S.RTrim, S.Like,
+             S.RLike, S.RegExpReplace, S.RegExpExtract):
     register_expr(_cls, TS.ALL_BASIC)
 
 for _cls in (D._DateField, D._TimeField, D.DateAdd, D.DateSub, D.DateDiff,

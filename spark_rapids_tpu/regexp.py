@@ -1,0 +1,671 @@
+"""Regex transpiler: Java-dialect patterns -> the engine's execution dialect.
+
+Reference: ``RegexParser.scala`` (2183 LoC) parses Java regex into an AST and
+transpiles to the cuDF dialect, rejecting constructs the device engine cannot
+run faithfully; ``RegexComplexityEstimator.scala`` bounds device memory;
+``RegexRewriteUtils`` (JNI) rewrites simple patterns into
+startswith/endswith/contains kernels.
+
+TPU stance: a backtracking byte-automaton is TPU-hostile, so general regex
+runs on the host tier (honest fallback tagging, as the reference does for
+unsupported ops).  This module plays all three reference roles:
+
+1. parse: Spark expressions carry *Java* regex; we parse the Java dialect
+   (with its escapes: \\uXXXX, \\0n octal, \\cX, \\p{Posix}, \\Q...\\E) and
+   reject what cannot be translated faithfully (lookaround, backreferences,
+   possessive quantifiers, atomic groups, inline flags, \\G, \\R, \\X).
+2. transpile: emit an equivalent pattern in the host engine's dialect
+   (Python ``re``), translating the divergent escapes.
+3. rewrite: detect patterns that reduce to literal prefix/suffix/contains/
+   equals and report the rewrite so the planner can run them as device
+   kernels (the RegexRewriteUtils trick).
+
+Modes mirror the reference's RegexMode: FIND (RLike), REPLACE
+(regexp_replace), SPLIT (string split).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+FIND = "FIND"
+REPLACE = "REPLACE"
+SPLIT = "SPLIT"
+
+
+class RegexUnsupported(ValueError):
+    """reference: RegexUnsupportedException — the pattern cannot run in the
+    accelerated engine; callers fall back (or surface the reason)."""
+
+    def __init__(self, msg: str, pos: Optional[int] = None):
+        self.pos = pos
+        super().__init__(msg if pos is None else f"{msg} near position {pos}")
+
+
+# ---------------------------------------------------------------------------
+# AST (reference: RegexAST sealed trait family in RegexParser.scala)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class RegexNode:
+    pass
+
+
+@dataclasses.dataclass
+class RLiteral(RegexNode):
+    ch: str            # one literal character (unescaped)
+
+
+@dataclasses.dataclass
+class RSequence(RegexNode):
+    parts: List[RegexNode]
+
+
+@dataclasses.dataclass
+class RAlternation(RegexNode):
+    branches: List[RegexNode]
+
+
+@dataclasses.dataclass
+class RCharClass(RegexNode):
+    body: str          # transpiled class body WITHOUT brackets
+    negated: bool
+    literal_chars: Optional[List[str]] = None  # set when all-plain chars
+
+
+@dataclasses.dataclass
+class RPredef(RegexNode):
+    cls: str           # one of d D w W s S .
+
+
+@dataclasses.dataclass
+class RAnchor(RegexNode):
+    kind: str          # ^ $ \A \Z \z \b \B
+
+
+@dataclasses.dataclass
+class RGroup(RegexNode):
+    child: RegexNode
+    capturing: bool
+    name: Optional[str] = None
+
+
+@dataclasses.dataclass
+class RRepeat(RegexNode):
+    child: RegexNode
+    min: int
+    max: Optional[int]  # None = unbounded
+    lazy: bool
+
+
+_POSIX_CLASSES = {
+    # Java \p{...} POSIX classes -> python class bodies (US-ASCII semantics,
+    # matching Java's default; reference transpiles these the same way)
+    "Lower": "a-z", "Upper": "A-Z", "Alpha": "a-zA-Z", "Digit": "0-9",
+    "Alnum": "a-zA-Z0-9", "Punct": r"!-/:-@\[-`{-~", "Graph": "!-~",
+    "Print": " -~", "Blank": r" \t", "Space": r" \t\n\x0b\f\r",
+    "XDigit": "0-9a-fA-F", "Cntrl": r"\x00-\x1f\x7f", "ASCII": r"\x00-\x7f",
+}
+
+_UNSUPPORTED_GROUPS = {
+    "=": "lookahead", "!": "negative lookahead",
+    "<=": "lookbehind", "<!": "negative lookbehind",
+    ">": "atomic group",
+}
+
+
+class _Parser:
+    """Recursive-descent parser over the Java pattern string
+    (reference: RegexParser.parse / parseInternal)."""
+
+    def __init__(self, pattern: str):
+        self.p = pattern
+        self.i = 0
+        self.group_count = 0
+
+    # -- stream helpers ------------------------------------------------------
+    def peek(self, off: int = 0) -> Optional[str]:
+        j = self.i + off
+        return self.p[j] if j < len(self.p) else None
+
+    def take(self) -> str:
+        ch = self.p[self.i]
+        self.i += 1
+        return ch
+
+    def expect(self, ch: str):
+        if self.peek() != ch:
+            raise RegexUnsupported(f"expected {ch!r}", self.i)
+        self.take()
+
+    def fail(self, msg: str):
+        raise RegexUnsupported(msg, self.i)
+
+    # -- grammar -------------------------------------------------------------
+    def parse(self) -> RegexNode:
+        node = self.alternation()
+        if self.i != len(self.p):
+            self.fail(f"unexpected {self.peek()!r}")
+        return node
+
+    def alternation(self) -> RegexNode:
+        branches = [self.sequence()]
+        while self.peek() == "|":
+            self.take()
+            branches.append(self.sequence())
+        return branches[0] if len(branches) == 1 else RAlternation(branches)
+
+    def sequence(self) -> RegexNode:
+        parts: List[RegexNode] = []
+        while True:
+            ch = self.peek()
+            if ch is None or ch in "|)":
+                break
+            parts.append(self.quantified())
+        return RSequence(parts)
+
+    def quantified(self) -> RegexNode:
+        atom = self.atom()
+        ch = self.peek()
+        rep: Optional[Tuple[int, Optional[int]]] = None
+        if ch == "*":
+            self.take()
+            rep = (0, None)
+        elif ch == "+":
+            self.take()
+            rep = (1, None)
+        elif ch == "?":
+            self.take()
+            rep = (0, 1)
+        elif ch == "{":
+            rep = self.brace_quantifier()
+        if rep is None:
+            return atom
+        if isinstance(atom, RAnchor):
+            self.fail(f"quantifier on anchor {atom.kind!r} is not supported")
+        lazy = False
+        nxt = self.peek()
+        if nxt == "?":
+            self.take()
+            lazy = True
+        elif nxt == "+":
+            self.fail("possessive quantifiers are not supported")
+        return RRepeat(atom, rep[0], rep[1], lazy)
+
+    def brace_quantifier(self) -> Optional[Tuple[int, Optional[int]]]:
+        # {n} {n,} {n,m}; a non-matching '{' is a literal in Java
+        start = self.i
+        self.take()  # {
+        digits = ""
+        while self.peek() is not None and self.peek().isdigit():
+            digits += self.take()
+        if not digits:
+            self.i = start
+            return None  # literal '{' handled by atom on next call
+        lo = int(digits)
+        hi: Optional[int] = lo
+        if self.peek() == ",":
+            self.take()
+            digits2 = ""
+            while self.peek() is not None and self.peek().isdigit():
+                digits2 += self.take()
+            hi = int(digits2) if digits2 else None
+        if self.peek() != "}":
+            self.i = start
+            return None
+        self.take()
+        if hi is not None and hi < lo:
+            self.fail(f"bad quantifier range {{{lo},{hi}}}")
+        return (lo, hi)
+
+    def atom(self) -> RegexNode:
+        ch = self.peek()
+        if ch == "(":
+            return self.group()
+        if ch == "[":
+            return self.char_class()
+        if ch == "\\":
+            return self.escape()
+        if ch in "*+?":
+            self.fail(f"dangling quantifier {ch!r}")
+        if ch == "^":
+            self.take()
+            return RAnchor("^")
+        if ch == "$":
+            self.take()
+            return RAnchor("$")
+        if ch == ".":
+            self.take()
+            return RPredef(".")
+        if ch == "{":
+            # tried as quantifier by caller only after an atom; here literal
+            self.take()
+            return RLiteral("{")
+        return RLiteral(self.take())
+
+    def group(self) -> RegexNode:
+        self.take()  # (
+        capturing = True
+        name = None
+        if self.peek() == "?":
+            self.take()
+            nxt = self.peek()
+            if nxt == ":":
+                self.take()
+                capturing = False
+            elif nxt == "<" and self.peek(1) not in ("=", "!"):
+                # named capturing group (?<name>...) -> python (?P<name>...)
+                self.take()
+                name = ""
+                while self.peek() is not None and self.peek() != ">":
+                    name += self.take()
+                self.expect(">")
+            else:
+                two = (nxt or "") + (self.peek(1) or "")
+                for key, what in _UNSUPPORTED_GROUPS.items():
+                    if two.startswith(key):
+                        self.fail(f"{what} is not supported")
+                self.fail(f"inline flags/special group (?{nxt} not supported")
+        if capturing:
+            self.group_count += 1
+        child = self.alternation()
+        self.expect(")")
+        return RGroup(child, capturing, name)
+
+    # -- escapes -------------------------------------------------------------
+    def escape(self) -> RegexNode:
+        self.take()  # backslash
+        ch = self.peek()
+        if ch is None:
+            self.fail("pattern ends with a bare backslash")
+        if ch in "dDwWsS":
+            self.take()
+            return RPredef(ch)
+        if ch in "bB":
+            self.take()
+            return RAnchor("\\" + ch)
+        if ch in "AzZ":
+            self.take()
+            return RAnchor("\\" + ch)
+        if ch == "G":
+            self.fail("\\G (end of previous match) is not supported")
+        if ch in ("R", "X"):
+            self.fail(f"\\{ch} is not supported")
+        if ch.isdigit() and ch != "0":
+            self.fail("backreferences are not supported")
+        if ch == "k":
+            self.fail("named backreferences are not supported")
+        if ch == "Q":
+            # \Q ... \E literal quotation
+            self.take()
+            lits: List[RegexNode] = []
+            while True:
+                c = self.peek()
+                if c is None:
+                    break
+                if c == "\\" and self.peek(1) == "E":
+                    self.take()
+                    self.take()
+                    break
+                lits.append(RLiteral(self.take()))
+            return RSequence(lits)
+        if ch == "E":
+            self.fail("\\E without \\Q")
+        if ch == "p" or ch == "P":
+            return self.posix_class(ch == "P")
+        if ch == "u":
+            self.take()
+            hexs = "".join(self.take() for _ in range(4)
+                           if self.peek() is not None)
+            try:
+                return RLiteral(chr(int(hexs, 16)))
+            except ValueError:
+                self.fail("bad \\uXXXX escape")
+        if ch == "x":
+            self.take()
+            if self.peek() == "{":
+                self.take()
+                hexs = ""
+                while self.peek() not in (None, "}"):
+                    hexs += self.take()
+                self.expect("}")
+            else:
+                hexs = "".join(self.take() for _ in range(2)
+                               if self.peek() is not None)
+            try:
+                return RLiteral(chr(int(hexs, 16)))
+            except ValueError:
+                self.fail("bad hex escape")
+        if ch == "0":
+            # Java octal \0n \0nn \0mnn
+            self.take()
+            digs = ""
+            while len(digs) < 3 and self.peek() is not None \
+                    and self.peek() in "01234567":
+                digs += self.take()
+            if not digs:
+                self.fail("bad octal escape")
+            return RLiteral(chr(int(digs, 8)))
+        if ch == "c":
+            self.take()
+            c = self.take() if self.peek() is not None else None
+            if c is None:
+                self.fail("bad \\cX escape")
+            return RLiteral(chr(ord(c.upper()) ^ 0x40))
+        if ch == "a":
+            self.take()
+            return RLiteral("\x07")
+        if ch == "e":
+            self.take()
+            return RLiteral("\x1b")
+        if ch == "f":
+            self.take()
+            return RLiteral("\f")
+        if ch == "n":
+            self.take()
+            return RLiteral("\n")
+        if ch == "r":
+            self.take()
+            return RLiteral("\r")
+        if ch == "t":
+            self.take()
+            return RLiteral("\t")
+        if ch.isalpha():
+            self.fail(f"unknown escape \\{ch}")
+        return RLiteral(self.take())
+
+    def posix_class(self, negated: bool) -> RegexNode:
+        self.take()  # p or P
+        if self.peek() != "{":
+            self.fail("\\p requires {Name}")
+        self.take()
+        name = ""
+        while self.peek() not in (None, "}"):
+            name += self.take()
+        self.expect("}")
+        body = _POSIX_CLASSES.get(name)
+        if body is None:
+            self.fail(f"unsupported character property \\p{{{name}}}")
+        return RCharClass(body, negated)
+
+    # -- character classes ---------------------------------------------------
+    def char_class(self) -> RegexNode:
+        self.take()  # [
+        negated = False
+        if self.peek() == "^":
+            self.take()
+            negated = True
+        body = ""
+        literal_chars: Optional[List[str]] = []
+        first = True
+        while True:
+            ch = self.peek()
+            if ch is None:
+                self.fail("unterminated character class")
+            if ch == "]" and not first:
+                self.take()
+                break
+            first = False
+            if ch == "[" and self.peek(1) == ":":
+                self.fail("POSIX [:class:] syntax is not supported")
+            if ch == "&" and self.peek(1) == "&":
+                self.fail("character class intersection (&&) not supported")
+            if ch == "[":
+                self.fail("nested character classes are not supported")
+            if ch == "\\":
+                node = self.escape()
+                if isinstance(node, RPredef):
+                    body += "\\" + node.cls
+                    literal_chars = None
+                elif isinstance(node, RCharClass):
+                    if node.negated:
+                        self.fail("negated property inside a class")
+                    body += node.body
+                    literal_chars = None
+                elif isinstance(node, RAnchor):
+                    if node.kind == "\\b":
+                        body += "\\x08"  # inside a class \b is backspace
+                        if literal_chars is not None:
+                            literal_chars.append("\x08")
+                    else:
+                        self.fail(f"{node.kind} inside a character class")
+                elif isinstance(node, RSequence):  # \Q..\E inside class
+                    for lit in node.parts:
+                        body += _escape_class_char(lit.ch)
+                        if literal_chars is not None:
+                            literal_chars.append(lit.ch)
+                else:
+                    body += _escape_class_char(node.ch)
+                    if literal_chars is not None:
+                        literal_chars.append(node.ch)
+                continue
+            if ch == "-" and self.peek(1) not in (None, "]") and body:
+                # range: previous char - next char
+                self.take()
+                body += "-"
+                literal_chars = None
+                continue
+            taken = self.take()
+            body += _escape_class_char(taken)
+            if literal_chars is not None:
+                literal_chars.append(taken)
+        if not body:
+            self.fail("empty character class")
+        return RCharClass(body, negated,
+                          literal_chars if literal_chars else None)
+
+
+def _escape_class_char(ch: str) -> str:
+    if ch in r"\^]-[":
+        return "\\" + ch
+    return ch
+
+
+# ---------------------------------------------------------------------------
+# Emission to the host dialect (python re)
+# ---------------------------------------------------------------------------
+
+_PY_SPECIAL = set(r"\.[]{}()*+?^$|")
+
+
+def _emit(node: RegexNode) -> str:
+    if isinstance(node, RLiteral):
+        ch = node.ch
+        if ch in _PY_SPECIAL:
+            return "\\" + ch
+        if ord(ch) < 0x20 or ord(ch) == 0x7f:
+            return f"\\x{ord(ch):02x}"
+        return ch
+    if isinstance(node, RSequence):
+        return "".join(_emit(p) for p in node.parts)
+    if isinstance(node, RAlternation):
+        return "|".join(_emit(b) for b in node.branches)
+    if isinstance(node, RCharClass):
+        return f"[{'^' if node.negated else ''}{node.body}]"
+    if isinstance(node, RPredef):
+        return "." if node.cls == "." else "\\" + node.cls
+    if isinstance(node, RAnchor):
+        if node.kind == "\\Z":
+            # Java \Z = before final line terminator; python \Z is absolute
+            return r"(?=\n?\Z)"
+        if node.kind == "\\z":
+            return r"\Z"
+        return node.kind
+    if isinstance(node, RGroup):
+        inner = _emit(node.child)
+        if node.name:
+            return f"(?P<{node.name}>{inner})"
+        return f"({inner})" if node.capturing else f"(?:{inner})"
+    if isinstance(node, RRepeat):
+        inner = _emit(node.child)
+        if isinstance(node.child, (RSequence, RAlternation)):
+            inner = f"(?:{inner})"
+        if (node.min, node.max) == (0, None):
+            q = "*"
+        elif (node.min, node.max) == (1, None):
+            q = "+"
+        elif (node.min, node.max) == (0, 1):
+            q = "?"
+        elif node.max is None:
+            q = f"{{{node.min},}}"
+        elif node.min == node.max:
+            q = f"{{{node.min}}}"
+        else:
+            q = f"{{{node.min},{node.max}}}"
+        return inner + q + ("?" if node.lazy else "")
+    raise AssertionError(f"unhandled node {node}")
+
+
+# ---------------------------------------------------------------------------
+# Complexity estimation (reference: RegexComplexityEstimator.scala — bounds
+# device memory; here bounds backtracking blowup)
+# ---------------------------------------------------------------------------
+
+def complexity(node: RegexNode, depth_unbounded: int = 0) -> int:
+    """Rough work estimate; nested unbounded repeats multiply
+    (the catastrophic-backtracking shape)."""
+    if isinstance(node, RRepeat):
+        inner_depth = depth_unbounded + (1 if node.max is None else 0)
+        weight = 10 ** inner_depth if node.max is None \
+            else max(1, (node.max or 1))
+        return weight * (1 + complexity(node.child, inner_depth))
+    if isinstance(node, (RSequence,)):
+        return sum(complexity(p, depth_unbounded) for p in node.parts) or 1
+    if isinstance(node, RAlternation):
+        return sum(complexity(b, depth_unbounded) for b in node.branches)
+    if isinstance(node, RGroup):
+        return complexity(node.child, depth_unbounded)
+    return 1
+
+
+MAX_COMPLEXITY = 10_000
+
+
+# ---------------------------------------------------------------------------
+# Simple-pattern rewrites (reference: RegexRewriteUtils JNI + the planner's
+# GpuRegExpReplaceMeta literal detection)
+# ---------------------------------------------------------------------------
+
+def _as_literal(node: RegexNode) -> Optional[str]:
+    """Returns the literal string when the node is a pure char sequence."""
+    if isinstance(node, RLiteral):
+        return node.ch
+    if isinstance(node, RSequence):
+        out = []
+        for p in node.parts:
+            s = _as_literal(p)
+            if s is None:
+                return None
+            out.append(s)
+        return "".join(out)
+    if isinstance(node, RGroup) and not node.capturing:
+        return _as_literal(node.child)
+    return None
+
+
+def simple_rewrite(node: RegexNode) -> Optional[Tuple[str, str]]:
+    """('equals'|'prefix'|'suffix'|'contains', literal) when the whole
+    pattern is anchors + a literal — device-executable as fixed-string
+    kernels (StartsWith/EndsWith/Contains/EqualTo)."""
+    seq = node.parts if isinstance(node, RSequence) else [node]
+    if not seq:
+        return ("contains", "")
+    starts = isinstance(seq[0], RAnchor) and seq[0].kind in ("^", "\\A")
+    # only \z (absolute end) is device-rewritable: Java '$'/'\Z' also match
+    # before a final line terminator, which a fixed EndsWith kernel cannot
+    # express — rewriting them would diverge from the CPU oracle
+    ends = isinstance(seq[-1], RAnchor) and seq[-1].kind == "\\z"
+    if not ends and isinstance(seq[-1], RAnchor) \
+            and seq[-1].kind in ("$", "\\Z"):
+        return None
+    core = seq[1 if starts else 0:(-1 if ends else len(seq))]
+    lit = _as_literal(RSequence(list(core)))
+    if lit is None:
+        return None
+    if starts and ends:
+        return ("equals", lit)
+    if starts:
+        return ("prefix", lit)
+    if ends:
+        return ("suffix", lit)
+    return ("contains", lit)
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Transpiled:
+    pattern: str                        # host-dialect (python re) pattern
+    rewrite: Optional[Tuple[str, str]]  # simple device rewrite, if any
+    num_groups: int
+    est_complexity: int
+
+
+def transpile(java_pattern: str, mode: str = FIND) -> Transpiled:
+    """Parses the Java pattern and returns the host-dialect translation;
+    raises RegexUnsupported for constructs that cannot run faithfully
+    (reference: CudfRegexTranspiler.transpile)."""
+    parser = _Parser(java_pattern)
+    ast = parser.parse()
+    if mode == SPLIT:
+        for kind in _collect_anchors(ast):
+            if kind in ("^", "$", "\\A", "\\Z", "\\z"):
+                raise RegexUnsupported(
+                    f"line/string anchor {kind!r} is not supported in "
+                    "split mode")
+    est = complexity(ast)
+    if est > MAX_COMPLEXITY:
+        raise RegexUnsupported(
+            f"pattern too complex (estimated work {est} > {MAX_COMPLEXITY}; "
+            "catastrophic backtracking risk)")
+    return Transpiled(_emit(ast), simple_rewrite(ast), parser.group_count,
+                      est)
+
+
+def _collect_anchors(node: RegexNode) -> List[str]:
+    out = []
+    if isinstance(node, RAnchor):
+        out.append(node.kind)
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, RegexNode):
+            out.extend(_collect_anchors(v))
+        elif isinstance(v, list):
+            for x in v:
+                if isinstance(x, RegexNode):
+                    out.extend(_collect_anchors(x))
+    return out
+
+
+def transpile_replacement(java_repl: str) -> str:
+    """Java replacement string ($1, \\$) -> python re (\\1, $)
+    (reference: GpuRegExpUtils.backrefConversion)."""
+    out = []
+    i = 0
+    while i < len(java_repl):
+        ch = java_repl[i]
+        if ch == "$" and i + 1 < len(java_repl) and java_repl[i + 1].isdigit():
+            j = i + 1
+            while j < len(java_repl) and java_repl[j].isdigit():
+                j += 1
+            out.append(f"\\g<{java_repl[i + 1:j]}>")
+            i = j
+        elif ch == "\\" and i + 1 < len(java_repl):
+            # Java: backslash makes the next char literal (incl. digits)
+            nxt = java_repl[i + 1]
+            if nxt == "$":
+                out.append("$")
+            elif nxt == "\\":
+                out.append("\\\\")
+            else:
+                out.append(nxt)
+            i += 2
+        elif ch == "\\":
+            raise RegexUnsupported("replacement ends with a bare backslash")
+        else:
+            out.append("\\\\" if ch == "\\" else ch)
+            i += 1
+    return "".join(out)

@@ -1,0 +1,220 @@
+"""Regex transpiler + expression tests.
+
+Reference analogs: tests/.../RegularExpressionTranspilerSuite.scala (dialect
+translation + rejection list), RegularExpressionRewriteSuite (simple-pattern
+rewrites), integration_tests regexp_test.py (semantics).
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import regexp as RX
+from spark_rapids_tpu.expressions.base import col, lit
+
+from tests.asserts import (assert_tpu_and_cpu_are_equal_collect, tpu_session)
+
+
+# ---------------------------------------------------------------------------
+# transpile: supported constructs
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("pat,subject,expect", [
+    ("abc", "xxabcx", True),
+    ("^abc", "abcd", True),
+    ("^abc", "xabc", False),
+    ("abc$", "xxabc", True),
+    ("a.c", "abc", True),
+    ("a.c", "a\nc", False),          # dot does not match newline (Java default)
+    ("[a-f]+", "xxdeadbeefxx", True),
+    ("[^0-9]", "123a", True),
+    (r"\d{3}", "ab123", True),
+    (r"\d{3}", "ab12", False),
+    (r"a|b|c", "zzb", True),
+    (r"(ab)+c", "ababc", True),
+    (r"colou?r", "color", True),
+    (r"\w+@\w+", "a@b", True),
+    (r"\s", "a b", True),
+    (r"\p{Digit}+", "x42", True),
+    (r"\p{Upper}", "aBc", True),
+    (r"\Qa.b\E", "xa.bx", True),
+    (r"\Qa.b\E", "xaxbx", False),    # quoted dot is literal
+    (r"a{2,}", "aaa", True),
+    (r"a{2,3}?b", "aaab", True),
+    (r"\x41", "A", True),
+    (r"A", "A", True),
+    (r"\012", None, None),           # octal is \0 prefixed in java
+    (r"\0101", "A", True),
+    (r"\cA", "\x01", True),
+    (r"\bword\b", "a word here", True),
+    (r"(?<year>\d{4})", "in 2024", True),
+])
+def test_transpile_find_matches(pat, subject, expect):
+    try:
+        tx = RX.transpile(pat)
+    except RX.RegexUnsupported:
+        if expect is None:
+            return
+        raise
+    if subject is None:
+        return
+    got = re.search(tx.pattern, subject) is not None
+    assert got == expect, (pat, tx.pattern, subject)
+
+
+@pytest.mark.parametrize("pat,why", [
+    (r"(?=abc)", "lookahead"),
+    (r"(?!abc)", "lookahead"),
+    (r"(?<=a)b", "lookbehind"),
+    (r"(?<!a)b", "lookbehind"),
+    (r"(?>ab)", "atomic"),
+    (r"a*+", "possessive"),
+    (r"a++b", "possessive"),
+    (r"(a)\1", "backreference"),
+    (r"\k<n>", "backreference"),
+    (r"\Gab", ""),
+    (r"[a-z&&[^bc]]", "intersection"),
+    (r"[[:alpha:]]", "POSIX"),
+    (r"\p{IsGreek}", "property"),
+    (r"a{3,1}", "range"),
+    (r"*a", "dangling"),
+    (r"(ab", ""),
+    (r"[abc", "unterminated"),
+    (r"a\\".rstrip("\\") + "\\", "bare backslash"),
+    (r"^?", "quantifier on anchor"),
+])
+def test_transpile_rejections(pat, why):
+    with pytest.raises(RX.RegexUnsupported):
+        RX.transpile(pat)
+
+
+def test_catastrophic_pattern_rejected():
+    with pytest.raises(RX.RegexUnsupported, match="complex"):
+        RX.transpile(r"(((a+)+)+)+b")
+
+
+def test_split_mode_rejects_anchors():
+    RX.transpile(r"a[+]b", RX.SPLIT)
+    with pytest.raises(RX.RegexUnsupported):
+        RX.transpile(r"^,", RX.SPLIT)
+    with pytest.raises(RX.RegexUnsupported):
+        RX.transpile(r",$", RX.SPLIT)
+
+
+def test_java_line_terminator_anchor():
+    # Java \Z matches before a final newline; python \Z does not
+    tx = RX.transpile(r"abc\Z")
+    assert re.search(tx.pattern, "abc\n")
+    assert re.search(tx.pattern, "abc")
+    tx2 = RX.transpile(r"abc\z")
+    assert not re.search(tx2.pattern, "abc\n")
+    assert re.search(tx2.pattern, "abc")
+
+
+# ---------------------------------------------------------------------------
+# simple-pattern rewrites (RegexRewriteUtils analog)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("pat,kind,litval", [
+    ("abc", "contains", "abc"),
+    ("^abc", "prefix", "abc"),
+    (r"\Aabc", "prefix", "abc"),
+    (r"abc\z", "suffix", "abc"),
+    (r"^abc\z", "equals", "abc"),
+    (r"a\.b", "contains", "a.b"),
+    (r"\Qa+b\E", "contains", "a+b"),
+])
+def test_simple_rewrites(pat, kind, litval):
+    tx = RX.transpile(pat)
+    assert tx.rewrite == (kind, litval)
+
+
+@pytest.mark.parametrize("pat", ["a.c", "ab+", "[ab]c", "a|b", r"^a.*b$",
+                                 # Java '$'/'\Z' match before a trailing
+                                 # newline; fixed suffix kernels cannot
+                                 "abc$", "^abc$", r"abc\Z"])
+def test_no_rewrite_for_real_regex(pat):
+    assert RX.transpile(pat).rewrite is None
+
+
+def test_dollar_anchor_not_rewritten_semantics():
+    """The reason '$' is excluded: 'abc\\n' matches abc$ in Java find."""
+    tx = RX.transpile("^abc$")
+    assert re.search(tx.pattern, "abc\n")  # host oracle matches
+    assert RX.transpile("^abc$").rewrite is None  # device must not EqualTo
+
+
+def test_replacement_transpile():
+    assert RX.transpile_replacement("x$1y") == r"x\g<1>y"
+    assert RX.transpile_replacement(r"\$5") == "$5"
+    assert RX.transpile_replacement("plain") == "plain"
+    assert re.sub(RX.transpile("(b)(c)").pattern,
+                  RX.transpile_replacement("[$2$1]"), "abcd") == "a[cb]d"
+
+
+# ---------------------------------------------------------------------------
+# expression semantics (differential + Spark known values)
+# ---------------------------------------------------------------------------
+
+_STRS = ["hello world", "Hello", None, "", "h3ll0", "aaa bbb", "xyz$",
+         "line1\nline2", "2024-07-29", "a.b.c"]
+
+
+def test_rlike_differential():
+    from spark_rapids_tpu import functions as F
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe({"s": _STRS})
+        .select(col("s"), F.rlike(col("s"), r"^[a-z]+$").alias("m"),
+                F.rlike(col("s"), r"\d+").alias("d")),
+        conf={"spark.rapids.sql.test.enabled": "false"})
+
+
+def test_rlike_simple_pattern_on_device():
+    """Prefix/contains patterns must run as device kernels (no fallback)."""
+    from spark_rapids_tpu import functions as F
+    s = tpu_session()
+    df = s.create_dataframe({"s": ["apple", "banana", None, "applesauce"]}) \
+        .select(F.rlike(col("s"), "^apple").alias("m"))
+    ex = df.explain()
+    assert "cannot run on TPU" not in ex, ex
+    assert [r["m"] for r in df.collect()] == [True, False, None, True]
+
+
+def test_rlike_complex_pattern_falls_back():
+    from spark_rapids_tpu import functions as F
+    from tests.asserts import assert_tpu_fallback_collect
+    s = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+    df = s.create_dataframe({"s": ["ab", "ba"]}) \
+        .select(F.rlike(col("s"), r"a.b?").alias("m"))
+    ex = df.explain()
+    assert "cannot run on TPU" in ex
+
+
+def test_regexp_replace_differential():
+    from spark_rapids_tpu import functions as F
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe({"s": _STRS})
+        .select(F.regexp_replace(col("s"), r"l+", "L").alias("r"),
+                F.regexp_replace(col("s"), r"(\d)", "<$1>").alias("b")),
+        conf={"spark.rapids.sql.test.enabled": "false"})
+
+
+def test_regexp_extract_spark_semantics():
+    from spark_rapids_tpu import functions as F
+    s = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+    df = s.create_dataframe({"s": ["2024-07-29", "no date", None]}) \
+        .select(F.regexp_extract(col("s"), r"(\d{4})-(\d{2})", 1).alias("y"),
+                F.regexp_extract(col("s"), r"(\d{4})-(\d{2})", 2).alias("m"))
+    rows = df.collect()
+    assert rows[0] == {"y": "2024", "m": "07"}
+    assert rows[1] == {"y": "", "m": ""}     # no match -> empty string
+    assert rows[2] == {"y": None, "m": None}  # null propagates
+
+
+def test_regexp_extract_bad_group_tagged():
+    from spark_rapids_tpu import functions as F
+    s = tpu_session()
+    df = s.create_dataframe({"s": ["x"]}) \
+        .select(F.regexp_extract(col("s"), r"(a)", 3).alias("g"))
+    assert "out of range" in df.explain()
