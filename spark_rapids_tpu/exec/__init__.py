@@ -11,3 +11,6 @@ from spark_rapids_tpu.exec.basic import (  # noqa: F401
     HostToDeviceExec, TpuCoalesceBatchesExec, TpuFilterExec,
     TpuInMemoryScanExec, TpuLimitExec, TpuProjectExec, TpuRangeExec,
     TpuSampleExec, TpuUnionExec)
+from spark_rapids_tpu.exec.expand import (  # noqa: F401
+    CpuExpandExec, CpuTakeOrderedAndProjectExec, TpuExpandExec,
+    TpuTakeOrderedAndProjectExec)

@@ -54,9 +54,85 @@ def _split_keys(specs: Sequence[SortSpec], n_cols: int):
     return orders, extra
 
 
+def host_sort_batch(b, specs: Sequence[SortSpec]):
+    """Stable host sort of one concatenated batch; iterative stable pandas
+    sort (general per-key null placement).  Shared by CpuSortExec and
+    CpuTakeOrderedAndProjectExec."""
+    import numpy as np
+    import pyarrow as pa
+    import pandas as pd
+    from spark_rapids_tpu.columnar.batch import batch_from_arrow
+    from spark_rapids_tpu.expressions.evaluator import (host_batch_tcols,
+                                                        tcol_to_host_column)
+    from spark_rapids_tpu.expressions.base import EvalContext
+    keys = []
+    cols = host_batch_tcols(b)
+    ctx = EvalContext(cols, "cpu", b.row_count)
+    for s in specs:
+        kc = tcol_to_host_column(s.expr.eval_cpu(ctx), b.row_count)
+        keys.append(kc.arrow)
+    perm = np.arange(b.row_count)
+
+    def key_series(arr):
+        # floats: pandas conflates NaN with NA; map to IEEE-sortable
+        # ints (NaN > +inf, Spark order) keeping true nulls as NA
+        if pa.types.is_floating(arr.type):
+            isnull = arr.is_null().to_numpy(zero_copy_only=False)
+            v = arr.fill_null(0).to_numpy(zero_copy_only=False)
+            v = np.where(v == 0.0, 0.0, v)  # -0.0 -> 0.0
+            v = np.where(np.isnan(v), np.nan, v)
+            u = v.astype(np.float64).view(np.uint64)
+            sign = np.uint64(1) << np.uint64(63)
+            key = np.where(u & sign != 0, u ^ ~np.uint64(0), u | sign)
+            ser = pd.Series(key, dtype="UInt64")
+            ser[isnull] = pd.NA
+            return ser
+        if pa.types.is_integer(arr.type):
+            # plain to_pandas() promotes nullable int64 to float64,
+            # corrupting values above 2^53 — keep exact via nullable Int64
+            isnull = arr.is_null().to_numpy(zero_copy_only=False)
+            v = arr.fill_null(0).to_numpy(zero_copy_only=False)
+            ser = pd.Series(v.astype(np.int64), dtype="Int64")
+            ser[isnull] = pd.NA
+            return ser
+        return pd.Series(arr.to_pandas())
+
+    for s, arr in zip(reversed(list(specs)), reversed(keys)):
+        ser = key_series(arr.take(pa.array(perm)))
+        na = "first" if s.effective_nulls_first else "last"
+        idx = ser.sort_values(kind="stable", ascending=s.ascending,
+                              na_position=na).index.to_numpy()
+        perm = perm[idx]
+    tab = pa.Table.from_batches([b.to_arrow()]).take(pa.array(perm))
+    return batch_from_arrow(tab)
+
+
+def device_sort_batch(b: ColumnarBatch, specs: Sequence[SortSpec]
+                      ) -> ColumnarBatch:
+    """Device sort of one batch, projecting non-reference keys as needed
+    (reference: SortUtils computeSortedTable)."""
+    from spark_rapids_tpu.expressions.evaluator import eval_exprs_tpu
+    from spark_rapids_tpu.expressions.base import Alias, BoundReference as BR
+    from spark_rapids_tpu.ops.sort_ops import sort_batch
+    from spark_rapids_tpu.memory.retry import with_retry_no_split
+    n_cols = b.num_columns
+    orders, extra = _split_keys(specs, n_cols)
+    if extra:
+        names = b.names or [f"c{i}" for i in range(n_cols)]
+        proj = [Alias(BR(i, c.data_type, True), names[i])
+                for i, c in enumerate(b.columns)]
+        keys = [Alias(e, f"__sortkey{i}") for i, e in enumerate(extra)]
+        aug = eval_exprs_tpu(proj + keys, b)
+    else:
+        aug = b
+    out = with_retry_no_split(None, lambda: sort_batch(aug, orders))
+    if extra:
+        out = out.select(list(range(n_cols)))
+    return out
+
+
 class CpuSortExec(UnaryExec):
-    """Per-partition host sort; iterative stable pandas sort (general
-    per-key null placement)."""
+    """Per-partition host sort."""
 
     def __init__(self, specs: Sequence[SortSpec], child: Exec,
                  global_sort: bool = False):
@@ -65,57 +141,10 @@ class CpuSortExec(UnaryExec):
         self.global_sort = global_sort
 
     def execute_partition(self, pidx):
-        import numpy as np
-        import pyarrow as pa
-        from spark_rapids_tpu.columnar.batch import batch_from_arrow
-        from spark_rapids_tpu.expressions.evaluator import (host_batch_tcols,
-                                                            tcol_to_host_column)
-        from spark_rapids_tpu.expressions.base import EvalContext
         batches = list(self.child.execute_partition(pidx))
         if not batches:
             return
-        b = concat_host_batches(batches)
-        keys = []
-        cols = host_batch_tcols(b)
-        ctx = EvalContext(cols, "cpu", b.row_count)
-        for s in self.specs:
-            kc = tcol_to_host_column(s.expr.eval_cpu(ctx), b.row_count)
-            keys.append(kc.arrow)
-        perm = np.arange(b.row_count)
-        import pandas as pd
-
-        def key_series(arr):
-            # floats: pandas conflates NaN with NA; map to IEEE-sortable
-            # ints (NaN > +inf, Spark order) keeping true nulls as NA
-            if pa.types.is_floating(arr.type):
-                isnull = arr.is_null().to_numpy(zero_copy_only=False)
-                v = arr.fill_null(0).to_numpy(zero_copy_only=False)
-                v = np.where(v == 0.0, 0.0, v)  # -0.0 -> 0.0
-                v = np.where(np.isnan(v), np.nan, v)
-                u = v.astype(np.float64).view(np.uint64)
-                sign = np.uint64(1) << np.uint64(63)
-                key = np.where(u & sign != 0, u ^ ~np.uint64(0), u | sign)
-                ser = pd.Series(key, dtype="UInt64")
-                ser[isnull] = pd.NA
-                return ser
-            if pa.types.is_integer(arr.type):
-                # plain to_pandas() promotes nullable int64 to float64,
-                # corrupting values above 2^53 — keep exact via nullable Int64
-                isnull = arr.is_null().to_numpy(zero_copy_only=False)
-                v = arr.fill_null(0).to_numpy(zero_copy_only=False)
-                ser = pd.Series(v.astype(np.int64), dtype="Int64")
-                ser[isnull] = pd.NA
-                return ser
-            return pd.Series(arr.to_pandas())
-
-        for s, arr in zip(reversed(self.specs), reversed(keys)):
-            ser = key_series(arr.take(pa.array(perm)))
-            na = "first" if s.effective_nulls_first else "last"
-            idx = ser.sort_values(kind="stable", ascending=s.ascending,
-                                  na_position=na).index.to_numpy()
-            perm = perm[idx]
-        tab = pa.Table.from_batches([b.to_arrow()]).take(pa.array(perm))
-        yield batch_from_arrow(tab)
+        yield host_sort_batch(concat_host_batches(batches), self.specs)
 
     def node_desc(self):
         ks = ", ".join(f"{s.expr.sql()} {'ASC' if s.ascending else 'DESC'}"
@@ -135,30 +164,11 @@ class TpuSortExec(UnaryExec):
         self.global_sort = global_sort
 
     def execute_partition(self, pidx):
-        from spark_rapids_tpu.expressions.evaluator import eval_exprs_tpu
-        from spark_rapids_tpu.expressions.base import (Alias, BoundReference
-                                                       as BR)
         from spark_rapids_tpu.ops import concat_batches
-        from spark_rapids_tpu.ops.sort_ops import sort_batch
-        from spark_rapids_tpu.memory.retry import with_retry_no_split
         batches = list(self.child.execute_partition(pidx))
         if not batches:
             return
-        b = concat_batches(batches)
-        n_cols = b.num_columns
-        orders, extra = _split_keys(self.specs, n_cols)
-        if extra:
-            names = b.names or [f"c{i}" for i in range(n_cols)]
-            proj = [Alias(BR(i, c.data_type, True), names[i])
-                    for i, c in enumerate(b.columns)]
-            keys = [Alias(e, f"__sortkey{i}") for i, e in enumerate(extra)]
-            aug = eval_exprs_tpu(proj + keys, b)
-        else:
-            aug = b
-        out = with_retry_no_split(None, lambda: sort_batch(aug, orders))
-        if extra:
-            out = out.select(list(range(n_cols)))
-        yield out
+        yield device_sort_batch(concat_batches(batches), self.specs)
 
     def node_desc(self):
         ks = ", ".join(f"{s.expr.sql()} {'ASC' if s.ascending else 'DESC'}"

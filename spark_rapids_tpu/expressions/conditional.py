@@ -156,6 +156,48 @@ class Coalesce(Expression):
         return self._eval(ctx, np)
 
 
+class AtLeastNNonNulls(Expression):
+    """True when at least n of the inputs are non-null (and non-NaN for
+    floats) — Spark's DataFrame.na.drop predicate (reference
+    GpuAtLeastNNonNulls in nullExpressions)."""
+
+    def __init__(self, n: int, *exprs):
+        super().__init__(list(exprs))
+        self.n = n
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def _eval(self, ctx, xp):
+        from spark_rapids_tpu.expressions.base import valid_array
+        count = xp.zeros(ctx.row_count, dtype=np.int32)
+        for c in self.children:
+            tc = c.eval(ctx)
+            ok = valid_array(tc, ctx)
+            if isinstance(tc.dtype, (T.FloatType, T.DoubleType)):
+                if tc.is_scalar:
+                    import math
+                    nanfree = not (tc.data is not None
+                                   and math.isnan(float(tc.data)))
+                    ok = ok & nanfree
+                else:
+                    ok = ok & ~xp.isnan(tc.data)
+            count = count + ok.astype(np.int32)
+        return TCol(count >= self.n, xp.ones(ctx.row_count, dtype=bool),
+                    T.BOOLEAN)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx, np)
+
+
 class NaNvl(Expression):
     """nanvl(a, b): b where a is NaN else a (reference GpuNaNvl)."""
 

@@ -589,14 +589,18 @@ class RegExpReplace(_RegexExpr):
         if not (isinstance(repl, Literal) and isinstance(repl.value, str)):
             raise NotImplementedError(
                 "regexp_replace requires a literal replacement string")
-        return RX.transpile_replacement(repl.value)
+        tx = self._transpiled()
+        return RX.transpile_replacement(
+            repl.value, None if tx is None else tx.num_groups)
 
     def eval_cpu(self, ctx):
         repl = self._py_replacement()
         c = self.children[0].eval(ctx)
         data = materialize(c, ctx, np.dtype(object))
         rxs = self._pattern_regexes(ctx, len(data))
-        valid = valid_array(c, ctx)
+        # a null pattern row nulls the output (Spark null propagation)
+        valid = valid_array(c, ctx) & valid_array(
+            self.children[1].eval(ctx), ctx)
         out = np.empty(len(data), dtype=object)
         for i in range(len(data)):
             if valid[i] and data[i] is not None and rxs[i] is not None:
@@ -641,7 +645,8 @@ class RegExpExtract(_RegexExpr):
         c = self.children[0].eval(ctx)
         data = materialize(c, ctx, np.dtype(object))
         rxs = self._pattern_regexes(ctx, len(data))
-        valid = valid_array(c, ctx)
+        valid = valid_array(c, ctx) & valid_array(
+            self.children[1].eval(ctx), ctx)
         out = np.empty(len(data), dtype=object)
         for i in range(len(data)):
             if valid[i] and data[i] is not None and rxs[i] is not None:

@@ -222,19 +222,26 @@ def lead(e, offset: int = 1, default=None):
 
 # -- regular expressions (reference: RLike/RegExpReplace/RegExpExtract rules) --
 
-def rlike(e, pattern: str):
+def rlike(e, pattern):
     from spark_rapids_tpu.expressions.strings import RLike
-    from spark_rapids_tpu.expressions.base import lit
-    return RLike(_expr(e), lit(pattern))
+    return RLike(_expr(e), _pattern_expr(pattern))
 
 
-def regexp_replace(e, pattern: str, replacement: str):
+def regexp_replace(e, pattern, replacement: str):
     from spark_rapids_tpu.expressions.strings import RegExpReplace
     from spark_rapids_tpu.expressions.base import lit
-    return RegExpReplace(_expr(e), lit(pattern), lit(replacement))
+    return RegExpReplace(_expr(e), _pattern_expr(pattern), lit(replacement))
 
 
-def regexp_extract(e, pattern: str, idx: int = 1):
+def regexp_extract(e, pattern, idx: int = 1):
     from spark_rapids_tpu.expressions.strings import RegExpExtract
     from spark_rapids_tpu.expressions.base import lit
-    return RegExpExtract(_expr(e), lit(pattern), lit(idx))
+    return RegExpExtract(_expr(e), _pattern_expr(pattern), lit(idx))
+
+
+def _pattern_expr(pattern) -> Expression:
+    """Literal string patterns stay literals (transpiled + taggable for the
+    device tier); Expression patterns are per-row (host tier, like Spark's
+    non-foldable regexp arguments)."""
+    from spark_rapids_tpu.expressions.base import lit
+    return pattern if isinstance(pattern, Expression) else lit(pattern)

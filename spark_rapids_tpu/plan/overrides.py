@@ -103,7 +103,8 @@ for _cls in (P.And, P.Or, P.Not):
 for _cls in (P.IsNull, P.IsNotNull, P.IsNan, P.In):
     register_expr(_cls, TS.ALL_BASIC)
 
-for _cls in (K.If, K.CaseWhen, K.Coalesce, K.NaNvl, K.Greatest, K.Least):
+for _cls in (K.If, K.CaseWhen, K.Coalesce, K.NaNvl, K.Greatest, K.Least,
+             K.AtLeastNNonNulls):
     register_expr(_cls, TS.ALL_BASIC)
 
 for _cls in (M.UnaryMath, M.Floor, M.Ceil, M.Round, M.BRound, M.Pow,

@@ -71,7 +71,6 @@ class RAlternation(RegexNode):
 class RCharClass(RegexNode):
     body: str          # transpiled class body WITHOUT brackets
     negated: bool
-    literal_chars: Optional[List[str]] = None  # set when all-plain chars
 
 
 @dataclasses.dataclass
@@ -318,6 +317,8 @@ class _Parser:
             self.take()
             hexs = "".join(self.take() for _ in range(4)
                            if self.peek() is not None)
+            if len(hexs) != 4:
+                self.fail("\\u needs exactly four hex digits")
             try:
                 return RLiteral(chr(int(hexs, 16)))
             except ValueError:
@@ -333,6 +334,8 @@ class _Parser:
             else:
                 hexs = "".join(self.take() for _ in range(2)
                                if self.peek() is not None)
+                if len(hexs) != 2:
+                    self.fail("\\x needs two hex digits")
             try:
                 return RLiteral(chr(int(hexs, 16)))
             except ValueError:
@@ -352,7 +355,8 @@ class _Parser:
             c = self.take() if self.peek() is not None else None
             if c is None:
                 self.fail("bad \\cX escape")
-            return RLiteral(chr(ord(c.upper()) ^ 0x40))
+            # Java XORs the raw operand (no case folding): \cj -> 0x2a '*'
+            return RLiteral(chr(ord(c) ^ 0x40))
         if ch == "a":
             self.take()
             return RLiteral("\x07")
@@ -397,7 +401,6 @@ class _Parser:
             self.take()
             negated = True
         body = ""
-        literal_chars: Optional[List[str]] = []
         first = True
         while True:
             ch = self.peek()
@@ -417,43 +420,31 @@ class _Parser:
                 node = self.escape()
                 if isinstance(node, RPredef):
                     body += "\\" + node.cls
-                    literal_chars = None
                 elif isinstance(node, RCharClass):
                     if node.negated:
                         self.fail("negated property inside a class")
                     body += node.body
-                    literal_chars = None
                 elif isinstance(node, RAnchor):
                     if node.kind == "\\b":
                         body += "\\x08"  # inside a class \b is backspace
-                        if literal_chars is not None:
-                            literal_chars.append("\x08")
                     else:
                         self.fail(f"{node.kind} inside a character class")
                 elif isinstance(node, RSequence):  # \Q..\E inside class
                     for lit in node.parts:
                         body += _escape_class_char(lit.ch)
-                        if literal_chars is not None:
-                            literal_chars.append(lit.ch)
                 else:
                     body += _escape_class_char(node.ch)
-                    if literal_chars is not None:
-                        literal_chars.append(node.ch)
                 continue
             if ch == "-" and self.peek(1) not in (None, "]") and body:
                 # range: previous char - next char
                 self.take()
                 body += "-"
-                literal_chars = None
                 continue
             taken = self.take()
             body += _escape_class_char(taken)
-            if literal_chars is not None:
-                literal_chars.append(taken)
         if not body:
             self.fail("empty character class")
-        return RCharClass(body, negated,
-                          literal_chars if literal_chars else None)
+        return RCharClass(body, negated)
 
 
 def _escape_class_char(ch: str) -> str:
@@ -527,7 +518,9 @@ def complexity(node: RegexNode, depth_unbounded: int = 0) -> int:
     (the catastrophic-backtracking shape)."""
     if isinstance(node, RRepeat):
         inner_depth = depth_unbounded + (1 if node.max is None else 0)
-        weight = 10 ** inner_depth if node.max is None \
+        # quadratic exponent: any two nested unbounded repeats (the
+        # catastrophic-backtracking shape, e.g. (a+)+) exceed MAX_COMPLEXITY
+        weight = 10 ** (2 * inner_depth) if node.max is None \
             else max(1, (node.max or 1))
         return weight * (1 + complexity(node.child, inner_depth))
     if isinstance(node, (RSequence,)):
@@ -640,17 +633,31 @@ def _collect_anchors(node: RegexNode) -> List[str]:
     return out
 
 
-def transpile_replacement(java_repl: str) -> str:
+def transpile_replacement(java_repl: str,
+                          num_groups: Optional[int] = None) -> str:
     """Java replacement string ($1, \\$) -> python re (\\1, $)
-    (reference: GpuRegExpUtils.backrefConversion)."""
+    (reference: GpuRegExpUtils.backrefConversion).
+
+    Java takes the longest digit run that names an EXISTING group ($10 with
+    one group = group 1 then literal '0'); pass ``num_groups`` to replicate
+    that; None keeps the full digit run (unknown group count)."""
     out = []
     i = 0
     while i < len(java_repl):
         ch = java_repl[i]
         if ch == "$" and i + 1 < len(java_repl) and java_repl[i + 1].isdigit():
             j = i + 1
-            while j < len(java_repl) and java_repl[j].isdigit():
-                j += 1
+            if num_groups is None:
+                while j < len(java_repl) and java_repl[j].isdigit():
+                    j += 1
+            else:
+                while (j < len(java_repl) and java_repl[j].isdigit()
+                       and int(java_repl[i + 1:j + 1]) <= num_groups):
+                    j += 1
+                if j == i + 1:
+                    raise RegexUnsupported(
+                        f"replacement group ${java_repl[i + 1]} out of "
+                        f"range (pattern has {num_groups} groups)")
             out.append(f"\\g<{java_repl[i + 1:j]}>")
             i = j
         elif ch == "\\" and i + 1 < len(java_repl):

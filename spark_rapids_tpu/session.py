@@ -259,7 +259,23 @@ class DataFrame:
     def limit(self, n: int) -> "DataFrame":
         from spark_rapids_tpu.exec.basic import (CpuGlobalLimitExec,
                                                  CpuLimitExec)
-        plan = CpuLimitExec(n, self._plan)  # local limit per partition
+        from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
+        from spark_rapids_tpu.exec.expand import CpuTakeOrderedAndProjectExec
+        from spark_rapids_tpu.exec.sort import CpuSortExec
+        from spark_rapids_tpu.plan.partitioning import RangePartitioning
+        plan = self._plan
+        if isinstance(plan, CpuSortExec) and plan.global_sort:
+            # ORDER BY + LIMIT collapses to TakeOrderedAndProject: local
+            # top-K replaces the range-partition exchange entirely
+            # (reference: the TakeOrderedAndProjectExec rule in GpuOverrides)
+            child = plan.children[0]
+            if isinstance(child, CpuShuffleExchangeExec) and \
+                    isinstance(child.partitioning, RangePartitioning):
+                child = child.children[0]
+            return DataFrame(
+                CpuTakeOrderedAndProjectExec(n, plan.specs, child),
+                self._session)
+        plan = CpuLimitExec(n, plan)  # local limit per partition
         if self._plan.num_partitions > 1:
             plan = CpuGlobalLimitExec(n, plan)
         return DataFrame(plan, self._session)
@@ -428,6 +444,37 @@ class DataFrame:
 
     groupBy = group_by
 
+    def rollup(self, *cols) -> "GroupedData":
+        """GROUP BY ROLLUP(k1..kn): grouping sets (k1..kn), (k1..kn-1), …, ().
+        Physical plan: Expand fan-out + grouping-id key (Spark's lowering)."""
+        keys = [self._no_windows(bind_references(_to_expr(c), self.schema),
+                                 "grouping keys") for c in cols]
+        sets = [tuple(range(i)) for i in range(len(keys), -1, -1)]
+        return GroupedData(self, keys, grouping_sets=sets,
+                           key_names=[str(c) for c in cols])
+
+    def cube(self, *cols) -> "GroupedData":
+        """GROUP BY CUBE(k1..kn): all 2^n grouping sets."""
+        import itertools
+        keys = [self._no_windows(bind_references(_to_expr(c), self.schema),
+                                 "grouping keys") for c in cols]
+        idx = range(len(keys))
+        sets = []
+        for r in range(len(keys), -1, -1):
+            sets.extend(itertools.combinations(idx, r))
+        return GroupedData(self, keys, grouping_sets=sets,
+                           key_names=[str(c) for c in cols])
+
+    def grouping_sets(self, cols, sets) -> "GroupedData":
+        """Explicit GROUPING SETS over named key columns; ``sets`` is a list
+        of tuples of key names."""
+        keys = [self._no_windows(bind_references(_to_expr(c), self.schema),
+                                 "grouping keys") for c in cols]
+        name_to_idx = {str(c): i for i, c in enumerate(cols)}
+        idx_sets = [tuple(sorted(name_to_idx[n] for n in s)) for s in sets]
+        return GroupedData(self, keys, grouping_sets=idx_sets,
+                           key_names=[str(c) for c in cols])
+
     def agg(self, *agg_exprs) -> "DataFrame":
         """Global aggregation (no grouping keys)."""
         return GroupedData(self, []).agg(*agg_exprs)
@@ -499,9 +546,57 @@ class GroupedData:
     aggregation (partial -> hash exchange -> final), Spark's
     EnsureRequirements pattern for aggregation."""
 
-    def __init__(self, df: DataFrame, keys):
+    def __init__(self, df: DataFrame, keys, grouping_sets=None,
+                 key_names=None):
         self._df = df
         self._keys = keys
+        self._grouping_sets = grouping_sets  # list of tuples of key indices
+        self._key_names = key_names
+
+    def _expand_for_grouping_sets(self):
+        """Lowers ROLLUP/CUBE/GROUPING SETS to Expand + regular group-by
+        (Spark's rewrite): one projection per grouping set emitting
+        [k1-or-null, …, kn-or-null, grouping_id, *child columns]; the
+        grouping id joins the keys so a null produced by the rollup never
+        merges with a genuine null key from another set."""
+        from spark_rapids_tpu.exec.expand import CpuExpandExec
+        from spark_rapids_tpu.expressions.base import (BoundReference,
+                                                       Literal)
+        child = self._df._plan
+        schema = child.schema
+        nk = len(self._keys)
+        key_names = self._key_names or [f"k{i}" for i in range(nk)]
+        child_refs = [BoundReference(i, f.data_type, f.nullable, f.name)
+                      for i, f in enumerate(schema.fields)]
+        projections = []
+        for s in self._grouping_sets:
+            gid = 0  # Spark semantics: bit i set when key i is NOT grouped
+            for i in range(nk):
+                if i not in s:
+                    gid |= 1 << (nk - 1 - i)
+            proj = [self._keys[i] if i in s
+                    else Literal(None, self._keys[i].data_type)
+                    for i in range(nk)]
+            proj.append(Literal(gid, T.LONG))
+            proj.extend(child_refs)
+            projections.append(proj)
+        names = (key_names + ["__grouping_id"]
+                 + [f.name for f in schema.fields])
+        expand = CpuExpandExec(projections, names, child)
+        # re-key on the expanded columns: keys + grouping id
+        new_keys = [_bound_ref(i, expand.schema) for i in range(nk + 1)]
+        # aggregate inputs shift past the nk+1 key columns
+        shift = nk + 1
+
+        def rebind(e):
+            def fix(node):
+                if isinstance(node, BoundReference):
+                    return BoundReference(node.ordinal + shift,
+                                          node.data_type, node.nullable,
+                                          node.ref_name)
+                return node
+            return e.transform_up(fix)
+        return expand, new_keys, rebind, nk
 
     def agg(self, *agg_exprs) -> "DataFrame":
         from spark_rapids_tpu.exec.aggregate import (COMPLETE, FINAL,
@@ -524,6 +619,8 @@ class GroupedData:
             DataFrame._no_windows(e, "aggregations")
             aggs.append(AggregateExpression(e, name or e.sql()))
         child = self._df._plan
+        if self._grouping_sets is not None:
+            return self._agg_grouping_sets(aggs)
         if child.num_partitions == 1:
             plan = CpuHashAggregateExec(self._keys, aggs, COMPLETE, child)
         else:
@@ -538,6 +635,34 @@ class GroupedData:
             final_keys = [_bound_ref(i, partial.schema) for i in range(nk)]
             plan = CpuHashAggregateExec(final_keys, aggs, FINAL, exchange)
         return DataFrame(plan, self._df._session)
+
+    def _agg_grouping_sets(self, aggs) -> "DataFrame":
+        from spark_rapids_tpu.exec.aggregate import (COMPLETE, FINAL,
+                                                     PARTIAL,
+                                                     CpuHashAggregateExec)
+        from spark_rapids_tpu.exec.basic import CpuProjectExec
+        from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
+        from spark_rapids_tpu.expressions.aggregates import AggregateExpression
+        from spark_rapids_tpu.plan.partitioning import HashPartitioning
+        expand, new_keys, rebind, nk = self._expand_for_grouping_sets()
+        aggs = [AggregateExpression(rebind(a.func), a.out_name)
+                for a in aggs]
+        if expand.num_partitions == 1:
+            plan = CpuHashAggregateExec(new_keys, aggs, COMPLETE, expand)
+        else:
+            partial = CpuHashAggregateExec(new_keys, aggs, PARTIAL, expand)
+            key_refs = [_bound_ref(i, partial.schema)
+                        for i in range(len(new_keys))]
+            exchange = CpuShuffleExchangeExec(
+                HashPartitioning(key_refs, expand.num_partitions), partial)
+            final_keys = [_bound_ref(i, partial.schema)
+                          for i in range(len(new_keys))]
+            plan = CpuHashAggregateExec(final_keys, aggs, FINAL, exchange)
+        # drop the internal grouping id: keys, then agg outputs
+        out = [_bound_ref(i, plan.schema) for i in range(nk)]
+        out += [_bound_ref(i, plan.schema)
+                for i in range(nk + 1, len(plan.schema.fields))]
+        return DataFrame(CpuProjectExec(out, plan), self._df._session)
 
     # sugar
     def count(self) -> "DataFrame":

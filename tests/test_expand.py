@@ -1,0 +1,120 @@
+"""Expand / rollup / cube / TakeOrderedAndProject differential tests
+(reference: hash_aggregate_test.py rollup/cube cases + limit tests in
+integration_tests)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec.expand import (CpuExpandExec,
+                                          CpuTakeOrderedAndProjectExec)
+from spark_rapids_tpu.exec.sort import SortSpec
+from spark_rapids_tpu.expressions import aggregates as AG
+from spark_rapids_tpu.expressions.base import Alias, col, lit
+from spark_rapids_tpu.session import DataFrame
+
+from tests.asserts import assert_tpu_and_cpu_are_equal_collect
+
+RNG = np.random.default_rng(7)
+N = 3000
+
+
+def _data():
+    return {
+        "g": RNG.integers(0, 5, N).astype(np.int64),
+        "h": [None if i % 13 == 0 else int(v) for i, v in
+              enumerate(RNG.integers(0, 3, N))],
+        "v": RNG.standard_normal(N),
+    }
+
+
+_DATA = _data()
+
+
+def test_rollup_differential():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_DATA, num_partitions=3)
+        .rollup("g", "h")
+        .agg(Alias(AG.Sum(col("v")), "sv"),
+             Alias(AG.Count(lit(1)), "c")),
+        ignore_order=True, approx_float=True)
+
+
+def test_cube_differential():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_DATA, num_partitions=2)
+        .cube("g", "h")
+        .agg(Alias(AG.Count(lit(1)), "c"),
+             Alias(AG.Min(col("v")), "mn")),
+        ignore_order=True, approx_float=True)
+
+
+def test_grouping_sets_differential():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_DATA, num_partitions=2)
+        .grouping_sets(["g", "h"], [("g",), ("h",), ()])
+        .agg(Alias(AG.Count(lit(1)), "c")),
+        ignore_order=True)
+
+
+def test_rollup_distinguishes_real_null_keys():
+    """A genuine null key must not merge with rollup-produced nulls."""
+    data = {"h": [None, None, 1, 1], "v": [1.0, 2.0, 3.0, 4.0]}
+
+    def q(s):
+        return (s.create_dataframe(data, num_partitions=1)
+                .rollup("h").agg(Alias(AG.Sum(col("v")), "sv")))
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True,
+                                         approx_float=True)
+    rows = q(__import__("tests.asserts", fromlist=["cpu_session"])
+             .cpu_session()).collect()
+    # (h=None real, 3.0), (h=1, 7.0), (grand total None, 10.0)
+    sums = sorted(r["sv"] for r in rows)
+    assert sums == [3.0, 7.0, 10.0]
+
+
+def test_take_ordered_and_project():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_DATA, num_partitions=4)
+        .order_by("v").limit(17))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_DATA, num_partitions=4)
+        .order_by("g", "v", ascending=False).limit(5))
+
+
+def test_take_ordered_collapses_exchange():
+    from tests.asserts import tpu_session
+    s = tpu_session()
+    df = (s.create_dataframe(_DATA, num_partitions=4)
+          .order_by("v").limit(10))
+    names = {n.name for n in df._plan.collect_nodes()}
+    assert "CpuTakeOrderedAndProjectExec" in names
+    assert not any("Exchange" in n for n in names)
+
+
+def test_expand_exec_direct():
+    """ExpandExec on its own (the GpuExpandExec unit-level contract)."""
+    from spark_rapids_tpu.expressions.base import BoundReference
+    from tests.asserts import cpu_session, tpu_session
+
+    def q(s):
+        df = s.create_dataframe({"a": [1, 2, 3], "b": [10.0, 20.0, 30.0]})
+        schema = df.schema
+        a = BoundReference(0, schema.fields[0].data_type, True, "a")
+        b = BoundReference(1, schema.fields[1].data_type, True, "b")
+        plan = CpuExpandExec(
+            [[a, b], [a, lit(None, T.DOUBLE)]], ["x", "y"], df._plan)
+        return DataFrame(plan, s)
+
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+
+
+def test_at_least_n_non_nulls():
+    from spark_rapids_tpu.expressions.conditional import AtLeastNNonNulls
+    data = {"a": [1, None, 3, None], "b": [1.0, float("nan"), None, 2.0]}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(data)
+        .filter(AtLeastNNonNulls(2, col("a"), col("b"))))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(data)
+        .with_column("ok", AtLeastNNonNulls(1, col("a"), col("b"))))

@@ -218,3 +218,58 @@ def test_regexp_extract_bad_group_tagged():
     df = s.create_dataframe({"s": ["x"]}) \
         .select(F.regexp_extract(col("s"), r"(a)", 3).alias("g"))
     assert "out of range" in df.explain()
+
+
+# -- code-review regression cases -------------------------------------------
+
+def test_control_escape_raw_xor():
+    """Java \\cX XORs the raw operand: \\cj -> 0x2a '*' (no case folding)."""
+    from spark_rapids_tpu.regexp import transpile
+    assert transpile(r"\cj").pattern == "\\*"
+    assert transpile(r"\cJ").pattern == "\\x0a"  # \cJ is newline
+
+
+def test_truncated_hex_escapes_rejected():
+    from spark_rapids_tpu.regexp import RegexUnsupported, transpile
+    for bad in (r"\u41", r"\u", r"a\x4"):
+        with pytest.raises(RegexUnsupported):
+            transpile(bad)
+
+
+def test_nested_unbounded_quantifier_rejected():
+    """(a+)+ is the canonical catastrophic-backtracking shape (ReDoS)."""
+    from spark_rapids_tpu.regexp import RegexUnsupported, transpile
+    for bad in (r"(a+)+", r"(a*)*", r"(a+)*b", r"(x{2,})+"):
+        with pytest.raises(RegexUnsupported, match="complex"):
+            transpile(bad)
+    # single-level quantifiers still fine
+    transpile(r"a+b*c{2,}")
+
+
+def test_replacement_group_longest_valid():
+    """$10 with one group = group 1 + literal '0' (Java semantics)."""
+    from spark_rapids_tpu.regexp import (RegexUnsupported,
+                                         transpile_replacement)
+    assert transpile_replacement("$10", num_groups=1) == "\\g<1>0"
+    assert transpile_replacement("$12", num_groups=12) == "\\g<12>"
+    with pytest.raises(RegexUnsupported):
+        transpile_replacement("$2", num_groups=1)
+
+
+def test_regexp_replace_ten_dollar_executes():
+    from spark_rapids_tpu import functions as F
+    s = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+    df = s.create_dataframe({"s": ["abc"]}) \
+        .select(F.regexp_replace(col("s"), "(a)", "$10").alias("r"))
+    assert df.collect() == [{"r": "a0bc"}]
+
+
+def test_regexp_replace_null_pattern_column_validity():
+    """Null pattern row must null the OUTPUT VALIDITY, not just the data."""
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.expressions.predicates import IsNull
+    s = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+    df = s.create_dataframe({"s": ["abc", "abc"], "p": ["b", None]}) \
+        .select(F.regexp_replace(col("s"), col("p"), "X").alias("r"))
+    out = df.select(IsNull(col("r")).alias("isnull")).collect()
+    assert [r["isnull"] for r in out] == [False, True]
