@@ -224,6 +224,50 @@ def _decimal128_from_limbs(hi: np.ndarray, lo: np.ndarray, valid, dt):
         [vbuf, pa.py_buffer(buf.tobytes())], null_count=nulls)
 
 
+def is_device_array_type(dt: T.DataType) -> bool:
+    """Arrays of fixed-width scalars ride the device as a padded rectangular
+    plane (data [bucket, max_elems] + lengths + element validity) — the same
+    layout trick as strings.  Nested/string elements stay on the host tier."""
+    if not isinstance(dt, T.ArrayType):
+        return False
+    e = dt.element_type
+    return isinstance(e, (T.ByteType, T.ShortType, T.IntegerType, T.LongType,
+                          T.FloatType, T.DoubleType, T.BooleanType,
+                          T.DateType, T.TimestampType))
+
+
+def _elem_np_dtype(elem: T.DataType):
+    if isinstance(elem, T.DateType):
+        return np.dtype(np.int32)
+    if isinstance(elem, T.TimestampType):
+        return np.dtype(np.int64)
+    return elem.np_dtype
+
+
+def _list_from_rectangular(vals: np.ndarray, lens: np.ndarray,
+                           elem_valid: np.ndarray, valid: np.ndarray,
+                           dt: T.ArrayType):
+    """Builds an arrow ListArray from [n, w] values + lengths (vectorized)."""
+    import pyarrow as pa
+    n = len(lens)
+    lens64 = np.where(valid, lens, 0).astype(np.int64)
+    offsets = np.zeros(n + 1, dtype=np.int32)
+    np.cumsum(lens64, out=offsets[1:])
+    if lens64.sum():
+        row_idx, within = _ragged_indices(lens64)
+        flat = np.ascontiguousarray(vals[row_idx, within])
+        flat_valid = np.ascontiguousarray(elem_valid[row_idx, within])
+    else:
+        flat = np.zeros(0, dtype=vals.dtype)
+        flat_valid = np.zeros(0, dtype=bool)
+    elem_col = HostColumn.from_numpy(flat, flat_valid, dt.element_type)
+    vbuf, nulls = _validity_buffer(valid)
+    return pa.Array.from_buffers(
+        pa.list_(T.to_arrow(dt.element_type)), n,
+        [vbuf, pa.py_buffer(offsets.tobytes())],
+        null_count=nulls, children=[elem_col.arrow])
+
+
 def _binary_from_rectangular(chars: np.ndarray, lens: np.ndarray,
                              valid: np.ndarray):
     """Builds an arrow binary array from uint8[n, w] + lengths (vectorized)."""
@@ -333,6 +377,8 @@ class HostColumn:
         dt = self.data_type
         if isinstance(dt, (T.StringType, T.BinaryType)):
             raise TypeError("use string_np() for string columns")
+        if isinstance(dt, T.ArrayType):
+            raise TypeError("use list_np() for array columns")
         if isinstance(dt, T.DecimalType):
             # vectorized unscaled-limb extraction straight from the arrow
             # 16-byte little-endian buffer (reference: cuDF DECIMAL64/128
@@ -395,6 +441,42 @@ class HostColumn:
             out[row_idx, within] = databuf[starts + within]
         return out, lens
 
+    def list_np(self, max_len: Optional[int] = None):
+        """Rectangularizes a list column to (values[rows, w], int32 lengths,
+        elem_valid[rows, w]) — the device array-plane layout."""
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        dt = self.data_type
+        if not isinstance(dt, T.ArrayType):
+            raise TypeError("list_np on a non-array column")
+        arr = self.arrow
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        if pa.types.is_large_list(arr.type):
+            arr = arr.cast(pa.list_(arr.type.value_type))
+        lens = pc.list_value_length(arr)
+        lens = pc.fill_null(lens, 0).to_numpy(zero_copy_only=False)\
+            .astype(np.int32)
+        ml = int(lens.max()) if len(lens) else 0
+        width = bucket_strlen(max(ml, 1) if max_len is None else max_len)
+        edt = _elem_np_dtype(dt.element_type)
+        out = np.zeros((len(arr), width), dtype=edt)
+        ev = np.zeros((len(arr), width), dtype=bool)
+        np.minimum(lens, width, out=lens)
+        if lens.sum():
+            # flatten() drops null-row slots, so align via raw offsets
+            offsets = np.frombuffer(arr.buffers()[1], dtype=np.int32,
+                                    count=len(arr) + 1, offset=arr.offset * 4)
+            values = HostColumn(arr.values, dt.element_type)
+            vdata = values.data_np()
+            vvalid = values.validity_np()
+            lens64 = lens.astype(np.int64)
+            row_idx, within = _ragged_indices(lens64)
+            starts = np.repeat(offsets[:-1].astype(np.int64), lens64)
+            out[row_idx, within] = vdata[starts + within]
+            ev[row_idx, within] = vvalid[starts + within]
+        return out, lens, ev
+
     def to_pylist(self):
         return self.arrow.to_pylist()
 
@@ -422,13 +504,16 @@ class DeviceColumn:
       - scalar types: data is 1-D jax array of the mapped dtype
       - string/binary: data is uint8[bucket, strwidth]; ``lengths`` int32[bucket]
       - decimal128: data is int64[bucket, 2] (hi limb, lo limb-as-int64-bits)
+      - array<fixed-width>: data is elem[bucket, max_elems]; ``lengths``
+        int32[bucket]; ``elem_valid`` bool[bucket, max_elems]
     """
 
     data: Any                      # jax Array
     validity: Any                  # jax bool Array [bucket]
     row_count: int
     data_type: T.DataType
-    lengths: Any = None            # jax int32 Array [bucket] for strings
+    lengths: Any = None            # jax int32 Array [bucket] (strings/arrays)
+    elem_valid: Any = None         # jax bool Array [bucket, w] (arrays only)
 
     # -- constructors -------------------------------------------------------
     @staticmethod
@@ -444,6 +529,18 @@ class DeviceColumn:
         valid = np.zeros(b, dtype=bool)
         valid[:n] = col.validity_np()
         dt = col.data_type
+        if is_device_array_type(dt):
+            vals, lens, ev = col.list_np()
+            w = vals.shape[1]
+            data = np.zeros((b, w), dtype=vals.dtype)
+            data[:n] = vals
+            lengths = np.zeros(b, dtype=np.int32)
+            lengths[:n] = lens
+            elem_valid = np.zeros((b, w), dtype=bool)
+            elem_valid[:n] = ev
+            return DeviceColumn(jnp.asarray(data), jnp.asarray(valid), n, dt,
+                                lengths=jnp.asarray(lengths),
+                                elem_valid=jnp.asarray(elem_valid))
         if isinstance(dt, (T.StringType, T.BinaryType)):
             chars, lens = col.string_np()
             data = np.zeros((b, chars.shape[1]), dtype=np.uint8)
@@ -463,8 +560,9 @@ class DeviceColumn:
 
     @staticmethod
     def from_parts(data, validity, row_count: int, data_type: T.DataType,
-                   lengths=None) -> "DeviceColumn":
-        return DeviceColumn(data, validity, row_count, data_type, lengths)
+                   lengths=None, elem_valid=None) -> "DeviceColumn":
+        return DeviceColumn(data, validity, row_count, data_type, lengths,
+                            elem_valid)
 
     # -- accessors ----------------------------------------------------------
     @property
@@ -482,6 +580,8 @@ class DeviceColumn:
         n = self.data.size * self.data.dtype.itemsize + self.validity.size
         if self.lengths is not None:
             n += self.lengths.size * 4
+        if self.elem_valid is not None:
+            n += self.elem_valid.size
         return int(n)
 
     def to_host(self) -> HostColumn:
@@ -491,6 +591,12 @@ class DeviceColumn:
         dt = self.data_type
         if isinstance(dt, T.NullType):
             return HostColumn(pa.nulls(n), dt)
+        if isinstance(dt, T.ArrayType):
+            vals = np.asarray(self.data)[:n]
+            lens = np.asarray(self.lengths)[:n]
+            ev = np.asarray(self.elem_valid)[:n]
+            return HostColumn(
+                _list_from_rectangular(vals, lens, ev, valid, dt), dt)
         if self.is_string:
             chars = np.asarray(self.data)[:n]
             lens = np.asarray(self.lengths)[:n]
@@ -516,7 +622,7 @@ class DeviceColumn:
 
     def with_row_count(self, n: int) -> "DeviceColumn":
         return DeviceColumn(self.data, self.validity, n, self.data_type,
-                            self.lengths)
+                            self.lengths, self.elem_valid)
 
     def __repr__(self):
         return (f"DeviceColumn({self.data_type}, rows={self.row_count}, "

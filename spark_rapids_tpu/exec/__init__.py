@@ -14,3 +14,5 @@ from spark_rapids_tpu.exec.basic import (  # noqa: F401
 from spark_rapids_tpu.exec.expand import (  # noqa: F401
     CpuExpandExec, CpuTakeOrderedAndProjectExec, TpuExpandExec,
     TpuTakeOrderedAndProjectExec)
+from spark_rapids_tpu.exec.generate import (  # noqa: F401
+    CpuGenerateExec, TpuGenerateExec)

@@ -175,10 +175,12 @@ class TpuTakeOrderedAndProjectExec(CpuTakeOrderedAndProjectExec):
 
 
 # plan-rewrite registrations
+from spark_rapids_tpu.plan import typechecks as TS  # noqa: E402
 from spark_rapids_tpu.plan.overrides import register_exec  # noqa: E402
 
 register_exec(CpuExpandExec,
               convert=lambda p, m: TpuExpandExec(p),
+              sig=TS.BASIC_WITH_ARRAYS,
               exprs_of=lambda p: [e for proj in p.projections for e in proj],
               desc="projection fan-out (ROLLUP/CUBE/GROUPING SETS)")
 register_exec(CpuTakeOrderedAndProjectExec,

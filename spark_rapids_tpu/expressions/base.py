@@ -40,8 +40,9 @@ class TCol:
     data: Any
     valid: Any                 # bool array, or True/False for scalars
     dtype: T.DataType
-    lengths: Any = None        # string columns only (device rep)
+    lengths: Any = None        # string/array columns (device rep)
     is_scalar: bool = False
+    elem_valid: Any = None     # array columns only (device rep)
 
     @staticmethod
     def scalar(value, dtype: T.DataType) -> "TCol":
@@ -61,12 +62,17 @@ class EvalContext:
     logical count and masks padding via validity.
     """
 
-    __slots__ = ("cols", "backend", "row_count")
+    __slots__ = ("cols", "backend", "row_count", "lambda_bindings",
+                 "elem_plane")
 
     def __init__(self, cols: Sequence[TCol], backend: str, row_count: int):
         self.cols = list(cols)
         self.backend = backend  # "tpu" | "cpu"
         self.row_count = row_count
+        self.lambda_bindings = {}  # name -> TCol (higher-order functions)
+        #: True while evaluating a lambda body over an [n, w] element plane
+        #: (scalars then densify to [n, 1] so they broadcast either way)
+        self.elem_plane = False
 
 
 class Expression:
@@ -376,6 +382,10 @@ def bind_references(expr: Expression, schema: T.StructType) -> Expression:
             i = schema.field_index(node.ref_name)
             f = schema.fields[i]
             return BoundReference(i, f.data_type, f.nullable, f.name)
+        if hasattr(node, "_sync_var_types"):
+            # higher-order fns type their lambda variables once the array
+            # child is resolved (the vars are shared leaf instances)
+            node._sync_var_types()
         return node
 
     return expr.transform_up(fix)
@@ -419,18 +429,19 @@ def materialize(c: TCol, ctx: EvalContext, np_dtype=None) -> Any:
     if not c.is_scalar:
         return c.data
     dt = np_dtype or (c.dtype.np_dtype or np.dtype(object))
-    n = ctx.row_count
+    shape = (ctx.row_count, 1) if ctx.elem_plane else (ctx.row_count,)
     if c.data is None:
         if dt == np.dtype(object):
-            return np.full(n, None, dtype=object)
-        return xp.zeros(n, dtype=dt)
+            return np.full(shape, None, dtype=object)
+        return xp.zeros(shape, dtype=dt)
     if dt == np.dtype(object):
-        return np.full(n, c.data, dtype=object)
-    return xp.full(n, c.data, dtype=dt)
+        return np.full(shape, c.data, dtype=object)
+    return xp.full(shape, c.data, dtype=dt)
 
 
 def valid_array(c: TCol, ctx: EvalContext):
     xp = jnp() if ctx.backend == "tpu" else np
     if not c.is_scalar:
         return c.valid
-    return xp.full(ctx.row_count, bool(c.valid), dtype=bool)
+    shape = (ctx.row_count, 1) if ctx.elem_plane else (ctx.row_count,)
+    return xp.full(shape, bool(c.valid), dtype=bool)

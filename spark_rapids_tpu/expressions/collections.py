@@ -1,0 +1,1026 @@
+"""Collection expressions: arrays, structs, maps, higher-order functions.
+
+Reference: collectionOperations.scala (GpuSize/GpuArrayContains/GpuSortArray/
+GpuSlice/GpuElementAt...), complexTypeCreator.scala (GpuCreateArray/
+GpuCreateNamedStruct/GpuCreateMap), complexTypeExtractors.scala
+(GpuGetStructField/GpuGetArrayItem), higherOrderFunctions.scala
+(GpuArrayTransform/GpuArrayExists/GpuArrayFilter/GpuArrayAggregate).
+
+TPU design: arrays of fixed-width scalars live as a padded rectangular plane
+(values [bucket, w] + lengths + element validity) — see DeviceColumn — so
+every array kernel below is pure elementwise/segmented jnp math over 2-D
+arrays and fuses into the surrounding XLA program.  The SAME kernel bodies
+serve the CPU oracle: the host backend rectangularizes the python lists,
+runs the numpy twin, and re-raggedizes.  Struct and map compute stays on the
+host tier (honest fallback tagging, as the reference does for types cuDF
+cannot represent).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import (EvalContext, Expression, TCol,
+                                               jnp, valid_array)
+
+
+# ---------------------------------------------------------------------------
+# rectangular <-> ragged bridges (CPU backend)
+# ---------------------------------------------------------------------------
+
+def _elem_np(elem: T.DataType):
+    from spark_rapids_tpu.columnar.column import _elem_np_dtype
+    return _elem_np_dtype(elem)
+
+
+def _rect_cpu(tc: TCol, ctx: EvalContext):
+    """Object-array-of-lists -> (vals [n, w], lens, elem_valid) numpy."""
+    n = ctx.row_count
+    dt = tc.dtype
+    assert isinstance(dt, T.ArrayType)
+    if tc.is_scalar:
+        lst = [tc.data if tc.valid else None] * n
+    else:
+        lst = [tc.data[i] for i in range(n)]
+    lens = np.zeros(n, dtype=np.int32)
+    for i, v in enumerate(lst):
+        if v is not None:
+            lens[i] = len(v)
+    w = max(1, int(lens.max()) if n else 1)
+    edt = _elem_np(dt.element_type) or np.dtype(object)
+    vals = np.zeros((n, w), dtype=edt) if edt != np.dtype(object) \
+        else np.empty((n, w), dtype=object)
+    ev = np.zeros((n, w), dtype=bool)
+    for i, v in enumerate(lst):
+        if v is None:
+            continue
+        for j, e in enumerate(v):
+            if e is not None:
+                vals[i, j] = _to_phys(e, dt.element_type)
+                ev[i, j] = True
+    return vals, lens, ev
+
+
+def _to_phys(v, elem: T.DataType):
+    import datetime
+    if isinstance(elem, T.DateType) and isinstance(v, datetime.date):
+        return (v - datetime.date(1970, 1, 1)).days
+    if isinstance(elem, T.TimestampType) and isinstance(v, datetime.datetime):
+        import calendar
+        return int(calendar.timegm(v.utctimetuple())) * 1_000_000 \
+            + v.microsecond
+    return v
+
+
+def _from_phys(v, elem: T.DataType):
+    import datetime
+    if isinstance(elem, T.DateType):
+        return datetime.date(1970, 1, 1) + datetime.timedelta(days=int(v))
+    if isinstance(elem, T.TimestampType):
+        return (datetime.datetime(1970, 1, 1,
+                                  tzinfo=datetime.timezone.utc)
+                + datetime.timedelta(microseconds=int(v)))
+    if isinstance(elem, T.BooleanType):
+        return bool(v)
+    if isinstance(elem, (T.FloatType, T.DoubleType)):
+        return float(v)
+    return int(v)
+
+
+def _ragged_cpu(vals, lens, ev, valid, dt: T.ArrayType):
+    """(vals, lens, elem_valid) -> object array of python lists."""
+    n = len(lens)
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        if not valid[i]:
+            out[i] = None
+            continue
+        out[i] = [(_from_phys(vals[i, j], dt.element_type)
+                   if ev[i, j] else None) for j in range(int(lens[i]))]
+    return out
+
+
+def _array_parts(tc: TCol, ctx: EvalContext):
+    """(vals, lens, elem_valid, row_valid) in the backend's array module."""
+    if ctx.backend == "tpu":
+        return tc.data, tc.lengths, tc.elem_valid, valid_array(tc, ctx)
+    vals, lens, ev = _rect_cpu(tc, ctx)
+    return vals, lens, ev, valid_array(tc, ctx)
+
+
+def _array_result(vals, lens, ev, valid, dt: T.ArrayType, ctx: EvalContext
+                  ) -> TCol:
+    if ctx.backend == "tpu":
+        return TCol(vals, valid, dt, lengths=lens, elem_valid=ev)
+    return TCol(_ragged_cpu(vals, np.asarray(lens), np.asarray(ev),
+                            np.asarray(valid), dt), valid, dt)
+
+
+def _xp(ctx):
+    return jnp() if ctx.backend == "tpu" else np
+
+
+def _positions(xp, shape):
+    """[n, w] matrix of element ordinals (iota over the element axis)."""
+    return xp.broadcast_to(xp.arange(shape[1], dtype=np.int32), shape)
+
+
+def _scalar_or_col(tc: TCol, ctx, xp, np_dtype):
+    from spark_rapids_tpu.expressions.base import materialize
+    return materialize(tc, ctx, np_dtype)[:, None] if not tc.is_scalar \
+        else (xp.zeros((ctx.row_count, 1), dtype=np_dtype) + (
+            tc.data if tc.valid else 0))
+
+
+# ---------------------------------------------------------------------------
+# basic array expressions
+# ---------------------------------------------------------------------------
+
+class _ArrayExpr(Expression):
+    """Base: first child must be an array."""
+
+    def _check_array_child(self) -> Optional[str]:
+        dt = self.children[0].data_type
+        if not isinstance(dt, T.ArrayType):
+            return f"{self.name} needs an array input, got {dt.simple_name}"
+        return None
+
+    def tpu_supported(self, conf):
+        from spark_rapids_tpu.columnar.column import is_device_array_type
+        r = self._check_array_child()
+        if r is not None:
+            return r
+        if not is_device_array_type(self.children[0].data_type):
+            return ("array element type "
+                    f"{self.children[0].data_type.element_type.simple_name} "
+                    "is host-only")
+        return None
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx)
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx)
+
+
+class Size(_ArrayExpr):
+    """size(arr): element count; -1 for null input (Spark legacy default,
+    reference GpuSize)."""
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+    def _eval(self, ctx):
+        xp = _xp(ctx)
+        tc = self.children[0].eval(ctx)
+        if ctx.backend == "cpu" and not tc.is_scalar:
+            # lengths come straight off the lists; no rectangularization
+            valid = valid_array(tc, ctx)
+            out = np.full(ctx.row_count, -1, dtype=np.int32)
+            for i in range(ctx.row_count):
+                if valid[i] and tc.data[i] is not None:
+                    out[i] = len(tc.data[i])
+            return TCol(out, np.ones(ctx.row_count, dtype=bool), T.INT)
+        vals, lens, ev, valid = _array_parts(tc, ctx)
+        out = xp.where(valid, xp.asarray(lens, dtype=np.int32),
+                       np.int32(-1))
+        return TCol(out, xp.ones(ctx.row_count, dtype=bool), T.INT)
+
+
+class GetArrayItem(_ArrayExpr):
+    """arr[i]: 0-based ordinal; null when out of bounds or element null
+    (reference GpuGetArrayItem)."""
+
+    def __init__(self, child, ordinal):
+        super().__init__([child, ordinal])
+        self._one_based = False
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.element_type
+
+    def _eval(self, ctx):
+        xp = _xp(ctx)
+        tc = self.children[0].eval(ctx)
+        vals, lens, ev, valid = _array_parts(tc, ctx)
+        idx_tc = self.children[1].eval(ctx)
+        idx = _scalar_or_col(idx_tc, ctx, xp, np.dtype(np.int64))[:, 0]
+        idx_valid = valid_array(idx_tc, ctx)
+        lens64 = xp.asarray(lens, dtype=np.int64)
+        if self._one_based:
+            # element_at: 1-based, negative counts from the end, 0 errors
+            eff = xp.where(idx > 0, idx - 1, lens64 + idx)
+        else:
+            eff = idx
+        in_bounds = (eff >= 0) & (eff < lens64)
+        safe = xp.clip(eff, 0, max(1, vals.shape[1]) - 1).astype(np.int64)
+        data = xp.take_along_axis(vals, safe[:, None], axis=1)[:, 0]
+        evv = xp.take_along_axis(ev, safe[:, None], axis=1)[:, 0]
+        ok = valid & idx_valid & in_bounds & evv
+        # both backends use the physical fixed-width repr for elements
+        return TCol(data, ok, self.data_type)
+
+
+class ElementAt(GetArrayItem):
+    """element_at(arr, i): 1-based, negative from end (reference
+    GpuElementAt; non-ANSI null-on-out-of-bounds semantics)."""
+
+    def __init__(self, child, ordinal):
+        super().__init__(child, ordinal)
+        self._one_based = True
+
+
+class ArrayContains(_ArrayExpr):
+    """array_contains(arr, v) with Spark's three-valued result: true when
+    found; null when not found but the array has null elements (or inputs
+    are null); false otherwise (reference GpuArrayContains)."""
+
+    def __init__(self, child, value):
+        super().__init__([child, value])
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def _eval(self, ctx):
+        xp = _xp(ctx)
+        tc = self.children[0].eval(ctx)
+        vals, lens, ev, valid = _array_parts(tc, ctx)
+        v_tc = self.children[1].eval(ctx)
+        v = _scalar_or_col(v_tc, ctx, xp, vals.dtype)
+        v_valid = valid_array(v_tc, ctx)
+        pos = _positions(xp, vals.shape)
+        in_len = pos < xp.asarray(lens, dtype=np.int32)[:, None]
+        hit = (vals == v) & ev & in_len
+        found = hit.any(axis=1)
+        has_null_elem = ((~ev) & in_len).any(axis=1)
+        out_valid = valid & v_valid & (found | ~has_null_elem)
+        return TCol(found, out_valid, T.BOOLEAN)
+
+
+class _ArrayMinMax(_ArrayExpr):
+    is_max = False
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.element_type
+
+    def _eval(self, ctx):
+        xp = _xp(ctx)
+        tc = self.children[0].eval(ctx)
+        vals, lens, ev, valid = _array_parts(tc, ctx)
+        pos = _positions(xp, vals.shape)
+        live = ev & (pos < xp.asarray(lens, dtype=np.int32)[:, None])
+        any_live = live.any(axis=1)
+        fdt = vals.dtype
+        if self.is_max:
+            neutral = np.finfo(fdt).min if fdt.kind == "f" else \
+                (np.iinfo(fdt).min if fdt.kind in "iu" else False)
+            masked = xp.where(live, vals, xp.asarray(neutral, dtype=fdt))
+            agg = masked.max(axis=1)
+        else:
+            neutral = np.finfo(fdt).max if fdt.kind == "f" else \
+                (np.iinfo(fdt).max if fdt.kind in "iu" else True)
+            masked = xp.where(live, vals, xp.asarray(neutral, dtype=fdt))
+            agg = masked.min(axis=1)
+        ok = valid & any_live
+        return TCol(agg, ok, self.data_type)
+
+
+class ArrayMin(_ArrayMinMax):
+    is_max = False
+
+
+class ArrayMax(_ArrayMinMax):
+    is_max = True
+
+
+class SortArray(_ArrayExpr):
+    """sort_array(arr, asc): per-row element sort; nulls first when
+    ascending, last when descending (Spark semantics; reference
+    GpuSortArray)."""
+
+    def __init__(self, child, ascending=None):
+        from spark_rapids_tpu.expressions.base import Literal
+        if ascending is None:
+            ascending = Literal(True, T.BOOLEAN)
+        super().__init__([child, ascending])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def _extra_check(self):
+        from spark_rapids_tpu.expressions.base import Literal
+        if not isinstance(self.children[1], Literal):
+            return "sort_array order must be a literal boolean"
+        return None
+
+    def tpu_supported(self, conf):
+        return super().tpu_supported(conf) or self._extra_check()
+
+    def _eval(self, ctx):
+        xp = _xp(ctx)
+        asc = bool(self.children[1].value)
+        tc = self.children[0].eval(ctx)
+        vals, lens, ev, valid = _array_parts(tc, ctx)
+        pos = _positions(xp, vals.shape)
+        in_len = pos < xp.asarray(lens, dtype=np.int32)[:, None]
+        live = ev & in_len
+        fdt = vals.dtype
+        big = np.finfo(fdt).max if fdt.kind == "f" else \
+            (np.iinfo(fdt).max if fdt.kind in "iu" else True)
+        small = np.finfo(fdt).min if fdt.kind == "f" else \
+            (np.iinfo(fdt).min if fdt.kind in "iu" else False)
+        if asc:
+            # nulls first: nulls -> -inf tier, padding -> +inf tier
+            key = xp.where(live, vals, xp.asarray(small, dtype=fdt))
+            key = xp.where(in_len & ~ev, xp.asarray(small, dtype=fdt), key)
+            key = xp.where(~in_len, xp.asarray(big, dtype=fdt), key)
+            tier = xp.where(live, 1, xp.where(in_len, 0, 2))
+        else:
+            key = xp.where(live, vals, xp.asarray(big, dtype=fdt))
+            tier = xp.where(live, 0, xp.where(in_len, 1, 2))
+        # lexicographic (tier, key): sort by key then stable-sort by tier
+        order = xp.argsort(key, axis=1, stable=True)
+        if not asc:
+            order = order[:, ::-1]
+        t2 = xp.take_along_axis(tier, order, axis=1)
+        order2 = xp.argsort(t2, axis=1, stable=True)
+        final = xp.take_along_axis(order, order2, axis=1)
+        nv = xp.take_along_axis(vals, final, axis=1)
+        ne = xp.take_along_axis(live, final, axis=1)
+        return _array_result(nv, lens, ne, valid, self.data_type, ctx)
+
+
+class Slice(_ArrayExpr):
+    """slice(arr, start, length): 1-based start, negative from end
+    (reference GpuSlice)."""
+
+    def __init__(self, child, start, length):
+        super().__init__([child, start, length])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def _eval(self, ctx):
+        xp = _xp(ctx)
+        tc = self.children[0].eval(ctx)
+        vals, lens, ev, valid = _array_parts(tc, ctx)
+        st_tc = self.children[1].eval(ctx)
+        ln_tc = self.children[2].eval(ctx)
+        start = _scalar_or_col(st_tc, ctx, xp, np.dtype(np.int64))
+        length = _scalar_or_col(ln_tc, ctx, xp, np.dtype(np.int64))
+        lens64 = xp.asarray(lens, dtype=np.int64)[:, None]
+        # Spark ArraySlice.semanticSlice: a resolved start outside
+        # [0, len) yields an EMPTY array (no clamping), so all kept rows
+        # have 0 <= eff < len and the gather is front-aligned
+        eff = xp.where(start > 0, start - 1, lens64 + start)
+        take = xp.clip(length, 0, None)
+        in_range = (eff >= 0) & (eff < lens64)
+        new_len = xp.where(in_range[:, 0],
+                           xp.minimum(take[:, 0], lens64[:, 0] - eff[:, 0]),
+                           0).astype(np.int32)
+        pos = _positions(xp, vals.shape).astype(np.int64)
+        src = xp.clip(pos + xp.where(in_range, eff, 0), 0,
+                      vals.shape[1] - 1)
+        in_slice = pos < new_len[:, None]
+        nv = xp.take_along_axis(vals, src, axis=1)
+        ne = xp.take_along_axis(ev, src, axis=1) & in_slice
+        # start=0 or negative length -> null row (Spark errors in ANSI;
+        # null here, like non-ANSI out-of-range element_at)
+        ok = valid & valid_array(st_tc, ctx) & valid_array(ln_tc, ctx) \
+            & (start[:, 0] != 0) & (length[:, 0] >= 0)
+        return _array_result(nv, new_len, ne, ok, self.data_type, ctx)
+
+
+class CreateArray(Expression):
+    """array(e1, ..., en) from scalar columns (reference GpuCreateArray)."""
+
+    def __init__(self, *exprs):
+        super().__init__(list(exprs))
+        if not exprs:
+            raise ValueError("array() needs at least one element")
+
+    @property
+    def data_type(self):
+        dt = self.children[0].data_type
+        for c in self.children[1:]:
+            dt = T.common_type(dt, c.data_type)
+        return T.ArrayType(dt)
+
+    @property
+    def nullable(self):
+        return False
+
+    def tpu_supported(self, conf):
+        from spark_rapids_tpu.columnar.column import is_device_array_type
+        if not is_device_array_type(self.data_type):
+            return (f"array of {self.data_type.element_type.simple_name} "
+                    "is host-only")
+        return None
+
+    def _eval(self, ctx):
+        from spark_rapids_tpu.expressions.cast import Cast
+        xp = _xp(ctx)
+        out_dt = self.data_type
+        edt = _elem_np(out_dt.element_type)
+        cols = []
+        for c in self.children:
+            if c.data_type != out_dt.element_type:
+                c = Cast(c, out_dt.element_type)
+            cols.append(c.eval(ctx))
+        n = ctx.row_count
+        vals = xp.stack([_scalar_or_col(tc, ctx, xp, edt)[:, 0]
+                         for tc in cols], axis=1)
+        ev = xp.stack([valid_array(tc, ctx) for tc in cols], axis=1)
+        lens = xp.full(n, len(cols), dtype=np.int32)
+        valid = xp.ones(n, dtype=bool)
+        return _array_result(vals, lens, ev, valid, out_dt, ctx)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx)
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx)
+
+
+class ArrayRepeat(_ArrayExpr):
+    """array_repeat(v, n) (reference GpuArrayRepeat)."""
+
+    def __init__(self, value, count):
+        Expression.__init__(self, [value, count])
+
+    @property
+    def data_type(self):
+        return T.ArrayType(self.children[0].data_type)
+
+    def _check_array_child(self):
+        return None
+
+    def tpu_supported(self, conf):
+        from spark_rapids_tpu.columnar.column import is_device_array_type
+        from spark_rapids_tpu.expressions.base import Literal
+        if not is_device_array_type(self.data_type):
+            return "array element type is host-only"
+        if not isinstance(self.children[1], Literal):
+            # the element-plane width is a compile-time shape on the device
+            return "array_repeat count must be a literal on the device"
+        return None
+
+    def _eval(self, ctx):
+        from spark_rapids_tpu.expressions.base import Literal
+        xp = _xp(ctx)
+        v_tc = self.children[0].eval(ctx)
+        n_tc = self.children[1].eval(ctx)
+        edt = _elem_np(self.data_type.element_type)
+        v = _scalar_or_col(v_tc, ctx, xp, edt)
+        cnt = _scalar_or_col(n_tc, ctx, xp, np.dtype(np.int64))[:, 0]
+        cnt = xp.clip(cnt, 0, None)
+        if isinstance(self.children[1], Literal):
+            w = max(1, int(self.children[1].value or 0))
+        else:
+            w = max(1, int(np.max(np.asarray(cnt))) if ctx.row_count else 1)
+        from spark_rapids_tpu.columnar.column import bucket_strlen
+        w = bucket_strlen(w)
+        pos = xp.broadcast_to(xp.arange(w, dtype=np.int64),
+                              (ctx.row_count, w))
+        in_len = pos < cnt[:, None]
+        vals = xp.broadcast_to(v.astype(edt), (ctx.row_count, w))
+        ev = in_len & valid_array(v_tc, ctx)[:, None]
+        valid = valid_array(n_tc, ctx)
+        return _array_result(vals, cnt.astype(np.int32), ev, valid,
+                             self.data_type, ctx)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx)
+
+    eval_cpu = eval_tpu
+
+
+# ---------------------------------------------------------------------------
+# higher-order functions (lambda over the element plane)
+# ---------------------------------------------------------------------------
+
+class LambdaVariable(Expression):
+    """Named lambda parameter; bound by the enclosing HOF during eval.
+    On the device the binding is the full [bucket, w] element plane, so the
+    lambda body's elementwise ops fuse over every element at once.
+
+    The dtype is resolved lazily (``typer``) because the HOF's array child
+    may still be an unresolved attribute when the lambda body is built."""
+
+    def __init__(self, var_name: str, dtype=None):
+        super().__init__()
+        self.var_name = var_name
+        self._dtype = dtype
+
+    @property
+    def data_type(self):
+        if self._dtype is None:
+            raise TypeError(f"lambda variable {self.var_name} not yet "
+                            "typed (bind the enclosing HOF first)")
+        return self._dtype
+
+    def sql(self):
+        return self.var_name
+
+    def eval_tpu(self, ctx):
+        return ctx.lambda_bindings[self.var_name]
+
+    eval_cpu = eval_tpu
+
+
+def _lambda_ctx(ctx: EvalContext, bindings) -> EvalContext:
+    """Context for evaluating a lambda body over the element plane: outer
+    column references are lifted to [n, 1] so they broadcast against the
+    [n, w] element matrices."""
+    xp = _xp(ctx)
+    lifted = []
+    for c in ctx.cols:
+        if c.is_scalar or c.lengths is not None or \
+                getattr(c.data, "ndim", 1) != 1:
+            lifted.append(c)
+        else:
+            lifted.append(TCol(c.data[:, None],
+                               c.valid if isinstance(c.valid, bool)
+                               else c.valid[:, None], c.dtype))
+    out = EvalContext(lifted, ctx.backend, ctx.row_count)
+    out.lambda_bindings = dict(getattr(ctx, "lambda_bindings", {}))
+    out.lambda_bindings.update(bindings)
+    out.elem_plane = True
+    return out
+
+
+class _HigherOrderFn(_ArrayExpr):
+    """fn(arr, lambda): children = [array, body]; the body references the
+    SHARED LambdaVariable instances ``self.var``/``self.idx_var`` (leaves
+    survive tree rewrites untouched, so typing them after reference binding
+    reaches the rebound body too)."""
+
+    def __init__(self, child, body_fn):
+        import inspect
+        super().__init__([child])
+        self.var = LambdaVariable("x")
+        self.idx_var = LambdaVariable("i", T.INT)
+        n_params = len(inspect.signature(body_fn).parameters)
+        body = body_fn(self.var, self.idx_var) if n_params >= 2 \
+            else body_fn(self.var)
+        from spark_rapids_tpu.expressions.base import Expression as E
+        if not isinstance(body, E):
+            raise TypeError("lambda must build an Expression")
+        self.children.append(body)
+        self._sync_var_types()
+
+    @property
+    def body(self) -> Expression:
+        return self.children[1]
+
+    def _sync_var_types(self):
+        try:
+            dt = self.children[0].data_type
+        except TypeError:
+            return  # still unresolved; synced again after binding
+        if isinstance(dt, T.ArrayType):
+            self.var._dtype = dt.element_type
+
+    def tpu_supported(self, conf):
+        self._sync_var_types()
+        r = super().tpu_supported(conf)
+        if r is not None:
+            return r
+        # the body must be elementwise-safe (no strings/nested inside)
+        bad = self.body.collect(
+            lambda e: isinstance(e.data_type, (T.StringType, T.BinaryType))
+            if not isinstance(e, LambdaVariable) and _has_dtype(e) else False)
+        if bad:
+            return "lambda body with string ops is host-only"
+        return None
+
+    def _body_parts(self, ctx):
+        self._sync_var_types()
+        xp = _xp(ctx)
+        tc = self.children[0].eval(ctx)
+        vals, lens, ev, valid = _array_parts(tc, ctx)
+        pos = _positions(xp, vals.shape)
+        in_len = pos < xp.asarray(lens, dtype=np.int32)[:, None]
+        x = TCol(vals, ev & in_len, self.var.data_type)
+        i = TCol(pos, in_len, T.INT)
+        bctx = _lambda_ctx(ctx, {self.var.var_name: x,
+                                 self.idx_var.var_name: i})
+        body = self.body.eval(bctx)
+        return xp, vals, lens, ev, valid, in_len, body, bctx
+
+
+def _has_dtype(e):
+    try:
+        e.data_type
+        return True
+    except Exception:
+        return False
+
+
+class ArrayTransform(_HigherOrderFn):
+    """transform(arr, x -> body) (reference GpuArrayTransform)."""
+
+    @property
+    def data_type(self):
+        self._sync_var_types()
+        return T.ArrayType(self.body.data_type)
+
+    def tpu_supported(self, conf):
+        from spark_rapids_tpu.columnar.column import is_device_array_type
+        return super().tpu_supported(conf) or (
+            None if is_device_array_type(self.data_type)
+            else "transform result element type is host-only")
+
+    def _eval(self, ctx):
+        xp, vals, lens, ev, valid, in_len, body, _ = self._body_parts(ctx)
+        edt = _elem_np(self.body.data_type)
+        bd = body.data if not body.is_scalar else \
+            xp.zeros(vals.shape, dtype=edt) + (body.data or 0)
+        bv = body.valid if not body.is_scalar else \
+            xp.full(vals.shape, bool(body.valid))
+        if getattr(bd, "ndim", 1) == 1:   # body ignored the element: lift
+            bd = xp.broadcast_to(bd[:, None], vals.shape)
+            bv = xp.broadcast_to(xp.asarray(bv)[:, None], vals.shape) \
+                if getattr(bv, "ndim", 0) == 1 else bv
+        return _array_result(bd.astype(edt), lens, bv & in_len, valid,
+                             self.data_type, ctx)
+
+
+class ArrayExists(_HigherOrderFn):
+    """exists(arr, x -> pred) (reference GpuArrayExists; 3VL)."""
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def _eval(self, ctx):
+        xp, vals, lens, ev, valid, in_len, body, _ = self._body_parts(ctx)
+        pd = body.data & body.valid & in_len
+        found = pd.any(axis=1)
+        null_pred = (~body.valid) & in_len
+        has_null = null_pred.any(axis=1)
+        ok = valid & (found | ~has_null)
+        return TCol(found, ok, T.BOOLEAN)
+
+
+class ArrayForAll(_HigherOrderFn):
+    """forall(arr, x -> pred)."""
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def _eval(self, ctx):
+        xp, vals, lens, ev, valid, in_len, body, _ = self._body_parts(ctx)
+        # Spark 3VL: any genuine false -> false; else any null pred -> null;
+        # else true
+        genuine_false = (in_len & body.valid & ~body.data).any(axis=1)
+        has_null = (in_len & ~body.valid).any(axis=1)
+        ok = valid & (genuine_false | ~has_null)
+        return TCol(~genuine_false & ~has_null, ok, T.BOOLEAN)
+
+
+class ArrayFilter(_HigherOrderFn):
+    """filter(arr, x -> pred) (reference GpuArrayFilter): keep elements
+    where pred is true, compacting within the row."""
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def _eval(self, ctx):
+        xp, vals, lens, ev, valid, in_len, body, _ = self._body_parts(ctx)
+        keep = body.data & body.valid & in_len
+        # stable within-row compaction: argsort on ~keep
+        order = xp.argsort(~keep, axis=1, stable=True)
+        nv = xp.take_along_axis(vals, order, axis=1)
+        ne = xp.take_along_axis(ev & keep, order, axis=1)
+        new_len = keep.sum(axis=1).astype(np.int32)
+        return _array_result(nv, new_len, ne, valid, self.data_type, ctx)
+
+
+class ArrayAggregate(Expression):
+    """aggregate(arr, zero, (acc, x) -> merge [, acc -> finish])
+    (reference GpuArrayAggregate).  The merge body is applied element by
+    element with a statically unrolled loop over the padded width — each
+    step is one fused elementwise op over the batch."""
+
+    def __init__(self, child, zero, merge_fn, finish_fn=None):
+        super().__init__([child])
+        zero = zero if isinstance(zero, Expression) else _lit(zero)
+        self.acc_var = LambdaVariable("acc")
+        self.x_var = LambdaVariable("x")
+        merge = merge_fn(self.acc_var, self.x_var)
+        finish = None if finish_fn is None else finish_fn(self.acc_var)
+        self.has_finish = finish is not None
+        self.children += [zero, merge] + ([finish] if finish is not None
+                                          else [])
+        self._sync_var_types()
+
+    @property
+    def zero(self) -> Expression:
+        return self.children[1]
+
+    @property
+    def merge(self) -> Expression:
+        return self.children[2]
+
+    @property
+    def finish(self) -> Optional[Expression]:
+        return self.children[3] if self.has_finish else None
+
+    def _sync_var_types(self):
+        try:
+            dt = self.children[0].data_type
+            if isinstance(dt, T.ArrayType):
+                self.x_var._dtype = dt.element_type
+        except TypeError:
+            pass
+        try:
+            self.acc_var._dtype = self.zero.data_type
+        except TypeError:
+            pass
+
+    @property
+    def data_type(self):
+        self._sync_var_types()
+        fin = self.finish
+        return fin.data_type if fin is not None else self.merge.data_type
+
+    def tpu_supported(self, conf):
+        from spark_rapids_tpu.columnar.column import is_device_array_type
+        self._sync_var_types()
+        dt = self.children[0].data_type
+        if not isinstance(dt, T.ArrayType):
+            return f"aggregate needs an array input, got {dt.simple_name}"
+        if not is_device_array_type(dt):
+            return "array element type is host-only"
+        return None
+
+    def _eval(self, ctx):
+        self._sync_var_types()
+        xp = _xp(ctx)
+        tc = self.children[0].eval(ctx)
+        vals, lens, ev, valid = _array_parts(tc, ctx)
+        pos = _positions(xp, vals.shape)
+        in_len = pos < xp.asarray(lens, dtype=np.int32)[:, None]
+        zero = self.zero.eval(ctx)
+        from spark_rapids_tpu.expressions.base import materialize
+        acc_d = materialize(zero, ctx,
+                            _elem_np(self.zero.data_type))
+        acc_v = valid_array(zero, ctx)
+        w = vals.shape[1]
+        for k in range(w):
+            x = TCol(vals[:, k], ev[:, k], self.x_var.data_type)
+            acc = TCol(acc_d, acc_v, self.acc_var.data_type)
+            # acc/x are 1-D planes like ordinary columns: plain bindings,
+            # no [n, 1] lifting (that is only for the [n, w] element HOFs)
+            bctx = EvalContext(ctx.cols, ctx.backend, ctx.row_count)
+            bctx.lambda_bindings = {"acc": acc, "x": x}
+            nxt = self.merge.eval(bctx)
+            from spark_rapids_tpu.expressions.base import materialize as mat
+            nd = mat(nxt, bctx, _elem_np(self.zero.data_type)) \
+                if nxt.is_scalar else nxt.data
+            nv = valid_array(nxt, bctx)
+            step = in_len[:, k]
+            acc_d = xp.where(step, nd, acc_d)
+            acc_v = xp.where(step, nv, acc_v)
+        out = TCol(acc_d, acc_v & valid, self.zero.data_type)
+        if self.finish is not None:
+            # acc is an ordinary 1-D column: plain bindings, no lifting
+            bctx = EvalContext(ctx.cols, ctx.backend, ctx.row_count)
+            bctx.lambda_bindings = {"acc": out}
+            out = self.finish.eval(bctx)
+        return out
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx)
+
+    eval_cpu = eval_tpu
+
+
+def _lit(v):
+    from spark_rapids_tpu.expressions.base import Literal
+    return Literal(v)
+
+
+# ---------------------------------------------------------------------------
+# struct & map expressions (host tier)
+# ---------------------------------------------------------------------------
+
+class GetStructField(Expression):
+    """struct.field (reference GpuGetStructField).  Host tier: structs have
+    no device plane yet."""
+
+    def __init__(self, child, field_name: str):
+        super().__init__([child])
+        self.field_name = field_name
+
+    @property
+    def data_type(self):
+        dt = self.children[0].data_type
+        if not isinstance(dt, T.StructType):
+            raise TypeError(f"GetStructField on {dt.simple_name}")
+        return dt.fields[dt.field_index(self.field_name)].data_type
+
+    def sql(self):
+        return f"{self.children[0].sql()}.{self.field_name}"
+
+    def tpu_supported(self, conf):
+        return "struct field access is host-only"
+
+    def eval_cpu(self, ctx):
+        tc = self.children[0].eval(ctx)
+        n = ctx.row_count
+        valid = valid_array(tc, ctx)
+        out = np.empty(n, dtype=object)
+        ok = np.zeros(n, dtype=bool)
+        for i in range(n):
+            if valid[i] and tc.data[i] is not None:
+                v = tc.data[i].get(self.field_name)
+                out[i] = v
+                ok[i] = v is not None
+        return _obj_result(out, ok, self.data_type)
+
+    eval_tpu = eval_cpu
+
+
+class CreateNamedStruct(Expression):
+    """named_struct(n1, e1, ...) (reference GpuCreateNamedStruct)."""
+
+    def __init__(self, names: Sequence[str], exprs: Sequence[Expression]):
+        super().__init__(list(exprs))
+        self.names = list(names)
+
+    @property
+    def data_type(self):
+        return T.StructType([
+            T.StructField(n, e.data_type, e.nullable)
+            for n, e in zip(self.names, self.children)])
+
+    @property
+    def nullable(self):
+        return False
+
+    def tpu_supported(self, conf):
+        return "struct construction is host-only"
+
+    def eval_cpu(self, ctx):
+        n = ctx.row_count
+        vals = [self.children[i].eval(ctx) for i in range(len(self.children))]
+        vas = [valid_array(tc, ctx) for tc in vals]
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            row = {}
+            for nm, tc, va in zip(self.names, vals, vas):
+                d = tc.data if tc.is_scalar else tc.data[i]
+                row[nm] = _pyval(d, va[i], tc.dtype)
+            out[i] = row
+        return TCol(out, np.ones(n, dtype=bool), self.data_type)
+
+    eval_tpu = eval_cpu
+
+
+class CreateMap(Expression):
+    """map(k1, v1, ...) (reference GpuCreateMap). Host tier."""
+
+    def __init__(self, *kv):
+        if len(kv) % 2 or not kv:
+            raise ValueError("map() needs key/value pairs")
+        super().__init__(list(kv))
+
+    @property
+    def data_type(self):
+        kt = self.children[0].data_type
+        vt = self.children[1].data_type
+        for i in range(2, len(self.children), 2):
+            kt = T.common_type(kt, self.children[i].data_type)
+            vt = T.common_type(vt, self.children[i + 1].data_type)
+        return T.MapType(kt, vt)
+
+    @property
+    def nullable(self):
+        return False
+
+    def tpu_supported(self, conf):
+        return "map construction is host-only"
+
+    def eval_cpu(self, ctx):
+        n = ctx.row_count
+        ks = [self.children[i].eval(ctx)
+              for i in range(0, len(self.children), 2)]
+        vs = [self.children[i].eval(ctx)
+              for i in range(1, len(self.children), 2)]
+        kvas = [valid_array(tc, ctx) for tc in ks]
+        vvas = [valid_array(tc, ctx) for tc in vs]
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            pairs = []
+            for ktc, vtc, kva, vva in zip(ks, vs, kvas, vvas):
+                k = _pyval(ktc.data if ktc.is_scalar else ktc.data[i],
+                           kva[i], ktc.dtype)
+                v = _pyval(vtc.data if vtc.is_scalar else vtc.data[i],
+                           vva[i], vtc.dtype)
+                if k is None:
+                    raise ValueError("map keys cannot be null")
+                pairs.append((k, v))
+            out[i] = pairs
+        return TCol(out, np.ones(n, dtype=bool), self.data_type)
+
+    eval_tpu = eval_cpu
+
+
+class MapKeys(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.ArrayType(self.children[0].data_type.key_type,
+                           contains_null=False)
+
+    def tpu_supported(self, conf):
+        return "map ops are host-only"
+
+    def eval_cpu(self, ctx):
+        return _map_part(self, ctx, 0)
+
+    eval_tpu = eval_cpu
+
+
+class MapValues(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.ArrayType(self.children[0].data_type.value_type)
+
+    def tpu_supported(self, conf):
+        return "map ops are host-only"
+
+    def eval_cpu(self, ctx):
+        return _map_part(self, ctx, 1)
+
+    eval_tpu = eval_cpu
+
+
+def _map_part(expr, ctx, part):
+    tc = expr.children[0].eval(ctx)
+    n = ctx.row_count
+    valid = valid_array(tc, ctx)
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        if valid[i] and tc.data[i] is not None:
+            entries = tc.data[i]
+            if isinstance(entries, dict):
+                entries = list(entries.items())
+            out[i] = [e[part] for e in entries]
+        else:
+            out[i] = None
+    return TCol(out, valid, expr.data_type)
+
+
+def _pyval(v, ok, dt):
+    if not ok or v is None:
+        return None
+    if hasattr(v, "item"):
+        v = v.item()
+    return v
+
+
+def _obj_result(out, ok, dt):
+    """Struct-field extraction results (python values from to_pylist) back
+    to the CPU backend's physical representations."""
+    ok2 = ok & np.array([v is not None for v in out], dtype=bool)
+    if isinstance(dt, (T.DateType, T.TimestampType)):
+        dense = np.zeros(len(out), dtype=_elem_np(dt))
+        for i, v in enumerate(out):
+            if ok2[i]:
+                dense[i] = _to_phys(v, dt)
+        return TCol(dense, ok2, dt)
+    if dt.np_dtype is not None:
+        dense = np.zeros(len(out), dtype=dt.np_dtype)
+        for i, v in enumerate(out):
+            if ok2[i]:
+                dense[i] = v
+        return TCol(dense, ok2, dt)
+    return TCol(out, ok2, dt)

@@ -28,7 +28,8 @@ from spark_rapids_tpu.expressions.base import (EvalContext, Expression, TCol,
 # ---------------------------------------------------------------------------
 
 def device_batch_tcols(batch: ColumnarBatch) -> List[TCol]:
-    return [TCol(c.data, c.validity, c.data_type, lengths=c.lengths)
+    return [TCol(c.data, c.validity, c.data_type, lengths=c.lengths,
+                 elem_valid=c.elem_valid)
             for c in batch.columns]
 
 
@@ -37,7 +38,8 @@ def host_batch_tcols(batch: HostColumnarBatch) -> List[TCol]:
     for c in batch.columns:
         dt = c.data_type
         valid = c.validity_np()
-        if isinstance(dt, (T.StringType, T.BinaryType)):
+        if isinstance(dt, (T.StringType, T.BinaryType)) or dt.is_nested:
+            # CPU backend: object array of python values (str / list / dict)
             data = np.empty(len(c), dtype=object)
             lst = c.to_pylist()
             for i, v in enumerate(lst):
@@ -59,6 +61,9 @@ def host_batch_tcols(batch: HostColumnarBatch) -> List[TCol]:
 def tcol_to_device_column(tc: TCol, row_count: int, bucket: int,
                           xp) -> DeviceColumn:
     data, valid, lens = tc.data, tc.valid, tc.lengths
+    if not tc.is_scalar and isinstance(tc.dtype, T.ArrayType):
+        return DeviceColumn(data, valid, row_count, tc.dtype, lengths=lens,
+                            elem_valid=tc.elem_valid)
     if tc.is_scalar:
         # densify a scalar result
         ctx = EvalContext([], "tpu", bucket)
@@ -85,7 +90,7 @@ def tcol_to_host_column(tc: TCol, row_count: int) -> HostColumn:
         return HostColumn(pa.array([_pyify(v, dt)] * row_count,
                                    type=T.to_arrow(dt)), dt)
     valid = np.asarray(tc.valid)
-    if isinstance(dt, (T.StringType, T.BinaryType)):
+    if isinstance(dt, (T.StringType, T.BinaryType)) or dt.is_nested:
         vals = [tc.data[i] if valid[i] else None for i in range(row_count)]
         return HostColumn(pa.array(vals, type=T.to_arrow(dt)), dt)
     if isinstance(dt, T.DecimalType) and dt.is_decimal128:
@@ -129,7 +134,9 @@ _JIT_CACHE: Dict[Tuple, object] = {}
 
 def _signature(exprs, batch: ColumnarBatch) -> Tuple:
     shape_sig = tuple(
-        (str(c.data_type), tuple(c.data.shape), None if c.lengths is None else True)
+        (str(c.data_type), tuple(c.data.shape),
+         None if c.lengths is None else True,
+         None if c.elem_valid is None else True)
         for c in batch.columns)
     # sql() alone under-identifies (e.g. lit(1, INT) vs lit(1, LONG) both
     # render "1"), so the output dtype participates in the key
@@ -148,25 +155,27 @@ def eval_exprs_tpu(exprs: Sequence[Expression], batch: ColumnarBatch,
 
     if fn is None:
         def run(arrs):
-            cols = [TCol(d, v, dt, lengths=ln)
-                    for (d, v, ln), dt in zip(arrs, dtypes)]
+            cols = [TCol(d, v, dt, lengths=ln, elem_valid=ev)
+                    for (d, v, ln, ev), dt in zip(arrs, dtypes)]
             ctx = EvalContext(cols, "tpu", bucket)
             outs = []
             for e in exprs:
                 tc = e.eval_tpu(ctx)
                 dc = tcol_to_device_column(tc, 0, bucket, xp)
-                outs.append((dc.data, dc.validity, dc.lengths))
+                outs.append((dc.data, dc.validity, dc.lengths,
+                             dc.elem_valid))
             return outs
 
         fn = jax.jit(run)
         _JIT_CACHE[key] = fn
 
-    arrs = [(c.data, c.validity, c.lengths) for c in batch.columns]
+    arrs = [(c.data, c.validity, c.lengths, c.elem_valid)
+            for c in batch.columns]
     results = fn(arrs)
     out_cols = []
-    for (d, v, ln), e in zip(results, exprs):
+    for (d, v, ln, ev), e in zip(results, exprs):
         out_cols.append(DeviceColumn(d, v, batch.row_count, e.data_type,
-                                     lengths=ln))
+                                     lengths=ln, elem_valid=ev))
     return ColumnarBatch(out_cols, batch.row_count, names or _out_names(exprs))
 
 

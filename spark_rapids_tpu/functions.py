@@ -245,3 +245,119 @@ def _pattern_expr(pattern) -> Expression:
     non-foldable regexp arguments)."""
     from spark_rapids_tpu.expressions.base import lit
     return pattern if isinstance(pattern, Expression) else lit(pattern)
+
+
+# -- collection functions (reference: collectionOperations registrations) ----
+
+def array(*cols):
+    from spark_rapids_tpu.expressions.collections import CreateArray
+    return CreateArray(*[_expr(c) for c in cols])
+
+
+def size(e):
+    from spark_rapids_tpu.expressions.collections import Size
+    return Size(_expr(e))
+
+
+def element_at(e, idx):
+    from spark_rapids_tpu.expressions.base import lit
+    from spark_rapids_tpu.expressions.collections import ElementAt
+    idx = idx if isinstance(idx, Expression) else lit(idx)
+    return ElementAt(_expr(e), idx)
+
+
+def get_array_item(e, idx):
+    from spark_rapids_tpu.expressions.base import lit
+    from spark_rapids_tpu.expressions.collections import GetArrayItem
+    idx = idx if isinstance(idx, Expression) else lit(idx)
+    return GetArrayItem(_expr(e), idx)
+
+
+def array_contains(e, value):
+    from spark_rapids_tpu.expressions.base import lit
+    from spark_rapids_tpu.expressions.collections import ArrayContains
+    value = value if isinstance(value, Expression) else lit(value)
+    return ArrayContains(_expr(e), value)
+
+
+def array_min(e):
+    from spark_rapids_tpu.expressions.collections import ArrayMin
+    return ArrayMin(_expr(e))
+
+
+def array_max(e):
+    from spark_rapids_tpu.expressions.collections import ArrayMax
+    return ArrayMax(_expr(e))
+
+
+def sort_array(e, asc: bool = True):
+    from spark_rapids_tpu.expressions.base import lit
+    from spark_rapids_tpu.expressions.collections import SortArray
+    return SortArray(_expr(e), lit(asc))
+
+
+def slice(e, start, length):  # noqa: A001 - pyspark naming
+    from spark_rapids_tpu.expressions.base import lit
+    from spark_rapids_tpu.expressions.collections import Slice
+    start = start if isinstance(start, Expression) else lit(start)
+    length = length if isinstance(length, Expression) else lit(length)
+    return Slice(_expr(e), start, length)
+
+
+def array_repeat(value, count):
+    from spark_rapids_tpu.expressions.base import lit
+    from spark_rapids_tpu.expressions.collections import ArrayRepeat
+    value = value if isinstance(value, Expression) else lit(value)
+    count = count if isinstance(count, Expression) else lit(count)
+    return ArrayRepeat(value, count)
+
+
+def transform(e, fn):
+    from spark_rapids_tpu.expressions.collections import ArrayTransform
+    return ArrayTransform(_expr(e), fn)
+
+
+def exists(e, fn):
+    from spark_rapids_tpu.expressions.collections import ArrayExists
+    return ArrayExists(_expr(e), fn)
+
+
+def forall(e, fn):
+    from spark_rapids_tpu.expressions.collections import ArrayForAll
+    return ArrayForAll(_expr(e), fn)
+
+
+def filter(e, fn):  # noqa: A001 - pyspark naming
+    from spark_rapids_tpu.expressions.collections import ArrayFilter
+    return ArrayFilter(_expr(e), fn)
+
+
+def aggregate(e, zero, merge, finish=None):
+    from spark_rapids_tpu.expressions.collections import ArrayAggregate
+    return ArrayAggregate(_expr(e), zero, merge, finish)
+
+
+def named_struct(**fields):
+    from spark_rapids_tpu.expressions.collections import CreateNamedStruct
+    return CreateNamedStruct(list(fields.keys()),
+                             [_expr(v) for v in fields.values()])
+
+
+def create_map(*kv):
+    from spark_rapids_tpu.expressions.collections import CreateMap
+    return CreateMap(*[_expr(c) for c in kv])
+
+
+def map_keys(e):
+    from spark_rapids_tpu.expressions.collections import MapKeys
+    return MapKeys(_expr(e))
+
+
+def map_values(e):
+    from spark_rapids_tpu.expressions.collections import MapValues
+    return MapValues(_expr(e))
+
+
+def get_struct_field(e, name: str):
+    from spark_rapids_tpu.expressions.collections import GetStructField
+    return GetStructField(_expr(e), name)

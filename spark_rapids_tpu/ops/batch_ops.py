@@ -33,7 +33,8 @@ _CONCAT_CACHE: Dict[Tuple, object] = {}
 
 
 def _col_sig(c: DeviceColumn) -> Tuple:
-    return (str(c.data.dtype), tuple(c.data.shape), c.lengths is not None)
+    return (str(c.data.dtype), tuple(c.data.shape), c.lengths is not None,
+            c.elem_valid is not None)
 
 
 def gather_batch(batch: ColumnarBatch, idx, row_count: int,
@@ -51,7 +52,10 @@ def gather_batch(batch: ColumnarBatch, idx, row_count: int,
         if idx_valid is not None:
             valid = valid & idx_valid
         lengths = None if c.lengths is None else jnp.take(c.lengths, safe, axis=0)
-        out.append(DeviceColumn(data, valid, row_count, c.data_type, lengths))
+        ev = None if c.elem_valid is None else jnp.take(c.elem_valid, safe,
+                                                        axis=0)
+        out.append(DeviceColumn(data, valid, row_count, c.data_type, lengths,
+                                ev))
     return ColumnarBatch(out, row_count, batch.names)
 
 
@@ -70,22 +74,24 @@ def compact_batch(batch: ColumnarBatch, keep) -> ColumnarBatch:
             # stable argsort: kept rows (False<True on ~keep) keep order
             order = jnp.argsort(~keep, stable=True)
             outs = []
-            for d, v, ln in arrs:
+            for d, v, ln, ev in arrs:
                 nd = jnp.take(d, order, axis=0)
                 # rows that were filtered out become padding: invalid
                 nv = jnp.take(v & keep, order, axis=0)
                 nl = None if ln is None else jnp.take(ln, order, axis=0)
-                outs.append((nd, nv, nl))
+                ne = None if ev is None else jnp.take(ev, order, axis=0)
+                outs.append((nd, nv, nl, ne))
             return outs, jnp.sum(keep)
 
         fn = jax.jit(run)
         _COMPACT_CACHE[key] = fn
-    arrs = [(c.data, c.validity, c.lengths) for c in batch.columns]
+    arrs = [(c.data, c.validity, c.lengths, c.elem_valid)
+            for c in batch.columns]
     outs, cnt = fn(arrs, keep)
     # count stays on device: chained kernels consume it sync-free
     row_count = DeferredCount(cnt)
-    cols = [DeviceColumn(d, v, row_count, c.data_type, ln)
-            for (d, v, ln), c in zip(outs, batch.columns)]
+    cols = [DeviceColumn(d, v, row_count, c.data_type, ln, ne)
+            for (d, v, ln, ne), c in zip(outs, batch.columns)]
     return ColumnarBatch(cols, row_count, batch.names)
 
 
@@ -108,7 +114,8 @@ def take_front(batch: ColumnarBatch, n: int) -> ColumnarBatch:
         n = min(n, int(rc))
         n_t = n
     keep = jnp.arange(batch.bucket) < n_t
-    cols = [DeviceColumn(c.data, c.validity & keep, n, c.data_type, c.lengths)
+    cols = [DeviceColumn(c.data, c.validity & keep, n, c.data_type, c.lengths,
+                         c.elem_valid)
             for c in batch.columns]
     return ColumnarBatch(cols, n, batch.names)
 
@@ -132,7 +139,7 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
     total = sum_counts([b.row_count for b in batches])   # one sync at most
     out_bucket = bucket_rows(total)
     ncols = batches[0].num_columns
-    # per-column max string width across inputs
+    # per-column max string/array width across inputs
     widths = []
     for ci in range(ncols):
         w = 0
@@ -152,10 +159,13 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
                 tgt_rows = out_bucket
                 acc_d = None
                 for bi in range(len(all_arrs)):
-                    d, v, ln = all_arrs[bi][ci]
+                    d, v, ln, ev = all_arrs[bi][ci]
                     w = widths[ci]
                     if ln is not None and d.shape[1] < w:
                         d = jnp.pad(d, ((0, 0), (0, w - d.shape[1])))
+                        if ev is not None:
+                            ev = jnp.pad(ev,
+                                         ((0, 0), (0, w - ev.shape[1])))
                     rowpos = jnp.arange(d.shape[0])
                     valid_rows = rowpos < counts_arr[bi]
                     # padding rows scatter out of range -> dropped
@@ -167,21 +177,25 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
                         acc_v = jnp.zeros(tgt_rows, dtype=bool)
                         acc_l = None if ln is None else \
                             jnp.zeros(tgt_rows, dtype=np.int32)
+                        acc_e = None if ev is None else \
+                            jnp.zeros((tgt_rows, w), dtype=bool)
                     acc_d = acc_d.at[dest].set(d, mode="drop")
                     acc_v = acc_v.at[dest].set(v & valid_rows, mode="drop")
                     if acc_l is not None:
                         acc_l = acc_l.at[dest].set(ln, mode="drop")
-                outs.append((acc_d, acc_v, acc_l))
+                    if acc_e is not None:
+                        acc_e = acc_e.at[dest].set(ev, mode="drop")
+                outs.append((acc_d, acc_v, acc_l, acc_e))
             return outs
 
         fn = jax.jit(run)
         _CONCAT_CACHE[key] = fn
     counts_arr = jnp.stack([jnp.asarray(rc_traceable(b.row_count),
                                         dtype=np.int64) for b in batches])
-    all_arrs = [[(c.data, c.validity, c.lengths) for c in b.columns]
-                for b in batches]
+    all_arrs = [[(c.data, c.validity, c.lengths, c.elem_valid)
+                 for c in b.columns] for b in batches]
     outs = fn(all_arrs, counts_arr)
     cols = []
-    for (d, v, ln), proto in zip(outs, batches[0].columns):
-        cols.append(DeviceColumn(d, v, total, proto.data_type, ln))
+    for (d, v, ln, ev), proto in zip(outs, batches[0].columns):
+        cols.append(DeviceColumn(d, v, total, proto.data_type, ln, ev))
     return ColumnarBatch(cols, total, batches[0].names)

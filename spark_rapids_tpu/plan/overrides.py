@@ -87,7 +87,7 @@ def exec_registry() -> Dict[type, ExecRule]:
 # ---------------------------------------------------------------------------
 
 for _cls in (Literal, BoundReference, Alias):
-    register_expr(_cls, TS.ALL_BASIC)
+    register_expr(_cls, TS.BASIC_WITH_ARRAYS)
 
 for _cls in (A.Add, A.Subtract, A.Multiply, A.Divide, A.IntegralDivide,
              A.Remainder, A.Pmod, A.UnaryMinus, A.Abs):
@@ -130,6 +130,24 @@ register_expr(H.Murmur3Hash, TS.ALL_BASIC)
 register_expr(H.XxHash64, TS.ALL_BASIC,
               extra_tag=lambda m: None)
 
+# collection / complex-type expressions (reference: GpuOverrides
+# registrations for Size/ElementAt/ArrayContains/SortArray/CreateArray/
+# transform/exists/filter/aggregate + complexTypeExtractors)
+from spark_rapids_tpu.expressions import collections as CO  # noqa: E402
+
+for _cls in (CO.Size, CO.GetArrayItem, CO.ElementAt, CO.ArrayContains,
+             CO.ArrayMin, CO.ArrayMax, CO.SortArray, CO.Slice,
+             CO.CreateArray, CO.ArrayRepeat, CO.LambdaVariable,
+             CO.ArrayTransform, CO.ArrayExists, CO.ArrayForAll,
+             CO.ArrayFilter, CO.ArrayAggregate):
+    register_expr(_cls, TS.BASIC_WITH_ARRAYS)
+
+# struct/map expressions exist as host-tier components (their
+# tpu_supported() tags the honest fallback reason)
+for _cls in (CO.GetStructField, CO.CreateNamedStruct, CO.CreateMap,
+             CO.MapKeys, CO.MapValues):
+    register_expr(_cls, TS.BASIC_WITH_ARRAYS)
+
 # aggregate functions (reference: GpuOverrides aggExprs — Sum/Count/Min/Max/
 # Average/First/Last/StddevSamp/... registrations)
 from spark_rapids_tpu.expressions import aggregates as AG  # noqa: E402
@@ -149,11 +167,13 @@ def _register_basic_execs():
 
     register_exec(X.CpuProjectExec,
                   convert=lambda p, m: X.TpuProjectExec(p.exprs, p.children[0]),
+                  sig=TS.BASIC_WITH_ARRAYS,
                   exprs_of=lambda p: p.exprs,
                   desc="columnar projection")
     register_exec(X.CpuFilterExec,
                   convert=lambda p, m: X.TpuFilterExec(p.condition,
                                                        p.children[0]),
+                  sig=TS.BASIC_WITH_ARRAYS,
                   exprs_of=lambda p: [p.condition],
                   desc="columnar filter")
     register_exec(X.CpuRangeExec,
@@ -161,20 +181,25 @@ def _register_basic_execs():
                   desc="range source")
     register_exec(X.CpuInMemoryScanExec,
                   convert=lambda p, m: X.TpuInMemoryScanExec(p),
+                  sig=TS.BASIC_WITH_ARRAYS,
                   desc="in-memory scan")
     register_exec(X.CpuLimitExec,
                   convert=lambda p, m: X.TpuLimitExec(p.n, p.children[0]),
+                  sig=TS.BASIC_WITH_ARRAYS,
                   desc="limit")
     register_exec(X.CpuCoalescePartitionsExec,
                   convert=lambda p, m: X.TpuCoalescePartitionsExec(
                       p.n, p.children[0]),
+                  sig=TS.BASIC_WITH_ARRAYS,
                   desc="shuffle-free partition merge")
     register_exec(X.CpuGlobalLimitExec,
                   convert=lambda p, m: X.TpuGlobalLimitExec(p.n,
                                                             p.children[0]),
+                  sig=TS.BASIC_WITH_ARRAYS,
                   desc="global limit")
     register_exec(X.CpuUnionExec,
                   convert=lambda p, m: X.TpuUnionExec(p.children),
+                  sig=TS.BASIC_WITH_ARRAYS,
                   desc="union")
     register_exec(X.CpuSampleExec,
                   convert=lambda p, m: X.TpuSampleExec(p.fraction, p.seed,

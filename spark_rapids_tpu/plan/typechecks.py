@@ -14,13 +14,22 @@ from spark_rapids_tpu import types as T
 
 class TypeSig:
     def __init__(self, classes: Iterable[type], allow_decimal128: bool = False,
-                 note: str = ""):
+                 note: str = "", allow_device_arrays: bool = False):
         self.classes = tuple(classes)
         self.allow_decimal128 = allow_decimal128
         self.note = note
+        #: arrays of fixed-width scalars ride the device as padded
+        #: rectangular planes; only layout-agnostic ops opt in
+        self.allow_device_arrays = allow_device_arrays
 
     def check(self, dt: T.DataType) -> Optional[str]:
         """None when supported, reason string otherwise."""
+        if isinstance(dt, T.ArrayType):
+            from spark_rapids_tpu.columnar.column import is_device_array_type
+            if self.allow_device_arrays and is_device_array_type(dt):
+                return None
+            return (f"{dt.simple_name} is not supported here (device "
+                    "arrays need fixed-width elements)")
         if isinstance(dt, T.DecimalType):
             if T.DecimalType not in self.classes:
                 return f"{dt.simple_name} is not supported"
@@ -35,7 +44,9 @@ class TypeSig:
 
     def __add__(self, other: "TypeSig") -> "TypeSig":
         return TypeSig(set(self.classes) | set(other.classes),
-                       self.allow_decimal128 or other.allow_decimal128)
+                       self.allow_decimal128 or other.allow_decimal128,
+                       allow_device_arrays=(self.allow_device_arrays
+                                            or other.allow_device_arrays))
 
     def names(self) -> str:
         return ", ".join(sorted(c.__name__.replace("Type", "")
@@ -66,6 +77,13 @@ COMPARABLE = TypeSig(_INTEGRAL + _FRACTIONAL +
 
 ORDERABLE = COMPARABLE
 NESTED = TypeSig([T.ArrayType, T.MapType, T.StructType])
+
+#: basics + device-resident arrays (padded rectangular plane) — for
+#: layout-agnostic data-plane ops (scan/project/filter/union/limit/expand/
+#: generate); sort/join/agg keep ALL_BASIC until their kernels thread the
+#: element-validity plane
+BASIC_WITH_ARRAYS = TypeSig(ALL_BASIC.classes, True,
+                            allow_device_arrays=True)
 
 
 def check_output_types(schema: T.StructType, sig: TypeSig) -> Optional[str]:

@@ -290,6 +290,23 @@ class DataFrame:
         return DataFrame(CpuSampleExec(fraction, seed, self._plan),
                          self._session)
 
+    def explode(self, column, alias: str = "col", outer: bool = False,
+                position: bool = False) -> "DataFrame":
+        """One output row per array element; other columns repeat.  With
+        ``position`` adds the element ordinal (posexplode); ``outer`` keeps
+        null/empty rows (explode_outer)."""
+        from spark_rapids_tpu.exec.generate import CpuGenerateExec
+        gen = bind_references(_to_expr(column), self.schema)
+        self._no_windows(gen, "explode")
+        return DataFrame(CpuGenerateExec(gen, self._plan, outer=outer,
+                                         position=position,
+                                         element_name=alias),
+                         self._session)
+
+    def posexplode(self, column, alias: str = "col",
+                   outer: bool = False) -> "DataFrame":
+        return self.explode(column, alias, outer, position=True)
+
     def repartition(self, n: int, *cols) -> "DataFrame":
         """Round-robin repartition, or hash repartition when keys given."""
         from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec

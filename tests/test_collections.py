@@ -1,0 +1,240 @@
+"""Collection/array expression + explode differential tests
+(reference: integration_tests collection_ops_test.py, array_test.py,
+explode shims in generate tests)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import Alias, col, lit
+
+from tests.asserts import (assert_tpu_and_cpu_are_equal_collect,
+                           cpu_session, tpu_session)
+
+RNG = np.random.default_rng(21)
+N = 800
+
+
+def _arr_data():
+    arrs = []
+    for i in range(N):
+        if i % 19 == 0:
+            arrs.append(None)
+        elif i % 7 == 0:
+            arrs.append([])
+        else:
+            n = int(RNG.integers(1, 6))
+            arrs.append([None if (i + j) % 11 == 0 else
+                         int(RNG.integers(-50, 50)) for j in range(n)])
+    return {
+        "a": arrs,
+        "k": RNG.integers(0, 5, N).astype(np.int64),
+        "x": RNG.integers(1, 4, N).astype(np.int32),
+    }
+
+
+_DATA = _arr_data()
+_SCHEMA = T.StructType([
+    T.StructField("a", T.ArrayType(T.LONG)),
+    T.StructField("k", T.LONG),
+    T.StructField("x", T.INT),
+])
+
+
+def _df(s):
+    return s.create_dataframe(_DATA, schema=_SCHEMA, num_partitions=2)
+
+
+def test_array_roundtrip_device():
+    """Host->device->host roundtrip of list columns preserves nulls."""
+    from spark_rapids_tpu.columnar.batch import batch_from_pydict
+    b = batch_from_pydict({"a": _DATA["a"]}, T.StructType(
+        [T.StructField("a", T.ArrayType(T.LONG))]))
+    d = b.to_device()
+    back = d.to_host()
+    assert back.to_pydict()["a"] == _DATA["a"]
+
+
+def test_size_differential():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(col("k"), Alias(F.size(col("a")), "n")))
+
+
+def test_get_array_item_and_element_at():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            Alias(F.get_array_item(col("a"), 0), "first"),
+            Alias(F.element_at(col("a"), 1), "e1"),
+            Alias(F.element_at(col("a"), -1), "last"),
+            Alias(F.element_at(col("a"), col("x")), "ex")))
+
+
+def test_array_contains_three_valued():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            Alias(F.array_contains(col("a"), 7), "c7"),
+            Alias(F.array_contains(col("a"), col("k")), "ck")))
+
+
+def test_array_min_max():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            Alias(F.array_min(col("a")), "mn"),
+            Alias(F.array_max(col("a")), "mx")))
+
+
+def test_sort_array_both_orders():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            Alias(F.sort_array(col("a")), "asc_"),
+            Alias(F.sort_array(col("a"), asc=False), "desc_")))
+
+
+def test_slice_differential():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            Alias(F.slice(col("a"), 1, 2), "s12"),
+            Alias(F.slice(col("a"), 2, 10), "s2"),
+            Alias(F.slice(col("a"), -2, 2), "sneg")))
+
+
+def test_create_array_and_repeat():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            Alias(F.array(col("k"), lit(1), col("x")), "arr"),
+            Alias(F.array_repeat(col("k"), 3), "rep")))
+
+
+def test_transform_hof():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            Alias(F.transform(col("a"), lambda x: x * lit(2)), "t2"),
+            Alias(F.transform(col("a"), lambda x, i: x + i), "ti"),
+            Alias(F.transform(col("a"), lambda x: x + col("k")), "tk")))
+
+
+def test_exists_forall_filter_hofs():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            Alias(F.exists(col("a"), lambda x: x > lit(25)), "ex"),
+            Alias(F.forall(col("a"), lambda x: x > lit(-100)), "fa"),
+            Alias(F.filter(col("a"), lambda x: x > lit(0)), "fl")))
+
+
+def test_aggregate_hof():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            Alias(F.aggregate(col("a"), lit(0),
+                              lambda acc, x: acc + x), "sum_"),
+            Alias(F.aggregate(col("a"), lit(1),
+                              lambda acc, x: acc * x,
+                              lambda acc: acc + lit(100)), "prod_")))
+
+
+def test_explode_variants():
+    for outer in (False, True):
+        for pos in (False, True):
+            assert_tpu_and_cpu_are_equal_collect(
+                lambda s, o=outer, p=pos: _df(s).explode(
+                    "a", outer=o, position=p),
+                ignore_order=True)
+
+
+def test_explode_on_device():
+    s = tpu_session()
+    df = _df(s).explode("a")
+    names = {n.name for n in df._executed_plan().collect_nodes()}
+    assert "TpuGenerateExec" in names
+
+
+def test_struct_ops_host_tier():
+    data = {"p": [1, 2, None], "q": [1.5, None, 3.5]}
+
+    def q(s):
+        df = s.create_dataframe(data)
+        df = df.select(Alias(F.named_struct(u=col("p"), v=col("q")), "st"),
+                       col("p"))
+        return df.select(Alias(F.get_struct_field(col("st"), "v"), "v"),
+                         col("p"))
+    # struct compute is host-tier by design: disable the all-on-device assert
+    assert_tpu_and_cpu_are_equal_collect(
+        q, approx_float=True,
+        conf={"spark.rapids.sql.test.enabled": "false"})
+
+
+def test_map_ops_host_tier():
+    def q(s):
+        df = s.create_dataframe({"p": [1, 2], "q": [10, 20]})
+        df = df.select(Alias(F.create_map(lit("a"), col("p"),
+                                          lit("b"), col("q")), "m"))
+        return df.select(Alias(F.map_keys(col("m")), "ks"),
+                         Alias(F.map_values(col("m")), "vs"))
+    rows = q(cpu_session()).collect()
+    assert rows[0]["ks"] == ["a", "b"]
+    assert rows[0]["vs"] == [1, 10]
+    assert rows[1]["vs"] == [2, 20]
+    rows_t = q(tpu_session({"spark.rapids.sql.test.enabled": "false"})).collect()
+    assert rows == rows_t
+
+
+def test_array_through_filter_union_limit():
+    """Arrays survive the device data plane (filter/union/limit paths)."""
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).filter(col("k") > lit(1)).limit(200),
+        ignore_order=False)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).union(_df(s)).filter(F.size(col("a")) > lit(2)),
+        ignore_order=True)
+
+
+def test_array_fallback_for_string_elements():
+    """array<string> is host-only: plan must tag the fallback."""
+    s = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+    df = s.create_dataframe(
+        {"a": [["x", "y"], ["z"]]},
+        schema=T.StructType([T.StructField("a", T.ArrayType(T.STRING))]))
+    out = df.select(Alias(F.size(col("a")), "n"))
+    assert "host-only" in out.explain() or "not supported" in out.explain()
+    assert [r["n"] for r in out.collect()] == [2, 1]
+
+
+# -- code-review regression cases -------------------------------------------
+
+def test_slice_out_of_range_start_yields_empty():
+    data = {"a": [[1, None, 3], [7]]}
+    schema = T.StructType([T.StructField("a", T.ArrayType(T.LONG))])
+
+    def q(s):
+        return s.create_dataframe(data, schema=schema).select(
+            Alias(F.slice(col("a"), -5, 5), "neg_oob"),
+            Alias(F.slice(col("a"), 5, 2), "pos_oob"),
+            Alias(F.slice(col("a"), -2, 2), "neg_ok"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+    rows = q(cpu_session()).collect()
+    assert rows[0] == {"neg_oob": [], "pos_oob": [], "neg_ok": [None, 3]}
+    # [7] with start -2 resolves to index -1: out of range -> empty
+    # (Spark ArraySlice.semanticSlice has no negative-start clamp)
+    assert rows[1] == {"neg_oob": [], "pos_oob": [], "neg_ok": []}
+
+
+def test_forall_three_valued_logic():
+    data = {"a": [[1, None, 3], [1, 2], [-1, None], None, []]}
+    schema = T.StructType([T.StructField("a", T.ArrayType(T.LONG))])
+
+    def q(s):
+        return s.create_dataframe(data, schema=schema).select(
+            Alias(F.forall(col("a"), lambda x: x > lit(0)), "fa"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+    rows = q(cpu_session()).collect()
+    # no-false+some-null -> NULL; genuine false wins; vacuous truth on []
+    assert [r["fa"] for r in rows] == [None, True, False, None, True]
+
+
+def test_explode_alias_collides_with_existing_column():
+    data = {"col": [10, 20], "a": [[1, 2], [3]]}
+    schema = T.StructType([T.StructField("col", T.LONG),
+                           T.StructField("a", T.ArrayType(T.LONG))])
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(data, schema=schema).explode("a"),
+        ignore_order=True)
