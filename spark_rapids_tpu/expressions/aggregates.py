@@ -57,6 +57,9 @@ class AggregateFunction(Expression):
         """Final projection over buffer refs (order matches buffers())."""
         raise NotImplementedError
 
+    # NOTE: decimal128 buffer gating lives in exec/aggregate.py
+    # (_tag_aggregate), which sees the full buffer layout.
+
     def sql(self):
         args = ", ".join(c.sql() for c in self.children)
         return f"{type(self).__name__.lower()}({args})"

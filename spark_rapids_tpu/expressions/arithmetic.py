@@ -58,14 +58,38 @@ class BinaryArithmetic(BinaryExpr):
     """Shared scaffolding: numeric coercion, null propagation, wrap-on-overflow."""
 
     null_on_zero_divisor = False
+    decimal_op: str = ""   # "add"/"sub"/"mul"/"div"/"rem"/"pmod"
+
+    def _decimal_operands(self):
+        """(left_dt, right_dt) when this op runs in decimal space (at least
+        one decimal operand, the other decimal/integral), else None."""
+        from spark_rapids_tpu.expressions import decimal_math as DM
+        lt, rt = self.left.data_type, self.right.data_type
+        if not (isinstance(lt, T.DecimalType) or isinstance(rt, T.DecimalType)):
+            return None
+        if lt.is_floating or rt.is_floating:
+            return None   # Spark promotes decimal+fractional to double
+        if DM.as_decimal_type(lt) is None or DM.as_decimal_type(rt) is None:
+            raise TypeError(
+                f"cannot apply {self.name} to {lt.simple_name} and "
+                f"{rt.simple_name}: cast the non-numeric side explicitly")
+        return lt, rt
 
     @property
     def data_type(self) -> T.DataType:
+        ops = self._decimal_operands()
+        if ops is not None and self.decimal_op:
+            from spark_rapids_tpu.expressions import decimal_math as DM
+            return DM.binary_result_type(self.decimal_op, *ops)
         return T.common_type(self.left.data_type, self.right.data_type)
 
     def tpu_supported(self, conf):
-        if isinstance(self.data_type, T.DecimalType):
-            return "decimal arithmetic not yet on device"
+        ops = self._decimal_operands()
+        if ops is not None:
+            if not self.decimal_op:
+                return f"decimal {self.name} not supported on device"
+            from spark_rapids_tpu.expressions import decimal_math as DM
+            return DM.device_supported(self.decimal_op, *ops)
         return None
 
     def _apply(self, a, b, xp):
@@ -73,8 +97,32 @@ class BinaryArithmetic(BinaryExpr):
 
     def _eval(self, ctx: EvalContext, xp) -> TCol:
         rt = self.data_type
+        ops = self._decimal_operands()
+        if ops is not None and self.decimal_op:
+            from spark_rapids_tpu.expressions import decimal_math as DM
+            ltc = self.left.eval(ctx)
+            rtc = self.right.eval(ctx)
+            if ctx.backend == "cpu":
+                return DM.cpu_binary_eval(self.decimal_op, ltc, rtc, rt, ctx)
+            return DM.tpu_binary_eval(self.decimal_op, ltc, rtc, rt, ctx, xp)
+        if isinstance(self.left.data_type, T.DecimalType) or \
+                isinstance(self.right.data_type, T.DecimalType):
+            # decimal + fractional: promote the decimal side to double
+            from spark_rapids_tpu.expressions import decimal_math as DM
+            a = self.left.eval(ctx)
+            b = self.right.eval(ctx)
+            if isinstance(a.dtype, T.DecimalType):
+                a = DM.decimal_to_double(a, ctx, xp)
+            if isinstance(b.dtype, T.DecimalType):
+                b = DM.decimal_to_double(b, ctx, xp)
+            a = _coerce(a, rt, ctx, xp)
+            b = _coerce(b, rt, ctx, xp)
+            return self._finish_eval(a, b, rt, ctx, xp)
         a = _coerce(self.left.eval(ctx), rt, ctx, xp)
         b = _coerce(self.right.eval(ctx), rt, ctx, xp)
+        return self._finish_eval(a, b, rt, ctx, xp)
+
+    def _finish_eval(self, a, b, rt, ctx, xp) -> TCol:
         valid = both_valid(a, b, ctx)
         if a.is_scalar and b.is_scalar:
             if not valid or (self.null_on_zero_divisor and not b.data):
@@ -100,6 +148,7 @@ class BinaryArithmetic(BinaryExpr):
 
 class Add(BinaryArithmetic):
     symbol = "+"
+    decimal_op = "add"
 
     def _apply(self, a, b, xp):
         return a + b
@@ -107,6 +156,7 @@ class Add(BinaryArithmetic):
 
 class Subtract(BinaryArithmetic):
     symbol = "-"
+    decimal_op = "sub"
 
     def _apply(self, a, b, xp):
         return a - b
@@ -114,17 +164,24 @@ class Subtract(BinaryArithmetic):
 
 class Multiply(BinaryArithmetic):
     symbol = "*"
+    decimal_op = "mul"
 
     def _apply(self, a, b, xp):
         return a * b
 
 
 class Divide(BinaryArithmetic):
-    """Spark Divide: result is double; x/0 -> NULL (non-ANSI)."""
+    """Spark Divide: double result — except decimal/decimal, which stays
+    decimal per DecimalPrecision; x/0 -> NULL (non-ANSI)."""
     symbol = "/"
+    decimal_op = "div"
 
     @property
     def data_type(self):
+        ops = self._decimal_operands()
+        if ops is not None:
+            from spark_rapids_tpu.expressions import decimal_math as DM
+            return DM.binary_result_type("div", *ops)
         return T.DOUBLE
 
     @property
@@ -138,6 +195,7 @@ class Divide(BinaryArithmetic):
 class IntegralDivide(BinaryArithmetic):
     """Spark `div`: long result, x div 0 -> NULL."""
     symbol = "div"
+    decimal_op = "idiv"
 
     @property
     def data_type(self):
@@ -161,6 +219,7 @@ class IntegralDivide(BinaryArithmetic):
 class Remainder(BinaryArithmetic):
     """Spark %: sign follows the dividend (fmod); x%0 -> NULL."""
     symbol = "%"
+    decimal_op = "rem"
 
     @property
     def null_on_zero_divisor(self):
@@ -173,6 +232,7 @@ class Remainder(BinaryArithmetic):
 class Pmod(BinaryArithmetic):
     """Positive modulus (reference GpuPmod)."""
     symbol = "pmod"
+    decimal_op = "pmod"
 
     @property
     def null_on_zero_divisor(self):
@@ -197,13 +257,21 @@ class UnaryMinus(UnaryExpr):
     def data_type(self):
         return self.child.data_type
 
-    def tpu_supported(self, conf):
-        if isinstance(self.data_type, T.DecimalType):
-            return "decimal negate not yet on device"
-        return None
-
     def _eval(self, ctx, xp):
         c = self.child.eval(ctx)
+        dt = c.dtype
+        if isinstance(dt, T.DecimalType):
+            from spark_rapids_tpu.expressions import decimal_math as DM
+            if ctx.backend == "cpu":
+                vals, valid = DM.unscaled_py(c, ctx)
+                out = np.empty(ctx.row_count, dtype=object)
+                for i in range(ctx.row_count):
+                    out[i] = -vals[i]
+                return DM.result_tcol_py(out, valid, dt, ctx)
+            hi, lo, valid = DM.device_parts(c, ctx, xp)
+            hi, lo = DM.widen_to_128(hi, lo, xp)
+            nh, nl = DM.neg128(hi, lo, xp)
+            return DM.pack_result(nh, nl, valid, dt, ctx, xp)
         if c.is_scalar:
             return TCol.scalar(None if c.data is None else -c.data, c.dtype)
         return TCol(-c.data, c.valid, c.dtype)
@@ -222,6 +290,19 @@ class Abs(UnaryExpr):
 
     def _eval(self, ctx, xp):
         c = self.child.eval(ctx)
+        dt = c.dtype
+        if isinstance(dt, T.DecimalType):
+            from spark_rapids_tpu.expressions import decimal_math as DM
+            if ctx.backend == "cpu":
+                vals, valid = DM.unscaled_py(c, ctx)
+                out = np.empty(ctx.row_count, dtype=object)
+                for i in range(ctx.row_count):
+                    out[i] = abs(vals[i])
+                return DM.result_tcol_py(out, valid, dt, ctx)
+            hi, lo, valid = DM.device_parts(c, ctx, xp)
+            hi, lo = DM.widen_to_128(hi, lo, xp)
+            ah, al = DM.abs128(hi, lo, xp)
+            return DM.pack_result(ah, al, valid, dt, ctx, xp)
         if c.is_scalar:
             return TCol.scalar(None if c.data is None else abs(c.data), c.dtype)
         return TCol(xp.abs(c.data), c.valid, c.dtype)

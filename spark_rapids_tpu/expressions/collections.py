@@ -77,6 +77,9 @@ def _to_phys(v, elem: T.DataType):
 
 def _from_phys(v, elem: T.DataType):
     import datetime
+    if elem.np_dtype is None and not isinstance(
+            elem, (T.DateType, T.TimestampType)):
+        return v   # host-only element types (strings/nested) pass through
     if isinstance(elem, T.DateType):
         return datetime.date(1970, 1, 1) + datetime.timedelta(days=int(v))
     if isinstance(elem, T.TimestampType):
@@ -283,6 +286,19 @@ class _ArrayMinMax(_ArrayExpr):
         xp = _xp(ctx)
         tc = self.children[0].eval(ctx)
         vals, lens, ev, valid = _array_parts(tc, ctx)
+        if ctx.backend == "cpu" and vals.dtype == np.dtype(object):
+            # host-only element types: per-row python min/max
+            out = np.empty(ctx.row_count, dtype=object)
+            ok = np.zeros(ctx.row_count, dtype=bool)
+            for i in range(ctx.row_count):
+                if not valid[i]:
+                    continue
+                live_vals = [vals[i, j] for j in range(int(lens[i]))
+                             if ev[i, j]]
+                if live_vals:
+                    out[i] = max(live_vals) if self.is_max else min(live_vals)
+                    ok[i] = True
+            return TCol(out, ok, self.data_type)
         pos = _positions(xp, vals.shape)
         live = ev & (pos < xp.asarray(lens, dtype=np.int32)[:, None])
         any_live = live.any(axis=1)
@@ -338,6 +354,20 @@ class SortArray(_ArrayExpr):
         asc = bool(self.children[1].value)
         tc = self.children[0].eval(ctx)
         vals, lens, ev, valid = _array_parts(tc, ctx)
+        if ctx.backend == "cpu" and vals.dtype == np.dtype(object):
+            # host-only element types: per-row python sort, Spark null
+            # placement (nulls first asc, last desc)
+            out = np.empty(ctx.row_count, dtype=object)
+            for i in range(ctx.row_count):
+                if not valid[i]:
+                    out[i] = None
+                    continue
+                row = [vals[i, j] if ev[i, j] else None
+                       for j in range(int(lens[i]))]
+                nn = sorted([v for v in row if v is not None], reverse=not asc)
+                nulls = [None] * (len(row) - len(nn))
+                out[i] = nulls + nn if asc else nn + nulls
+            return TCol(out, valid, self.data_type)
         pos = _positions(xp, vals.shape)
         in_len = pos < xp.asarray(lens, dtype=np.int32)[:, None]
         live = ev & in_len

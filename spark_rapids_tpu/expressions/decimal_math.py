@@ -1,0 +1,683 @@
+"""Decimal arithmetic: Spark DecimalPrecision result types + exact kernels.
+
+Reference: decimalExpressions.scala + the JNI ``DecimalUtils`` 128-bit
+kernels (SURVEY.md §2.16) and GpuDecimalMultiply/GpuDecimalDivide in
+arithmetic.scala.  Semantics follow Spark non-ANSI mode: overflow -> NULL,
+divide-by-zero -> NULL, HALF_UP rounding.
+
+TPU design: decimal64 (precision <= 18) is plain int64 lane math.
+decimal128 lives as [n, 2] int64 (hi, lo-bits) columns; kernels split each
+lane into four 32-bit limbs (held in int64 lanes so carries fit), run
+schoolbook add/mul/divmod-by-small, and rejoin — all elementwise jnp ops
+that fuse into the surrounding XLA program.  The device handles:
+
+- add/sub/negate/abs at any precision (incl. 128-bit, with 10^d rescale)
+- multiply when the UNADJUSTED result fits 38 digits (64x64->128 limbs)
+- divide when the scaled numerator fits in 64 bits
+
+Everything else is tagged host-only (the CPU oracle computes with python
+ints, always exact).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import (EvalContext, TCol, jnp,
+                                               valid_array)
+
+D = T.DecimalType
+MAX_P = D.MAX_PRECISION          # 38
+MAX_LONG = D.MAX_LONG_DIGITS     # 18
+_MASK32 = np.int64(0xFFFFFFFF)
+
+
+# ---------------------------------------------------------------------------
+# Spark DecimalPrecision result types (allowPrecisionLoss=true defaults)
+# ---------------------------------------------------------------------------
+
+def _bounded(precision: int, scale: int) -> D:
+    """Spark DecimalType.adjustPrecisionScale."""
+    if precision <= MAX_P:
+        return D(precision, scale)
+    digits = precision - scale
+    min_scale = min(scale, 6)
+    adj_scale = max(MAX_P - digits, min_scale)
+    return D(MAX_P, adj_scale)
+
+
+def add_result_type(a: D, b: D) -> D:
+    scale = max(a.scale, b.scale)
+    digits = max(a.precision - a.scale, b.precision - b.scale)
+    return _bounded(digits + scale + 1, scale)
+
+
+def mul_result_type(a: D, b: D) -> D:
+    return _bounded(a.precision + b.precision + 1, a.scale + b.scale)
+
+
+def div_result_type(a: D, b: D) -> D:
+    scale = max(6, a.scale + b.precision + 1)
+    digits = a.precision - a.scale + b.scale
+    return _bounded(digits + scale, scale)
+
+
+def rem_result_type(a: D, b: D) -> D:
+    scale = max(a.scale, b.scale)
+    digits = min(a.precision - a.scale, b.precision - b.scale)
+    return _bounded(digits + scale, scale)
+
+
+def as_decimal_type(dt: T.DataType) -> Optional[D]:
+    """The decimal view of an operand (Spark's integral->decimal widening)."""
+    if isinstance(dt, D):
+        return dt
+    if isinstance(dt, T.ByteType):
+        return D(3, 0)
+    if isinstance(dt, T.ShortType):
+        return D(5, 0)
+    if isinstance(dt, T.IntegerType):
+        return D(10, 0)
+    if isinstance(dt, T.LongType):
+        return D(19, 0)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# 32-bit limb helpers (device decimal128); numpy twin drives the CPU checks
+# ---------------------------------------------------------------------------
+
+def _u64(x, xp):
+    if xp is np:
+        return np.asarray(x).view(np.uint64)
+    import jax
+    return jax.lax.bitcast_convert_type(x, np.uint64)
+
+
+def _i64(x, xp):
+    if xp is np:
+        return np.asarray(x).view(np.int64)
+    import jax
+    return jax.lax.bitcast_convert_type(x, np.int64)
+
+
+def split128(hi, lo, xp):
+    """(hi int64, lo bits int64) -> 4 unsigned 32-bit limbs in int64 lanes,
+    little-endian, two's-complement (negative numbers stay wrapped)."""
+    lo_u = _u64(lo, xp)
+    hi_u = _u64(hi, xp)
+    m = np.uint64(0xFFFFFFFF)
+    l0 = _i64((lo_u & m), xp)
+    l1 = _i64((lo_u >> np.uint64(32)), xp)
+    l2 = _i64((hi_u & m), xp)
+    l3 = _i64((hi_u >> np.uint64(32)), xp)
+    return [l0, l1, l2, l3]
+
+
+def join128(limbs, xp):
+    """4 normalized limbs -> (hi, lo-bits) int64."""
+    lo = _i64(_u64(limbs[0], xp) | (_u64(limbs[1], xp) << np.uint64(32)), xp)
+    hi = _i64(_u64(limbs[2], xp) | (_u64(limbs[3], xp) << np.uint64(32)), xp)
+    return hi, lo
+
+
+def _normalize(limbs, xp):
+    """Propagates carries so every limb is in [0, 2^32); returns (limbs,
+    carry-out) — carry-out nonzero means 128-bit overflow (for unsigned
+    magnitude math)."""
+    out = []
+    carry = xp.zeros_like(limbs[0])
+    for l in limbs:
+        s = l + carry
+        out.append(s & _MASK32)
+        carry = s >> np.int64(32)
+    return out, carry
+
+
+def neg128(hi, lo, xp):
+    """Two's-complement negate."""
+    limbs = split128(hi, lo, xp)
+    inv = [(~l) & _MASK32 for l in limbs]
+    inv[0] = inv[0] + 1
+    norm, _ = _normalize(inv, xp)
+    return join128(norm, xp)
+
+
+def is_neg128(hi):
+    return hi < 0
+
+
+def abs128(hi, lo, xp):
+    nh, nl = neg128(hi, lo, xp)
+    neg = hi < 0
+    return xp.where(neg, nh, hi), xp.where(neg, nl, lo)
+
+
+def add128(ah, al, bh, bl, xp):
+    """Signed 128-bit add; returns (hi, lo, overflow)."""
+    a = split128(ah, al, xp)
+    b = split128(bh, bl, xp)
+    s = [x + y for x, y in zip(a, b)]
+    norm, _ = _normalize(s, xp)
+    hi, lo = join128(norm, xp)
+    # signed overflow: operands same sign, result differs
+    ovf = ((ah < 0) == (bh < 0)) & ((hi < 0) != (ah < 0))
+    return hi, lo, ovf
+
+
+def mul128_small(hi, lo, mult_limbs, xp):
+    """|x| * m for a non-negative 128-bit magnitude and a python-int
+    multiplier decomposed into 32-bit limbs; returns (hi, lo, overflow)."""
+    a = split128(hi, lo, xp)
+    acc = [xp.zeros_like(a[0]) for _ in range(5)]
+    ovf = xp.zeros_like(hi < 0)
+    for j, m in enumerate(mult_limbs):
+        if m == 0:
+            continue
+        m64 = np.int64(m)          # m < 2^32
+        for i in range(4):
+            k = i + j
+            if k >= 4:
+                # any contribution past 128 bits is overflow
+                ovf = ovf | (a[i] != 0)
+                continue
+            # limb product < 2^64 would not fit signed int64; split the
+            # 32-bit limb into 16-bit halves so partials stay exact
+            p_lo = a[i] * (m64 & np.int64(0xFFFF))
+            p_hi = a[i] * (m64 >> np.int64(16))
+            acc[k] = acc[k] + (p_lo & _MASK32) \
+                + ((p_hi & np.int64(0xFFFF)) << np.int64(16))
+            spill = (p_lo >> np.int64(32)) + (p_hi >> np.int64(16))
+            if k + 1 >= 4:
+                ovf = ovf | (spill != 0)
+            else:
+                acc[k + 1] = acc[k + 1] + spill
+    norm, carry = _normalize(acc[:4], xp)
+    ovf = ovf | (carry != 0) | (acc[4] != 0)
+    hi2, lo2 = join128(norm, xp)
+    # magnitude math: a negative (signed) result bit means > 2^127-1
+    ovf = ovf | (hi2 < 0)
+    return hi2, lo2, ovf
+
+
+def _mul32(a, b):
+    """Exact 32x32 -> 64 product of unsigned limbs held in int64 lanes,
+    returned as (low32, high32) — the naive a*b can reach ~2^64 and wrap
+    signed int64, so the product is assembled from 16-bit halves."""
+    a0, a1 = a & np.int64(0xFFFF), a >> np.int64(16)
+    b0, b1 = b & np.int64(0xFFFF), b >> np.int64(16)
+    mid = a0 * b1 + a1 * b0                      # < 2^33
+    low = a0 * b0 + ((mid & np.int64(0xFFFF)) << np.int64(16))  # < 2^33
+    high = a1 * b1 + (mid >> np.int64(16)) + (low >> np.int64(32))
+    return low & _MASK32, high
+
+
+def divmod128_small(hi, lo, div: int, xp):
+    """|x| divmod d for a non-negative 128-bit magnitude and a python int
+    divisor 0 < d < 2^31; long division over the four limbs."""
+    limbs = split128(hi, lo, xp)
+    d = np.int64(div)
+    q = []
+    rem = xp.zeros_like(limbs[0])
+    for l in reversed(limbs):
+        cur = (rem << np.int64(32)) | l
+        q.append(cur // d)
+        rem = cur % d
+    q = list(reversed(q))
+    qh, ql = join128([x & _MASK32 for x in q], xp)
+    return qh, ql, rem
+
+
+def cmp128_const(hi, lo, bound: int, xp):
+    """|x| > bound (non-negative magnitudes), bound a python int < 2^127."""
+    bh = np.int64(bound >> 64)
+    bl = np.int64((bound & ((1 << 64) - 1)) - (1 << 64)) \
+        if (bound & ((1 << 64) - 1)) >= (1 << 63) else \
+        np.int64(bound & ((1 << 64) - 1))
+    gt_hi = hi > bh
+    eq_hi = hi == bh
+    gt_lo = _u64(lo, xp) > _u64(xp.zeros_like(lo) + bl, xp)
+    return gt_hi | (eq_hi & gt_lo)
+
+
+def _pow10_limbs(d: int):
+    v = 10 ** d
+    return [(v >> (32 * i)) & 0xFFFFFFFF for i in range(4)]
+
+
+def rescale128_up(hi, lo, d: int, xp):
+    """x * 10^d (signed), returns (hi, lo, overflow)."""
+    if d == 0:
+        return hi, lo, xp.zeros_like(hi < 0)
+    ah, al = abs128(hi, lo, xp)
+    mh, ml, ovf = mul128_small(ah, al, _pow10_limbs(d), xp)
+    nh, nl = neg128(mh, ml, xp)
+    neg = hi < 0
+    return xp.where(neg, nh, mh), xp.where(neg, nl, ml), ovf
+
+
+def div128_pow10_half_up(hi, lo, d: int, xp):
+    """round_half_up(x / 10^d) (signed)."""
+    if d == 0:
+        return hi, lo
+    ah, al = abs128(hi, lo, xp)
+    q_h, q_l = ah, al
+    # divide in <=9-digit chunks (divisor must fit in 31 bits)
+    rem_scale = 1
+    remainders = xp.zeros_like(hi)
+    left = d
+    while left > 0:
+        step = min(left, 9)
+        dv = 10 ** step
+        q_h, q_l, r = divmod128_small(q_h, q_l, dv, xp)
+        remainders = remainders + r * np.int64(rem_scale)
+        rem_scale *= dv
+        left -= step
+    # HALF_UP: remainder*2 >= divisor -> bump (remainder < 10^d <= 10^38
+    # may exceed int64 when d > 18 — compare in float is unsafe; instead
+    # compare against half-divisor chunkwise is overkill: d > 18 implies
+    # dropping >18 digits, only the top chunk matters for the half test)
+    if d <= 18:
+        bump = 2 * remainders >= np.int64(10 ** d)
+    else:
+        # remainder tracked exactly only while it fits; for d>18 divide is
+        # host-only (guarded by callers), keep a defensive floor here
+        bump = xp.zeros_like(hi < 0)
+    b_limbs = split128(q_h, q_l, xp)
+    b_limbs[0] = b_limbs[0] + bump.astype(np.int64)
+    norm, _ = _normalize(b_limbs, xp)
+    q_h, q_l = join128(norm, xp)
+    nh, nl = neg128(q_h, q_l, xp)
+    neg = hi < 0
+    return xp.where(neg, nh, q_h), xp.where(neg, nl, q_l)
+
+
+# ---------------------------------------------------------------------------
+# TCol plumbing
+# ---------------------------------------------------------------------------
+
+def unscaled_py(tc: TCol, ctx: EvalContext) -> Tuple[np.ndarray, np.ndarray]:
+    """CPU backend: (object array of python unscaled ints, validity)."""
+    import decimal as dec
+    n = ctx.row_count
+    valid = valid_array(tc, ctx)
+    out = np.empty(n, dtype=object)
+    dt = tc.dtype
+    if tc.is_scalar:
+        v = _scalar_unscaled(tc)
+        for i in range(n):
+            out[i] = v
+        return out, valid
+    if isinstance(dt, D) and dt.is_decimal128:
+        # data is already a python-int object array (signed unscaled)
+        for i in range(n):
+            out[i] = int(tc.data[i]) if valid[i] else 0
+        return out, valid
+    arr = np.asarray(tc.data)
+    for i in range(n):
+        out[i] = int(arr[i]) if valid[i] else 0
+    return out, valid
+
+
+def _scalar_unscaled(tc: TCol) -> int:
+    import decimal as dec
+    if tc.data is None:
+        return 0
+    dt = tc.dtype
+    if isinstance(tc.data, dec.Decimal):
+        scale = dt.scale if isinstance(dt, D) else 0
+        return int(tc.data.scaleb(scale).to_integral_value())
+    return int(tc.data)
+
+
+def result_tcol_py(vals: np.ndarray, valid, rt: D, ctx) -> TCol:
+    """Python ints -> the CPU physical repr of the result type, nulling
+    overflow (Spark non-ANSI)."""
+    n = ctx.row_count
+    bound = 10 ** rt.precision
+    ok = np.asarray(valid).copy()
+    if rt.is_decimal128:
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            v = vals[i]
+            if abs(v) >= bound:
+                ok[i] = False
+                out[i] = 0
+            else:
+                out[i] = v
+        return TCol(out, ok, rt)
+    out = np.zeros(n, dtype=np.int64)
+    for i in range(n):
+        v = vals[i]
+        if abs(v) >= bound:
+            ok[i] = False
+        else:
+            out[i] = v
+    return TCol(out, ok, rt)
+
+
+def device_parts(tc: TCol, ctx: EvalContext, xp):
+    """Device backend: ((hi, lo) limbs or (None, lo64), validity); integral
+    operands present as decimal(p, 0) in int64."""
+    valid = valid_array(tc, ctx)
+    dt = tc.dtype
+    if tc.is_scalar:
+        v = _scalar_unscaled(tc)
+        if isinstance(dt, D) and dt.is_decimal128:
+            hi = xp.full(ctx.row_count, np.int64(v >> 64))
+            lo = xp.full(ctx.row_count,
+                         np.int64((v & ((1 << 64) - 1)) - (1 << 64)
+                                  if (v & ((1 << 64) - 1)) >= (1 << 63)
+                                  else v & ((1 << 64) - 1)))
+            return hi, lo, valid
+        return None, xp.full(ctx.row_count, np.int64(v)), valid
+    if isinstance(dt, D) and dt.is_decimal128:
+        return tc.data[:, 0], tc.data[:, 1], valid
+    return None, xp.asarray(tc.data).astype(np.int64), valid
+
+
+def widen_to_128(hi, lo, xp):
+    if hi is not None:
+        return hi, lo
+    return xp.where(lo < 0, np.int64(-1), np.int64(0)), lo
+
+
+def pack_result(hi, lo, valid, rt: D, ctx, xp) -> TCol:
+    """Device (hi, lo) -> result column with overflow nulling."""
+    bound = 10 ** rt.precision - 1
+    ah, al = abs128(hi, lo, xp)
+    ovf = cmp128_const(ah, al, bound, xp)
+    ok = valid & ~ovf
+    if rt.is_decimal128:
+        return TCol(xp.stack([hi, lo], axis=1), ok, rt)
+    return TCol(lo, ok, rt)
+
+
+# ---------------------------------------------------------------------------
+# High-level op evaluation (called from arithmetic.py when decimals involved)
+# ---------------------------------------------------------------------------
+
+def binary_result_type(op: str, lt: T.DataType, rt: T.DataType) -> D:
+    a, b = as_decimal_type(lt), as_decimal_type(rt)
+    if a is None or b is None:
+        raise TypeError(f"decimal {op} on non-decimal operands {lt}, {rt}")
+    if op in ("add", "sub"):
+        return add_result_type(a, b)
+    if op == "mul":
+        return mul_result_type(a, b)
+    if op == "div":
+        return div_result_type(a, b)
+    if op in ("rem", "pmod", "idiv"):
+        # idiv's column type is LONG; the decimal view only drives gating
+        return rem_result_type(a, b)
+    raise ValueError(op)
+
+
+def device_supported(op: str, lt: T.DataType, rt_: T.DataType) -> Optional[str]:
+    """None when the device kernels handle this op/type combo exactly;
+    reason string otherwise (tagging -> host fallback, like the reference
+    gates DECIMAL128 ops per JNI kernel availability)."""
+    a, b = as_decimal_type(lt), as_decimal_type(rt_)
+    out = binary_result_type(op, lt, rt_)
+    if op in ("add", "sub"):
+        if max(a.scale, b.scale) - out.scale > 18:
+            return "decimal add/sub scale reduction beyond 18 is host tier"
+        return None   # 128-bit add with rescale covers the rest
+    if op == "mul":
+        raw_scale = a.scale + b.scale
+        if a.precision <= MAX_LONG and b.precision <= MAX_LONG and \
+                out.scale == raw_scale:
+            return None
+        if out.scale != raw_scale and raw_scale - out.scale <= 18 and \
+                a.precision <= MAX_LONG and b.precision <= MAX_LONG:
+            return None  # 64x64->128 then one rounded pow10 divide
+        return (f"decimal multiply {a.simple_name} x {b.simple_name} "
+                "needs >128-bit intermediates (host tier)")
+    if op == "div":
+        d = out.scale + b.scale - a.scale
+        if a.precision + d <= MAX_LONG and b.precision <= MAX_LONG:
+            return None  # scaled numerator fits int64
+        return (f"decimal divide {a.simple_name} / {b.simple_name} "
+                "needs 128-bit division (host tier)")
+    if op in ("rem", "pmod", "idiv"):
+        s = max(a.scale, b.scale)
+        if a.precision + (s - a.scale) <= MAX_LONG and \
+                b.precision + (s - b.scale) <= MAX_LONG:
+            return None  # aligned operands fit int64
+        return f"decimal {op} at this precision is host tier"
+    return f"decimal {op} not implemented"
+
+
+def cpu_binary_eval(op: str, left: TCol, right: TCol, out: D,
+                    ctx: EvalContext) -> TCol:
+    """Exact python-int oracle for every decimal op."""
+    a, b = as_decimal_type(left.dtype), as_decimal_type(right.dtype)
+    av, avalid = unscaled_py(left, ctx)
+    bv, bvalid = unscaled_py(right, ctx)
+    n = ctx.row_count
+    valid = np.asarray(avalid & bvalid).copy()
+    vals = np.empty(n, dtype=object)
+    for i in range(n):
+        vals[i] = 0
+        if not valid[i]:
+            continue
+        x, y = av[i], bv[i]
+        if op in ("add", "sub"):
+            s_max = max(a.scale, b.scale)
+            x *= 10 ** (s_max - a.scale)
+            y *= 10 ** (s_max - b.scale)
+            r = x + y if op == "add" else x - y
+            vals[i] = _round_half_up(r, s_max - out.scale)
+        elif op == "mul":
+            raw = x * y                      # scale a.scale + b.scale
+            vals[i] = _round_half_up(raw, a.scale + b.scale - out.scale)
+        elif op == "div":
+            if y == 0:
+                valid[i] = False
+                continue
+            d = out.scale + b.scale - a.scale
+            vals[i] = _div_half_up(x * 10 ** d, y)
+        elif op in ("rem", "pmod", "idiv"):
+            if y == 0:
+                valid[i] = False
+                continue
+            s = max(a.scale, b.scale)
+            xs = x * 10 ** (s - a.scale)
+            ys = y * 10 ** (s - b.scale)
+            if op == "idiv":
+                q = abs(xs) // abs(ys)
+                vals[i] = -q if (xs < 0) != (ys < 0) else q
+                continue
+            r = math_fmod(xs, ys)
+            if op == "pmod" and r < 0:
+                r += abs(ys)
+            vals[i] = _round_half_up(r, s - out.scale)
+    if op == "idiv":
+        # long result (Spark IntegralDivide), overflow -> null
+        ok = np.asarray(valid).copy()
+        dense = np.zeros(ctx.row_count, dtype=np.int64)
+        for i in range(ctx.row_count):
+            if ok[i]:
+                if abs(vals[i]) > (1 << 63) - 1:
+                    ok[i] = False
+                else:
+                    dense[i] = vals[i]
+        return TCol(dense, ok, T.LONG)
+    return result_tcol_py(vals, valid, out, ctx)
+
+
+def math_fmod(x: int, y: int) -> int:
+    """Java % (sign follows dividend) on ints."""
+    r = abs(x) % abs(y)
+    return -r if x < 0 else r
+
+
+def _round_half_up(v: int, drop_digits: int) -> int:
+    if drop_digits <= 0:
+        return v * 10 ** (-drop_digits)
+    return _div_half_up(v, 10 ** drop_digits)
+
+
+def _div_half_up(num: int, den: int) -> int:
+    sign = -1 if (num < 0) != (den < 0) else 1
+    num, den = abs(num), abs(den)
+    return sign * ((2 * num + den) // (2 * den))
+
+
+def tpu_binary_eval(op: str, left: TCol, right: TCol, out: D,
+                    ctx: EvalContext, xp) -> TCol:
+    """Device kernels for the combos device_supported() admits."""
+    a, b = as_decimal_type(left.dtype), as_decimal_type(right.dtype)
+    ah, al, avalid = device_parts(left, ctx, xp)
+    bh, bl, bvalid = device_parts(right, ctx, xp)
+    valid = avalid & bvalid
+    if op in ("add", "sub"):
+        # exact sum at s_max = max(s1, s2); when _bounded reduced the
+        # result scale below s_max, round HALF_UP afterwards (BigDecimal
+        # semantics)
+        s_max = max(a.scale, b.scale)
+        ah, al = widen_to_128(ah, al, xp)
+        bh, bl = widen_to_128(bh, bl, xp)
+        ah, al, ovf1 = rescale128_up(ah, al, s_max - a.scale, xp)
+        bh, bl, ovf2 = rescale128_up(bh, bl, s_max - b.scale, xp)
+        if op == "sub":
+            bh, bl = neg128(bh, bl, xp)
+        rh, rl, ovf3 = add128(ah, al, bh, bl, xp)
+        if out.scale < s_max:
+            rh, rl = div128_pow10_half_up(rh, rl, s_max - out.scale, xp)
+        return pack_result(rh, rl, valid & ~ovf1 & ~ovf2 & ~ovf3, out,
+                           ctx, xp)
+    if op == "mul":
+        # both operands fit int64: 64x64 -> 128 via 32-bit limb products
+        neg = (al < 0) != (bl < 0)
+        x = xp.abs(al)
+        y = xp.abs(bl)
+        x_l, x_h = x & _MASK32, x >> np.int64(32)
+        y_l, y_h = y & _MASK32, y >> np.int64(32)
+        ll_lo, ll_hi = _mul32(x_l, y_l)
+        lh_lo, lh_hi = _mul32(x_l, y_h)
+        hl_lo, hl_hi = _mul32(x_h, y_l)
+        hh_lo, hh_hi = _mul32(x_h, y_h)
+        acc0 = ll_lo
+        acc1 = ll_hi + lh_lo + hl_lo
+        acc2 = lh_hi + hl_hi + hh_lo
+        acc3 = hh_hi
+        norm, carry = _normalize([acc0, acc1, acc2, acc3], xp)
+        rh, rl = join128(norm, xp)
+        drop = a.scale + b.scale - out.scale
+        if drop > 0:
+            rh, rl = div128_pow10_half_up(rh, rl, drop, xp)
+        nh, nl = neg128(rh, rl, xp)
+        rh = xp.where(neg, nh, rh)
+        rl = xp.where(neg, nl, rl)
+        return pack_result(rh, rl, valid & (carry == 0), out, ctx, xp)
+    if op == "div":
+        d = out.scale + b.scale - a.scale
+        num = al * np.int64(10 ** d)     # guarded: fits int64
+        den = bl
+        zero = den == 0
+        den = xp.where(zero, np.int64(1), den)
+        sign = xp.where((num < 0) != (den < 0), np.int64(-1), np.int64(1))
+        q = (2 * xp.abs(num) + xp.abs(den)) // (2 * xp.abs(den))
+        rl = sign * q
+        rh = xp.where(rl < 0, np.int64(-1), np.int64(0))
+        return pack_result(rh, rl, valid & ~zero, out, ctx, xp)
+    if op in ("rem", "pmod", "idiv"):
+        s = max(a.scale, b.scale)
+        xs = al * np.int64(10 ** (s - a.scale))
+        ys = bl * np.int64(10 ** (s - b.scale))
+        zero = ys == 0
+        ys = xp.where(zero, np.int64(1), ys)
+        if op == "idiv":
+            q = xp.abs(xs) // xp.abs(ys)
+            q = xp.where((xs < 0) != (ys < 0), -q, q)
+            return TCol(q, valid & ~zero, T.LONG)
+        r = xp.abs(xs) % xp.abs(ys)
+        r = xp.where(xs < 0, -r, r)
+        if op == "pmod":
+            r = xp.where(r < 0, r + xp.abs(ys), r)
+        drop = s - out.scale
+        if drop > 0:
+            sign = xp.where(r < 0, np.int64(-1), np.int64(1))
+            p10 = np.int64(10 ** drop)
+            r = sign * ((2 * xp.abs(r) + p10) // (2 * p10))
+        rh = xp.where(r < 0, np.int64(-1), np.int64(0))
+        return pack_result(rh, r, valid & ~zero, out, ctx, xp)
+    raise ValueError(op)
+
+
+def decimal_to_double(tc: TCol, ctx: EvalContext, xp) -> TCol:
+    """decimal -> double (for decimal+float promotions)."""
+    dt = tc.dtype
+    assert isinstance(dt, D)
+    if ctx.backend == "cpu":
+        vals, valid = unscaled_py(tc, ctx)
+        out = np.zeros(ctx.row_count, dtype=np.float64)
+        for i in range(ctx.row_count):
+            out[i] = float(vals[i]) / (10.0 ** dt.scale)
+        return TCol(out, valid, T.DOUBLE)
+    hi, lo, valid = device_parts(tc, ctx, xp)
+    if hi is None:
+        out = lo.astype(np.float64) / (10.0 ** dt.scale)
+    else:
+        out = (hi.astype(np.float64) * np.float64(2.0 ** 64)
+               + _u64(lo, xp).astype(np.float64)) / (10.0 ** dt.scale)
+    return TCol(out, valid, T.DOUBLE)
+
+
+# ---------------------------------------------------------------------------
+# Comparisons (used by BinaryComparison when decimals are involved)
+# ---------------------------------------------------------------------------
+
+def compare_involved(lt: T.DataType, rt: T.DataType) -> bool:
+    """True when the comparison must run in decimal space (both sides
+    decimal or integral; fractional partners promote to double instead;
+    anything else needs an explicit cast)."""
+    if not (isinstance(lt, D) or isinstance(rt, D)):
+        return False
+    return as_decimal_type(lt) is not None and \
+        as_decimal_type(rt) is not None
+
+
+def compare_supported(lt: T.DataType, rt: T.DataType) -> Optional[str]:
+    a, b = as_decimal_type(lt), as_decimal_type(rt)
+    s = max(a.scale, b.scale)
+    if max(a.precision + (s - a.scale), b.precision + (s - b.scale)) <= MAX_P:
+        return None
+    return "decimal comparison at this scale mix is host tier"
+
+
+def compare(left: TCol, right: TCol, ctx: EvalContext, xp):
+    """Returns an int8/int array of -1/0/1 per row (nulls handled by the
+    caller's validity)."""
+    a, b = as_decimal_type(left.dtype), as_decimal_type(right.dtype)
+    s = max(a.scale, b.scale)
+    if ctx.backend == "cpu":
+        av, _ = unscaled_py(left, ctx)
+        bv, _ = unscaled_py(right, ctx)
+        out = np.zeros(ctx.row_count, dtype=np.int8)
+        da, db = 10 ** (s - a.scale), 10 ** (s - b.scale)
+        for i in range(ctx.row_count):
+            x, y = av[i] * da, bv[i] * db
+            out[i] = (x > y) - (x < y)
+        return out
+    ah, al, _ = device_parts(left, ctx, xp)
+    bh, bl, _ = device_parts(right, ctx, xp)
+    da, db = s - a.scale, s - b.scale
+    if ah is None and bh is None and \
+            a.precision + da <= MAX_LONG and b.precision + db <= MAX_LONG:
+        x = al * np.int64(10 ** da)
+        y = bl * np.int64(10 ** db)
+        return (xp.asarray(x > y, dtype=np.int8)
+                - xp.asarray(x < y, dtype=np.int8))
+    ah, al = widen_to_128(ah, al, xp)
+    bh, bl = widen_to_128(bh, bl, xp)
+    ah, al, _o1 = rescale128_up(ah, al, da, xp)
+    bh, bl, _o2 = rescale128_up(bh, bl, db, xp)
+    # signed 128-bit compare: hi signed, lo unsigned
+    lt_ = (ah < bh) | ((ah == bh) & (_u64(al, xp) < _u64(bl, xp)))
+    gt_ = (ah > bh) | ((ah == bh) & (_u64(al, xp) > _u64(bl, xp)))
+    return xp.asarray(gt_, dtype=np.int8) - xp.asarray(lt_, dtype=np.int8)

@@ -60,13 +60,43 @@ class BinaryComparison(BinaryExpr):
                        xp.sign(a.lengths - b.lengths))
         return cmp  # -1/0/1 per row
 
+    def tpu_supported(self, conf):
+        from spark_rapids_tpu.expressions import decimal_math as DM
+        lt, rt = self.left.data_type, self.right.data_type
+        if DM.compare_involved(lt, rt):
+            return DM.compare_supported(lt, rt)
+        return None
+
     def _eval(self, ctx: EvalContext, xp) -> TCol:
+        from spark_rapids_tpu.expressions import decimal_math as DM
         a = self.left.eval(ctx)
         b = self.right.eval(ctx)
+        # Spark promotes decimal-vs-fractional to double before comparing
+        if isinstance(a.dtype, T.DecimalType) and b.dtype.is_floating:
+            a = _decimal_side_to_double(a, ctx, xp)
+        elif isinstance(b.dtype, T.DecimalType) and a.dtype.is_floating:
+            b = _decimal_side_to_double(b, ctx, xp)
         valid = both_valid(a, b, ctx)
+        if DM.compare_involved(a.dtype, b.dtype) and \
+                not (a.is_scalar and b.is_scalar):
+            cmp = DM.compare(a, b, ctx, xp)
+            out = self._cmp(cmp, np.int8(0), xp)
+            if isinstance(valid, bool):
+                from spark_rapids_tpu.expressions.base import valid_array
+                valid = valid_array(a, ctx) & valid_array(b, ctx)
+            return TCol(out, valid, T.BOOLEAN)
         if a.is_scalar and b.is_scalar:
             if not valid:
                 return TCol.scalar(None, T.BOOLEAN)
+            if DM.compare_involved(a.dtype, b.dtype):
+                x = DM._scalar_unscaled(a) * 10 ** max(
+                    0, _dscale(b.dtype) - _dscale(a.dtype))
+                y = DM._scalar_unscaled(b) * 10 ** max(
+                    0, _dscale(a.dtype) - _dscale(b.dtype))
+                order = (x > y) - (x < y)
+                return TCol.scalar(bool(self._cmp(np.asarray(order),
+                                                  np.asarray(0), np)[()]),
+                                   T.BOOLEAN)
             return TCol.scalar(bool(self._cmp(np.asarray(a.data),
                                               np.asarray(b.data), np)[()]),
                                T.BOOLEAN)
@@ -107,6 +137,19 @@ class BinaryComparison(BinaryExpr):
     def eval_cpu(self, ctx):
         with np.errstate(all="ignore"):
             return self._eval(ctx, np)
+
+
+def _dscale(dt) -> int:
+    return dt.scale if isinstance(dt, T.DecimalType) else 0
+
+
+def _decimal_side_to_double(c: TCol, ctx, xp) -> TCol:
+    from spark_rapids_tpu.expressions import decimal_math as DM
+    if c.is_scalar:
+        v = None if c.data is None else \
+            float(DM._scalar_unscaled(c)) / (10.0 ** c.dtype.scale)
+        return TCol.scalar(v, T.DOUBLE)
+    return DM.decimal_to_double(c, ctx, xp)
 
 
 def _densify_string(c: TCol, ctx: EvalContext, xp):

@@ -1,0 +1,265 @@
+"""Decimal arithmetic differential tests (reference: decimalExpressions +
+DecimalUtils JNI; integration_tests arithmetic_ops_test decimal cases)."""
+
+import decimal
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions import arithmetic as A
+from spark_rapids_tpu.expressions import decimal_math as DM
+from spark_rapids_tpu.expressions import predicates as P
+from spark_rapids_tpu.expressions.base import Alias, col, lit
+
+from tests.asserts import (assert_tpu_and_cpu_are_equal_collect, cpu_session,
+                           tpu_session)
+
+Dec = decimal.Decimal
+RNG = np.random.default_rng(33)
+N = 500
+
+
+def _dec_col(precision, scale, n=N, null_every=13):
+    out = []
+    digits = precision - 1
+    for i in range(n):
+        if i % null_every == 0:
+            out.append(None)
+        else:
+            # build wide unscaled values digit-block-wise (beyond int64)
+            v = 0
+            for _ in range(-(-digits // 18)):
+                v = v * 10 ** 18 + int(RNG.integers(0, 10 ** 18))
+            v %= 10 ** digits
+            if RNG.integers(0, 2):
+                v = -v
+            out.append(Dec(v).scaleb(-scale))
+    return out
+
+
+_DATA = {
+    "a": _dec_col(10, 2),
+    "b": _dec_col(10, 4, null_every=7),
+    "big": _dec_col(30, 6),
+    "k": RNG.integers(1, 100, N).astype(np.int64),
+}
+_SCHEMA = T.StructType([
+    T.StructField("a", T.DecimalType(10, 2)),
+    T.StructField("b", T.DecimalType(10, 4)),
+    T.StructField("big", T.DecimalType(30, 6)),
+    T.StructField("k", T.LONG),
+])
+
+
+def _df(s):
+    return s.create_dataframe(_DATA, schema=_SCHEMA, num_partitions=2)
+
+
+def test_result_types_match_spark_rules():
+    a, b = T.DecimalType(10, 2), T.DecimalType(10, 4)
+    assert DM.add_result_type(a, b) == T.DecimalType(13, 4)
+    assert DM.mul_result_type(a, b) == T.DecimalType(21, 6)
+    assert DM.div_result_type(a, b) == T.DecimalType(25, 13)
+    assert DM.rem_result_type(a, b) == T.DecimalType(10, 4)
+    big = T.DecimalType(38, 10)
+    # precision overflow adjusts scale, not correctness
+    assert DM.mul_result_type(big, big).precision == 38
+
+
+def test_decimal_add_sub_differential():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            Alias(A.Add(col("a"), col("b")), "apb"),
+            Alias(A.Subtract(col("a"), col("b")), "amb"),
+            Alias(A.Add(col("a"), col("big")), "abig"),
+            Alias(A.Subtract(col("big"), col("big")), "zero")))
+
+
+def test_decimal_mul_differential():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            Alias(A.Multiply(col("a"), col("b")), "ab")))
+
+
+def test_decimal_div_rem_differential():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            Alias(A.Divide(col("a"), col("b")), "adivb"),
+            Alias(A.Remainder(col("a"), col("b")), "arem"),
+            Alias(A.Pmod(col("a"), col("b")), "apmod"),
+            Alias(A.IntegralDivide(col("a"), col("b")), "aidiv")),
+        conf={"spark.rapids.sql.test.enabled": "false"})
+
+
+def test_decimal_with_integer_operand():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            Alias(A.Add(col("a"), col("k")), "ak"),
+            Alias(A.Multiply(col("a"), lit(3)), "a3")))
+
+
+def test_decimal_unary_minus_abs():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            Alias(A.UnaryMinus(col("a")), "na"),
+            Alias(A.Abs(col("a")), "absa"),
+            Alias(A.UnaryMinus(col("big")), "nbig"),
+            Alias(A.Abs(col("big")), "absbig")))
+
+
+def test_decimal_comparisons_mixed_scales():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            Alias(P.LessThan(col("a"), col("b")), "altb"),
+            Alias(P.EqualTo(col("a"), col("a")), "aeqa"),
+            Alias(P.GreaterThan(col("big"), col("a")), "bgta")))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).filter(
+            P.GreaterThan(col("a"), lit(Dec("1.50")))))
+
+
+def test_decimal_exact_values():
+    """Known-answer checks (not just CPU-vs-TPU agreement)."""
+    data = {"x": [Dec("1.23"), Dec("-9.99"), None],
+            "y": [Dec("0.005"), Dec("2.500"), Dec("1.000")]}
+    schema = T.StructType([T.StructField("x", T.DecimalType(5, 2)),
+                           T.StructField("y", T.DecimalType(5, 3))])
+    s = cpu_session()
+    rows = (s.create_dataframe(data, schema=schema)
+            .select(Alias(A.Add(col("x"), col("y")), "add_"),
+                    Alias(A.Multiply(col("x"), col("y")), "mul_"),
+                    Alias(A.Divide(col("x"), col("y")), "div_"))
+            .collect())
+    assert rows[0]["add_"] == Dec("1.235")
+    assert rows[0]["mul_"] == Dec("0.00615")
+    # 1.23/0.005 = 246; div scale = max(6, 2+5+1) = 8
+    assert rows[0]["div_"] == Dec("246.00000000")
+    assert rows[1]["add_"] == Dec("-7.490")
+    assert rows[2]["add_"] is None
+
+
+def test_decimal_overflow_nulls():
+    """Non-ANSI Spark: decimal overflow -> null.  add(38,0)+(38,0) stays
+    (38,0) after precision adjustment, so 9.5e37 + 9.5e37 = 1.9e38
+    overflows the 38-digit bound while 1 + 1 stays exact."""
+    data = {"x": [Dec(95) * 10 ** 36, Dec("1")]}
+    schema = T.StructType([T.StructField("x", T.DecimalType(38, 0))])
+
+    def q(s):
+        return (s.create_dataframe(data, schema=schema)
+                .select(Alias(A.Add(col("x"), col("x")), "dbl")))
+    assert_tpu_and_cpu_are_equal_collect(q)
+    rows = q(cpu_session()).collect()
+    assert rows[0]["dbl"] is None
+    assert rows[1]["dbl"] == Dec("2")
+
+
+def test_decimal_mult_on_device_when_supported():
+    s = tpu_session()
+    df = _df(s).select(Alias(A.Multiply(col("a"), col("b")), "ab"))
+    ex = df.explain()
+    assert "TpuProject" in ex
+
+
+def test_decimal128_matmul_falls_back():
+    s = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+    df = _df(s).select(Alias(A.Multiply(col("big"), col("big")), "bb"))
+    assert "host tier" in df.explain()
+    # still correct via CPU
+    rows = df.collect()
+    assert len(rows) == N
+
+
+def test_decimal_to_double_promotion():
+    data = {"x": [Dec("1.25"), None], "f": [2.0, 3.0]}
+    schema = T.StructType([T.StructField("x", T.DecimalType(5, 2)),
+                           T.StructField("f", T.DOUBLE)])
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(data, schema=schema)
+        .select(Alias(A.Add(col("x"), col("f")), "xf")),
+        approx_float=True)
+
+
+def test_decimal_sum_avg_groupby():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s)
+        .with_column("g", A.Pmod(col("k"), lit(5)))
+        .group_by("g")
+        .agg(Alias(__import__("spark_rapids_tpu.expressions.aggregates",
+                              fromlist=["Sum"]).Sum(col("a")), "sa")),
+        ignore_order=True,
+        conf={"spark.rapids.sql.test.enabled": "false"})
+
+
+# -- code-review regression cases -------------------------------------------
+
+def test_device_multiply_large_limbs_exact():
+    """32x32 limb products near 2^64 must not wrap (16-bit split)."""
+    x = Dec(4294967295)       # 2^32 - 1
+    data = {"p": [x, Dec(3037000499)], "q": [x, Dec(3037000499)]}
+    schema = T.StructType([T.StructField("p", T.DecimalType(18, 0)),
+                           T.StructField("q", T.DecimalType(18, 0))])
+
+    def qy(s):
+        return s.create_dataframe(data, schema=schema).select(
+            Alias(A.Multiply(col("p"), col("q")), "pq"))
+    assert_tpu_and_cpu_are_equal_collect(qy)
+    rows = qy(cpu_session()).collect()
+    assert rows[0]["pq"] == Dec(4294967295) * Dec(4294967295)
+    assert rows[1]["pq"] == Dec(3037000499) * Dec(3037000499)
+
+
+def test_add_with_scale_reduction_rounds_half_up():
+    """(38,10)+(38,10) -> (38,9): exact sum then HALF_UP round."""
+    data = {"p": [Dec("1.0000000005"), Dec("2.0000000004")]}
+    schema = T.StructType([T.StructField("p", T.DecimalType(38, 10))])
+
+    def qy(s):
+        return s.create_dataframe(data, schema=schema).select(
+            Alias(A.Add(col("p"), lit(Dec(0), T.DecimalType(38, 10))), "r"))
+    assert_tpu_and_cpu_are_equal_collect(qy)
+    rows = qy(cpu_session()).collect()
+    assert rows[0]["r"] == Dec("1.000000001")   # .0000000005 rounds up
+    assert rows[1]["r"] == Dec("2.000000000")
+
+
+def test_decimal_vs_double_comparison_promotes():
+    data = {"d": [Dec("1.50"), Dec("0.25")]}
+    schema = T.StructType([T.StructField("d", T.DecimalType(5, 2))])
+
+    def qy(s):
+        return s.create_dataframe(data, schema=schema).select(
+            Alias(P.GreaterThan(col("d"), lit(1.0)), "gt1"),
+            Alias(P.LessThan(col("d"), lit(0.5)), "lt05"))
+    assert_tpu_and_cpu_are_equal_collect(qy)
+    rows = qy(cpu_session()).collect()
+    assert [r["gt1"] for r in rows] == [True, False]
+    assert [r["lt05"] for r in rows] == [False, True]
+
+
+def test_decimal_vs_string_clean_error():
+    data = {"d": [Dec("1.50")]}
+    schema = T.StructType([T.StructField("d", T.DecimalType(5, 2))])
+    s = cpu_session()
+    df = s.create_dataframe(data, schema=schema)
+    with pytest.raises(TypeError, match="cast"):
+        df.select(Alias(A.Add(col("d"), lit("x")), "bad")).collect()
+
+
+def test_string_array_host_ops():
+    """sort_array / array_min / array_max on array<string> (host tier)."""
+    from spark_rapids_tpu import functions as F
+    data = {"a": [["pear", None, "apple"], [], None]}
+    schema = T.StructType([T.StructField("a", T.ArrayType(T.STRING))])
+    s = cpu_session()
+    rows = (s.create_dataframe(data, schema=schema)
+            .select(Alias(F.sort_array(col("a")), "sa"),
+                    Alias(F.sort_array(col("a"), asc=False), "sd"),
+                    Alias(F.array_min(col("a")), "mn"),
+                    Alias(F.array_max(col("a")), "mx")).collect())
+    assert rows[0]["sa"] == [None, "apple", "pear"]
+    assert rows[0]["sd"] == ["pear", "apple", None]
+    assert rows[0]["mn"] == "apple" and rows[0]["mx"] == "pear"
+    assert rows[1] == {"sa": [], "sd": [], "mn": None, "mx": None}
+    assert rows[2] == {"sa": None, "sd": None, "mn": None, "mx": None}
