@@ -1,0 +1,442 @@
+"""JSON and URL expressions.
+
+Reference: GpuGetJsonObject / GpuJsonToStructs / GpuStructsToJson /
+GpuJsonTuple (JNI ``JSONUtils``/``MapUtils``, SURVEY.md §2.16) and
+GpuParseUrl (JNI ``ParseURI``).
+
+TPU stance: byte-level JSON/URL parsing is TPU-hostile (irregular control
+flow, no fixed-width lanes), so these run on the host tier with honest
+fallback tagging — exactly the contract the reference applies to ops cuDF
+cannot run (SURVEY.md §7 hard-parts #4).  The expressions still exist as
+first-class components: they plan, tag, and execute through the same
+pipeline, just on the CPU engine.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import (EvalContext, Expression, TCol,
+                                               materialize, valid_array)
+
+
+class _HostStringExpr(Expression):
+    """Host-tier expression over string inputs."""
+
+    host_reason = "byte-level parsing is host tier on TPU"
+
+    def tpu_supported(self, conf):
+        return self.host_reason
+
+    def eval_tpu(self, ctx):
+        return self.eval_cpu(ctx)
+
+
+# ---------------------------------------------------------------------------
+# JSON path (reference: JSONUtils.getJsonObject; Spark JsonPathParser)
+# ---------------------------------------------------------------------------
+
+class _PathStep:
+    __slots__ = ("kind", "value")
+
+    def __init__(self, kind, value=None):
+        self.kind = kind       # "field" | "index" | "wild"
+        self.value = value
+
+
+def parse_json_path(path: str) -> Optional[List[_PathStep]]:
+    """Parses Spark's get_json_object path dialect: $, .name, ['name'],
+    [index], [*].  Returns None for an invalid path (Spark -> null)."""
+    if not path or not path.startswith("$"):
+        return None
+    steps: List[_PathStep] = []
+    i = 1
+    n = len(path)
+    while i < n:
+        c = path[i]
+        if c == ".":
+            j = i + 1
+            # unquoted field name: up to next '.' or '['
+            k = j
+            while k < n and path[k] not in ".[":
+                k += 1
+            if k == j:
+                return None
+            steps.append(_PathStep("field", path[j:k]))
+            i = k
+        elif c == "[":
+            j = path.find("]", i)
+            if j < 0:
+                return None
+            token = path[i + 1:j].strip()
+            if token == "*":
+                steps.append(_PathStep("wild"))
+            elif token[:1] in ("'", '"') and token[-1:] == token[:1]:
+                steps.append(_PathStep("field", token[1:-1]))
+            else:
+                try:
+                    steps.append(_PathStep("index", int(token)))
+                except ValueError:
+                    return None
+            i = j + 1
+        else:
+            return None
+    return steps
+
+
+def _walk(value, steps: List[_PathStep], idx: int):
+    """Returns list of matches (wildcards can fan out)."""
+    if idx == len(steps):
+        return [value]
+    step = steps[idx]
+    if step.kind == "field":
+        if isinstance(value, dict) and step.value in value:
+            return _walk(value[step.value], steps, idx + 1)
+        return []
+    if step.kind == "index":
+        if isinstance(value, list) and 0 <= step.value < len(value):
+            return _walk(value[step.value], steps, idx + 1)
+        return []
+    # wildcard
+    if isinstance(value, list):
+        out = []
+        for v in value:
+            out.extend(_walk(v, steps, idx + 1))
+        return out
+    return []
+
+
+def _render(matches, had_wildcard: bool) -> Optional[str]:
+    """Spark rendering: scalars unquoted; objects/arrays as JSON; multiple
+    wildcard matches wrapped in a JSON array."""
+    if not matches:
+        return None
+    if len(matches) == 1 and not had_wildcard:
+        v = matches[0]
+        if v is None:
+            return None
+        if isinstance(v, str):
+            return v
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if isinstance(v, (int, float)):
+            return json.dumps(v)
+        return json.dumps(v, separators=(",", ":"))
+    if len(matches) == 1:
+        v = matches[0]
+        return json.dumps(v, separators=(",", ":")) \
+            if not isinstance(v, str) else v
+    return json.dumps(matches, separators=(",", ":"))
+
+
+class GetJsonObject(_HostStringExpr):
+    """get_json_object(json, path) (reference GpuGetJsonObject)."""
+
+    def __init__(self, child, path):
+        super().__init__([child, path])
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def eval_cpu(self, ctx):
+        from spark_rapids_tpu.expressions.base import Literal
+        c = self.children[0].eval(ctx)
+        data = materialize(c, ctx, np.dtype(object))
+        valid = valid_array(c, ctx)
+        p = self.children[1]
+        if isinstance(p, Literal):
+            paths = [p.value] * ctx.row_count
+            pvalid = np.full(ctx.row_count, p.value is not None)
+        else:
+            ptc = p.eval(ctx)
+            paths = materialize(ptc, ctx, np.dtype(object))
+            pvalid = valid_array(ptc, ctx)
+        out = np.empty(ctx.row_count, dtype=object)
+        ok = np.zeros(ctx.row_count, dtype=bool)
+        path_cache = {}
+        for i in range(ctx.row_count):
+            out[i] = None
+            if not (valid[i] and pvalid[i]) or data[i] is None \
+                    or paths[i] is None:
+                continue
+            pth = paths[i]
+            if pth not in path_cache:
+                path_cache[pth] = parse_json_path(pth)
+            steps = path_cache[pth]
+            if steps is None:
+                continue
+            try:
+                doc = json.loads(data[i])
+            except (ValueError, TypeError):
+                continue
+            wild = any(s.kind == "wild" for s in steps)
+            r = _render(_walk(doc, steps, 0), wild)
+            out[i] = r
+            ok[i] = r is not None
+        return TCol(out, ok, T.STRING)
+
+
+class JsonTuple(_HostStringExpr):
+    """json_tuple(json, f1, ..., fn) -> struct of n string fields
+    (reference GpuJsonTuple; Spark's generator form is a projection of
+    this struct)."""
+
+    def __init__(self, child, *fields: str):
+        super().__init__([child])
+        if not fields:
+            raise ValueError("json_tuple needs at least one field")
+        self.fields = list(fields)
+
+    @property
+    def data_type(self):
+        return T.StructType([T.StructField(f, T.STRING) for f in self.fields])
+
+    def eval_cpu(self, ctx):
+        c = self.children[0].eval(ctx)
+        data = materialize(c, ctx, np.dtype(object))
+        valid = valid_array(c, ctx)
+        out = np.empty(ctx.row_count, dtype=object)
+        for i in range(ctx.row_count):
+            row = {f: None for f in self.fields}
+            if valid[i] and data[i] is not None:
+                try:
+                    doc = json.loads(data[i])
+                    if isinstance(doc, dict):
+                        for f in self.fields:
+                            v = doc.get(f)
+                            if v is not None:
+                                row[f] = v if isinstance(v, str) else \
+                                    json.dumps(v, separators=(",", ":"))
+                except (ValueError, TypeError):
+                    pass
+            out[i] = row
+        return TCol(out, np.ones(ctx.row_count, dtype=bool), self.data_type)
+
+
+class JsonToStructs(_HostStringExpr):
+    """from_json(json, schema) (reference GpuJsonToStructs via JSONUtils).
+    Malformed rows -> null (PERMISSIVE-lite)."""
+
+    def __init__(self, child, schema: T.DataType):
+        super().__init__([child])
+        if not isinstance(schema, (T.StructType, T.ArrayType, T.MapType)):
+            raise TypeError("from_json needs a struct/array/map schema")
+        self._schema = schema
+
+    @property
+    def data_type(self):
+        return self._schema
+
+    def eval_cpu(self, ctx):
+        c = self.children[0].eval(ctx)
+        data = materialize(c, ctx, np.dtype(object))
+        valid = valid_array(c, ctx)
+        out = np.empty(ctx.row_count, dtype=object)
+        ok = np.zeros(ctx.row_count, dtype=bool)
+        for i in range(ctx.row_count):
+            out[i] = None
+            if not valid[i] or data[i] is None:
+                continue
+            try:
+                doc = json.loads(data[i])
+            except (ValueError, TypeError):
+                continue
+            v = _coerce_json(doc, self._schema)
+            if v is not None:
+                out[i] = v
+                ok[i] = True
+        return TCol(out, ok, self._schema)
+
+
+def _coerce_json(v, dt: T.DataType):
+    """Coerces a parsed JSON value to the target type; None on mismatch."""
+    if v is None:
+        return None
+    if isinstance(dt, T.StructType):
+        if not isinstance(v, dict):
+            return None
+        return {f.name: _coerce_json(v.get(f.name), f.data_type)
+                for f in dt.fields}
+    if isinstance(dt, T.ArrayType):
+        if not isinstance(v, list):
+            return None
+        return [_coerce_json(x, dt.element_type) for x in v]
+    if isinstance(dt, T.MapType):
+        if not isinstance(v, dict):
+            return None
+        return [(k, _coerce_json(x, dt.value_type)) for k, x in v.items()]
+    if isinstance(dt, T.StringType):
+        return v if isinstance(v, str) else \
+            json.dumps(v, separators=(",", ":"))
+    if isinstance(dt, T.BooleanType):
+        return v if isinstance(v, bool) else None
+    if isinstance(dt, (T.DoubleType, T.FloatType)):
+        return float(v) if isinstance(v, (int, float)) \
+            and not isinstance(v, bool) else None
+    if dt.is_integral:
+        if isinstance(v, bool) or not isinstance(v, int):
+            return None
+        return v
+    if isinstance(dt, T.DecimalType):
+        import decimal
+        if isinstance(v, bool) or not isinstance(v, (int, float, str)):
+            return None
+        try:
+            return decimal.Decimal(str(v)).quantize(
+                decimal.Decimal(1).scaleb(-dt.scale))
+        except decimal.InvalidOperation:
+            return None
+    return None
+
+
+class StructsToJson(_HostStringExpr):
+    """to_json(struct/array/map) (reference GpuStructsToJson)."""
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def eval_cpu(self, ctx):
+        c = self.children[0].eval(ctx)
+        if not c.dtype.is_nested:
+            raise TypeError(f"to_json needs a nested input, got "
+                            f"{c.dtype.simple_name}")
+        valid = valid_array(c, ctx)
+        out = np.empty(ctx.row_count, dtype=object)
+        ok = np.zeros(ctx.row_count, dtype=bool)
+        for i in range(ctx.row_count):
+            out[i] = None
+            if not valid[i] or (not c.is_scalar and c.data[i] is None):
+                continue
+            v = c.data if c.is_scalar else c.data[i]
+            out[i] = json.dumps(_jsonable(v, c.dtype),
+                                separators=(",", ":"), default=str)
+            ok[i] = True
+        return TCol(out, ok, T.STRING)
+
+
+def _jsonable(v, dt: T.DataType):
+    import datetime
+    import decimal
+    if v is None:
+        return None
+    if isinstance(dt, T.StructType):
+        return {f.name: _jsonable(v.get(f.name), f.data_type)
+                for f in dt.fields}
+    if isinstance(dt, T.ArrayType):
+        return [_jsonable(x, dt.element_type) for x in v]
+    if isinstance(dt, T.MapType):
+        entries = v.items() if isinstance(v, dict) else v
+        return {str(k): _jsonable(x, dt.value_type) for k, x in entries}
+    if isinstance(v, decimal.Decimal):
+        return float(v)
+    if isinstance(v, (datetime.date, datetime.datetime)):
+        return str(v)
+    if hasattr(v, "item"):
+        return v.item()
+    return v
+
+
+# ---------------------------------------------------------------------------
+# parse_url (reference: GpuParseUrl via JNI ParseURI)
+# ---------------------------------------------------------------------------
+
+_URL_PARTS = {"HOST", "PATH", "QUERY", "REF", "PROTOCOL", "FILE",
+              "AUTHORITY", "USERINFO"}
+
+
+class ParseUrl(_HostStringExpr):
+    """parse_url(url, part [, key]) — Spark semantics (java.net.URI-style
+    extraction; QUERY with key returns that parameter's value)."""
+
+    def __init__(self, url, part, key=None):
+        children = [url, part] + ([key] if key is not None else [])
+        super().__init__(children)
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def eval_cpu(self, ctx):
+        c = self.children[0].eval(ctx)
+        data = materialize(c, ctx, np.dtype(object))
+        valid = valid_array(c, ctx)
+        part_tc = self.children[1].eval(ctx)
+        parts = materialize(part_tc, ctx, np.dtype(object))
+        pvalid = valid_array(part_tc, ctx)
+        if len(self.children) > 2:
+            key_tc = self.children[2].eval(ctx)
+            keys = materialize(key_tc, ctx, np.dtype(object))
+            kvalid = valid_array(key_tc, ctx)
+        else:
+            keys = [None] * ctx.row_count
+            kvalid = np.ones(ctx.row_count, dtype=bool)
+        out = np.empty(ctx.row_count, dtype=object)
+        ok = np.zeros(ctx.row_count, dtype=bool)
+        for i in range(ctx.row_count):
+            out[i] = None
+            if not (valid[i] and pvalid[i] and kvalid[i]) \
+                    or data[i] is None or parts[i] is None:
+                continue
+            r = _parse_url_one(data[i], parts[i], keys[i])
+            out[i] = r
+            ok[i] = r is not None
+        return TCol(out, ok, T.STRING)
+
+
+def _parse_url_one(url: str, part: str, key: Optional[str]) -> Optional[str]:
+    from urllib.parse import urlsplit
+    if part not in _URL_PARTS:
+        return None
+    try:
+        sp = urlsplit(url)
+    except ValueError:
+        return None
+    if not sp.scheme:
+        return None   # Spark returns null for non-absolute URIs
+    if part == "PROTOCOL":
+        return sp.scheme or None
+    if part == "HOST":
+        return sp.hostname
+    if part == "PATH":
+        return sp.path
+    if part == "QUERY":
+        q = sp.query or None
+        if q is None:
+            return None
+        if key is None:
+            return q
+        # Spark matches the raw key=value pair via regex, no decoding
+        for pair in q.split("&"):
+            if pair.startswith(key + "="):
+                return pair[len(key) + 1:]
+        return None
+    if part == "REF":
+        return sp.fragment or None
+    if part == "FILE":
+        return sp.path + ("?" + sp.query if sp.query else "")
+    if part == "AUTHORITY":
+        return sp.netloc or None
+    if part == "USERINFO":
+        if "@" in sp.netloc:
+            return sp.netloc.rsplit("@", 1)[0]
+        return None
+    return None
+
+
+# plan-rewrite registrations (host tier: exist in the registry so tagging
+# reports "host tier" instead of "no TPU implementation")
+from spark_rapids_tpu.plan import typechecks as TS  # noqa: E402
+from spark_rapids_tpu.plan.overrides import register_expr  # noqa: E402
+
+for _cls in (GetJsonObject, JsonTuple, JsonToStructs, StructsToJson,
+             ParseUrl):
+    register_expr(_cls, TS.BASIC_WITH_ARRAYS)

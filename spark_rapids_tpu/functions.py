@@ -361,3 +361,36 @@ def map_values(e):
 def get_struct_field(e, name: str):
     from spark_rapids_tpu.expressions.collections import GetStructField
     return GetStructField(_expr(e), name)
+
+
+# -- JSON / URL functions (reference: JSONUtils + ParseURI JNI kernels) ------
+
+def get_json_object(e, path: str):
+    from spark_rapids_tpu.expressions.base import lit
+    from spark_rapids_tpu.expressions.json_exprs import GetJsonObject
+    return GetJsonObject(_expr(e), path if isinstance(path, Expression)
+                         else lit(path))
+
+
+def json_tuple(e, *fields: str):
+    from spark_rapids_tpu.expressions.json_exprs import JsonTuple
+    return JsonTuple(_expr(e), *fields)
+
+
+def from_json(e, schema):
+    from spark_rapids_tpu.expressions.json_exprs import JsonToStructs
+    return JsonToStructs(_expr(e), schema)
+
+
+def to_json(e):
+    from spark_rapids_tpu.expressions.json_exprs import StructsToJson
+    return StructsToJson(_expr(e))
+
+
+def parse_url(e, part: str, key=None):
+    from spark_rapids_tpu.expressions.base import lit
+    from spark_rapids_tpu.expressions.json_exprs import ParseUrl
+    part = part if isinstance(part, Expression) else lit(part)
+    if key is not None and not isinstance(key, Expression):
+        key = lit(key)
+    return ParseUrl(_expr(e), part, key)

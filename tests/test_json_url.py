@@ -1,0 +1,134 @@
+"""JSON expression + parse_url tests (reference: get_json_object_test.py,
+json_test.py, url_test.py in integration_tests)."""
+
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import Alias, col, lit
+
+from tests.asserts import cpu_session, tpu_session
+
+_JSONS = [
+    '{"a": 1, "b": {"c": "x"}, "arr": [10, 20, {"d": true}]}',
+    '{"a": "s", "arr": []}',
+    'not json',
+    None,
+    '{"b": {"c": null}}',
+]
+
+
+def _df(s):
+    return s.create_dataframe({"j": _JSONS})
+
+
+def _both(q):
+    """Runs on the CPU session and the TPU session (host-tier fallback)
+    and asserts identical results."""
+    r1 = q(cpu_session()).collect()
+    r2 = q(tpu_session({"spark.rapids.sql.test.enabled": "false"})).collect()
+    assert r1 == r2
+    return r1
+
+
+def test_get_json_object_basics():
+    rows = _both(lambda s: _df(s).select(
+        Alias(F.get_json_object(col("j"), "$.a"), "a"),
+        Alias(F.get_json_object(col("j"), "$.b.c"), "bc"),
+        Alias(F.get_json_object(col("j"), "$.arr[1]"), "a1"),
+        Alias(F.get_json_object(col("j"), "$.arr[2].d"), "d"),
+        Alias(F.get_json_object(col("j"), "$.b"), "b")))
+    assert rows[0] == {"a": "1", "bc": "x", "a1": "20", "d": "true",
+                      "b": '{"c":"x"}'}
+    assert rows[1]["a"] == "s" and rows[1]["a1"] is None
+    assert rows[2] == {k: None for k in rows[2]}   # invalid json -> null
+    assert rows[3] == {k: None for k in rows[3]}   # null input
+    assert rows[4]["bc"] is None                   # json null -> null
+
+
+def test_get_json_object_wildcard_and_quoted():
+    rows = _both(lambda s: _df(s).select(
+        Alias(F.get_json_object(col("j"), "$.arr[*]"), "w"),
+        Alias(F.get_json_object(col("j"), "$['a']"), "qa")))
+    assert rows[0]["w"] == '[10,20,{"d":true}]'
+    assert rows[0]["qa"] == "1"
+    # bad path -> null everywhere
+    rows = _both(lambda s: _df(s).select(
+        Alias(F.get_json_object(col("j"), "a.b"), "bad")))
+    assert all(r["bad"] is None for r in rows)
+
+
+def test_json_tuple():
+    rows = _both(lambda s: _df(s)
+                 .select(Alias(F.json_tuple(col("j"), "a", "b"), "t"))
+                 .select(Alias(F.get_struct_field(col("t"), "a"), "a"),
+                         Alias(F.get_struct_field(col("t"), "b"), "b")))
+    assert rows[0] == {"a": "1", "b": '{"c":"x"}'}
+    assert rows[2] == {"a": None, "b": None}
+
+
+def test_from_json_to_json_roundtrip():
+    schema = T.StructType([
+        T.StructField("a", T.STRING),
+        T.StructField("b", T.StructType([T.StructField("c", T.STRING)])),
+    ])
+    rows = _both(lambda s: _df(s)
+                 .select(Alias(F.from_json(col("j"), schema), "st"))
+                 .select(Alias(F.to_json(col("st")), "js"),
+                         Alias(F.get_struct_field(col("st"), "a"), "a")))
+    assert rows[0]["a"] == "1"          # numeric coerced to string field
+    assert '"c":"x"' in rows[0]["js"]
+    assert rows[2]["js"] is None        # malformed -> null struct
+
+
+def test_from_json_array_schema():
+    s = cpu_session()
+    df = s.create_dataframe({"j": ['[1, 2, 3]', '{"no": 1}', None]})
+    rows = df.select(
+        Alias(F.from_json(col("j"), T.ArrayType(T.LONG)), "arr")).collect()
+    assert rows[0]["arr"] == [1, 2, 3]
+    assert rows[1]["arr"] is None
+    assert rows[2]["arr"] is None
+
+
+_URLS = [
+    "https://user:pw@example.com:8443/a/b?x=1&y=2#frag",
+    "ftp://files.example.org/pub",
+    "not a url",
+    None,
+]
+
+
+def test_parse_url_parts():
+    def q(s):
+        df = s.create_dataframe({"u": _URLS})
+        return df.select(
+            Alias(F.parse_url(col("u"), "HOST"), "host"),
+            Alias(F.parse_url(col("u"), "PROTOCOL"), "proto"),
+            Alias(F.parse_url(col("u"), "PATH"), "path"),
+            Alias(F.parse_url(col("u"), "QUERY"), "query"),
+            Alias(F.parse_url(col("u"), "REF"), "ref"),
+            Alias(F.parse_url(col("u"), "FILE"), "file"),
+            Alias(F.parse_url(col("u"), "AUTHORITY"), "auth"),
+            Alias(F.parse_url(col("u"), "USERINFO"), "user"))
+    rows = _both(q)
+    assert rows[0] == {
+        "host": "example.com", "proto": "https", "path": "/a/b",
+        "query": "x=1&y=2", "ref": "frag", "file": "/a/b?x=1&y=2",
+        "auth": "user:pw@example.com:8443", "user": "user:pw"}
+    assert rows[1]["host"] == "files.example.org"
+    assert rows[1]["query"] is None
+    assert rows[3]["host"] is None
+
+
+def test_parse_url_query_key():
+    rows = _both(lambda s: s.create_dataframe({"u": _URLS}).select(
+        Alias(F.parse_url(col("u"), "QUERY", "y"), "y"),
+        Alias(F.parse_url(col("u"), "QUERY", "zz"), "zz")))
+    assert rows[0] == {"y": "2", "zz": None}
+
+
+def test_json_exprs_tagged_host_tier():
+    s = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+    df = _df(s).select(Alias(F.get_json_object(col("j"), "$.a"), "a"))
+    assert "host tier" in df.explain()
