@@ -233,9 +233,11 @@ SPILL_TO_DISK_DIR = conf_str(
 
 SHUFFLE_MANAGER_MODE = conf_str(
     "spark.rapids.shuffle.mode",
-    "Shuffle mode: CACHE_ONLY | MULTITHREADED | ICI "
+    "Shuffle mode: DEFAULT (in-memory host store) | MULTITHREADED "
+    "(pooled writer/reader over spill files) | CACHED (alias CACHE_ONLY: "
+    "buffer catalog + client/server transport) "
     "(reference RapidsShuffleManagerMode UCX|CACHE_ONLY|MULTITHREADED).",
-    "MULTITHREADED")
+    "DEFAULT")
 
 SHUFFLE_WRITER_THREADS = conf_int(
     "spark.rapids.shuffle.multiThreaded.writer.threads",
@@ -249,8 +251,8 @@ SHUFFLE_READER_THREADS = conf_int(
 
 SHUFFLE_COMPRESSION_CODEC = conf_str(
     "spark.rapids.shuffle.compression.codec",
-    "Codec for shuffle payloads: none | lz4 | zstd (reference nvcomp "
-    "LZ4/ZSTD; here host-side codecs from libtpucol / python-zstandard).",
+    "Codec for shuffle payloads: none | lz4 | zlib (reference nvcomp "
+    "LZ4/ZSTD; here the libtpucol LZ4 block codec or zlib).",
     "lz4")
 
 SHUFFLE_PARTITIONS = conf_int(

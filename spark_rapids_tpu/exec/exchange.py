@@ -54,13 +54,38 @@ def _sample_bounds(part: RangePartitioning, sample_rows, to_host_batch):
     return cc(rows) if rows else HostColumnarBatch([], 0, [])
 
 
+class _LazyPartitions:
+    """Reduce-side view over mode-specific storage: partitions fetch on
+    first access (the reduce task's fetch) and cache for re-execution."""
+
+    def __init__(self, n: int, fetch):
+        self._n = n
+        self._fetch = fetch
+        self._cache: Dict[int, List] = {}
+
+    def __getitem__(self, pidx: int):
+        if pidx not in self._cache:
+            self._cache[pidx] = self._fetch(pidx)
+        return self._cache[pidx]
+
+    def __len__(self):
+        return self._n
+
+
 class CpuShuffleExchangeExec(UnaryExec):
     """Host shuffle: materializes the map side once into a store of host
-    batches grouped by reduce partition."""
+    batches grouped by reduce partition.  The storage/fetch path is chosen
+    by ``spark.rapids.shuffle.mode`` (GpuShuffleEnv analog): DEFAULT
+    in-memory store, MULTITHREADED spill-file writer/reader pools, CACHED
+    catalog + client/server transport."""
 
-    def __init__(self, partitioning: Partitioning, child: Exec):
+    def __init__(self, partitioning: Partitioning, child: Exec,
+                 shuffle_env=None):
         super().__init__(child)
         self.partitioning = partitioning
+        #: the owning session's ShuffleEnv; None falls back to the
+        #: process-wide env (standalone plan construction)
+        self.shuffle_env = shuffle_env
         self._store: Optional[List[List]] = None
 
     @property
@@ -68,21 +93,90 @@ class CpuShuffleExchangeExec(UnaryExec):
         return self.partitioning.num_partitions
 
     # -- map side -----------------------------------------------------------
+    def _split_pairs(self, hb: HostColumnarBatch, pids: np.ndarray, n: int):
+        """Splits one batch into (reduce_partition, sub_batch) pairs."""
+        import pyarrow as pa
+        from spark_rapids_tpu.columnar.batch import batch_from_arrow
+        order = np.argsort(pids, kind="stable")
+        counts = np.bincount(pids, minlength=n)
+        tab = pa.Table.from_batches([hb.to_arrow()]).take(pa.array(order))
+        off = 0
+        out = []
+        for p in range(n):
+            if counts[p]:
+                out.append((p, batch_from_arrow(tab.slice(off, counts[p]))))
+            off += counts[p]
+        return out
+
+    def _map_pairs(self, mp: int, n: int):
+        part = self.partitioning
+        if isinstance(part, RoundRobinPartitioning):
+            part = RoundRobinPartitioning(n, start=mp)
+        for hb in self.child.execute_partition(mp):
+            pids = part.partition_ids_cpu(hb)
+            yield from self._split_pairs(hb, pids, n)
+
     def _materialize(self):
         if self._store is not None:
             return
         part = self.partitioning
         n = part.num_partitions
-        store: List[List] = [[] for _ in range(n)]
         if isinstance(part, RangePartitioning) and part.bounds is None:
             self._compute_bounds()
+        from spark_rapids_tpu.shuffle.env import get_shuffle_env
+        env = self.shuffle_env or get_shuffle_env()
+        mode = env.mode if env is not None else "DEFAULT"
+        if mode == "MULTITHREADED":
+            self._store = self._materialize_multithreaded(env, n)
+            return
+        if mode == "CACHED":
+            self._store = self._materialize_cached(env, n)
+            return
+        store: List[List] = [[] for _ in range(n)]
         for mp in range(self.child.num_partitions):
-            if isinstance(part, RoundRobinPartitioning):
-                part = RoundRobinPartitioning(n, start=mp)
-            for hb in self.child.execute_partition(mp):
-                pids = part.partition_ids_cpu(hb)
-                self._split_host(hb, pids, store)
+            for p, sub in self._map_pairs(mp, n):
+                store[p].append(sub)
         self._store = store
+
+    def _materialize_multithreaded(self, env, n: int):
+        """MULTITHREADED mode (reference RapidsShuffleThreadedWriterBase):
+        pool-parallel serialization into per-map spill files, read back
+        per reduce partition on the reader pool."""
+        from spark_rapids_tpu.shuffle.threaded import (ThreadedShuffleReader,
+                                                       ThreadedShuffleWriter)
+        sid = env.next_shuffle_id()
+        outputs = []
+        for mp in range(self.child.num_partitions):
+            writer = ThreadedShuffleWriter(sid, mp, n, env.writer_pool,
+                                           directory=env.shuffle_dir,
+                                           codec=env.codec)
+            outputs.append(writer.write(list(self._map_pairs(mp, n))))
+        reader = ThreadedShuffleReader(env.reader_pool)
+        return _LazyPartitions(
+            n, lambda pidx: list(reader.read(outputs, pidx)))
+
+    def _materialize_cached(self, env, n: int):
+        """CACHED mode (reference UCX shuffle): map output registered in
+        the ShuffleBufferCatalog, reduce side fetches through the
+        client/server state machines over the transport."""
+        from spark_rapids_tpu.shuffle.catalog import ShuffleBlockId
+        catalog, client, server = env.cached_machinery()
+        sid = env.next_shuffle_id()
+        for mp in range(self.child.num_partitions):
+            for p, sub in self._map_pairs(mp, n):
+                catalog.add_batch(ShuffleBlockId(sid, mp, p), sub)
+
+        def fetch(pidx):
+            blocks = client.do_fetch(server, sid, pidx)
+            out = []
+            for b in blocks:
+                out.extend(client.received.read_batches(b))
+                client.received.drop(b)
+            # the fetched partition is cached by _LazyPartitions; release
+            # the map-side frames (reference: unregisterShuffle on consume)
+            catalog.drop_partition(sid, pidx)
+            return out
+        return _LazyPartitions(n, fetch)
 
     def _compute_bounds(self):
         """Extra pass sampling key rows (the reference runs a sample job)."""
@@ -104,19 +198,6 @@ class CpuShuffleExchangeExec(UnaryExec):
                 samples.append(batch_from_arrow(tab))
         part.bounds = _sample_bounds(part, samples, None)
 
-    @staticmethod
-    def _split_host(hb: HostColumnarBatch, pids: np.ndarray, store):
-        import pyarrow as pa
-        from spark_rapids_tpu.columnar.batch import batch_from_arrow
-        order = np.argsort(pids, kind="stable")
-        counts = np.bincount(pids, minlength=len(store))
-        tab = pa.Table.from_batches([hb.to_arrow()]).take(pa.array(order))
-        off = 0
-        for p in range(len(store)):
-            if counts[p]:
-                store[p].append(batch_from_arrow(tab.slice(off, counts[p])))
-            off += counts[p]
-
     # -- reduce side --------------------------------------------------------
     def execute_partition(self, pidx):
         self._materialize()
@@ -133,40 +214,36 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
 
     is_device = True
 
-    def _materialize(self):
-        if self._store is not None:
-            return
-        from spark_rapids_tpu.columnar.column import _jnp
+    def _map_pairs(self, mp: int, n: int):
+        """Device shuffle write: pid eval + stable sort-by-pid on device,
+        ONE host copy, then arrow slicing per reduce partition."""
+        from spark_rapids_tpu.columnar.column import DeviceColumn, _jnp
         from spark_rapids_tpu.ops.batch_ops import gather_batch
         from spark_rapids_tpu.ops.sort_ops import SortOrder, sort_permutation
-        from spark_rapids_tpu.columnar.column import DeviceColumn
         jnp = _jnp()
         part = self.partitioning
-        n = part.num_partitions
-        if isinstance(part, RangePartitioning) and part.bounds is None:
-            self._compute_bounds_tpu()
-        store: List[List] = [[] for _ in range(n)]
-        for mp in range(self.child.num_partitions):
-            if isinstance(part, RoundRobinPartitioning):
-                part = RoundRobinPartitioning(n, start=mp)
-            for b in self.child.execute_partition(mp):
-                pids = part.partition_ids_tpu(b)
-                pid_col = DeviceColumn(pids.astype(np.int64),
-                                       jnp.ones(b.bucket, dtype=bool),
-                                       b.row_count, None)
-                aug = ColumnarBatch([pid_col] + list(b.columns), b.row_count)
-                perm = sort_permutation(aug, [SortOrder(0, True, True)])
-                shuffled = gather_batch(b, perm, b.row_count)
-                counts = np.asarray(jnp.bincount(
-                    jnp.clip(pids, 0, n), length=n + 1))[:n]
-                hb = shuffled.to_host()
-                hb.names = b.names
-                off = 0
-                for p in range(n):
-                    if counts[p]:
-                        store[p].append(hb.slice(off, int(counts[p])))
-                    off += int(counts[p])
-        self._store = store
+        if isinstance(part, RoundRobinPartitioning):
+            part = RoundRobinPartitioning(n, start=mp)
+        for b in self.child.execute_partition(mp):
+            pids = part.partition_ids_tpu(b)
+            pid_col = DeviceColumn(pids.astype(np.int64),
+                                   jnp.ones(b.bucket, dtype=bool),
+                                   b.row_count, None)
+            aug = ColumnarBatch([pid_col] + list(b.columns), b.row_count)
+            perm = sort_permutation(aug, [SortOrder(0, True, True)])
+            shuffled = gather_batch(b, perm, b.row_count)
+            counts = np.asarray(jnp.bincount(
+                jnp.clip(pids, 0, n), length=n + 1))[:n]
+            hb = shuffled.to_host()
+            hb.names = b.names
+            off = 0
+            for p in range(n):
+                if counts[p]:
+                    yield p, hb.slice(off, int(counts[p]))
+                off += int(counts[p])
+
+    def _compute_bounds(self):
+        self._compute_bounds_tpu()
 
     def _compute_bounds_tpu(self):
         """Samples on device, computes bounds on host (small)."""

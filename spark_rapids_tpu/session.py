@@ -39,6 +39,8 @@ class TpuSession:
             self.runtime = initialize(self.conf)
         else:
             self.runtime = None
+        from spark_rapids_tpu.shuffle.env import init_shuffle_env
+        self.shuffle_env = init_shuffle_env(self.conf)
         TpuSession._active = self
 
     # -- conf ---------------------------------------------------------------
@@ -139,6 +141,8 @@ class TpuSession:
     def stop(self):
         from spark_rapids_tpu.memory.device_manager import shutdown
         shutdown()
+        if self.shuffle_env is not None:
+            self.shuffle_env.shutdown()
         if TpuSession._active is self:
             TpuSession._active = None
 
@@ -203,7 +207,8 @@ class DataFrame:
                                             plan.num_partitions)
                 else:
                     part = SinglePartitioning()
-                plan = CpuShuffleExchangeExec(part, plan)
+                plan = CpuShuffleExchangeExec(
+                    part, plan, shuffle_env=self._session.shuffle_env)
             base = len(plan.schema.fields)
             cols = [(f"_w{base + i}", w) for i, w in enumerate(ws)]
             plan = CpuWindowExec(cols, plan)
@@ -317,8 +322,10 @@ class DataFrame:
             part = HashPartitioning(keys, n)
         else:
             part = RoundRobinPartitioning(n)
-        return DataFrame(CpuShuffleExchangeExec(part, self._plan),
-                         self._session)
+        return DataFrame(
+            CpuShuffleExchangeExec(part, self._plan,
+                                   shuffle_env=self._session.shuffle_env),
+            self._session)
 
     def coalesce(self, n: int) -> "DataFrame":
         """Shuffle-free partition merge (Spark coalesce contract)."""
@@ -351,7 +358,8 @@ class DataFrame:
         plan = self._plan
         if plan.num_partitions > 1:
             plan = CpuShuffleExchangeExec(
-                RangePartitioning(specs, plan.num_partitions), plan)
+                RangePartitioning(specs, plan.num_partitions), plan,
+                shuffle_env=self._session.shuffle_env)
         return DataFrame(CpuSortExec(specs, plan, global_sort=True),
                          self._session)
 
@@ -405,10 +413,11 @@ class DataFrame:
         else:
             nparts = max(lplan.num_partitions, rplan.num_partitions)
             if nparts > 1:
+                env = self._session.shuffle_env
                 lplan = CpuShuffleExchangeExec(
-                    HashPartitioning(lkeys, nparts), lplan)
+                    HashPartitioning(lkeys, nparts), lplan, shuffle_env=env)
                 rplan = CpuShuffleExchangeExec(
-                    HashPartitioning(rkeys, nparts), rplan)
+                    HashPartitioning(rkeys, nparts), rplan, shuffle_env=env)
                 # keys bind identically post-shuffle (same child schema)
             plan = CpuShuffledHashJoinExec(lkeys, rkeys, jt, cond, lplan,
                                            rplan, ns)
@@ -648,7 +657,8 @@ class GroupedData:
                 part = HashPartitioning(key_refs, child.num_partitions)
             else:
                 part = SinglePartitioning()
-            exchange = CpuShuffleExchangeExec(part, partial)
+            exchange = CpuShuffleExchangeExec(
+                part, partial, shuffle_env=self._df._session.shuffle_env)
             final_keys = [_bound_ref(i, partial.schema) for i in range(nk)]
             plan = CpuHashAggregateExec(final_keys, aggs, FINAL, exchange)
         return DataFrame(plan, self._df._session)
@@ -671,7 +681,8 @@ class GroupedData:
             key_refs = [_bound_ref(i, partial.schema)
                         for i in range(len(new_keys))]
             exchange = CpuShuffleExchangeExec(
-                HashPartitioning(key_refs, expand.num_partitions), partial)
+                HashPartitioning(key_refs, expand.num_partitions), partial,
+                shuffle_env=self._df._session.shuffle_env)
             final_keys = [_bound_ref(i, partial.schema)
                           for i in range(len(new_keys))]
             plan = CpuHashAggregateExec(final_keys, aggs, FINAL, exchange)

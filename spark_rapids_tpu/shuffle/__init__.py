@@ -1,0 +1,25 @@
+"""Accelerated shuffle subsystem.
+
+Reference: SURVEY.md §2.8 — the UCX peer-to-peer shuffle stack
+(`com/nvidia/spark/rapids/shuffle/`): `RapidsShuffleTransport` SPI,
+client/server state machines with bounce buffers, flatbuffers control
+protocol, `ShuffleBufferCatalog`/`ShuffleReceivedBufferCatalog`, the
+driver-side `RapidsShuffleHeartbeatManager`, and the MULTITHREADED
+writer/reader mode (`RapidsShuffleInternalManagerBase.scala:238,569`).
+
+TPU redesign: RDMA bounce buffers become fixed-size staging buffers over
+whatever byte transport links executors (in-process loopback here; DCN/gRPC
+in a deployment); ICI all-to-all (parallel/collective.py) replaces NVLink
+peer copies inside a slice.  The catalog + windowed-transfer + heartbeat
+architecture is preserved — that is the part the reference proves out, and
+it is what the mocked-transport tests exercise without a cluster
+(SURVEY.md §4 takeaway)."""
+
+from spark_rapids_tpu.shuffle.catalog import (  # noqa: F401
+    ShuffleBlockId, ShuffleBufferCatalog, ShuffleReceivedBufferCatalog)
+from spark_rapids_tpu.shuffle.protocol import (  # noqa: F401
+    BlockMeta, MetadataRequest, MetadataResponse, TransferRequest,
+    TransferResponse, decode_message, encode_message)
+from spark_rapids_tpu.shuffle.transport import (  # noqa: F401
+    BounceBufferManager, Connection, InProcessTransport, Transaction,
+    TransactionStatus, Transport, WindowedBlockIterator)

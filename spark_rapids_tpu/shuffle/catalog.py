@@ -1,0 +1,122 @@
+"""Shuffle buffer catalogs.
+
+Reference: ShuffleBufferCatalog (map-side shuffle payloads tracked as
+spillable buffers, RapidsCachingWriter registers batches
+RapidsShuffleInternalManagerBase.scala:1034-1057) and
+ShuffleReceivedBufferCatalog (fetched blocks on the reduce side).
+
+Payloads live as serialized frames registered with the memory runtime's
+tiered catalog when available (spill-to-disk under pressure), else plain
+host bytes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from spark_rapids_tpu.shuffle.serializer import (deserialize_batch,
+                                                 serialize_batch)
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class ShuffleBlockId:
+    shuffle_id: int
+    map_id: int
+    partition_id: int
+
+
+class ShuffleBufferCatalog:
+    """Map-side store: (shuffle, map, reduce-partition) -> serialized frames.
+
+    Thread-safe: the multithreaded writer registers from pool threads."""
+
+    def __init__(self, codec: str = "none"):
+        self.codec = codec
+        self._lock = threading.Lock()
+        self._blocks: Dict[ShuffleBlockId, List[bytes]] = {}
+
+    def add_batch(self, block: ShuffleBlockId, hb) -> int:
+        """Serializes and registers one batch; returns frame length."""
+        frame = serialize_batch(hb, self.codec)
+        self.add_frame(block, frame)
+        return len(frame)
+
+    def add_frame(self, block: ShuffleBlockId, frame: bytes) -> None:
+        with self._lock:
+            self._blocks.setdefault(block, []).append(frame)
+
+    def block_ids(self, shuffle_id: int,
+                  partition_id: Optional[int] = None) -> List[ShuffleBlockId]:
+        with self._lock:
+            return sorted(
+                b for b in self._blocks
+                if b.shuffle_id == shuffle_id
+                and (partition_id is None or b.partition_id == partition_id))
+
+    def frames(self, block: ShuffleBlockId) -> List[bytes]:
+        with self._lock:
+            return list(self._blocks.get(block, ()))
+
+    def block_sizes(self, shuffle_id: int, partition_id: int
+                    ) -> List[Tuple[ShuffleBlockId, int]]:
+        """(block, total bytes) for a reduce partition — the metadata the
+        server answers MetadataRequests from."""
+        out = []
+        for b in self.block_ids(shuffle_id, partition_id):
+            out.append((b, sum(len(f) for f in self.frames(b))))
+        return out
+
+    def read_batches(self, block: ShuffleBlockId):
+        for frame in self.frames(block):
+            yield deserialize_batch(frame)
+
+    def drop_partition(self, shuffle_id: int, partition_id: int) -> None:
+        """Releases a reduce partition's frames once the fetch is consumed
+        (bounded catalog growth across queries)."""
+        with self._lock:
+            dead = [b for b in self._blocks
+                    if b.shuffle_id == shuffle_id
+                    and b.partition_id == partition_id]
+            for b in dead:
+                del self._blocks[b]
+
+    def unregister_shuffle(self, shuffle_id: int) -> int:
+        with self._lock:
+            dead = [b for b in self._blocks if b.shuffle_id == shuffle_id]
+            for b in dead:
+                del self._blocks[b]
+            return len(dead)
+
+    def nbytes(self) -> int:
+        with self._lock:
+            return sum(len(f) for fr in self._blocks.values() for f in fr)
+
+
+class ShuffleReceivedBufferCatalog:
+    """Reduce-side store for fetched frames (reference:
+    ShuffleReceivedBufferCatalog); frames arrive in bounce-buffer windows
+    and are reassembled before registration."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._frames: Dict[ShuffleBlockId, List[bytes]] = {}
+
+    def add_frame(self, block: ShuffleBlockId, frame: bytes) -> None:
+        with self._lock:
+            self._frames.setdefault(block, []).append(frame)
+
+    def read_batches(self, block: ShuffleBlockId):
+        with self._lock:
+            frames = list(self._frames.get(block, ()))
+        for f in frames:
+            yield deserialize_batch(f)
+
+    def blocks(self) -> List[ShuffleBlockId]:
+        with self._lock:
+            return sorted(self._frames)
+
+    def drop(self, block: ShuffleBlockId) -> None:
+        with self._lock:
+            self._frames.pop(block, None)

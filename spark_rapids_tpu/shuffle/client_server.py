@@ -1,0 +1,218 @@
+"""Shuffle client/server state machines.
+
+Reference: RapidsShuffleClient.scala (481 — doFetch: MetadataRequest ->
+TransferRequest -> receive into bounce buffers -> reassemble) and
+RapidsShuffleServer.scala (450 — BufferSendState drains blocks through
+bounce buffers).  The flow is the reference's, byte-for-byte simpler:
+
+  client                          server
+    |--- MetadataRequest ---------->|   (which blocks exist for partition)
+    |<-- MetadataResponse ----------|
+    |--- TransferRequest ----------->|  (start sending block set)
+    |<== BlockFrameHeader + bytes ==|  (windowed via bounce buffers)
+    |<-- TransferResponse ----------|
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu.shuffle.catalog import (ShuffleBlockId,
+                                              ShuffleBufferCatalog,
+                                              ShuffleReceivedBufferCatalog)
+from spark_rapids_tpu.shuffle.protocol import (BlockFrameHeader, BlockMeta,
+                                               MetadataRequest,
+                                               MetadataResponse,
+                                               TransferRequest,
+                                               TransferResponse,
+                                               decode_message, encode_message)
+from spark_rapids_tpu.shuffle.transport import (BounceBufferManager,
+                                                Connection,
+                                                TransactionStatus,
+                                                WindowedBlockIterator)
+
+
+class BufferSendState:
+    """Server-side per-transfer cursor: drains the requested blocks through
+    bounce buffers window by window (reference: BufferSendState in
+    RapidsShuffleServer.scala)."""
+
+    def __init__(self, req_id: int, blocks: Sequence[ShuffleBlockId],
+                 catalog: ShuffleBufferCatalog,
+                 bounce: BounceBufferManager):
+        self.req_id = req_id
+        self.catalog = catalog
+        self.bounce = bounce
+        # flatten every frame of every block (frame = one serialized batch)
+        self.frames: List[Tuple[ShuffleBlockId, int, int, bytes]] = []
+        for b in blocks:
+            fr = catalog.frames(b)
+            for i, f in enumerate(fr):
+                self.frames.append((b, i, len(fr), f))
+        self._idx = 0
+
+    @property
+    def done(self) -> bool:
+        return self._idx >= len(self.frames)
+
+    def send_next(self, conn: Connection) -> None:
+        """Sends one frame, chunked through a bounce buffer."""
+        block, fi, fc, frame = self.frames[self._idx]
+        self._idx += 1
+        header = BlockFrameHeader(self.req_id, block, fi, fc, len(frame))
+        hbytes = encode_message(header)
+        # windowed copy through a bounce buffer (the transfer unit that a
+        # real RDMA/DCN transport pins; loopback still exercises the flow)
+        sent = 0
+        chunks = []
+        while sent < len(frame) or not chunks:
+            buf = self.bounce.acquire()
+            take = min(self.bounce.buffer_size, len(frame) - sent)
+            buf.data[:take] = frame[sent:sent + take]
+            chunks.append(bytes(buf.data[:take]))
+            sent += take
+            buf.close()
+        txn = conn.send_data(hbytes, b"".join(chunks))
+        txn.wait()
+        if txn.status is not TransactionStatus.SUCCESS:
+            raise ConnectionError(
+                f"send failed: {txn.error_message}")
+
+
+class ShuffleServer:
+    """Serves one executor's map output (reference: RapidsShuffleServer).
+
+    Registered as the transport handler for this executor id; replies to
+    control messages and pushes data frames back over the requesting
+    connection."""
+
+    def __init__(self, executor_id: str, catalog: ShuffleBufferCatalog,
+                 transport, bounce: Optional[BounceBufferManager] = None):
+        self.executor_id = executor_id
+        self.catalog = catalog
+        self.transport = transport
+        self.bounce = bounce or BounceBufferManager()
+        self._reply_to: Dict[int, str] = {}
+        self._lock = threading.Lock()
+
+    # -- transport handler interface ----------------------------------------
+    def handle_request(self, message: bytes) -> bytes:
+        msg = decode_message(message)
+        if isinstance(msg, MetadataRequest):
+            blocks = self.catalog.block_sizes(msg.shuffle_id,
+                                              msg.partition_id)
+            metas = tuple(BlockMeta(b, sz, len(self.catalog.frames(b)))
+                          for b, sz in blocks)
+            return encode_message(MetadataResponse(msg.req_id, metas))
+        if isinstance(msg, TransferRequest):
+            # connection metadata rides with the request in-process; a
+            # remote transport resolves the peer from the channel itself
+            with self._lock:
+                peer = self._reply_to.pop(msg.req_id, None)
+            if peer is None:
+                return encode_message(TransferResponse(
+                    msg.req_id, False, "unknown reply-to peer"))
+            try:
+                self._send_blocks(msg, peer)
+                return encode_message(TransferResponse(msg.req_id, True))
+            except Exception as e:    # noqa: BLE001 - to the client as nack
+                return encode_message(TransferResponse(msg.req_id, False,
+                                                       str(e)))
+        raise ValueError(f"server cannot handle {type(msg).__name__}")
+
+    def handle_data(self, header: bytes, payload: bytes) -> None:
+        raise ValueError("server does not accept data frames")
+
+    # -- server internals ---------------------------------------------------
+    def note_reply_to(self, req_id: int, peer_executor_id: str) -> None:
+        """In-process stand-in for the transport's channel peer identity."""
+        with self._lock:
+            self._reply_to[req_id] = peer_executor_id
+
+    def _send_blocks(self, msg: TransferRequest, peer: str) -> None:
+        state = BufferSendState(msg.req_id, msg.blocks, self.catalog,
+                                self.bounce)
+        conn = self.transport.connect(peer)
+        while not state.done:
+            state.send_next(conn)
+
+
+class ShuffleClient:
+    """Fetches blocks from peer executors (reference: RapidsShuffleClient).
+
+    One instance per executor; receives data frames via the transport
+    handler interface and reassembles them into the received catalog."""
+
+    def __init__(self, executor_id: str, transport,
+                 received: Optional[ShuffleReceivedBufferCatalog] = None):
+        self.executor_id = executor_id
+        self.transport = transport
+        self.received = received or ShuffleReceivedBufferCatalog()
+        self._req_counter = 0
+        self._lock = threading.Lock()
+        self._pending: Dict[int, Dict] = {}
+
+    def _next_req(self) -> int:
+        with self._lock:
+            self._req_counter += 1
+            return self._req_counter
+
+    # -- transport handler interface (data plane) ---------------------------
+    def handle_request(self, message: bytes) -> bytes:
+        raise ValueError("client does not serve requests")
+
+    def handle_data(self, header: bytes, payload: bytes) -> None:
+        h = decode_message(header)
+        if not isinstance(h, BlockFrameHeader):
+            raise ValueError("client expected a BlockFrameHeader")
+        if len(payload) != h.nbytes:
+            raise ValueError(
+                f"frame length mismatch: header {h.nbytes}, got "
+                f"{len(payload)}")
+        self.received.add_frame(h.block, bytes(payload))
+        with self._lock:
+            st = self._pending.get(h.req_id)
+            if st is not None:
+                st["frames"] += 1
+
+    # -- fetch flow ---------------------------------------------------------
+    def fetch_metadata(self, server: "ShuffleServer", shuffle_id: int,
+                       partition_id: int) -> MetadataResponse:
+        req = MetadataRequest(self._next_req(), shuffle_id, partition_id)
+        conn = self.transport.connect(server.executor_id)
+        txn = conn.request(encode_message(req)).wait()
+        if txn.status is not TransactionStatus.SUCCESS:
+            raise ConnectionError(f"metadata fetch failed: "
+                                  f"{txn.error_message}")
+        resp = decode_message(txn.response)
+        assert isinstance(resp, MetadataResponse)
+        return resp
+
+    def do_fetch(self, server: "ShuffleServer", shuffle_id: int,
+                 partition_id: int) -> List[ShuffleBlockId]:
+        """Full fetch of one reduce partition from one peer; returns the
+        fetched block ids (frames land in self.received)."""
+        meta = self.fetch_metadata(server, shuffle_id, partition_id)
+        if not meta.blocks:
+            return []
+        req_id = self._next_req()
+        with self._lock:
+            self._pending[req_id] = {"frames": 0}
+        expected = sum(m.num_frames for m in meta.blocks)
+        treq = TransferRequest(req_id, tuple(m.block for m in meta.blocks))
+        server.note_reply_to(req_id, self.executor_id)
+        conn = self.transport.connect(server.executor_id)
+        txn = conn.request(encode_message(treq)).wait()
+        if txn.status is not TransactionStatus.SUCCESS:
+            raise ConnectionError(f"transfer failed: {txn.error_message}")
+        resp = decode_message(txn.response)
+        if not (isinstance(resp, TransferResponse) and resp.ok):
+            raise ConnectionError(
+                f"transfer rejected: {getattr(resp, 'detail', '?')}")
+        with self._lock:
+            got = self._pending.pop(req_id)["frames"]
+        if got != expected:
+            raise ConnectionError(
+                f"short transfer: {got}/{expected} frames")
+        return [m.block for m in meta.blocks]

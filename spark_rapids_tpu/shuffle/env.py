@@ -1,0 +1,138 @@
+"""Shuffle environment: mode selection + shared machinery per session.
+
+Reference: GpuShuffleEnv.scala (:186 — picks default / MULTITHREADED / UCX
+mode from conf and owns the shuffle-wide singletons) wired from executor
+init (Plugin.scala:550-557).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from spark_rapids_tpu import config as C
+
+
+class ShuffleEnv:
+    """Owns the per-session shuffle machinery according to
+    ``spark.rapids.shuffle.mode``:
+
+    - DEFAULT:       in-exec host store (exchange.py's store)
+    - MULTITHREADED: threaded writer/reader over spill files
+    - CACHED:        catalog + client/server over the in-process transport
+                     (the UCX-mode architecture; a DCN transport slots in)
+    """
+
+    MODES = ("DEFAULT", "MULTITHREADED", "CACHED")
+    CODECS = ("none", "lz4", "zlib")
+
+    def __init__(self, conf):
+        mode = conf.get(C.SHUFFLE_MANAGER_MODE.key).upper()
+        if mode == "CACHE_ONLY":      # reference naming
+            mode = "CACHED"
+        if mode not in self.MODES:
+            raise ValueError(
+                f"unknown {C.SHUFFLE_MANAGER_MODE.key}={mode!r} "
+                f"(supported: {', '.join(self.MODES)} or CACHE_ONLY)")
+        self.mode = mode
+        self.codec = conf.get(C.SHUFFLE_COMPRESSION_CODEC.key).lower()
+        if self.codec not in self.CODECS:
+            raise ValueError(
+                f"unknown {C.SHUFFLE_COMPRESSION_CODEC.key}="
+                f"{self.codec!r} (supported: {', '.join(self.CODECS)})")
+        self.writer_threads = int(conf.get(C.SHUFFLE_WRITER_THREADS.key))
+        self.reader_threads = int(conf.get(C.SHUFFLE_READER_THREADS.key))
+        self._dir = None
+        self._lock = threading.Lock()
+        self._writer_pool: Optional[ThreadPoolExecutor] = None
+        self._reader_pool: Optional[ThreadPoolExecutor] = None
+        self._catalog = None
+        self._transport = None
+        self._client = None
+        self._server = None
+        self._shuffle_counter = 0
+
+    def next_shuffle_id(self) -> int:
+        with self._lock:
+            self._shuffle_counter += 1
+            return self._shuffle_counter
+
+    @property
+    def shuffle_dir(self) -> str:
+        """One spill directory per env, removed at shutdown (the reference
+        parks shuffle files under Spark's block-manager dirs, which Spark
+        cleans up the same way).  Sessions left unstopped are swept at
+        interpreter exit."""
+        import atexit
+        import tempfile
+        with self._lock:
+            if self._dir is None:
+                self._dir = tempfile.mkdtemp(prefix="tpu_shuffle_")
+                atexit.register(self.shutdown)
+            return self._dir
+
+    @property
+    def writer_pool(self) -> ThreadPoolExecutor:
+        with self._lock:
+            if self._writer_pool is None:
+                self._writer_pool = ThreadPoolExecutor(
+                    max_workers=max(1, self.writer_threads),
+                    thread_name_prefix="shuffle-writer")
+            return self._writer_pool
+
+    @property
+    def reader_pool(self) -> ThreadPoolExecutor:
+        with self._lock:
+            if self._reader_pool is None:
+                self._reader_pool = ThreadPoolExecutor(
+                    max_workers=max(1, self.reader_threads),
+                    thread_name_prefix="shuffle-reader")
+            return self._reader_pool
+
+    # -- CACHED (transport) mode singletons ---------------------------------
+    def cached_machinery(self):
+        """(catalog, client, server) for the single in-process executor."""
+        from spark_rapids_tpu.shuffle.catalog import (
+            ShuffleBufferCatalog, ShuffleReceivedBufferCatalog)
+        from spark_rapids_tpu.shuffle.client_server import (ShuffleClient,
+                                                            ShuffleServer)
+        from spark_rapids_tpu.shuffle.transport import InProcessTransport
+        with self._lock:
+            if self._catalog is None:
+                self._catalog = ShuffleBufferCatalog(self.codec)
+                self._transport = InProcessTransport()
+                self._server = ShuffleServer("exec-0", self._catalog,
+                                             self._transport)
+                self._client = ShuffleClient("exec-0-client",
+                                             self._transport)
+                self._transport.register_handler("exec-0", self._server)
+                self._transport.register_handler("exec-0-client",
+                                                 self._client)
+            return self._catalog, self._client, self._server
+
+    def shutdown(self):
+        import shutil
+        with self._lock:
+            if self._writer_pool is not None:
+                self._writer_pool.shutdown(wait=False)
+            if self._reader_pool is not None:
+                self._reader_pool.shutdown(wait=False)
+            if self._dir is not None:
+                shutil.rmtree(self._dir, ignore_errors=True)
+                self._dir = None
+
+
+_ACTIVE: Optional[ShuffleEnv] = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def init_shuffle_env(conf) -> ShuffleEnv:
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        _ACTIVE = ShuffleEnv(conf)
+        return _ACTIVE
+
+
+def get_shuffle_env() -> Optional[ShuffleEnv]:
+    return _ACTIVE

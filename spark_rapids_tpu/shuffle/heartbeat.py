@@ -1,0 +1,108 @@
+"""Executor liveness for the accelerated shuffle.
+
+Reference: RapidsShuffleHeartbeatManager.scala (234 — driver-side registry;
+executors register + heartbeat via plugin RPC, Plugin.scala:436-447) and
+RapidsShuffleHeartbeatEndpoint (executor side).  New peers are disseminated
+through heartbeat responses; lost peers age out and their blocks surface as
+fetch failures, which the engine's normal stage retry handles (no custom
+elastic layer — SURVEY.md §5 failure detection)."""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class ExecutorInfo:
+    executor_id: str
+    endpoint: str                 # opaque transport address
+    last_heartbeat: float
+    registration_order: int
+
+
+class ShuffleHeartbeatManager:
+    """Driver-side registry (reference: RapidsShuffleHeartbeatManager).
+
+    register() returns every known peer; heartbeat() returns peers that
+    appeared since the caller last asked (the reference's delta protocol)."""
+
+    def __init__(self, timeout_s: float = 60.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self._timeout = timeout_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._executors: Dict[str, ExecutorInfo] = {}
+        self._order = 0
+        self._last_seen_order: Dict[str, int] = {}
+
+    def register_executor(self, executor_id: str,
+                          endpoint: str = "") -> List[ExecutorInfo]:
+        with self._lock:
+            self._order += 1
+            self._executors[executor_id] = ExecutorInfo(
+                executor_id, endpoint, self._clock(), self._order)
+            self._last_seen_order[executor_id] = self._order
+            return [e for e in self._sorted() if e.executor_id != executor_id]
+
+    def executor_heartbeat(self, executor_id: str) -> List[ExecutorInfo]:
+        """Refreshes liveness; returns peers registered since this
+        executor's last call (delta dissemination)."""
+        with self._lock:
+            info = self._executors.get(executor_id)
+            if info is None:
+                raise KeyError(f"executor {executor_id!r} never registered")
+            info.last_heartbeat = self._clock()
+            seen = self._last_seen_order.get(executor_id, 0)
+            self._last_seen_order[executor_id] = self._order
+            return [e for e in self._sorted()
+                    if e.registration_order > seen
+                    and e.executor_id != executor_id]
+
+    def expire_dead(self) -> List[str]:
+        """Drops executors whose heartbeat aged out; returns their ids."""
+        now = self._clock()
+        with self._lock:
+            dead = [eid for eid, e in self._executors.items()
+                    if now - e.last_heartbeat > self._timeout]
+            for eid in dead:
+                del self._executors[eid]
+                self._last_seen_order.pop(eid, None)
+            return dead
+
+    def live_executors(self) -> List[ExecutorInfo]:
+        with self._lock:
+            return self._sorted()
+
+    def _sorted(self) -> List[ExecutorInfo]:
+        return sorted(self._executors.values(),
+                      key=lambda e: e.registration_order)
+
+
+class ExecutorHeartbeatEndpoint:
+    """Executor-side loop driving registration + periodic heartbeats
+    (reference: RapidsShuffleHeartbeatEndpoint).  ``on_new_peer`` wires
+    discovered peers into the local client's connection table."""
+
+    def __init__(self, executor_id: str, manager: ShuffleHeartbeatManager,
+                 on_new_peer: Optional[Callable[[ExecutorInfo], None]] = None):
+        self.executor_id = executor_id
+        self.manager = manager
+        self.on_new_peer = on_new_peer
+        self.known_peers: Dict[str, ExecutorInfo] = {}
+
+    def register(self) -> None:
+        for peer in self.manager.register_executor(self.executor_id):
+            self._add_peer(peer)
+
+    def heartbeat(self) -> None:
+        for peer in self.manager.executor_heartbeat(self.executor_id):
+            self._add_peer(peer)
+
+    def _add_peer(self, peer: ExecutorInfo) -> None:
+        if peer.executor_id not in self.known_peers:
+            self.known_peers[peer.executor_id] = peer
+            if self.on_new_peer is not None:
+                self.on_new_peer(peer)

@@ -1,0 +1,172 @@
+"""Shuffle control-plane wire protocol.
+
+Reference: the flatbuffers schemas in sql-plugin/src/main/format/
+(ShuffleCommon.fbs, ShuffleMetadataRequest/Response.fbs,
+ShuffleTransferRequest/Response.fbs).  Same message shapes, packed with
+``struct`` instead of flatbuffers (one fixed header + length-prefixed
+fields — no schema compiler needed and the layout stays inspectable).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import List, Tuple
+
+from spark_rapids_tpu.shuffle.catalog import ShuffleBlockId
+
+_MAGIC = b"TSHF"
+_MSG_TYPES = {}
+
+
+def _register(code):
+    def deco(cls):
+        cls.code = code
+        _MSG_TYPES[code] = cls
+        return cls
+    return deco
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockMeta:
+    """One fetchable block: identity + payload size + frame count."""
+    block: ShuffleBlockId
+    nbytes: int
+    num_frames: int
+
+    def pack(self) -> bytes:
+        return struct.pack("<qqqqq", self.block.shuffle_id,
+                           self.block.map_id, self.block.partition_id,
+                           self.nbytes, self.num_frames)
+
+    @staticmethod
+    def unpack(buf: memoryview) -> "BlockMeta":
+        s, m, p, nb, nf = struct.unpack_from("<qqqqq", buf)
+        return BlockMeta(ShuffleBlockId(s, m, p), nb, nf)
+
+    SIZE = 40
+
+
+@_register(1)
+@dataclasses.dataclass(frozen=True)
+class MetadataRequest:
+    """Which blocks exist for (shuffle, reduce partition)? (reference:
+    ShuffleMetadataRequest.fbs)"""
+    req_id: int
+    shuffle_id: int
+    partition_id: int
+
+    def pack_body(self) -> bytes:
+        return struct.pack("<qqq", self.req_id, self.shuffle_id,
+                           self.partition_id)
+
+    @staticmethod
+    def unpack_body(buf: memoryview) -> "MetadataRequest":
+        return MetadataRequest(*struct.unpack_from("<qqq", buf))
+
+
+@_register(2)
+@dataclasses.dataclass(frozen=True)
+class MetadataResponse:
+    req_id: int
+    blocks: Tuple[BlockMeta, ...]
+
+    def pack_body(self) -> bytes:
+        out = [struct.pack("<qi", self.req_id, len(self.blocks))]
+        for b in self.blocks:
+            out.append(b.pack())
+        return b"".join(out)
+
+    @staticmethod
+    def unpack_body(buf: memoryview) -> "MetadataResponse":
+        req_id, n = struct.unpack_from("<qi", buf)
+        off = 12
+        blocks = []
+        for _ in range(n):
+            blocks.append(BlockMeta.unpack(buf[off:]))
+            off += BlockMeta.SIZE
+        return MetadataResponse(req_id, tuple(blocks))
+
+
+@_register(3)
+@dataclasses.dataclass(frozen=True)
+class TransferRequest:
+    """Start sending these blocks (reference: ShuffleTransferRequest.fbs)."""
+    req_id: int
+    blocks: Tuple[ShuffleBlockId, ...]
+
+    def pack_body(self) -> bytes:
+        out = [struct.pack("<qi", self.req_id, len(self.blocks))]
+        for b in self.blocks:
+            out.append(struct.pack("<qqq", b.shuffle_id, b.map_id,
+                                   b.partition_id))
+        return b"".join(out)
+
+    @staticmethod
+    def unpack_body(buf: memoryview) -> "TransferRequest":
+        req_id, n = struct.unpack_from("<qi", buf)
+        off = 12
+        blocks = []
+        for _ in range(n):
+            s, m, p = struct.unpack_from("<qqq", buf, off)
+            blocks.append(ShuffleBlockId(s, m, p))
+            off += 24
+        return TransferRequest(req_id, tuple(blocks))
+
+
+@_register(4)
+@dataclasses.dataclass(frozen=True)
+class TransferResponse:
+    """Acknowledges a transfer; failure detail carried as status text."""
+    req_id: int
+    ok: bool
+    detail: str = ""
+
+    def pack_body(self) -> bytes:
+        d = self.detail.encode()
+        return struct.pack("<qBi", self.req_id, int(self.ok), len(d)) + d
+
+    @staticmethod
+    def unpack_body(buf: memoryview) -> "TransferResponse":
+        req_id, ok, n = struct.unpack_from("<qBi", buf)
+        d = bytes(buf[13:13 + n]).decode()
+        return TransferResponse(req_id, bool(ok), d)
+
+
+@_register(5)
+@dataclasses.dataclass(frozen=True)
+class BlockFrameHeader:
+    """Precedes each data frame on the data channel: which block, which
+    frame, how many bytes follow (reference: BufferSendState windows +
+    BufferMeta in ShuffleCommon.fbs)."""
+    req_id: int
+    block: ShuffleBlockId
+    frame_index: int
+    frame_count: int
+    nbytes: int
+
+    def pack_body(self) -> bytes:
+        return struct.pack("<qqqqiiq", self.req_id, self.block.shuffle_id,
+                           self.block.map_id, self.block.partition_id,
+                           self.frame_index, self.frame_count, self.nbytes)
+
+    @staticmethod
+    def unpack_body(buf: memoryview) -> "BlockFrameHeader":
+        r, s, m, p, fi, fc, nb = struct.unpack_from("<qqqqiiq", buf)
+        return BlockFrameHeader(r, ShuffleBlockId(s, m, p), fi, fc, nb)
+
+
+def encode_message(msg) -> bytes:
+    body = msg.pack_body()
+    return _MAGIC + struct.pack("<Bi", msg.code, len(body)) + body
+
+
+def decode_message(data: bytes):
+    if data[:4] != _MAGIC:
+        raise ValueError("bad shuffle message magic")
+    code, n = struct.unpack_from("<Bi", data, 4)
+    cls = _MSG_TYPES.get(code)
+    if cls is None:
+        raise ValueError(f"unknown shuffle message code {code}")
+    body = memoryview(data)[9:9 + n]
+    return cls.unpack_body(body)

@@ -1,0 +1,51 @@
+"""Columnar batch wire format.
+
+Reference: JCudfSerialization (host columnar wire format used by the
+default-mode shuffle serializer, GpuColumnarBatchSerializer.scala:127) +
+TableCompressionCodec/NvcompLZ4CompressionCodec for compressed payloads.
+
+Format: arrow IPC stream (the host columnar layout of this engine) with an
+optional LZ4 frame (native/tpucol codec, crc-checked) around the bytes.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Optional
+
+from spark_rapids_tpu.columnar.batch import HostColumnarBatch, batch_from_arrow
+
+
+def serialize_batch(hb: HostColumnarBatch, codec: str = "none") -> bytes:
+    import pyarrow as pa
+    rb = hb.to_arrow()
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, rb.schema) as w:
+        w.write_batch(rb)
+    raw = sink.getvalue()
+    if codec == "lz4":
+        from spark_rapids_tpu.native import lz4_compress
+        return b"\x01" + lz4_compress(raw)
+    if codec == "zlib":
+        import zlib
+        return b"\x02" + zlib.compress(raw, 1)
+    if codec in ("none", ""):
+        return b"\x00" + raw
+    raise ValueError(f"unknown shuffle codec {codec!r} "
+                     "(supported: none, lz4, zlib)")
+
+
+def deserialize_batch(data: bytes) -> HostColumnarBatch:
+    import pyarrow as pa
+    tag, payload = data[0], data[1:]
+    if tag == 1:
+        from spark_rapids_tpu.native import lz4_decompress
+        payload = lz4_decompress(payload)
+    elif tag == 2:
+        import zlib
+        payload = zlib.decompress(payload)
+    elif tag != 0:
+        raise ValueError(f"bad shuffle frame tag {tag}")
+    with pa.ipc.open_stream(io.BytesIO(payload)) as r:
+        tab = r.read_all()
+    return batch_from_arrow(tab)

@@ -1,0 +1,154 @@
+"""Multithreaded shuffle writer/reader.
+
+Reference: RapidsShuffleThreadedWriterBase / ReaderBase
+(RapidsShuffleInternalManagerBase.scala:238,569) — thread pools parallelize
+serialization + disk I/O per task, with a BytesInFlightLimiter (:529)
+bounding buffered bytes.  Here the writer serializes each reduce
+partition's batches on a pool and appends them to per-map spill files; the
+reader deserializes fetched frames on a pool.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import struct
+import tempfile
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu.shuffle.catalog import ShuffleBlockId
+from spark_rapids_tpu.shuffle.serializer import (deserialize_batch,
+                                                 serialize_batch)
+
+
+class BytesInFlightLimiter:
+    """Bounds bytes buffered across pool threads (reference:
+    BytesInFlightLimiter — acquire blocks until room frees up)."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._in_flight = 0
+        self._cv = threading.Condition()
+
+    def acquire(self, n: int) -> None:
+        with self._cv:
+            # a single oversized payload must still make progress
+            while self._in_flight and self._in_flight + n > self.max_bytes:
+                self._cv.wait()
+            self._in_flight += n
+
+    def release(self, n: int) -> None:
+        with self._cv:
+            self._in_flight -= n
+            self._cv.notify_all()
+
+    @property
+    def in_flight(self) -> int:
+        with self._cv:
+            return self._in_flight
+
+
+class ThreadedShuffleWriter:
+    """Writes one map task's output: per-partition batches are serialized
+    on the pool and appended to one spill file + an index (the classic
+    sort-shuffle file pair, parallelized like the reference's MULTITHREADED
+    mode).
+
+    The spill ``directory`` is owned by the caller (ShuffleEnv passes its
+    session directory and removes it at shutdown); the mkdtemp fallback is
+    for standalone use, where the caller must clean up."""
+
+    def __init__(self, shuffle_id: int, map_id: int, num_partitions: int,
+                 pool: ThreadPoolExecutor, directory: Optional[str] = None,
+                 codec: str = "none"):
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self.num_partitions = num_partitions
+        self.pool = pool
+        self.codec = codec
+        self.dir = directory or tempfile.mkdtemp(prefix="tpu_shuffle_")
+
+    def write(self, partitioned_batches: Sequence[Tuple[int, object]]
+              ) -> "MapOutputInfo":
+        """partitioned_batches: iterable of (reduce_partition, host_batch).
+        Serialization runs on the pool; results are collected in submission
+        order so batch order within a reduce partition is deterministic
+        (matching the reference writer and DEFAULT mode)."""
+        futs = [(pid, self.pool.submit(serialize_batch, hb, self.codec))
+                for pid, hb in partitioned_batches]
+        frames: Dict[int, List[bytes]] = {}
+        for pid, f in futs:
+            frames.setdefault(pid, []).append(f.result())
+        # write the data file partition by partition + offsets index
+        path = os.path.join(self.dir,
+                            f"shuffle_{self.shuffle_id}_{self.map_id}.data")
+        offsets = [0]
+        counts = []
+        with open(path, "wb") as out:
+            for pid in range(self.num_partitions):
+                fr = frames.get(pid, [])
+                counts.append(len(fr))
+                for data in fr:
+                    out.write(struct.pack("<q", len(data)))
+                    out.write(data)
+                offsets.append(out.tell())
+        return MapOutputInfo(self.shuffle_id, self.map_id, path,
+                             offsets, counts)
+
+
+class MapOutputInfo:
+    """Where one map task's output lives (file + per-partition offsets) —
+    the MapStatus analog."""
+
+    def __init__(self, shuffle_id: int, map_id: int, path: str,
+                 offsets: List[int], frame_counts: List[int]):
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self.path = path
+        self.offsets = offsets
+        self.frame_counts = frame_counts
+
+    def partition_bytes(self, pid: int) -> int:
+        return self.offsets[pid + 1] - self.offsets[pid]
+
+    def read_frames(self, pid: int) -> Iterator[bytes]:
+        n = self.partition_bytes(pid)
+        if n == 0:
+            return
+        with open(self.path, "rb") as f:
+            f.seek(self.offsets[pid])
+            end = self.offsets[pid + 1]
+            while f.tell() < end:
+                (ln,) = struct.unpack("<q", f.read(8))
+                yield f.read(ln)
+
+
+class ThreadedShuffleReader:
+    """Reads one reduce partition across map outputs, deserializing frames
+    on the pool (reference: RapidsShuffleThreadedReaderBase)."""
+
+    def __init__(self, pool: ThreadPoolExecutor,
+                 limiter: Optional[BytesInFlightLimiter] = None):
+        self.pool = pool
+        self.limiter = limiter or BytesInFlightLimiter(128 << 20)
+
+    def read(self, outputs: Sequence[MapOutputInfo], pid: int):
+        """Yields host batches for partition ``pid`` in map order.  The
+        limiter bounds RAW frame bytes held by concurrent loads (acquired
+        around the read+deserialize window; the decoded batches are the
+        caller's memory, as in the reference reader)."""
+        def load(out: MapOutputInfo):
+            res = []
+            for frame in out.read_frames(pid):
+                self.limiter.acquire(len(frame))
+                try:
+                    res.append(deserialize_batch(frame))
+                finally:
+                    self.limiter.release(len(frame))
+            return res
+
+        futs = [self.pool.submit(load, o) for o in outputs]
+        for f in futs:
+            yield from f.result()
