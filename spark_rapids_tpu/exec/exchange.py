@@ -63,9 +63,17 @@ class _LazyPartitions:
         self._fetch = fetch
         self._cache: Dict[int, List] = {}
 
+    #: optional callback fired once every partition has been fetched
+    #: (storage can be released; results stay in the cache)
+    on_all_fetched = None
+
     def __getitem__(self, pidx: int):
         if pidx not in self._cache:
             self._cache[pidx] = self._fetch(pidx)
+            if len(self._cache) == self._n and \
+                    self.on_all_fetched is not None:
+                cb, self.on_all_fetched = self.on_all_fetched, None
+                cb()
         return self._cache[pidx]
 
     def __len__(self):
@@ -152,8 +160,18 @@ class CpuShuffleExchangeExec(UnaryExec):
                                            codec=env.codec)
             outputs.append(writer.write(list(self._map_pairs(mp, n))))
         reader = ThreadedShuffleReader(env.reader_pool)
-        return _LazyPartitions(
+        lazy = _LazyPartitions(
             n, lambda pidx: list(reader.read(outputs, pidx)))
+
+        def cleanup():
+            import os
+            for o in outputs:
+                try:
+                    os.unlink(o.path)
+                except OSError:
+                    pass
+        lazy.on_all_fetched = cleanup
+        return lazy
 
     def _materialize_cached(self, env, n: int):
         """CACHED mode (reference UCX shuffle): map output registered in

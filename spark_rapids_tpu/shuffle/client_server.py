@@ -29,8 +29,7 @@ from spark_rapids_tpu.shuffle.protocol import (BlockFrameHeader, BlockMeta,
                                                decode_message, encode_message)
 from spark_rapids_tpu.shuffle.transport import (BounceBufferManager,
                                                 Connection,
-                                                TransactionStatus,
-                                                WindowedBlockIterator)
+                                                TransactionStatus)
 
 
 class BufferSendState:
@@ -57,27 +56,31 @@ class BufferSendState:
         return self._idx >= len(self.frames)
 
     def send_next(self, conn: Connection) -> None:
-        """Sends one frame, chunked through a bounce buffer."""
+        """Sends one frame as bounce-buffer-sized CHUNKS, each its own
+        data-plane send — at most one bounce buffer of this frame is in
+        flight at a time (real windowing/backpressure; the receiver
+        reassembles by chunk offset)."""
         block, fi, fc, frame = self.frames[self._idx]
         self._idx += 1
-        header = BlockFrameHeader(self.req_id, block, fi, fc, len(frame))
-        hbytes = encode_message(header)
-        # windowed copy through a bounce buffer (the transfer unit that a
-        # real RDMA/DCN transport pins; loopback still exercises the flow)
+        total = len(frame)
         sent = 0
-        chunks = []
-        while sent < len(frame) or not chunks:
+        while sent < total or sent == 0:
             buf = self.bounce.acquire()
-            take = min(self.bounce.buffer_size, len(frame) - sent)
-            buf.data[:take] = frame[sent:sent + take]
-            chunks.append(bytes(buf.data[:take]))
+            try:
+                take = min(self.bounce.buffer_size, total - sent)
+                buf.data[:take] = frame[sent:sent + take]
+                header = BlockFrameHeader(self.req_id, block, fi, fc,
+                                          take, sent, total)
+                txn = conn.send_data(encode_message(header),
+                                     bytes(buf.data[:take]))
+                txn.wait()
+            finally:
+                buf.close()
+            if txn.status is not TransactionStatus.SUCCESS:
+                raise ConnectionError(f"send failed: {txn.error_message}")
             sent += take
-            buf.close()
-        txn = conn.send_data(hbytes, b"".join(chunks))
-        txn.wait()
-        if txn.status is not TransactionStatus.SUCCESS:
-            raise ConnectionError(
-                f"send failed: {txn.error_message}")
+            if total == 0:
+                break
 
 
 class ShuffleServer:
@@ -152,6 +155,8 @@ class ShuffleClient:
         self._req_counter = 0
         self._lock = threading.Lock()
         self._pending: Dict[int, Dict] = {}
+        self._partial: Dict = {}        # (req, block, frame) -> bytearray
+        self._partial_got: Dict = {}
 
     def _next_req(self) -> int:
         with self._lock:
@@ -168,13 +173,25 @@ class ShuffleClient:
             raise ValueError("client expected a BlockFrameHeader")
         if len(payload) != h.nbytes:
             raise ValueError(
-                f"frame length mismatch: header {h.nbytes}, got "
+                f"chunk length mismatch: header {h.nbytes}, got "
                 f"{len(payload)}")
-        self.received.add_frame(h.block, bytes(payload))
+        total = h.total_bytes or h.nbytes
+        key = (h.req_id, h.block, h.frame_index)
         with self._lock:
+            buf = self._partial.get(key)
+            if buf is None:
+                buf = self._partial[key] = bytearray(total)
+                self._partial_got[key] = 0
+            buf[h.chunk_offset:h.chunk_offset + h.nbytes] = payload
+            self._partial_got[key] += h.nbytes
+            if self._partial_got[key] < total:
+                return
+            frame = bytes(self._partial.pop(key))
+            self._partial_got.pop(key)
             st = self._pending.get(h.req_id)
             if st is not None:
                 st["frames"] += 1
+        self.received.add_frame(h.block, frame)
 
     # -- fetch flow ---------------------------------------------------------
     def fetch_metadata(self, server: "ShuffleServer", shuffle_id: int,
@@ -199,20 +216,32 @@ class ShuffleClient:
         req_id = self._next_req()
         with self._lock:
             self._pending[req_id] = {"frames": 0}
-        expected = sum(m.num_frames for m in meta.blocks)
-        treq = TransferRequest(req_id, tuple(m.block for m in meta.blocks))
-        server.note_reply_to(req_id, self.executor_id)
-        conn = self.transport.connect(server.executor_id)
-        txn = conn.request(encode_message(treq)).wait()
-        if txn.status is not TransactionStatus.SUCCESS:
-            raise ConnectionError(f"transfer failed: {txn.error_message}")
-        resp = decode_message(txn.response)
-        if not (isinstance(resp, TransferResponse) and resp.ok):
-            raise ConnectionError(
-                f"transfer rejected: {getattr(resp, 'detail', '?')}")
-        with self._lock:
-            got = self._pending.pop(req_id)["frames"]
-        if got != expected:
-            raise ConnectionError(
-                f"short transfer: {got}/{expected} frames")
-        return [m.block for m in meta.blocks]
+        try:
+            expected = sum(m.num_frames for m in meta.blocks)
+            treq = TransferRequest(req_id,
+                                   tuple(m.block for m in meta.blocks))
+            server.note_reply_to(req_id, self.executor_id)
+            conn = self.transport.connect(server.executor_id)
+            txn = conn.request(encode_message(treq)).wait()
+            if txn.status is not TransactionStatus.SUCCESS:
+                raise ConnectionError(
+                    f"transfer failed: {txn.error_message}")
+            resp = decode_message(txn.response)
+            if not (isinstance(resp, TransferResponse) and resp.ok):
+                raise ConnectionError(
+                    f"transfer rejected: {getattr(resp, 'detail', '?')}")
+            with self._lock:
+                got = self._pending[req_id]["frames"]
+            if got != expected:
+                raise ConnectionError(
+                    f"short transfer: {got}/{expected} frames")
+            return [m.block for m in meta.blocks]
+        finally:
+            # error or success: release tracking + any partial chunks so a
+            # flaky peer cannot grow client state unboundedly
+            with self._lock:
+                self._pending.pop(req_id, None)
+                stale = [k for k in self._partial if k[0] == req_id]
+                for k in stale:
+                    self._partial.pop(k, None)
+                    self._partial_got.pop(k, None)

@@ -44,6 +44,7 @@ class ShuffleEnv:
         self.writer_threads = int(conf.get(C.SHUFFLE_WRITER_THREADS.key))
         self.reader_threads = int(conf.get(C.SHUFFLE_READER_THREADS.key))
         self._dir = None
+        self._atexit_registered = False
         self._lock = threading.Lock()
         self._writer_pool: Optional[ThreadPoolExecutor] = None
         self._reader_pool: Optional[ThreadPoolExecutor] = None
@@ -69,7 +70,9 @@ class ShuffleEnv:
         with self._lock:
             if self._dir is None:
                 self._dir = tempfile.mkdtemp(prefix="tpu_shuffle_")
-                atexit.register(self.shutdown)
+                if not self._atexit_registered:
+                    self._atexit_registered = True
+                    atexit.register(self.shutdown)
             return self._dir
 
     @property
@@ -116,8 +119,10 @@ class ShuffleEnv:
         with self._lock:
             if self._writer_pool is not None:
                 self._writer_pool.shutdown(wait=False)
+                self._writer_pool = None   # lazily recreated if reused
             if self._reader_pool is not None:
                 self._reader_pool.shutdown(wait=False)
+                self._reader_pool = None
             if self._dir is not None:
                 shutil.rmtree(self._dir, ignore_errors=True)
                 self._dir = None

@@ -136,24 +136,30 @@ class TransferResponse:
 @_register(5)
 @dataclasses.dataclass(frozen=True)
 class BlockFrameHeader:
-    """Precedes each data frame on the data channel: which block, which
-    frame, how many bytes follow (reference: BufferSendState windows +
+    """Precedes each data CHUNK on the data channel: which block/frame it
+    belongs to, the chunk's byte range, and the frame's total size — one
+    chunk per bounce-buffer window (reference: BufferSendState windows +
     BufferMeta in ShuffleCommon.fbs)."""
     req_id: int
     block: ShuffleBlockId
     frame_index: int
     frame_count: int
-    nbytes: int
+    nbytes: int            # bytes in THIS chunk
+    chunk_offset: int = 0  # offset of this chunk within the frame
+    total_bytes: int = 0   # full frame size (0 legacy = nbytes)
 
     def pack_body(self) -> bytes:
-        return struct.pack("<qqqqiiq", self.req_id, self.block.shuffle_id,
+        return struct.pack("<qqqqiiqqq", self.req_id, self.block.shuffle_id,
                            self.block.map_id, self.block.partition_id,
-                           self.frame_index, self.frame_count, self.nbytes)
+                           self.frame_index, self.frame_count, self.nbytes,
+                           self.chunk_offset, self.total_bytes)
 
     @staticmethod
     def unpack_body(buf: memoryview) -> "BlockFrameHeader":
-        r, s, m, p, fi, fc, nb = struct.unpack_from("<qqqqiiq", buf)
-        return BlockFrameHeader(r, ShuffleBlockId(s, m, p), fi, fc, nb)
+        r, s, m, p, fi, fc, nb, co, tb = struct.unpack_from("<qqqqiiqqq",
+                                                            buf)
+        return BlockFrameHeader(r, ShuffleBlockId(s, m, p), fi, fc, nb,
+                                co, tb)
 
 
 def encode_message(msg) -> bytes:

@@ -1,0 +1,18 @@
+"""UDF support.
+
+Reference: SURVEY.md §2.10 — the `RapidsUDF` columnar-UDF interface
+(sql-plugin-api/.../RapidsUDF.java), row-based UDF passthrough, the
+`udf-compiler/` module (JVM-bytecode -> Catalyst via javassist + CFG +
+symbolic execution), and the Pandas-UDF exec family (execution/python/).
+
+TPU redesign: the compiler decompiles *Python* bytecode (dis module) of
+simple lambdas into this engine's Expression trees — a compiled UDF runs
+fused in the device XLA program like any built-in expression.  Functions
+the compiler cannot prove translateable run row-based on the host tier
+with honest fallback tagging (exactly the reference's LogicalPlanRules
+contract: try to compile, fall back untouched)."""
+
+from spark_rapids_tpu.udf.api import (  # noqa: F401
+    ColumnarUDF, PandasUDF, PythonRowUDF, udf)
+from spark_rapids_tpu.udf.compiler import (  # noqa: F401
+    UdfCompileError, compile_udf)

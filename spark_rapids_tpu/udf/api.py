@@ -1,0 +1,229 @@
+"""User-facing UDF API.
+
+Reference: RapidsUDF.java (columnar UDF interface: user supplies a
+columnar kernel and the plugin runs it on device), GpuUserDefinedFunction /
+GpuScalaUDF (row fallback), and the Pandas UDF execs (GpuArrowEvalPythonExec
+— arrow batches handed to vectorized python).
+
+Three tiers, fastest first:
+1. ``udf(f)``: the compiler translates f's bytecode into native
+   expressions -> fully fused into the device XLA program.
+2. ``ColumnarUDF``: the user writes the vectorized kernel (jax/numpy in,
+   array out) -> runs device-side as one kernel (RapidsUDF analog).
+3. Row fallback: f is called per row on the host tier with honest tagging.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import (EvalContext, Expression, TCol,
+                                               materialize, valid_array)
+
+log = logging.getLogger(__name__)
+
+
+class ColumnarUDF(Expression):
+    """RapidsUDF analog: the user supplies a VECTORIZED kernel.
+
+    ``fn(xp, *data_arrays) -> data_array`` is called with the backend's
+    array module (jax.numpy on device, numpy on host) and the dense input
+    arrays; rows where any input is null are nulled afterwards (standard
+    null propagation; kernels never see validity)."""
+
+    def __init__(self, fn: Callable, return_type: T.DataType,
+                 children: Sequence[Expression], name: str = ""):
+        super().__init__(list(children))
+        self.fn = fn
+        self._dtype = return_type
+        self._name = name or getattr(fn, "__name__", "columnar_udf")
+
+    @property
+    def data_type(self):
+        return self._dtype
+
+    def sql(self):
+        args = ", ".join(c.sql() for c in self.children)
+        return f"{self._name}({args})"
+
+    def _eval(self, ctx: EvalContext, xp):
+        from spark_rapids_tpu.expressions.base import all_valid
+        ins = [c.eval(ctx) for c in self.children]
+        data = [materialize(c, ctx, c.dtype.np_dtype) for c in ins]
+        out = self.fn(xp, *data)
+        valid = valid_array(ins[0], ctx)
+        for c in ins[1:]:
+            valid = valid & valid_array(c, ctx)
+        return TCol(out, valid, self._dtype)
+
+    def eval_tpu(self, ctx):
+        from spark_rapids_tpu.expressions.base import jnp
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx, np)
+
+    def tpu_supported(self, conf):
+        for c in self.children:
+            if isinstance(c.data_type, (T.StringType, T.BinaryType)) or \
+                    c.data_type.is_nested:
+                return "columnar UDFs take fixed-width inputs on device"
+        return None
+
+
+class PythonRowUDF(Expression):
+    """Row-at-a-time python UDF: the host-tier fallback (reference:
+    GpuUserDefinedFunction's CPU passthrough; Spark's BatchEvalPython)."""
+
+    def __init__(self, fn: Callable, return_type: T.DataType,
+                 children: Sequence[Expression], name: str = ""):
+        super().__init__(list(children))
+        self.fn = fn
+        self._dtype = return_type
+        self._name = name or getattr(fn, "__name__", "udf")
+
+    @property
+    def data_type(self):
+        return self._dtype
+
+    def sql(self):
+        args = ", ".join(c.sql() for c in self.children)
+        return f"{self._name}({args})"
+
+    def tpu_supported(self, conf):
+        return "python row UDF runs on the host tier (try udf() compilation)"
+
+    def eval_cpu(self, ctx):
+        ins = [c.eval(ctx) for c in self.children]
+        datas = [materialize(c, ctx, np.dtype(object)
+                             if c.dtype.np_dtype is None else c.dtype.np_dtype)
+                 for c in ins]
+        valids = [valid_array(c, ctx) for c in ins]
+        n = ctx.row_count
+        out = np.empty(n, dtype=object)
+        ok = np.zeros(n, dtype=bool)
+        for i in range(n):
+            args = [d[i] if v[i] else None
+                    for d, v in zip(datas, valids)]
+            args = [a.item() if hasattr(a, "item") else a for a in args]
+            r = self.fn(*args)
+            out[i] = r
+            ok[i] = r is not None
+        return _pack_row_results(out, ok, self._dtype)
+
+    eval_tpu = eval_cpu
+
+
+class PandasUDF(Expression):
+    """Vectorized pandas UDF (reference: the Pandas-UDF exec family —
+    GpuArrowEvalPythonExec hands arrow batches to python).  ``fn`` receives
+    pandas Series (nulls as NaN/None) and returns a Series/array."""
+
+    def __init__(self, fn: Callable, return_type: T.DataType,
+                 children: Sequence[Expression], name: str = ""):
+        super().__init__(list(children))
+        self.fn = fn
+        self._dtype = return_type
+        self._name = name or getattr(fn, "__name__", "pandas_udf")
+
+    @property
+    def data_type(self):
+        return self._dtype
+
+    def sql(self):
+        args = ", ".join(c.sql() for c in self.children)
+        return f"{self._name}({args})"
+
+    def tpu_supported(self, conf):
+        return "pandas UDF runs on the host tier (arrow hand-off)"
+
+    def eval_cpu(self, ctx):
+        import pandas as pd
+        from spark_rapids_tpu.expressions.evaluator import tcol_to_host_column
+        ins = [c.eval(ctx) for c in self.children]
+        series = [tcol_to_host_column(c, ctx.row_count).arrow.to_pandas()
+                  for c in ins]
+        res = self.fn(*series)
+        if isinstance(res, pd.Series):
+            arr = res.to_numpy()
+        else:
+            arr = np.asarray(res)
+        ok = ~pd.isna(arr)
+        out = np.empty(ctx.row_count, dtype=object)
+        for i in range(ctx.row_count):
+            out[i] = arr[i] if ok[i] else None
+        return _pack_row_results(out, np.asarray(ok, dtype=bool),
+                                 self._dtype)
+
+    eval_tpu = eval_cpu
+
+
+def _pack_row_results(out: np.ndarray, ok: np.ndarray, dt: T.DataType) -> TCol:
+    """Object results -> the CPU backend's physical representation."""
+    if isinstance(dt, (T.StringType, T.BinaryType)) or dt.is_nested:
+        return TCol(out, ok, dt)
+    npdt = dt.np_dtype
+    if npdt is None:
+        return TCol(out, ok, dt)
+    dense = np.zeros(len(out), dtype=npdt)
+    for i in range(len(out)):
+        if ok[i]:
+            try:
+                dense[i] = out[i]
+            except (TypeError, ValueError) as e:
+                raise TypeError(
+                    f"UDF declared return type {dt.simple_name} but "
+                    f"produced {type(out[i]).__name__} ({out[i]!r})") from e
+    return TCol(dense, ok, dt)
+
+
+def udf(fn: Callable, return_type: Optional[T.DataType] = None,
+        name: str = ""):
+    """Creates a UDF builder: ``udf(lambda x: x + 1, T.LONG)(col("a"))``.
+
+    Tries the bytecode compiler first (reference udf-compiler contract:
+    compiled UDFs become native expressions and run fused on device);
+    functions outside the compilable subset become row UDFs on the host
+    tier — which REQUIRES an explicit ``return_type`` (a compiled UDF
+    carries its type in the expression tree).  The compilation outcome is
+    visible in ``explain()``."""
+
+    def build(*cols) -> Expression:
+        from spark_rapids_tpu.udf.compiler import UdfCompileError, compile_udf
+        exprs = [c if isinstance(c, Expression) else _colref(c)
+                 for c in cols]
+        fname = name or getattr(fn, "__name__", "<lambda>")
+        try:
+            compiled = compile_udf(fn, exprs)
+            log.debug("UDF %s compiled to native expressions", fname)
+            return compiled
+        except Exception as e:   # noqa: BLE001 - any analysis failure
+            if return_type is None:
+                raise TypeError(
+                    f"UDF {fname} could not be compiled to native "
+                    f"expressions ({e}); pass return_type= to run it as a "
+                    "row UDF on the host tier") from e
+            log.info("UDF %s falls back to row execution: %s", fname, e)
+            return PythonRowUDF(fn, return_type, exprs, name)
+
+    return build
+
+
+def _colref(name: str) -> Expression:
+    from spark_rapids_tpu.expressions.base import col
+    return col(name)
+
+
+# plan-rewrite registrations: the UDF expression types exist in the
+# registry so tagging reports the honest tier instead of "no implementation"
+from spark_rapids_tpu.plan import typechecks as TS  # noqa: E402
+from spark_rapids_tpu.plan.overrides import register_expr  # noqa: E402
+
+from spark_rapids_tpu.udf.compiler import Truthy  # noqa: E402
+
+for _cls in (ColumnarUDF, PythonRowUDF, PandasUDF, Truthy):
+    register_expr(_cls, TS.BASIC_WITH_ARRAYS)

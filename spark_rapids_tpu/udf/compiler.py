@@ -1,0 +1,402 @@
+"""Python-bytecode UDF compiler.
+
+Reference: the `udf-compiler/` module — LambdaReflection.scala (javassist
+decompile), CFG.scala (basic blocks), CatalystExpressionBuilder.scala +
+State.scala (symbolic execution of the bytecode into Catalyst expressions).
+
+Here the same pipeline over CPython bytecode (``dis``): a recursive
+symbolic interpreter walks the instruction stream with an operand stack of
+Expression nodes; conditional jumps fork both paths and merge their RETURN
+expressions into ``If(cond, then, else)``.  Backward jumps (loops) and
+unsupported opcodes raise ``UdfCompileError`` — callers fall back to the
+row-based host UDF, as the reference falls back to the original lambda.
+
+Supported surface: arithmetic, comparisons, and/or/not, ternaries,
+``is [not] None``, math.* functions, abs/min/max/len/round, string methods
+(upper/lower/strip/startswith/endswith), local variable assignment, and
+closure constants.
+"""
+
+from __future__ import annotations
+
+import dis
+import math
+import types
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions import arithmetic as A
+from spark_rapids_tpu.expressions import bitwise as B
+from spark_rapids_tpu.expressions import conditional as K
+from spark_rapids_tpu.expressions import mathexprs as M
+from spark_rapids_tpu.expressions import predicates as P
+from spark_rapids_tpu.expressions import strings as S
+from spark_rapids_tpu.expressions.base import Expression, Literal
+
+
+class UdfCompileError(Exception):
+    """The function cannot be translated (caller falls back to row UDF)."""
+
+
+class Truthy(Expression):
+    """Python truthiness of a value used as a branch condition: booleans
+    pass through, numbers test != 0, strings test non-empty.  Typing is
+    deferred to eval/tagging time because UDF parameters are unresolved
+    attributes while compiling."""
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def sql(self):
+        return f"truthy({self.children[0].sql()})"
+
+    def _lowered(self) -> Expression:
+        c = self.children[0]
+        dt = c.data_type
+        if isinstance(dt, T.BooleanType):
+            return c
+        if dt.is_numeric:
+            return P.NotEqual(c, Literal(0))
+        if isinstance(dt, (T.StringType, T.BinaryType)):
+            return P.GreaterThan(S.Length(c), Literal(0, T.INT))
+        raise TypeError(
+            f"python truthiness of {dt.simple_name} is not translatable")
+
+    def tpu_supported(self, conf):
+        try:
+            self._lowered()
+        except TypeError as e:
+            return str(e)
+        return None
+
+    def eval_tpu(self, ctx):
+        return self._lowered().eval_tpu(ctx)
+
+    def eval_cpu(self, ctx):
+        return self._lowered().eval_cpu(ctx)
+
+
+# -- stack marker objects (non-Expression stack entries) ---------------------
+
+class _Null:
+    """The NULL slot CPython pushes for non-method calls."""
+
+
+class _Module:
+    def __init__(self, name):
+        self.name = name
+
+
+class _Fn:
+    """A resolved callable marker: builds an Expression from args."""
+
+    def __init__(self, name, builder, arity):
+        self.name = name
+        self.builder = builder
+        self.arity = arity   # int or (min, max)
+
+    def build(self, args: List[Expression]) -> Expression:
+        lo, hi = (self.arity, self.arity) if isinstance(self.arity, int) \
+            else self.arity
+        if not (lo <= len(args) <= hi):
+            raise UdfCompileError(
+                f"{self.name}() with {len(args)} args not supported")
+        return self.builder(args)
+
+
+class _Method:
+    """A bound method marker: self expression + method name."""
+
+    def __init__(self, recv: Expression, name: str):
+        self.recv = recv
+        self.name = name
+
+
+_MATH_FNS = {
+    "sqrt": M.Sqrt, "exp": M.Exp, "expm1": M.Expm1, "log": M.Log,
+    "log2": M.Log2, "log10": M.Log10, "log1p": M.Log1p, "sin": M.Sin,
+    "cos": M.Cos, "tan": M.Tan, "asin": M.Asin, "acos": M.Acos,
+    "atan": M.Atan, "sinh": M.Sinh, "cosh": M.Cosh, "tanh": M.Tanh,
+    "floor": M.Floor, "ceil": M.Ceil, "degrees": M.ToDegrees,
+    "radians": M.ToRadians, "cbrt": M.Cbrt,
+}
+
+_BUILTIN_FNS = {
+    "abs": _Fn("abs", lambda a: A.Abs(a[0]), 1),
+    "len": _Fn("len", lambda a: S.Length(a[0]), 1),
+    "min": _Fn("min", lambda a: K.Least(*a), (2, 8)),
+    "max": _Fn("max", lambda a: K.Greatest(*a), (2, 8)),
+    "round": _Fn("round", lambda a: M.Round(a[0], a[1])
+                 if len(a) == 2 else M.Round(a[0], Literal(0, T.INT)),
+                 (1, 2)),
+    "float": _Fn("float", lambda a: _cast(a[0], T.DOUBLE), 1),
+    "int": _Fn("int", lambda a: _cast(a[0], T.LONG), 1),
+    "str": _Fn("str", lambda a: _cast(a[0], T.STRING), 1),
+    "bool": _Fn("bool", lambda a: _cast(a[0], T.BOOLEAN), 1),
+    "pow": _Fn("pow", lambda a: M.Pow(a[0], a[1]), 2),
+}
+
+_STRING_METHODS = {
+    "upper": lambda r, a: S.Upper(r),
+    "lower": lambda r, a: S.Lower(r),
+    "strip": lambda r, a: S.Trim(r),
+    "lstrip": lambda r, a: S.LTrim(r),
+    "rstrip": lambda r, a: S.RTrim(r),
+    "startswith": lambda r, a: S.StartsWith(r, a[0]),
+    "endswith": lambda r, a: S.EndsWith(r, a[0]),
+}
+
+_BINARY_OPS = {
+    "+": A.Add, "-": A.Subtract, "*": A.Multiply, "/": A.Divide,
+    "//": A.IntegralDivide, "%": A.Remainder, "**": M.Pow,
+    "&": B.BitwiseAnd, "|": B.BitwiseOr, "^": B.BitwiseXor,
+    "<<": B.ShiftLeft, ">>": B.ShiftRight,
+}
+
+_COMPARE_OPS = {
+    "<": P.LessThan, "<=": P.LessThanOrEqual, ">": P.GreaterThan,
+    ">=": P.GreaterThanOrEqual, "==": P.EqualTo, "!=": P.NotEqual,
+}
+
+
+def _cast(e: Expression, dt) -> Expression:
+    from spark_rapids_tpu.expressions.cast import Cast
+    return Cast(e, dt)
+
+
+def _as_expr(v) -> Expression:
+    if isinstance(v, Expression):
+        return v
+    if isinstance(v, (_Null, _Module, _Fn, _Method)):
+        raise UdfCompileError(f"cannot use {type(v).__name__} as a value")
+    return Literal(v)
+
+
+class _Compiler:
+    def __init__(self, fn, params: Sequence[Expression]):
+        self.fn = fn
+        code = fn.__code__
+        names = code.co_varnames[:code.co_argcount]
+        if len(params) != code.co_argcount:
+            raise UdfCompileError(
+                f"UDF takes {code.co_argcount} args, got {len(params)} "
+                "input expressions")
+        self.args: Dict[str, Expression] = dict(zip(names, params))
+        self.instructions = list(dis.get_instructions(fn))
+        self.by_offset = {i.offset: idx
+                          for idx, i in enumerate(self.instructions)}
+        self.globals = fn.__globals__
+        self.closure = {}
+        if code.co_freevars and fn.__closure__:
+            for name, cell in zip(code.co_freevars, fn.__closure__):
+                self.closure[name] = cell.cell_contents
+        self._fuel = 8192   # combined instruction budget across forks
+
+    def compile(self) -> Expression:
+        return self._run(0, [], dict(self.args))
+
+    # -- symbolic interpreter ------------------------------------------------
+    def _run(self, idx: int, stack: List, local: Dict) -> Expression:
+        """Executes from instruction index ``idx`` until RETURN; forks on
+        conditional jumps."""
+        while True:
+            self._fuel -= 1
+            if self._fuel <= 0:
+                raise UdfCompileError("function too complex")
+            if idx >= len(self.instructions):
+                raise UdfCompileError("fell off the end of the bytecode")
+            ins = self.instructions[idx]
+            op = ins.opname
+            if op in ("RESUME", "NOP", "PRECALL", "CACHE", "MAKE_CELL",
+                      "COPY_FREE_VARS"):
+                idx += 1
+            elif op == "POP_TOP":
+                stack.pop()
+                idx += 1
+            elif op == "COPY":
+                stack.append(stack[-ins.arg])
+                idx += 1
+            elif op == "SWAP":
+                stack[-1], stack[-ins.arg] = stack[-ins.arg], stack[-1]
+                idx += 1
+            elif op == "PUSH_NULL":
+                stack.append(_Null())
+                idx += 1
+            elif op == "LOAD_CONST":
+                stack.append(Literal(ins.argval)
+                             if not isinstance(ins.argval, tuple)
+                             else ins.argval)
+                idx += 1
+            elif op == "RETURN_CONST":
+                return Literal(ins.argval)
+            elif op == "LOAD_FAST":
+                if ins.argval not in local:
+                    raise UdfCompileError(
+                        f"unbound local {ins.argval!r}")
+                stack.append(local[ins.argval])
+                idx += 1
+            elif op == "STORE_FAST":
+                local[ins.argval] = _as_expr(stack.pop())
+                idx += 1
+            elif op == "LOAD_DEREF":
+                if ins.argval not in self.closure:
+                    raise UdfCompileError(
+                        f"unknown closure variable {ins.argval!r}")
+                stack.append(Literal(self.closure[ins.argval]))
+                idx += 1
+            elif op == "LOAD_GLOBAL":
+                # low bit of raw arg: also push NULL (callable position)
+                if ins.arg & 1:
+                    stack.append(_Null())
+                stack.append(self._resolve_global(ins.argval))
+                idx += 1
+            elif op == "LOAD_ATTR":
+                recv = stack.pop()
+                if isinstance(recv, _Module):
+                    if recv.name == "math" and ins.argval in _MATH_FNS:
+                        cls = _MATH_FNS[ins.argval]
+                        fn = _Fn(f"math.{ins.argval}",
+                                 lambda a, c=cls: c(*a),
+                                 1 if not issubclass(cls, M.BinaryMath)
+                                 else 2)
+                        if ins.arg & 1:   # method-call form
+                            stack.append(fn)
+                            stack.append(_Null())
+                        else:
+                            stack.append(fn)
+                    else:
+                        raise UdfCompileError(
+                            f"unsupported module attribute "
+                            f"{recv.name}.{ins.argval}")
+                else:
+                    # method on an expression (string methods)
+                    if ins.arg & 1:
+                        m = _Method(_as_expr(recv), ins.argval)
+                        stack.append(m)
+                        stack.append(recv)   # self slot (ignored at CALL)
+                    else:
+                        raise UdfCompileError(
+                            f"attribute access .{ins.argval} not supported")
+                idx += 1
+            elif op == "CALL":
+                n = ins.arg
+                args = [stack.pop() for _ in range(n)][::-1]
+                self_or_null = stack.pop()
+                callee = stack.pop()
+                if isinstance(callee, _Null):
+                    callee, self_or_null = self_or_null, callee
+                stack.append(self._call(callee, self_or_null, args))
+                idx += 1
+            elif op == "BINARY_OP":
+                sym = ins.argrepr.rstrip("=")
+                if ins.argrepr.endswith("=") and ins.argrepr not in \
+                        ("<=", ">=", "==", "!="):
+                    pass   # in-place ops share the symbol
+                cls = _BINARY_OPS.get(sym)
+                if cls is None:
+                    raise UdfCompileError(
+                        f"binary operator {ins.argrepr!r} not supported")
+                b = _as_expr(stack.pop())
+                a = _as_expr(stack.pop())
+                stack.append(cls(a, b))
+                idx += 1
+            elif op == "COMPARE_OP":
+                sym = ins.argrepr
+                cls = _COMPARE_OPS.get(sym)
+                if cls is None:
+                    raise UdfCompileError(
+                        f"comparison {sym!r} not supported")
+                b = _as_expr(stack.pop())
+                a = _as_expr(stack.pop())
+                stack.append(cls(a, b))
+                idx += 1
+            elif op == "IS_OP":
+                b = stack.pop()
+                a = _as_expr(stack.pop())
+                if isinstance(b, Literal) and b.value is None:
+                    stack.append(P.IsNotNull(a) if ins.arg else P.IsNull(a))
+                else:
+                    raise UdfCompileError("`is` only supported against None")
+                idx += 1
+            elif op == "UNARY_NEGATIVE":
+                stack.append(A.UnaryMinus(_as_expr(stack.pop())))
+                idx += 1
+            elif op == "UNARY_NOT":
+                stack.append(P.Not(Truthy(_as_expr(stack.pop()))))
+                idx += 1
+            elif op == "UNARY_INVERT":
+                stack.append(B.BitwiseNot(_as_expr(stack.pop())))
+                idx += 1
+            elif op in ("JUMP_FORWARD", "JUMP_BACKWARD_NO_INTERRUPT"):
+                target = ins.argval
+                if target <= ins.offset:
+                    raise UdfCompileError("loops are not supported")
+                idx = self.by_offset[target]
+            elif op == "JUMP_BACKWARD":
+                raise UdfCompileError("loops are not supported")
+            elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE",
+                        "POP_JUMP_IF_NONE", "POP_JUMP_IF_NOT_NONE"):
+                raw = stack.pop()
+                if op.endswith("_NONE"):
+                    # `cond` must hold on the FALL-THROUGH path: the
+                    # interpreter jumps AWAY on None (IF_NONE), so falling
+                    # through means NOT-None, and vice versa
+                    e = _as_expr(raw)
+                    cond = P.IsNotNull(e) if op == "POP_JUMP_IF_NONE" \
+                        else P.IsNull(e)
+                else:
+                    # python truthiness, not a raw bitwise/no-op coercion
+                    cond = Truthy(_as_expr(raw))
+                    if op == "POP_JUMP_IF_TRUE":
+                        cond = P.Not(cond)
+                # cond False -> jump; True -> fall through
+                target = self.by_offset[ins.argval]
+                if ins.argval <= ins.offset:
+                    raise UdfCompileError("loops are not supported")
+                then_e = self._run(idx + 1, list(stack), dict(local))
+                else_e = self._run(target, list(stack), dict(local))
+                return K.If(cond, then_e, else_e)
+            elif op == "RETURN_VALUE":
+                return _as_expr(stack.pop())
+            elif op == "TO_BOOL":   # 3.13 forward-compat
+                idx += 1
+            else:
+                raise UdfCompileError(f"unsupported opcode {op}")
+
+    def _resolve_global(self, name: str):
+        if name in _BUILTIN_FNS:
+            return _BUILTIN_FNS[name]
+        v = self.globals.get(name, getattr(__import__("builtins"), name,
+                                           None))
+        if v is math:
+            return _Module("math")
+        if isinstance(v, types.ModuleType):
+            raise UdfCompileError(f"module {name!r} not supported")
+        if v is not None and not callable(v):
+            return Literal(v)          # global constant
+        raise UdfCompileError(f"global {name!r} not supported")
+
+    def _call(self, callee, self_or_null, args: List) -> Expression:
+        exprs = [_as_expr(a) for a in args]
+        if isinstance(callee, _Fn):
+            return callee.build(exprs)
+        if isinstance(callee, _Method):
+            m = _STRING_METHODS.get(callee.name)
+            if m is None:
+                raise UdfCompileError(
+                    f"method .{callee.name}() not supported")
+            return m(callee.recv, exprs)
+        raise UdfCompileError(f"cannot call {callee!r}")
+
+
+def compile_udf(fn, params: Sequence[Expression]) -> Expression:
+    """Translates ``fn``'s bytecode into an Expression over ``params``.
+    Raises UdfCompileError when any construct falls outside the supported
+    subset (caller falls back to the row UDF)."""
+    if not isinstance(fn, types.FunctionType):
+        raise UdfCompileError("only plain python functions are compilable")
+    return _Compiler(fn, params).compile()

@@ -214,9 +214,19 @@ def test_buffer_send_state_chunks_through_bounce_buffers():
     state = BufferSendState(9, [block], catalog, bounce)
     while not state.done:
         state.send_next(conn)
-    (header, payload), = conn.data_frames
-    assert header.block == block and header.frame_count == 1
-    assert deserialize_batch(payload).to_pydict() == hb.to_pydict()
+    # every chunk <= the bounce window; offsets tile the frame exactly
+    assert len(conn.data_frames) > 1
+    total = conn.data_frames[0][0].total_bytes
+    acc = bytearray(total)
+    covered = 0
+    for header, payload in conn.data_frames:
+        assert header.block == block and header.frame_count == 1
+        assert header.nbytes == len(payload) <= 128
+        acc[header.chunk_offset:header.chunk_offset + header.nbytes] = \
+            payload
+        covered += header.nbytes
+    assert covered == total
+    assert deserialize_batch(bytes(acc)).to_pydict() == hb.to_pydict()
     assert bounce.available == 2          # all returned to the pool
 
 
