@@ -98,6 +98,11 @@ def conf_float(key, doc, default, level=ConfLevel.COMMONLY_USED) -> ConfEntry[fl
     return ConfEntry(key, doc, default, float, level)
 
 
+def parse_bytes(s) -> int:
+    """Public byte-size parser ("512m", "1g", plain ints)."""
+    return _bytes_conv(str(s))
+
+
 def conf_str(key, doc, default, level=ConfLevel.COMMONLY_USED) -> ConfEntry[str]:
     return ConfEntry(key, doc, default, str, level)
 
@@ -254,6 +259,17 @@ SHUFFLE_COMPRESSION_CODEC = conf_str(
     "Codec for shuffle payloads: none | lz4 | zlib (reference nvcomp "
     "LZ4/ZSTD; here the libtpucol LZ4 block codec or zlib).",
     "lz4")
+
+FILECACHE_ENABLED = conf_bool(
+    "spark.rapids.filecache.enabled",
+    "Cache remote file ranges on local disk (reference: the closed-source "
+    "FileCache reimplemented open, SURVEY.md §2.7).",
+    False)
+
+FILECACHE_MAX_BYTES = conf_bytes(
+    "spark.rapids.filecache.maxBytes",
+    "Local disk budget for the file cache.",
+    "1g", ConfLevel.STARTUP)
 
 SHUFFLE_PARTITIONS = conf_int(
     "spark.sql.shuffle.partitions",

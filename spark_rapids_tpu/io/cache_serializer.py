@@ -1,0 +1,114 @@
+"""Cached-batch serializer: df.cache() storage.
+
+Reference: ParquetCachedBatchSerializer.scala (1407) — spark.sql.cache
+stores columnar batches as compressed parquet-encoded bytes on the host,
+encoded/decoded on the accelerator when possible.  Same design: each cached
+batch is an in-memory parquet file (schema + encodings + compression for
+free), decoded back through the normal scan machinery.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import List, Optional, Sequence
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import HostColumnarBatch, batch_from_arrow
+from spark_rapids_tpu.plan.base import Exec, LeafExec, UnaryExec
+
+
+def serialize_cached(hb: HostColumnarBatch, compression: str = "zstd"
+                     ) -> bytes:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    sink = io.BytesIO()
+    tab = pa.Table.from_batches([hb.to_arrow()])
+    pq.write_table(tab, sink, compression=compression)
+    return sink.getvalue()
+
+
+def deserialize_cached(data: bytes) -> HostColumnarBatch:
+    import pyarrow.parquet as pq
+    tab = pq.read_table(io.BytesIO(data))
+    return batch_from_arrow(tab)
+
+
+class CpuCachedScanExec(LeafExec):
+    """Scan over a materialized cache (reference: the InMemoryTableScan
+    path through the parquet cached-batch serializer).
+
+    ``materialize(child)`` runs the child plan ONCE and keeps each
+    partition as parquet-encoded bytes; re-executions decode from the
+    cache."""
+
+    def __init__(self, schema: T.StructType, num_partitions: int):
+        super().__init__()
+        self._schema = schema
+        self._parts = num_partitions
+        self._cache: Optional[List[List[bytes]]] = None
+        self.compression = "zstd"
+
+    @property
+    def schema(self):
+        return self._schema
+
+    @property
+    def num_partitions(self):
+        return self._parts
+
+    @property
+    def is_materialized(self) -> bool:
+        return self._cache is not None
+
+    def materialize(self, child: Exec) -> "CpuCachedScanExec":
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        cache: List[List[bytes]] = []
+        for p in range(child.num_partitions):
+            frames = []
+            for b in child.execute_partition(p):
+                if isinstance(b, ColumnarBatch):
+                    b = b.to_host()
+                frames.append(serialize_cached(b, self.compression))
+            cache.append(frames)
+        self._cache = cache
+        return self
+
+    def cached_bytes(self) -> int:
+        if self._cache is None:
+            return 0
+        return sum(len(f) for part in self._cache for f in part)
+
+    def execute_partition(self, pidx: int):
+        if self._cache is None:
+            raise RuntimeError("cache not materialized")
+        for frame in self._cache[pidx]:
+            yield deserialize_cached(frame)
+
+    def node_desc(self):
+        state = "materialized" if self.is_materialized else "pending"
+        return f"CachedScan[{self._parts}p, {state}]"
+
+
+class TpuCachedScanExec(CpuCachedScanExec):
+    is_device = True
+
+    def __init__(self, cpu: CpuCachedScanExec):
+        super().__init__(cpu.schema, cpu.num_partitions)
+        self._cache = cpu._cache
+        self.compression = cpu.compression
+
+    def execute_partition(self, pidx):
+        from spark_rapids_tpu.exec.basic import upload_batches
+        yield from upload_batches(super().execute_partition(pidx))
+
+    def node_desc(self):
+        return "Tpu" + super().node_desc()
+
+
+from spark_rapids_tpu.plan import typechecks as TS  # noqa: E402
+from spark_rapids_tpu.plan.overrides import register_exec  # noqa: E402
+
+register_exec(CpuCachedScanExec,
+              convert=lambda p, m: TpuCachedScanExec(p),
+              sig=TS.BASIC_WITH_ARRAYS,
+              desc="parquet-encoded in-memory cache scan")

@@ -1,0 +1,136 @@
+"""Local file-range cache.
+
+Reference: the closed-source FileCache (SURVEY.md §2.7 — caches remote file
+ranges, footers and data chunks, on local disk; hooks in GpuParquetScan/
+GpuOrcScan, locality manager on the driver).  Reimplemented open: an
+LRU-bounded local store keyed by (path, mtime, offset, length), so repeated
+scans of remote files hit local disk.
+
+Scans call ``get_range(path, offset, length, loader)`` — loader reads from
+the source on miss.  Local files bypass the cache (no benefit)."""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import os
+import tempfile
+import threading
+from typing import Callable, Optional
+
+from spark_rapids_tpu.io.multifile import is_cloud_path
+
+
+class FileCache:
+    def __init__(self, directory: Optional[str] = None,
+                 max_bytes: int = 1 << 30):
+        self.dir = directory or tempfile.mkdtemp(prefix="tpu_filecache_")
+        os.makedirs(self.dir, exist_ok=True)
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        # key -> size, in LRU order (move_to_end on hit)
+        self._entries: "collections.OrderedDict[str, int]" = \
+            collections.OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def _key(self, path: str, mtime: float, offset: int, length: int) -> str:
+        h = hashlib.sha256(
+            f"{path}|{mtime}|{offset}|{length}".encode()).hexdigest()[:32]
+        return h
+
+    def _local_path(self, key: str) -> str:
+        return os.path.join(self.dir, key)
+
+    def get_range(self, path: str, offset: int, length: int,
+                  loader: Callable[[], bytes],
+                  mtime: Optional[float] = None) -> bytes:
+        """Cached read of ``path[offset:offset+length]``; loader supplies
+        the bytes on miss.  mtime participates in the key so stale entries
+        die with the source file's modification."""
+        if mtime is None:
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                mtime = 0.0
+        key = self._key(path, mtime, offset, length)
+        lp = self._local_path(key)
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                hit = True
+            else:
+                hit = False
+        if hit:
+            try:
+                with open(lp, "rb") as f:
+                    return f.read()
+            except OSError:
+                pass   # evicted underneath us; fall through to load
+        data = loader()
+        with self._lock:
+            self.misses += 1
+            if key not in self._entries:
+                tmp = lp + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, lp)
+                self._entries[key] = len(data)
+                self._bytes += len(data)
+                self._evict_locked()
+        return data
+
+    def _evict_locked(self) -> None:
+        while self._bytes > self.max_bytes and self._entries:
+            key, size = self._entries.popitem(last=False)
+            self._bytes -= size
+            try:
+                os.unlink(self._local_path(key))
+            except OSError:
+                pass
+
+    @property
+    def cached_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def clear(self) -> None:
+        with self._lock:
+            for key in list(self._entries):
+                try:
+                    os.unlink(self._local_path(key))
+                except OSError:
+                    pass
+            self._entries.clear()
+            self._bytes = 0
+
+
+_ACTIVE: Optional[FileCache] = None
+_LOCK = threading.Lock()
+
+
+def get_file_cache(conf=None) -> Optional[FileCache]:
+    """The process-wide cache, created on first use when enabled
+    (reference: FileCache.init from the executor plugin)."""
+    global _ACTIVE
+    from spark_rapids_tpu import config as C
+    with _LOCK:
+        if _ACTIVE is None and conf is not None and \
+                str(conf.get(C.FILECACHE_ENABLED.key)).lower() == "true":
+            _ACTIVE = FileCache(
+                max_bytes=int(conf.get(C.FILECACHE_MAX_BYTES.key)))
+        return _ACTIVE
+
+
+def cached_read(path: str, conf=None) -> bytes:
+    """Whole-file cached read for remote paths; local paths read directly
+    (the integration point the scans use)."""
+    cache = get_file_cache(conf)
+    if cache is None or not is_cloud_path(path):
+        with open(path, "rb") as f:
+            return f.read()
+    size = os.path.getsize(path)
+    return cache.get_range(path, 0, size,
+                           lambda: open(path, "rb").read())

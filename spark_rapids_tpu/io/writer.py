@@ -43,13 +43,20 @@ def _write_json(batches, path, schema):
     write_json(batches, path, schema)
 
 
+@_register("avro")
+def _write_avro(batches, path, schema, **opts):
+    from spark_rapids_tpu.io.avro import write_avro
+    write_avro(batches, path, schema, **opts)
+
+
 @_register("orc")
 def _write_orc(batches, path, schema):
     from spark_rapids_tpu.io.orc import write_orc
     write_orc(batches, path, schema)
 
 
-_EXT = {"parquet": ".parquet", "csv": ".csv", "json": ".json", "orc": ".orc"}
+_EXT = {"parquet": ".parquet", "csv": ".csv", "json": ".json",
+        "orc": ".orc", "avro": ".avro"}
 
 
 class DataFrameWriter:
@@ -81,6 +88,9 @@ class DataFrameWriter:
 
     def json(self, path: str):
         self._save(path, "json")
+
+    def avro(self, path: str):
+        return self._save(path, "avro")
 
     def orc(self, path: str):
         self._save(path, "orc")

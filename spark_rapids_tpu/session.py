@@ -134,6 +134,12 @@ class TpuSession:
                 CpuOrcScanExec(list(paths), columns=columns,
                                **self._common(C.ORC_READER_TYPE)), self._s)
 
+        def avro(self, *paths, columns=None) -> "DataFrame":
+            from spark_rapids_tpu.io.avro import CpuAvroScanExec
+            return DataFrame(
+                CpuAvroScanExec(list(paths), columns=columns,
+                                **self._common(C.READER_TYPE)), self._s)
+
     @property
     def read(self) -> "_Reader":
         return TpuSession._Reader(self)
@@ -507,6 +513,16 @@ class DataFrame:
 
     def distinct(self) -> "DataFrame":
         return self.group_by(*self.columns).agg()
+
+    def cache(self) -> "DataFrame":
+        """Materializes this plan once into compressed parquet-encoded host
+        batches (reference: ParquetCachedBatchSerializer); later actions
+        scan the cache."""
+        from spark_rapids_tpu.io.cache_serializer import CpuCachedScanExec
+        executed = self._executed_plan()
+        scan = CpuCachedScanExec(self.schema, executed.num_partitions)
+        scan.materialize(executed)
+        return DataFrame(scan, self._session)
 
     drop_duplicates = distinct
 
