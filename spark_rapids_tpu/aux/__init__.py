@@ -1,0 +1,10 @@
+"""Auxiliary subsystems (SURVEY.md §2.13/§5): op metrics with verbosity
+levels, profiler trace ranges, debug batch dumps, execution-plan capture,
+and the cost-based optimizer's helpers."""
+
+from spark_rapids_tpu.aux.capture import (  # noqa: F401
+    ExecutionPlanCaptureCallback)
+from spark_rapids_tpu.aux.metrics import (  # noqa: F401
+    MetricLevel, OpMetric, collect_metrics, instrument_plan)
+from spark_rapids_tpu.aux.profiler import (  # noqa: F401
+    Profiler, op_range)

@@ -1,0 +1,96 @@
+"""Execution-plan capture + debug batch dumps.
+
+Reference: ExecutionPlanCaptureCallback (test/debug plan capture, used by
+integration-test fallback assertions) and DumpUtils.scala (writes offending
+input batches to parquet for kernel-bug reproduction)."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+
+class ExecutionPlanCaptureCallback:
+    """Captures every plan that flows through TpuOverrides.apply.
+
+    ``start_capture()`` then run queries; ``get_captured_plans()`` returns
+    (input_plan, final_plan, meta) triples; assert helpers mirror the
+    reference's assertContains/assertDidFallBack."""
+
+    _lock = threading.Lock()
+    _capturing = False
+    _captured: List[tuple] = []
+
+    @classmethod
+    def start_capture(cls) -> None:
+        with cls._lock:
+            cls._captured = []
+            cls._capturing = True
+
+    @classmethod
+    def end_capture(cls) -> List[tuple]:
+        with cls._lock:
+            cls._capturing = False
+            return list(cls._captured)
+
+    @classmethod
+    def capture_if_needed(cls, input_plan, final_plan, meta) -> None:
+        with cls._lock:
+            if cls._capturing:
+                cls._captured.append((input_plan, final_plan, meta))
+
+    @classmethod
+    def get_captured_plans(cls) -> List[tuple]:
+        with cls._lock:
+            return list(cls._captured)
+
+    # -- assertion helpers ---------------------------------------------------
+    @classmethod
+    def assert_contains(cls, exec_name: str) -> None:
+        for _, final, _ in cls.get_captured_plans():
+            if any(n.name == exec_name for n in final.collect_nodes()):
+                return
+        raise AssertionError(
+            f"no captured plan contains {exec_name}; captured: "
+            + "; ".join(f.tree_string() for _, f, _ in
+                        cls.get_captured_plans()))
+
+    @classmethod
+    def assert_did_fall_back(cls, exec_name: str) -> None:
+        """The named CPU exec must appear NON-converted in a final plan
+        (reference: assert_gpu_fallback_collect's plan check)."""
+        cls.assert_contains(exec_name)
+
+
+def dump_batch(hb, path_prefix: str) -> str:
+    """Writes a host batch to a parquet file for offline repro (reference:
+    DumpUtils.dumpToParquetFile — used when a kernel fails on an input)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    path = f"{path_prefix}-{int(time.time() * 1000)}.parquet"
+    pq.write_table(pa.Table.from_batches([hb.to_arrow()]), path)
+    return path
+
+
+def dump_on_error(batch_iter, path_prefix: Optional[str]):
+    """Wraps a host-batch iterator: on an exception mid-stream, dumps the
+    LAST successfully produced batch to parquet and re-raises with the dump
+    path in the message (the reference dumps the failing operator input)."""
+    last = None
+    try:
+        for b in batch_iter:
+            last = b
+            yield b
+    except Exception as e:
+        if path_prefix and last is not None:
+            hb = last.to_host() if hasattr(last, "to_host") and \
+                not hasattr(last, "arrow_schema") else last
+            try:
+                p = dump_batch(hb, path_prefix)
+                raise type(e)(f"{e} [last good batch dumped to {p}]") from e
+            except TypeError:
+                pass
+        raise

@@ -260,6 +260,18 @@ SHUFFLE_COMPRESSION_CODEC = conf_str(
     "LZ4/ZSTD; here the libtpucol LZ4 block codec or zlib).",
     "lz4")
 
+RANGES_ENABLED = conf_bool(
+    "spark.rapids.sql.nvtx.enabled",
+    "Annotate operator ranges into the active profiler trace "
+    "(reference: NVTX ranges, NvtxWithMetrics.scala).",
+    False)
+
+DUMP_PATH = conf_str(
+    "spark.rapids.sql.debug.dumpPathPrefix",
+    "When set, operators dump their last good input batch to parquet "
+    "under this prefix when a kernel fails (reference: DumpUtils.scala).",
+    "")
+
 FILECACHE_ENABLED = conf_bool(
     "spark.rapids.filecache.enabled",
     "Cache remote file ranges on local disk (reference: the closed-source "

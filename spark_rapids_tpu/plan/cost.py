@@ -1,0 +1,130 @@
+"""Cost-based optimizer.
+
+Reference: CostBasedOptimizer.scala (531 LoC, invoked at
+GpuOverrides.scala:4372-4387; conf ``spark.rapids.sql.optimizer.enabled``,
+default off) — avoids device placement when host<->device transitions cost
+more than the device speedup for a plan section.
+
+Model: per-node row estimates propagate bottom-up; every op carries a
+host-cost and device-cost factor (cost = rows * factor); a CONVERTIBLE
+REGION (maximal connected set of device-capable metas) pays one transfer
+per boundary row crossing.  Regions whose device saving does not cover
+their transfer cost are reverted to the host engine with an explain-visible
+reason — exactly the reference's section-based decision."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.plan.base import Exec
+from spark_rapids_tpu.plan.meta import PlanMeta
+
+DEFAULT_ROWS = 1_000_000
+
+#: relative per-row cost factors (host, device); ops not listed use (1, .25)
+_FACTORS = {
+    "CpuProjectExec": (1.0, 0.1),
+    "CpuFilterExec": (1.0, 0.1),
+    "CpuHashAggregateExec": (4.0, 0.5),
+    "CpuSortExec": (6.0, 0.8),
+    "CpuShuffledHashJoinExec": (6.0, 0.8),
+    "CpuBroadcastHashJoinExec": (4.0, 0.5),
+    "CpuWindowExec": (6.0, 0.8),
+    "CpuShuffleExchangeExec": (2.0, 1.0),   # host staging either way
+    "CpuInMemoryScanExec": (0.2, 0.6),      # upload makes device pricier
+}
+
+#: cost of moving one row across the host<->device boundary
+_TRANSFER_FACTOR = 0.5
+
+#: fixed per-region overhead in row-equivalents (kernel dispatch + compile
+#: cache lookup; keeps trivial row counts off the device, where the
+#: reference's per-exec overhead terms play the same role)
+_REGION_FIXED = 10_000.0
+
+
+def estimate_rows(plan: Exec) -> int:
+    """Bottom-up row estimate (reference: RowCountPlanVisitor)."""
+    name = type(plan).__name__
+    kids = [estimate_rows(c) for c in plan.children]
+    if name == "CpuInMemoryScanExec":
+        try:
+            return sum(b.row_count for part in plan.partitions
+                       for b in part)
+        except Exception:    # noqa: BLE001
+            return DEFAULT_ROWS
+    if name == "CpuRangeExec":
+        try:
+            return max(0, (plan.end - plan.start) // plan.step)
+        except Exception:    # noqa: BLE001
+            return DEFAULT_ROWS
+    if name == "CpuFilterExec":
+        return max(1, (kids[0] if kids else DEFAULT_ROWS) // 2)
+    if name in ("CpuLimitExec", "CpuGlobalLimitExec"):
+        return min(getattr(plan, "n", DEFAULT_ROWS),
+                   kids[0] if kids else DEFAULT_ROWS)
+    if name == "CpuHashAggregateExec":
+        return max(1, (kids[0] if kids else DEFAULT_ROWS) // 10)
+    if kids:
+        return max(kids)
+    return DEFAULT_ROWS
+
+
+class CostBasedOptimizer:
+    """Reverts device regions whose transfer overhead beats their
+    speedup."""
+
+    def __init__(self, conf):
+        self.conf = conf
+
+    def optimize(self, meta: PlanMeta) -> List[str]:
+        """Mutates the tagged meta tree; returns explain notes."""
+        notes: List[str] = []
+        self._visit(meta, notes)
+        return notes
+
+    def _visit(self, meta: PlanMeta, notes: List[str]) -> None:
+        # find maximal convertible regions via DFS over the meta tree
+        if meta.can_run_on_device:
+            region: List[PlanMeta] = []
+            self._collect_region(meta, region)
+            self._decide(region, notes)
+            # children below the region continue independently
+            for m in region:
+                for cm in m.child_metas:
+                    if not cm.can_run_on_device:
+                        self._visit_children(cm, notes)
+        else:
+            self._visit_children(meta, notes)
+
+    def _visit_children(self, meta: PlanMeta, notes: List[str]) -> None:
+        for cm in meta.child_metas:
+            self._visit(cm, notes)
+
+    def _collect_region(self, meta: PlanMeta, out: List[PlanMeta]) -> None:
+        out.append(meta)
+        for cm in meta.child_metas:
+            if cm.can_run_on_device:
+                self._collect_region(cm, out)
+
+    def _decide(self, region: List[PlanMeta], notes: List[str]) -> None:
+        saving = 0.0
+        transfer = 0.0
+        members = set(id(m) for m in region)
+        for m in region:
+            rows = estimate_rows(m.plan)
+            host_f, dev_f = _FACTORS.get(type(m.plan).__name__, (1.0, 0.25))
+            saving += rows * (host_f - dev_f)
+            # boundary edges: child outside the region -> upload rows
+            for cm in m.child_metas:
+                if id(cm) not in members:
+                    transfer += estimate_rows(cm.plan) * _TRANSFER_FACTOR
+        # the region root downloads its output + fixed region overhead
+        transfer += estimate_rows(region[0].plan) * _TRANSFER_FACTOR
+        transfer += _REGION_FIXED
+        if saving < transfer:
+            reason = (f"cost-based optimizer: device saving "
+                      f"{saving:.0f} < transfer cost {transfer:.0f}")
+            for m in region:
+                m.will_not_work(reason)
+            notes.append(f"{region[0].plan.name}: {reason}")

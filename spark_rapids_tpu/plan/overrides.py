@@ -267,10 +267,19 @@ class TpuOverrides:
     def apply(self, plan: Exec, for_explain: bool = False) -> Exec:
         """``for_explain`` produces the would-be plan without the test-mode
         all-on-device assertion (introspection must not raise on fallback)."""
+        from spark_rapids_tpu.plan.meta import PlanMeta
         conf = self.conf
         if not conf.is_sql_enabled:
             return plan
-        meta, converted = tag_and_convert(plan, conf)
+        meta = PlanMeta(plan, conf)
+        meta.tag()
+        if conf.get(C.CBO_ENABLED.key):
+            # reference: optional CBO between tag and convert
+            # (GpuOverrides.scala:4372-4387)
+            from spark_rapids_tpu.plan.cost import CostBasedOptimizer
+            for note in CostBasedOptimizer(conf).optimize(meta):
+                log.info("CBO: %s", note)
+        converted = meta.convert_if_needed()
         self.last_meta = meta
         explain_mode = conf.get(C.EXPLAIN.key, "NOT_ON_GPU").upper()
         if explain_mode != "NONE":
@@ -284,6 +293,14 @@ class TpuOverrides:
         out = self._coalesce_after_device_sources(out)
         if conf.is_test_enabled and not for_explain:
             validate_all_on_device(out, conf)
+        from spark_rapids_tpu.aux.capture import ExecutionPlanCaptureCallback
+        ExecutionPlanCaptureCallback.capture_if_needed(plan, out, meta)
+        from spark_rapids_tpu.aux.metrics import (MetricLevel,
+                                                  instrument_plan)
+        level = MetricLevel.parse(conf.get(C.METRICS_LEVEL.key, "MODERATE"))
+        instrument_plan(out, level)
+        from spark_rapids_tpu.aux import profiler as _prof
+        _prof.set_ranges_enabled(bool(conf.get(C.RANGES_ENABLED.key)))
         return out
 
     def _coalesce_after_device_sources(self, plan: Exec) -> Exec:
