@@ -394,3 +394,36 @@ def parse_url(e, part: str, key=None):
     if key is not None and not isinstance(key, Expression):
         key = lit(key)
     return ParseUrl(_expr(e), part, key)
+
+
+# -- string function wrappers ------------------------------------------------
+
+def length(e):
+    from spark_rapids_tpu.expressions.strings import Length
+    return Length(_expr(e))
+
+
+def upper(e):
+    from spark_rapids_tpu.expressions.strings import Upper
+    return Upper(_expr(e))
+
+
+def lower(e):
+    from spark_rapids_tpu.expressions.strings import Lower
+    return Lower(_expr(e))
+
+
+def substring(e, pos: int, length_: int):
+    from spark_rapids_tpu.expressions.base import lit
+    from spark_rapids_tpu.expressions.strings import Substring
+    return Substring(_expr(e), lit(pos), lit(length_))
+
+
+def concat(*cols):
+    from spark_rapids_tpu.expressions.strings import Concat
+    return Concat(*[_expr(c) for c in cols])
+
+
+def trim(e):
+    from spark_rapids_tpu.expressions.strings import Trim
+    return Trim(_expr(e))

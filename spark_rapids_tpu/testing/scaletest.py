@@ -1,0 +1,144 @@
+"""ScaleTest: the stress-query suite + runner.
+
+Reference: integration_tests/.../scaletest/ScaleTest.scala — a CLI app
+running a fixed suite of join/agg/window stress queries over generated
+tables, reporting per-query runtime and failures (documented in
+integration_tests/ScaleTest.md).  Tables come from the datagen module
+(ScaleTestDataGen analog)."""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import Alias, col, lit
+from spark_rapids_tpu.testing.datagen import (DateGen, DoubleGen, IntegerGen,
+                                              LongGen, StringGen, gen_df)
+
+
+def build_tables(session, scale_rows: int = 10_000, seed: int = 7,
+                 parts: int = 2) -> Dict[str, object]:
+    """The suite's input tables (ScaleTestDataGen analog): a fact table and
+    two dimensions with skewed keys."""
+    fact = gen_df(session, [
+        ("key", LongGen(nullable=False, min_val=0,
+                        max_val=max(1, scale_rows // 10))),
+        ("key2", IntegerGen(min_val=0, max_val=100)),
+        ("v1", DoubleGen()),
+        ("v2", LongGen(min_val=-1000, max_val=1000)),
+        ("s", StringGen(max_len=12)),
+        ("d", DateGen()),
+    ], length=scale_rows, seed=seed, num_partitions=parts)
+    dim = gen_df(session, [
+        ("key", LongGen(nullable=False, min_val=0,
+                        max_val=max(1, scale_rows // 10))),
+        ("name", StringGen(nullable=False, max_len=8)),
+        ("weight", DoubleGen(no_nans=True)),
+    ], length=max(10, scale_rows // 10), seed=seed + 1, num_partitions=parts)
+    dim2 = gen_df(session, [
+        ("key2", IntegerGen(nullable=False, min_val=0, max_val=100)),
+        ("grp", StringGen(nullable=False, max_len=4)),
+    ], length=101, seed=seed + 2)
+    return {"fact": fact, "dim": dim, "dim2": dim2}
+
+
+def _queries() -> List:
+    from spark_rapids_tpu import functions as F
+
+    def q_agg_sum(t):
+        return (t["fact"].group_by("key2")
+                .agg(Alias(F.sum(col("v1")), "sv"),
+                     Alias(F.count(col("v2")), "c")))
+
+    def q_agg_multi(t):
+        return (t["fact"].group_by("key2")
+                .agg(Alias(F.min(col("v2")), "mn"),
+                     Alias(F.max(col("v2")), "mx"),
+                     Alias(F.avg(col("v1")), "av")))
+
+    def q_join_inner(t):
+        return t["fact"].join(t["dim"], on="key", how="inner") \
+            .select(col("key"), col("name"), col("v1"))
+
+    def q_join_left(t):
+        return t["fact"].join(t["dim"], on="key", how="left")
+
+    def q_join_two(t):
+        return (t["fact"].join(t["dim"], on="key", how="inner")
+                .join(t["dim2"], on="key2", how="inner")
+                .group_by("grp").agg(Alias(F.sum(col("weight")), "w")))
+
+    def q_sort_limit(t):
+        return t["fact"].order_by("v2", ascending=False).limit(100)
+
+    def q_filter_project(t):
+        return (t["fact"].filter(col("v2") > lit(0))
+                .select(Alias(col("v1") * lit(2.0), "v"),
+                        Alias(F.length(col("s")), "sl")))
+
+    def q_distinct(t):
+        return t["fact"].select(col("key2")).distinct()
+
+    def q_window_rank(t):
+        from spark_rapids_tpu.functions import Window, rank
+        spec = Window.partition_by("key2").order_by("v2")
+        return t["fact"].select(col("key2"), col("v2"),
+                                Alias(rank().over(spec), "r"))
+
+    def q_union_count(t):
+        return t["fact"].union(t["fact"]).group_by("key2").count()
+
+    return [("agg_sum", q_agg_sum), ("agg_multi", q_agg_multi),
+            ("join_inner", q_join_inner), ("join_left", q_join_left),
+            ("join_two_dims", q_join_two), ("sort_limit", q_sort_limit),
+            ("filter_project", q_filter_project), ("distinct", q_distinct),
+            ("window_rank", q_window_rank), ("union_count", q_union_count)]
+
+
+def run_scale_test(session, scale_rows: int = 10_000, seed: int = 7,
+                   iterations: int = 1,
+                   queries: Optional[List[str]] = None) -> List[dict]:
+    """Runs the suite; returns per-query reports (name, rows, seconds,
+    status) — the ScaleTest report JSON."""
+    tables = build_tables(session, scale_rows, seed)
+    picked = _queries()
+    if queries:
+        picked = [(n, q) for n, q in picked if n in queries]
+    report = []
+    for name, q in picked:
+        for it in range(iterations):
+            t0 = time.perf_counter()
+            try:
+                rows = len(q(tables).collect())
+                report.append({"query": name, "iteration": it,
+                               "rows": rows, "status": "OK",
+                               "seconds": round(time.perf_counter() - t0,
+                                                4)})
+            except Exception as e:   # noqa: BLE001 - reported, not raised
+                report.append({"query": name, "iteration": it, "rows": 0,
+                               "status": f"FAILED: {e}",
+                               "seconds": round(time.perf_counter() - t0,
+                                                4)})
+    return report
+
+
+def main(argv=None):
+    """CLI: python -m spark_rapids_tpu.testing.scaletest [rows]."""
+    import sys
+    argv = argv if argv is not None else sys.argv[1:]
+    rows = int(argv[0]) if argv else 100_000
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.session import TpuSession
+    s = TpuSession(TpuConf({"spark.rapids.sql.enabled": "true"}))
+    report = run_scale_test(s, scale_rows=rows)
+    print(json.dumps(report, indent=2))
+    failed = [r for r in report if r["status"] != "OK"]
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
