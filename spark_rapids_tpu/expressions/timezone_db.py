@@ -1,0 +1,289 @@
+"""Timezone database + UTC<->zone conversion kernels.
+
+Reference: TimeZoneDB.scala (188) + JNI ``GpuTimeZoneDB`` — the reference
+loads the tz database's transition tables to the device once and converts
+timestamps with a binary-search kernel; non-UTC session timezones gate on
+it (GpuOverrides nonUTC checks).
+
+TPU design: parse the TZif files (RFC 8536) straight from the zoneinfo
+path into numpy transition tables (UTC transition instants in MICROSECONDS
++ UTC offsets in seconds); conversion is ``searchsorted`` + gather — pure
+elementwise device work that fuses like any other expression kernel.
+
+Local->UTC handles the classic DST edge cases the way java.time (and so
+Spark) does: ambiguous local times (fall-back overlap) take the EARLIER
+offset; non-existent local times (spring-forward gap) shift forward by the
+gap."""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import (EvalContext, TCol, jnp,
+                                               valid_array)
+
+_US = 1_000_000
+
+
+def _find_tzfile(zone: str) -> str:
+    import zoneinfo
+    for base in zoneinfo.TZPATH:
+        p = os.path.join(base, zone)
+        if os.path.exists(p):
+            return p
+    # pip tzdata package fallback
+    try:
+        import importlib.resources as res
+        import tzdata  # noqa: F401
+        parts = zone.split("/")
+        ref = res.files("tzdata.zoneinfo").joinpath(*parts)
+        if ref.is_file():
+            return str(ref)
+    except Exception:   # noqa: BLE001
+        pass
+    raise KeyError(f"unknown timezone {zone!r}")
+
+
+def _parse_tzif(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """(transition instants in us, offsets in seconds) — offsets[i] applies
+    from transitions[i] (transitions[0] = -inf sentinel)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if data[:4] != b"TZif":
+        raise ValueError(f"{path} is not a TZif file")
+
+    def parse_block(off: int, long_format: bool):
+        (isutcnt, isstdcnt, leapcnt, timecnt, typecnt,
+         charcnt) = struct.unpack_from(">6I", data, off + 20)
+        pos = off + 44
+        tsize = 8 if long_format else 4
+        fmt = ">%dq" % timecnt if long_format else ">%di" % timecnt
+        trans = np.array(struct.unpack_from(fmt, data, pos), dtype=np.int64)
+        pos += timecnt * tsize
+        idx = np.frombuffer(data, dtype=np.uint8, count=timecnt,
+                            offset=pos)
+        pos += timecnt
+        ttinfo = []
+        for i in range(typecnt):
+            utoff, isdst, abbrind = struct.unpack_from(">iBB", data, pos)
+            ttinfo.append(utoff)
+            pos += 6
+        pos += charcnt + leapcnt * (tsize + 4) + isstdcnt + isutcnt
+        return trans, idx, np.array(ttinfo, dtype=np.int64), pos
+
+    version = data[4:5]
+    trans, idx, offs, end = parse_block(0, False)
+    if version in (b"2", b"3"):
+        # v2+ block follows with 64-bit transitions (authoritative)
+        trans, idx, offs, _ = parse_block(end, True)
+    if len(trans) == 0:
+        base = offs[0] if len(offs) else 0
+        return (np.array([np.iinfo(np.int64).min // 2], dtype=np.int64),
+                np.array([base], dtype=np.int64))
+    # initial offset: the first ttinfo (per RFC, the type used before the
+    # first transition is the first non-dst type; first entry is close
+    # enough for the reference's supported range)
+    instants = np.concatenate(
+        [[np.iinfo(np.int64).min // 2], trans * _US])
+    offsets = np.concatenate([[offs[idx[0]]], offs[idx]])
+    return instants, offsets
+
+
+class TimeZoneDB:
+    """Per-zone transition tables, parsed once and cached (reference:
+    GpuTimeZoneDB.cacheDatabase)."""
+
+    _lock = threading.Lock()
+    _cache: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+
+    @classmethod
+    def tables(cls, zone: str) -> Tuple[np.ndarray, np.ndarray]:
+        with cls._lock:
+            t = cls._cache.get(zone)
+        if t is not None:
+            return t
+        if zone in ("UTC", "Z", "GMT", "+00:00"):
+            t = (np.array([np.iinfo(np.int64).min // 2], dtype=np.int64),
+                 np.array([0], dtype=np.int64))
+        else:
+            t = _parse_tzif(_find_tzfile(zone))
+        with cls._lock:
+            cls._cache[zone] = t
+        return t
+
+    @classmethod
+    def utc_to_local_us(cls, ts_us, zone: str, xp):
+        """timestamp (us since epoch, UTC) -> local wall-clock micros."""
+        instants, offsets = cls.tables(zone)
+        instants = xp.asarray(instants)
+        offsets = xp.asarray(offsets)
+        i = xp.searchsorted(instants, ts_us, side="right") - 1
+        i = xp.clip(i, 0, len(offsets) - 1)
+        return ts_us + xp.take(offsets, i) * _US
+
+    @classmethod
+    def local_to_utc_us(cls, local_us, zone: str, xp):
+        """local wall-clock micros -> UTC micros (earlier offset on
+        overlap; gap times shift forward, java.time semantics)."""
+        instants, offsets = cls.tables(zone)
+        # each interval's local-time start, using its own offset
+        lb = xp.asarray(instants + offsets * _US)
+        offs = xp.asarray(offsets)
+        inst = xp.asarray(instants)
+        i = xp.searchsorted(lb, local_us, side="right") - 1
+        i = xp.clip(i, 0, len(offs) - 1)
+        # fall-back overlap: the PREVIOUS interval's local window ends at
+        # instants[i] + offs[i-1] (its offset applied to its utc end); a
+        # value still inside it is ambiguous -> earlier offset wins
+        prev = xp.clip(i - 1, 0, len(offs) - 1)
+        prev_end_local = xp.take(inst, i) + xp.take(offs, prev) * _US
+        amb = (local_us < prev_end_local) & (i > 0)
+        idx = xp.where(amb, prev, i)
+        # spring-forward gap values resolve against the pre-transition
+        # offset naturally (searchsorted lands on it), which shifts them
+        # forward by the gap — java.time semantics
+        return local_us - xp.take(offs, idx) * _US
+
+
+# ---------------------------------------------------------------------------
+# expressions
+# ---------------------------------------------------------------------------
+
+from spark_rapids_tpu.expressions.arithmetic import UnaryExpr  # noqa: E402
+
+
+class _TzConvert(UnaryExpr):
+    to_local = True
+
+    def __init__(self, child, zone: str):
+        super().__init__(child)
+        if not isinstance(zone, str):
+            raise TypeError("timezone must be a literal string")
+        self.zone = zone
+        TimeZoneDB.tables(zone)   # validate eagerly (planner-time error)
+
+    @property
+    def data_type(self):
+        return T.TIMESTAMP
+
+    def sql(self):
+        return f"{self.name}({self.child.sql()}, '{self.zone}')"
+
+    def _eval(self, ctx, xp):
+        from spark_rapids_tpu.expressions.base import materialize
+        c = self.child.eval(ctx)
+        data = materialize(c, ctx, np.dtype(np.int64))
+        if self.to_local:
+            out = TimeZoneDB.utc_to_local_us(data, self.zone, xp)
+        else:
+            out = TimeZoneDB.local_to_utc_us(data, self.zone, xp)
+        return TCol(out, valid_array(c, ctx), T.TIMESTAMP)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx, np)
+
+
+class FromUTCTimestamp(_TzConvert):
+    """from_utc_timestamp(ts, zone) (reference GpuFromUTCTimestamp via
+    GpuTimeZoneDB)."""
+    to_local = True
+
+
+class ToUTCTimestamp(_TzConvert):
+    """to_utc_timestamp(ts, zone)."""
+    to_local = False
+
+
+# ---------------------------------------------------------------------------
+# julian <-> proleptic-gregorian rebase (reference: DateTimeRebase JNI +
+# datetimeRebaseUtils.scala — parquet LEGACY mode wrote julian days)
+# ---------------------------------------------------------------------------
+
+_SWITCH_DAYS = -141427          # 1582-10-15 in proleptic gregorian days
+_JDN_EPOCH = 2440588            # julian day number of 1970-01-01 gregorian
+
+
+def _julian_civil_from_days(n: np.ndarray):
+    """Hybrid day count (julian calendar) -> (y, m, d), vectorized
+    (standard JDN->julian-calendar arithmetic)."""
+    jdn = n + _JDN_EPOCH
+    a = jdn + 32082
+    b = (4 * a + 3) // 1461
+    c = a - (1461 * b) // 4
+    d2 = (5 * c + 2) // 153
+    day = c - (153 * d2 + 2) // 5 + 1
+    month = d2 + 3 - 12 * (d2 // 10)
+    year = b - 4800 + d2 // 10
+    return year, month, day
+
+
+def _days_from_julian_civil(y, m, d):
+    """(julian calendar y, m, d) -> hybrid day count, vectorized."""
+    a = (14 - m) // 12
+    y2 = y + 4800 - a
+    m2 = m + 12 * a - 3
+    jdn = d + (153 * m2 + 2) // 5 + 365 * y2 + y2 // 4 - 32083
+    return jdn - _JDN_EPOCH
+
+
+def rebase_julian_to_gregorian_days(days: np.ndarray) -> np.ndarray:
+    """Legacy hybrid-calendar day counts (julian before the 1582-10-15
+    switch) -> proleptic gregorian for the SAME civil date — exact via
+    JDN round-trip, not a drift table (reference: DateTimeRebase JNI /
+    RebaseDateTime.rebaseJulianToGregorianDays)."""
+    from spark_rapids_tpu.expressions.datetime_exprs import _days_from_civil
+    days = np.asarray(days, dtype=np.int64)
+    old = days < _SWITCH_DAYS
+    if not old.any():
+        return days.copy()
+    y, m, d = _julian_civil_from_days(days[old])
+    out = days.copy()
+    out[old] = _days_from_civil(np.asarray(y, dtype=np.int64),
+                                np.asarray(m, dtype=np.int64),
+                                np.asarray(d, dtype=np.int64), np)
+    return out
+
+
+def rebase_gregorian_to_julian_days(days: np.ndarray) -> np.ndarray:
+    from spark_rapids_tpu.expressions.datetime_exprs import _civil_from_days
+    days = np.asarray(days, dtype=np.int64)
+    old = days < _SWITCH_DAYS
+    if not old.any():
+        return days.copy()
+    y, m, d = _civil_from_days(days[old], np)
+    out = days.copy()
+    out[old] = _days_from_julian_civil(y.astype(np.int64),
+                                       m.astype(np.int64),
+                                       d.astype(np.int64))
+    return out
+
+
+def rebase_julian_to_gregorian_micros(us: np.ndarray) -> np.ndarray:
+    us = np.asarray(us, dtype=np.int64)
+    days = np.floor_divide(us, 86400 * _US)
+    rem = us - days * 86400 * _US
+    return rebase_julian_to_gregorian_days(days) * 86400 * _US + rem
+
+
+def rebase_gregorian_to_julian_micros(us: np.ndarray) -> np.ndarray:
+    us = np.asarray(us, dtype=np.int64)
+    days = np.floor_divide(us, 86400 * _US)
+    rem = us - days * 86400 * _US
+    return rebase_gregorian_to_julian_days(days) * 86400 * _US + rem
+
+
+# plan-rewrite registrations
+from spark_rapids_tpu.plan import typechecks as TS  # noqa: E402
+from spark_rapids_tpu.plan.overrides import register_expr  # noqa: E402
+
+for _cls in (FromUTCTimestamp, ToUTCTimestamp):
+    register_expr(_cls, TS.ALL_BASIC)

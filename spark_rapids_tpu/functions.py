@@ -427,3 +427,13 @@ def concat(*cols):
 def trim(e):
     from spark_rapids_tpu.expressions.strings import Trim
     return Trim(_expr(e))
+
+
+def from_utc_timestamp(e, zone: str):
+    from spark_rapids_tpu.expressions.timezone_db import FromUTCTimestamp
+    return FromUTCTimestamp(_expr(e), zone)
+
+
+def to_utc_timestamp(e, zone: str):
+    from spark_rapids_tpu.expressions.timezone_db import ToUTCTimestamp
+    return ToUTCTimestamp(_expr(e), zone)

@@ -1,0 +1,135 @@
+"""Timezone DB + datetime rebase tests (reference: tests/.../timezone/
+suites + date_time_test.py from_utc_timestamp cases)."""
+
+import datetime
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import Alias, col
+from spark_rapids_tpu.expressions.timezone_db import (
+    FromUTCTimestamp, TimeZoneDB, ToUTCTimestamp,
+    rebase_gregorian_to_julian_days, rebase_julian_to_gregorian_days,
+    rebase_julian_to_gregorian_micros)
+
+from tests.asserts import assert_tpu_and_cpu_are_equal_collect, cpu_session
+
+UTC = datetime.timezone.utc
+_US = 1_000_000
+
+
+def _us(dt: datetime.datetime) -> int:
+    return int(dt.timestamp() * _US)
+
+
+def test_tz_tables_parse_and_convert_scalar():
+    import zoneinfo
+    for zone in ("America/Los_Angeles", "Europe/Berlin", "Asia/Kolkata",
+                 "Australia/Sydney", "UTC"):
+        zi = zoneinfo.ZoneInfo(zone)
+        for dt in (datetime.datetime(2024, 7, 4, 12, 0, tzinfo=UTC),
+                   datetime.datetime(2024, 1, 15, 3, 30, tzinfo=UTC),
+                   datetime.datetime(1999, 12, 31, 23, 59, tzinfo=UTC),
+                   datetime.datetime(2030, 6, 1, 0, 0, tzinfo=UTC)):
+            want_off = zi.utcoffset(dt.astimezone(zi)).total_seconds()
+            got = TimeZoneDB.utc_to_local_us(
+                np.array([_us(dt)], dtype=np.int64), zone, np)[0]
+            assert got == _us(dt) + int(want_off) * _US, (zone, dt)
+
+
+def test_tz_local_to_utc_roundtrip_and_dst_edges():
+    zone = "America/Los_Angeles"
+    # normal times roundtrip exactly
+    for dt in (datetime.datetime(2024, 7, 4, 12, 0, tzinfo=UTC),
+               datetime.datetime(2024, 12, 25, 8, 0, tzinfo=UTC)):
+        us = np.array([_us(dt)], dtype=np.int64)
+        local = TimeZoneDB.utc_to_local_us(us, zone, np)
+        back = TimeZoneDB.local_to_utc_us(local, zone, np)
+        assert back[0] == us[0]
+    # ambiguous local time (fall-back 2024-11-03 01:30): earlier offset
+    # (PDT, UTC-7) wins, java.time semantics
+    amb = int(datetime.datetime(2024, 11, 3, 1, 30).replace(
+        tzinfo=UTC).timestamp() * _US)
+    got = TimeZoneDB.local_to_utc_us(np.array([amb]), zone, np)[0]
+    assert got == amb + 7 * 3600 * _US
+    # non-existent local time (spring-forward 2024-03-10 02:30) shifts
+    gap = int(datetime.datetime(2024, 3, 10, 2, 30).replace(
+        tzinfo=UTC).timestamp() * _US)
+    got2 = TimeZoneDB.local_to_utc_us(np.array([gap]), zone, np)[0]
+    assert got2 == gap + 8 * 3600 * _US     # resolved with PST offset
+
+
+def test_from_to_utc_timestamp_differential():
+    base = datetime.datetime(2024, 3, 9, 12, 0, tzinfo=UTC)
+    data = {"ts": [base + datetime.timedelta(hours=h) for h in range(48)]}
+
+    def q(s):
+        return (s.create_dataframe(data)
+                .select(Alias(FromUTCTimestamp(col("ts"),
+                                               "America/Los_Angeles"), "la"),
+                        Alias(FromUTCTimestamp(col("ts"),
+                                               "Asia/Kolkata"), "ist"),
+                        Alias(ToUTCTimestamp(col("ts"),
+                                             "Europe/Berlin"), "ber")))
+    assert_tpu_and_cpu_are_equal_collect(q)
+    rows = q(cpu_session()).collect()
+    # ground truth via zoneinfo
+    import zoneinfo
+    la = zoneinfo.ZoneInfo("America/Los_Angeles")
+    for i, h in enumerate(range(48)):
+        ts = base + datetime.timedelta(hours=h)
+        want = ts.astimezone(la).replace(tzinfo=UTC)
+        assert rows[i]["la"] == want, (i, rows[i]["la"], want)
+
+
+def test_unknown_zone_raises_at_plan_time():
+    with pytest.raises(KeyError, match="Not/AZone"):
+        FromUTCTimestamp(col("ts"), "Not/AZone")
+
+
+def test_rebase_julian_gregorian_days():
+    """Spark RebaseDateTime semantics: the CIVIL DATE is preserved — a
+    legacy value displaying as julian 1582-10-04 becomes the proleptic
+    gregorian day count of 1582-10-04 (hybrid -141428 -> -141438)."""
+    import datetime as dt
+
+    def greg_days(y, m, d):
+        return (dt.date(y, m, d) - dt.date(1970, 1, 1)).days
+
+    assert rebase_julian_to_gregorian_days(
+        np.array([-141428]))[0] == greg_days(1582, 10, 4)
+    # the day AFTER the switch is already gregorian: unchanged
+    assert rebase_julian_to_gregorian_days(
+        np.array([-141427]))[0] == greg_days(1582, 10, 15)
+    # modern dates unchanged
+    assert rebase_julian_to_gregorian_days(np.array([0, 19000])).tolist() \
+        == [0, 19000]
+    # roundtrip across centuries
+    days = np.array([-141428, -200000, -300000, -500000, -700000])
+    back = rebase_gregorian_to_julian_days(
+        rebase_julian_to_gregorian_days(days))
+    assert back.tolist() == days.tolist()
+
+
+def test_rebase_matches_known_spark_values():
+    """Drift widths per era: 10 days at the switch, 5 days around 1000 AD
+    (julian 1000-01-01 == proleptic gregorian 1000-01-06 physically, so
+    same-civil-date rebase moves the count by that drift)."""
+    import datetime as dt
+
+    def greg_days(y, m, d):
+        return (dt.date(y, m, d) - dt.date(1970, 1, 1)).days
+
+    # julian civil 1582-10-04 (hybrid -141428): count moves by -10
+    assert rebase_julian_to_gregorian_days(np.array([-141428]))[0] \
+        == -141428 - 10
+    # julian civil 1000-01-01: physical day of greg 1000-01-06, rebased
+    # count = greg_days(1000, 1, 1) -> drift of -5 days
+    hybrid_1000 = greg_days(1000, 1, 6)   # physical == julian 1000-01-01
+    assert rebase_julian_to_gregorian_days(
+        np.array([hybrid_1000]))[0] == greg_days(1000, 1, 1)
+    # micros variant preserves time-of-day
+    us = np.array([-141428 * 86400 * _US + 12 * 3600 * _US])
+    out = rebase_julian_to_gregorian_micros(us)[0]
+    assert out == greg_days(1582, 10, 4) * 86400 * _US + 12 * 3600 * _US
