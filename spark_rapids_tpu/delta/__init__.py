@@ -1,0 +1,6 @@
+"""Delta-style ACID table layer (SURVEY.md §2.7 delta-lake module —
+GpuMergeIntoCommand, GpuOptimisticTransaction, GpuDeltaTaskStatisticsTracker,
+OPTIMIZE/ZORDER — re-designed for one table-format version, as §7
+de-scopes the 9 per-version shims)."""
+
+from spark_rapids_tpu.delta.table import DeltaTable  # noqa: F401

@@ -1,0 +1,198 @@
+"""Delta transaction log.
+
+Reference: the delta-lake module's transaction plumbing
+(GpuOptimisticTransaction over Delta's OptimisticTransaction; per-file
+statistics via GpuDeltaTaskStatisticsTracker / GpuStatisticsCollection).
+
+Format (delta-protocol-shaped, one JSON action per line):
+``_delta_log/00000000000000000000.json`` etc., actions: metaData, add
+(path + numRecords + per-column min/max/nullCount stats), remove,
+commitInfo.  A snapshot is the log replay; commits are optimistic —
+the writer re-checks the version it read before renaming its commit file
+(single-filesystem CAS via O_EXCL create)."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu import types as T
+
+
+class ConcurrentModificationException(Exception):
+    """Another writer committed the version this transaction targeted."""
+
+
+def _log_dir(path: str) -> str:
+    return os.path.join(path, "_delta_log")
+
+
+def _version_file(path: str, version: int) -> str:
+    return os.path.join(_log_dir(path), f"{version:020d}.json")
+
+
+class Snapshot:
+    """Replayed state at a version: schema + live files (+stats)."""
+
+    def __init__(self, version: int, schema_json: Optional[str],
+                 files: Dict[str, dict]):
+        self.version = version
+        self.schema_json = schema_json
+        self.files = files               # path -> add action
+
+    @property
+    def schema(self) -> Optional[T.StructType]:
+        if not self.schema_json:
+            return None
+        return _schema_from_json(self.schema_json)
+
+    def file_paths(self) -> List[str]:
+        return sorted(self.files)
+
+
+def _schema_to_json(schema: T.StructType) -> str:
+    def field(f):
+        return {"name": f.name, "type": f.data_type.simple_name,
+                "nullable": f.nullable}
+    return json.dumps({"type": "struct",
+                       "fields": [field(f) for f in schema.fields]})
+
+
+_NAME_TO_TYPE = {
+    "boolean": T.BOOLEAN, "tinyint": T.BYTE, "byte": T.BYTE,
+    "smallint": T.SHORT, "short": T.SHORT, "int": T.INT,
+    "integer": T.INT, "bigint": T.LONG, "long": T.LONG,
+    "float": T.FLOAT, "double": T.DOUBLE, "string": T.STRING,
+    "binary": T.BINARY, "date": T.DATE, "timestamp": T.TIMESTAMP,
+}
+
+
+def _type_from_name(n: str) -> T.DataType:
+    if n in _NAME_TO_TYPE:
+        return _NAME_TO_TYPE[n]
+    if n.startswith("decimal("):
+        p, s = n[8:-1].split(",")
+        return T.DecimalType(int(p), int(s))
+    if n.startswith("array<") and n.endswith(">"):
+        return T.ArrayType(_type_from_name(n[6:-1]))
+    raise ValueError(f"cannot parse delta type {n!r}")
+
+
+def _schema_from_json(s: str) -> T.StructType:
+    d = json.loads(s)
+    return T.StructType([
+        T.StructField(f["name"], _type_from_name(f["type"]),
+                      f.get("nullable", True))
+        for f in d["fields"]])
+
+
+class DeltaLog:
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def latest_version(self) -> int:
+        d = _log_dir(self.path)
+        if not os.path.isdir(d):
+            return -1
+        versions = [int(f[:-5]) for f in os.listdir(d)
+                    if f.endswith(".json") and f[:-5].isdigit()]
+        return max(versions) if versions else -1
+
+    def snapshot(self, version: Optional[int] = None) -> Snapshot:
+        latest = self.latest_version()
+        if version is None:
+            version = latest
+        if version < 0:
+            return Snapshot(-1, None, {})
+        if version > latest:
+            raise ValueError(f"version {version} > latest {latest}")
+        schema_json = None
+        files: Dict[str, dict] = {}
+        for v in range(version + 1):
+            p = _version_file(self.path, v)
+            with open(p) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    action = json.loads(line)
+                    if "metaData" in action:
+                        schema_json = action["metaData"].get("schemaString")
+                    elif "add" in action:
+                        files[action["add"]["path"]] = action["add"]
+                    elif "remove" in action:
+                        files.pop(action["remove"]["path"], None)
+        return Snapshot(version, schema_json, files)
+
+    def commit(self, read_version: int, actions: List[dict],
+               operation: str) -> int:
+        """Optimistic commit: targets read_version + 1; O_EXCL create is
+        the CAS (reference: OptimisticTransaction.commit's conflict
+        detection collapsed to the filesystem primitive)."""
+        version = read_version + 1
+        actions = list(actions) + [{
+            "commitInfo": {"operation": operation,
+                           "timestamp": int(time.time() * 1000)}}]
+        os.makedirs(_log_dir(self.path), exist_ok=True)
+        target = _version_file(self.path, version)
+        try:
+            fd = os.open(target, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            raise ConcurrentModificationException(
+                f"version {version} was committed by another writer "
+                f"(read version {read_version} is stale)")
+        with os.fdopen(fd, "w") as f:
+            for a in actions:
+                f.write(json.dumps(a) + "\n")
+        return version
+
+    def history(self) -> List[dict]:
+        out = []
+        for v in range(self.latest_version() + 1):
+            with open(_version_file(self.path, v)) as f:
+                for line in f:
+                    a = json.loads(line)
+                    if "commitInfo" in a:
+                        out.append({"version": v, **a["commitInfo"]})
+        return out
+
+
+def compute_file_stats(hb, schema: T.StructType) -> dict:
+    """Per-file column stats (reference: GpuStatisticsCollection —
+    min/max/nullCount per column feed data skipping)."""
+    import pyarrow.compute as pc
+    stats = {"numRecords": int(hb.row_count), "minValues": {},
+             "maxValues": {}, "nullCount": {}}
+    for f in schema.fields:
+        try:
+            col = hb.column_by_name(f.name)
+        except (KeyError, AttributeError):
+            cols = {n: c for n, c in zip(hb.schema.names, hb.columns)}
+            col = cols.get(f.name)
+        if col is None:
+            continue
+        arr = col.arrow
+        stats["nullCount"][f.name] = arr.null_count
+        if f.data_type.is_numeric or isinstance(
+                f.data_type, (T.DateType, T.TimestampType, T.StringType)):
+            if len(arr) > arr.null_count:
+                mn = pc.min(arr).as_py()
+                mx = pc.max(arr).as_py()
+                stats["minValues"][f.name] = _stat_value(mn)
+                stats["maxValues"][f.name] = _stat_value(mx)
+    return stats
+
+
+def _stat_value(v):
+    import datetime
+    import decimal
+    if isinstance(v, (datetime.date, datetime.datetime)):
+        return v.isoformat()
+    if isinstance(v, decimal.Decimal):
+        return str(v)
+    if hasattr(v, "item"):
+        return v.item()
+    return v

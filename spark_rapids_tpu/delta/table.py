@@ -1,0 +1,339 @@
+"""Delta-style table operations.
+
+Reference: delta-lake/ GPU commands — GpuMergeIntoCommand,
+GpuUpdateCommand/GpuDeleteCommand (copy-on-write file rewrite),
+GpuOptimizeExecutor (compaction + ZORDER BY via the zorder kernels), all
+through GpuOptimisticTransaction.  The engine's own columnar pipeline does
+the row work; this layer owns files + log actions."""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Dict, List, Optional, Sequence
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.delta.log import (ConcurrentModificationException,
+                                        DeltaLog, Snapshot,
+                                        _schema_to_json, compute_file_stats)
+from spark_rapids_tpu.expressions.base import Expression, bind_references
+
+
+class DeltaTable:
+    def __init__(self, session, path: str):
+        self.session = session
+        self.path = path
+        self.log = DeltaLog(path)
+
+    # -- creation / write ----------------------------------------------------
+    @classmethod
+    def create(cls, session, path: str, df) -> "DeltaTable":
+        t = cls(session, path)
+        t._write_df(df, mode="overwrite", operation="CREATE TABLE AS SELECT")
+        return t
+
+    @classmethod
+    def for_path(cls, session, path: str) -> "DeltaTable":
+        t = cls(session, path)
+        if t.log.latest_version() < 0:
+            raise FileNotFoundError(f"no delta table at {path}")
+        return t
+
+    def write(self, df, mode: str = "append") -> None:
+        op = "WRITE" if mode == "append" else "OVERWRITE"
+        self._write_df(df, mode=mode, operation=op)
+
+    def _write_df(self, df, mode: str, operation: str) -> None:
+        snap = self.log.snapshot()
+        schema = df.schema
+        adds = self._write_files(df)
+        actions: List[dict] = []
+        if snap.version < 0 or mode == "overwrite":
+            actions.append({"metaData": {
+                "id": str(uuid.uuid4()),
+                "schemaString": _schema_to_json(schema),
+                "format": {"provider": "parquet"}}})
+        if mode == "overwrite":
+            for p in snap.file_paths():
+                actions.append({"remove": {"path": p,
+                                           "dataChange": True}})
+        actions.extend({"add": a} for a in adds)
+        self.log.commit(snap.version, actions, operation)
+
+    def _write_files(self, df, batches=None) -> List[dict]:
+        """Writes data files + computes per-file stats; returns add
+        actions."""
+        from spark_rapids_tpu.columnar.batch import (ColumnarBatch,
+                                                     concat_host_batches)
+        os.makedirs(self.path, exist_ok=True)
+        schema = df.schema if df is not None else None
+        if batches is None:
+            plan = df._executed_plan()
+            batches = list(plan.execute_all())
+        host = []
+        for b in batches:
+            host.append(b.to_host() if isinstance(b, ColumnarBatch) else b)
+        if not host:
+            return []
+        hb = concat_host_batches(host) if len(host) > 1 else host[0]
+        if hb.row_count == 0:
+            return []
+        name = f"part-{uuid.uuid4().hex[:12]}.parquet"
+        fpath = os.path.join(self.path, name)
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        pq.write_table(pa.Table.from_batches([hb.to_arrow()]), fpath)
+        stats = compute_file_stats(hb, hb.schema if schema is None
+                                   else schema)
+        return [{"path": name, "size": os.path.getsize(fpath),
+                 "dataChange": True, "stats": stats}]
+
+    # -- read ----------------------------------------------------------------
+    def to_df(self, predicate: Optional[Expression] = None):
+        """Scan of the live files; per-file min/max stats skip files that
+        cannot match a simple comparison predicate (data skipping)."""
+        snap = self.log.snapshot()
+        schema = snap.schema
+        paths = [os.path.join(self.path, p) for p in
+                 self._skip_files(snap, predicate)]
+        if not paths:
+            from spark_rapids_tpu.columnar.batch import batch_from_pydict
+            empty = batch_from_pydict({f.name: [] for f in schema.fields},
+                                      schema)
+            return self.session.create_dataframe(empty)
+        df = self.session.read.parquet(*paths)
+        if predicate is not None:
+            df = df.filter(predicate)
+        return df
+
+    def _skip_files(self, snap: Snapshot, predicate) -> List[str]:
+        files = snap.file_paths()
+        bound = _simple_bound(predicate)
+        if bound is None:
+            return files
+        name, op, value = bound
+        keep = []
+        for p in files:
+            st = snap.files[p].get("stats") or {}
+            mn = st.get("minValues", {}).get(name)
+            mx = st.get("maxValues", {}).get(name)
+            if mn is None or mx is None:
+                keep.append(p)
+                continue
+            if op == ">" and not (mx > value):
+                continue
+            if op == ">=" and not (mx >= value):
+                continue
+            if op == "<" and not (mn < value):
+                continue
+            if op == "<=" and not (mn <= value):
+                continue
+            if op == "=" and not (mn <= value <= mx):
+                continue
+            keep.append(p)
+        return keep
+
+    # -- DML -----------------------------------------------------------------
+    def delete(self, condition: Expression) -> int:
+        """Copy-on-write DELETE (reference GpuDeleteCommand): rewrite the
+        files that contain matching rows without them."""
+        from spark_rapids_tpu.expressions.predicates import Not
+        snap = self.log.snapshot()
+        schema = snap.schema
+        cond = bind_references(condition, schema)
+        removed, adds, deleted = self._rewrite_files(
+            snap, keep_predicate=Not(cond))
+        actions = [{"remove": {"path": p, "dataChange": True}}
+                   for p in removed]
+        actions += [{"add": a} for a in adds]
+        if actions:
+            self.log.commit(snap.version, actions, "DELETE")
+        return deleted
+
+    def update(self, set_exprs: Dict[str, Expression],
+               condition: Optional[Expression] = None) -> int:
+        """Copy-on-write UPDATE (reference GpuUpdateCommand)."""
+        from spark_rapids_tpu.expressions.base import Alias, col
+        from spark_rapids_tpu.expressions.conditional import If
+        snap = self.log.snapshot()
+        schema = snap.schema
+        cond = bind_references(condition, schema) if condition is not None \
+            else None
+        removed: List[str] = []
+        adds: List[dict] = []
+        touched = 0
+        for p in snap.file_paths():
+            df = self.session.read.parquet(os.path.join(self.path, p))
+            n_match = df.filter(cond).count() if cond is not None \
+                else df.count()
+            if n_match == 0:
+                continue
+            touched += n_match
+            proj = []
+            for f in schema.fields:
+                if f.name in set_exprs:
+                    new = bind_references(set_exprs[f.name], schema)
+                    e = If(cond, new, col(f.name)) if cond is not None \
+                        else new
+                    proj.append(Alias(bind_references(e, schema), f.name))
+                else:
+                    proj.append(col(f.name))
+            out = df.select(*proj)
+            removed.append(p)
+            adds.extend(self._write_files(out))
+        actions = [{"remove": {"path": p, "dataChange": True}}
+                   for p in removed]
+        actions += [{"add": a} for a in adds]
+        if actions:
+            self.log.commit(snap.version, actions, "UPDATE")
+        return touched
+
+    def merge(self, source_df, on: str,
+              when_matched_update: Optional[Dict[str, Expression]] = None,
+              when_not_matched_insert: bool = True) -> dict:
+        """MERGE (reference GpuMergeIntoCommand, low-shuffle variant
+        de-scoped): matched rows update, unmatched source rows insert."""
+        from spark_rapids_tpu.expressions.base import Alias, col, lit
+        snap = self.log.snapshot()
+        schema = snap.schema
+        target = self.to_df()
+        # matched keys (semi-join on the key column)
+        src_keys = set(r[on] for r in
+                       source_df.select(col(on)).collect())
+        stats = {"updated": 0, "inserted": 0}
+        # prefix source columns so they never collide with target names;
+        # a constant __src__match marker makes "the join found a source
+        # row" unambiguous even for all-null source values
+        src_cols = [Alias(col(on), on),
+                    Alias(lit(1), "__src__match")]
+        src_cols += [Alias(col(c), f"__src_{c}")
+                     for c in source_df.columns if c != on]
+        src2 = source_df.select(*src_cols)
+        joined = target.join(src2, on=on, how="left", null_safe=False)
+        from spark_rapids_tpu.expressions.conditional import If
+        from spark_rapids_tpu.expressions.predicates import IsNotNull
+        matched = IsNotNull(col("__src__match"))
+        proj = []
+        for f in schema.fields:
+            if when_matched_update and f.name in when_matched_update:
+                # update expressions may reference source values as
+                # __src_<name>
+                upd = when_matched_update[f.name]
+                proj.append(Alias(If(matched, upd, col(f.name)), f.name))
+            else:
+                proj.append(Alias(col(f.name), f.name))
+        updated_target = joined.select(*proj)
+        # inserts: source rows whose key is absent from the target
+        tgt_keys = set(r[on] for r in target.select(col(on)).collect())
+        insert_rows = [r for r in source_df.collect()
+                       if r[on] not in tgt_keys]
+        stats["inserted"] = len(insert_rows)
+        stats["updated"] = sum(1 for k in src_keys if k in tgt_keys)
+        removed = snap.file_paths()
+        adds = self._write_files(updated_target)
+        if insert_rows:
+            cols = {c: [r[c] for r in insert_rows]
+                    for c in source_df.columns}
+            ins_df = self.session.create_dataframe(cols, schema=schema)
+            adds += self._write_files(ins_df)
+        actions = [{"remove": {"path": p, "dataChange": True}}
+                   for p in removed]
+        actions += [{"add": a} for a in adds]
+        self.log.commit(snap.version, actions, "MERGE")
+        return stats
+
+    # -- OPTIMIZE ------------------------------------------------------------
+    def optimize(self, zorder_by: Optional[Sequence[str]] = None) -> dict:
+        """Compacts all live files into one, optionally Z-ORDERed
+        (reference: GpuOptimizeExecutor + zorder kernels)."""
+        snap = self.log.snapshot()
+        schema = snap.schema
+        df = self.to_df()
+        batches = [b.to_host() if hasattr(b, "to_host") and
+                   not hasattr(b, "arrow_schema") else b
+                   for b in df._executed_plan().execute_all()]
+        from spark_rapids_tpu.columnar.batch import (concat_host_batches)
+        if not batches:
+            return {"filesRemoved": 0, "filesAdded": 0}
+        hb = concat_host_batches([
+            b.to_host() if hasattr(b, "bucket") else b for b in batches])
+        if zorder_by:
+            import numpy as np
+            import pyarrow as pa
+            from spark_rapids_tpu.ops.zorder_ops import zorder_permutation
+            cols = {n: c for n, c in zip(hb.schema.names, hb.columns)}
+            keys = [cols[n].data_np() for n in zorder_by]
+            perm = zorder_permutation(keys, np)
+            tab = pa.Table.from_batches([hb.to_arrow()]) \
+                .take(pa.array(perm))
+            from spark_rapids_tpu.columnar.batch import batch_from_arrow
+            hb = batch_from_arrow(tab)
+        adds = self._write_files_direct([hb], schema)
+        removed = snap.file_paths()
+        actions = [{"remove": {"path": p, "dataChange": False}}
+                   for p in removed]
+        actions += [{"add": a} for a in adds]
+        self.log.commit(snap.version, actions,
+                        "OPTIMIZE" + (" ZORDER" if zorder_by else ""))
+        return {"filesRemoved": len(removed), "filesAdded": len(adds)}
+
+    def _write_files_direct(self, batches, schema) -> List[dict]:
+        from spark_rapids_tpu.columnar.batch import concat_host_batches
+        hb = concat_host_batches(batches) if len(batches) > 1 else batches[0]
+        if hb.row_count == 0:
+            return []
+        name = f"part-{uuid.uuid4().hex[:12]}.parquet"
+        fpath = os.path.join(self.path, name)
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        pq.write_table(pa.Table.from_batches([hb.to_arrow()]), fpath)
+        stats = compute_file_stats(hb, schema)
+        return [{"path": name, "size": os.path.getsize(fpath),
+                 "dataChange": True, "stats": stats}]
+
+    def _rewrite_files(self, snap: Snapshot, keep_predicate: Expression):
+        """Rewrites each file keeping rows matching the predicate; returns
+        (removed paths, add actions, dropped row count)."""
+        schema = snap.schema
+        removed: List[str] = []
+        adds: List[dict] = []
+        dropped = 0
+        for p in snap.file_paths():
+            df = self.session.read.parquet(os.path.join(self.path, p))
+            total = df.count()
+            kept_df = df.filter(keep_predicate)
+            kept = kept_df.count()
+            if kept == total:
+                continue
+            dropped += total - kept
+            removed.append(p)
+            if kept:
+                adds.extend(self._write_files(kept_df))
+        return removed, adds, dropped
+
+    def history(self) -> List[dict]:
+        return self.log.history()
+
+    def version(self) -> int:
+        return self.log.latest_version()
+
+
+def _simple_bound(predicate):
+    """(col, op, value) for a single comparison against a literal, else
+    None (data skipping handles the simple shapes, like the reference)."""
+    if predicate is None:
+        return None
+    from spark_rapids_tpu.expressions import predicates as P
+    from spark_rapids_tpu.expressions.base import (AttributeReference,
+                                                   BoundReference, Literal)
+    ops = {P.GreaterThan: ">", P.GreaterThanOrEqual: ">=",
+           P.LessThan: "<", P.LessThanOrEqual: "<=", P.EqualTo: "="}
+    cls = type(predicate)
+    if cls not in ops:
+        return None
+    left, right = predicate.children
+    if isinstance(left, (AttributeReference,)) and isinstance(right, Literal):
+        return (left.ref_name, ops[cls], right.value)
+    if isinstance(left, BoundReference) and isinstance(right, Literal):
+        return (left.ref_name, ops[cls], right.value)
+    return None

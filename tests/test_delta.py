@@ -1,0 +1,142 @@
+"""Delta-style table layer tests (reference: delta-lake module suites —
+delta_lake_*_test.py: write/read, DELETE/UPDATE/MERGE, OPTIMIZE ZORDER,
+optimistic concurrency, stats/data-skipping)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.delta import DeltaTable
+from spark_rapids_tpu.delta.log import (ConcurrentModificationException,
+                                        DeltaLog)
+from spark_rapids_tpu.expressions.base import Alias, col, lit
+
+from tests.asserts import tpu_session
+
+
+def _session():
+    return tpu_session({"spark.rapids.sql.test.enabled": "false"})
+
+
+def _make(s, path, n=100):
+    df = s.create_dataframe({
+        "id": np.arange(n, dtype=np.int64),
+        "v": (np.arange(n, dtype=np.float64) * 1.5),
+        "cat": [f"c{i % 5}" for i in range(n)],
+    })
+    return DeltaTable.create(s, str(path), df)
+
+
+def test_create_write_read_roundtrip(tmp_path):
+    s = _session()
+    t = _make(s, tmp_path / "t")
+    assert t.version() == 0
+    rows = t.to_df().collect()
+    assert len(rows) == 100
+    # append bumps the version and adds rows
+    extra = s.create_dataframe({"id": [1000], "v": [1.0], "cat": ["x"]})
+    t.write(extra, mode="append")
+    assert t.version() == 1
+    assert t.to_df().count() == 101
+    # overwrite resets
+    t.write(extra, mode="overwrite")
+    assert t.to_df().count() == 1
+    # reopen from disk
+    t2 = DeltaTable.for_path(s, str(tmp_path / "t"))
+    assert t2.to_df().count() == 1
+
+
+def test_delete(tmp_path):
+    s = _session()
+    t = _make(s, tmp_path / "t")
+    deleted = t.delete(col("id") < lit(10))
+    assert deleted == 10
+    assert t.to_df().count() == 90
+    assert t.to_df().filter(col("id") < lit(10)).count() == 0
+    ops = [h["operation"] for h in t.history()]
+    assert "DELETE" in ops
+
+
+def test_update(tmp_path):
+    s = _session()
+    t = _make(s, tmp_path / "t")
+    touched = t.update({"v": col("v") * lit(10.0)},
+                       condition=col("id") < lit(5))
+    assert touched == 5
+    rows = {r["id"]: r["v"] for r in t.to_df().collect()}
+    assert rows[0] == 0.0 and rows[1] == 15.0 and rows[2] == 30.0
+    assert rows[10] == 15.0      # untouched
+
+
+def test_merge(tmp_path):
+    s = _session()
+    t = _make(s, tmp_path / "t", n=10)
+    src = s.create_dataframe({
+        "id": np.array([5, 6, 100], dtype=np.int64),
+        "v": np.array([555.0, 666.0, 1000.0]),
+        "cat": ["u", "u", "new"],
+    })
+    stats = t.merge(src, on="id",
+                    when_matched_update={"v": lit(999.0)})
+    assert stats["updated"] == 2 and stats["inserted"] == 1
+    rows = {r["id"]: r["v"] for r in t.to_df().collect()}
+    assert rows[5] == 999.0 and rows[6] == 999.0
+    assert rows[100] == 1000.0
+    assert rows[0] == 0.0
+    assert t.to_df().count() == 11
+
+
+def test_optimize_compacts_and_zorders(tmp_path):
+    s = _session()
+    t = _make(s, tmp_path / "t", n=50)
+    for k in range(3):
+        t.write(s.create_dataframe({
+            "id": np.arange(k * 10, k * 10 + 10, dtype=np.int64) + 1000,
+            "v": np.zeros(10), "cat": ["z"] * 10}), mode="append")
+    assert len(t.log.snapshot().file_paths()) == 4
+    res = t.optimize(zorder_by=["id"])
+    assert res["filesRemoved"] == 4 and res["filesAdded"] == 1
+    assert t.to_df().count() == 80
+    ops = [h["operation"] for h in t.history()]
+    assert "OPTIMIZE ZORDER" in ops
+
+
+def test_optimistic_concurrency_conflict(tmp_path):
+    s = _session()
+    t = _make(s, tmp_path / "t")
+    log = DeltaLog(str(tmp_path / "t"))
+    v = log.latest_version()
+    log.commit(v, [{"commitInfo": {"operation": "X"}}], "X")
+    with pytest.raises(ConcurrentModificationException):
+        log.commit(v, [{"commitInfo": {"operation": "Y"}}], "Y")
+
+
+def test_stats_data_skipping(tmp_path):
+    s = _session()
+    t = _make(s, tmp_path / "t", n=100)     # ids 0..99, one file
+    t.write(s.create_dataframe({
+        "id": np.arange(1000, 1100, dtype=np.int64),
+        "v": np.zeros(100), "cat": ["hi"] * 100}), mode="append")
+    snap = t.log.snapshot()
+    assert len(snap.file_paths()) == 2
+    kept = t._skip_files(snap, col("id") > lit(500))
+    assert len(kept) == 1                  # the 0..99 file skipped
+    # correctness with skipping active
+    assert t.to_df(col("id") > lit(500)).count() == 100
+
+
+def test_zorder_interleave_locality():
+    from spark_rapids_tpu.ops.zorder_ops import interleave_bits
+    xs, ys = np.meshgrid(np.arange(16), np.arange(16))
+    xs, ys = xs.ravel(), ys.ravel()
+    z = interleave_bits([xs, ys], np)
+    assert len(set(z.tolist())) == 256       # injective on the grid
+    # morton basics: (0,0)<(1,0)<(1,1); neighbors cluster better than
+    # row-major for 2-d range queries: check known small values
+    zmap = {(int(x), int(y)): int(v) for x, y, v in zip(xs, ys, z)}
+    assert zmap[(0, 0)] == 0
+    assert zmap[(1, 0)] == 1
+    assert zmap[(0, 1)] == 2
+    assert zmap[(1, 1)] == 3
