@@ -71,17 +71,26 @@ def compact_batch(batch: ColumnarBatch, keep) -> ColumnarBatch:
     fn = _COMPACT_CACHE.get(key)
     if fn is None:
         def run(arrs, keep):
-            # stable argsort: kept rows (False<True on ~keep) keep order
-            order = jnp.argsort(~keep, stable=True)
+            # stable compaction WITHOUT a sort: prefix-sum the keep mask
+            # for destination slots and scatter (O(n) vs argsort's
+            # O(n log n); sorts are among the priciest TPU ops while
+            # cumsum+scatter ride the VPU)
+            n = keep.shape[0]
+            dest = jnp.cumsum(keep) - 1
+            dest = jnp.where(keep, dest, n)     # dropped rows: scatter out
+            cnt = jnp.sum(keep)
             outs = []
             for d, v, ln, ev in arrs:
-                nd = jnp.take(d, order, axis=0)
-                # rows that were filtered out become padding: invalid
-                nv = jnp.take(v & keep, order, axis=0)
-                nl = None if ln is None else jnp.take(ln, order, axis=0)
-                ne = None if ev is None else jnp.take(ev, order, axis=0)
+                nd = jnp.zeros_like(d).at[dest].set(d, mode="drop")
+                live = jnp.arange(n) < cnt
+                nv = jnp.zeros_like(v).at[dest].set(v & keep,
+                                                    mode="drop") & live
+                nl = None if ln is None else \
+                    jnp.zeros_like(ln).at[dest].set(ln, mode="drop")
+                ne = None if ev is None else \
+                    jnp.zeros_like(ev).at[dest].set(ev, mode="drop")
                 outs.append((nd, nv, nl, ne))
-            return outs, jnp.sum(keep)
+            return outs, cnt
 
         fn = jax.jit(run)
         _COMPACT_CACHE[key] = fn

@@ -236,6 +236,27 @@ def insert_transitions(plan: Exec, conf: TpuConf) -> Exec:
     return out
 
 
+def fuse_device_stages(plan: Exec) -> Exec:
+    """Whole-stage fusion pass: collapse TpuProject(TpuFilter(x)) into one
+    jitted kernel (predicate + projection + compaction in a single XLA
+    program).  The reference cannot do this — cuDF dispatches one kernel
+    per operator; XLA's tracing model makes cross-operator fusion a plan
+    rewrite."""
+    from spark_rapids_tpu.exec.basic import (TpuFilterExec,
+                                             TpuFilterProjectExec,
+                                             TpuProjectExec)
+
+    def fix(node: Exec) -> Exec:
+        if isinstance(node, TpuProjectExec) and \
+                isinstance(node.children[0], TpuFilterExec):
+            filt = node.children[0]
+            return TpuFilterProjectExec(filt.condition, node.exprs,
+                                        filt.children[0])
+        return node
+
+    return plan.transform_up(fix)
+
+
 def validate_all_on_device(plan: Exec, conf: TpuConf) -> None:
     """Test-mode assertion (reference: GpuTransitionOverrides
     assertIsOnTheGpu :616 + spark.rapids.sql.test.enabled)."""
@@ -291,6 +312,7 @@ class TpuOverrides:
             return plan
         out = insert_transitions(converted, conf)
         out = self._coalesce_after_device_sources(out)
+        out = fuse_device_stages(out)
         if conf.is_test_enabled and not for_explain:
             validate_all_on_device(out, conf)
         from spark_rapids_tpu.aux.capture import ExecutionPlanCaptureCallback
