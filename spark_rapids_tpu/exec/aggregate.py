@@ -157,11 +157,20 @@ class CpuHashAggregateExec(UnaryExec):
             elif kind in ("first_valid", "last_valid"):
                 opt = pc.ScalarAggregateOptions(skip_nulls=True, min_count=0)
                 aggs.append((col_name, kind.split("_")[0], opt))
+            elif kind in ("list", "distinct"):
+                # variable-length state (CollectList/CollectSet/Percentile);
+                # COMPLETE-mode only, so no merge of list buffers is needed
+                aggs.append((col_name, kind, None))
             else:
                 raise ValueError(kind)
         if key_names:
             gb = table.group_by(key_names, use_threads=False)
             res = gb.aggregate(aggs)
+        elif any(a[1] in ("list", "distinct") for a in aggs):
+            # scalar aggregation has no hash_list kernel: group by a
+            # constant key instead, then ignore it
+            const = pa.array([0] * table.num_rows, type=pa.int8())
+            res = table.append_column("__g", const)                 .group_by(["__g"], use_threads=False).aggregate(aggs)
         else:
             # reduction: aggregate to one row
             res = table.group_by([], use_threads=False).aggregate(aggs)
@@ -388,6 +397,10 @@ def _tag_aggregate(meta) -> None:
         if isinstance(dt, T.DecimalType) and dt.is_decimal128:
             meta.will_not_work(f"decimal128 aggregation buffer "
                                f"{lay.buffer_name(j)} not on device yet")
+        if spec.update_kind in ("list", "distinct"):
+            meta.will_not_work(
+                f"variable-length aggregation buffer "
+                f"{lay.buffer_name(j)} is host tier (collect/percentile)")
 
 
 from spark_rapids_tpu.plan.overrides import register_exec  # noqa: E402

@@ -24,7 +24,7 @@ from typing import List, Optional, Sequence, Tuple
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.expressions.base import (BoundReference, Expression,
-                                               Literal)
+                                               Literal, TCol)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -41,6 +41,9 @@ class AggregateFunction(Expression):
     """Base; children are the raw input expressions."""
 
     is_aggregate = True
+    #: variable-length state: plan in COMPLETE mode after a key shuffle
+    #: (Spark's ObjectHashAggregate pattern), no partial/merge stages
+    requires_complete = False
 
     @property
     def nullable(self) -> bool:
@@ -289,3 +292,137 @@ class AggregateExpression:
     """An aggregate + its output name (Alias analog for agg results)."""
     func: AggregateFunction
     out_name: str
+
+
+# ---------------------------------------------------------------------------
+# collection + percentile aggregates (reference: GpuCollectList/GpuCollectSet
+# in aggregateFunctions.scala; GpuPercentile/GpuApproximatePercentile via the
+# JNI Histogram/t-digest kernels).  These need variable-length state, so
+# they plan in COMPLETE mode (shuffle raw rows by key first — Spark's
+# ObjectHashAggregate pattern) and run on the host tier until segmented
+# list-state kernels land on device.
+# ---------------------------------------------------------------------------
+
+class CollectList(AggregateFunction):
+    requires_complete = True
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.ArrayType(self.children[0].data_type)
+
+    def buffers(self):
+        return [BufferSpec("list", self.data_type, "list", "list")]
+
+    def evaluate(self, refs):
+        return refs[0]
+
+
+class CollectSet(AggregateFunction):
+    requires_complete = True
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.ArrayType(self.children[0].data_type, contains_null=False)
+
+    def buffers(self):
+        return [BufferSpec("set", self.data_type, "distinct", "distinct")]
+
+    def evaluate(self, refs):
+        return refs[0]
+
+
+class Percentile(AggregateFunction):
+    """Exact percentile with Spark's 1-based-rank linear interpolation."""
+
+    requires_complete = True
+
+    def __init__(self, child: Expression, percentage):
+        super().__init__([child])
+        self.percentages = list(percentage) \
+            if isinstance(percentage, (list, tuple)) else [percentage]
+        self.scalar = not isinstance(percentage, (list, tuple))
+        for p in self.percentages:
+            if not (0.0 <= float(p) <= 1.0):
+                raise ValueError(f"percentage {p} out of [0, 1]")
+
+    @property
+    def data_type(self):
+        return T.DOUBLE if self.scalar else T.ArrayType(T.DOUBLE)
+
+    def buffers(self):
+        return [BufferSpec("vals", T.ArrayType(self.children[0].data_type),
+                           "list", "list")]
+
+    def evaluate(self, refs):
+        return _PercentileFromList(refs[0], self.percentages, self.scalar)
+
+
+class ApproximatePercentile(Percentile):
+    """approx_percentile: the reference runs a t-digest JNI kernel; here the
+    collected values are reduced exactly (a strictly more accurate answer
+    for the same contract — the accuracy argument is accepted and
+    ignored)."""
+
+    def __init__(self, child: Expression, percentage, accuracy: int = 10000):
+        super().__init__(child, percentage)
+        self.accuracy = accuracy
+
+
+class _PercentileFromList(Expression):
+    """Final projection for Percentile: per-group sorted interpolation over
+    the collected array buffer (host tier)."""
+
+    def __init__(self, child, percentages, scalar: bool):
+        super().__init__([child])
+        self.percentages = [float(p) for p in percentages]
+        self.scalar = scalar
+
+    @property
+    def data_type(self):
+        return T.DOUBLE if self.scalar else T.ArrayType(T.DOUBLE)
+
+    def tpu_supported(self, conf):
+        return "percentile finalization is host tier"
+
+    def eval_cpu(self, ctx):
+        import numpy as np
+        from spark_rapids_tpu.expressions.base import valid_array
+        tc = self.children[0].eval(ctx)
+        valid = valid_array(tc, ctx)
+        n = ctx.row_count
+        out = np.empty(n, dtype=object)
+        ok = np.zeros(n, dtype=bool)
+        for i in range(n):
+            vals = tc.data[i] if valid[i] else None
+            nums = sorted(float(v) for v in (vals or []) if v is not None)
+            if not nums:
+                out[i] = None
+                continue
+            res = [_interp(nums, p) for p in self.percentages]
+            out[i] = res[0] if self.scalar else res
+            ok[i] = True
+        if self.scalar:
+            dense = np.zeros(n, dtype=np.float64)
+            for i in range(n):
+                if ok[i]:
+                    dense[i] = out[i]
+            return TCol(dense, ok, T.DOUBLE)
+        return TCol(out, ok, self.data_type)
+
+    eval_tpu = eval_cpu
+
+
+def _interp(sorted_vals, p: float) -> float:
+    """Spark Percentile: rank = 1 + p*(n-1), linear interpolation."""
+    n = len(sorted_vals)
+    pos = p * (n - 1)
+    lo = int(pos)
+    hi = min(lo + 1, n - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac

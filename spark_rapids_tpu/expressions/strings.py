@@ -658,3 +658,418 @@ class RegExpExtract(_RegexExpr):
         return TCol(out, valid, T.STRING)
 
     eval_tpu = eval_cpu
+
+
+# ---------------------------------------------------------------------------
+# volume string functions (reference: stringFunctions.scala — GpuReverse,
+# GpuInitCap, GpuStringRepeat, GpuStringLPad/RPad, GpuStringLocate,
+# GpuStringTranslate, GpuStringSplit, GpuConcatWs)
+# ---------------------------------------------------------------------------
+
+class Reverse(UnaryExpr):
+    """reverse(str): per-row byte reversal within the row's length — one
+    gather over the padded byte plane."""
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def eval_tpu(self, ctx):
+        xp = jnp()
+        c = self.child.eval(ctx)
+        chars, lens, valid = _dev_inputs(c, ctx, xp)
+        w = chars.shape[1]
+        pos = xp.arange(w)[None, :]
+        src = xp.clip(lens[:, None] - 1 - pos, 0, w - 1)
+        rev = xp.take_along_axis(chars, src, axis=1)
+        out = xp.where(pos < lens[:, None], rev, 0)
+        return TCol(out, valid, T.STRING, lengths=lens)
+
+    def eval_cpu(self, ctx):
+        c = self.child.eval(ctx)
+        out, valid = _cpu_str_map(c, ctx, lambda s: s[::-1])
+        return TCol(out, valid, T.STRING)
+
+
+class InitCap(UnaryExpr):
+    """initcap: uppercase the first letter of each word, lowercase the rest
+    (ASCII on device, like Upper/Lower)."""
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def eval_tpu(self, ctx):
+        xp = jnp()
+        c = self.child.eval(ctx)
+        chars, lens, valid = _dev_inputs(c, ctx, xp)
+        lower = xp.where((chars >= ord("A")) & (chars <= ord("Z")),
+                         chars + 32, chars)
+        is_alpha = ((lower >= ord("a")) & (lower <= ord("z")))
+        prev_alpha = xp.concatenate(
+            [xp.zeros_like(is_alpha[:, :1]), is_alpha[:, :-1]], axis=1)
+        word_start = is_alpha & ~prev_alpha
+        out = xp.where(word_start & (lower >= ord("a"))
+                       & (lower <= ord("z")), lower - 32, lower)
+        return TCol(out, valid, T.STRING, lengths=lens)
+
+    def eval_cpu(self, ctx):
+        import re as _re
+        c = self.child.eval(ctx)
+
+        def cap(s):
+            return _re.sub(r"\w+", lambda m: m.group(0).capitalize()
+                           if m.group(0)[0].isascii() else m.group(0),
+                           s.lower())
+        out, valid = _cpu_str_map(c, ctx, cap)
+        return TCol(out, valid, T.STRING)
+
+
+class StringRepeat(BinaryExpr):
+    """repeat(str, n) — device for literal n (static output width)."""
+
+    symbol = "repeat"
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def tpu_supported(self, conf):
+        from spark_rapids_tpu.expressions.base import Literal
+        if not isinstance(self.right, Literal):
+            return "repeat count must be a literal on the device"
+        return None
+
+    def eval_tpu(self, ctx):
+        xp = jnp()
+        from spark_rapids_tpu.columnar.column import bucket_strlen
+        n = max(0, int(self.right.value or 0))
+        c = self.left.eval(ctx)
+        chars, lens, valid = _dev_inputs(c, ctx, xp)
+        w = chars.shape[1]
+        if n == 0:
+            z = xp.zeros((ctx.row_count, 1), dtype=chars.dtype)
+            return TCol(z, valid, T.STRING,
+                        lengths=xp.zeros(ctx.row_count, dtype=np.int32))
+        out_w = bucket_strlen(w * n)
+        pos = xp.arange(out_w)[None, :]
+        src = pos % xp.maximum(lens[:, None], 1)
+        gathered = xp.take_along_axis(
+            xp.pad(chars, ((0, 0), (0, max(0, out_w - w)))),
+            xp.clip(src, 0, out_w - 1), axis=1)
+        new_len = (lens * n).astype(np.int32)
+        out = xp.where(pos < new_len[:, None], gathered, 0)
+        return TCol(out, valid, T.STRING, lengths=new_len)
+
+    def eval_cpu(self, ctx):
+        from spark_rapids_tpu.expressions.base import materialize, valid_array
+        c = self.left.eval(ctx)
+        nt = self.right.eval(ctx)
+        ns = materialize(nt, ctx, np.dtype(np.int64))
+        nv = valid_array(nt, ctx)
+        data = materialize(c, ctx, np.dtype(object))
+        valid = valid_array(c, ctx) & nv
+        out = np.empty(len(data), dtype=object)
+        for i in range(len(data)):
+            out[i] = data[i] * max(0, int(ns[i])) \
+                if valid[i] and data[i] is not None else None
+        return TCol(out, valid, T.STRING)
+
+
+class _Pad(Expression):
+    left_pad = True
+
+    def __init__(self, child, length, pad=None):
+        from spark_rapids_tpu.expressions.base import Literal
+        if pad is None:
+            pad = Literal(" ", T.STRING)
+        super().__init__([child, length, pad])
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def tpu_supported(self, conf):
+        from spark_rapids_tpu.expressions.base import Literal
+        if not (isinstance(self.children[1], Literal)
+                and isinstance(self.children[2], Literal)):
+            return "pad length/fill must be literals on the device"
+        return None
+
+    def eval_tpu(self, ctx):
+        xp = jnp()
+        from spark_rapids_tpu.columnar.column import bucket_strlen
+        tgt = max(0, int(self.children[1].value or 0))
+        pad = self.children[2].value or ""
+        c = self.children[0].eval(ctx)
+        chars, lens, valid = _dev_inputs(c, ctx, xp)
+        w = chars.shape[1]
+        out_w = bucket_strlen(max(1, tgt))
+        pad_bytes = np.frombuffer((pad * (tgt or 1))[:max(1, tgt)]
+                                  .encode()[:max(1, tgt)], dtype=np.uint8)
+        pad_row = xp.asarray(np.pad(pad_bytes,
+                                    (0, max(0, out_w - len(pad_bytes)))))
+        pos = xp.arange(out_w)[None, :]
+        trunc = xp.minimum(lens, tgt)
+        if self.left_pad:
+            n_pad = xp.maximum(tgt - lens, 0)[:, None]
+            src = xp.clip(pos - n_pad, 0, max(w - 1, 0))
+            from_str = xp.take_along_axis(
+                xp.pad(chars, ((0, 0), (0, max(0, out_w - w)))),
+                xp.clip(src, 0, out_w - 1), axis=1)
+            out = xp.where(pos < n_pad, pad_row[None, :][
+                xp.zeros_like(pos), xp.clip(pos, 0, out_w - 1)], from_str)
+        else:
+            padded = xp.pad(chars, ((0, 0), (0, max(0, out_w - w))))
+            pad_region = pad_row[None, :][
+                xp.zeros_like(pos),
+                xp.clip(pos - trunc[:, None], 0, out_w - 1)]
+            out = xp.where(pos < trunc[:, None], padded[:, :out_w],
+                           pad_region)
+        new_len = xp.full(ctx.row_count, tgt, dtype=np.int32)
+        out = xp.where(pos < tgt, out, 0)
+        return TCol(out, valid, T.STRING, lengths=new_len)
+
+    def eval_cpu(self, ctx):
+        from spark_rapids_tpu.expressions.base import materialize, valid_array
+        c = self.children[0].eval(ctx)
+        ln = self.children[1].eval(ctx)
+        pd = self.children[2].eval(ctx)
+        data = materialize(c, ctx, np.dtype(object))
+        lens = materialize(ln, ctx, np.dtype(np.int64))
+        pads = materialize(pd, ctx, np.dtype(object))
+        valid = valid_array(c, ctx) & valid_array(ln, ctx) \
+            & valid_array(pd, ctx)
+        out = np.empty(len(data), dtype=object)
+        for i in range(len(data)):
+            if not valid[i] or data[i] is None or pads[i] is None:
+                out[i] = None
+                continue
+            t = max(0, int(lens[i]))
+            s = data[i]
+            if len(s) >= t:
+                out[i] = s[:t]
+            elif not pads[i]:
+                out[i] = s
+            else:
+                fill = (pads[i] * t)[:t - len(s)]
+                out[i] = fill + s if self.left_pad else s + fill
+        return TCol(out, valid, T.STRING)
+
+
+class LPad(_Pad):
+    left_pad = True
+
+
+class RPad(_Pad):
+    left_pad = False
+
+
+class StringLocate(Expression):
+    """locate(substr, str[, pos]) — 1-based index of the first occurrence at
+    or after pos; 0 when absent (Spark semantics).  Device via the sliding
+    window used by Contains."""
+
+    def __init__(self, substr, string, start=None):
+        from spark_rapids_tpu.expressions.base import Literal
+        if start is None:
+            start = Literal(1, T.INT)
+        super().__init__([substr, string, start])
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def eval_tpu(self, ctx):
+        xp = jnp()
+        from spark_rapids_tpu.expressions.base import materialize, valid_array
+        sub = self.children[0].eval(ctx)
+        s = self.children[1].eval(ctx)
+        st = self.children[2].eval(ctx)
+        bc, bl, bvalid = _dev_inputs(sub, ctx, xp)
+        ac, al, avalid = _dev_inputs(s, ctx, xp)
+        starts0 = materialize(st, ctx, np.dtype(np.int64))
+        wa, wb = ac.shape[1], bc.shape[1]
+        j = xp.arange(wb)[None, None, :]
+        starts = xp.arange(wa)[None, :, None]
+        src = starts + j
+        src_c = xp.broadcast_to(xp.clip(src, 0, wa - 1),
+                                (ac.shape[0], wa, wb))
+        gathered = xp.take_along_axis(ac[:, None, :], src_c, axis=2)
+        in_pat = j < bl[:, None, None]
+        eq = gathered == bc[:, None, :]
+        match_at = xp.all(eq | ~in_pat, axis=2)          # [n, wa]
+        pos_ok = (xp.arange(wa)[None, :] <= (al - bl)[:, None]) & \
+            (xp.arange(wa)[None, :] >= (starts0[:, None] - 1))
+        cand = xp.where(match_at & pos_ok, xp.arange(wa)[None, :], wa)
+        first = xp.min(cand, axis=1)
+        found = first < wa
+        out = xp.where(found, first + 1, 0).astype(np.int32)
+        # Spark: pos <= 0 -> 0; null substr/str -> null
+        out = xp.where(starts0 <= 0, 0, out)
+        valid = avalid & bvalid & valid_array(st, ctx)
+        return TCol(out, valid, T.INT)
+
+    def eval_cpu(self, ctx):
+        from spark_rapids_tpu.expressions.base import materialize, valid_array
+        sub = self.children[0].eval(ctx)
+        s = self.children[1].eval(ctx)
+        st = self.children[2].eval(ctx)
+        subs = materialize(sub, ctx, np.dtype(object))
+        strs = materialize(s, ctx, np.dtype(object))
+        starts = materialize(st, ctx, np.dtype(np.int64))
+        valid = valid_array(sub, ctx) & valid_array(s, ctx) \
+            & valid_array(st, ctx)
+        out = np.zeros(ctx.row_count, dtype=np.int32)
+        for i in range(ctx.row_count):
+            if not valid[i] or subs[i] is None or strs[i] is None:
+                continue
+            p = int(starts[i])
+            if p <= 0:
+                out[i] = 0
+            else:
+                out[i] = strs[i].find(subs[i], p - 1) + 1
+        return TCol(out, valid, T.INT)
+
+
+class StringTranslate(Expression):
+    """translate(str, from, to) — per-byte substitution via a 256-entry
+    lookup table built from the LITERAL from/to strings (device gather)."""
+
+    def __init__(self, child, from_str, to_str):
+        super().__init__([child, from_str, to_str])
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def tpu_supported(self, conf):
+        from spark_rapids_tpu.expressions.base import Literal
+        if not (isinstance(self.children[1], Literal)
+                and isinstance(self.children[2], Literal)):
+            return "translate from/to must be literals on the device"
+        f, t = self.children[1].value, self.children[2].value
+        if any(ord(ch) > 127 for ch in (f or "") + (t or "")):
+            return "non-ASCII translate is host tier"
+        if len(f or "") > len(t or ""):
+            return "translate with deletions is host tier (ragged output)"
+        return None
+
+    def _table(self):
+        f = self.children[1].value or ""
+        t = self.children[2].value or ""
+        tab = np.arange(256, dtype=np.uint8)
+        for fc, tc in zip(f, t):
+            tab[ord(fc)] = ord(tc)
+        return tab
+
+    def eval_tpu(self, ctx):
+        xp = jnp()
+        c = self.children[0].eval(ctx)
+        chars, lens, valid = _dev_inputs(c, ctx, xp)
+        tab = xp.asarray(self._table())
+        out = xp.take(tab, chars.astype(np.int32))
+        pos = xp.arange(chars.shape[1])[None, :]
+        out = xp.where(pos < lens[:, None], out, 0)
+        return TCol(out, valid, T.STRING, lengths=lens)
+
+    def eval_cpu(self, ctx):
+        f = self.children[1].value or ""
+        t = self.children[2].value or ""
+        # Spark translate: chars beyond `to` are DELETED
+        table = {ord(fc): (ord(t[i]) if i < len(t) else None)
+                 for i, fc in enumerate(f)}
+        c = self.children[0].eval(ctx)
+        out, valid = _cpu_str_map(c, ctx, lambda s: s.translate(table))
+        return TCol(out, valid, T.STRING)
+
+
+class StringSplit(Expression):
+    """split(str, delim[, limit]) -> array<string> (host tier: string-array
+    outputs have no device plane; reference GpuStringSplit gates on the
+    regex transpiler the same way)."""
+
+    def __init__(self, child, delim, limit=None):
+        from spark_rapids_tpu.expressions.base import Literal
+        if limit is None:
+            limit = Literal(-1, T.INT)
+        super().__init__([child, delim, limit])
+
+    @property
+    def data_type(self):
+        return T.ArrayType(T.STRING)
+
+    def tpu_supported(self, conf):
+        return "string-array output is host tier"
+
+    def eval_cpu(self, ctx):
+        import re as _re
+        from spark_rapids_tpu import regexp as RX
+        from spark_rapids_tpu.expressions.base import (Literal, materialize,
+                                                       valid_array)
+        delim = self.children[1]
+        if not isinstance(delim, Literal):
+            raise NotImplementedError("split delimiter must be a literal")
+        limit = int(self.children[2].value)
+        tx = RX.transpile(delim.value, RX.SPLIT)
+        rx = _re.compile(tx.pattern)
+        c = self.children[0].eval(ctx)
+        data = materialize(c, ctx, np.dtype(object))
+        valid = valid_array(c, ctx)
+        out = np.empty(ctx.row_count, dtype=object)
+        for i in range(ctx.row_count):
+            if not valid[i] or data[i] is None:
+                out[i] = None
+                continue
+            parts = rx.split(data[i], maxsplit=0 if limit <= 0
+                             else limit - 1)
+            if limit <= 0:
+                # Spark drops trailing empty strings when limit <= 0
+                while parts and parts[-1] == "":
+                    parts.pop()
+            out[i] = parts
+        return TCol(out, valid, self.data_type)
+
+    eval_tpu = eval_cpu
+
+
+class ConcatWs(Expression):
+    """concat_ws(sep, e1, ..., en): null inputs are SKIPPED (not nulling),
+    per Spark semantics."""
+
+    def __init__(self, sep, *exprs):
+        super().__init__([sep] + list(exprs))
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    @property
+    def nullable(self):
+        return self.children[0].nullable
+
+    def tpu_supported(self, conf):
+        return "concat_ws is host tier (ragged skip-null concat)"
+
+    def eval_cpu(self, ctx):
+        from spark_rapids_tpu.expressions.base import materialize, valid_array
+        sep_tc = self.children[0].eval(ctx)
+        seps = materialize(sep_tc, ctx, np.dtype(object))
+        sep_valid = valid_array(sep_tc, ctx)
+        parts = [self.children[i].eval(ctx)
+                 for i in range(1, len(self.children))]
+        datas = [materialize(p, ctx, np.dtype(object)) for p in parts]
+        valids = [valid_array(p, ctx) for p in parts]
+        out = np.empty(ctx.row_count, dtype=object)
+        ok = np.zeros(ctx.row_count, dtype=bool)
+        for i in range(ctx.row_count):
+            if not sep_valid[i] or seps[i] is None:
+                out[i] = None
+                continue
+            vals = [d[i] for d, v in zip(datas, valids)
+                    if v[i] and d[i] is not None]
+            out[i] = seps[i].join(vals)
+            ok[i] = True
+        return TCol(out, ok, T.STRING)
+
+    eval_tpu = eval_cpu

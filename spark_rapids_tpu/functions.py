@@ -437,3 +437,83 @@ def from_utc_timestamp(e, zone: str):
 def to_utc_timestamp(e, zone: str):
     from spark_rapids_tpu.expressions.timezone_db import ToUTCTimestamp
     return ToUTCTimestamp(_expr(e), zone)
+
+
+# -- collection/percentile aggregates ---------------------------------------
+
+def collect_list(e):
+    from spark_rapids_tpu.expressions.aggregates import CollectList
+    return CollectList(_expr(e))
+
+
+def collect_set(e):
+    from spark_rapids_tpu.expressions.aggregates import CollectSet
+    return CollectSet(_expr(e))
+
+
+def percentile(e, percentage):
+    from spark_rapids_tpu.expressions.aggregates import Percentile
+    return Percentile(_expr(e), percentage)
+
+
+def approx_percentile(e, percentage, accuracy: int = 10000):
+    from spark_rapids_tpu.expressions.aggregates import ApproximatePercentile
+    return ApproximatePercentile(_expr(e), percentage, accuracy)
+
+
+def reverse(e):
+    from spark_rapids_tpu.expressions.strings import Reverse
+    return Reverse(_expr(e))
+
+
+def initcap(e):
+    from spark_rapids_tpu.expressions.strings import InitCap
+    return InitCap(_expr(e))
+
+
+def repeat(e, n: int):
+    from spark_rapids_tpu.expressions.base import lit
+    from spark_rapids_tpu.expressions.strings import StringRepeat
+    return StringRepeat(_expr(e), n if isinstance(n, Expression) else lit(n))
+
+
+def lpad(e, length_: int, pad: str = " "):
+    from spark_rapids_tpu.expressions.base import lit
+    from spark_rapids_tpu.expressions.strings import LPad
+    return LPad(_expr(e), lit(length_), lit(pad))
+
+
+def rpad(e, length_: int, pad: str = " "):
+    from spark_rapids_tpu.expressions.base import lit
+    from spark_rapids_tpu.expressions.strings import RPad
+    return RPad(_expr(e), lit(length_), lit(pad))
+
+
+def locate(substr, e, pos: int = 1):
+    from spark_rapids_tpu.expressions.base import lit
+    from spark_rapids_tpu.expressions.strings import StringLocate
+    return StringLocate(lit(substr) if not isinstance(substr, Expression)
+                        else substr, _expr(e), lit(pos))
+
+
+def instr(e, substr):
+    return locate(substr, e, 1)
+
+
+def translate(e, from_str: str, to_str: str):
+    from spark_rapids_tpu.expressions.base import lit
+    from spark_rapids_tpu.expressions.strings import StringTranslate
+    return StringTranslate(_expr(e), lit(from_str), lit(to_str))
+
+
+def split(e, pattern: str, limit: int = -1):
+    from spark_rapids_tpu.expressions.base import lit
+    from spark_rapids_tpu.expressions.strings import StringSplit
+    return StringSplit(_expr(e), lit(pattern), lit(limit))
+
+
+def concat_ws(sep: str, *cols):
+    from spark_rapids_tpu.expressions.base import lit
+    from spark_rapids_tpu.expressions.strings import ConcatWs
+    return ConcatWs(lit(sep) if not isinstance(sep, Expression) else sep,
+                    *[_expr(c) for c in cols])

@@ -119,8 +119,12 @@ register_expr(CA.Cast, TS.ALL_BASIC)
 
 for _cls in (S.Length, S.Upper, S.Lower, S.Concat, S.Substring, S.StartsWith,
              S.EndsWith, S.Contains, S.Trim, S.LTrim, S.RTrim, S.Like,
-             S.RLike, S.RegExpReplace, S.RegExpExtract):
+             S.RLike, S.RegExpReplace, S.RegExpExtract, S.Reverse,
+             S.InitCap, S.StringRepeat, S.LPad, S.RPad, S.StringLocate,
+             S.StringTranslate, S.ConcatWs):
     register_expr(_cls, TS.ALL_BASIC)
+
+register_expr(S.StringSplit, TS.BASIC_WITH_ARRAYS)
 
 for _cls in (D._DateField, D._TimeField, D.DateAdd, D.DateSub, D.DateDiff,
              D.LastDay, D.UnixTimestampFromTs):
@@ -156,6 +160,11 @@ for _cls in (AG.Sum, AG.Count, AG.Min, AG.Max, AG.Average, AG.First,
              AG.Last, AG.VarianceSamp, AG.VariancePop, AG.StddevSamp,
              AG.StddevPop):
     register_expr(_cls, TS.ALL_BASIC)
+
+# variable-length-state aggregates: host tier (COMPLETE-mode planning)
+for _cls in (AG.CollectList, AG.CollectSet, AG.Percentile,
+             AG.ApproximatePercentile, AG._PercentileFromList):
+    register_expr(_cls, TS.BASIC_WITH_ARRAYS)
 
 
 # ---------------------------------------------------------------------------

@@ -663,6 +663,22 @@ class GroupedData:
         child = self._df._plan
         if self._grouping_sets is not None:
             return self._agg_grouping_sets(aggs)
+        if any(a.func.requires_complete for a in aggs):
+            # variable-length-state aggregates (collect/percentile): hash
+            # shuffle the RAW rows by key, then one COMPLETE pass per
+            # partition (Spark's ObjectHashAggregate pattern)
+            nk = len(self._keys)
+            if child.num_partitions > 1 and nk:
+                part = HashPartitioning(self._keys, child.num_partitions)
+                child = CpuShuffleExchangeExec(
+                    part, child, shuffle_env=self._df._session.shuffle_env)
+            elif child.num_partitions > 1:
+                from spark_rapids_tpu.exec.basic import \
+                    CpuCoalescePartitionsExec
+                child = CpuCoalescePartitionsExec(1, child)
+            return DataFrame(
+                CpuHashAggregateExec(self._keys, aggs, COMPLETE, child),
+                self._df._session)
         if child.num_partitions == 1:
             plan = CpuHashAggregateExec(self._keys, aggs, COMPLETE, child)
         else:

@@ -1,0 +1,85 @@
+"""Volume string function tests (reference: string_test.py)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.expressions.base import Alias, col, lit
+
+from tests.asserts import assert_tpu_and_cpu_are_equal_collect, cpu_session
+
+_STRS = [None, "", "a", "Hello world", "FOO bar Baz", "x" * 30,
+         "one two  three", "AbCdEf", "  pad  ", "tail "]
+
+
+def _df(s, parts=2):
+    return s.create_dataframe({"s": _STRS, "n": list(range(10))},
+                              num_partitions=parts)
+
+
+def test_reverse_initcap_differential():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            Alias(F.reverse(col("s")), "r"),
+            Alias(F.initcap(col("s")), "ic")))
+    rows = _df(cpu_session()).select(
+        Alias(F.reverse(col("s")), "r"),
+        Alias(F.initcap(col("s")), "ic")).collect()
+    assert rows[3]["r"] == "dlrow olleH"
+    assert rows[3]["ic"] == "Hello World"
+    assert rows[4]["ic"] == "Foo Bar Baz"
+
+
+def test_repeat_pad_differential():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            Alias(F.repeat(col("s"), 3), "r3"),
+            Alias(F.lpad(col("s"), 12, "*"), "lp"),
+            Alias(F.rpad(col("s"), 12, "-"), "rp"),
+            Alias(F.lpad(col("s"), 2), "trunc")))
+    rows = _df(cpu_session()).select(
+        Alias(F.lpad(col("s"), 6, "*"), "lp"),
+        Alias(F.rpad(col("s"), 6, "-"), "rp")).collect()
+    assert rows[2]["lp"] == "*****a" and rows[2]["rp"] == "a-----"
+    assert rows[3]["lp"] == "Hello " and rows[3]["rp"] == "Hello "
+
+
+def test_locate_translate_differential():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            Alias(F.locate("o", col("s")), "lo"),
+            Alias(F.locate("o", col("s"), 6), "lo6"),
+            Alias(F.instr(col("s"), "wor"), "iw"),
+            Alias(F.translate(col("s"), "lo", "LO"), "tr")))
+    rows = _df(cpu_session()).select(
+        Alias(F.locate("o", col("s")), "lo"),
+        Alias(F.translate(col("s"), "lo", "LO"), "tr")).collect()
+    assert rows[3]["lo"] == 5                       # Hell[o]
+    assert rows[3]["tr"] == "HeLLO wOrLd"
+
+
+def test_split_and_concat_ws():
+    s = cpu_session()
+    rows = (_df(s).select(
+        Alias(F.split(col("s"), " "), "sp"),
+        Alias(F.concat_ws("-", col("s"), lit("z")), "cw")).collect())
+    assert rows[3]["sp"] == ["Hello", "world"]
+    assert rows[6]["sp"] == ["one", "two", "", "three"]
+    assert rows[9]["sp"] == ["tail"]               # trailing empty dropped
+    assert rows[3]["cw"] == "Hello world-z"
+    assert rows[0]["cw"] == "z"                    # null input skipped
+    from tests.asserts import tpu_session
+    s2 = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+    rows2 = (_df(s2).select(
+        Alias(F.split(col("s"), " "), "sp"),
+        Alias(F.concat_ws("-", col("s"), lit("z")), "cw")).collect())
+    assert rows2 == rows
+
+
+def test_translate_with_deletion_falls_back():
+    from tests.asserts import tpu_session
+    s = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+    df = _df(s).select(Alias(F.translate(col("s"), "lox", "L"), "t"))
+    assert "host tier" in df.explain()
+    rows = df.collect()
+    assert rows[3]["t"] == "HeLL wrLd"             # o, x deleted
