@@ -272,6 +272,17 @@ DUMP_PATH = conf_str(
     "under this prefix when a kernel fails (reference: DumpUtils.scala).",
     "")
 
+ADAPTIVE_COALESCE_ENABLED = conf_bool(
+    "spark.sql.adaptive.coalescePartitions.enabled",
+    "Post-shuffle adaptive partition coalescing from materialized sizes "
+    "(reference: GpuCustomShuffleReaderExec consuming AQE specs).",
+    True)
+
+ADVISORY_PARTITION_BYTES = conf_bytes(
+    "spark.sql.adaptive.advisoryPartitionSizeInBytes",
+    "Target size for adaptive partition coalescing.",
+    "64m")
+
 FILECACHE_ENABLED = conf_bool(
     "spark.rapids.filecache.enabled",
     "Cache remote file ranges on local disk (reference: the closed-source "

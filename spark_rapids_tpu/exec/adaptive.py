@@ -1,0 +1,173 @@
+"""Adaptive (AQE-style) shuffle reader.
+
+Reference: GpuCustomShuffleReaderExec (SURVEY.md §2.9) — consumes the
+partition specs Spark's AQE derives from materialized shuffle statistics:
+CoalescedPartitionSpec (merge small adjacent reduce partitions) and
+PartialReducerPartitionSpec (split skewed ones).  Here the engine IS the
+planner, so the reader derives the specs itself from the exchange's
+materialized per-partition sizes.
+
+The planner pass applies COALESCING universally (whole-partition merges
+preserve hash-grouping and range order).  Skew-split specs are computed by
+the same machinery but only applied where duplication is coordinated (the
+shuffled-join path), mirroring Spark's own restriction."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Union
+
+from spark_rapids_tpu.plan.base import Exec, UnaryExec
+
+
+@dataclasses.dataclass(frozen=True)
+class CoalescedPartitionSpec:
+    """Read reduce partitions [start, end) as one output partition."""
+    start: int
+    end: int
+
+
+@dataclasses.dataclass(frozen=True)
+class PartialPartitionSpec:
+    """Read a slice of one reduce partition's batches (skew split)."""
+    partition: int
+    batch_start: int
+    batch_end: int
+
+
+PartitionSpec = Union[CoalescedPartitionSpec, PartialPartitionSpec]
+
+
+def _partition_sizes(exchange) -> List[int]:
+    """Materializes the exchange and sizes each reduce partition (the AQE
+    'query stage statistics' step)."""
+    exchange._materialize()
+    sizes = []
+    for p in range(exchange.num_partitions):
+        total = 0
+        for b in exchange._store[p]:
+            total += b.nbytes() if hasattr(b, "nbytes") else 0
+        sizes.append(total)
+    return sizes
+
+
+def coalesce_specs(sizes: Sequence[int],
+                   target_bytes: int) -> List[CoalescedPartitionSpec]:
+    """Greedy adjacent merge up to the advisory size (Spark's
+    coalescePartitions algorithm)."""
+    specs: List[CoalescedPartitionSpec] = []
+    start = 0
+    acc = 0
+    for i, sz in enumerate(sizes):
+        if i > start and acc + sz > target_bytes:
+            specs.append(CoalescedPartitionSpec(start, i))
+            start, acc = i, 0
+        acc += sz
+    if start < len(sizes) or not specs:
+        specs.append(CoalescedPartitionSpec(start, max(len(sizes), 1)))
+    return specs
+
+
+def skew_split_specs(exchange, pidx: int,
+                     target_bytes: int) -> List[PartialPartitionSpec]:
+    """Splits one partition's batch list into roughly target-sized runs
+    (PartialReducerPartitionSpec analog)."""
+    exchange._materialize()
+    batches = exchange._store[pidx]
+    specs = []
+    start = 0
+    acc = 0
+    for i, b in enumerate(batches):
+        sz = b.nbytes() if hasattr(b, "nbytes") else 0
+        if i > start and acc + sz > target_bytes:
+            specs.append(PartialPartitionSpec(pidx, start, i))
+            start, acc = i, 0
+        acc += sz
+    specs.append(PartialPartitionSpec(pidx, start, len(batches)))
+    return specs
+
+
+def detect_skew(sizes: Sequence[int], factor: float = 5.0,
+                min_bytes: int = 64 << 20) -> List[int]:
+    """Skewed partition indexes: > factor * median AND > min size
+    (Spark skewJoin detection)."""
+    if not sizes:
+        return []
+    srt = sorted(sizes)
+    median = srt[len(srt) // 2]
+    return [i for i, s in enumerate(sizes)
+            if s > max(median * factor, min_bytes)]
+
+
+class AdaptiveShuffleReaderExec(UnaryExec):
+    """Reads an exchange through derived partition specs."""
+
+    def __init__(self, exchange, target_bytes: int = 64 << 20,
+                 specs: Optional[List[PartitionSpec]] = None):
+        super().__init__(exchange)
+        self.target_bytes = target_bytes
+        self._specs = specs
+
+    @property
+    def is_device(self):  # type: ignore[override]
+        return self.children[0].is_device
+
+    @property
+    def specs(self) -> List[PartitionSpec]:
+        if self._specs is None:
+            sizes = _partition_sizes(self.children[0])
+            self._specs = coalesce_specs(sizes, self.target_bytes)
+        return self._specs
+
+    @property
+    def num_partitions(self):
+        return len(self.specs)
+
+    def execute_partition(self, pidx):
+        spec = self.specs[pidx]
+        ex = self.children[0]
+        if isinstance(spec, CoalescedPartitionSpec):
+            for p in range(spec.start, min(spec.end, ex.num_partitions)):
+                yield from ex.execute_partition(p)
+        else:
+            ex._materialize()
+            batches = ex._store[spec.partition]
+            for b in batches[spec.batch_start:spec.batch_end]:
+                if ex.is_device and not hasattr(b, "bucket"):
+                    from spark_rapids_tpu.exec.basic import upload_batches
+                    yield from upload_batches([b])
+                else:
+                    yield b
+
+    def node_desc(self):
+        if self._specs is None:
+            return "AdaptiveShuffleReader[pending]"
+        nc = sum(1 for s in self._specs
+                 if isinstance(s, CoalescedPartitionSpec))
+        np_ = len(self._specs) - nc
+        return (f"AdaptiveShuffleReader[{len(self._specs)}p "
+                f"({nc} coalesced, {np_} partial)]")
+
+
+def insert_adaptive_readers(plan: Exec, target_bytes: int) -> Exec:
+    """Planner pass: wrap every shuffle exchange whose parent will iterate
+    its reduce partitions (coalescing is always safe: whole partitions
+    merge, so hash groups and range order are preserved)."""
+    from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
+    from spark_rapids_tpu.plan.base import BinaryExec
+
+    def fix(node: Exec) -> Exec:
+        if isinstance(node, BinaryExec):
+            # join inputs pair partition i with partition i: independent
+            # re-coalescing would break the pairing (Spark coordinates
+            # these specs across both sides; that path is the join's)
+            return node
+        new_children = []
+        for c in node.children:
+            if isinstance(c, CpuShuffleExchangeExec) and \
+                    not isinstance(node, AdaptiveShuffleReaderExec):
+                c = AdaptiveShuffleReaderExec(c, target_bytes)
+            new_children.append(c)
+        return node.with_children(new_children)
+
+    return plan.transform_up(fix)

@@ -120,7 +120,7 @@ def get_file_cache(conf=None) -> Optional[FileCache]:
         if _ACTIVE is None and conf is not None and \
                 str(conf.get(C.FILECACHE_ENABLED.key)).lower() == "true":
             _ACTIVE = FileCache(
-                max_bytes=int(conf.get(C.FILECACHE_MAX_BYTES.key)))
+                max_bytes=C.parse_bytes(conf.get(C.FILECACHE_MAX_BYTES.key)))
         return _ACTIVE
 
 

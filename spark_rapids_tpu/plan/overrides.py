@@ -322,6 +322,11 @@ class TpuOverrides:
         out = insert_transitions(converted, conf)
         out = self._coalesce_after_device_sources(out)
         out = fuse_device_stages(out)
+        if conf.get(C.ADAPTIVE_COALESCE_ENABLED.key):
+            from spark_rapids_tpu.exec.adaptive import \
+                insert_adaptive_readers
+            out = insert_adaptive_readers(
+                out, C.parse_bytes(conf.get(C.ADVISORY_PARTITION_BYTES.key)))
         if conf.is_test_enabled and not for_explain:
             validate_all_on_device(out, conf)
         from spark_rapids_tpu.aux.capture import ExecutionPlanCaptureCallback

@@ -1,0 +1,95 @@
+"""Adaptive shuffle reader tests (reference: GpuCustomShuffleReaderExec +
+aqe_test.py)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.exec.adaptive import (AdaptiveShuffleReaderExec,
+                                            CoalescedPartitionSpec,
+                                            PartialPartitionSpec,
+                                            coalesce_specs, detect_skew,
+                                            skew_split_specs)
+from spark_rapids_tpu.expressions.base import Alias, col, lit
+
+from tests.asserts import (assert_tpu_and_cpu_are_equal_collect, cpu_session,
+                           tpu_session)
+
+
+def test_coalesce_specs_merges_small():
+    sizes = [10, 10, 10, 100, 5, 5, 5, 5]
+    specs = coalesce_specs(sizes, target_bytes=30)
+    # every input partition covered exactly once, in order
+    covered = [p for s in specs for p in range(s.start, s.end)]
+    assert covered == list(range(8))
+    assert len(specs) < 8
+    assert all(isinstance(s, CoalescedPartitionSpec) for s in specs)
+
+
+def test_coalesce_specs_degenerate():
+    assert coalesce_specs([], 10) == [CoalescedPartitionSpec(0, 1)]
+    assert coalesce_specs([1000], 10) == [CoalescedPartitionSpec(0, 1)]
+
+
+def test_detect_skew():
+    sizes = [10, 10, 10, 10_000_000_000, 10]
+    assert detect_skew(sizes, factor=5.0, min_bytes=1000) == [3]
+    assert detect_skew([10, 10, 10], factor=5.0, min_bytes=1000) == []
+
+
+def test_reader_end_to_end_differential():
+    rng = np.random.default_rng(2)
+    data = {"g": rng.integers(0, 100, 20_000).astype(np.int64),
+            "v": rng.standard_normal(20_000)}
+    # tiny advisory size: the 16 default shuffle partitions coalesce
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(data, num_partitions=4)
+        .group_by("g").agg(Alias(F.sum(col("v")), "sv")),
+        ignore_order=True, approx_float=True,
+        conf={"spark.sql.adaptive.advisoryPartitionSizeInBytes": "8k"})
+
+
+def test_reader_coalesces_partitions():
+    rng = np.random.default_rng(3)
+    data = {"g": rng.integers(0, 50, 5000).astype(np.int64),
+            "v": rng.standard_normal(5000)}
+    s = tpu_session({"spark.rapids.sql.test.enabled": "false",
+                     "spark.sql.adaptive.advisoryPartitionSizeInBytes":
+                         "1g"})
+    df = (s.create_dataframe(data, num_partitions=4)
+          .group_by("g").agg(Alias(F.count(col("v")), "c")))
+    plan = df._executed_plan()
+    readers = [n for n in plan.collect_nodes()
+               if isinstance(n, AdaptiveShuffleReaderExec)]
+    assert readers
+    rows = plan.collect_host().row_count
+    assert rows == 50
+    # with a huge advisory size everything coalesces into few partitions
+    assert readers[0].num_partitions < readers[0].children[0].num_partitions
+
+
+def test_order_preserved_through_coalescing():
+    rng = np.random.default_rng(4)
+    data = {"v": rng.standard_normal(8000)}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(data, num_partitions=4).order_by("v"),
+        conf={"spark.sql.adaptive.advisoryPartitionSizeInBytes": "16k"})
+
+
+def test_skew_split_specs_cover_batches():
+    s = cpu_session()
+    from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
+    from spark_rapids_tpu.plan.partitioning import RoundRobinPartitioning
+    df = s.create_dataframe({"v": np.arange(100)}, num_partitions=5)
+    ex = CpuShuffleExchangeExec(RoundRobinPartitioning(2), df._plan)
+    specs = skew_split_specs(ex, 0, target_bytes=1)
+    assert all(isinstance(x, PartialPartitionSpec) for x in specs)
+    n_batches = len(ex._store[0])
+    covered = [b for x in specs for b in range(x.batch_start, x.batch_end)]
+    assert covered == list(range(n_batches))
+    # reading the split specs yields every row of the partition
+    reader = AdaptiveShuffleReaderExec(ex, specs=specs)
+    rows = sum(b.row_count for p in range(reader.num_partitions)
+               for b in reader.execute_partition(p))
+    want = sum(b.row_count for b in ex._store[0])
+    assert rows == want
