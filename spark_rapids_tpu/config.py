@@ -272,6 +272,17 @@ DUMP_PATH = conf_str(
     "under this prefix when a kernel fails (reference: DumpUtils.scala).",
     "")
 
+JOIN_SUBPARTITION_THRESHOLD = conf_bytes(
+    "spark.rapids.sql.join.subPartitionThresholdBytes",
+    "Build sides larger than this re-partition into hash buckets joined "
+    "independently (reference: GpuSubPartitionHashJoin.scala).",
+    "1g")
+
+JOIN_NUM_SUBPARTITIONS = conf_int(
+    "spark.rapids.sql.join.numSubPartitions",
+    "Bucket count for oversized-join sub-partitioning.",
+    16)
+
 ADAPTIVE_COALESCE_ENABLED = conf_bool(
     "spark.sql.adaptive.coalescePartitions.enabled",
     "Post-shuffle adaptive partition coalescing from materialized sizes "

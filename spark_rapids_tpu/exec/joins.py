@@ -501,9 +501,140 @@ def _reg(cpu_cls, tpu_cls, desc):
         desc=desc)
 
 
-_reg(CpuShuffledHashJoinExec, TpuShuffledHashJoinExec,
-     "hash join over shuffled children")
+def _convert_shuffled(p, m):
+    """Shuffled joins convert to the sub-partition-capable device join;
+    below the size threshold it behaves exactly like the plain one."""
+    from spark_rapids_tpu import config as C
+    out = TpuSubPartitionHashJoinExec(p.left_keys, p.right_keys,
+                                      p.join_type, p.condition,
+                                      p.children[0], p.children[1],
+                                      p.null_safe)
+    out.subpartition_threshold = C.parse_bytes(
+        m.conf.get(C.JOIN_SUBPARTITION_THRESHOLD.key))
+    out.num_subpartitions = int(m.conf.get(C.JOIN_NUM_SUBPARTITIONS.key))
+    return out
+
+
+register_exec(CpuShuffledHashJoinExec, convert=_convert_shuffled,
+              exprs_of=_join_exprs,
+              desc="hash join over shuffled children (size-adaptive "
+                   "sub-partitioning)")
 _reg(CpuBroadcastHashJoinExec, TpuBroadcastHashJoinExec,
      "broadcast hash join")
 _reg(CpuBroadcastNestedLoopJoinExec, TpuBroadcastNestedLoopJoinExec,
      "broadcast nested loop join")
+
+
+# ---------------------------------------------------------------------------
+# sub-partitioned join for oversized inputs (reference:
+# GpuSubPartitionHashJoin.scala — when the build side cannot fit the memory
+# budget, re-hash BOTH sides with a fresh seed into buckets and join each
+# bucket pair independently; rows of one key land in exactly one bucket)
+# ---------------------------------------------------------------------------
+
+_SUBPART_SEED = 1999
+
+
+def _subpartition_ids_device(batch, keys, k):
+    from spark_rapids_tpu.columnar.column import _jnp
+    from spark_rapids_tpu.expressions.evaluator import device_batch_tcols
+    from spark_rapids_tpu.expressions.hashing import Murmur3Hash
+    jnp = _jnp()
+    ctx = EvalContext(device_batch_tcols(batch), "tpu", batch.bucket)
+    h = Murmur3Hash(*keys, seed=_SUBPART_SEED).eval_tpu(ctx)
+    r = h.data.astype(np.int32) % np.int32(k)
+    return jnp.where(r < 0, r + k, r)
+
+
+def _subpartition_device(batches, keys, k):
+    """Splits device batches into k bucket lists by re-hash of the keys."""
+    from spark_rapids_tpu.columnar.column import _jnp
+    from spark_rapids_tpu.ops.batch_ops import compact_batch
+    jnp = _jnp()
+    buckets = [[] for _ in range(k)]
+    for b in batches:
+        pids = _subpartition_ids_device(b, keys, k)
+        live = jnp.arange(b.bucket) < b.row_count
+        for i in range(k):
+            sub = compact_batch(b, (pids == i) & live)
+            buckets[i].append(sub)
+    return buckets
+
+
+def _subpartition_host(batches, keys, k, schema):
+    import pyarrow as pa
+    from spark_rapids_tpu.columnar.batch import batch_from_arrow
+    from spark_rapids_tpu.expressions.evaluator import host_batch_tcols
+    from spark_rapids_tpu.expressions.hashing import Murmur3Hash
+    buckets = [[] for _ in range(k)]
+    for hb in batches:
+        ctx = EvalContext(host_batch_tcols(hb), "cpu", hb.row_count)
+        h = Murmur3Hash(*keys, seed=_SUBPART_SEED).eval_cpu(ctx)
+        pids = np.mod(h.data.astype(np.int64), k).astype(np.int64)
+        tab = pa.Table.from_batches([hb.to_arrow()])
+        for i in range(k):
+            idx = np.flatnonzero(pids == i)
+            if len(idx):
+                buckets[i].append(
+                    batch_from_arrow(tab.take(pa.array(idx))))
+    return buckets
+
+
+class _SubPartitionMixin:
+    """Adds size-gated sub-partitioning to the shuffled joins."""
+
+    subpartition_threshold: int = 1 << 30
+    num_subpartitions: int = 16
+
+    def _build_oversized(self, build_batches) -> bool:
+        total = sum(b.nbytes() if hasattr(b, "nbytes") else 0
+                    for b in build_batches)
+        return total > self.subpartition_threshold
+
+
+class CpuSubPartitionHashJoinExec(_SubPartitionMixin, CpuShuffledHashJoinExec):
+    """Host variant (oracle): always joins through the bucket machinery."""
+
+    def execute_partition(self, pidx):
+        left = list(self.left.execute_partition(pidx))
+        right = list(self.right.execute_partition(pidx))
+        if not self._build_oversized(right):
+            lb = _concat_or_empty(left, self.left.schema)
+            rb = _concat_or_empty(right, self.right.schema)
+            out = self._join_host(lb, rb)
+            if out.row_count:
+                yield out
+            return
+        k = self.num_subpartitions
+        lbuckets = _subpartition_host(left, self.left_keys, k,
+                                      self.left.schema)
+        rbuckets = _subpartition_host(right, self.right_keys, k,
+                                      self.right.schema)
+        for i in range(k):
+            lb = _concat_or_empty(lbuckets[i], self.left.schema)
+            rb = _concat_or_empty(rbuckets[i], self.right.schema)
+            if lb.row_count == 0 and rb.row_count == 0:
+                continue
+            out = self._join_host(lb, rb)
+            if out.row_count:
+                yield out
+
+
+class TpuSubPartitionHashJoinExec(_SubPartitionMixin, TpuShuffledHashJoinExec):
+    def execute_partition(self, pidx):
+        build = list(self.right.execute_partition(pidx))
+        if not self._build_oversized(build):
+            yield from self._join_device(
+                self.left.execute_partition(pidx), build)
+            return
+        k = self.num_subpartitions
+        probe = list(self.left.execute_partition(pidx))
+        lbuckets = _subpartition_device(probe, self.left_keys, k)
+        rbuckets = _subpartition_device(build, self.right_keys, k)
+        for i in range(k):
+            yield from self._join_device(iter(lbuckets[i]), rbuckets[i])
+
+
+register_exec(CpuSubPartitionHashJoinExec, convert=_convert_shuffled,
+              exprs_of=_join_exprs,
+              desc="explicit sub-partitioned hash join")

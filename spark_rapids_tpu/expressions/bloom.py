@@ -1,0 +1,129 @@
+"""Bloom filter build + probe (join pruning).
+
+Reference: the JNI ``BloomFilter`` kernels (SURVEY.md §2.16) backing
+Spark's runtime bloom-filter join pruning (BloomFilterAggregate /
+BloomFilterMightContain).
+
+Layout: a power-of-two bit array packed in uint32 words.  Positions come
+from double hashing (h1 + i*h2) of the murmur3 of the value — the build is
+hash + scatter-OR, the probe is gather + AND: both pure elementwise device
+work."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import (EvalContext, Expression, TCol,
+                                               jnp, valid_array)
+from spark_rapids_tpu.expressions.hashing import murmur3_col
+
+
+class BloomFilter:
+    """Immutable once built; ``might_contain`` has no false negatives."""
+
+    def __init__(self, bits_words: np.ndarray, num_hashes: int):
+        self.words = np.asarray(bits_words, dtype=np.uint32)
+        self.num_bits = len(self.words) * 32
+        assert self.num_bits & (self.num_bits - 1) == 0, \
+            "bloom bit count must be a power of two"
+        self.num_hashes = num_hashes
+
+    # -- construction --------------------------------------------------------
+    @staticmethod
+    def empty(num_bits: int = 1 << 20, num_hashes: int = 3) -> "BloomFilter":
+        if num_bits & (num_bits - 1):
+            raise ValueError("num_bits must be a power of two")
+        return BloomFilter(np.zeros(num_bits // 32, dtype=np.uint32),
+                           num_hashes)
+
+    @staticmethod
+    def build(df, column, num_bits: int = 1 << 20,
+              num_hashes: int = 3) -> "BloomFilter":
+        """Builds from a DataFrame column (one pass over the executed
+        plan; device batches hash on device, the small word array folds on
+        host)."""
+        from spark_rapids_tpu.expressions.base import bind_references, col
+        bf = BloomFilter.empty(num_bits, num_hashes)
+        expr = bind_references(
+            col(column) if isinstance(column, str) else column, df.schema)
+        plan = df._executed_plan()
+        for b in plan.execute_all():
+            if hasattr(b, "bucket"):
+                hb = b.to_host()
+            else:
+                hb = b
+            from spark_rapids_tpu.expressions.evaluator import host_batch_tcols
+            ctx = EvalContext(host_batch_tcols(hb), "cpu", hb.row_count)
+            tc = expr.eval_cpu(ctx)
+            bf._add_host(tc, ctx)
+        return bf
+
+    def _positions(self, tc: TCol, ctx, xp):
+        dt = tc.dtype
+        h1 = murmur3_col(tc, dt, np.uint32(0x9747B28C), ctx, xp) \
+            .astype(np.uint32)
+        h2 = murmur3_col(tc, dt, np.uint32(0x85EBCA6B), ctx, xp) \
+            .astype(np.uint32) | np.uint32(1)
+        mask = np.uint32(self.num_bits - 1)
+        return [((h1 + np.uint32(i) * h2) & mask)
+                for i in range(self.num_hashes)]
+
+    def _add_host(self, tc: TCol, ctx) -> None:
+        valid = np.asarray(valid_array(tc, ctx))
+        for pos in self._positions(tc, ctx, np):
+            p = np.asarray(pos)[valid]
+            np.bitwise_or.at(self.words, p >> 5,
+                             np.uint32(1) << (p & np.uint32(31)))
+
+    # -- probe ---------------------------------------------------------------
+    def might_contain_kernel(self, tc: TCol, ctx, xp):
+        """bool array: True unless definitely absent."""
+        words = xp.asarray(self.words)
+        out = None
+        for pos in self._positions(tc, ctx, xp):
+            w = xp.take(words, (pos >> np.uint32(5)).astype(np.int32))
+            bit = (w >> (pos & np.uint32(31))) & np.uint32(1)
+            hit = bit != 0
+            out = hit if out is None else (out & hit)
+        return out
+
+    @property
+    def saturation(self) -> float:
+        return float(np.unpackbits(self.words.view(np.uint8)).mean())
+
+
+class BloomMightContain(Expression):
+    """might_contain(bloom, value): null-in-null-out probe expression
+    (reference: GpuBloomFilterMightContain)."""
+
+    def __init__(self, bloom: BloomFilter, child: Expression):
+        super().__init__([child])
+        self.bloom = bloom
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def sql(self):
+        return (f"might_contain(bloom[{self.bloom.num_bits}b], "
+                f"{self.children[0].sql()})")
+
+    def _eval(self, ctx, xp):
+        tc = self.children[0].eval(ctx)
+        out = self.bloom.might_contain_kernel(tc, ctx, xp)
+        return TCol(out, valid_array(tc, ctx), T.BOOLEAN)
+
+    def eval_tpu(self, ctx):
+        return self._eval(ctx, jnp())
+
+    def eval_cpu(self, ctx):
+        return self._eval(ctx, np)
+
+
+from spark_rapids_tpu.plan import typechecks as TS  # noqa: E402
+from spark_rapids_tpu.plan.overrides import register_expr  # noqa: E402
+
+register_expr(BloomMightContain, TS.ALL_BASIC)

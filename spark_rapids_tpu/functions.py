@@ -517,3 +517,14 @@ def concat_ws(sep: str, *cols):
     from spark_rapids_tpu.expressions.strings import ConcatWs
     return ConcatWs(lit(sep) if not isinstance(sep, Expression) else sep,
                     *[_expr(c) for c in cols])
+
+
+def bloom_filter(df, column, num_bits: int = 1 << 20, num_hashes: int = 3):
+    """Builds a BloomFilter from a DataFrame column (join pruning)."""
+    from spark_rapids_tpu.expressions.bloom import BloomFilter
+    return BloomFilter.build(df, column, num_bits, num_hashes)
+
+
+def might_contain(bloom, e):
+    from spark_rapids_tpu.expressions.bloom import BloomMightContain
+    return BloomMightContain(bloom, _expr(e))
