@@ -436,7 +436,18 @@ def materialize(c: TCol, ctx: EvalContext, np_dtype=None) -> Any:
         return xp.zeros(shape, dtype=dt)
     if dt == np.dtype(object):
         return np.full(shape, c.data, dtype=object)
-    return xp.full(shape, c.data, dtype=dt)
+    v = c.data
+    if dt != np.dtype(object):
+        # date/timestamp literals carry python objects; kernels want the
+        # physical int representation
+        import datetime as _dt
+        if isinstance(v, _dt.datetime):
+            import calendar
+            v = int(calendar.timegm(v.utctimetuple())) * 1_000_000 \
+                + v.microsecond
+        elif isinstance(v, _dt.date):
+            v = (v - _dt.date(1970, 1, 1)).days
+    return xp.full(shape, v, dtype=dt)
 
 
 def valid_array(c: TCol, ctx: EvalContext):

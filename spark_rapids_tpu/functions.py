@@ -528,3 +528,31 @@ def bloom_filter(df, column, num_bits: int = 1 << 20, num_hashes: int = 3):
 def might_contain(bloom, e):
     from spark_rapids_tpu.expressions.bloom import BloomMightContain
     return BloomMightContain(bloom, _expr(e))
+
+
+# -- datetime function wrappers ----------------------------------------------
+
+def add_months(e, n):
+    from spark_rapids_tpu.expressions.base import lit
+    from spark_rapids_tpu.expressions.datetime_exprs import AddMonths
+    return AddMonths(_expr(e), n if isinstance(n, Expression) else lit(n))
+
+
+def months_between(end, start):
+    from spark_rapids_tpu.expressions.datetime_exprs import MonthsBetween
+    return MonthsBetween(_expr(end), _expr(start))
+
+
+def next_day(e, day_of_week: str):
+    from spark_rapids_tpu.expressions.datetime_exprs import NextDay
+    return NextDay(_expr(e), day_of_week)
+
+
+def trunc(e, fmt: str):
+    from spark_rapids_tpu.expressions.datetime_exprs import TruncDate
+    return TruncDate(_expr(e), fmt)
+
+
+def date_format(e, pattern: str):
+    from spark_rapids_tpu.expressions.datetime_exprs import DateFormat
+    return DateFormat(_expr(e), pattern)

@@ -127,7 +127,8 @@ for _cls in (S.Length, S.Upper, S.Lower, S.Concat, S.Substring, S.StartsWith,
 register_expr(S.StringSplit, TS.BASIC_WITH_ARRAYS)
 
 for _cls in (D._DateField, D._TimeField, D.DateAdd, D.DateSub, D.DateDiff,
-             D.LastDay, D.UnixTimestampFromTs):
+             D.LastDay, D.UnixTimestampFromTs, D.AddMonths,
+             D.MonthsBetween, D.NextDay, D.TruncDate, D.DateFormat):
     register_expr(_cls, TS.ALL_BASIC)
 
 register_expr(H.Murmur3Hash, TS.ALL_BASIC)

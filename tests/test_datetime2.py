@@ -1,0 +1,93 @@
+"""Volume datetime function tests (reference: date_time_test.py)."""
+
+import datetime
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.expressions.base import Alias, col, lit
+
+from tests.asserts import assert_tpu_and_cpu_are_equal_collect, cpu_session
+
+D = datetime.date
+_DATES = [D(2024, 1, 31), D(2024, 2, 29), D(2023, 12, 1), None,
+          D(1999, 6, 15), D(2024, 3, 10), D(1970, 1, 1)]
+_TS = [None if d is None else
+       datetime.datetime(d.year, d.month, d.day, 13, 7, 59,
+                         tzinfo=datetime.timezone.utc) for d in _DATES]
+
+
+def _df(s):
+    return s.create_dataframe({"d": _DATES, "ts": _TS})
+
+
+def test_add_months_clamps():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            Alias(F.add_months(col("d"), 1), "m1"),
+            Alias(F.add_months(col("d"), -13), "mneg")))
+    rows = _df(cpu_session()).select(
+        Alias(F.add_months(col("d"), 1), "m1")).collect()
+    assert rows[0]["m1"] == D(2024, 2, 29)     # Jan 31 + 1m clamps
+    assert rows[1]["m1"] == D(2024, 3, 29)
+    assert rows[3]["m1"] is None
+
+
+def test_months_between_spark_semantics():
+    rows = (cpu_session().create_dataframe(
+        {"a": [D(2024, 3, 31), D(2024, 3, 15), D(2024, 2, 29)],
+         "b": [D(2024, 1, 31), D(2024, 1, 15), D(2024, 1, 31)]})
+        .select(Alias(F.months_between(col("a"), col("b")), "mb"))
+        .collect())
+    assert rows[0]["mb"] == 2.0      # both last-of-month -> whole
+    assert rows[1]["mb"] == 2.0      # same day-of-month
+    assert rows[2]["mb"] == 1.0      # both last day (Feb 29 / Jan 31)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            Alias(F.months_between(col("d"), lit(D(2020, 5, 17))), "mb")),
+        approx_float=True)
+
+
+def test_next_day_and_trunc():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            Alias(F.next_day(col("d"), "mon"), "nm"),
+            Alias(F.trunc(col("d"), "year"), "ty"),
+            Alias(F.trunc(col("d"), "quarter"), "tq"),
+            Alias(F.trunc(col("d"), "month"), "tm"),
+            Alias(F.trunc(col("d"), "week"), "tw")))
+    rows = _df(cpu_session()).select(
+        Alias(F.next_day(col("d"), "sunday"), "ns"),
+        Alias(F.trunc(col("d"), "week"), "tw")).collect()
+    # 2024-03-10 IS a Sunday: next_day is strictly after
+    assert rows[5]["ns"] == D(2024, 3, 17)
+    assert rows[5]["tw"] == D(2024, 3, 4)      # Monday of that week
+    for i, d in enumerate(_DATES):
+        if d is None:
+            continue
+        assert rows[i]["ns"].weekday() == 6
+        assert rows[i]["ns"] > d
+
+
+def test_date_format():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            Alias(F.date_format(col("ts"), "yyyy-MM-dd HH:mm:ss"), "f"),
+            Alias(F.date_format(col("d"), "yyyy/MM/dd"), "fd"),
+            Alias(F.date_format(col("ts"), "yy.MM.dd"), "short")))
+    rows = _df(cpu_session()).select(
+        Alias(F.date_format(col("ts"), "yyyy-MM-dd HH:mm:ss"), "f"),
+        Alias(F.date_format(col("ts"), "yy.MM.dd"), "s2")).collect()
+    assert rows[0]["f"] == "2024-01-31 13:07:59"
+    assert rows[6]["f"] == "1970-01-01 13:07:59"
+    assert rows[0]["s2"] == "24.01.31"
+    assert rows[3]["f"] is None
+
+
+def test_date_format_rejects_unknown_pattern():
+    with pytest.raises(ValueError, match="pattern"):
+        F.date_format(col("ts"), "yyyy-QQ")
+    # variable-width single-letter fields are host-formatting territory
+    with pytest.raises(ValueError, match="fixed"):
+        F.date_format(col("ts"), "yy.M.d")
