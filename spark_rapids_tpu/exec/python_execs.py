@@ -1,0 +1,111 @@
+"""Pandas-exec family: vectorized python over arrow batches.
+
+Reference: execution/python/ (14 files) — GpuMapInPandasExec,
+GpuFlatMapGroupsInPandasExec, GpuArrowEvalPythonExec: the engine batches
+columnar data, hands it to python over Arrow, and reads arrow back.  Here
+the hand-off is in-process (pandas <-> arrow), host tier with honest
+tagging — the data-movement architecture (batch -> arrow -> python ->
+arrow -> batch) is the same."""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import batch_from_arrow
+from spark_rapids_tpu.plan.base import Exec, UnaryExec
+
+
+def _to_pandas(b):
+    import pyarrow as pa
+    hb = b.to_host() if hasattr(b, "bucket") else b
+    return pa.Table.from_batches([hb.to_arrow()]).to_pandas()
+
+
+def _from_pandas(pdf, schema: T.StructType):
+    import pyarrow as pa
+    arrays = {}
+    for f in schema.fields:
+        if f.name not in pdf.columns:
+            raise ValueError(f"pandas UDF result is missing column "
+                             f"{f.name!r} (declared schema: "
+                             f"{schema.simple_name})")
+        arrays[f.name] = pa.array(pdf[f.name],
+                                  type=T.to_arrow(f.data_type))
+    return batch_from_arrow(pa.table(arrays))
+
+
+class CpuMapInPandasExec(UnaryExec):
+    """df.map_in_pandas(fn, schema): fn(pandas.DataFrame) ->
+    pandas.DataFrame per batch (reference GpuMapInPandasExec)."""
+
+    def __init__(self, fn: Callable, out_schema: T.StructType, child: Exec):
+        super().__init__(child)
+        self.fn = fn
+        self._schema = out_schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def execute_partition(self, pidx):
+        for b in self.child.execute_partition(pidx):
+            pdf = self.fn(_to_pandas(b))
+            yield _from_pandas(pdf, self._schema)
+
+    def node_desc(self):
+        return f"MapInPandas[{getattr(self.fn, '__name__', 'fn')}]"
+
+
+class CpuFlatMapGroupsInPandasExec(UnaryExec):
+    """group_by(keys).apply_in_pandas(fn, schema): child is already
+    hash-partitioned by the keys; each group's rows become one pandas
+    DataFrame handed to fn (reference GpuFlatMapGroupsInPandasExec)."""
+
+    def __init__(self, key_names: Sequence[str], fn: Callable,
+                 out_schema: T.StructType, child: Exec):
+        super().__init__(child)
+        self.key_names = list(key_names)
+        self.fn = fn
+        self._schema = out_schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def execute_partition(self, pidx):
+        import pandas as pd
+        frames = [_to_pandas(b) for b in self.child.execute_partition(pidx)]
+        if not frames:
+            return
+        pdf = pd.concat(frames, ignore_index=True) if len(frames) > 1 \
+            else frames[0]
+        if not len(pdf):
+            return
+        for _key, group in pdf.groupby(self.key_names, dropna=False,
+                                       sort=True):
+            out = self.fn(group.reset_index(drop=True))
+            if out is not None and len(out):
+                yield _from_pandas(out, self._schema)
+
+    def node_desc(self):
+        return (f"FlatMapGroupsInPandas[{', '.join(self.key_names)}; "
+                f"{getattr(self.fn, '__name__', 'fn')}]")
+
+
+# host tier: registered so tagging reports the honest reason
+from spark_rapids_tpu.plan import typechecks as TS  # noqa: E402
+from spark_rapids_tpu.plan.overrides import register_exec  # noqa: E402
+
+
+def _host_only(meta):
+    meta.will_not_work("pandas execs run on the host tier "
+                       "(arrow hand-off to python)")
+
+
+register_exec(CpuMapInPandasExec, convert=lambda p, m: p,
+              sig=TS.BASIC_WITH_ARRAYS, extra_tag=_host_only,
+              desc="vectorized python over arrow batches")
+register_exec(CpuFlatMapGroupsInPandasExec, convert=lambda p, m: p,
+              sig=TS.BASIC_WITH_ARRAYS, extra_tag=_host_only,
+              desc="grouped pandas apply over arrow batches")

@@ -514,6 +514,13 @@ class DataFrame:
     def distinct(self) -> "DataFrame":
         return self.group_by(*self.columns).agg()
 
+    def map_in_pandas(self, fn, schema: T.StructType) -> "DataFrame":
+        """Vectorized python: fn(pandas.DataFrame) -> pandas.DataFrame per
+        batch (reference GpuMapInPandasExec; host tier)."""
+        from spark_rapids_tpu.exec.python_execs import CpuMapInPandasExec
+        return DataFrame(CpuMapInPandasExec(fn, schema, self._plan),
+                         self._session)
+
     def cache(self) -> "DataFrame":
         """Materializes this plan once into compressed parquet-encoded host
         batches (reference: ParquetCachedBatchSerializer); later actions
@@ -723,6 +730,27 @@ class GroupedData:
         out += [_bound_ref(i, plan.schema)
                 for i in range(nk + 1, len(plan.schema.fields))]
         return DataFrame(CpuProjectExec(out, plan), self._df._session)
+
+    def apply_in_pandas(self, fn, schema: T.StructType) -> "DataFrame":
+        """Grouped pandas apply: shuffle raw rows by the keys, then
+        fn(group_pdf) -> pdf per group (reference
+        GpuFlatMapGroupsInPandasExec)."""
+        from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
+        from spark_rapids_tpu.exec.python_execs import \
+            CpuFlatMapGroupsInPandasExec
+        from spark_rapids_tpu.plan.partitioning import HashPartitioning
+        if self._grouping_sets is not None:
+            raise ValueError("apply_in_pandas cannot follow rollup/cube")
+        child = self._df._plan
+        key_names = [getattr(k, "ref_name", None) or k.sql()
+                     for k in self._keys]
+        if child.num_partitions > 1 and self._keys:
+            child = CpuShuffleExchangeExec(
+                HashPartitioning(self._keys, child.num_partitions), child,
+                shuffle_env=self._df._session.shuffle_env)
+        return DataFrame(
+            CpuFlatMapGroupsInPandasExec(key_names, fn, schema, child),
+            self._df._session)
 
     # sugar
     def count(self) -> "DataFrame":

@@ -1,0 +1,58 @@
+"""Pandas exec tests (reference: udf_cudf_test.py / map_in_pandas cases)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import col, lit
+
+from tests.asserts import cpu_session, tpu_session
+
+_DATA = {"g": [1, 1, 2, 2, 2, 3], "v": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]}
+
+
+def test_map_in_pandas():
+    def double(pdf):
+        pdf = pdf.copy()
+        pdf["v2"] = pdf["v"] * 2
+        return pdf[["g", "v2"]]
+
+    schema = T.StructType([T.StructField("g", T.LONG),
+                           T.StructField("v2", T.DOUBLE)])
+    for s in (cpu_session(),
+              tpu_session({"spark.rapids.sql.test.enabled": "false"})):
+        df = s.create_dataframe(_DATA, num_partitions=2) \
+            .map_in_pandas(double, schema)
+        rows = sorted(df.collect(), key=lambda r: (r["g"], r["v2"]))
+        assert rows[0] == {"g": 1, "v2": 2.0}
+        assert len(rows) == 6
+    # the TPU session's plan reports the honest tier
+    assert "host tier" in df.explain()
+
+
+def test_apply_in_pandas_grouped():
+    def summarize(pdf):
+        import pandas as pd
+        return pd.DataFrame({"g": [pdf["g"].iloc[0]],
+                             "total": [pdf["v"].sum()],
+                             "n": [len(pdf)]})
+
+    schema = T.StructType([T.StructField("g", T.LONG),
+                           T.StructField("total", T.DOUBLE),
+                           T.StructField("n", T.LONG)])
+    for s in (cpu_session(),
+              tpu_session({"spark.rapids.sql.test.enabled": "false"})):
+        df = (s.create_dataframe(_DATA, num_partitions=3)
+              .group_by("g").apply_in_pandas(summarize, schema))
+        rows = sorted(df.collect(), key=lambda r: r["g"])
+        assert rows == [{"g": 1, "total": 3.0, "n": 2},
+                        {"g": 2, "total": 12.0, "n": 3},
+                        {"g": 3, "total": 6.0, "n": 1}]
+
+
+def test_map_in_pandas_schema_mismatch_clear_error():
+    schema = T.StructType([T.StructField("missing", T.LONG)])
+    s = cpu_session()
+    df = s.create_dataframe(_DATA).map_in_pandas(lambda p: p, schema)
+    with pytest.raises(ValueError, match="missing"):
+        df.collect()
