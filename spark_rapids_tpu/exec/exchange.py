@@ -296,8 +296,12 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
 # GpuOverrides.scala:4023 + GpuShuffleMeta)
 from spark_rapids_tpu.plan.overrides import register_exec  # noqa: E402
 
+from spark_rapids_tpu.plan import typechecks as _TS  # noqa: E402
+
 register_exec(CpuShuffleExchangeExec,
-              convert=lambda p, m: TpuShuffleExchangeExec(p.partitioning,
-                                                          p.children[0]),
+              convert=lambda p, m: TpuShuffleExchangeExec(
+                  p.partitioning, p.children[0],
+                  shuffle_env=p.shuffle_env),
+              sig=_TS.BASIC_WITH_ARRAYS,
               exprs_of=lambda p: list(p.partitioning.exprs),
               desc="shuffle exchange (device partition + host-staged store)")

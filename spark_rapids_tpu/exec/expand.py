@@ -185,6 +185,7 @@ register_exec(CpuExpandExec,
               desc="projection fan-out (ROLLUP/CUBE/GROUPING SETS)")
 register_exec(CpuTakeOrderedAndProjectExec,
               convert=lambda p, m: TpuTakeOrderedAndProjectExec(p),
+              sig=TS.BASIC_WITH_ARRAYS,
               exprs_of=lambda p: ([s.expr for s in p.specs]
                                   + (p.project or [])),
               desc="order-by + limit + project in one pass")

@@ -492,11 +492,13 @@ def _join_exprs(p: _JoinBase):
 
 
 def _reg(cpu_cls, tpu_cls, desc):
+    from spark_rapids_tpu.plan import typechecks as _TS
     register_exec(
         cpu_cls,
         convert=lambda p, m: tpu_cls(p.left_keys, p.right_keys, p.join_type,
                                      p.condition, p.children[0],
                                      p.children[1], p.null_safe),
+        sig=_TS.BASIC_WITH_ARRAYS,
         exprs_of=_join_exprs,
         desc=desc)
 
@@ -515,7 +517,10 @@ def _convert_shuffled(p, m):
     return out
 
 
+from spark_rapids_tpu.plan import typechecks as _TS2  # noqa: E402
+
 register_exec(CpuShuffledHashJoinExec, convert=_convert_shuffled,
+              sig=_TS2.BASIC_WITH_ARRAYS,
               exprs_of=_join_exprs,
               desc="hash join over shuffled children (size-adaptive "
                    "sub-partitioning)")
@@ -636,5 +641,6 @@ class TpuSubPartitionHashJoinExec(_SubPartitionMixin, TpuShuffledHashJoinExec):
 
 
 register_exec(CpuSubPartitionHashJoinExec, convert=_convert_shuffled,
+              sig=_TS2.BASIC_WITH_ARRAYS,
               exprs_of=_join_exprs,
               desc="explicit sub-partitioned hash join")

@@ -179,8 +179,11 @@ class TpuSortExec(UnaryExec):
 # plan-rewrite registration (reference: GpuOverrides SortExec rule :4210)
 from spark_rapids_tpu.plan.overrides import register_exec  # noqa: E402
 
+from spark_rapids_tpu.plan import typechecks as _TS  # noqa: E402
+
 register_exec(CpuSortExec,
               convert=lambda p, m: TpuSortExec(p.specs, p.children[0],
                                                p.global_sort),
+              sig=_TS.BASIC_WITH_ARRAYS,
               exprs_of=lambda p: [s.expr for s in p.specs],
               desc="device sort (fused lax.sort over sortable key words)")

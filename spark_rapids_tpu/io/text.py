@@ -283,3 +283,60 @@ def write_json(batches, path: str, schema: Optional[T.StructType] = None):
             for row in zip(*d.values()):
                 obj = {k: enc(v) for k, v in zip(names, row) if v is not None}
                 f.write(json.dumps(obj) + "\n")
+
+
+class CpuTextScanExec(MultiFileScanBase):
+    """Line-oriented text scan: each line is one row in a single ``value``
+    string column (reference: GpuHiveTableScanExec's delimited-text path /
+    Spark's text format)."""
+
+    format_name = "text"
+    file_ext = ".txt"
+
+    def __init__(self, paths: Sequence[str], reader_type: str = AUTO,
+                 batch_rows: int = 1 << 20, num_threads: int = 8, **_kw):
+        super().__init__(paths, reader_type=reader_type,
+                         batch_rows=batch_rows, num_threads=num_threads)
+
+    def infer_schema(self) -> T.StructType:
+        return T.StructType([T.StructField("value", T.STRING, False)])
+
+    def read_file(self, path: str):
+        import pyarrow as pa
+        from spark_rapids_tpu.columnar.batch import batch_from_arrow
+        with open(path, "rb") as f:
+            data = f.read()
+        lines = data.decode("utf-8", "replace").splitlines()
+        for off in range(0, max(len(lines), 1), self.batch_rows):
+            chunk = lines[off:off + self.batch_rows]
+            if not chunk and off > 0:
+                break
+            yield batch_from_arrow(
+                pa.table({"value": pa.array(chunk, type=pa.string())}))
+
+
+TpuTextScanExec, _text_convert = tpu_scan_of(CpuTextScanExec)
+register_exec(CpuTextScanExec, convert=_text_convert,
+              desc="line-oriented text scan")
+
+
+def write_text(batches, path: str, schema: Optional[T.StructType] = None):
+    """One line per row of the single string column."""
+
+    class _W:
+        def __init__(self, p):
+            self.f = open(p, "w")
+
+        def write(self, rb):
+            if rb.num_columns != 1:
+                raise ValueError("text format writes exactly one column")
+            for v in rb.column(0).to_pylist():
+                self.f.write(("" if v is None else str(v)) + "\n")
+
+        def close(self):
+            self.f.close()
+
+    from spark_rapids_tpu.io.multifile import chunked_write
+    chunked_write(batches, path, schema,
+                  open_writer=lambda p, sch: _W(p),
+                  write_batch=lambda w, rb: w.write(rb))

@@ -49,6 +49,12 @@ def _write_avro(batches, path, schema, **opts):
     write_avro(batches, path, schema, **opts)
 
 
+@_register("text")
+def _write_text(batches, path, schema, **opts):
+    from spark_rapids_tpu.io.text import write_text
+    write_text(batches, path, schema)
+
+
 @_register("orc")
 def _write_orc(batches, path, schema):
     from spark_rapids_tpu.io.orc import write_orc
@@ -56,7 +62,7 @@ def _write_orc(batches, path, schema):
 
 
 _EXT = {"parquet": ".parquet", "csv": ".csv", "json": ".json",
-        "orc": ".orc", "avro": ".avro"}
+        "orc": ".orc", "avro": ".avro", "text": ".txt"}
 
 
 class DataFrameWriter:
@@ -88,6 +94,9 @@ class DataFrameWriter:
 
     def json(self, path: str):
         self._save(path, "json")
+
+    def text(self, path: str):
+        return self._save(path, "text")
 
     def avro(self, path: str):
         return self._save(path, "avro")

@@ -102,7 +102,8 @@ def _hash_rows(cols: List[DeviceColumn], widths: List[int], inrow, jnp):
 
 
 def _col_sig(c: DeviceColumn) -> Tuple:
-    return (str(c.data.dtype), tuple(c.data.shape), c.lengths is not None)
+    return (str(c.data.dtype), tuple(c.data.shape), c.lengths is not None,
+            c.elem_valid is not None)
 
 
 @dataclasses.dataclass
@@ -360,30 +361,34 @@ def gather_join_output(probe: ColumnarBatch, build: ColumnarBatch,
             lm = jnp.take(l_map, safe_r)
             rm = jnp.take(r_map, safe_r)
             outs = []
-            for (d, v, ln) in parrs:
+            for (d, v, ln, ev) in parrs:
                 sl = jnp.clip(lm, 0, p_bucket - 1)
                 nd = jnp.take(d, sl, axis=0)
                 nv = jnp.take(v, sl, axis=0) & (lm >= 0) & live
                 nl = None if ln is None else jnp.take(ln, sl, axis=0)
-                outs.append((nd, nv, nl))
-            for (d, v, ln) in barrs:
+                ne = None if ev is None else jnp.take(ev, sl, axis=0)
+                outs.append((nd, nv, nl, ne))
+            for (d, v, ln, ev) in barrs:
                 sr = jnp.clip(rm, 0, b_bucket - 1)
                 nd = jnp.take(d, sr, axis=0)
                 nv = jnp.take(v, sr, axis=0) & (rm >= 0) & live
                 nl = None if ln is None else jnp.take(ln, sr, axis=0)
-                outs.append((nd, nv, nl))
+                ne = None if ev is None else jnp.take(ev, sr, axis=0)
+                outs.append((nd, nv, nl, ne))
             return outs
 
         fn = jax.jit(run)
         _GATHER_CACHE[key] = fn
-    parrs = [(c.data, c.validity, c.lengths) for c in probe.columns]
-    barrs = [(c.data, c.validity, c.lengths) for c in build.columns]
+    parrs = [(c.data, c.validity, c.lengths, c.elem_valid)
+             for c in probe.columns]
+    barrs = [(c.data, c.validity, c.lengths, c.elem_valid)
+             for c in build.columns]
     outs = fn(parrs, barrs, l_map, r_map, count)
     cols = []
     all_dt = [c.data_type for c in probe.columns] + \
         [c.data_type for c in build.columns]
-    for (d, v, ln), dt in zip(outs, all_dt):
-        cols.append(DeviceColumn(d, v, count, dt, ln))
+    for (d, v, ln, ev), dt in zip(outs, all_dt):
+        cols.append(DeviceColumn(d, v, count, dt, ln, ev))
     return ColumnarBatch(cols, count, names)
 
 

@@ -134,6 +134,12 @@ class TpuSession:
                 CpuOrcScanExec(list(paths), columns=columns,
                                **self._common(C.ORC_READER_TYPE)), self._s)
 
+        def text(self, *paths) -> "DataFrame":
+            from spark_rapids_tpu.io.text import CpuTextScanExec
+            return DataFrame(
+                CpuTextScanExec(list(paths),
+                                **self._common(C.READER_TYPE)), self._s)
+
         def avro(self, *paths, columns=None) -> "DataFrame":
             from spark_rapids_tpu.io.avro import CpuAvroScanExec
             return DataFrame(

@@ -238,3 +238,26 @@ def test_explode_alias_collides_with_existing_column():
     assert_tpu_and_cpu_are_equal_collect(
         lambda s: s.create_dataframe(data, schema=schema).explode("a"),
         ignore_order=True)
+
+
+def test_arrays_through_sort_join_shuffle():
+    """Array payload columns ride sort, take-ordered, shuffled joins and
+    the device exchange (element-validity threaded end to end)."""
+    data = {"k": [3, 1, 2, 1, None],
+            "a": [[1, None], [2], None, [], [5, 6, 7]]}
+    schema = T.StructType([T.StructField("k", T.LONG),
+                           T.StructField("a", T.ArrayType(T.LONG))])
+
+    def mk(s):
+        return s.create_dataframe(data, schema=schema, num_partitions=2)
+
+    assert_tpu_and_cpu_are_equal_collect(lambda s: mk(s).order_by("k"))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: mk(s).order_by("k", ascending=False).limit(3))
+    dim = {"k": [1, 2], "name": ["one", "two"]}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: mk(s).join(s.create_dataframe(dim, num_partitions=2),
+                             on="k", how="inner"),
+        ignore_order=True)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: mk(s).repartition(3, "k"), ignore_order=True)

@@ -191,3 +191,21 @@ def test_parquet_predicate_pushdown_still_works(datasets):
     expected = sum(1 for r in cpu_session().read
                    .parquet(datasets["parquet"]).collect() if r["i"] > 500)
     assert total == expected
+
+
+def test_text_format_roundtrip(tmp_path):
+    from tests.asserts import cpu_session, tpu_session
+    from spark_rapids_tpu.expressions.base import Alias, col
+    from spark_rapids_tpu import functions as F
+    s = cpu_session()
+    lines = ["alpha", "beta gamma", "", "delta"]
+    df = s.create_dataframe({"value": lines})
+    out = tmp_path / "t"
+    df.write.text(str(out))
+    back = s.read.text(str(out))
+    assert [r["value"] for r in back.collect()] == lines
+    # device path processes the value column like any string column
+    s2 = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+    rows = (s2.read.text(str(out))
+            .select(Alias(F.upper(col("value")), "u")).collect())
+    assert rows[0]["u"] == "ALPHA"
