@@ -304,4 +304,7 @@ register_exec(CpuShuffleExchangeExec,
                   shuffle_env=p.shuffle_env),
               sig=_TS.BASIC_WITH_ARRAYS,
               exprs_of=lambda p: list(p.partitioning.exprs),
+              extra_tag=lambda m: _TS.no_array_keys(
+                  list(m.plan.partitioning.exprs), m,
+                  "partitioning expression"),
               desc="shuffle exchange (device partition + host-staged store)")

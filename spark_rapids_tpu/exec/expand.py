@@ -188,4 +188,6 @@ register_exec(CpuTakeOrderedAndProjectExec,
               sig=TS.BASIC_WITH_ARRAYS,
               exprs_of=lambda p: ([s.expr for s in p.specs]
                                   + (p.project or [])),
+              extra_tag=lambda m: TS.no_array_keys(
+                  [s.expr for s in m.plan.specs], m, "sort key"),
               desc="order-by + limit + project in one pass")

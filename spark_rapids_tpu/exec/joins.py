@@ -71,6 +71,11 @@ class _JoinBase(BinaryExec):
                 raise ValueError(
                     f"join key type mismatch: {lk.data_type} vs "
                     f"{rk.data_type}; add explicit casts")
+            if lk.data_type.is_nested:
+                raise TypeError(
+                    f"equi-join key of type {lk.data_type.simple_name} "
+                    "is not supported (arrays/structs/maps join as "
+                    "payload, not keys)")
 
     @property
     def schema(self) -> T.StructType:
@@ -491,15 +496,23 @@ def _join_exprs(p: _JoinBase):
     return out
 
 
+from spark_rapids_tpu.plan import typechecks as TS  # noqa: E402
+
+
+def _tag_join_keys(m):
+    TS.no_array_keys(list(m.plan.left_keys) + list(m.plan.right_keys), m,
+                     "join key")
+
+
 def _reg(cpu_cls, tpu_cls, desc):
-    from spark_rapids_tpu.plan import typechecks as _TS
     register_exec(
         cpu_cls,
         convert=lambda p, m: tpu_cls(p.left_keys, p.right_keys, p.join_type,
                                      p.condition, p.children[0],
                                      p.children[1], p.null_safe),
-        sig=_TS.BASIC_WITH_ARRAYS,
+        sig=TS.BASIC_WITH_ARRAYS,
         exprs_of=_join_exprs,
+        extra_tag=_tag_join_keys,
         desc=desc)
 
 
@@ -517,11 +530,10 @@ def _convert_shuffled(p, m):
     return out
 
 
-from spark_rapids_tpu.plan import typechecks as _TS2  # noqa: E402
-
 register_exec(CpuShuffledHashJoinExec, convert=_convert_shuffled,
-              sig=_TS2.BASIC_WITH_ARRAYS,
+              sig=TS.BASIC_WITH_ARRAYS,
               exprs_of=_join_exprs,
+              extra_tag=_tag_join_keys,
               desc="hash join over shuffled children (size-adaptive "
                    "sub-partitioning)")
 _reg(CpuBroadcastHashJoinExec, TpuBroadcastHashJoinExec,
@@ -641,6 +653,7 @@ class TpuSubPartitionHashJoinExec(_SubPartitionMixin, TpuShuffledHashJoinExec):
 
 
 register_exec(CpuSubPartitionHashJoinExec, convert=_convert_shuffled,
-              sig=_TS2.BASIC_WITH_ARRAYS,
+              sig=TS.BASIC_WITH_ARRAYS,
               exprs_of=_join_exprs,
+              extra_tag=_tag_join_keys,
               desc="explicit sub-partitioned hash join")

@@ -186,4 +186,6 @@ register_exec(CpuSortExec,
                                                p.global_sort),
               sig=_TS.BASIC_WITH_ARRAYS,
               exprs_of=lambda p: [s.expr for s in p.specs],
+              extra_tag=lambda m: _TS.no_array_keys(
+                  [s.expr for s in m.plan.specs], m, "sort key"),
               desc="device sort (fused lax.sort over sortable key words)")

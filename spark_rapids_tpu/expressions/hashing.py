@@ -124,6 +124,30 @@ def murmur3_col(c: TCol, dtype: T.DataType, seed, ctx: EvalContext, xp):
     """Running murmur3 update for one column; returns uint32 array."""
     seed = seed.astype(_U32) if hasattr(seed, "astype") else _U32(seed)
     valid = valid_array(c, ctx)
+    if isinstance(dtype, T.ArrayType):
+        # Spark hashes arrays by folding element hashes: h = hash(e, h)
+        # per element in order (host path; device taggers keep arrays off
+        # the hash kernels)
+        if ctx.backend != "cpu":
+            raise NotImplementedError(
+                "array hashing runs on the host tier")
+        data = materialize(c, ctx, np.dtype(object))
+        out = np.broadcast_to(np.asarray(seed, dtype=_U32),
+                              (len(data),)).copy()
+        for i in range(len(data)):
+            if not valid[i] or data[i] is None:
+                continue
+            for e in data[i]:
+                etc = TCol(np.array([e] if e is not None else [None],
+                                    dtype=object)
+                           if dtype.element_type.np_dtype is None
+                           else np.array([0 if e is None else e],
+                                         dtype=dtype.element_type.np_dtype),
+                           np.array([e is not None]), dtype.element_type)
+                sub = EvalContext([], "cpu", 1)
+                out[i] = murmur3_col(etc, dtype.element_type,
+                                     _U32(int(out[i])), sub, np)[0]
+        return out
     if isinstance(dtype, (T.StringType, T.BinaryType)):
         if ctx.backend == "cpu":
             data = materialize(c, ctx, np.dtype(object))

@@ -304,15 +304,25 @@ class CpuTextScanExec(MultiFileScanBase):
     def read_file(self, path: str):
         import pyarrow as pa
         from spark_rapids_tpu.columnar.batch import batch_from_arrow
-        with open(path, "rb") as f:
-            data = f.read()
-        lines = data.decode("utf-8", "replace").splitlines()
-        for off in range(0, max(len(lines), 1), self.batch_rows):
-            chunk = lines[off:off + self.batch_rows]
-            if not chunk and off > 0:
-                break
-            yield batch_from_arrow(
-                pa.table({"value": pa.array(chunk, type=pa.string())}))
+        # stream line by line; ONLY \n / \r\n terminate rows (Spark's
+        # text format — str.splitlines would also split on \v, \f,
+        # U+2028...), and the file is never slurped whole
+        chunk = []
+        with open(path, "r", encoding="utf-8", errors="replace",
+                  newline="\n") as f:
+            for line in f:
+                if line.endswith("\n"):
+                    line = line[:-1]
+                if line.endswith("\r"):
+                    line = line[:-1]
+                chunk.append(line)
+                if len(chunk) >= self.batch_rows:
+                    yield batch_from_arrow(pa.table(
+                        {"value": pa.array(chunk, type=pa.string())}))
+                    chunk = []
+        if chunk:
+            yield batch_from_arrow(pa.table(
+                {"value": pa.array(chunk, type=pa.string())}))
 
 
 TpuTextScanExec, _text_convert = tpu_scan_of(CpuTextScanExec)

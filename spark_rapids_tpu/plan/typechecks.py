@@ -78,12 +78,26 @@ COMPARABLE = TypeSig(_INTEGRAL + _FRACTIONAL +
 ORDERABLE = COMPARABLE
 NESTED = TypeSig([T.ArrayType, T.MapType, T.StructType])
 
-#: basics + device-resident arrays (padded rectangular plane) — for
-#: layout-agnostic data-plane ops (scan/project/filter/union/limit/expand/
-#: generate); sort/join/agg keep ALL_BASIC until their kernels thread the
-#: element-validity plane
+#: basics + device-resident arrays (padded rectangular plane).  Arrays are
+#: PAYLOAD-only for sort/join/exchange: their registrations pair this sig
+#: with ``no_array_keys`` so array-typed sort keys / join keys /
+#: partitioning expressions still fall back (the key kernels are 1-D).
 BASIC_WITH_ARRAYS = TypeSig(ALL_BASIC.classes, True,
                             allow_device_arrays=True)
+
+
+def no_array_keys(exprs, meta, what: str) -> None:
+    """extra_tag helper: array-typed KEY expressions reject the device
+    path (payload arrays are fine; the key word kernels are 1-D)."""
+    for e in exprs:
+        try:
+            dt = e.data_type
+        except Exception:    # noqa: BLE001 - unresolved exprs tag elsewhere
+            continue
+        if isinstance(dt, T.ArrayType):
+            meta.will_not_work(
+                f"{what} of type {dt.simple_name} is not supported on "
+                "the device (arrays ride as payload only)")
 
 
 def check_output_types(schema: T.StructType, sig: TypeSig) -> Optional[str]:

@@ -369,9 +369,21 @@ class DataFrame:
         specs = self._sort_specs(cols, ascending)
         plan = self._plan
         if plan.num_partitions > 1:
-            plan = CpuShuffleExchangeExec(
-                RangePartitioning(specs, plan.num_partitions), plan,
-                shuffle_env=self._session.shuffle_env)
+            def _is_array(e):
+                try:
+                    return isinstance(e.data_type, T.ArrayType)
+                except Exception:    # noqa: BLE001
+                    return False
+            if any(_is_array(s.expr) for s in specs):
+                # no range-partitioner for array keys (either engine):
+                # global sort collapses to one partition instead
+                from spark_rapids_tpu.exec.basic import \
+                    CpuCoalescePartitionsExec
+                plan = CpuCoalescePartitionsExec(1, plan)
+            else:
+                plan = CpuShuffleExchangeExec(
+                    RangePartitioning(specs, plan.num_partitions), plan,
+                    shuffle_env=self._session.shuffle_env)
         return DataFrame(CpuSortExec(specs, plan, global_sort=True),
                          self._session)
 

@@ -261,3 +261,21 @@ def test_arrays_through_sort_join_shuffle():
         ignore_order=True)
     assert_tpu_and_cpu_are_equal_collect(
         lambda s: mk(s).repartition(3, "k"), ignore_order=True)
+
+
+def test_array_keys_fall_back_not_crash():
+    """Array-typed sort/join/partition KEYS must fall back to the host
+    engine, not crash the device kernels (payload arrays still ride)."""
+    data = {"a": [[2], [1], [3]], "k": [1, 2, 3]}
+    schema = T.StructType([T.StructField("a", T.ArrayType(T.LONG)),
+                           T.StructField("k", T.LONG)])
+    s = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+    df = s.create_dataframe(data, schema=schema, num_partitions=2)
+    assert df.order_by("a").count() == 3
+    assert "payload only" in df.order_by("a").explain()
+    assert df.repartition(2, "a").count() == 3
+    # equi-join keys of array type are unsupported in BOTH engines:
+    # a clean plan-time error, not a device crash
+    df2 = s.create_dataframe(data, schema=schema, num_partitions=2)
+    with pytest.raises(TypeError, match="payload"):
+        df.join(df2, on="a", how="inner")
