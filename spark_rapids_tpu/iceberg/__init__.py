@@ -1,0 +1,6 @@
+"""Iceberg table support (SURVEY.md §2.7: the reference ports Iceberg's
+parquet reader stack — 29 Java files — wired to its accelerated parquet
+scan; here the metadata/manifest layer reads through the engine's own avro
+codec and data files through the accelerated parquet scan)."""
+
+from spark_rapids_tpu.iceberg.table import IcebergTable  # noqa: F401

@@ -1,0 +1,256 @@
+"""Iceberg v1 read path (+ a writer for tests).
+
+Reference: sql-plugin/src/main/java/com/nvidia/spark/rapids/iceberg/ —
+the reference reimplements Iceberg's reader stack so data files decode on
+the accelerator.  Same shape here, sized to the protocol's core:
+
+  <table>/metadata/vN.metadata.json     table metadata + snapshot log
+  <table>/metadata/snap-*.avro          manifest LIST (one row/manifest)
+  <table>/metadata/*-m0.avro            MANIFEST (one row per data file)
+  <table>/data/*.parquet                data files
+
+Reading: latest metadata -> current snapshot -> manifest list -> manifests
+-> live data files -> the engine's multi-file parquet scan.  Deletes
+(v2 positional/equality files) are not supported and raise clearly."""
+
+from __future__ import annotations
+
+import json
+import os
+import uuid
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.io.avro import read_avro_records, write_avro_records
+
+_MANIFEST_LIST_SCHEMA = {
+    "type": "record", "name": "manifest_file", "fields": [
+        {"name": "manifest_path", "type": "string"},
+        {"name": "manifest_length", "type": "long"},
+        {"name": "added_files_count", "type": ["null", "int"]},
+        {"name": "content", "type": ["null", "int"]},
+    ]}
+
+_MANIFEST_SCHEMA = {
+    "type": "record", "name": "manifest_entry", "fields": [
+        {"name": "status", "type": "int"},   # 0 existing 1 added 2 deleted
+        {"name": "data_file", "type": {
+            "type": "record", "name": "data_file", "fields": [
+                {"name": "file_path", "type": "string"},
+                {"name": "file_format", "type": "string"},
+                {"name": "record_count", "type": "long"},
+                {"name": "file_size_in_bytes", "type": "long"},
+                {"name": "content", "type": ["null", "int"]},
+            ]}},
+    ]}
+
+_ICE_TO_TYPE = {
+    "boolean": T.BOOLEAN, "int": T.INT, "long": T.LONG, "float": T.FLOAT,
+    "double": T.DOUBLE, "string": T.STRING, "binary": T.BINARY,
+    "date": T.DATE, "timestamptz": T.TIMESTAMP, "timestamp": T.TIMESTAMP,
+}
+
+
+def _type_from_iceberg(t):
+    if isinstance(t, str):
+        if t in _ICE_TO_TYPE:
+            return _ICE_TO_TYPE[t]
+        if t.startswith("decimal("):
+            p, s = t[8:-1].split(",")
+            return T.DecimalType(int(p), int(s.strip()))
+        raise ValueError(f"unsupported iceberg type {t!r}")
+    if isinstance(t, dict) and t.get("type") == "list":
+        return T.ArrayType(_type_from_iceberg(t["element"]))
+    raise ValueError(f"unsupported iceberg type {t!r}")
+
+
+def _type_to_iceberg(dt: T.DataType) -> str:
+    if isinstance(dt, T.BooleanType):
+        return "boolean"
+    if isinstance(dt, T.IntegerType):
+        return "int"
+    if isinstance(dt, T.LongType):
+        return "long"
+    if isinstance(dt, T.FloatType):
+        return "float"
+    if isinstance(dt, T.DoubleType):
+        return "double"
+    if isinstance(dt, T.StringType):
+        return "string"
+    if isinstance(dt, T.BinaryType):
+        return "binary"
+    if isinstance(dt, T.DateType):
+        return "date"
+    if isinstance(dt, T.TimestampType):
+        return "timestamptz"
+    if isinstance(dt, T.DecimalType):
+        return f"decimal({dt.precision}, {dt.scale})"
+    raise ValueError(f"cannot map {dt.simple_name} to iceberg")
+
+
+class IcebergTable:
+    def __init__(self, session, path: str):
+        self.session = session
+        self.path = path
+        self.meta_dir = os.path.join(path, "metadata")
+
+    # -- metadata ------------------------------------------------------------
+    def _latest_metadata(self) -> dict:
+        if not os.path.isdir(self.meta_dir):
+            raise FileNotFoundError(f"no iceberg metadata in "
+                                    f"{self.meta_dir}")
+        versions = []
+        for f in os.listdir(self.meta_dir):
+            if f.endswith(".metadata.json") and f.startswith("v"):
+                versions.append((int(f[1:].split(".")[0]), f))
+        if not versions:
+            raise FileNotFoundError(f"no iceberg metadata in "
+                                    f"{self.meta_dir}")
+        _, latest = max(versions)
+        with open(os.path.join(self.meta_dir, latest)) as fh:
+            return json.load(fh)
+
+    @property
+    def schema(self) -> T.StructType:
+        md = self._latest_metadata()
+        schemas = md.get("schemas") or [md["schema"]]
+        sid = md.get("current-schema-id", 0)
+        sch = next((s for s in schemas if s.get("schema-id", 0) == sid),
+                   schemas[-1])
+        return T.StructType([
+            T.StructField(f["name"], _type_from_iceberg(f["type"]),
+                          not f.get("required", False))
+            for f in sch["fields"]])
+
+    def current_snapshot(self) -> Optional[dict]:
+        md = self._latest_metadata()
+        sid = md.get("current-snapshot-id")
+        if sid is None or sid == -1:
+            return None
+        return next(s for s in md["snapshots"] if s["snapshot-id"] == sid)
+
+    def data_files(self) -> List[dict]:
+        snap = self.current_snapshot()
+        if snap is None:
+            return []
+        mlist = snap["manifest-list"]
+        if not os.path.isabs(mlist):
+            mlist = os.path.join(self.path, mlist)
+        files: List[dict] = []
+        for m in read_avro_records(mlist):
+            mpath = m["manifest_path"]
+            if not os.path.isabs(mpath):
+                mpath = os.path.join(self.path, mpath)
+            for entry in read_avro_records(mpath):
+                if entry["status"] == 2:      # deleted
+                    continue
+                df = entry["data_file"]
+                if (df.get("content") or 0) != 0:
+                    raise NotImplementedError(
+                        "iceberg v2 delete files are not supported")
+                files.append(df)
+        return files
+
+    # -- read ----------------------------------------------------------------
+    def to_df(self):
+        files = self.data_files()
+        schema = self.schema
+        paths = []
+        for df in files:
+            p = df["file_path"]
+            if p.startswith("file:"):
+                p = p[5:]
+            if not os.path.isabs(p):
+                p = os.path.join(self.path, p)
+            paths.append(p)
+        if not paths:
+            from spark_rapids_tpu.columnar.batch import batch_from_pydict
+            return self.session.create_dataframe(
+                batch_from_pydict({f.name: [] for f in schema.fields},
+                                  schema))
+        return self.session.read.parquet(*paths)
+
+    def record_count(self) -> int:
+        """Metadata-only count (no data read) — the manifest stats path."""
+        return sum(df["record_count"] for df in self.data_files())
+
+    # -- write (test harness / CTAS) -----------------------------------------
+    @classmethod
+    def create(cls, session, path: str, df) -> "IcebergTable":
+        t = cls(session, path)
+        os.makedirs(t.meta_dir, exist_ok=True)
+        os.makedirs(os.path.join(path, "data"), exist_ok=True)
+        t._commit(df, version=1)
+        return t
+
+    def append(self, df) -> None:
+        md = self._latest_metadata()
+        versions = [int(f[1:].split(".")[0])
+                    for f in os.listdir(self.meta_dir)
+                    if f.endswith(".metadata.json")]
+        self._commit(df, version=max(versions) + 1, previous=md)
+
+    def _commit(self, df, version: int, previous: Optional[dict] = None):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        from spark_rapids_tpu.columnar.batch import (ColumnarBatch,
+                                                     concat_host_batches)
+        schema = df.schema
+        batches = []
+        for b in df._executed_plan().execute_all():
+            batches.append(b.to_host() if isinstance(b, ColumnarBatch)
+                           else b)
+        entries = []
+        if batches:
+            hb = concat_host_batches(batches) if len(batches) > 1 \
+                else batches[0]
+            name = f"data/{uuid.uuid4().hex[:12]}.parquet"
+            fpath = os.path.join(self.path, name)
+            pq.write_table(pa.Table.from_batches([hb.to_arrow()]), fpath)
+            entries.append({"status": 1, "data_file": {
+                "file_path": name, "file_format": "PARQUET",
+                "record_count": int(hb.row_count),
+                "file_size_in_bytes": os.path.getsize(fpath),
+                "content": 0}})
+        snap_id = version
+        manifest = f"metadata/{uuid.uuid4().hex[:8]}-m0.avro"
+        write_avro_records(os.path.join(self.path, manifest),
+                           _MANIFEST_SCHEMA, entries)
+        # carry forward previous manifests (append semantics)
+        manifests = [{"manifest_path": manifest,
+                      "manifest_length": os.path.getsize(
+                          os.path.join(self.path, manifest)),
+                      "added_files_count": len(entries), "content": 0}]
+        if previous is not None:
+            prev_snap = next((s for s in previous.get("snapshots", [])
+                              if s["snapshot-id"] ==
+                              previous.get("current-snapshot-id")), None)
+            if prev_snap is not None:
+                ml = prev_snap["manifest-list"]
+                if not os.path.isabs(ml):
+                    ml = os.path.join(self.path, ml)
+                manifests = read_avro_records(ml) + manifests
+        mlist = f"metadata/snap-{snap_id}.avro"
+        write_avro_records(os.path.join(self.path, mlist),
+                           _MANIFEST_LIST_SCHEMA, manifests)
+        fields = [{"id": i + 1, "name": f.name,
+                   "required": not f.nullable,
+                   "type": _type_to_iceberg(f.data_type)}
+                  for i, f in enumerate(schema.fields)]
+        snapshots = list((previous or {}).get("snapshots", []))
+        snapshots.append({"snapshot-id": snap_id,
+                          "manifest-list": mlist,
+                          "summary": {"operation": "append"}})
+        md = {"format-version": 1,
+              "table-uuid": (previous or {}).get("table-uuid",
+                                                 str(uuid.uuid4())),
+              "location": self.path,
+              "current-schema-id": 0,
+              "schemas": [{"schema-id": 0, "type": "struct",
+                           "fields": fields}],
+              "schema": {"type": "struct", "fields": fields},
+              "current-snapshot-id": snap_id,
+              "snapshots": snapshots}
+        with open(os.path.join(self.meta_dir,
+                               f"v{version}.metadata.json"), "w") as fh:
+            json.dump(md, fh)

@@ -423,3 +423,257 @@ def _encode_value(out: bytearray, v, fld: T.StructField) -> None:
         out += v
     else:
         raise ValueError(f"cannot encode {dt.simple_name}")
+
+
+# ---------------------------------------------------------------------------
+# generic (nested) decode — used by the Iceberg manifest reader; the flat
+# columnar fast path above stays for plain tabular files
+# ---------------------------------------------------------------------------
+
+class _TypeDesc:
+    __slots__ = ("kind", "fields", "items", "values", "symbols", "logical",
+                 "nullable", "null_first", "size")
+
+    def __init__(self, kind, **kw):
+        self.kind = kind
+        self.fields = kw.get("fields")      # record: [(name, desc)]
+        self.items = kw.get("items")        # array
+        self.values = kw.get("values")      # map
+        self.symbols = kw.get("symbols")    # enum
+        self.logical = kw.get("logical")
+        self.nullable = kw.get("nullable", False)
+        self.null_first = kw.get("null_first", True)
+        self.size = kw.get("size")          # fixed
+
+
+def _parse_type(t) -> _TypeDesc:
+    if isinstance(t, list):
+        branches = [b for b in t if b != "null"]
+        if len(t) == 2 and len(branches) == 1:
+            d = _parse_type(branches[0])
+            d.nullable = True
+            d.null_first = t[0] == "null"
+            return d
+        raise ValueError(f"unsupported avro union {t}")
+    if isinstance(t, dict):
+        kind = t.get("type")
+        logical = t.get("logicalType")
+        if kind == "record":
+            return _TypeDesc("record", fields=[
+                (f["name"], _parse_type(f["type"])) for f in t["fields"]])
+        if kind == "array":
+            return _TypeDesc("array", items=_parse_type(t["items"]))
+        if kind == "map":
+            return _TypeDesc("map", values=_parse_type(t["values"]))
+        if kind == "enum":
+            return _TypeDesc("enum", symbols=list(t["symbols"]))
+        if kind == "fixed":
+            return _TypeDesc("fixed", size=int(t["size"]))
+        d = _parse_type(kind)
+        d.logical = logical
+        return d
+    if t in ("null", "boolean", "int", "long", "float", "double", "bytes",
+             "string"):
+        return _TypeDesc(t)
+    raise ValueError(f"unsupported avro type {t!r}")
+
+
+def _decode_generic(mv, pos, d: _TypeDesc):
+    if d.nullable:
+        branch, pos = _read_long(mv, pos)
+        if (branch == 0) == d.null_first:
+            return None, pos
+    k = d.kind
+    if k == "record":
+        out = {}
+        for name, fd in d.fields:
+            out[name], pos = _decode_generic(mv, pos, fd)
+        return out, pos
+    if k == "array":
+        items = []
+        while True:
+            n, pos = _read_long(mv, pos)
+            if n == 0:
+                break
+            if n < 0:
+                _, pos = _read_long(mv, pos)   # block byte size
+                n = -n
+            for _ in range(n):
+                v, pos = _decode_generic(mv, pos, d.items)
+                items.append(v)
+        return items, pos
+    if k == "map":
+        out = {}
+        while True:
+            n, pos = _read_long(mv, pos)
+            if n == 0:
+                break
+            if n < 0:
+                _, pos = _read_long(mv, pos)
+                n = -n
+            for _ in range(n):
+                klen, pos = _read_long(mv, pos)
+                key = bytes(mv[pos:pos + klen]).decode()
+                pos += klen
+                out[key], pos = _decode_generic(mv, pos, d.values)
+        return out, pos
+    if k == "fixed":
+        raw = bytes(mv[pos:pos + d.size])
+        return raw, pos + d.size
+    if k == "enum":
+        i, pos = _read_long(mv, pos)
+        return d.symbols[i], pos
+    if k == "boolean":
+        return mv[pos] != 0, pos + 1
+    if k in ("int", "long"):
+        return _read_long(mv, pos)
+    if k == "float":
+        return struct.unpack_from("<f", mv, pos)[0], pos + 4
+    if k == "double":
+        return struct.unpack_from("<d", mv, pos)[0], pos + 8
+    if k in ("bytes", "string"):
+        n, pos = _read_long(mv, pos)
+        raw = bytes(mv[pos:pos + n])
+        return (raw.decode() if k == "string" else raw), pos + n
+    if k == "null":
+        return None, pos
+    raise ValueError(f"unsupported avro kind {k}")
+
+
+def read_avro_records(path: str):
+    """Reads an avro container file with an ARBITRARILY NESTED record
+    schema into python dicts (the Iceberg manifest path)."""
+    with open(path, "rb") as f:
+        if f.read(4) != _MAGIC:
+            raise ValueError("not an avro object container file")
+        data = f.read()
+    buf = memoryview(data)
+    pos = 0
+    meta = {}
+    while True:
+        n, pos = _read_long(buf, pos)
+        if n == 0:
+            break
+        for _ in range(abs(n)):
+            klen, pos = _read_long(buf, pos)
+            key = bytes(buf[pos:pos + klen]).decode()
+            pos += klen
+            vlen, pos = _read_long(buf, pos)
+            meta[key] = bytes(buf[pos:pos + vlen])
+            pos += vlen
+        if n < 0:
+            _, pos = _read_long(buf, pos)
+    sync = bytes(buf[pos:pos + 16])
+    pos += 16
+    import json as _json
+    schema = _json.loads(meta["avro.schema"].decode())
+    codec = meta.get("avro.codec", b"null").decode()
+    desc = _parse_type(schema)
+    if desc.kind != "record":
+        raise ValueError("top-level avro schema must be a record")
+    out = []
+    while pos < len(buf):
+        count, pos = _read_long(buf, pos)
+        size, pos = _read_long(buf, pos)
+        block = bytes(buf[pos:pos + size])
+        pos += size
+        if bytes(buf[pos:pos + 16]) != sync:
+            raise ValueError(f"corrupt avro block in {path}")
+        pos += 16
+        if codec == "deflate":
+            block = zlib.decompress(block, -15)
+        elif codec != "null":
+            raise ValueError(f"unsupported avro codec {codec!r}")
+        bmv = memoryview(block)
+        bpos = 0
+        for _ in range(count):
+            rec, bpos = _decode_generic(bmv, bpos, desc)
+            out.append(rec)
+    return out
+
+
+def write_avro_records(path: str, schema_json: dict, records,
+                       codec: str = "null") -> None:
+    """Writes nested python dicts as an avro container (the test/writer
+    counterpart of read_avro_records)."""
+    import json as _json
+    import secrets
+    desc = _parse_type(schema_json)
+    sync = secrets.token_bytes(16)
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        meta = {b"avro.schema": _json.dumps(schema_json).encode(),
+                b"avro.codec": codec.encode()}
+        out = bytearray()
+        _write_long(out, len(meta))
+        for k, v in meta.items():
+            _write_long(out, len(k))
+            out += k
+            _write_long(out, len(v))
+            out += v
+        _write_long(out, 0)
+        f.write(bytes(out))
+        f.write(sync)
+        body = bytearray()
+        for rec in records:
+            _encode_generic(body, rec, desc)
+        block = bytes(body)
+        if codec == "deflate":
+            comp = zlib.compressobj(6, zlib.DEFLATED, -15)
+            block = comp.compress(block) + comp.flush()
+        head = bytearray()
+        _write_long(head, len(records))
+        _write_long(head, len(block))
+        f.write(bytes(head))
+        f.write(block)
+        f.write(sync)
+
+
+def _encode_generic(out: bytearray, v, d: _TypeDesc) -> None:
+    if d.nullable:
+        if v is None:
+            _write_long(out, 0 if d.null_first else 1)
+            return
+        _write_long(out, 1 if d.null_first else 0)
+    k = d.kind
+    if k == "record":
+        for name, fd in d.fields:
+            _encode_generic(out, v.get(name), fd)
+    elif k == "array":
+        if v:
+            _write_long(out, len(v))
+            for item in v:
+                _encode_generic(out, item, d.items)
+        _write_long(out, 0)
+    elif k == "map":
+        if v:
+            _write_long(out, len(v))
+            for key, val in v.items():
+                raw = key.encode()
+                _write_long(out, len(raw))
+                out += raw
+                _encode_generic(out, val, d.values)
+        _write_long(out, 0)
+    elif k == "fixed":
+        out += v
+    elif k == "enum":
+        _write_long(out, d.symbols.index(v))
+    elif k == "boolean":
+        out.append(1 if v else 0)
+    elif k in ("int", "long"):
+        _write_long(out, int(v))
+    elif k == "float":
+        out += struct.pack("<f", float(v))
+    elif k == "double":
+        out += struct.pack("<d", float(v))
+    elif k == "string":
+        raw = v.encode()
+        _write_long(out, len(raw))
+        out += raw
+    elif k == "bytes":
+        _write_long(out, len(v))
+        out += v
+    elif k == "null":
+        pass
+    else:
+        raise ValueError(f"cannot encode avro kind {k}")
