@@ -159,6 +159,7 @@ class Cast(Expression):
                     if valid[i] else None
             return TCol(out, valid, dst)
         if isinstance(src, T.StringType):
+            import datetime as _dt
             out_nd = dst.np_dtype or np.dtype(object)
             out = np.zeros(n, dtype=out_nd)
             ok = np.zeros(n, dtype=bool)
@@ -166,9 +167,18 @@ class Cast(Expression):
                 if not valid[i] or data[i] is None:
                     continue
                 v = _cast_py_value(data[i], src, dst)
-                if v is not None:
-                    out[i] = v
-                    ok[i] = True
+                if v is None:
+                    continue
+                # parsed python dates/timestamps land in their PHYSICAL
+                # int representation (the CPU backend's convention)
+                if isinstance(v, _dt.datetime):
+                    import calendar
+                    v = int(calendar.timegm(v.utctimetuple())) \
+                        * 1_000_000 + v.microsecond
+                elif isinstance(v, _dt.date):
+                    v = (v - _dt.date(1970, 1, 1)).days
+                out[i] = v
+                ok[i] = True
             return TCol(out, ok, dst)
         raise NotImplementedError(f"host cast {src} -> {dst}")
 
@@ -234,9 +244,9 @@ def _cast_py_value(v, src: T.DataType, dst: T.DataType):
             if dst.is_floating:
                 return float(s)
             if isinstance(dst, T.DateType):
-                return datetime.date.fromisoformat(s[:10])
+                return _parse_spark_date(s)
             if isinstance(dst, T.TimestampType):
-                return datetime.datetime.fromisoformat(s)
+                return _parse_spark_timestamp(s)
             if isinstance(dst, T.DecimalType):
                 import decimal
                 return decimal.Decimal(s)
@@ -262,7 +272,41 @@ def _cast_py_value(v, src: T.DataType, dst: T.DataType):
     raise NotImplementedError(f"scalar cast {src} -> {dst}")
 
 
+def _parse_spark_date(s: str):
+    """Spark date cast accepts [y]yyyy-[m]m-[d]d (+ optional trailing
+    time/junk after the date, which Spark truncates)."""
+    import datetime
+    m = _DATE_RE.match(s)
+    if not m:
+        return None
+    return datetime.date(int(m.group(1)), int(m.group(2)),
+                         int(m.group(3)))
+
+
+def _parse_spark_timestamp(s: str):
+    """yyyy-[m]m-[d]d[ T][h]h:[m]m:[s]s[.fraction] (Spark cast subset)."""
+    import datetime
+    m = _TS_RE.match(s)
+    if not m:
+        d = _parse_spark_date(s)
+        if d is None:
+            return None
+        return datetime.datetime(d.year, d.month, d.day,
+                                 tzinfo=datetime.timezone.utc)
+    frac = (m.group(7) or "").ljust(6, "0")[:6]
+    return datetime.datetime(int(m.group(1)), int(m.group(2)),
+                             int(m.group(3)), int(m.group(4)),
+                             int(m.group(5)), int(m.group(6)),
+                             int(frac or 0),
+                             tzinfo=datetime.timezone.utc)
+
+
 import re  # noqa: E402
+
+_DATE_RE = re.compile(r"^(\d{4})-(\d{1,2})-(\d{1,2})(?:[ T].*)?$")
+_TS_RE = re.compile(
+    r"^(\d{4})-(\d{1,2})-(\d{1,2})[ T](\d{1,2}):(\d{1,2}):(\d{1,2})"
+    r"(?:\.(\d{1,6}))?\s*(?:Z|UTC)?$")
 
 _INT_RE = re.compile(r"^[+-]?\d+$")
 

@@ -402,3 +402,31 @@ class TestHashing:
     def test_xxhash64(self):
         diff_check(H.XxHash64(col("i")))
         diff_check(H.XxHash64(col("l"), col("d")))
+
+
+def test_cast_string_to_date_timestamp():
+    """Spark cast subset: [y]yyy-[m]m-[d]d (+time), unpadded accepted,
+    junk -> NULL."""
+    import datetime
+    from spark_rapids_tpu.expressions.cast import Cast
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.expressions.base import Alias, col
+    from tests.asserts import cpu_session, tpu_session
+    data = {"s": ["2024-07-30", "2024-2-3", "2024-07-30 12:34:56",
+                  "1999-12-31T23:59:59.25", "junk", None]}
+
+    def q(s):
+        return s.create_dataframe(data).select(
+            Alias(Cast(col("s"), T.DATE), "d"),
+            Alias(Cast(col("s"), T.TIMESTAMP), "t"))
+    rows = q(cpu_session()).collect()
+    assert rows[0]["d"] == datetime.date(2024, 7, 30)
+    assert rows[1]["d"] == datetime.date(2024, 2, 3)
+    assert rows[2]["d"] == datetime.date(2024, 7, 30)   # time truncated
+    assert rows[2]["t"].hour == 12 and rows[2]["t"].second == 56
+    assert rows[3]["t"].microsecond == 250000
+    assert rows[4]["d"] is None and rows[4]["t"] is None
+    assert rows[5]["d"] is None
+    # the TPU session falls back for these casts but must agree
+    s2 = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+    assert q(s2).collect() == rows
