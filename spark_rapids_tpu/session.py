@@ -20,8 +20,8 @@ from spark_rapids_tpu.columnar.batch import (HostColumnarBatch,
                                              batch_from_arrow,
                                              batch_from_pydict)
 from spark_rapids_tpu.expressions.base import (Alias, AttributeReference,
-                                               Expression, bind_references,
-                                               col, lit)
+                                               Expression, Literal,
+                                               bind_references, col, lit)
 from spark_rapids_tpu.plan.base import Exec
 from spark_rapids_tpu.plan.overrides import TpuOverrides
 
@@ -619,6 +619,7 @@ class GroupedData:
         self._keys = keys
         self._grouping_sets = grouping_sets  # list of tuples of key indices
         self._key_names = key_names
+        self._pivot = None
 
     def _expand_for_grouping_sets(self):
         """Lowers ROLLUP/CUBE/GROUPING SETS to Expand + regular group-by
@@ -675,7 +676,7 @@ class GroupedData:
         from spark_rapids_tpu.plan.partitioning import (HashPartitioning,
                                                         SinglePartitioning)
         schema = self._df.schema
-        aggs = []
+        raw = []
         for e in agg_exprs:
             name = None
             if isinstance(e, Alias):
@@ -684,7 +685,27 @@ class GroupedData:
                 raise TypeError(f"not an aggregate expression: {e}")
             e = bind_references(e, schema)
             DataFrame._no_windows(e, "aggregations")
-            aggs.append(AggregateExpression(e, name or e.sql()))
+            raw.append((e, name))
+        if self._pivot is not None:
+            # pivot lowering: one conditional aggregate per (value, agg) —
+            # agg inputs null out where the pivot column != value
+            from spark_rapids_tpu.expressions.conditional import If
+            from spark_rapids_tpu.expressions.predicates import EqualTo
+            pc, values = self._pivot
+            pivoted = []
+            for v in values:
+                cond = EqualTo(pc, lit(v))
+                for e, name in raw:
+                    import copy
+                    pe = copy.copy(e)
+                    pe.children = [
+                        If(cond, c, Literal(None, c.data_type))
+                        for c in e.children]
+                    label = f"{v}" if len(raw) == 1 else                         f"{v}_{name or e.sql()}"
+                    pivoted.append((pe, label))
+            raw = pivoted
+        aggs = [AggregateExpression(e, name or e.sql())
+                for e, name in raw]
         child = self._df._plan
         if self._grouping_sets is not None:
             return self._agg_grouping_sets(aggs)
@@ -748,6 +769,19 @@ class GroupedData:
         out += [_bound_ref(i, plan.schema)
                 for i in range(nk + 1, len(plan.schema.fields))]
         return DataFrame(CpuProjectExec(out, plan), self._df._session)
+
+    def pivot(self, pivot_col, values) -> "GroupedData":
+        """df.group_by(k).pivot(c, [v1, v2]).agg(sum(x)): each pivot value
+        becomes a column via conditional aggregation (Spark's pivot
+        lowering: agg(expr WHERE c == v) per value)."""
+        if self._grouping_sets is not None:
+            raise ValueError("pivot cannot follow rollup/cube")
+        pc = bind_references(_to_expr(pivot_col), self._df.schema)
+        out = GroupedData(self._df, self._keys)
+        out._pivot = (pc, list(values))
+        return out
+
+    _pivot = None
 
     def apply_in_pandas(self, fn, schema: T.StructType) -> "DataFrame":
         """Grouped pandas apply: shuffle raw rows by the keys, then

@@ -163,3 +163,31 @@ def test_min_max_string_falls_back():
         return s.create_dataframe(tbl).group_by("k").agg(
             F.min("s").alias("mn"))
     assert_tpu_fallback_collect(f, "CpuHashAggregateExec")
+
+
+def test_pivot_conditional_aggregation():
+    """group_by(k).pivot(c, values).agg(...) — one column per pivot value
+    (Spark's conditional-aggregate lowering)."""
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.expressions.base import Alias, col
+    from tests.asserts import (assert_tpu_and_cpu_are_equal_collect,
+                               cpu_session)
+    data = {"g": [1, 1, 1, 2, 2], "c": ["a", "b", "a", "a", "c"],
+            "v": [1.0, 2.0, 3.0, 4.0, 5.0]}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(data, num_partitions=2)
+        .group_by("g").pivot("c", ["a", "b", "c"])
+        .agg(Alias(F.sum(col("v")), "sv")),
+        ignore_order=True, approx_float=True)
+    rows = sorted((cpu_session().create_dataframe(data)
+                   .group_by("g").pivot("c", ["a", "b"])
+                   .agg(Alias(F.sum(col("v")), "sv")).collect()),
+                  key=lambda r: r["g"])
+    assert rows[0] == {"g": 1, "a": 4.0, "b": 2.0}
+    assert rows[1] == {"g": 2, "a": 4.0, "b": None}
+    # multiple aggs get value_name columns
+    multi = (cpu_session().create_dataframe(data)
+             .group_by("g").pivot("c", ["a"])
+             .agg(Alias(F.sum(col("v")), "s"),
+                  Alias(F.count(col("v")), "n")).collect())
+    assert set(multi[0].keys()) == {"g", "a_s", "a_n"}
