@@ -273,6 +273,39 @@ class DataFrame:
         plan, bound = self._plan_windows(bound)
         return DataFrame(CpuProjectExec(bound, plan), self._session)
 
+    def drop(self, *cols) -> "DataFrame":
+        names = {str(c) for c in cols}
+        keep = [col(f.name) for f in self.schema.fields
+                if f.name not in names]
+        if len(keep) == len(self.schema.fields):
+            return self
+        return self.select(*keep)
+
+    def with_column_renamed(self, old: str, new: str) -> "DataFrame":
+        if old not in self.columns:
+            return self
+        return self.select(*[
+            Alias(col(f.name), new if f.name == old else f.name)
+            for f in self.schema.fields])
+
+    @property
+    def na(self) -> "DataFrameNaFunctions":
+        return DataFrameNaFunctions(self)
+
+    def intersect(self, other: "DataFrame") -> "DataFrame":
+        """Distinct rows present in both (Spark INTERSECT)."""
+        on = list(self.columns)
+        return self.distinct().join(other.distinct(), on=on,
+                                    how="left_semi", null_safe=True)
+
+    def except_all_distinct(self, other: "DataFrame") -> "DataFrame":
+        """Distinct rows of self absent from other (Spark EXCEPT)."""
+        on = list(self.columns)
+        return self.distinct().join(other, on=on, how="left_anti",
+                                    null_safe=True)
+
+    exceptAll = except_all_distinct
+
     def limit(self, n: int) -> "DataFrame":
         from spark_rapids_tpu.exec.basic import (CpuGlobalLimitExec,
                                                  CpuLimitExec)
@@ -606,6 +639,38 @@ class DataFrame:
 
     def __repr__(self):
         return f"DataFrame[{self.schema.simple_name}]"
+
+
+class DataFrameNaFunctions:
+    """df.na.fill / df.na.drop (Spark DataFrameNaFunctions)."""
+
+    def __init__(self, df: DataFrame):
+        self._df = df
+
+    def fill(self, value, subset=None) -> DataFrame:
+        from spark_rapids_tpu.expressions.conditional import Coalesce
+        names = set(subset) if subset is not None else None
+        proj = []
+        for f in self._df.schema.fields:
+            use = names is None or f.name in names
+            compatible = (f.data_type.is_numeric and
+                          isinstance(value, (int, float))) or                 (isinstance(f.data_type, T.StringType) and
+                 isinstance(value, str)) or                 (isinstance(f.data_type, T.BooleanType) and
+                 isinstance(value, bool))
+            if use and compatible:
+                proj.append(Alias(Coalesce(col(f.name),
+                                           lit(value, f.data_type)),
+                                  f.name))
+            else:
+                proj.append(col(f.name))
+        return self._df.select(*proj)
+
+    def drop(self, how: str = "any", subset=None) -> DataFrame:
+        from spark_rapids_tpu.expressions.conditional import AtLeastNNonNulls
+        names = list(subset) if subset is not None else self._df.columns
+        need = len(names) if how == "any" else 1
+        return self._df.filter(
+            AtLeastNNonNulls(need, *[col(n) for n in names]))
 
 
 class GroupedData:

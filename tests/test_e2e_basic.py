@@ -158,3 +158,42 @@ def test_explain_does_not_raise_on_fallback():
     df = s.create_dataframe({"a": list(range(10))}).sample(0.5, seed=1)
     text = df.explain()
     assert "Placement" in text
+
+
+def test_drop_rename_na_setops():
+    from spark_rapids_tpu.expressions.base import col, lit
+    from tests.asserts import cpu_session
+    s = cpu_session()
+    df = s.create_dataframe({"a": [1, None, 3], "b": [1.0, 2.0, None],
+                             "c": ["x", None, "z"]})
+    assert df.drop("b").columns == ["a", "c"]
+    assert df.with_column_renamed("a", "id").columns == ["id", "b", "c"]
+    filled = df.na.fill(0).collect()
+    assert filled[1]["a"] == 0 and filled[2]["b"] == 0.0
+    assert filled[1]["c"] is None                  # type-incompatible kept
+    sfill = df.na.fill("?", subset=["c"]).collect()
+    assert sfill[1]["c"] == "?"
+    assert df.na.drop().count() == 1               # only row 0 complete
+    assert df.na.drop(how="all").count() == 3
+    assert df.na.drop(subset=["a"]).count() == 2
+    x = s.create_dataframe({"k": [1, 2, 2, 3]})
+    y = s.create_dataframe({"k": [2, 3, 4]})
+    assert sorted(r["k"] for r in x.intersect(y).collect()) == [2, 3]
+    assert sorted(r["k"] for r in x.except_all_distinct(y).collect()) == [1]
+
+
+def test_na_and_setops_differential():
+    from spark_rapids_tpu.expressions.base import col
+    data = {"a": [1, None, 3, None], "b": [1.0, 2.0, None, None]}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(data, num_partitions=2).na.fill(-1),
+        ignore_order=True)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(data, num_partitions=2).na.drop(),
+        ignore_order=True)
+    x = {"k": [1, 2, 2, 3, None]}
+    y = {"k": [2, 3, 4]}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(x, num_partitions=2)
+        .intersect(s.create_dataframe(y, num_partitions=2)),
+        ignore_order=True)
