@@ -293,18 +293,24 @@ class DataFrame:
         return DataFrameNaFunctions(self)
 
     def intersect(self, other: "DataFrame") -> "DataFrame":
-        """Distinct rows present in both (Spark INTERSECT)."""
+        """Distinct rows present in both (Spark INTERSECT).  NOTE: columns
+        match BY NAME here (engine restriction), not positionally as in
+        Spark SQL set operations.  The right side needs no distinct: a
+        left-semi join ignores duplicate matches."""
         on = list(self.columns)
-        return self.distinct().join(other.distinct(), on=on,
+        return self.distinct().join(other, on=on,
                                     how="left_semi", null_safe=True)
 
-    def except_all_distinct(self, other: "DataFrame") -> "DataFrame":
-        """Distinct rows of self absent from other (Spark EXCEPT)."""
+    def except_distinct(self, other: "DataFrame") -> "DataFrame":
+        """Distinct rows of self absent from other (Spark EXCEPT
+        [DISTINCT]; there is intentionally no exceptAll alias — multiset
+        semantics are not implemented).  Columns match BY NAME."""
         on = list(self.columns)
         return self.distinct().join(other, on=on, how="left_anti",
                                     null_safe=True)
 
-    exceptAll = except_all_distinct
+    # back-compat for the earlier name
+    except_all_distinct = except_distinct
 
     def limit(self, n: int) -> "DataFrame":
         from spark_rapids_tpu.exec.basic import (CpuGlobalLimitExec,
@@ -653,10 +659,16 @@ class DataFrameNaFunctions:
         proj = []
         for f in self._df.schema.fields:
             use = names is None or f.name in names
-            compatible = (f.data_type.is_numeric and
-                          isinstance(value, (int, float))) or                 (isinstance(f.data_type, T.StringType) and
-                 isinstance(value, str)) or                 (isinstance(f.data_type, T.BooleanType) and
-                 isinstance(value, bool))
+            # bool is an int subclass: check it FIRST so fill(True) only
+            # touches boolean columns (Spark semantics)
+            if isinstance(value, bool):
+                compatible = isinstance(f.data_type, T.BooleanType)
+            elif isinstance(value, (int, float)):
+                compatible = f.data_type.is_numeric
+            elif isinstance(value, str):
+                compatible = isinstance(f.data_type, T.StringType)
+            else:
+                compatible = False
             if use and compatible:
                 proj.append(Alias(Coalesce(col(f.name),
                                            lit(value, f.data_type)),
@@ -667,6 +679,8 @@ class DataFrameNaFunctions:
 
     def drop(self, how: str = "any", subset=None) -> DataFrame:
         from spark_rapids_tpu.expressions.conditional import AtLeastNNonNulls
+        if how not in ("any", "all"):
+            raise ValueError(f"how must be 'any' or 'all', got {how!r}")
         names = list(subset) if subset is not None else self._df.columns
         need = len(names) if how == "any" else 1
         return self._df.filter(

@@ -197,3 +197,15 @@ def test_na_and_setops_differential():
         lambda s: s.create_dataframe(x, num_partitions=2)
         .intersect(s.create_dataframe(y, num_partitions=2)),
         ignore_order=True)
+
+
+def test_na_fill_bool_and_drop_validation():
+    from tests.asserts import cpu_session
+    import pytest as _pytest
+    s = cpu_session()
+    df = s.create_dataframe({"a": [1, None], "f": [True, None]})
+    filled = df.na.fill(True).collect()
+    assert filled[1]["a"] is None          # bool fill skips numeric cols
+    assert filled[1]["f"] is True
+    with _pytest.raises(ValueError, match="any"):
+        df.na.drop(how="anyy")
