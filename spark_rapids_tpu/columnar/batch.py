@@ -79,8 +79,8 @@ class ColumnarBatch:
         return int(self.nbytes() * (self.row_count / max(self.bucket, 1)))
 
     def to_host(self) -> "HostColumnarBatch":
-        return HostColumnarBatch([c.to_host() for c in self.columns],
-                                 self.row_count, self.names)
+        from spark_rapids_tpu.columnar.transfer import download_host_batch
+        return download_host_batch(self)
 
     def select(self, indices: Sequence[int]) -> "ColumnarBatch":
         names = None if self.names is None else [self.names[i] for i in indices]
@@ -118,9 +118,8 @@ class HostColumnarBatch:
                              for n, c in zip(names, self.columns)])
 
     def to_device(self, bucket: Optional[int] = None) -> ColumnarBatch:
-        b = bucket or bucket_rows(self.row_count)
-        return ColumnarBatch([DeviceColumn.from_host(c, b) for c in self.columns],
-                             self.row_count, self.names)
+        from spark_rapids_tpu.columnar.transfer import upload_host_batch
+        return upload_host_batch(self, bucket)
 
     def to_arrow(self):
         import pyarrow as pa

@@ -585,40 +585,15 @@ class DeviceColumn:
         return int(n)
 
     def to_host(self) -> HostColumn:
-        import pyarrow as pa
         n = self.row_count
-        valid = np.asarray(self.validity)[:n]
-        dt = self.data_type
-        if isinstance(dt, T.NullType):
-            return HostColumn(pa.nulls(n), dt)
-        if isinstance(dt, T.ArrayType):
-            vals = np.asarray(self.data)[:n]
-            lens = np.asarray(self.lengths)[:n]
-            ev = np.asarray(self.elem_valid)[:n]
-            return HostColumn(
-                _list_from_rectangular(vals, lens, ev, valid, dt), dt)
-        if self.is_string:
-            chars = np.asarray(self.data)[:n]
-            lens = np.asarray(self.lengths)[:n]
-            binary = _binary_from_rectangular(chars, lens, valid)
-            if isinstance(dt, T.StringType):
-                try:
-                    return HostColumn(binary.cast(pa.string()), dt)
-                except pa.ArrowInvalid:
-                    # kernel produced non-UTF8 bytes; decode with replacement
-                    py = [None if v is None else v.decode("utf-8", "replace")
-                          for v in binary.to_pylist()]
-                    return HostColumn(pa.array(py, type=pa.string()), dt)
-            return HostColumn(binary, dt)
-        raw = np.asarray(self.data)[:n]
-        if isinstance(dt, T.DecimalType):
-            if dt.is_decimal128:
-                hi, lo = raw[:, 0], raw[:, 1]
-            else:
-                lo = raw.astype(np.int64)
-                hi = np.where(lo < 0, np.int64(-1), np.int64(0))
-            return HostColumn(_decimal128_from_limbs(hi, lo, valid, dt), dt)
-        return HostColumn.from_numpy(raw, valid, dt)
+        return assemble_host_column(
+            self.data_type, n,
+            None if isinstance(self.data_type, T.NullType)
+            else np.asarray(self.data)[:n],
+            np.asarray(self.validity)[:n],
+            None if self.lengths is None else np.asarray(self.lengths)[:n],
+            None if self.elem_valid is None
+            else np.asarray(self.elem_valid)[:n])
 
     def with_row_count(self, n: int) -> "DeviceColumn":
         return DeviceColumn(self.data, self.validity, n, self.data_type,
@@ -627,3 +602,34 @@ class DeviceColumn:
     def __repr__(self):
         return (f"DeviceColumn({self.data_type}, rows={self.row_count}, "
                 f"bucket={self.bucket})")
+
+
+def assemble_host_column(dt: T.DataType, n: int, raw, valid,
+                         lens=None, ev=None) -> HostColumn:
+    """Rebuilds a HostColumn from already-fetched numpy planes (shared by
+    DeviceColumn.to_host and the packed batch download in transfer.py)."""
+    import pyarrow as pa
+    if isinstance(dt, T.NullType):
+        return HostColumn(pa.nulls(n), dt)
+    if isinstance(dt, T.ArrayType):
+        return HostColumn(_list_from_rectangular(raw, lens, ev, valid, dt),
+                          dt)
+    if isinstance(dt, (T.StringType, T.BinaryType)):
+        binary = _binary_from_rectangular(raw, lens, valid)
+        if isinstance(dt, T.StringType):
+            try:
+                return HostColumn(binary.cast(pa.string()), dt)
+            except pa.ArrowInvalid:
+                # kernel produced non-UTF8 bytes; decode with replacement
+                py = [None if v is None else v.decode("utf-8", "replace")
+                      for v in binary.to_pylist()]
+                return HostColumn(pa.array(py, type=pa.string()), dt)
+        return HostColumn(binary, dt)
+    if isinstance(dt, T.DecimalType):
+        if dt.is_decimal128:
+            hi, lo = raw[:, 0], raw[:, 1]
+        else:
+            lo = raw.astype(np.int64)
+            hi = np.where(lo < 0, np.int64(-1), np.int64(0))
+        return HostColumn(_decimal128_from_limbs(hi, lo, valid, dt), dt)
+    return HostColumn.from_numpy(raw, valid, dt)

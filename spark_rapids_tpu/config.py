@@ -400,6 +400,13 @@ CBO_ENABLED = conf_bool(
     "Enable the transition cost-based optimizer (reference CostBasedOptimizer.scala).",
     False)
 
+COLUMN_PRUNING_ENABLED = conf_bool(
+    "spark.rapids.sql.columnPruning.enabled",
+    "Prune unused columns at scans before plan rewrite (Spark performs this "
+    "in its logical optimizer; this engine plans physical trees directly). "
+    "On TPU every pruned column is a host->device transfer avoided.",
+    True)
+
 
 class TpuConf:
     """Immutable snapshot of config values (reference: ``new RapidsConf(conf)``

@@ -33,28 +33,74 @@ def _project_schema(exprs: Sequence[Expression]) -> T.StructType:
 # ---------------------------------------------------------------------------
 
 class CpuInMemoryScanExec(LeafExec):
-    """Scan over in-memory arrow batches, pre-split into partitions."""
+    """Scan over in-memory arrow batches, pre-split into partitions.
+
+    Carries a device-column cache shared by every plan derived from the same
+    source DataFrame: the first device action uploads each referenced column
+    once, later actions (and later queries over the same DataFrame) reuse the
+    device-resident columns — the TPU analog of a device-cached table
+    (reference: shuffle/cache keep batches device-resident in
+    RapidsBufferCatalog; here the scan itself is the resident tier).
+    """
 
     def __init__(self, partitions: List[List[HostColumnarBatch]],
-                 schema: T.StructType):
+                 schema: T.StructType, col_indices=None, dev_cache=None):
         super().__init__()
         self.partitions = partitions
         self._schema = schema
+        #: column subset (pruning); None = all
+        self.col_indices = col_indices
+        #: (pidx, batch_idx, col_ordinal_in_full_schema) -> DeviceColumn;
+        #: shared across shallow copies / pruned clones of this scan
+        self._dev_cache = {} if dev_cache is None else dev_cache
 
     @property
     def schema(self):
-        return self._schema
+        if self.col_indices is None:
+            return self._schema
+        return T.StructType([self._schema.fields[i]
+                             for i in self.col_indices])
 
     @property
     def num_partitions(self):
         return max(1, len(self.partitions))
 
+    def with_pruned_columns(self, indices):
+        base = self.col_indices or list(range(len(self._schema.fields)))
+        if not indices and base:
+            # a batch with zero columns loses its row count in arrow form;
+            # keep the narrowest column so row semantics survive
+            def width(i):
+                dt = self.schema.fields[i].data_type
+                npdt = getattr(dt, "np_dtype", None)
+                if dt.is_nested or npdt is None:  # strings/nested: wide
+                    return 64
+                return npdt.itemsize
+            indices = [min(range(len(base)), key=width)]
+        return CpuInMemoryScanExec(self.partitions, self._schema,
+                                   [base[i] for i in indices],
+                                   self._dev_cache)
+
+    def _host_batches(self, pidx):
+        if pidx >= len(self.partitions):
+            return
+        for hb in self.partitions[pidx]:
+            if self.col_indices is None:
+                yield hb
+            else:
+                yield HostColumnarBatch(
+                    [hb.columns[i] for i in self.col_indices],
+                    hb.row_count,
+                    None if hb.names is None else
+                    [hb.names[i] for i in self.col_indices])
+
     def execute_partition(self, pidx):
-        if pidx < len(self.partitions):
-            yield from self.partitions[pidx]
+        yield from self._host_batches(pidx)
 
     def node_desc(self):
-        return f"InMemoryScan[{self.num_partitions}p]"
+        cols = "" if self.col_indices is None else \
+            f", cols={list(self.col_indices)}"
+        return f"InMemoryScan[{self.num_partitions}p{cols}]"
 
 
 def upload_batches(batches):
@@ -72,14 +118,45 @@ class TpuInMemoryScanExec(CpuInMemoryScanExec):
     is_device = True
 
     def __init__(self, cpu: CpuInMemoryScanExec):
-        super().__init__(cpu.partitions, cpu.schema)
+        super().__init__(cpu.partitions, cpu._schema, cpu.col_indices,
+                         cpu._dev_cache)
 
     def execute_partition(self, pidx):
-        if pidx < len(self.partitions):
-            yield from upload_batches(self.partitions[pidx])
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        from spark_rapids_tpu.memory.device_manager import get_runtime
+        if pidx >= len(self.partitions):
+            return
+        rt = get_runtime()
+        indices = self.col_indices or \
+            list(range(len(self._schema.fields)))
+        for bi, hb in enumerate(self.partitions[pidx]):
+            if rt is not None:
+                rt.semaphore.acquire_if_necessary()
+            def alive(i):
+                dc = self._dev_cache.get((pidx, bi, i))
+                if dc is None:
+                    return False
+                deleted = getattr(dc.data, "is_deleted", None)
+                return not (deleted and deleted())
+
+            missing = [i for i in indices if not alive(i)]
+            if missing:
+                sub = HostColumnarBatch(
+                    [hb.columns[i] for i in missing], hb.row_count,
+                    [str(i) for i in missing])
+                dev = sub.to_device()
+                for i, dc in zip(missing, dev.columns):
+                    self._dev_cache[(pidx, bi, i)] = dc
+            names = None if hb.names is None else \
+                [hb.names[i] for i in indices]
+            yield ColumnarBatch(
+                [self._dev_cache[(pidx, bi, i)] for i in indices],
+                hb.row_count, names)
 
     def node_desc(self):
-        return f"TpuInMemoryScan[{self.num_partitions}p]"
+        cols = "" if self.col_indices is None else \
+            f", cols={list(self.col_indices)}"
+        return f"TpuInMemoryScan[{self.num_partitions}p{cols}]"
 
 
 # ---------------------------------------------------------------------------

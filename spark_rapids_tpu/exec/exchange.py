@@ -226,11 +226,75 @@ class CpuShuffleExchangeExec(UnaryExec):
 
 
 class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
-    """Device shuffle write: pid eval + stable sort-by-pid + one host copy +
-    arrow slicing; payloads stored spillable (ShuffleBufferCatalog analog).
+    """Device shuffle.
+
+    DEFAULT mode within one process keeps the store DEVICE-RESIDENT: map
+    output batches never leave HBM (reference: the UCX caching writer keeps
+    shuffle output on device in ShuffleBufferCatalog,
+    RapidsShuffleInternalManagerBase.scala:1034).  Each map batch is first
+    shrunk to its live row bucket (one sync at this materialization
+    boundary), then each reduce partition is produced by a mask+compact
+    kernel whose output count stays deferred.  The store is NOT yet
+    catalog-spillable — an oversized shuffle should use MULTITHREADED mode
+    (host-staged, spill-file backed) via spark.rapids.shuffle.mode.
+
+    MULTITHREADED/CACHED modes keep the host-staged path from the base
+    class (process-boundary semantics, spillable storage).
     """
 
     is_device = True
+
+    def _materialize(self):
+        if self._store is not None:
+            return
+        from spark_rapids_tpu.shuffle.env import get_shuffle_env
+        env = self.shuffle_env or get_shuffle_env()
+        mode = env.mode if env is not None else "DEFAULT"
+        part = self.partitioning
+        if mode != "DEFAULT":
+            super()._materialize()
+            return
+        if isinstance(part, RangePartitioning) and part.bounds is None:
+            self._compute_bounds()
+        n = part.num_partitions
+        from spark_rapids_tpu.plan.partitioning import SinglePartitioning
+        store: List[List] = [[] for _ in range(n)]
+        if isinstance(part, SinglePartitioning) or n == 1:
+            for mp in range(self.child.num_partitions):
+                store[0].extend(self.child.execute_partition(mp))
+            self._store = store
+            return
+        from spark_rapids_tpu.ops.batch_ops import (compact_batch,
+                                                    shrink_batch)
+        from spark_rapids_tpu.columnar.column import _jnp, rc_traceable
+        jnp = _jnp()
+        for mp in range(self.child.num_partitions):
+            p_eff = part
+            if isinstance(part, RoundRobinPartitioning):
+                p_eff = RoundRobinPartitioning(n, start=mp)
+            for b in self.child.execute_partition(mp):
+                # cap the n-fold storage cost: drop padding before the
+                # per-partition compacts
+                b = shrink_batch(b)
+                pids = p_eff.partition_ids_tpu(b)
+                rowpos = jnp.arange(b.bucket)
+                inrow = rowpos < rc_traceable(b.row_count)
+                for p in range(n):
+                    store[p].append(compact_batch(b, (pids == p) & inrow))
+        self._store = store
+
+    def execute_partition(self, pidx):
+        self._materialize()
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch as _CB
+        from spark_rapids_tpu.exec.basic import upload_batches
+        host_pending = []
+        for b in self._store[pidx]:
+            if isinstance(b, _CB):
+                yield b
+            else:
+                host_pending.append(b)
+        if host_pending:
+            yield from upload_batches(host_pending)
 
     def _map_pairs(self, mp: int, n: int):
         """Device shuffle write: pid eval + stable sort-by-pid on device,
@@ -282,11 +346,6 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
                     .take(pa.array(idx))
                 samples.append(batch_from_arrow(tab))
         part.bounds = _sample_bounds(part, samples, None)
-
-    def execute_partition(self, pidx):
-        from spark_rapids_tpu.exec.basic import upload_batches
-        self._materialize()
-        yield from upload_batches(self._store[pidx])
 
     def node_desc(self):
         return f"TpuExchange[{self.partitioning.desc()}]"

@@ -122,6 +122,168 @@ def _lengths_reduce(kind, col, valid, seg, inrow, bucket, jnp):
     return data, v, lens
 
 
+_GLOBAL_OUT_BUCKET = 8
+
+
+def _global_reduce(kind: str, x, valid, inrow, jnp, count_valid_only=True):
+    """Whole-array reduction -> (scalar, scalar_valid).  The global-agg
+    analog of _segment_reduce: plain jnp reductions instead of segment ops
+    (segment_* with num_segments=bucket costs ~80ms/call on v5e; jnp.sum
+    costs ~1ms)."""
+    present = valid & inrow
+    any_valid = jnp.any(present)
+    if kind == "count":
+        src = present if count_valid_only else inrow
+        return jnp.sum(src.astype(np.int64)), jnp.asarray(True)
+    if kind == "sum":
+        return jnp.sum(jnp.where(present, x, jnp.zeros_like(x))), any_valid
+    if kind in ("min", "max"):
+        if jnp.issubdtype(x.dtype, jnp.inexact):
+            ident = jnp.asarray(np.inf if kind == "min" else -np.inf, x.dtype)
+        else:
+            info = jnp.iinfo(x.dtype)
+            ident = jnp.asarray(info.max if kind == "min" else info.min,
+                                x.dtype)
+        z = jnp.where(present, x, ident)
+        return (jnp.min(z) if kind == "min" else jnp.max(z)), any_valid
+    if kind in ("first", "last", "first_valid", "last_valid"):
+        want_valid = kind.endswith("_valid")
+        cond = present if want_valid else inrow
+        n = x.shape[0]
+        pos = jnp.arange(n, dtype=np.int64)
+        if kind.startswith("first"):
+            idx = jnp.min(jnp.where(cond, pos, n))
+            found = idx < n
+        else:
+            idx = jnp.max(jnp.where(cond, pos, -1))
+            found = idx >= 0
+        safe = jnp.clip(idx, 0, n - 1)
+        return x[safe], found & valid[safe]
+    if kind == "mean":
+        z = jnp.where(present, x, jnp.zeros_like(x))
+        s = jnp.sum(z)
+        cnt = jnp.sum(present.astype(x.dtype))
+        return jnp.where(cnt > 0, s / jnp.where(cnt > 0, cnt, 1), 0.0), \
+            any_valid
+    raise ValueError(f"unknown reduction kind {kind!r}")
+
+
+def _global_aggregate(batch: ColumnarBatch,
+                      specs: Sequence[Tuple[int, str, bool, T.DataType]],
+                      ) -> ColumnarBatch:
+    """num_keys == 0: no sort, no segments; output planes are tiny
+    (bucket 8) so downstream merge/final passes and the result download
+    never touch input-sized buffers."""
+    import jax
+    jnp = _jx()
+    bucket = batch.bucket
+    spec_key = tuple((o, k, cv, str(dt)) for o, k, cv, dt in specs)
+    key = ("globalagg", tuple(_col_sig(c) for c in batch.columns), spec_key)
+    fn = _AGG_CACHE.get(key)
+    if fn is None:
+        dtypes = [c.data_type for c in batch.columns]
+
+        def run(arrs, row_count):
+            cols = [DeviceColumn(d, v, bucket, dtypes[i], ln)
+                    for i, (d, v, ln) in enumerate(arrs)]
+            sel = jnp.arange(bucket, dtype=np.int32) < row_count
+            return global_agg_trace(cols, sel, specs, jnp)
+
+        fn = jax.jit(run)
+        _AGG_CACHE[key] = fn
+    from spark_rapids_tpu.columnar.column import rc_traceable
+    arrs = [(c.data, c.validity, c.lengths) for c in batch.columns]
+    outs = fn(arrs, rc_traceable(batch.row_count))
+    names = [f"a{j}" for j in range(len(specs))]
+    cols = []
+    for j, (d, v, ln) in enumerate(outs):
+        dt = specs[j][3]
+        if ln is None and dt.np_dtype is not None and \
+                d.dtype != np.dtype(dt.np_dtype):
+            d = d.astype(dt.np_dtype)
+        cols.append(DeviceColumn(d, v, 1, dt, ln))
+    return ColumnarBatch(cols, 1, names)
+
+
+def global_agg_trace(cols, sel, specs, jnp):
+    """Traceable global-agg update/merge pass over (cols, selection mask):
+    returns [(data, valid, lengths)] 8-row planes, value in row 0.  Called
+    by _global_aggregate and by the whole-stage fuser (exec/fused.py)."""
+    inrow = sel
+
+    def slot(val, ok, width=None):
+        """scalar -> 8-row plane with the value at row 0."""
+        if width is None:
+            d = jnp.zeros(_GLOBAL_OUT_BUCKET, dtype=val.dtype).at[0].set(val)
+        else:
+            d = jnp.zeros((_GLOBAL_OUT_BUCKET, width),
+                          dtype=val.dtype).at[0].set(val)
+        v = jnp.zeros(_GLOBAL_OUT_BUCKET, dtype=bool).at[0].set(ok)
+        return d, v
+
+    outs = []
+    i = 0
+    while i < len(specs):
+        o, kind, cvo, _dt = specs[i]
+        c = cols[o]
+        if kind == "m2_cnt":
+            oc, om, o2 = specs[i][0], specs[i + 1][0], specs[i + 2][0]
+            cnt_c, mean_c, m2_c = cols[oc], cols[om], cols[o2]
+            pres = cnt_c.validity & inrow
+            n_i = jnp.where(pres, cnt_c.data, 0.0)
+            mu_i = jnp.where(pres, mean_c.data, 0.0)
+            m2_i = jnp.where(pres, m2_c.data, 0.0)
+            tot = jnp.sum(n_i)
+            wsum = jnp.sum(n_i * mu_i)
+            mu = jnp.where(tot > 0, wsum / jnp.where(tot > 0, tot, 1), 0.0)
+            dev = mu_i - mu
+            m2 = jnp.sum(m2_i + n_i * dev * dev)
+            ok = jnp.asarray(True)
+            for val in (tot, mu, m2):
+                d, v = slot(val, ok)
+                outs.append((d, v, None))
+            i += 3
+            continue
+        if kind == "m2":
+            x = c.data
+            pres = c.validity & inrow
+            z = jnp.where(pres, x, 0.0)
+            cnt = jnp.sum(pres.astype(x.dtype))
+            s = jnp.sum(z)
+            mu = jnp.where(cnt > 0, s / jnp.where(cnt > 0, cnt, 1), 0.0)
+            dctr = jnp.where(pres, x - mu, 0.0)
+            d, v = slot(jnp.sum(dctr * dctr), jnp.asarray(True))
+            outs.append((d, v, None))
+            i += 1
+            continue
+        if c.lengths is not None and kind != "count":
+            # first/last over strings: pick the row, carry lengths
+            want_valid = kind.endswith("_valid")
+            pres = c.validity & inrow
+            cond = pres if want_valid else inrow
+            nn = c.data.shape[0]
+            pos = jnp.arange(nn, dtype=np.int64)
+            if kind.startswith("first"):
+                idx = jnp.min(jnp.where(cond, pos, nn))
+                found = idx < nn
+            else:
+                idx = jnp.max(jnp.where(cond, pos, -1))
+                found = idx >= 0
+            safe = jnp.clip(idx, 0, nn - 1)
+            d, v = slot(c.data[safe], found & c.validity[safe],
+                        width=c.data.shape[1])
+            ln = jnp.zeros(_GLOBAL_OUT_BUCKET,
+                           dtype=c.lengths.dtype).at[0].set(c.lengths[safe])
+            outs.append((d, v, ln))
+        else:
+            val, ok = _global_reduce(kind, c.data, c.validity, inrow, jnp,
+                                     count_valid_only=cvo)
+            d, v = slot(val, ok)
+            outs.append((d, v, None))
+        i += 1
+    return outs
+
+
 def segmented_aggregate(batch: ColumnarBatch, num_keys: int,
                         specs: Sequence[Tuple[int, str, bool, T.DataType]],
                         ) -> ColumnarBatch:
@@ -135,114 +297,22 @@ def segmented_aggregate(batch: ColumnarBatch, num_keys: int,
     import jax
     jnp = _jx()
     from spark_rapids_tpu.ops.sort_ops import SortOrder, sortable_words
+    if num_keys == 0:
+        return _global_aggregate(batch, specs)
     bucket = batch.bucket
     spec_key = tuple((o, k, cv, str(dt)) for o, k, cv, dt in specs)
     key = ("segagg", tuple(_col_sig(c) for c in batch.columns), num_keys,
            spec_key)
     fn = _AGG_CACHE.get(key)
     if fn is None:
-        orders = [SortOrder(i, True, True) for i in range(num_keys)]
         # capture only scalars/types, never the batch (module-cache pinning)
         dtypes = [c.data_type for c in batch.columns]
 
         def run(arrs, row_count):
-            from spark_rapids_tpu.ops.sort_ops import _order_words
             cols = [DeviceColumn(d, v, bucket, dtypes[i], ln)
                     for i, (d, v, ln) in enumerate(arrs)]
-            rowpos = jnp.arange(bucket, dtype=np.int32)
-            inrow = rowpos < row_count
-            # ---- sort by keys (padding last) ----
-            words = [(~inrow).astype(np.int8)]
-            for o in orders:
-                words.extend(_order_words(cols[o.ordinal], o, jnp))
-            perm = jax.lax.sort(tuple(words) + (rowpos,),
-                                num_keys=len(words), is_stable=True)[-1]
-            scols = []
-            for c in cols:
-                d = jnp.take(c.data, perm, axis=0)
-                v = jnp.take(c.validity, perm, axis=0)
-                ln = None if c.lengths is None else jnp.take(c.lengths, perm,
-                                                             axis=0)
-                scols.append(DeviceColumn(d, v, bucket, c.data_type, ln))
-            inrow_s = jnp.take(inrow, perm, axis=0)  # still a prefix
-            # ---- segment boundaries over masked key words ----
-            boundary = jnp.zeros(bucket, dtype=bool).at[0].set(True)
-            for kcol in scols[:num_keys]:
-                for w in _masked_group_words(kcol, jnp):
-                    if w.ndim == 1:
-                        diff = w[1:] != w[:-1]
-                    else:
-                        diff = jnp.any(w[1:] != w[:-1], axis=-1)
-                    boundary = boundary.at[1:].max(diff)
-            # first padding row opens its own (discarded) segment
-            boundary = boundary | (rowpos == row_count)
-            seg = jnp.cumsum(boundary.astype(np.int32)) - 1
-            num_groups = jnp.max(jnp.where(inrow_s, seg, -1)) + 1
-            # ---- unique keys: value at each segment's first row ----
-            outs = []
-            first_pos = jax.ops.segment_min(
-                jnp.where(inrow_s, rowpos.astype(np.int64), bucket), seg,
-                num_segments=bucket)
-            safe_first = jnp.clip(first_pos, 0, bucket - 1)
-            gvalid = jnp.arange(bucket) < num_groups
-            for kcol in scols[:num_keys]:
-                d = jnp.take(kcol.data, safe_first, axis=0)
-                v = jnp.take(kcol.validity, safe_first, axis=0) & gvalid
-                ln = None if kcol.lengths is None else \
-                    jnp.take(kcol.lengths, safe_first, axis=0)
-                outs.append((d, v, ln))
-            # ---- reductions ----
-            i = 0
-            while i < len(specs):
-                o, kind, cvo, _dt = specs[i]
-                c = scols[o]
-                if kind == "m2_cnt":
-                    # joint Chan merge over partial (cnt, mean, m2) triples
-                    oc, om, o2 = specs[i][0], specs[i + 1][0], specs[i + 2][0]
-                    cnt_c, mean_c, m2_c = scols[oc], scols[om], scols[o2]
-                    pres = cnt_c.validity & inrow_s
-                    n_i = jnp.where(pres, cnt_c.data, 0.0)
-                    mu_i = jnp.where(pres, mean_c.data, 0.0)
-                    m2_i = jnp.where(pres, m2_c.data, 0.0)
-                    tot = jax.ops.segment_sum(n_i, seg, num_segments=bucket)
-                    wsum = jax.ops.segment_sum(n_i * mu_i, seg,
-                                               num_segments=bucket)
-                    mu = jnp.where(tot > 0, wsum / jnp.where(tot > 0, tot, 1),
-                                   0.0)
-                    dev = mu_i - jnp.take(mu, seg)
-                    m2 = jax.ops.segment_sum(m2_i + n_i * dev * dev, seg,
-                                             num_segments=bucket)
-                    ok = jnp.ones(bucket, dtype=bool)
-                    outs.append((tot, ok, None))
-                    outs.append((mu, ok, None))
-                    outs.append((m2, ok, None))
-                    i += 3
-                    continue
-                if kind == "m2":
-                    # update: needs this input's per-segment mean first
-                    x = c.data
-                    pres = c.validity & inrow_s
-                    z = jnp.where(pres, x, 0.0)
-                    n = jax.ops.segment_sum(pres.astype(x.dtype), seg,
-                                            num_segments=bucket)
-                    s = jax.ops.segment_sum(z, seg, num_segments=bucket)
-                    mu = jnp.where(n > 0, s / jnp.where(n > 0, n, 1), 0.0)
-                    d = jnp.where(pres, x - jnp.take(mu, seg), 0.0)
-                    m2 = jax.ops.segment_sum(d * d, seg, num_segments=bucket)
-                    outs.append((m2, jnp.ones(bucket, dtype=bool), None))
-                    i += 1
-                    continue
-                if c.lengths is not None and kind != "count":
-                    d, v, ln = _lengths_reduce(kind, c, c.validity, seg,
-                                               inrow_s, bucket, jnp)
-                    outs.append((d, v, ln))
-                else:
-                    d, v = _segment_reduce(kind, c.data, c.validity, seg,
-                                           inrow_s, bucket, jnp,
-                                           count_valid_only=cvo)
-                    outs.append((d, v, None))
-                i += 1
-            return outs, num_groups
+            sel = jnp.arange(bucket, dtype=np.int32) < row_count
+            return keyed_agg_trace(cols, sel, num_keys, specs, bucket, jnp)
 
         fn = jax.jit(run)
         _AGG_CACHE[key] = fn
@@ -253,7 +323,6 @@ def segmented_aggregate(batch: ColumnarBatch, num_keys: int,
     names = (batch.names or [f"c{i}" for i in range(batch.num_columns)])
     out_names = names[:num_keys] + [f"a{j}" for j in range(len(specs))]
     cols = []
-    jnp = _jx()
     for j, (d, v, ln) in enumerate(outs):
         if j < num_keys:
             dt = batch.columns[j].data_type
@@ -262,6 +331,136 @@ def segmented_aggregate(batch: ColumnarBatch, num_keys: int,
             if ln is None and dt.np_dtype is not None and \
                     d.dtype != np.dtype(dt.np_dtype):
                 d = d.astype(dt.np_dtype)
-        gvalid = jnp.arange(d.shape[0]) < ng
-        cols.append(DeviceColumn(d, v & gvalid, n, dt, ln))
+        cols.append(DeviceColumn(d, v, n, dt, ln))
     return ColumnarBatch(cols, n, out_names)
+
+
+def keyed_agg_trace(cols, sel, num_keys, specs, bucket, jnp):
+    """Traceable keyed groupby pass over (cols, selection mask): sort by
+    keys, detect segments, reduce.  Returns ([(data, valid, lengths)],
+    num_groups).  Called by segmented_aggregate and the whole-stage fuser."""
+    import jax
+    from spark_rapids_tpu.ops.sort_ops import SortOrder, _order_words
+    orders = [SortOrder(i, True, True) for i in range(num_keys)]
+    rowpos = jnp.arange(bucket, dtype=np.int32)
+    inrow = sel
+    row_count = jnp.sum(sel)  # selected rows sort to the front
+    # ---- sort by keys (padding last); every 1-D plane rides the
+    # sort as an operand (gathers cost ~40ms/col/M on v5e, sort
+    # operands are near-free) ----
+    words = [(~inrow).astype(np.int8)]
+    for o in orders:
+        words.extend(_order_words(cols[o.ordinal], o, jnp))
+    flat_planes = []
+    twod_planes = []
+    for c in cols:
+        (flat_planes if c.data.ndim == 1 else
+         twod_planes).append(c.data)
+        flat_planes.append(c.validity)
+        if c.lengths is not None:
+            flat_planes.append(c.lengths)
+    operands = tuple(words) + (rowpos,) + tuple(flat_planes)
+    sorted_ops = jax.lax.sort(operands, num_keys=len(words),
+                              is_stable=True)
+    perm = sorted_ops[len(words)]
+    flat_sorted = list(sorted_ops[len(words) + 1:])
+    twod_sorted = [jnp.take(p, perm, axis=0) for p in twod_planes]
+    scols = []
+    fi = ti = 0
+    for c in cols:
+        if c.data.ndim == 1:
+            d = flat_sorted[fi]
+            fi += 1
+        else:
+            d = twod_sorted[ti]
+            ti += 1
+        v = flat_sorted[fi]
+        fi += 1
+        ln = None
+        if c.lengths is not None:
+            ln = flat_sorted[fi]
+            fi += 1
+        scols.append(DeviceColumn(d, v, bucket, c.data_type, ln))
+    inrow_s = jnp.take(inrow, perm, axis=0)  # still a prefix
+    # ---- segment boundaries over masked key words ----
+    boundary = jnp.zeros(bucket, dtype=bool).at[0].set(True)
+    for kcol in scols[:num_keys]:
+        for w in _masked_group_words(kcol, jnp):
+            if w.ndim == 1:
+                diff = w[1:] != w[:-1]
+            else:
+                diff = jnp.any(w[1:] != w[:-1], axis=-1)
+            boundary = boundary.at[1:].max(diff)
+    # first padding row opens its own (discarded) segment
+    boundary = boundary | (rowpos == row_count)
+    seg = jnp.cumsum(boundary.astype(np.int32)) - 1
+    num_groups = jnp.max(jnp.where(inrow_s, seg, -1)) + 1
+    # ---- unique keys: value at each segment's first row ----
+    outs = []
+    first_pos = jax.ops.segment_min(
+        jnp.where(inrow_s, rowpos.astype(np.int64), bucket), seg,
+        num_segments=bucket)
+    safe_first = jnp.clip(first_pos, 0, bucket - 1)
+    gvalid = jnp.arange(bucket) < num_groups
+    for kcol in scols[:num_keys]:
+        d = jnp.take(kcol.data, safe_first, axis=0)
+        v = jnp.take(kcol.validity, safe_first, axis=0) & gvalid
+        ln = None if kcol.lengths is None else \
+            jnp.take(kcol.lengths, safe_first, axis=0)
+        outs.append((d, v, ln))
+    # ---- reductions ----
+    i = 0
+    while i < len(specs):
+        o, kind, cvo, _dt = specs[i]
+        c = scols[o]
+        if kind == "m2_cnt":
+            # joint Chan merge over partial (cnt, mean, m2) triples
+            oc, om, o2 = specs[i][0], specs[i + 1][0], specs[i + 2][0]
+            cnt_c, mean_c, m2_c = scols[oc], scols[om], scols[o2]
+            pres = cnt_c.validity & inrow_s
+            n_i = jnp.where(pres, cnt_c.data, 0.0)
+            mu_i = jnp.where(pres, mean_c.data, 0.0)
+            m2_i = jnp.where(pres, m2_c.data, 0.0)
+            tot = jax.ops.segment_sum(n_i, seg, num_segments=bucket)
+            wsum = jax.ops.segment_sum(n_i * mu_i, seg,
+                                       num_segments=bucket)
+            mu = jnp.where(tot > 0, wsum / jnp.where(tot > 0, tot, 1),
+                           0.0)
+            dev = mu_i - jnp.take(mu, seg)
+            m2 = jax.ops.segment_sum(m2_i + n_i * dev * dev, seg,
+                                     num_segments=bucket)
+            ok = jnp.ones(bucket, dtype=bool)
+            outs.append((tot, ok, None))
+            outs.append((mu, ok, None))
+            outs.append((m2, ok, None))
+            i += 3
+            continue
+        if kind == "m2":
+            # update: needs this input's per-segment mean first
+            x = c.data
+            pres = c.validity & inrow_s
+            z = jnp.where(pres, x, 0.0)
+            n = jax.ops.segment_sum(pres.astype(x.dtype), seg,
+                                    num_segments=bucket)
+            s = jax.ops.segment_sum(z, seg, num_segments=bucket)
+            mu = jnp.where(n > 0, s / jnp.where(n > 0, n, 1), 0.0)
+            d = jnp.where(pres, x - jnp.take(mu, seg), 0.0)
+            m2 = jax.ops.segment_sum(d * d, seg, num_segments=bucket)
+            outs.append((m2, jnp.ones(bucket, dtype=bool), None))
+            i += 1
+            continue
+        if c.lengths is not None and kind != "count":
+            d, v, ln = _lengths_reduce(kind, c, c.validity, seg,
+                                       inrow_s, bucket, jnp)
+            outs.append((d, v, ln))
+        else:
+            d, v = _segment_reduce(kind, c.data, c.validity, seg,
+                                   inrow_s, bucket, jnp,
+                                   count_valid_only=cvo)
+            outs.append((d, v, None))
+        i += 1
+    # mask group-slot padding in-trace (eager masking would cost one
+    # tunnel dispatch per output column)
+    gv = jnp.arange(bucket) < num_groups
+    outs = [(d, v & gv, ln) for (d, v, ln) in outs]
+    return outs, num_groups

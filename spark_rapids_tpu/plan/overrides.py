@@ -247,21 +247,52 @@ def insert_transitions(plan: Exec, conf: TpuConf) -> Exec:
 
 
 def fuse_device_stages(plan: Exec) -> Exec:
-    """Whole-stage fusion pass: collapse TpuProject(TpuFilter(x)) into one
-    jitted kernel (predicate + projection + compaction in a single XLA
-    program).  The reference cannot do this — cuDF dispatches one kernel
-    per operator; XLA's tracing model makes cross-operator fusion a plan
-    rewrite."""
+    """Whole-stage fusion pass: collapse maximal chains of device narrow
+    ops (Filter/Project) — and, when they feed a hash aggregate, the
+    aggregate's update pass — into ONE jitted XLA program (exec/fused.py).
+    The reference cannot do this — cuDF dispatches one kernel per operator;
+    XLA's tracing model makes cross-operator fusion a plan rewrite."""
+    from spark_rapids_tpu.exec.aggregate import (FINAL, TpuHashAggregateExec)
     from spark_rapids_tpu.exec.basic import (TpuFilterExec,
                                              TpuFilterProjectExec,
                                              TpuProjectExec)
+    from spark_rapids_tpu.exec.fused import (TpuFusedAggExec,
+                                             TpuFusedStageExec)
+
+    def chain_of(node: Exec):
+        """Descends through fusable narrow ops; returns (ops top-down ->
+        bottom-up reversed, base child)."""
+        ops = []
+        cur = node
+        while True:
+            if isinstance(cur, TpuFilterExec):
+                ops.append(("filter", cur.condition))
+                cur = cur.children[0]
+            elif isinstance(cur, TpuProjectExec):
+                ops.append(("project", cur.exprs))
+                cur = cur.children[0]
+            elif isinstance(cur, TpuFilterProjectExec):
+                ops.append(("project", cur.exprs))
+                ops.append(("filter", cur.condition))
+                cur = cur.children[0]
+            elif isinstance(cur, TpuFusedStageExec):
+                ops.extend(reversed(cur.ops))
+                cur = cur.children[0]
+            else:
+                return list(reversed(ops)), cur
 
     def fix(node: Exec) -> Exec:
-        if isinstance(node, TpuProjectExec) and \
-                isinstance(node.children[0], TpuFilterExec):
-            filt = node.children[0]
-            return TpuFilterProjectExec(filt.condition, node.exprs,
-                                        filt.children[0])
+        if isinstance(node, TpuHashAggregateExec) and node.mode != FINAL:
+            ops, base = chain_of(node.children[0])
+            lay = node.layout
+            return TpuFusedAggExec(ops, lay, node.mode, base)
+        if isinstance(node, (TpuFilterExec, TpuProjectExec,
+                             TpuFilterProjectExec)):
+            ops, base = chain_of(node)
+            # fuse whenever it saves a dispatch: any filter (eager predicate
+            # + separate compact otherwise) or a multi-op chain
+            if len(ops) >= 2 or any(k == "filter" for k, _ in ops):
+                return TpuFusedStageExec(ops, base)
         return node
 
     return plan.transform_up(fix)
@@ -295,11 +326,16 @@ class TpuOverrides:
         self.conf = conf
         self.last_meta: Optional[PlanMeta] = None
 
-    def apply(self, plan: Exec, for_explain: bool = False) -> Exec:
+    def apply(self, plan: Exec, for_explain: bool = False,
+              skip_pruning: bool = False) -> Exec:
         """``for_explain`` produces the would-be plan without the test-mode
-        all-on-device assertion (introspection must not raise on fallback)."""
+        all-on-device assertion (introspection must not raise on fallback).
+        ``skip_pruning`` is set by callers that already pruned (count())."""
         from spark_rapids_tpu.plan.meta import PlanMeta
         conf = self.conf
+        if not skip_pruning and conf.get(C.COLUMN_PRUNING_ENABLED.key, True):
+            from spark_rapids_tpu.plan.pruning import prune_columns
+            plan = prune_columns(plan)
         if not conf.is_sql_enabled:
             return plan
         meta = PlanMeta(plan, conf)

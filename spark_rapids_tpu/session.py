@@ -616,9 +616,19 @@ class DataFrame:
 
     def count(self) -> int:
         from spark_rapids_tpu.columnar.column import sum_counts
-        # deferred device counts are summed on device: ONE sync total
+        from spark_rapids_tpu.plan.pruning import prune_columns
+        # count needs row counts only: prune every column the plan's own
+        # filters/keys don't reference, then sum deferred device counts
+        # with ONE sync total
+        plan = self._plan
+        if self._session.conf.get(C.COLUMN_PRUNING_ENABLED.key, True):
+            plan = prune_columns(plan, required=set())
+        overrides = TpuOverrides(self._session.conf)
+        # already pruned above (with the tighter empty required-set);
+        # don't pay a second tree walk inside apply()
         return sum_counts([b.row_count for b in
-                           self._executed_plan().execute_all()])
+                           overrides.apply(plan, skip_pruning=True)
+                           .execute_all()])
 
     def write_parquet(self, path: str) -> None:
         from spark_rapids_tpu.io.parquet import write_parquet

@@ -33,7 +33,9 @@ def test_metrics_levels_and_collection():
     ms = collect_metrics(plan)
     assert ms, "instrumented plan must report metrics"
     by_node = {m["node"]: m for m in ms}
-    root = [m for m in ms if "Project" in m["node"]]
+    # filter+project fuses into one whole-stage node (fuse_device_stages)
+    root = [m for m in ms
+            if "Project" in m["node"] or "FusedStage" in m["node"]]
     assert root and root[0]["numOutputBatches"] >= 1
     assert any(m.get("opTime", 0) > 0 for m in ms)
     # essential-only level drops opTime
@@ -51,7 +53,7 @@ def test_plan_capture_callback():
         (s.create_dataframe(_DATA).filter(col("a") > lit(5)).collect())
         plans = ExecutionPlanCaptureCallback.get_captured_plans()
         assert plans
-        ExecutionPlanCaptureCallback.assert_contains("TpuFilterExec")
+        ExecutionPlanCaptureCallback.assert_contains("TpuFusedStageExec")
         with pytest.raises(AssertionError):
             ExecutionPlanCaptureCallback.assert_contains("NoSuchExec")
     finally:
