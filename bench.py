@@ -89,7 +89,7 @@ def main():
         return 1
 
     rows_per_sec = n_rows / best_tpu
-    print(json.dumps({
+    out = {
         "metric": "filter_project_hash_agg_rows_per_sec",
         "value": round(rows_per_sec),
         "unit": "rows/s",
@@ -98,8 +98,51 @@ def main():
         "tpu_s": round(best_tpu, 4),
         "cpu_s": round(best_cpu, 4),
         "results_match": True,
-    }))
+    }
+
+    if os.environ.get("BENCH_SKIP_TPCDS", "") != "1":
+        try:
+            out["tpcds"] = _tpcds_phase(tpu, cpu)
+        except Exception as e:  # keep the primary metric reportable
+            out["tpcds"] = {"error": f"{type(e).__name__}: {e}"}
+
+    print(json.dumps(out))
     return 0
+
+
+def _tpcds_phase(tpu, cpu):
+    """BASELINE.md milestone #2: TPC-DS q1-q10 wall clock, TPU vs the CPU
+    engine, geomean speedup (per-query differential-checked)."""
+    import math
+    from spark_rapids_tpu.testing.tpcds import register_tables
+    from spark_rapids_tpu.testing.tpcds_queries import QUERIES
+    sf = float(os.environ.get("BENCH_TPCDS_SF", 1.0))
+    per_query = {}
+    speedups = []
+    register_tables(tpu, sf=sf, num_partitions=4)
+    register_tables(cpu, sf=sf, num_partitions=4)
+    for qname in sorted(QUERIES):
+        sql = QUERIES[qname]
+        t_rows = tpu.sql(sql).collect()       # warm (compile cache)
+        t0 = time.perf_counter()
+        t_rows = tpu.sql(sql).collect()
+        t_tpu = time.perf_counter() - t0
+        c_rows = cpu.sql(sql).collect()
+        t0 = time.perf_counter()
+        c_rows = cpu.sql(sql).collect()
+        t_cpu = time.perf_counter() - t0
+        match = len(t_rows) == len(c_rows)
+        per_query[qname] = {"tpu_s": round(t_tpu, 4),
+                            "cpu_s": round(t_cpu, 4),
+                            "speedup": round(t_cpu / t_tpu, 3),
+                            "rows": len(t_rows),
+                            "match": match}
+        if match:
+            speedups.append(t_cpu / t_tpu)
+    geomean = math.exp(sum(math.log(s) for s in speedups) /
+                       len(speedups)) if speedups else 0.0
+    return {"sf": sf, "geomean_speedup": round(geomean, 3),
+            "queries": per_query}
 
 
 if __name__ == "__main__":

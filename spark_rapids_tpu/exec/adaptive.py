@@ -41,7 +41,17 @@ PartitionSpec = Union[CoalescedPartitionSpec, PartialPartitionSpec]
 def _partition_sizes(exchange) -> List[int]:
     """Materializes the exchange and sizes each reduce partition (the AQE
     'query stage statistics' step)."""
+    import numpy as np
     exchange._materialize()
+    if getattr(exchange, "_collective", None) is not None:
+        # mesh path: partitions are device shards; size = rows * row width
+        _ctx, cols, counts, schema = exchange._collective
+        counts_h = np.asarray(counts)
+        row_bytes = sum(
+            getattr(f.data_type, "np_dtype", None).itemsize
+            if getattr(f.data_type, "np_dtype", None) is not None else 16
+            for f in schema.fields) + len(schema.fields)
+        return [int(c) * row_bytes for c in counts_h]
     sizes = []
     for p in range(exchange.num_partitions):
         total = 0
@@ -156,6 +166,9 @@ def insert_adaptive_readers(plan: Exec, target_bytes: int) -> Exec:
     from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
     from spark_rapids_tpu.plan.base import BinaryExec
 
+    from spark_rapids_tpu.parallel.mesh import active_mesh
+    mesh_on = active_mesh() is not None
+
     def fix(node: Exec) -> Exec:
         if isinstance(node, BinaryExec):
             # join inputs pair partition i with partition i: independent
@@ -166,6 +179,12 @@ def insert_adaptive_readers(plan: Exec, target_bytes: int) -> Exec:
         for c in node.children:
             if isinstance(c, CpuShuffleExchangeExec) and \
                     not isinstance(node, AdaptiveShuffleReaderExec):
+                if mesh_on:
+                    # mesh shuffles map reduce partitions 1:1 onto device
+                    # shards; coalescing would concatenate batches living
+                    # on different devices into one downstream kernel
+                    new_children.append(c)
+                    continue
                 c = AdaptiveShuffleReaderExec(c, target_bytes)
             new_children.append(c)
         return node.with_children(new_children)

@@ -244,13 +244,73 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
 
     is_device = True
 
+    #: set when the collective (mesh) path materialized this exchange:
+    #: (MeshContext, sharded cols, per-device counts, schema)
+    _collective = None
+
+    def _collective_eligible(self, part):
+        """The mesh path covers hash shuffles whose reduce count equals the
+        mesh size and whose columns ride the sharded layout (no nested
+        element-validity planes)."""
+        from spark_rapids_tpu import types as T
+        from spark_rapids_tpu.parallel.mesh import active_mesh
+        from spark_rapids_tpu.plan.partitioning import HashPartitioning
+        ctx = active_mesh()
+        if ctx is None or not isinstance(part, HashPartitioning):
+            return None
+        if part.num_partitions != ctx.num_devices:
+            return None
+        for f in self.child.schema.fields:
+            if isinstance(f.data_type, (T.ArrayType, T.MapType,
+                                        T.StructType)):
+                return None
+        return ctx
+
+    def _materialize_collective(self, ctx):
+        """Mesh execution: shard the map output over the devices (input
+        pipeline step of the single-controller SPMD model), then ONE fused
+        all_to_all program is the entire shuffle (reference: the UCX
+        RDMA transport + catalogs + heartbeats collapse into the
+        collective; parallel/collective.py docstring)."""
+        import jax
+        from spark_rapids_tpu.columnar.column import _jnp
+        from spark_rapids_tpu.expressions.base import EvalContext, TCol
+        from spark_rapids_tpu.parallel import collective as C
+        jnp = _jnp()
+        schema = self.child.schema
+        batches = []
+        for mp in range(self.child.num_partitions):
+            batches.extend(self.child.execute_partition(mp))
+        cols, counts = C.shard_engine_batches(ctx, batches, schema)
+        part = self.partitioning
+
+        total = int(cols[0][0].shape[0])
+
+        def pid_fn(arrs):
+            tcols = [TCol(d, v, f.data_type, lengths=ln)
+                     for (d, v, ln), f in zip(arrs, schema.fields)]
+            ectx = EvalContext(tcols, "tpu", total)
+            h = part._hash_expr().eval_tpu(ectx)
+            n = np.int32(part.num_partitions)
+            return (((h.data % n) + n) % n).astype(np.int32)
+
+        pids = jax.jit(pid_fn)([tuple(c) for c in cols])
+        out_cols, out_counts = C.collective_hash_shuffle(ctx, cols, counts,
+                                                         pids)
+        self._collective = (ctx, out_cols, out_counts, schema)
+
     def _materialize(self):
-        if self._store is not None:
+        if self._store is not None or self._collective is not None:
             return
         from spark_rapids_tpu.shuffle.env import get_shuffle_env
         env = self.shuffle_env or get_shuffle_env()
         mode = env.mode if env is not None else "DEFAULT"
         part = self.partitioning
+        if mode == "DEFAULT":
+            ctx = self._collective_eligible(part)
+            if ctx is not None:
+                self._materialize_collective(ctx)
+                return
         if mode != "DEFAULT":
             super()._materialize()
             return
@@ -285,6 +345,11 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
 
     def execute_partition(self, pidx):
         self._materialize()
+        if self._collective is not None:
+            from spark_rapids_tpu.parallel import collective as C
+            ctx, cols, counts, schema = self._collective
+            yield C.shard_to_batch(ctx, cols, counts, schema, pidx)
+            return
         from spark_rapids_tpu.columnar.batch import ColumnarBatch as _CB
         from spark_rapids_tpu.exec.basic import upload_batches
         host_pending = []

@@ -121,6 +121,45 @@ def unshard_batch(ctx: MeshContext, cols, counts,
     return concat_host_batches(batches)
 
 
+def shard_engine_batches(ctx: MeshContext, batches, schema):
+    """Places engine batches (host or device ColumnarBatch) into the
+    sharded-batch layout: the single-controller input-pipeline step of the
+    SPMD model (scan output -> device_put with a NamedSharding); all
+    subsequent shuffle/compute rides the mesh."""
+    from spark_rapids_tpu.columnar.batch import (ColumnarBatch,
+                                                 HostColumnarBatch)
+    host = []
+    for b in batches:
+        if isinstance(b, ColumnarBatch):
+            b = b.to_host()
+        host.append(b)
+    if not host:
+        import pyarrow as pa
+        from spark_rapids_tpu import types as T
+        from spark_rapids_tpu.columnar.batch import batch_from_arrow
+        empty = pa.table({f.name: pa.array([], type=T.to_arrow(f.data_type))
+                          for f in schema.fields})
+        host = [batch_from_arrow(empty)]
+    return shard_batch(ctx, host)
+
+
+def shard_to_batch(ctx: MeshContext, cols, counts, schema, shard: int):
+    """Reduce-side read: materializes mesh shard ``shard`` as a regular
+    engine ColumnarBatch (the reduce task's fetch; all data already sits on
+    that device)."""
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    n = ctx.num_devices
+    cnt = int(np.asarray(counts)[shard])
+    out_cols = []
+    for (d, v, ln), f in zip(cols, schema.fields):
+        ds = d.addressable_shards[shard].data
+        vs = v.addressable_shards[shard].data
+        ls = None if ln is None else ln.addressable_shards[shard].data
+        out_cols.append(DeviceColumn(ds, vs, cnt, f.data_type, ls))
+    return ColumnarBatch(out_cols, cnt,
+                         [f.name for f in schema.fields])
+
+
 def collective_hash_shuffle(ctx: MeshContext, cols, counts, pids):
     """The fused distributed shuffle.
 

@@ -158,7 +158,7 @@ class _Pruner:
 
     def _prune_join(self, node, required: Optional[Set[int]]):
         from spark_rapids_tpu.exec import joins as JX
-        from spark_rapids_tpu.ops.join_ops import J
+        import spark_rapids_tpu.ops.join_ops as J
         nl = len(node.left.schema.fields)
         nr = len(node.right.schema.fields)
         semi = node.join_type in (J.LEFT_SEMI, J.LEFT_ANTI)

@@ -41,12 +41,37 @@ class TpuSession:
             self.runtime = None
         from spark_rapids_tpu.shuffle.env import init_shuffle_env
         self.shuffle_env = init_shuffle_env(self.conf)
+        #: temp views for the SQL front-end (name -> DataFrame)
+        self._views: Dict[str, "DataFrame"] = {}
         TpuSession._active = self
 
     # -- conf ---------------------------------------------------------------
     def set_conf(self, key: str, value) -> "TpuSession":
         self.conf = self.conf.set(key, value)
         return self
+
+    # -- SQL ----------------------------------------------------------------
+    def sql(self, text: str) -> "DataFrame":
+        """Executes SQL text against registered temp views (the reference
+        accepts arbitrary Spark SQL via Catalyst; here sql/ carries the
+        parser + analyzer for the TPC-DS-class dialect)."""
+        from spark_rapids_tpu.sql.analyzer import Analyzer
+        from spark_rapids_tpu.sql.parser import parse
+        return Analyzer(self).plan(parse(text))
+
+    def create_or_replace_temp_view(self, name: str, df: "DataFrame") -> None:
+        self._views[name.lower()] = df
+
+    createOrReplaceTempView = create_or_replace_temp_view
+
+    def table(self, name: str) -> "DataFrame":
+        df = self.catalog_lookup(name)
+        if df is None:
+            raise ValueError(f"table or view not found: {name}")
+        return df
+
+    def catalog_lookup(self, name: str) -> Optional["DataFrame"]:
+        return self._views.get(name.lower())
 
     # -- dataframe constructors --------------------------------------------
     def create_dataframe(self, data, schema: Optional[T.StructType] = None,

@@ -109,10 +109,12 @@ class ShuffleServer:
                           for b, sz in blocks)
             return encode_message(MetadataResponse(msg.req_id, metas))
         if isinstance(msg, TransferRequest):
-            # connection metadata rides with the request in-process; a
-            # remote transport resolves the peer from the channel itself
-            with self._lock:
-                peer = self._reply_to.pop(msg.req_id, None)
+            # reply-to identity rides in the request (socket transport) or
+            # via the in-process note_reply_to side channel (mock tests)
+            peer = msg.reply_to or None
+            if peer is None:
+                with self._lock:
+                    peer = self._reply_to.pop(msg.req_id, None)
             if peer is None:
                 return encode_message(TransferResponse(
                     msg.req_id, False, "unknown reply-to peer"))
@@ -146,6 +148,9 @@ class ShuffleClient:
 
     One instance per executor; receives data frames via the transport
     handler interface and reassembles them into the received catalog."""
+
+    #: max wait for in-flight data frames after a transfer ack
+    data_timeout_s = 30.0
 
     def __init__(self, executor_id: str, transport,
                  received: Optional[ShuffleReceivedBufferCatalog] = None):
@@ -194,10 +199,15 @@ class ShuffleClient:
         self.received.add_frame(h.block, frame)
 
     # -- fetch flow ---------------------------------------------------------
-    def fetch_metadata(self, server: "ShuffleServer", shuffle_id: int,
+    @staticmethod
+    def _peer_id(server_or_peer) -> str:
+        return server_or_peer if isinstance(server_or_peer, str) \
+            else server_or_peer.executor_id
+
+    def fetch_metadata(self, server_or_peer, shuffle_id: int,
                        partition_id: int) -> MetadataResponse:
         req = MetadataRequest(self._next_req(), shuffle_id, partition_id)
-        conn = self.transport.connect(server.executor_id)
+        conn = self.transport.connect(self._peer_id(server_or_peer))
         txn = conn.request(encode_message(req)).wait()
         if txn.status is not TransactionStatus.SUCCESS:
             raise ConnectionError(f"metadata fetch failed: "
@@ -206,11 +216,12 @@ class ShuffleClient:
         assert isinstance(resp, MetadataResponse)
         return resp
 
-    def do_fetch(self, server: "ShuffleServer", shuffle_id: int,
+    def do_fetch(self, server_or_peer, shuffle_id: int,
                  partition_id: int) -> List[ShuffleBlockId]:
-        """Full fetch of one reduce partition from one peer; returns the
-        fetched block ids (frames land in self.received)."""
-        meta = self.fetch_metadata(server, shuffle_id, partition_id)
+        """Full fetch of one reduce partition from one peer (a local
+        ShuffleServer or a remote peer's executor id); returns the fetched
+        block ids (frames land in self.received)."""
+        meta = self.fetch_metadata(server_or_peer, shuffle_id, partition_id)
         if not meta.blocks:
             return []
         req_id = self._next_req()
@@ -219,9 +230,11 @@ class ShuffleClient:
         try:
             expected = sum(m.num_frames for m in meta.blocks)
             treq = TransferRequest(req_id,
-                                   tuple(m.block for m in meta.blocks))
-            server.note_reply_to(req_id, self.executor_id)
-            conn = self.transport.connect(server.executor_id)
+                                   tuple(m.block for m in meta.blocks),
+                                   reply_to=self.executor_id)
+            if not isinstance(server_or_peer, str):
+                server_or_peer.note_reply_to(req_id, self.executor_id)
+            conn = self.transport.connect(self._peer_id(server_or_peer))
             txn = conn.request(encode_message(treq)).wait()
             if txn.status is not TransactionStatus.SUCCESS:
                 raise ConnectionError(
@@ -230,11 +243,19 @@ class ShuffleClient:
             if not (isinstance(resp, TransferResponse) and resp.ok):
                 raise ConnectionError(
                     f"transfer rejected: {getattr(resp, 'detail', '?')}")
-            with self._lock:
-                got = self._pending[req_id]["frames"]
-            if got != expected:
-                raise ConnectionError(
-                    f"short transfer: {got}/{expected} frames")
+            # over a real transport the response races the data channel:
+            # frames may still be in flight when the ack lands
+            import time as _time
+            deadline = _time.monotonic() + self.data_timeout_s
+            while True:
+                with self._lock:
+                    got = self._pending[req_id]["frames"]
+                if got >= expected:
+                    break
+                if _time.monotonic() > deadline:
+                    raise ConnectionError(
+                        f"short transfer: {got}/{expected} frames")
+                _time.sleep(0.005)
             return [m.block for m in meta.blocks]
         finally:
             # error or success: release tracking + any partial chunks so a

@@ -91,12 +91,20 @@ class MetadataResponse:
 @_register(3)
 @dataclasses.dataclass(frozen=True)
 class TransferRequest:
-    """Start sending these blocks (reference: ShuffleTransferRequest.fbs)."""
+    """Start sending these blocks (reference: ShuffleTransferRequest.fbs).
+
+    ``reply_to``: the requesting executor's id — over a real transport the
+    server pushes data frames back by connecting to this peer (in-process
+    tests may leave it empty and use the server's note_reply_to side
+    channel instead)."""
     req_id: int
     blocks: Tuple[ShuffleBlockId, ...]
+    reply_to: str = ""
 
     def pack_body(self) -> bytes:
-        out = [struct.pack("<qi", self.req_id, len(self.blocks))]
+        rt = self.reply_to.encode()
+        out = [struct.pack("<qii", self.req_id, len(self.blocks), len(rt)),
+               rt]
         for b in self.blocks:
             out.append(struct.pack("<qqq", b.shuffle_id, b.map_id,
                                    b.partition_id))
@@ -104,14 +112,16 @@ class TransferRequest:
 
     @staticmethod
     def unpack_body(buf: memoryview) -> "TransferRequest":
-        req_id, n = struct.unpack_from("<qi", buf)
-        off = 12
+        req_id, n, rt_len = struct.unpack_from("<qii", buf)
+        off = 16
+        reply_to = bytes(buf[off:off + rt_len]).decode()
+        off += rt_len
         blocks = []
         for _ in range(n):
             s, m, p = struct.unpack_from("<qqq", buf, off)
             blocks.append(ShuffleBlockId(s, m, p))
             off += 24
-        return TransferRequest(req_id, tuple(blocks))
+        return TransferRequest(req_id, tuple(blocks), reply_to)
 
 
 @_register(4)

@@ -1,0 +1,252 @@
+"""TCP socket transport for the accelerated shuffle.
+
+Reference: the UCX transport (shuffle-plugin/.../UCX.scala:1119,
+UCXShuffleTransport.scala, UCXConnection.scala) — listeners, endpoints and
+active messages over RDMA.  The TPU build's cross-process data plane is
+DCN/TCP (ICI collectives cover the in-slice path, parallel/collective.py);
+this transport implements the same Transport/Connection SPI the
+client/server state machines already run against, over real sockets:
+
+- one listening endpoint per executor; every frame is
+  ``[type u8][tag u64][header u32-len][payload u32-len][header][payload]``
+- REQUEST frames dispatch to the registered server handler, the return
+  value travels back as a RESPONSE with the same tag
+- DATA frames dispatch to the registered client handler (the server pushes
+  them by connecting back to the requester's endpoint, resolved through
+  the peer table the heartbeat layer maintains)
+- a dead peer surfaces as ConnectionError on connect/request — the
+  fetch-failure signal the engine's retry layer consumes (reference:
+  lost UCX peers produce fetch failures -> Spark stage retry)
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from spark_rapids_tpu.shuffle.transport import (Connection, Transaction,
+                                                TransactionStatus, Transport)
+
+_REQ, _RESP, _DATA = 1, 2, 3
+_HDR = struct.Struct("<BQII")
+
+
+def _send_frame(sock: socket.socket, ftype: int, tag: int,
+                header: bytes, payload: bytes, lock: threading.Lock) -> None:
+    buf = _HDR.pack(ftype, tag, len(header), len(payload))
+    with lock:
+        sock.sendall(buf)
+        if header:
+            sock.sendall(header)
+        if payload:
+            sock.sendall(payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    out = bytearray()
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        out.extend(chunk)
+    return bytes(out)
+
+
+class _SocketConnection(Connection):
+    """Outbound channel to one peer (socket + response waiters)."""
+
+    def __init__(self, peer_executor_id: str, addr: Tuple[str, int],
+                 owner: "SocketTransport"):
+        super().__init__(peer_executor_id)
+        self._owner = owner
+        self._sock = socket.create_connection(addr, timeout=10)
+        self._sock.settimeout(None)
+        self._wlock = threading.Lock()
+        self._send_lock = threading.Lock()
+        self._waiters: Dict[int, Transaction] = {}
+        self._dead: Optional[str] = None
+        t = threading.Thread(target=self._reader, daemon=True,
+                             name=f"shuffle-conn-{peer_executor_id}")
+        t.start()
+
+    def _reader(self):
+        try:
+            while True:
+                raw = _recv_exact(self._sock, _HDR.size)
+                ftype, tag, hlen, plen = _HDR.unpack(raw)
+                header = _recv_exact(self._sock, hlen) if hlen else b""
+                payload = _recv_exact(self._sock, plen) if plen else b""
+                if ftype == _RESP:
+                    with self._wlock:
+                        txn = self._waiters.pop(tag, None)
+                    if txn is not None:
+                        txn.complete(TransactionStatus.SUCCESS,
+                                     response=header)
+                elif ftype == _DATA:
+                    # a peer may push data frames on this channel too
+                    self._owner._dispatch_data(header, payload)
+        except (ConnectionError, OSError) as e:
+            self._fail_all(str(e) or "connection lost")
+
+    def _fail_all(self, why: str):
+        self._dead = why
+        with self._wlock:
+            waiters, self._waiters = dict(self._waiters), {}
+        for txn in waiters.values():
+            txn.complete(TransactionStatus.ERROR, error=why)
+        self._owner._drop_connection(self.peer_executor_id, self)
+
+    def request(self, message: bytes,
+                cb: Optional[Callable] = None) -> Transaction:
+        txn = self._new_txn()
+        txn.start(cb)
+        if self._dead:
+            txn.complete(TransactionStatus.ERROR, error=self._dead)
+            return txn
+        with self._wlock:
+            self._waiters[txn.txn_id] = txn
+        try:
+            _send_frame(self._sock, _REQ, txn.txn_id, message, b"",
+                        self._send_lock)
+        except (ConnectionError, OSError) as e:
+            with self._wlock:
+                self._waiters.pop(txn.txn_id, None)
+            txn.complete(TransactionStatus.ERROR, error=str(e))
+        return txn
+
+    def send_data(self, header: bytes, payload: bytes,
+                  cb: Optional[Callable] = None) -> Transaction:
+        txn = self._new_txn()
+        txn.start(cb)
+        try:
+            _send_frame(self._sock, _DATA, txn.txn_id, header, payload,
+                        self._send_lock)
+            txn.complete(TransactionStatus.SUCCESS)
+        except (ConnectionError, OSError) as e:
+            txn.complete(TransactionStatus.ERROR, error=str(e))
+        return txn
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class SocketTransport(Transport):
+    """Listening endpoint + outbound connection table for one executor.
+
+    Handlers (a ShuffleServer for requests, a ShuffleClient for data) are
+    wired after construction; the peer table maps executor ids to
+    ``host:port`` endpoints and is fed by the heartbeat layer
+    (ExecutorInfo.endpoint carries the address, heartbeat.py)."""
+
+    def __init__(self, executor_id: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.executor_id = executor_id
+        self._server_handler = None
+        self._data_handler = None
+        self._peers: Dict[str, Tuple[str, int]] = {}
+        self._conns: Dict[str, _SocketConnection] = {}
+        self._lock = threading.Lock()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(16)
+        self.address: Tuple[str, int] = self._listener.getsockname()
+        self._closing = False
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name=f"shuffle-listen-{executor_id}")
+        t.start()
+
+    # -- wiring --------------------------------------------------------------
+    def set_handlers(self, server_handler, data_handler) -> None:
+        self._server_handler = server_handler
+        self._data_handler = data_handler
+
+    def update_peer(self, executor_id: str, host: str, port: int) -> None:
+        with self._lock:
+            self._peers[executor_id] = (host, port)
+            # a re-registered peer (restart) invalidates the old channel
+            stale = self._conns.pop(executor_id, None)
+        if stale is not None:
+            stale.close()
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.address[0]}:{self.address[1]}"
+
+    # -- inbound -------------------------------------------------------------
+    def _accept_loop(self):
+        while not self._closing:
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(sock,),
+                             daemon=True).start()
+
+    def _serve_conn(self, sock: socket.socket):
+        wlock = threading.Lock()
+        try:
+            while True:
+                raw = _recv_exact(sock, _HDR.size)
+                ftype, tag, hlen, plen = _HDR.unpack(raw)
+                header = _recv_exact(sock, hlen) if hlen else b""
+                payload = _recv_exact(sock, plen) if plen else b""
+                if ftype == _REQ:
+                    try:
+                        resp = self._server_handler.handle_request(header)
+                    except Exception as e:   # noqa: BLE001 - to the peer
+                        resp = b""
+                        # surface the failure by closing: the peer sees a
+                        # failed transaction
+                        raise ConnectionError(str(e))
+                    _send_frame(sock, _RESP, tag, resp, b"", wlock)
+                elif ftype == _DATA:
+                    self._dispatch_data(header, payload)
+        except (ConnectionError, OSError):
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _dispatch_data(self, header: bytes, payload: bytes):
+        self._data_handler.handle_data(header, payload)
+
+    # -- outbound ------------------------------------------------------------
+    def connect(self, peer_executor_id: str) -> Connection:
+        with self._lock:
+            conn = self._conns.get(peer_executor_id)
+            if conn is not None and conn._dead is None:
+                return conn
+            addr = self._peers.get(peer_executor_id)
+        if addr is None:
+            raise ConnectionError(f"unknown peer {peer_executor_id!r} "
+                                  "(not registered via heartbeat)")
+        try:
+            conn = _SocketConnection(peer_executor_id, addr, self)
+        except OSError as e:
+            raise ConnectionError(
+                f"cannot reach {peer_executor_id} at {addr}: {e}") from e
+        with self._lock:
+            self._conns[peer_executor_id] = conn
+        return conn
+
+    def _drop_connection(self, peer_executor_id: str, conn) -> None:
+        with self._lock:
+            if self._conns.get(peer_executor_id) is conn:
+                del self._conns[peer_executor_id]
+
+    def shutdown(self) -> None:
+        self._closing = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns, self._conns = list(self._conns.values()), {}
+        for c in conns:
+            c.close()

@@ -1,0 +1,14 @@
+"""SQL front-end: text -> logical AST -> physical plan over the engine.
+
+Reference parity: the reference accepts arbitrary Spark SQL because Spark
+parses/analyzes it and hands over physical plans (SQLPlugin.scala:28,
+GpuOverrides.scala:4562).  This engine is standalone, so it carries its own
+parser + analyzer for the TPC-DS-class dialect: SELECT with joins,
+GROUP BY/ROLLUP, HAVING, window functions, CTEs, set operations,
+scalar/IN/EXISTS subqueries (correlated ones decorrelated to joins),
+CASE, CAST, INTERVAL and date arithmetic.
+"""
+
+from spark_rapids_tpu.sql.parser import parse
+
+__all__ = ["parse"]

@@ -1,0 +1,202 @@
+"""SQL AST nodes (parser output, analyzer input).
+
+A deliberately small surface: everything TPC-DS-shaped, nothing more.
+Names follow Spark's logical-plan vocabulary where it helps orientation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple, Union
+
+
+# ---------------------------------------------------------------------------
+# expressions
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class SqlExpr:
+    pass
+
+
+@dataclasses.dataclass
+class Literal(SqlExpr):
+    value: object            # python int/float/str/bool/None/Decimal
+    kind: str = "auto"       # auto|string|number|null|bool|date|interval
+
+
+@dataclasses.dataclass
+class IntervalLit(SqlExpr):
+    value: int
+    unit: str                # day|month|year
+
+
+@dataclasses.dataclass
+class ColumnRef(SqlExpr):
+    name: str
+    qualifier: Optional[str] = None
+
+
+@dataclasses.dataclass
+class Star(SqlExpr):
+    qualifier: Optional[str] = None
+
+
+@dataclasses.dataclass
+class Alias(SqlExpr):
+    expr: SqlExpr
+    name: str
+
+
+@dataclasses.dataclass
+class BinaryOp(SqlExpr):
+    op: str                  # + - * / % || = <> < <= > >= and or
+    left: SqlExpr
+    right: SqlExpr
+
+
+@dataclasses.dataclass
+class UnaryOp(SqlExpr):
+    op: str                  # - + not
+    operand: SqlExpr
+
+
+@dataclasses.dataclass
+class IsNull(SqlExpr):
+    operand: SqlExpr
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class Between(SqlExpr):
+    operand: SqlExpr
+    low: SqlExpr
+    high: SqlExpr
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class InList(SqlExpr):
+    operand: SqlExpr
+    values: List[SqlExpr]
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class InSubquery(SqlExpr):
+    operand: SqlExpr
+    query: "Select"
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class Exists(SqlExpr):
+    query: "Select"
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class ScalarSubquery(SqlExpr):
+    query: "Select"
+
+
+@dataclasses.dataclass
+class Like(SqlExpr):
+    operand: SqlExpr
+    pattern: str
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class FuncCall(SqlExpr):
+    name: str
+    args: List[SqlExpr]
+    distinct: bool = False
+    star: bool = False       # count(*)
+    window: Optional["WindowDef"] = None
+
+
+@dataclasses.dataclass
+class Cast(SqlExpr):
+    expr: SqlExpr
+    type_name: str           # normalized lower-case, e.g. "decimal(15,2)"
+
+
+@dataclasses.dataclass
+class Case(SqlExpr):
+    operand: Optional[SqlExpr]          # CASE x WHEN ... vs CASE WHEN ...
+    branches: List[Tuple[SqlExpr, SqlExpr]]
+    otherwise: Optional[SqlExpr]
+
+
+@dataclasses.dataclass
+class WindowDef(SqlExpr):
+    partition_by: List[SqlExpr]
+    order_by: List["SortItem"]
+    # frame: (kind, start, end) with textual bounds; None = dialect default
+    frame: Optional[Tuple[str, str, str]] = None
+
+
+@dataclasses.dataclass
+class SortItem:
+    expr: SqlExpr
+    ascending: bool = True
+    nulls_first: Optional[bool] = None
+
+
+# ---------------------------------------------------------------------------
+# relations
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Relation:
+    pass
+
+
+@dataclasses.dataclass
+class TableRef(Relation):
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass
+class SubqueryRef(Relation):
+    query: "Select"
+    alias: str
+
+
+@dataclasses.dataclass
+class Join(Relation):
+    left: Relation
+    right: Relation
+    kind: str                # inner|left|right|full|cross
+    condition: Optional[SqlExpr] = None
+    using: Optional[List[str]] = None
+
+
+# ---------------------------------------------------------------------------
+# queries
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class GroupingSpec:
+    exprs: List[SqlExpr]
+    rollup: bool = False
+    cube: bool = False
+
+
+@dataclasses.dataclass
+class Select:
+    projections: List[SqlExpr]
+    relations: List[Relation]                  # comma-joined FROM items
+    where: Optional[SqlExpr] = None
+    group_by: Optional[GroupingSpec] = None
+    having: Optional[SqlExpr] = None
+    order_by: List[SortItem] = dataclasses.field(default_factory=list)
+    limit: Optional[int] = None
+    distinct: bool = False
+    ctes: List[Tuple[str, "Select"]] = dataclasses.field(default_factory=list)
+    # set operation chain: [(op, rhs_select)], op in
+    # union|union all|intersect|except
+    set_ops: List[Tuple[str, "Select"]] = dataclasses.field(
+        default_factory=list)

@@ -1,0 +1,133 @@
+"""SQL lexer: text -> token stream.
+
+Case-insensitive keywords, '--' line comments, /* */ block comments,
+single-quoted strings with '' escaping, double-quoted and backquoted
+identifiers, numeric literals (int/float/scientific), and multi-char
+operators (<= >= <> != ||).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class Token:
+    kind: str     # ident|number|string|op|kw|eof
+    value: str    # normalized: kw lower-cased; ident original case
+    pos: int
+
+    def __repr__(self):
+        return f"{self.kind}:{self.value}"
+
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "as", "and", "or", "not", "in", "exists", "between", "like", "is",
+    "null", "true", "false", "case", "when", "then", "else", "end", "cast",
+    "join", "inner", "left", "right", "full", "outer", "cross", "on",
+    "using", "union", "all", "intersect", "except", "distinct", "with",
+    "rollup", "cube", "grouping", "over", "partition", "rows", "range",
+    "unbounded", "preceding", "following", "current", "row", "asc", "desc",
+    "nulls", "first", "last", "interval", "date", "timestamp", "substr",
+    "substring", "extract", "escape", "any", "some",
+}
+
+_TWO_CHAR = {"<=", ">=", "<>", "!=", "||"}
+_ONE_CHAR = set("+-*/%(),.=<>")
+
+
+class LexError(ValueError):
+    pass
+
+
+def tokenize(text: str) -> List[Token]:
+    out: List[Token] = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c.isspace():
+            i += 1
+            continue
+        if text.startswith("--", i):
+            j = text.find("\n", i)
+            i = n if j < 0 else j + 1
+            continue
+        if text.startswith("/*", i):
+            j = text.find("*/", i + 2)
+            if j < 0:
+                raise LexError(f"unterminated comment at {i}")
+            i = j + 2
+            continue
+        if c == "'":
+            j = i + 1
+            buf = []
+            while True:
+                if j >= n:
+                    raise LexError(f"unterminated string at {i}")
+                if text[j] == "'":
+                    if j + 1 < n and text[j + 1] == "'":
+                        buf.append("'")
+                        j += 2
+                        continue
+                    break
+                buf.append(text[j])
+                j += 1
+            out.append(Token("string", "".join(buf), i))
+            i = j + 1
+            continue
+        if c in '"`':
+            j = text.find(c, i + 1)
+            if j < 0:
+                raise LexError(f"unterminated quoted identifier at {i}")
+            out.append(Token("ident", text[i + 1:j], i))
+            i = j + 1
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and text[i + 1].isdigit()):
+            j = i
+            seen_dot = False
+            seen_e = False
+            while j < n:
+                ch = text[j]
+                if ch.isdigit():
+                    j += 1
+                elif ch == "." and not seen_dot and not seen_e:
+                    seen_dot = True
+                    j += 1
+                elif ch in "eE" and not seen_e and j > i:
+                    if j + 1 < n and (text[j + 1].isdigit() or
+                                      text[j + 1] in "+-"):
+                        seen_e = True
+                        j += 2 if text[j + 1] in "+-" else 1
+                    else:
+                        break
+                else:
+                    break
+            out.append(Token("number", text[i:j], i))
+            i = j
+            continue
+        if c.isalpha() or c == "_":
+            j = i
+            while j < n and (text[j].isalnum() or text[j] == "_"):
+                j += 1
+            word = text[i:j]
+            low = word.lower()
+            if low in KEYWORDS:
+                out.append(Token("kw", low, i))
+            else:
+                out.append(Token("ident", word, i))
+            i = j
+            continue
+        two = text[i:i + 2]
+        if two in _TWO_CHAR:
+            out.append(Token("op", two, i))
+            i += 2
+            continue
+        if c in _ONE_CHAR:
+            out.append(Token("op", c, i))
+            i += 1
+            continue
+        raise LexError(f"unexpected character {c!r} at {i}")
+    out.append(Token("eof", "", n))
+    return out

@@ -1,0 +1,541 @@
+"""Recursive-descent SQL parser for the TPC-DS-class dialect.
+
+parse(text) -> ast.Select.  Grammar subset (see sql/__init__ docstring).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from spark_rapids_tpu.sql import ast as A
+from spark_rapids_tpu.sql.lexer import Token, tokenize
+
+
+class ParseError(ValueError):
+    pass
+
+
+class Parser:
+    def __init__(self, text: str):
+        self.text = text
+        self.toks = tokenize(text)
+        self.i = 0
+
+    # -- token helpers ------------------------------------------------------
+    def peek(self, k: int = 0) -> Token:
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def at_kw(self, *words: str) -> bool:
+        t = self.peek()
+        return t.kind == "kw" and t.value in words
+
+    def at_op(self, *ops: str) -> bool:
+        t = self.peek()
+        return t.kind == "op" and t.value in ops
+
+    def accept_kw(self, *words: str) -> Optional[str]:
+        if self.at_kw(*words):
+            return self.next().value
+        return None
+
+    def accept_op(self, *ops: str) -> Optional[str]:
+        if self.at_op(*ops):
+            return self.next().value
+        return None
+
+    def expect_kw(self, word: str) -> None:
+        if not self.accept_kw(word):
+            self.fail(f"expected {word.upper()}")
+
+    def expect_op(self, op: str) -> None:
+        if not self.accept_op(op):
+            self.fail(f"expected {op!r}")
+
+    def fail(self, msg: str):
+        t = self.peek()
+        ctx = self.text[max(0, t.pos - 30):t.pos + 30].replace("\n", " ")
+        raise ParseError(f"{msg} at offset {t.pos} (near ...{ctx}...), "
+                         f"got {t}")
+
+    # -- entry --------------------------------------------------------------
+    def parse(self) -> A.Select:
+        q = self.query()
+        if self.peek().kind != "eof":
+            self.fail("trailing input")
+        return q
+
+    def query(self) -> A.Select:
+        ctes: List[Tuple[str, A.Select]] = []
+        if self.accept_kw("with"):
+            while True:
+                name = self.ident()
+                self.expect_kw("as")
+                self.expect_op("(")
+                sub = self.query()
+                self.expect_op(")")
+                ctes.append((name, sub))
+                if not self.accept_op(","):
+                    break
+        q = self.select_core()
+        q.ctes = ctes
+        while self.at_kw("union", "intersect", "except"):
+            op = self.next().value
+            if op == "union" and self.accept_kw("all"):
+                op = "union all"
+            else:
+                self.accept_kw("distinct")
+            rhs = self.select_core()
+            q.set_ops.append((op, rhs))
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            q.order_by = self.sort_items()
+        if self.accept_kw("limit"):
+            t = self.next()
+            if t.kind != "number":
+                self.fail("expected LIMIT count")
+            q.limit = int(t.value)
+        return q
+
+    def select_core(self) -> A.Select:
+        self.expect_kw("select")
+        distinct = bool(self.accept_kw("distinct"))
+        self.accept_kw("all")
+        projections = [self.projection()]
+        while self.accept_op(","):
+            projections.append(self.projection())
+        relations: List[A.Relation] = []
+        if self.accept_kw("from"):
+            relations.append(self.relation())
+            while self.accept_op(","):
+                relations.append(self.relation())
+        where = None
+        if self.accept_kw("where"):
+            where = self.expr()
+        group_by = None
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            group_by = self.grouping_spec()
+        having = None
+        if self.accept_kw("having"):
+            having = self.expr()
+        return A.Select(projections=projections, relations=relations,
+                        where=where, group_by=group_by, having=having,
+                        distinct=distinct)
+
+    # -- projections / sorting ---------------------------------------------
+    def projection(self) -> A.SqlExpr:
+        if self.at_op("*"):
+            self.next()
+            return A.Star()
+        if self.peek().kind == "ident" and \
+                self.peek(1).kind == "op" and self.peek(1).value == "." and \
+                self.peek(2).kind == "op" and self.peek(2).value == "*":
+            q = self.next().value
+            self.next()
+            self.next()
+            return A.Star(qualifier=q)
+        e = self.expr()
+        if self.accept_kw("as"):
+            return A.Alias(e, self.ident())
+        if self.peek().kind == "ident":
+            return A.Alias(e, self.next().value)
+        return e
+
+    def sort_items(self) -> List[A.SortItem]:
+        items = [self.sort_item()]
+        while self.accept_op(","):
+            items.append(self.sort_item())
+        return items
+
+    def sort_item(self) -> A.SortItem:
+        e = self.expr()
+        asc = True
+        if self.accept_kw("desc"):
+            asc = False
+        else:
+            self.accept_kw("asc")
+        nulls_first = None
+        if self.accept_kw("nulls"):
+            if self.accept_kw("first"):
+                nulls_first = True
+            else:
+                self.expect_kw("last")
+                nulls_first = False
+        return A.SortItem(e, asc, nulls_first)
+
+    def grouping_spec(self) -> A.GroupingSpec:
+        if self.accept_kw("rollup"):
+            self.expect_op("(")
+            exprs = [self.expr()]
+            while self.accept_op(","):
+                exprs.append(self.expr())
+            self.expect_op(")")
+            return A.GroupingSpec(exprs, rollup=True)
+        if self.accept_kw("cube"):
+            self.expect_op("(")
+            exprs = [self.expr()]
+            while self.accept_op(","):
+                exprs.append(self.expr())
+            self.expect_op(")")
+            return A.GroupingSpec(exprs, cube=True)
+        exprs = [self.expr()]
+        while self.accept_op(","):
+            exprs.append(self.expr())
+        return A.GroupingSpec(exprs)
+
+    # -- relations ----------------------------------------------------------
+    def relation(self) -> A.Relation:
+        rel = self.relation_primary()
+        while True:
+            kind = None
+            if self.accept_kw("cross"):
+                self.expect_kw("join")
+                kind = "cross"
+            elif self.accept_kw("inner"):
+                self.expect_kw("join")
+                kind = "inner"
+            elif self.at_kw("left", "right", "full"):
+                kind = self.next().value
+                self.accept_kw("outer")
+                self.expect_kw("join")
+            elif self.accept_kw("join"):
+                kind = "inner"
+            else:
+                return rel
+            right = self.relation_primary()
+            cond = None
+            using = None
+            if kind != "cross":
+                if self.accept_kw("on"):
+                    cond = self.expr()
+                elif self.accept_kw("using"):
+                    self.expect_op("(")
+                    using = [self.ident()]
+                    while self.accept_op(","):
+                        using.append(self.ident())
+                    self.expect_op(")")
+            rel = A.Join(rel, right, kind, cond, using)
+
+    def relation_primary(self) -> A.Relation:
+        if self.accept_op("("):
+            if self.at_kw("select", "with"):
+                q = self.query()
+                self.expect_op(")")
+                self.accept_kw("as")
+                alias = self.ident()
+                return A.SubqueryRef(q, alias)
+            # parenthesized join tree
+            rel = self.relation()
+            self.expect_op(")")
+            return rel
+        name = self.ident()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.ident()
+        elif self.peek().kind == "ident":
+            alias = self.next().value
+        return A.TableRef(name, alias)
+
+    def ident(self) -> str:
+        t = self.peek()
+        if t.kind == "ident":
+            return self.next().value
+        # permit non-reserved keywords as identifiers where unambiguous
+        if t.kind == "kw" and t.value in ("date", "timestamp", "first",
+                                          "last", "row", "range", "rows"):
+            return self.next().value
+        self.fail("expected identifier")
+
+    # -- expressions ---------------------------------------------------------
+    def expr(self) -> A.SqlExpr:
+        return self.or_expr()
+
+    def or_expr(self) -> A.SqlExpr:
+        e = self.and_expr()
+        while self.accept_kw("or"):
+            e = A.BinaryOp("or", e, self.and_expr())
+        return e
+
+    def and_expr(self) -> A.SqlExpr:
+        e = self.not_expr()
+        while self.accept_kw("and"):
+            e = A.BinaryOp("and", e, self.not_expr())
+        return e
+
+    def not_expr(self) -> A.SqlExpr:
+        if self.accept_kw("not"):
+            return A.UnaryOp("not", self.not_expr())
+        return self.predicate()
+
+    def predicate(self) -> A.SqlExpr:
+        e = self.add_expr()
+        while True:
+            negated = False
+            if self.at_kw("not") and self.peek(1).kind == "kw" and \
+                    self.peek(1).value in ("in", "between", "like"):
+                self.next()
+                negated = True
+            if self.accept_kw("is"):
+                neg = bool(self.accept_kw("not"))
+                self.expect_kw("null")
+                e = A.IsNull(e, negated=neg)
+                continue
+            if self.accept_kw("between"):
+                low = self.add_expr()
+                self.expect_kw("and")
+                high = self.add_expr()
+                e = A.Between(e, low, high, negated=negated)
+                continue
+            if self.accept_kw("in"):
+                self.expect_op("(")
+                if self.at_kw("select", "with"):
+                    q = self.query()
+                    self.expect_op(")")
+                    e = A.InSubquery(e, q, negated=negated)
+                else:
+                    vals = [self.expr()]
+                    while self.accept_op(","):
+                        vals.append(self.expr())
+                    self.expect_op(")")
+                    e = A.InList(e, vals, negated=negated)
+                continue
+            if self.accept_kw("like"):
+                t = self.next()
+                if t.kind != "string":
+                    self.fail("expected LIKE pattern string")
+                if self.accept_kw("escape"):
+                    self.next()  # escape char (default \ semantics assumed)
+                e = A.Like(e, t.value, negated=negated)
+                continue
+            if negated:
+                self.fail("dangling NOT")
+            op = None
+            if self.at_op("=", "<>", "!=", "<", "<=", ">", ">="):
+                op = self.next().value
+                if op == "!=":
+                    op = "<>"
+            if op is None:
+                return e
+            rhs = self.add_expr()
+            e = A.BinaryOp(op, e, rhs)
+
+    def add_expr(self) -> A.SqlExpr:
+        e = self.mul_expr()
+        while True:
+            if self.at_op("+", "-"):
+                op = self.next().value
+            elif self.at_op("||"):
+                op = self.next().value
+            else:
+                return e
+            e = A.BinaryOp(op, e, self.mul_expr())
+
+    def mul_expr(self) -> A.SqlExpr:
+        e = self.unary_expr()
+        while self.at_op("*", "/", "%"):
+            op = self.next().value
+            e = A.BinaryOp(op, e, self.unary_expr())
+        return e
+
+    def unary_expr(self) -> A.SqlExpr:
+        if self.at_op("-"):
+            self.next()
+            return A.UnaryOp("-", self.unary_expr())
+        if self.at_op("+"):
+            self.next()
+            return self.unary_expr()
+        return self.primary_expr()
+
+    def primary_expr(self) -> A.SqlExpr:
+        t = self.peek()
+        if t.kind == "number":
+            self.next()
+            txt = t.value
+            if "." in txt or "e" in txt or "E" in txt:
+                return A.Literal(float(txt), "number")
+            return A.Literal(int(txt), "number")
+        if t.kind == "string":
+            self.next()
+            return A.Literal(t.value, "string")
+        if self.accept_kw("null"):
+            return A.Literal(None, "null")
+        if self.accept_kw("true"):
+            return A.Literal(True, "bool")
+        if self.accept_kw("false"):
+            return A.Literal(False, "bool")
+        if self.at_kw("date") and self.peek(1).kind == "string":
+            self.next()
+            return A.Literal(self.next().value, "date")
+        if self.at_kw("timestamp") and self.peek(1).kind == "string":
+            self.next()
+            return A.Literal(self.next().value, "timestamp")
+        if self.accept_kw("interval"):
+            tok = self.next()
+            if tok.kind == "string":
+                val = int(tok.value)
+            elif tok.kind == "number":
+                val = int(tok.value)
+            else:
+                self.fail("expected INTERVAL value")
+            unit_tok = self.next()
+            unit = unit_tok.value.lower().rstrip("s")
+            if unit not in ("day", "month", "year"):
+                self.fail(f"unsupported INTERVAL unit {unit}")
+            return A.IntervalLit(val, unit)
+        if self.accept_kw("cast"):
+            self.expect_op("(")
+            e = self.expr()
+            self.expect_kw("as")
+            ty = self.type_name()
+            self.expect_op(")")
+            return A.Cast(e, ty)
+        if self.accept_kw("case"):
+            return self.case_expr()
+        if self.accept_kw("exists"):
+            self.expect_op("(")
+            q = self.query()
+            self.expect_op(")")
+            return A.Exists(q)
+        if self.at_kw("substr", "substring"):
+            self.next()
+            self.expect_op("(")
+            args = [self.expr()]
+            # SUBSTRING(x FROM a FOR b) form
+            if self.accept_kw("from"):
+                args.append(self.expr())
+                if self.accept_kw("for"):
+                    args.append(self.expr())
+            else:
+                while self.accept_op(","):
+                    args.append(self.expr())
+            self.expect_op(")")
+            return A.FuncCall("substr", args)
+        if self.accept_kw("extract"):
+            self.expect_op("(")
+            field = self.ident().lower()
+            self.expect_kw("from")
+            e = self.expr()
+            self.expect_op(")")
+            return A.FuncCall(field, [e])
+        if self.accept_kw("grouping"):
+            self.expect_op("(")
+            e = self.expr()
+            self.expect_op(")")
+            return A.FuncCall("grouping", [e])
+        if self.accept_op("("):
+            if self.at_kw("select", "with"):
+                q = self.query()
+                self.expect_op(")")
+                return A.ScalarSubquery(q)
+            e = self.expr()
+            self.expect_op(")")
+            return e
+        if t.kind == "ident" or (t.kind == "kw" and t.value in
+                                 ("date", "first", "last")):
+            name = self.next().value
+            # function call?
+            if self.at_op("(") and not (t.kind == "kw" and t.value == "date"):
+                self.next()
+                distinct = bool(self.accept_kw("distinct"))
+                star = False
+                args: List[A.SqlExpr] = []
+                if self.at_op("*"):
+                    self.next()
+                    star = True
+                elif not self.at_op(")"):
+                    args.append(self.expr())
+                    while self.accept_op(","):
+                        args.append(self.expr())
+                self.expect_op(")")
+                win = None
+                if self.accept_kw("over"):
+                    win = self.window_def()
+                return A.FuncCall(name.lower(), args, distinct=distinct,
+                                  star=star, window=win)
+            if self.at_op(".") and self.peek(1).kind in ("ident", "kw"):
+                self.next()
+                col = self.ident()
+                return A.ColumnRef(col, qualifier=name)
+            return A.ColumnRef(name)
+        self.fail("expected expression")
+
+    def case_expr(self) -> A.SqlExpr:
+        operand = None
+        if not self.at_kw("when"):
+            operand = self.expr()
+        branches = []
+        while self.accept_kw("when"):
+            cond = self.expr()
+            self.expect_kw("then")
+            val = self.expr()
+            branches.append((cond, val))
+        otherwise = None
+        if self.accept_kw("else"):
+            otherwise = self.expr()
+        self.expect_kw("end")
+        if not branches:
+            self.fail("CASE without WHEN")
+        return A.Case(operand, branches, otherwise)
+
+    def window_def(self) -> A.WindowDef:
+        self.expect_op("(")
+        partition: List[A.SqlExpr] = []
+        order: List[A.SortItem] = []
+        frame = None
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            partition.append(self.expr())
+            while self.accept_op(","):
+                partition.append(self.expr())
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order = self.sort_items()
+        if self.at_kw("rows", "range"):
+            kind = self.next().value
+            self.expect_kw("between")
+            start = self.frame_bound()
+            self.expect_kw("and")
+            end = self.frame_bound()
+            frame = (kind, start, end)
+        self.expect_op(")")
+        return A.WindowDef(partition, order, frame)
+
+    def frame_bound(self) -> str:
+        if self.accept_kw("unbounded"):
+            if self.accept_kw("preceding"):
+                return "unbounded preceding"
+            self.expect_kw("following")
+            return "unbounded following"
+        if self.accept_kw("current"):
+            self.expect_kw("row")
+            return "current row"
+        t = self.next()
+        if t.kind != "number":
+            self.fail("expected frame bound")
+        if self.accept_kw("preceding"):
+            return f"{t.value} preceding"
+        self.expect_kw("following")
+        return f"{t.value} following"
+
+    def type_name(self) -> str:
+        t = self.next()
+        if t.kind not in ("ident", "kw"):
+            self.fail("expected type name")
+        name = t.value.lower()
+        if self.at_op("("):
+            self.next()
+            args = [self.next().value]
+            while self.accept_op(","):
+                args.append(self.next().value)
+            self.expect_op(")")
+            return f"{name}({','.join(args)})"
+        return name
+
+
+def parse(text: str) -> A.Select:
+    return Parser(text).parse()

@@ -1,0 +1,386 @@
+"""TPC-DS queries 1-10 (qualification parameters).
+
+Texts follow the official templates with the documented dialect
+adaptations: money literals cast as DOUBLE instead of DECIMAL(7,2)
+(datagen uses double money columns), subqueries always aliased, and
+set-operation branches unparenthesized — see testing/tpcds.py and
+docs/compatibility.md.
+"""
+
+QUERIES = {}
+
+QUERIES["q1"] = """
+with customer_total_return as
+ (select sr_customer_sk as ctr_customer_sk, sr_store_sk as ctr_store_sk,
+         sum(sr_return_amt) as ctr_total_return
+  from store_returns, date_dim
+  where sr_returned_date_sk = d_date_sk and d_year = 2000
+  group by sr_customer_sk, sr_store_sk)
+select c_customer_id
+from customer_total_return ctr1, store, customer
+where ctr1.ctr_total_return > (select avg(ctr_total_return) * 1.2
+                               from customer_total_return ctr2
+                               where ctr1.ctr_store_sk = ctr2.ctr_store_sk)
+  and s_store_sk = ctr1.ctr_store_sk
+  and s_state = 'TN'
+  and ctr1.ctr_customer_sk = c_customer_sk
+order by c_customer_id
+limit 100
+"""
+
+QUERIES["q2"] = """
+with wscs as
+ (select sold_date_sk, sales_price
+  from (select ws_sold_date_sk sold_date_sk, ws_ext_sales_price sales_price
+        from web_sales
+        union all
+        select cs_sold_date_sk sold_date_sk, cs_ext_sales_price sales_price
+        from catalog_sales) sc),
+ wswscs as
+ (select d_week_seq,
+         sum(case when (d_day_name = 'Sunday') then sales_price else null end) sun_sales,
+         sum(case when (d_day_name = 'Monday') then sales_price else null end) mon_sales,
+         sum(case when (d_day_name = 'Tuesday') then sales_price else null end) tue_sales,
+         sum(case when (d_day_name = 'Wednesday') then sales_price else null end) wed_sales,
+         sum(case when (d_day_name = 'Thursday') then sales_price else null end) thu_sales,
+         sum(case when (d_day_name = 'Friday') then sales_price else null end) fri_sales,
+         sum(case when (d_day_name = 'Saturday') then sales_price else null end) sat_sales
+  from wscs, date_dim
+  where d_date_sk = sold_date_sk
+  group by d_week_seq)
+select d_week_seq1,
+       round(sun_sales1 / sun_sales2, 2),
+       round(mon_sales1 / mon_sales2, 2),
+       round(tue_sales1 / tue_sales2, 2),
+       round(wed_sales1 / wed_sales2, 2),
+       round(thu_sales1 / thu_sales2, 2),
+       round(fri_sales1 / fri_sales2, 2),
+       round(sat_sales1 / sat_sales2, 2)
+from
+ (select wswscs.d_week_seq d_week_seq1, sun_sales sun_sales1,
+         mon_sales mon_sales1, tue_sales tue_sales1, wed_sales wed_sales1,
+         thu_sales thu_sales1, fri_sales fri_sales1, sat_sales sat_sales1
+  from wswscs, date_dim
+  where date_dim.d_week_seq = wswscs.d_week_seq and d_year = 1999) y,
+ (select wswscs.d_week_seq d_week_seq2, sun_sales sun_sales2,
+         mon_sales mon_sales2, tue_sales tue_sales2, wed_sales wed_sales2,
+         thu_sales thu_sales2, fri_sales fri_sales2, sat_sales sat_sales2
+  from wswscs, date_dim
+  where date_dim.d_week_seq = wswscs.d_week_seq and d_year = 1999 + 1) z
+where d_week_seq1 = d_week_seq2 - 53
+order by d_week_seq1
+"""
+
+QUERIES["q3"] = """
+select dt.d_year, item.i_brand_id brand_id, item.i_brand brand,
+       sum(ss_ext_sales_price) sum_agg
+from date_dim dt, store_sales, item
+where dt.d_date_sk = store_sales.ss_sold_date_sk
+  and store_sales.ss_item_sk = item.i_item_sk
+  and item.i_manufact_id = 128
+  and dt.d_moy = 11
+group by dt.d_year, item.i_brand_id, item.i_brand
+order by dt.d_year, sum_agg desc, brand_id
+limit 100
+"""
+
+QUERIES["q4"] = """
+with year_total as
+ (select c_customer_id customer_id, c_first_name customer_first_name,
+         c_last_name customer_last_name,
+         c_preferred_cust_flag customer_preferred_cust_flag,
+         c_birth_country customer_birth_country,
+         d_year dyear,
+         sum(((ss_ext_list_price - ss_ext_wholesale_cost
+               - ss_ext_discount_amt) + ss_ext_sales_price) / 2) year_total,
+         's' sale_type
+  from customer, store_sales, date_dim
+  where c_customer_sk = ss_customer_sk and ss_sold_date_sk = d_date_sk
+  group by c_customer_id, c_first_name, c_last_name,
+           c_preferred_cust_flag, c_birth_country, d_year
+  union all
+  select c_customer_id customer_id, c_first_name customer_first_name,
+         c_last_name customer_last_name,
+         c_preferred_cust_flag customer_preferred_cust_flag,
+         c_birth_country customer_birth_country,
+         d_year dyear,
+         sum((((cs_ext_list_price - cs_ext_wholesale_cost
+                - cs_ext_discount_amt) + cs_ext_sales_price) / 2)) year_total,
+         'c' sale_type
+  from customer, catalog_sales, date_dim
+  where c_customer_sk = cs_bill_customer_sk and cs_sold_date_sk = d_date_sk
+  group by c_customer_id, c_first_name, c_last_name,
+           c_preferred_cust_flag, c_birth_country, d_year
+  union all
+  select c_customer_id customer_id, c_first_name customer_first_name,
+         c_last_name customer_last_name,
+         c_preferred_cust_flag customer_preferred_cust_flag,
+         c_birth_country customer_birth_country,
+         d_year dyear,
+         sum((((ws_ext_list_price - ws_ext_wholesale_cost
+                - ws_ext_discount_amt) + ws_ext_sales_price) / 2)) year_total,
+         'w' sale_type
+  from customer, web_sales, date_dim
+  where c_customer_sk = ws_bill_customer_sk and ws_sold_date_sk = d_date_sk
+  group by c_customer_id, c_first_name, c_last_name,
+           c_preferred_cust_flag, c_birth_country, d_year)
+select t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+       t_s_secyear.customer_last_name,
+       t_s_secyear.customer_preferred_cust_flag
+from year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_c_firstyear, year_total t_c_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+where t_s_secyear.customer_id = t_s_firstyear.customer_id
+  and t_s_firstyear.customer_id = t_c_secyear.customer_id
+  and t_s_firstyear.customer_id = t_c_firstyear.customer_id
+  and t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  and t_s_firstyear.customer_id = t_w_secyear.customer_id
+  and t_s_firstyear.sale_type = 's'
+  and t_c_firstyear.sale_type = 'c'
+  and t_w_firstyear.sale_type = 'w'
+  and t_s_secyear.sale_type = 's'
+  and t_c_secyear.sale_type = 'c'
+  and t_w_secyear.sale_type = 'w'
+  and t_s_firstyear.dyear = 1999
+  and t_s_secyear.dyear = 1999 + 1
+  and t_c_firstyear.dyear = 1999
+  and t_c_secyear.dyear = 1999 + 1
+  and t_w_firstyear.dyear = 1999
+  and t_w_secyear.dyear = 1999 + 1
+  and t_s_firstyear.year_total > 0
+  and t_c_firstyear.year_total > 0
+  and t_w_firstyear.year_total > 0
+  and case when t_c_firstyear.year_total > 0
+           then t_c_secyear.year_total / t_c_firstyear.year_total
+           else null end
+      > case when t_s_firstyear.year_total > 0
+             then t_s_secyear.year_total / t_s_firstyear.year_total
+             else null end
+  and case when t_c_firstyear.year_total > 0
+           then t_c_secyear.year_total / t_c_firstyear.year_total
+           else null end
+      > case when t_w_firstyear.year_total > 0
+             then t_w_secyear.year_total / t_w_firstyear.year_total
+             else null end
+order by t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+         t_s_secyear.customer_last_name,
+         t_s_secyear.customer_preferred_cust_flag
+limit 100
+"""
+
+QUERIES["q5"] = """
+with ssr as
+ (select s_store_id, sum(sales_price) as sales, sum(profit) as profit,
+         sum(return_amt) as returns_amt, sum(net_loss) as profit_loss
+  from (select ss_store_sk as store_sk, ss_sold_date_sk as date_sk,
+               ss_ext_sales_price as sales_price, ss_net_profit as profit,
+               cast(0 as double) as return_amt, cast(0 as double) as net_loss
+        from store_sales
+        union all
+        select sr_store_sk as store_sk, sr_returned_date_sk as date_sk,
+               cast(0 as double) as sales_price, cast(0 as double) as profit,
+               sr_return_amt as return_amt, sr_net_loss as net_loss
+        from store_returns) salesreturns, date_dim, store
+  where date_sk = d_date_sk
+    and d_date between cast('2000-08-23' as date)
+                   and (cast('2000-08-23' as date) + interval 14 day)
+    and store_sk = s_store_sk
+  group by s_store_id),
+ csr as
+ (select cp_catalog_page_id, sum(sales_price) as sales,
+         sum(profit) as profit, sum(return_amt) as returns_amt,
+         sum(net_loss) as profit_loss
+  from (select cs_catalog_page_sk as page_sk, cs_sold_date_sk as date_sk,
+               cs_ext_sales_price as sales_price, cs_net_profit as profit,
+               cast(0 as double) as return_amt, cast(0 as double) as net_loss
+        from catalog_sales
+        union all
+        select cr_catalog_page_sk as page_sk,
+               cr_returned_date_sk as date_sk,
+               cast(0 as double) as sales_price, cast(0 as double) as profit,
+               cr_return_amount as return_amt, cr_net_loss as net_loss
+        from catalog_returns) salesreturns, date_dim, catalog_page
+  where date_sk = d_date_sk
+    and d_date between cast('2000-08-23' as date)
+                   and (cast('2000-08-23' as date) + interval 14 day)
+    and page_sk = cp_catalog_page_sk
+  group by cp_catalog_page_id),
+ wsr as
+ (select web_site_id, sum(sales_price) as sales, sum(profit) as profit,
+         sum(return_amt) as returns_amt, sum(net_loss) as profit_loss
+  from (select ws_web_site_sk as wsr_web_site_sk,
+               ws_sold_date_sk as date_sk,
+               ws_ext_sales_price as sales_price, ws_net_profit as profit,
+               cast(0 as double) as return_amt, cast(0 as double) as net_loss
+        from web_sales
+        union all
+        select ws.ws_web_site_sk as wsr_web_site_sk,
+               wr_returned_date_sk as date_sk,
+               cast(0 as double) as sales_price, cast(0 as double) as profit,
+               wr_return_amt as return_amt, wr_net_loss as net_loss
+        from web_returns wr left outer join web_sales ws
+             on wr.wr_web_page_sk = ws.ws_web_page_sk) salesreturns,
+       date_dim, web_site
+  where date_sk = d_date_sk
+    and d_date between cast('2000-08-23' as date)
+                   and (cast('2000-08-23' as date) + interval 14 day)
+    and wsr_web_site_sk = web_site_sk
+  group by web_site_id)
+select channel, id, sum(sales) as sales, sum(returns_amt) as returns_amt,
+       sum(profit) as profit
+from (select 'store channel' as channel, 'store' || s_store_id as id,
+             sales, returns_amt, profit - profit_loss as profit
+      from ssr
+      union all
+      select 'catalog channel' as channel,
+             'catalog_page' || cp_catalog_page_id as id,
+             sales, returns_amt, profit - profit_loss as profit
+      from csr
+      union all
+      select 'web channel' as channel, 'web_site' || web_site_id as id,
+             sales, returns_amt, profit - profit_loss as profit
+      from wsr) x
+group by rollup (channel, id)
+order by channel, id
+limit 100
+"""
+
+QUERIES["q6"] = """
+select a.ca_state state, count(*) cnt
+from customer_address a, customer c, store_sales s, date_dim d, item i
+where a.ca_address_sk = c.c_current_addr_sk
+  and c.c_customer_sk = s.ss_customer_sk
+  and s.ss_sold_date_sk = d.d_date_sk
+  and s.ss_item_sk = i.i_item_sk
+  and d.d_month_seq = (select distinct d_month_seq from date_dim
+                       where d_year = 2001 and d_moy = 1)
+  and i.i_current_price > 1.2 * (select avg(j.i_current_price) from item j
+                                 where j.i_category = i.i_category)
+group by a.ca_state
+having count(*) >= 10
+order by cnt, a.ca_state
+limit 100
+"""
+
+QUERIES["q7"] = """
+select i_item_id, avg(ss_quantity) agg1, avg(ss_list_price) agg2,
+       avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
+from store_sales, customer_demographics, date_dim, item, promotion
+where ss_sold_date_sk = d_date_sk
+  and ss_item_sk = i_item_sk
+  and ss_cdemo_sk = cd_demo_sk
+  and ss_promo_sk = p_promo_sk
+  and cd_gender = 'M'
+  and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and (p_channel_email = 'N' or p_channel_event = 'N')
+  and d_year = 2000
+group by i_item_id
+order by i_item_id
+limit 100
+"""
+
+QUERIES["q8"] = """
+select s_store_name, sum(ss_net_profit)
+from store_sales, date_dim, store,
+     (select ca_zip from
+       (select substr(ca_zip, 1, 5) ca_zip from customer_address
+        where substr(ca_zip, 1, 5) in
+          ('24128','76232','65084','87816','83926','77556','20548','26231',
+           '43848','15126','91137','61265','98294','25782','17920','18426',
+           '98235','40081','84093','28577','55565','17183','54601','67897',
+           '22752','86284','18376','38607','45200','21756','29741','96765',
+           '23932','89360','29839','25989','28898','91068','72550','10390',
+           '18845','47770','82636','41367','76638','86198','81312','37126',
+           '39192','88424','72175','81426','53672','10445','42666','66864',
+           '66708','41248','48583','82276','18842','78890','49448','14089',
+           '38122','34425','79077','19849','43285','39861','66162','77610',
+           '13695','99543','83444','83041','12305','57665','68341','25003',
+           '57834','62878','49130','81096','18840','27700','23470','50412',
+           '21195','16021','76107','71954','68309','18119','98359','64544',
+           '10336','86379','27068','39736','98569','28915','24206','56529',
+           '57647','54917','42961','91110','63981','14922','36420','23006',
+           '67467','32754','30903','20260','31671','51373','33998','71137',
+           '30984','84387','28246','18030','60576','19849','40429','30389')
+        intersect
+        select ca_zip from
+          (select substr(ca_zip, 1, 5) ca_zip, count(*) cnt
+           from customer_address, customer
+           where ca_address_sk = c_current_addr_sk
+             and c_preferred_cust_flag = 'Y'
+           group by ca_zip
+           having count(*) > 2) a1) a2) v1
+where ss_store_sk = s_store_sk
+  and ss_sold_date_sk = d_date_sk
+  and d_qoy = 2 and d_year = 1998
+  and substr(s_zip, 1, 2) = substr(v1.ca_zip, 1, 2)
+group by s_store_name
+order by s_store_name
+limit 100
+"""
+
+QUERIES["q9"] = """
+select case when (select count(*) from store_sales
+                  where ss_quantity between 1 and 20) > 3000
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 1 and 20)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 1 and 20) end bucket1,
+       case when (select count(*) from store_sales
+                  where ss_quantity between 21 and 40) > 2000
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 21 and 40)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 21 and 40) end bucket2,
+       case when (select count(*) from store_sales
+                  where ss_quantity between 41 and 60) > 1500
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 41 and 60)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 41 and 60) end bucket3,
+       case when (select count(*) from store_sales
+                  where ss_quantity between 61 and 80) > 1000
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 61 and 80)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 61 and 80) end bucket4,
+       case when (select count(*) from store_sales
+                  where ss_quantity between 81 and 100) > 500
+            then (select avg(ss_ext_discount_amt) from store_sales
+                  where ss_quantity between 81 and 100)
+            else (select avg(ss_net_paid) from store_sales
+                  where ss_quantity between 81 and 100) end bucket5
+from reason
+where r_reason_sk = 1
+"""
+
+QUERIES["q10"] = """
+select cd_gender, cd_marital_status, cd_education_status, count(*) cnt1,
+       cd_purchase_estimate, count(*) cnt2, cd_credit_rating, count(*) cnt3,
+       cd_dep_count, count(*) cnt4, cd_dep_employed_count, count(*) cnt5,
+       cd_dep_college_count, count(*) cnt6
+from customer c, customer_address ca, customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+  and ca_county in ('Rush County', 'Toole County', 'Jefferson County',
+                    'Dona Ana County', 'La Porte County')
+  and cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select * from store_sales, date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk
+                and d_year = 2000 and d_moy between 1 and 4)
+  and (exists (select * from web_sales, date_dim
+               where c.c_customer_sk = ws_bill_customer_sk
+                 and ws_sold_date_sk = d_date_sk
+                 and d_year = 2000 and d_moy between 1 and 4)
+       or exists (select * from catalog_sales, date_dim
+                  where c.c_customer_sk = cs_ship_customer_sk
+                    and cs_sold_date_sk = d_date_sk
+                    and d_year = 2000 and d_moy between 1 and 4))
+group by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+order by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+limit 100
+"""

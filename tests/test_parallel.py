@@ -114,3 +114,80 @@ def test_distributed_group_by_matches_local(ctx):
     assert set(got) == set(exp)
     for k in exp:
         assert abs(got[k] - exp[k]) < 1e-9, (k, got[k], exp[k])
+
+
+# ---------------------------------------------------------------------------
+# engine-driven mesh execution: real plans, not primitives (VERDICT r1 #2)
+# ---------------------------------------------------------------------------
+
+def _mesh_session_query(query_fn):
+    """Runs query_fn twice — CPU oracle, then TPU engine with the 8-device
+    mesh active (the exchange lowers to the collective) — and compares."""
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.parallel.mesh import set_active_mesh
+    from spark_rapids_tpu.session import TpuSession
+    cpu = TpuSession(TpuConf({"spark.rapids.sql.enabled": "false"}),
+                     init_device=False)
+    expect = sorted(map(str, query_fn(cpu).collect()))
+    ctx = data_mesh(8)
+    set_active_mesh(ctx)
+    try:
+        tpu = TpuSession(TpuConf({"spark.rapids.sql.enabled": "true",
+                                  "spark.rapids.sql.test.enabled": "false"}))
+        df = query_fn(tpu)
+        from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+        from spark_rapids_tpu.plan.overrides import TpuOverrides
+        final = TpuOverrides(tpu.conf).apply(df._plan)
+        exchanges = [n for n in final.collect_nodes()
+                     if isinstance(n, TpuShuffleExchangeExec)]
+        assert exchanges, f"no device exchange:\n{final.tree_string()}"
+        # execute THE inspected plan so the assertion sees its state
+        batch = final.collect_host()
+        names = list(batch.to_pydict().keys())
+        got = sorted(str(dict(zip(names, row)))
+                     for row in zip(*batch.to_pydict().values()))
+        # the exchange must actually have taken the collective path
+        assert any(x._collective is not None for x in exchanges), \
+            "exchange did not lower to the mesh collective"
+    finally:
+        set_active_mesh(None)
+    assert got == expect
+
+
+def test_engine_groupby_runs_distributed():
+    rng = np.random.default_rng(9)
+    data = {"k": rng.integers(0, 40, 2000).astype(np.int64),
+            "v": np.round(rng.standard_normal(2000), 3)}
+
+    def q(s):
+        from spark_rapids_tpu import functions as F
+        df = s.create_dataframe(data, num_partitions=8)
+        return df.group_by("k").agg(F.sum("v").alias("sv"),
+                                    F.count("*").alias("c"))
+    _mesh_session_query(q)
+
+
+def test_engine_join_runs_distributed():
+    rng = np.random.default_rng(10)
+    left = {"k": rng.integers(0, 50, 1500).astype(np.int64),
+            "v": np.round(rng.standard_normal(1500), 3)}
+    right = {"k": np.arange(0, 50, dtype=np.int64),
+             "name": np.array([f"n{i}" for i in range(50)], dtype=object)}
+
+    def q(s):
+        l = s.create_dataframe(left, num_partitions=8)
+        r = s.create_dataframe(right, num_partitions=8)
+        return l.join(r, on="k", how="inner")
+    _mesh_session_query(q)
+
+
+def test_engine_sql_runs_distributed():
+    rng = np.random.default_rng(12)
+    data = {"k": rng.integers(0, 30, 1600).astype(np.int64),
+            "w": rng.integers(-10, 10, 1600).astype(np.int32)}
+
+    def q(s):
+        s.create_or_replace_temp_view(
+            "t", s.create_dataframe(data, num_partitions=8))
+        return s.sql("select k, count(*) c from t where w > 0 group by k")
+    _mesh_session_query(q)
