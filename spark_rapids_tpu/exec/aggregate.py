@@ -144,6 +144,32 @@ class CpuHashAggregateExec(UnaryExec):
         import pyarrow.compute as pc
         aggs = []
         post = []  # names in output, spec order
+        # Spark NaN semantics for float min/max: NaN is larger than any
+        # value, so max -> NaN when any NaN present, min skips NaN unless
+        # the group is all-NaN.  Arrow's kernels skip NaN entirely, so NaN
+        # is masked to null and tracked via a companion any(is_nan) agg.
+        nan_patch = {}       # spec index -> nanflag result name
+        extra_aggs = []
+        added_cols = set()
+        for idx, (col_name, kind, cvo) in enumerate(specs):
+            if kind in ("min", "max") and \
+                    pa.types.is_floating(table.column(col_name).type):
+                nn, nanflag = f"{col_name}__nn", f"{col_name}__nan"
+                if nn not in added_cols:
+                    src = table.column(col_name)
+                    isnan = pc.is_nan(src)
+                    table = table.append_column(
+                        nn, pc.if_else(pc.fill_null(isnan, False),
+                                       pa.scalar(None, src.type), src))
+                    table = table.append_column(
+                        nanflag, pc.fill_null(isnan, False))
+                    added_cols.add(nn)
+                    extra_aggs.append(
+                        (nanflag, "any",
+                         pc.ScalarAggregateOptions(skip_nulls=True,
+                                                   min_count=0)))
+                specs[idx] = (nn, kind, cvo)
+                nan_patch[idx] = f"{nanflag}_any"
         for col_name, kind, cvo in specs:
             if kind == "count":
                 opt = pc.CountOptions(mode="only_valid" if cvo else "all")
@@ -163,23 +189,35 @@ class CpuHashAggregateExec(UnaryExec):
                 aggs.append((col_name, kind, None))
             else:
                 raise ValueError(kind)
+        all_aggs = aggs + extra_aggs
         if key_names:
             gb = table.group_by(key_names, use_threads=False)
-            res = gb.aggregate(aggs)
-        elif any(a[1] in ("list", "distinct") for a in aggs):
+            res = gb.aggregate(all_aggs)
+        elif any(a[1] in ("list", "distinct") for a in all_aggs):
             # scalar aggregation has no hash_list kernel: group by a
             # constant key instead, then ignore it
             const = pa.array([0] * table.num_rows, type=pa.int8())
-            res = table.append_column("__g", const)                 .group_by(["__g"], use_threads=False).aggregate(aggs)
+            res = table.append_column("__g", const)                 .group_by(["__g"], use_threads=False).aggregate(all_aggs)
         else:
             # reduction: aggregate to one row
-            res = table.group_by([], use_threads=False).aggregate(aggs)
+            res = table.group_by([], use_threads=False).aggregate(all_aggs)
         # output order: aggregate cols are named f"{col}_{fn}"; build in
         # spec order (duplicate (col, fn) pairs collapse to one output col)
         out_cols, out_names = [], []
-        for (col_name, kind, cvo), (src, fn, _o) in zip(specs, aggs):
+        for idx, ((col_name, kind, cvo), (src, fn, _o)) in \
+                enumerate(zip(specs, aggs)):
             res_name = f"{src}_{fn}"
-            out_cols.append(res.column(res_name))
+            c = res.column(res_name)
+            if idx in nan_patch:
+                anyn = pc.fill_null(res.column(nan_patch[idx]), False)
+                nanval = pa.scalar(float("nan"), type=T.to_arrow(
+                    T.DOUBLE) if pa.types.is_float64(
+                        table.column(src).type) else pa.float32())
+                if kind == "max":
+                    c = pc.if_else(anyn, nanval, c)
+                else:
+                    c = pc.if_else(pc.and_(anyn, pc.is_null(c)), nanval, c)
+            out_cols.append(c)
             out_names.append(res_name)
         keys = [res.column(k) for k in key_names]
         return keys, out_cols, res.num_rows

@@ -84,7 +84,12 @@ def _normalize_float_bits(data, xp, double: bool):
         d = data.astype(np.float64)
         d = xp.where(d == 0.0, 0.0, d)          # -0.0 -> 0.0
         d = xp.where(xp.isnan(d), np.float64("nan"), d)  # canonical NaN
-        return _bitcast(d, np.int64, xp)
+        if xp is np:
+            return _bitcast(d, np.int64, xp)
+        # device: f64_ieee_bits picks the exact bitcast where supported
+        # and the arithmetic dd reconstruction on TPU (no f64 bitcast)
+        from spark_rapids_tpu.ops.f64bits import f64_ieee_bits
+        return f64_ieee_bits(d, xp)
     f = data.astype(np.float32)
     f = xp.where(f == 0.0, np.float32(0.0), f)
     f = xp.where(xp.isnan(f), np.float32("nan"), f)

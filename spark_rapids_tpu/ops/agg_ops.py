@@ -67,11 +67,28 @@ def _segment_reduce(kind: str, x, valid, seg, inrow, bucket, jnp,
         return jax.ops.segment_sum(z, seg, num_segments=bucket), any_valid
     if kind in ("min", "max"):
         if jnp.issubdtype(x.dtype, jnp.inexact):
+            # Spark: NaN > every double.  min skips NaN (unless the group
+            # is all-NaN); max yields NaN when any present.  Explicit, not
+            # left to backend NaN propagation (XLA CPU and TPU differ).
             ident = jnp.asarray(np.inf if kind == "min" else -np.inf, x.dtype)
-        else:
-            info = jnp.iinfo(x.dtype)
-            ident = jnp.asarray(info.max if kind == "min" else info.min,
-                                x.dtype)
+            nanrow = present & jnp.isnan(x)
+            z = jnp.where(present & ~jnp.isnan(x), x, ident)
+            f = jax.ops.segment_min if kind == "min" else jax.ops.segment_max
+            red = f(z, seg, num_segments=bucket)
+            has_nan = jax.ops.segment_max(nanrow.astype(np.int32), seg,
+                                          num_segments=bucket) > 0
+            if kind == "max":
+                red = jnp.where(has_nan, jnp.asarray(np.nan, x.dtype), red)
+            else:
+                has_num = jax.ops.segment_max(
+                    (present & ~jnp.isnan(x)).astype(np.int32), seg,
+                    num_segments=bucket) > 0
+                red = jnp.where(has_nan & ~has_num,
+                                jnp.asarray(np.nan, x.dtype), red)
+            return red, any_valid
+        info = jnp.iinfo(x.dtype)
+        ident = jnp.asarray(info.max if kind == "min" else info.min,
+                            x.dtype)
         z = jnp.where(present, x, ident)
         f = jax.ops.segment_min if kind == "min" else jax.ops.segment_max
         return f(z, seg, num_segments=bucket), any_valid
@@ -139,11 +156,22 @@ def _global_reduce(kind: str, x, valid, inrow, jnp, count_valid_only=True):
         return jnp.sum(jnp.where(present, x, jnp.zeros_like(x))), any_valid
     if kind in ("min", "max"):
         if jnp.issubdtype(x.dtype, jnp.inexact):
+            # Spark NaN-greatest semantics, explicit (see _segment_reduce)
             ident = jnp.asarray(np.inf if kind == "min" else -np.inf, x.dtype)
-        else:
-            info = jnp.iinfo(x.dtype)
-            ident = jnp.asarray(info.max if kind == "min" else info.min,
-                                x.dtype)
+            nanrow = present & jnp.isnan(x)
+            z = jnp.where(present & ~jnp.isnan(x), x, ident)
+            red = jnp.min(z) if kind == "min" else jnp.max(z)
+            has_nan = jnp.any(nanrow)
+            if kind == "max":
+                red = jnp.where(has_nan, jnp.asarray(np.nan, x.dtype), red)
+            else:
+                has_num = jnp.any(present & ~jnp.isnan(x))
+                red = jnp.where(has_nan & ~has_num,
+                                jnp.asarray(np.nan, x.dtype), red)
+            return red, any_valid
+        info = jnp.iinfo(x.dtype)
+        ident = jnp.asarray(info.max if kind == "min" else info.min,
+                            x.dtype)
         z = jnp.where(present, x, ident)
         return (jnp.min(z) if kind == "min" else jnp.max(z)), any_valid
     if kind in ("first", "last", "first_valid", "last_valid"):

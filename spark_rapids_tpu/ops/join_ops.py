@@ -87,6 +87,9 @@ def _n_value_words(col: DeviceColumn) -> int:
         return max(1, -(-w // 7))
     if isinstance(dt, T.DecimalType) and dt.is_decimal128:
         return 2
+    if isinstance(dt, T.DoubleType):
+        from spark_rapids_tpu.ops.f64bits import f64_word_count
+        return f64_word_count()   # 1 exact u64 (CPU) / 2 dd u32s (TPU)
     return 1
 
 

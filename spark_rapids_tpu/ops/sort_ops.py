@@ -59,16 +59,13 @@ class SortOrder:
 
 
 def _float_sortable(x, jnp, ubits_dtype):
-    import jax
-    # canonicalize: -0.0 -> 0.0, NaN -> positive canonical NaN
-    zero = jnp.asarray(0, dtype=x.dtype)
-    x = jnp.where(x == zero, zero, x)         # collapses -0.0 (NaN != 0 safe)
-    x = jnp.where(jnp.isnan(x), jnp.asarray(np.nan, dtype=x.dtype), x)
-    u = jax.lax.bitcast_convert_type(x, ubits_dtype)
-    sign = np.dtype(ubits_dtype).type(1) << np.dtype(ubits_dtype).type(
-        np.dtype(ubits_dtype).itemsize * 8 - 1)
-    allbits = ~np.dtype(ubits_dtype).type(0)
-    return jnp.where((u & sign) != 0, u ^ allbits, u | sign)
+    # f32: one u32 word; f64: TWO u32 words via double-double split —
+    # the TPU X64 rewriter has no f64 bitcast (see ops/f64bits.py)
+    from spark_rapids_tpu.ops.f64bits import (f32_sortable_u32,
+                                              f64_sortable_words)
+    if np.dtype(ubits_dtype).itemsize == 8:
+        return f64_sortable_words(x, jnp)
+    return [f32_sortable_u32(x, jnp)]
 
 
 def _string_words(col: DeviceColumn, jnp) -> List:
@@ -106,9 +103,9 @@ def sortable_words(col: DeviceColumn, jnp) -> List:
         lo = jax.lax.bitcast_convert_type(col.data[:, 1], np.uint64)
         return [hi, lo]
     if isinstance(dt, T.FloatType):
-        return [_float_sortable(col.data, jnp, np.uint32)]
+        return _float_sortable(col.data, jnp, np.uint32)
     if isinstance(dt, T.DoubleType):
-        return [_float_sortable(col.data, jnp, np.uint64)]
+        return _float_sortable(col.data, jnp, np.uint64)
     if isinstance(dt, T.BooleanType):
         return [col.data.astype(np.int8)]
     # integral / date / timestamp / decimal64: native integer order
@@ -121,6 +118,78 @@ def _order_words(col: DeviceColumn, order: SortOrder, jnp) -> List:
     rank_val = np.int8(1 if order.nulls_first else 0)
     words = [jnp.where(col.validity, rank_val, rank_null)]
     for w in sortable_words(col, jnp):
+        if not order.ascending:
+            w = ~w
+        words.append(w)
+    return words
+
+
+# ---------------------------------------------------------------------------
+# numpy twin (CPU oracle paths, e.g. RangePartitioning.partition_ids_cpu):
+# same normalization semantics, classic host-side bit tricks
+# ---------------------------------------------------------------------------
+
+def _float_sortable_np(x: np.ndarray) -> np.ndarray:
+    x = np.where(x == 0, np.zeros((), dtype=x.dtype), x)
+    x = np.where(np.isnan(x), np.array(np.nan, dtype=x.dtype), x)
+    ub = np.uint64 if x.dtype == np.float64 else np.uint32
+    u = np.ascontiguousarray(x).view(ub)
+    nbits = np.dtype(ub).itemsize * 8
+    sign = ub(1) << ub(nbits - 1)
+    allbits = ~ub(0)
+    return np.where((u & sign) != 0, u ^ allbits, u | sign)
+
+
+def _string_words_np(chars: np.ndarray, lens: np.ndarray) -> List[np.ndarray]:
+    w = chars.shape[1] if chars.ndim == 2 else 0
+    if w == 0:
+        return [np.zeros(chars.shape[0], dtype=np.uint64)]
+    pos = np.arange(w, dtype=np.int32)
+    vals = np.where(pos[None, :] < lens[:, None],
+                    chars.astype(np.uint64) + 1, np.uint64(0))
+    words = []
+    for start in range(0, w, 7):
+        chunk = vals[:, start:start + 7]
+        word = np.zeros(chars.shape[0], dtype=np.uint64)
+        for j in range(chunk.shape[1]):
+            word = word | (chunk[:, j] << np.uint64(9 * (6 - j)))
+        words.append(word)
+    return words
+
+
+def host_order_words(col, order: SortOrder,
+                     string_width: Optional[int] = None,
+                     string_pair=None) -> List[np.ndarray]:
+    """Numpy order words for one HostColumn: [null-rank] + value words in
+    the same SQL order as the device path.  ``string_width`` pads string
+    rectangles so two batches (rows vs range bounds) agree on word count;
+    ``string_pair`` reuses an already-rectangularized (chars, lens) so
+    callers that probed the width don't pay the ragged->rect scatter twice."""
+    dt = col.data_type
+    valid = col.validity_np()
+    rank_null = np.int8(0 if order.nulls_first else 1)
+    rank_val = np.int8(1 if order.nulls_first else 0)
+    words: List[np.ndarray] = [np.where(valid, rank_val, rank_null)]
+    if isinstance(dt, (T.StringType, T.BinaryType)):
+        if string_pair is not None:
+            chars, lens = string_pair
+            if string_width and chars.shape[1] < string_width:
+                chars = np.pad(chars,
+                               ((0, 0), (0, string_width - chars.shape[1])))
+        else:
+            chars, lens = col.string_np(max_len=string_width)
+        vw = _string_words_np(chars, lens)
+    elif isinstance(dt, T.DecimalType) and dt.is_decimal128:
+        raw = col.data_np()
+        vw = [raw[:, 0], np.ascontiguousarray(raw[:, 1]).view(np.uint64)]
+    elif isinstance(dt, (T.FloatType, T.DoubleType)):
+        vw = [_float_sortable_np(col.data_np())]
+    elif isinstance(dt, T.BooleanType):
+        vw = [col.data_np().astype(np.int8)]
+    else:
+        vw = [col.data_np()]
+    for w in vw:
+        w = np.where(valid, w, np.zeros((), dtype=w.dtype))
         if not order.ascending:
             w = ~w
         words.append(w)

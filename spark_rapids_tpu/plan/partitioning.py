@@ -209,11 +209,47 @@ class RangePartitioning(Partitioning):
                          self.num_partitions).astype(np.int32)
 
     def partition_ids_cpu(self, batch):
-        # reuse the device logic on a CPU jax backend-free path: numpy words
-        from spark_rapids_tpu.columnar.column import _jnp
-        jnp = _jnp()
-        dev = batch.to_device()
-        return np.asarray(self.partition_ids_tpu(dev))[:batch.row_count]
+        # genuinely host-side: numpy twin of the device word normalization
+        # (the CPU oracle must never touch the accelerator)
+        from spark_rapids_tpu.ops.sort_ops import host_order_words
+        assert self.bounds is not None, "bounds not computed"
+        n = batch.row_count
+        if self.bounds.row_count == 0:
+            return np.zeros(n, dtype=np.int32)
+        keys = self._key_batch_cpu(batch)
+        # agree on string rectangle widths across rows and bounds; keep the
+        # probed rectangles so the scatter isn't done twice per column
+        widths, kpairs, bpairs = [], [], []
+        for kc, bc in zip(keys.columns, self.bounds.columns):
+            if isinstance(kc.data_type, (T.StringType, T.BinaryType)):
+                kp, bp = kc.string_np(), bc.string_np()
+                widths.append(max(kp[0].shape[1], bp[0].shape[1], 1))
+                kpairs.append(kp)
+                bpairs.append(bp)
+            else:
+                widths.append(None)
+                kpairs.append(None)
+                bpairs.append(None)
+        row_words: List[np.ndarray] = []
+        bound_words: List[np.ndarray] = []
+        for i, s in enumerate(self.specs):
+            from spark_rapids_tpu.ops.sort_ops import SortOrder
+            o = SortOrder(i, s.ascending, s.effective_nulls_first)
+            row_words.extend(host_order_words(keys.columns[i], o, widths[i],
+                                              kpairs[i]))
+            bound_words.extend(
+                host_order_words(self.bounds.columns[i], o, widths[i],
+                                 bpairs[i]))
+        pid = np.zeros(n, dtype=np.int32)
+        for j in range(self.bounds.row_count):
+            gt = np.zeros(n, dtype=bool)
+            eq = np.ones(n, dtype=bool)
+            for rw, bw in zip(row_words, bound_words):
+                bj = bw[j]
+                gt = gt | (eq & (rw > bj))
+                eq = eq & (rw == bj)
+            pid += gt.astype(np.int32)
+        return pid
 
     def desc(self):
         ks = ", ".join(s.expr.sql() for s in self.specs)

@@ -1253,6 +1253,12 @@ class Analyzer:
         if name == "rpad":
             return ST.RPad(args[0], args[1], args[2] if len(args) > 2
                            else lit(" "))
+        if name == "hash":
+            from spark_rapids_tpu.expressions.hashing import Murmur3Hash
+            return Murmur3Hash(*args)
+        if name == "xxhash64":
+            from spark_rapids_tpu.expressions.hashing import XxHash64
+            return XxHash64(*args)
         raise AnalysisError(f"unknown function {name}")
 
     def _window_call(self, e: A.FuncCall, rec) -> Expression:
