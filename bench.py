@@ -89,16 +89,38 @@ def main():
         return 1
 
     rows_per_sec = n_rows / best_tpu
+    # honest device efficiency: effective bytes/s vs HBM bandwidth (v5e
+    # ~819 GB/s; override for other chips).  The pipeline reads each row
+    # once, so bytes/s ~ input traffic; hbm_frac near 0 = dispatch-bound.
+    hbm_bw = float(os.environ.get("BENCH_HBM_GBPS", 819)) * 1e9
+    bytes_per_sec = n_rows * row_bytes / best_tpu
     out = {
         "metric": "filter_project_hash_agg_rows_per_sec",
         "value": round(rows_per_sec),
         "unit": "rows/s",
         "vs_baseline": round(best_cpu / best_tpu, 3),
-        "bytes_per_sec": round(n_rows * row_bytes / best_tpu),
+        "bytes_per_sec": round(bytes_per_sec),
+        "hbm_frac": round(bytes_per_sec / hbm_bw, 5),
         "tpu_s": round(best_tpu, 4),
         "cpu_s": round(best_cpu, 4),
         "results_match": True,
     }
+
+    if os.environ.get("BENCH_SKIP_SCALING", "") != "1":
+        # row-count scaling curve: dispatch-bound shows flat time (rising
+        # rows/s); bandwidth-bound shows flat rows/s
+        curve = {}
+        for cn in (1_000_000, 2_000_000, 4_000_000, n_rows):
+            if cn > n_rows:
+                continue
+            cdata = {k: v[:cn] for k, v in data.items()}
+            ctable = tpu.create_dataframe(cdata, num_partitions=parts)
+            _query(ctable).collect()
+            t0 = time.perf_counter()
+            _query(ctable).collect()
+            dt = time.perf_counter() - t0
+            curve[str(cn)] = round(cn / dt)
+        out["scaling_rows_per_sec"] = curve
 
     if os.environ.get("BENCH_SKIP_TPCDS", "") != "1":
         try:
@@ -112,8 +134,13 @@ def main():
 
 def _tpcds_phase(tpu, cpu):
     """BASELINE.md milestone #2: TPC-DS q1-q10 wall clock, TPU vs the CPU
-    engine, geomean speedup (per-query differential-checked)."""
+    engine, geomean speedup.  Per-query oracle: row-LEVEL deep compare
+    (sorted, float-tolerant — the same comparator the pytest differential
+    tier uses), never just a count; an empty result set on both engines is
+    flagged, not counted as a pass (reference:
+    integration_tests/src/main/python/asserts.py:579)."""
     import math
+    from spark_rapids_tpu.testing.rowcompare import rows_equal
     from spark_rapids_tpu.testing.tpcds import register_tables
     from spark_rapids_tpu.testing.tpcds_queries import QUERIES
     sf = float(os.environ.get("BENCH_TPCDS_SF", 1.0))
@@ -131,17 +158,24 @@ def _tpcds_phase(tpu, cpu):
         t0 = time.perf_counter()
         c_rows = cpu.sql(sql).collect()
         t_cpu = time.perf_counter() - t0
-        match = len(t_rows) == len(c_rows)
+        diff = rows_equal(c_rows, t_rows, check_order=False,
+                          approx_float=True)
+        match = diff is None
         per_query[qname] = {"tpu_s": round(t_tpu, 4),
                             "cpu_s": round(t_cpu, 4),
                             "speedup": round(t_cpu / t_tpu, 3),
                             "rows": len(t_rows),
                             "match": match}
-        if match:
+        if not match:
+            per_query[qname]["diff"] = diff[:160]
+        if len(t_rows) == 0:
+            per_query[qname]["empty"] = True   # vacuous: flag loudly
+        if match and t_rows:
             speedups.append(t_cpu / t_tpu)
     geomean = math.exp(sum(math.log(s) for s in speedups) /
                        len(speedups)) if speedups else 0.0
     return {"sf": sf, "geomean_speedup": round(geomean, 3),
+            "queries_counted": len(speedups),
             "queries": per_query}
 
 

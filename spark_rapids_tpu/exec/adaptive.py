@@ -56,7 +56,15 @@ def _partition_sizes(exchange) -> List[int]:
     for p in range(exchange.num_partitions):
         total = 0
         for b in exchange._store[p]:
-            total += b.nbytes() if hasattr(b, "nbytes") else 0
+            # sized_nbytes: logical rows * row width.  The device-resident
+            # DEFAULT shuffle store keeps full bucket-padded planes per
+            # reduce partition, so physical nbytes() would report ~the
+            # whole map output for EVERY partition — coalesce would never
+            # merge and skew detection would see uniform huge partitions.
+            if hasattr(b, "sized_nbytes"):
+                total += b.sized_nbytes()
+            elif hasattr(b, "nbytes"):
+                total += b.nbytes()
         sizes.append(total)
     return sizes
 
@@ -88,7 +96,8 @@ def skew_split_specs(exchange, pidx: int,
     start = 0
     acc = 0
     for i, b in enumerate(batches):
-        sz = b.nbytes() if hasattr(b, "nbytes") else 0
+        sz = b.sized_nbytes() if hasattr(b, "sized_nbytes") else \
+            (b.nbytes() if hasattr(b, "nbytes") else 0)
         if i > start and acc + sz > target_bytes:
             specs.append(PartialPartitionSpec(pidx, start, i))
             start, acc = i, 0

@@ -328,6 +328,15 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
                                                     shrink_batch)
         from spark_rapids_tpu.columnar.column import _jnp, rc_traceable
         jnp = _jnp()
+        # HBM guard: the device-resident store keeps one full-bucket
+        # compacted copy of every map batch PER reduce partition (~n x
+        # input bytes).  When that estimate crosses the free-HBM budget,
+        # fall back to the host-staged path automatically instead of
+        # OOMing the device (DEFAULT is the default mode; users shouldn't
+        # need to know to flip spark.rapids.shuffle.mode=MULTITHREADED).
+        budget = self._device_store_budget()
+        stored_estimate = 0
+        host_staging = False
         for mp in range(self.child.num_partitions):
             p_eff = part
             if isinstance(part, RoundRobinPartitioning):
@@ -336,12 +345,65 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
                 # cap the n-fold storage cost: drop padding before the
                 # per-partition compacts
                 b = shrink_batch(b)
+                if not host_staging:
+                    stored_estimate += b.nbytes() * n
+                    if budget is not None and stored_estimate > budget:
+                        # auto-fallback: the rest of the map output goes
+                        # through the host-staged writer; batches already
+                        # compacted stay on device (they fit the budget)
+                        # and execute_partition handles the mixed store
+                        import logging
+                        logging.getLogger(__name__).info(
+                            "device shuffle store would exceed HBM budget "
+                            "(%d > %d bytes); host-staging the remainder",
+                            stored_estimate, budget)
+                        host_staging = True
+                if host_staging:
+                    for p, hb in self._slice_host_pairs(b, p_eff, n):
+                        store[p].append(hb)
+                    continue
                 pids = p_eff.partition_ids_tpu(b)
                 rowpos = jnp.arange(b.bucket)
                 inrow = rowpos < rc_traceable(b.row_count)
                 for p in range(n):
                     store[p].append(compact_batch(b, (pids == p) & inrow))
         self._store = store
+
+    def _device_store_budget(self):
+        """Bytes the device-resident shuffle store may occupy: half the
+        remaining device pool, or None when no runtime is initialized
+        (tests that drive execs directly)."""
+        from spark_rapids_tpu.memory.device_manager import get_runtime
+        rt = get_runtime()
+        if rt is None:
+            return None
+        cat = rt.catalog
+        free = max(0, cat.device_limit - cat.device_bytes)
+        return free // 2
+
+    def _slice_host_pairs(self, b, part, n):
+        """One device batch -> (pid, host slice) pairs via the device
+        sort-by-pid writer (the _map_pairs core, batch-wise)."""
+        from spark_rapids_tpu.columnar.column import DeviceColumn, _jnp
+        from spark_rapids_tpu.ops.batch_ops import gather_batch
+        from spark_rapids_tpu.ops.sort_ops import SortOrder, sort_permutation
+        jnp = _jnp()
+        pids = part.partition_ids_tpu(b)
+        pid_col = DeviceColumn(pids.astype(np.int64),
+                               jnp.ones(b.bucket, dtype=bool),
+                               b.row_count, None)
+        aug = ColumnarBatch([pid_col] + list(b.columns), b.row_count)
+        perm = sort_permutation(aug, [SortOrder(0, True, True)])
+        shuffled = gather_batch(b, perm, b.row_count)
+        counts = np.asarray(jnp.bincount(
+            jnp.clip(pids, 0, n), length=n + 1))[:n]
+        hb = shuffled.to_host()
+        hb.names = b.names
+        off = 0
+        for p in range(n):
+            if counts[p]:
+                yield p, hb.slice(off, int(counts[p]))
+            off += int(counts[p])
 
     def execute_partition(self, pidx):
         self._materialize()

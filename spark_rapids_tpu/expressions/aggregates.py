@@ -38,6 +38,8 @@ class BufferSpec:
 
 
 class AggregateFunction(Expression):
+    foldable = False   # never constant-fold aggregation/window context
+
     """Base; children are the raw input expressions."""
 
     is_aggregate = True

@@ -78,6 +78,10 @@ class EvalContext:
 class Expression:
     """Base expression node."""
 
+    #: False for expressions that must not be constant-folded even over
+    #: all-literal children (non-deterministic, context-dependent)
+    foldable: bool = True
+
     def __init__(self, children: Sequence["Expression"] = ()):
         self.children: List[Expression] = list(children)
 
@@ -387,6 +391,31 @@ def bind_references(expr: Expression, schema: T.StructType) -> Expression:
             # child is resolved (the vars are shared leaf instances)
             node._sync_var_types()
         return node
+
+    return expr.transform_up(fix)
+
+
+def fold_constants(expr: Expression) -> Expression:
+    """Evaluates deterministic all-literal subtrees once on the host and
+    replaces them with Literals (Spark's ConstantFolding logical rule).
+
+    First-order device win: ``cast('2000-08-23' as date)`` inside a filter
+    otherwise drags the whole operator to host because string->date casts
+    are host-only; folded to a DATE literal the comparison stays on device.
+    """
+    from spark_rapids_tpu.expressions.evaluator import tcol_to_host_column
+
+    def fix(n: Expression) -> Expression:
+        if (isinstance(n, (Literal, Alias)) or not n.children or
+                not n.foldable or
+                not all(isinstance(c, Literal) for c in n.children)):
+            return n
+        try:
+            tc = n.eval_cpu(EvalContext([], "cpu", 1))
+            v = tcol_to_host_column(tc, 1).arrow[0].as_py()
+            return Literal(v, n.data_type)
+        except Exception:
+            return n   # not evaluable standalone; leave for runtime
 
     return expr.transform_up(fix)
 

@@ -264,7 +264,14 @@ def _cast_py_value(v, src: T.DataType, dst: T.DataType):
         return int(v) // _DAY_MICROS if isinstance(v, (int, np.integer)) \
             else v.date()
     if isinstance(src, T.DateType) and isinstance(dst, T.TimestampType):
-        return int(v) * _DAY_MICROS if isinstance(v, (int, np.integer)) else v
+        if isinstance(v, (int, np.integer)):
+            return int(v) * _DAY_MICROS
+        if isinstance(v, datetime.datetime):
+            return v
+        # datetime.date -> midnight UTC; returning the date unchanged would
+        # materialize as DAYS inside a micros-typed timestamp column
+        return datetime.datetime(v.year, v.month, v.day,
+                                 tzinfo=datetime.timezone.utc)
     if src.is_numeric and isinstance(dst, T.TimestampType):
         return int(float(v) * _SECONDS_TO_MICROS)
     if isinstance(src, T.TimestampType) and dst.is_numeric:

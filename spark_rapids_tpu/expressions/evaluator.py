@@ -90,6 +90,14 @@ def tcol_to_host_column(tc: TCol, row_count: int) -> HostColumn:
         return HostColumn(pa.array([_pyify(v, dt)] * row_count,
                                    type=T.to_arrow(dt)), dt)
     valid = np.asarray(tc.valid)
+    if valid.ndim == 0:
+        # all-literal expression trees keep scalar (0-d) planes through
+        # binary kernels; broadcast to the logical row count
+        valid = np.full(row_count, bool(valid))
+    if not (isinstance(dt, (T.StringType, T.BinaryType)) or dt.is_nested):
+        d = np.asarray(tc.data)
+        if d.ndim == 0:
+            tc = TCol(np.full(row_count, d[()]), valid, dt)
     if isinstance(dt, (T.StringType, T.BinaryType)) or dt.is_nested:
         vals = [tc.data[i] if valid[i] else None for i in range(row_count)]
         return HostColumn(pa.array(vals, type=T.to_arrow(dt)), dt)

@@ -91,6 +91,8 @@ class WindowSpecDef:
 
 
 class WindowExpression(Expression):
+    foldable = False   # never constant-fold aggregation/window context
+
     """function OVER spec — the planner extracts these from projections and
     lowers each spec group to one WindowExec (reference: Spark's
     ExtractWindowExpressions + GpuWindowExecMeta).
@@ -127,6 +129,8 @@ class WindowExpression(Expression):
 
 
 class WindowFunction(Expression):
+    foldable = False   # never constant-fold aggregation/window context
+
     """Ranking/offset functions valid only inside a window spec."""
 
     is_window_function = True

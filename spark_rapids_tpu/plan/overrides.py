@@ -335,7 +335,11 @@ class TpuOverrides:
         conf = self.conf
         if not skip_pruning and conf.get(C.COLUMN_PRUNING_ENABLED.key, True):
             from spark_rapids_tpu.plan.pruning import prune_columns
-            plan = prune_columns(plan)
+            # test mode turns a pruning failure into an error instead of a
+            # silent unpruned fallback (VERDICT r2: the q1/q3/q4/q7/q8
+            # KeyErrors hid behind the warning for a whole round)
+            plan = prune_columns(plan,
+                                 strict=conf.get(C.TEST_ENABLED.key, False))
         if not conf.is_sql_enabled:
             return plan
         meta = PlanMeta(plan, conf)

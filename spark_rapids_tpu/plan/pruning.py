@@ -119,6 +119,16 @@ class _Pruner:
         if isinstance(node, AG.CpuHashAggregateExec) and \
                 type(node) is AG.CpuHashAggregateExec:
             layout = node.layout
+            if node.mode not in (AG.PARTIAL, AG.COMPLETE):
+                # FINAL/merge mode consumes the positional BUFFER schema of
+                # its child (keys ++ agg buffers) — the layout's func/
+                # grouping ordinals are bound against the pre-partial raw
+                # input, a different schema space, so remapping them with
+                # the child's map would corrupt them (and every buffer
+                # column is required anyway).  Recurse keeping all child
+                # columns; pruning continues below the exchange.
+                child, _ = self.prune(node.child, None)
+                return node.with_children([child]), _identity(ncols)
             child_req = set()
             for e in layout.grouping:
                 _refs(e, child_req)
@@ -225,17 +235,22 @@ def prune_scan(scan: Exec, indices: List[int]) -> Optional[Exec]:
     return fn(indices)
 
 
-def prune_columns(plan: Exec, required: Optional[Set[int]] = None) -> Exec:
+def prune_columns(plan: Exec, required: Optional[Set[int]] = None,
+                  strict: bool = False) -> Exec:
     """Entry point: prunes unused columns below the root.
 
     ``required=None`` keeps the root's full output; an explicit set narrows
-    it (count() passes an empty set: only row counts survive).
+    it (count() passes an empty set: only row counts survive).  ``strict``
+    (test mode) re-raises instead of silently executing unpruned — a
+    pruning crash is a modeling bug, not an acceptable steady state.
     """
     import logging
     try:
         new, _ = _Pruner().prune(plan, required)
         return new
     except Exception:
+        if strict:
+            raise
         # pruning is an optimization; never let it break planning
         logging.getLogger(__name__).warning(
             "column pruning failed; executing unpruned plan", exc_info=True)

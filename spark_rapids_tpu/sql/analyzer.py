@@ -402,6 +402,14 @@ class Analyzer:
                 continue
             owners = [i for i, (_p, s) in enumerate(rels)
                       if self._resolves(c, s)]
+            if len(owners) > 1 and _column_refs(c):
+                # Spark raises AMBIGUOUS_REFERENCE here; silently filtering
+                # only the first relation would produce wrong results
+                refs = ", ".join(r.name for r in _column_refs(c))
+                raise AnalysisError(
+                    f"ambiguous column reference in predicate "
+                    f"{c!r} (columns [{refs}] resolve in "
+                    f"{len(owners)} FROM relations); qualify the columns")
             if owners:
                 pushed.setdefault(owners[0], []).append(c)
                 continue
@@ -523,16 +531,27 @@ class Analyzer:
             df = DataFrame(plan, self.session)
             plan = df.distinct()._plan
 
+        # INTERSECT binds tighter than UNION/EXCEPT (SQL standard; Spark/
+        # Catalyst precedence): group each INTERSECT with its preceding
+        # term first, then fold UNION/EXCEPT left-to-right
+        groups = [(None, plan)]
         for op, rhs in q.set_ops:
             rplan, _ = self._select(rhs, env, outer=None)
+            if op == "intersect":
+                prev_op, prev = groups[-1]
+                merged = DataFrame(prev, self.session).intersect(
+                    DataFrame(rplan, self.session))._plan
+                groups[-1] = (prev_op, merged)
+            else:
+                groups.append((op, rplan))
+        plan = groups[0][1]
+        for op, rplan in groups[1:]:
             df = DataFrame(plan, self.session)
             rdf = DataFrame(rplan, self.session)
             if op == "union all":
                 plan = df.union(rdf)._plan
             elif op == "union":
                 plan = df.union(rdf).distinct()._plan
-            elif op == "intersect":
-                plan = df.intersect(rdf)._plan
             else:
                 plan = df.except_distinct(rdf)._plan
 
@@ -1040,7 +1059,8 @@ class Analyzer:
 
     # -- expression translation ----------------------------------------------
     def _expr(self, e: A.SqlExpr, scope: Scope) -> Expression:
-        return self._expr_generic(e, None, scope)
+        from spark_rapids_tpu.expressions.base import fold_constants
+        return fold_constants(self._expr_generic(e, None, scope))
 
     def _expr_sq(self, e: A.SqlExpr, plan, scope: Scope, env) -> Expression:
         """Expression that may contain uncorrelated scalar subqueries."""
@@ -1054,7 +1074,8 @@ class Analyzer:
                 k = list(rows[0].keys())[0]
                 return lit(rows[0][k])
             return None
-        return self._expr_generic(e, lower, scope)
+        from spark_rapids_tpu.expressions.base import fold_constants
+        return fold_constants(self._expr_generic(e, lower, scope))
 
     def _expr_generic(self, e: A.SqlExpr, leaf_hook, scope: Optional[Scope]
                       ) -> Expression:
@@ -1093,10 +1114,13 @@ class Analyzer:
             return PR.IsNotNull(x) if e.negated else PR.IsNull(x)
         if isinstance(e, A.Between):
             x = rec(e.operand)
-            lo = rec(e.low)
-            hi = rec(e.high)
-            inside = PR.And(PR.GreaterThanOrEqual(x, lo),
-                            PR.LessThanOrEqual(x, hi))
+            # coerce each bound like a standalone comparison would —
+            # timestamp BETWEEN date-typed bounds must not compare
+            # micros against day numbers
+            x1, lo = self._coerce_pair(x, rec(e.low))
+            x2, hi = self._coerce_pair(x, rec(e.high))
+            inside = PR.And(PR.GreaterThanOrEqual(x1, lo),
+                            PR.LessThanOrEqual(x2, hi))
             return PR.Not(inside) if e.negated else inside
         if isinstance(e, A.InList):
             x = rec(e.operand)

@@ -53,6 +53,18 @@ _EDU = np.array(["Primary", "Secondary", "College", "2 yr Degree",
 _DAYS = np.array(["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
                   "Friday", "Saturday"])
 
+# zip pool overlapping the literal IN-lists the queries carry (q8 names 120
+# specific 5-digit zips and then requires >2 preferred customers per zip —
+# uniform 5-digit zips make its result empty at every realistic SF); half
+# the pool comes from q8's list, half is filler, all reused heavily so the
+# HAVING count(*) > 2 clause can fire
+_ZIP_POOL = np.array([
+    "24128", "76232", "65084", "87816", "83926", "77556", "20548", "26231",
+    "43848", "15126", "91137", "61265", "98294", "25782", "17920", "18426",
+    "98235", "40081", "84093", "28577", "55565", "17183", "54601", "67897",
+    "30411", "12345", "55901", "77001", "94105", "60601", "30301", "73301",
+    "85001", "19101", "48201", "63101", "37201", "40201", "23220", "29201"])
+
 
 def _money(rng, n, lo=0.5, hi=300.0):
     return np.round(rng.uniform(lo, hi, n), 2)
@@ -101,8 +113,9 @@ def generate_tables(sf: float = 0.01, seed: int = 20) -> Dict[str, dict]:
                                   "cally", "ation", "eing", "n st", "bar",
                                   "ought2", "able2"][:ns], dtype=object),
         "s_state": rng.choice(_STATES[:4], ns).astype(object),
-        "s_zip": np.array([f"{rng.integers(10000, 99999)}" for _ in
-                           range(ns)], dtype=object),
+        # store zips from the same pool as customer addresses so q8's
+        # substr(s_zip,1,2) = substr(ca_zip,1,2) prefix join has matches
+        "s_zip": rng.choice(_ZIP_POOL, ns).astype(object),
         "s_gmt_offset": np.full(ns, -5.0),
     }
     nw = n["web_site"]
@@ -168,8 +181,7 @@ def generate_tables(sf: float = 0.01, seed: int = 20) -> Dict[str, dict]:
     t["customer_address"] = {
         "ca_address_sk": np.arange(1, nca + 1, dtype=np.int64),
         "ca_state": rng.choice(_STATES, nca).astype(object),
-        "ca_zip": np.array([f"{z:05d}" for z in
-                            rng.integers(10000, 99999, nca)], dtype=object),
+        "ca_zip": rng.choice(_ZIP_POOL, nca).astype(object),
         "ca_county": rng.choice(_COUNTIES, nca).astype(object),
         "ca_country": np.full(nca, "United States", dtype=object),
         "ca_gmt_offset": rng.choice(np.array([-5.0, -6.0, -7.0]), nca),
@@ -207,7 +219,11 @@ def generate_tables(sf: float = 0.01, seed: int = 20) -> Dict[str, dict]:
                             dtype=object),
         "i_brand_id": (1_000_000 + rng.integers(1, 1000, ni)).astype(
             np.int64),
-        "i_manufact_id": rng.integers(1, 250, ni),
+        # deterministic cycle so the constants queries name (q3:
+        # i_manufact_id = 128) are guaranteed present once ni >= 250 and
+        # carry ~ni/250 items each — uniform random leaves them absent at
+        # small scale and the differential oracle goes vacuous (0 == 0)
+        "i_manufact_id": (np.arange(ni) % 250 + 1).astype(np.int64),
         "i_category_id": rng.integers(1, 11, ni),
         "i_manager_id": rng.integers(1, 100, ni),
     }

@@ -25,33 +25,13 @@ def tpu_session(extra=None) -> TpuSession:
     return TpuSession(TpuConf(conf))
 
 
-def _val_eq(a, b, approx):
-    if a is None or b is None:
-        return a is None and b is None
-    if isinstance(a, float) and isinstance(b, float):
-        if math.isnan(a) or math.isnan(b):
-            return math.isnan(a) and math.isnan(b)
-        if approx:
-            return a == b or abs(a - b) <= max(1e-9, 1e-6 * max(abs(a), abs(b)))
-        return a == b
-    return a == b
+from spark_rapids_tpu.testing.rowcompare import rows_equal, val_eq as _val_eq
 
 
 def _compare_rows(expected_rows, actual_rows, check_order, approx_float,
                   labels=("expected", "actual")):
-    assert len(expected_rows) == len(actual_rows), \
-        (f"row count differs: {labels[0]}={len(expected_rows)} "
-         f"{labels[1]}={len(actual_rows)}")
-    if not check_order:
-        keyfn = lambda r: tuple(str(v) for v in r.values())
-        expected_rows = sorted(expected_rows, key=keyfn)
-        actual_rows = sorted(actual_rows, key=keyfn)
-    for i, (er, ar) in enumerate(zip(expected_rows, actual_rows)):
-        assert er.keys() == ar.keys(), f"row {i}: columns differ"
-        for k in er:
-            assert _val_eq(er[k], ar[k], approx_float), \
-                (f"row {i} col {k!r}: {labels[0]}={er[k]!r} "
-                 f"{labels[1]}={ar[k]!r}")
+    diff = rows_equal(expected_rows, actual_rows, check_order, approx_float)
+    assert diff is None, f"({labels[0]} vs {labels[1]}) {diff}"
 
 
 def assert_tpu_and_cpu_are_equal_collect(df_fn, ignore_order=False,
