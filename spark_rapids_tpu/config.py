@@ -188,6 +188,14 @@ CONCURRENT_TPU_TASKS = conf_int(
     "reference 'spark.rapids.sql.concurrentGpuTasks', RapidsConf.scala:544).",
     2)
 
+TASK_PARALLELISM = conf_int(
+    "spark.rapids.tpu.taskParallelism",
+    "Task threads driving plan partitions concurrently (the executor-cores "
+    "analog: host I/O and shuffle ser/deser overlap device dispatch, with "
+    "device admission still bounded by concurrentGpuTasks). 0 = auto "
+    "(min(4, cpu_count)); 1 = serial.",
+    0)
+
 ROW_BUCKET_MIN = conf_int(
     "spark.rapids.tpu.batch.rowBucketMin",
     "Minimum padded row-count bucket for device batches. Device batches are "

@@ -134,8 +134,14 @@ class AdaptiveShuffleReaderExec(UnaryExec):
     @property
     def specs(self) -> List[PartitionSpec]:
         if self._specs is None:
-            sizes = _partition_sizes(self.children[0])
-            self._specs = coalesce_specs(sizes, self.target_bytes)
+            # materializes the child exchange: drop device admission and
+            # serialize against concurrent tasks (plan/base.py semantics)
+            from spark_rapids_tpu.plan.base import release_semaphore_for_wait
+            release_semaphore_for_wait()
+            with self._exec_lock:
+                if self._specs is None:
+                    sizes = _partition_sizes(self.children[0])
+                    self._specs = coalesce_specs(sizes, self.target_bytes)
         return self._specs
 
     @property

@@ -56,25 +56,56 @@ def _sample_bounds(part: RangePartitioning, sample_rows, to_host_batch):
 
 class _LazyPartitions:
     """Reduce-side view over mode-specific storage: partitions fetch on
-    first access (the reduce task's fetch) and cache for re-execution."""
+    first access (the reduce task's fetch) and cache for re-execution.
+    Distinct partitions fetch CONCURRENTLY (the lock guards only the
+    bookkeeping, never the fetch itself — serializing fetches would undo
+    the task pool's host-I/O overlap); a duplicate request for an
+    in-flight partition waits for the first fetch instead of repeating
+    it."""
 
     def __init__(self, n: int, fetch):
+        import threading
         self._n = n
         self._fetch = fetch
         self._cache: Dict[int, List] = {}
+        self._inflight: Dict[int, "threading.Event"] = {}
+        self._lock = threading.Lock()
 
     #: optional callback fired once every partition has been fetched
     #: (storage can be released; results stay in the cache)
     on_all_fetched = None
 
     def __getitem__(self, pidx: int):
-        if pidx not in self._cache:
-            self._cache[pidx] = self._fetch(pidx)
+        import threading
+        with self._lock:
+            if pidx in self._cache:
+                return self._cache[pidx]
+            ev = self._inflight.get(pidx)
+            if ev is None:
+                ev = self._inflight[pidx] = threading.Event()
+            else:
+                ev = (ev, "waiter")
+        if isinstance(ev, tuple):
+            ev[0].wait()
+            return self[pidx]   # cached now; re-fetches if the owner failed
+        try:
+            res = self._fetch(pidx)
+        except BaseException:
+            with self._lock:       # let a later caller retry the fetch
+                self._inflight.pop(pidx, None)
+            ev.set()
+            raise
+        cb = None
+        with self._lock:
+            self._cache[pidx] = res
+            self._inflight.pop(pidx, None)
             if len(self._cache) == self._n and \
                     self.on_all_fetched is not None:
                 cb, self.on_all_fetched = self.on_all_fetched, None
-                cb()
-        return self._cache[pidx]
+        ev.set()
+        if cb is not None:
+            cb()
+        return res
 
     def __len__(self):
         return self._n
@@ -140,10 +171,17 @@ class CpuShuffleExchangeExec(UnaryExec):
         if mode == "CACHED":
             self._store = self._materialize_cached(env, n)
             return
+        from spark_rapids_tpu.plan.base import (iter_partition_tasks,
+                                                run_task_iter)
         store: List[List] = [[] for _ in range(n)]
-        for mp in range(self.child.num_partitions):
-            for p, sub in self._map_pairs(mp, n):
-                store[p].append(sub)
+        # map side: one task per map partition on the task pool (the
+        # multithreaded shuffle writer analog); pairs come back in map
+        # order so the store stays deterministic
+        for p, sub in iter_partition_tasks(
+                lambda mp: run_task_iter(
+                    lambda m: self._map_pairs(m, n), mp),
+                self.child.num_partitions):
+            store[p].append(sub)
         self._store = store
 
     def _materialize_multithreaded(self, env, n: int):
@@ -218,7 +256,13 @@ class CpuShuffleExchangeExec(UnaryExec):
 
     # -- reduce side --------------------------------------------------------
     def execute_partition(self, pidx):
-        self._materialize()
+        from spark_rapids_tpu.plan.base import release_semaphore_for_wait
+        if self._store is None:
+            # drop device admission before blocking on the map side (the
+            # map tasks need permits); re-acquired lazily downstream
+            release_semaphore_for_wait()
+            with self._exec_lock:
+                self._materialize()
         yield from self._store[pidx]
 
     def node_desc(self):
@@ -320,13 +364,15 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
         from spark_rapids_tpu.plan.partitioning import SinglePartitioning
         store: List[List] = [[] for _ in range(n)]
         if isinstance(part, SinglePartitioning) or n == 1:
-            for mp in range(self.child.num_partitions):
-                store[0].extend(self.child.execute_partition(mp))
+            # child partitions run as concurrent tasks via execute_all
+            store[0].extend(self.child.execute_all())
             self._store = store
             return
         from spark_rapids_tpu.ops.batch_ops import (compact_batch,
                                                     shrink_batch)
         from spark_rapids_tpu.columnar.column import _jnp, rc_traceable
+        from spark_rapids_tpu.plan.base import (iter_partition_tasks,
+                                                run_task_iter)
         jnp = _jnp()
         # HBM guard: the device-resident store keeps one full-bucket
         # compacted copy of every map batch PER reduce partition (~n x
@@ -335,9 +381,10 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
         # OOMing the device (DEFAULT is the default mode; users shouldn't
         # need to know to flip spark.rapids.shuffle.mode=MULTITHREADED).
         budget = self._device_store_budget()
-        stored_estimate = 0
-        host_staging = False
-        for mp in range(self.child.num_partitions):
+        state = {"stored_estimate": 0, "host_staging": False}
+        state_lock = __import__("threading").Lock()
+
+        def map_gen(mp):
             p_eff = part
             if isinstance(part, RoundRobinPartitioning):
                 p_eff = RoundRobinPartitioning(n, start=mp)
@@ -345,41 +392,46 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
                 # cap the n-fold storage cost: drop padding before the
                 # per-partition compacts
                 b = shrink_batch(b)
-                if not host_staging:
-                    stored_estimate += b.nbytes() * n
-                    if budget is not None and stored_estimate > budget:
-                        # auto-fallback: the rest of the map output goes
-                        # through the host-staged writer; batches already
-                        # compacted stay on device (they fit the budget)
-                        # and execute_partition handles the mixed store
-                        import logging
-                        logging.getLogger(__name__).info(
-                            "device shuffle store would exceed HBM budget "
-                            "(%d > %d bytes); host-staging the remainder",
-                            stored_estimate, budget)
-                        host_staging = True
-                if host_staging:
-                    for p, hb in self._slice_host_pairs(b, p_eff, n):
-                        store[p].append(hb)
+                with state_lock:
+                    if not state["host_staging"]:
+                        state["stored_estimate"] += b.nbytes() * n
+                        if budget is not None and \
+                                state["stored_estimate"] > budget:
+                            # auto-fallback: the rest of the map output
+                            # goes through the host-staged writer; batches
+                            # already compacted stay on device (they fit
+                            # the budget) and execute_partition handles
+                            # the mixed store
+                            import logging
+                            logging.getLogger(__name__).info(
+                                "device shuffle store would exceed HBM "
+                                "budget (%d > %d bytes); host-staging the "
+                                "remainder",
+                                state["stored_estimate"], budget)
+                            state["host_staging"] = True
+                    staging = state["host_staging"]
+                if staging:
+                    yield from self._slice_host_pairs(b, p_eff, n)
                     continue
                 pids = p_eff.partition_ids_tpu(b)
                 rowpos = jnp.arange(b.bucket)
                 inrow = rowpos < rc_traceable(b.row_count)
                 for p in range(n):
-                    store[p].append(compact_batch(b, (pids == p) & inrow))
+                    yield p, compact_batch(b, (pids == p) & inrow)
+
+        for p, sub in iter_partition_tasks(
+                lambda mp: run_task_iter(map_gen, mp),
+                self.child.num_partitions):
+            store[p].append(sub)
         self._store = store
 
     def _device_store_budget(self):
         """Bytes the device-resident shuffle store may occupy: half the
         remaining device pool, or None when no runtime is initialized
         (tests that drive execs directly)."""
-        from spark_rapids_tpu.memory.device_manager import get_runtime
-        rt = get_runtime()
-        if rt is None:
-            return None
-        cat = rt.catalog
-        free = max(0, cat.device_limit - cat.device_bytes)
-        return free // 2
+        from spark_rapids_tpu.memory.device_manager import \
+            free_device_headroom
+        return free_device_headroom(2)
 
     def _slice_host_pairs(self, b, part, n):
         """One device batch -> (pid, host slice) pairs via the device
@@ -406,7 +458,11 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
             off += int(counts[p])
 
     def execute_partition(self, pidx):
-        self._materialize()
+        from spark_rapids_tpu.plan.base import release_semaphore_for_wait
+        if self._store is None and self._collective is None:
+            release_semaphore_for_wait()
+            with self._exec_lock:
+                self._materialize()
         if self._collective is not None:
             from spark_rapids_tpu.parallel import collective as C
             ctx, cols, counts, schema = self._collective
@@ -425,31 +481,13 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
 
     def _map_pairs(self, mp: int, n: int):
         """Device shuffle write: pid eval + stable sort-by-pid on device,
-        ONE host copy, then arrow slicing per reduce partition."""
-        from spark_rapids_tpu.columnar.column import DeviceColumn, _jnp
-        from spark_rapids_tpu.ops.batch_ops import gather_batch
-        from spark_rapids_tpu.ops.sort_ops import SortOrder, sort_permutation
-        jnp = _jnp()
+        ONE host copy, then arrow slicing per reduce partition (shared
+        per-batch core: ``_slice_host_pairs``)."""
         part = self.partitioning
         if isinstance(part, RoundRobinPartitioning):
             part = RoundRobinPartitioning(n, start=mp)
         for b in self.child.execute_partition(mp):
-            pids = part.partition_ids_tpu(b)
-            pid_col = DeviceColumn(pids.astype(np.int64),
-                                   jnp.ones(b.bucket, dtype=bool),
-                                   b.row_count, None)
-            aug = ColumnarBatch([pid_col] + list(b.columns), b.row_count)
-            perm = sort_permutation(aug, [SortOrder(0, True, True)])
-            shuffled = gather_batch(b, perm, b.row_count)
-            counts = np.asarray(jnp.bincount(
-                jnp.clip(pids, 0, n), length=n + 1))[:n]
-            hb = shuffled.to_host()
-            hb.names = b.names
-            off = 0
-            for p in range(n):
-                if counts[p]:
-                    yield p, hb.slice(off, int(counts[p]))
-                off += int(counts[p])
+            yield from self._slice_host_pairs(b, part, n)
 
     def _compute_bounds(self):
         self._compute_bounds_tpu()

@@ -322,7 +322,10 @@ class _TpuJoinCore(_JoinBase):
             build_batches = [b for b in build_batches if b.row_count]
             build = concat_batches(build_batches) if build_batches else \
                 _empty_device(rs)
-            build.names = None
+            # concat_batches passes a single input through unchanged —
+            # never mutate it (it may be a shared/cached batch); rewrap
+            # to drop names instead
+            build = ColumnarBatch(build.columns, build.row_count)
             build_aug, build_ords = (build, ())
             if use_hash:
                 build_aug, build_ords = self._augment_keys(build,
@@ -441,10 +444,17 @@ class CpuBroadcastHashJoinExec(_CpuJoinCore):
 
     def _build_all(self):
         if getattr(self, "_built_host", None) is None:
-            bs = []
-            for p in range(self.right.num_partitions):
-                bs.extend(self.right.execute_partition(p))
-            self._built_host = _concat_or_empty(bs, self.right.schema)
+            # concurrent probe tasks must not double-build; drop device
+            # admission before blocking on the lock (the builder may need it)
+            from spark_rapids_tpu.plan.base import release_semaphore_for_wait
+            release_semaphore_for_wait()
+            with self._exec_lock:
+                if getattr(self, "_built_host", None) is None:
+                    bs = []
+                    for p in range(self.right.num_partitions):
+                        bs.extend(self.right.execute_partition(p))
+                    self._built_host = _concat_or_empty(bs,
+                                                        self.right.schema)
         return self._built_host
 
     def execute_partition(self, pidx):
@@ -462,15 +472,22 @@ class TpuBroadcastHashJoinExec(_TpuJoinCore):
 
     def execute_partition(self, pidx):
         # the build cache persists across probe partitions: the broadcast
-        # side is concatenated, keyed, and hash-sorted exactly once
-        cache = getattr(self, "_build_cache", None)
-        if cache is None:
-            cache = self._build_cache = {}
-        if "batches" not in cache:
-            bs = []
-            for p in range(self.right.num_partitions):
-                bs.extend(self.right.execute_partition(p))
-            cache["batches"] = bs
+        # side is concatenated, keyed, and hash-sorted exactly once; the
+        # population is locked against concurrent probe tasks (admission
+        # dropped first so the builder can acquire it)
+        if getattr(self, "_build_cache", None) is None or \
+                "batches" not in self._build_cache:
+            from spark_rapids_tpu.plan.base import release_semaphore_for_wait
+            release_semaphore_for_wait()
+            with self._exec_lock:
+                if getattr(self, "_build_cache", None) is None:
+                    self._build_cache = {}
+                if "batches" not in self._build_cache:
+                    bs = []
+                    for p in range(self.right.num_partitions):
+                        bs.extend(self.right.execute_partition(p))
+                    self._build_cache["batches"] = bs
+        cache = self._build_cache
         yield from self._join_device(self.left.execute_partition(pidx),
                                      cache["batches"], cache)
 

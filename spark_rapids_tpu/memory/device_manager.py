@@ -103,6 +103,18 @@ def get_runtime() -> Optional[DeviceManager]:
     return _runtime
 
 
+def free_device_headroom(divisor: int) -> Optional[int]:
+    """Free device-pool bytes divided by a safety factor, or None when no
+    runtime is initialized (tests driving execs directly).  The single
+    policy point for every out-of-core trigger (agg merge, external sort,
+    running window, exchange store)."""
+    rt = get_runtime()
+    if rt is None:
+        return None
+    free = max(0, rt.catalog.device_limit - rt.catalog.device_bytes)
+    return free // divisor
+
+
 def shutdown() -> None:
     global _runtime
     with _runtime_lock:

@@ -14,10 +14,33 @@ preserve it, exchanges change it (shuffle layer).
 
 from __future__ import annotations
 
+import itertools
+import threading
 from typing import Iterator, List, Optional, Sequence
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, HostColumnarBatch
+
+#: process-wide task-thread count for execute_all; set from
+#: ``spark.rapids.tpu.taskParallelism`` each time TpuOverrides.apply prepares
+#: a plan (execs carry no conf).  0 = auto (min(4, cpu_count)).
+_task_parallelism = 0
+#: unique task ids across the process — partition indexes would collide when
+#: independent plans execute concurrently (semaphore/metrics key on this)
+_task_ids = itertools.count(1)
+
+
+def set_task_parallelism(n: int) -> None:
+    global _task_parallelism
+    _task_parallelism = n
+
+
+def effective_task_parallelism() -> int:
+    import os
+    n = _task_parallelism
+    if n <= 0:
+        n = min(4, os.cpu_count() or 1)
+    return n
 
 
 class Exec:
@@ -29,6 +52,10 @@ class Exec:
     def __init__(self, children: Sequence["Exec"] = ()):
         self.children: List[Exec] = list(children)
         self.metrics = {}
+        # guards lazily-materialized per-exec state (shuffle stores,
+        # broadcast build sides) against concurrent partition tasks;
+        # with_children's copy.copy shares it, which only over-serializes
+        self._exec_lock = threading.Lock()
 
     # -- static shape -------------------------------------------------------
     @property
@@ -54,8 +81,16 @@ class Exec:
         raise NotImplementedError
 
     def execute_all(self):
-        for p in range(self.num_partitions):
-            yield from run_task(self, p)
+        """Drives every partition as a task.  With taskParallelism > 1 a
+        bounded thread pool runs partitions concurrently — host work
+        (shuffle ser/deser, I/O, arrow) overlaps device dispatch, and the
+        TpuSemaphore bounds device admission (reference: the executor's
+        task slots + GpuSemaphore, GpuSemaphore.scala:51-120;
+        RapidsShuffleInternalManagerBase.scala:120-218 thread pools).
+        Batches are yielded in partition order regardless of completion
+        order, so results stay deterministic."""
+        yield from iter_partition_tasks(
+            lambda p: run_task(self, p), self.num_partitions)
 
     def collect_host(self) -> HostColumnarBatch:
         """Gathers every partition to one host batch (driver collect)."""
@@ -106,16 +141,131 @@ class Exec:
 
 
 def run_task(plan: "Exec", pidx: int):
-    """Drives one partition as a task: the device semaphore (acquired by any
-    device section during execution) is fully released at completion, like
-    the reference's task-completion listener (GpuSemaphore.scala:51-120)."""
+    """Drives one partition as a task: a fresh task id + metrics bind to the
+    executing thread for the duration, and the device semaphore (acquired by
+    any device section during execution) is fully released at completion,
+    like the reference's task-completion listener (GpuSemaphore.scala:51-120
+    + RmmSpark thread-to-task registration)."""
+    yield from run_task_iter(plan.execute_partition, pidx)
+
+
+def run_task_iter(gen_fn, pidx: int):
+    """``run_task`` semantics over an arbitrary per-partition generator —
+    exchange map sides run through this so each map partition is a real
+    task (own id, metrics, semaphore release at completion)."""
+    from spark_rapids_tpu.memory.device_manager import get_runtime
+    from spark_rapids_tpu.memory.metrics import task_scope
+    task_id = next(_task_ids)
+    rt = get_runtime()
+    with task_scope(task_id, rt.metrics if rt is not None else None):
+        try:
+            yield from gen_fn(pidx)
+        finally:
+            rt = get_runtime()
+            if rt is not None:
+                rt.semaphore.release_all(task_id)
+
+
+def release_semaphore_for_wait() -> None:
+    """Releases the current task's device admission before a blocking wait
+    on other tasks' progress (exchange materialization, broadcast build) —
+    otherwise tasks holding every permit can all block on workers that need
+    one.  Device sections re-acquire lazily afterwards.  Reference: the
+    semaphore is released while a task blocks on a shuffle fetch
+    (GpuShuffleExchangeExecBase / RapidsCachingReader wait paths)."""
+    from spark_rapids_tpu.memory.device_manager import get_runtime
+    rt = get_runtime()
+    if rt is not None:
+        rt.semaphore.release_all()
+
+
+class _PartitionError:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+_DONE = object()
+
+
+def iter_partition_tasks(task_fn, n: int, workers: Optional[int] = None):
+    """Runs ``task_fn(p) -> iterator`` for ``p in range(n)`` and yields every
+    produced item in partition order.
+
+    With effective parallelism > 1 this is a windowed producer/consumer:
+    each partition's items drain into its own bounded queue (caps buffered
+    batches per partition), so partition p's items are being yielded while
+    partitions p+1..p+workers-1 are already producing.  A stop event
+    unblocks producers if the consumer abandons the generator (e.g. a
+    short-circuiting limit).  Used by ``Exec.execute_all`` and by exchange
+    map sides (the reference's task slots / multithreaded shuffle writer
+    pools, RapidsShuffleInternalManagerBase.scala:120-218)."""
+    if workers is None:
+        workers = effective_task_parallelism()
+    workers = min(workers, n)
+    if workers <= 1:
+        for p in range(n):
+            yield from task_fn(p)
+        return
+
+    import queue as qmod
+    from concurrent.futures import ThreadPoolExecutor
+
+    qs = [qmod.Queue(maxsize=4) for _ in range(n)]
+    stop = threading.Event()
+
+    def put(q, item) -> bool:
+        released = False
+        while True:
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except qmod.Full:
+                if stop.is_set():
+                    return False
+                if not released:
+                    # waiting on backpressure must not hold device
+                    # admission: tasks parked on full queues would
+                    # otherwise starve the partition the consumer is
+                    # draining (permits re-acquire lazily at the next
+                    # device section)
+                    release_semaphore_for_wait()
+                    released = True
+
+    def drive(p: int) -> None:
+        q = qs[p]
+        try:
+            for b in task_fn(p):
+                if stop.is_set() or not put(q, b):
+                    return
+        except BaseException as e:  # propagated to the consumer
+            put(q, _PartitionError(e))
+        finally:
+            put(q, _DONE)
+
+    pool = ThreadPoolExecutor(max_workers=workers,
+                              thread_name_prefix="tpu-task")
     try:
-        yield from plan.execute_partition(pidx)
+        for p in range(n):
+            pool.submit(drive, p)
+        for p in range(n):
+            while True:
+                item = qs[p].get()
+                if item is _DONE:
+                    break
+                if isinstance(item, _PartitionError):
+                    raise item.exc
+                yield item
     finally:
-        from spark_rapids_tpu.memory.device_manager import get_runtime
-        rt = get_runtime()
-        if rt is not None:
-            rt.semaphore.release_all()
+        stop.set()
+        for q in qs:  # unblock producers stuck on a full queue
+            try:
+                while True:
+                    q.get_nowait()
+            except qmod.Empty:
+                pass
+        pool.shutdown(wait=True, cancel_futures=True)
 
 
 class LeafExec(Exec):

@@ -12,6 +12,7 @@ import logging
 from typing import Callable, Dict, List, Optional, Type
 
 from spark_rapids_tpu import config as C
+from spark_rapids_tpu import types as T
 from spark_rapids_tpu.config import TpuConf
 from spark_rapids_tpu.expressions import (arithmetic as A, bitwise as B,
                                           cast as CA, conditional as K,
@@ -35,6 +36,9 @@ class ExprRule:
     sig: Optional[TS.TypeSig] = None
     desc: str = ""
     extra_tag: Optional[Callable] = None
+    #: per-op input/output matrix (ExprChecks analog); when present it
+    #: refines ``sig`` with per-parameter signatures
+    checks: Optional[TS.OpChecks] = None
 
 
 @dataclasses.dataclass
@@ -46,20 +50,23 @@ class ExecRule:
     desc: str = ""
     exprs_of: Callable[[Exec], List[Expression]] = lambda p: []
     extra_tag: Optional[Callable] = None
+    #: deliberately host-tier (identity convert + honest fallback tag);
+    #: api_validation skips the Tpu-twin naming contract for these
+    host_only: bool = False
 
 
 _EXPR_RULES: Dict[type, ExprRule] = {}
 _EXEC_RULES: Dict[type, ExecRule] = {}
 
 
-def register_expr(cls, sig=None, desc="", extra_tag=None):
-    _EXPR_RULES[cls] = ExprRule(cls, sig, desc, extra_tag)
+def register_expr(cls, sig=None, desc="", extra_tag=None, checks=None):
+    _EXPR_RULES[cls] = ExprRule(cls, sig, desc, extra_tag, checks)
 
 
 def register_exec(cls, convert, sig=None, expr_sig=None, desc="",
-                  exprs_of=lambda p: [], extra_tag=None):
+                  exprs_of=lambda p: [], extra_tag=None, host_only=False):
     _EXEC_RULES[cls] = ExecRule(cls, convert, sig, expr_sig, desc, exprs_of,
-                                extra_tag)
+                                extra_tag, host_only)
 
 
 def expr_rule_for(cls) -> Optional[ExprRule]:
@@ -89,13 +96,21 @@ def exec_registry() -> Dict[type, ExecRule]:
 for _cls in (Literal, BoundReference, Alias):
     register_expr(_cls, TS.BASIC_WITH_ARRAYS)
 
+_ARITH_CHECKS = TS.OpChecks(
+    TS.NUMERIC_128,
+    [TS.ParamCheck("lhs", TS.NUMERIC_128), TS.ParamCheck("rhs",
+                                                         TS.NUMERIC_128)])
 for _cls in (A.Add, A.Subtract, A.Multiply, A.Divide, A.IntegralDivide,
              A.Remainder, A.Pmod, A.UnaryMinus, A.Abs):
-    register_expr(_cls, TS.NUMERIC_128)
+    register_expr(_cls, TS.NUMERIC_128, checks=_ARITH_CHECKS)
 
+_CMP_CHECKS = TS.OpChecks(
+    TS.BOOLEAN,
+    [TS.ParamCheck("lhs", TS.COMPARABLE), TS.ParamCheck("rhs",
+                                                        TS.COMPARABLE)])
 for _cls in (P.EqualTo, P.NotEqual, P.LessThan, P.LessThanOrEqual,
              P.GreaterThan, P.GreaterThanOrEqual, P.EqualNullSafe):
-    register_expr(_cls, TS.COMPARABLE)
+    register_expr(_cls, TS.COMPARABLE, checks=_CMP_CHECKS)
 
 for _cls in (P.And, P.Or, P.Not):
     register_expr(_cls, TS.BOOLEAN)
@@ -117,10 +132,24 @@ for _cls in (B.BitwiseAnd, B.BitwiseOr, B.BitwiseXor, B.BitwiseNot,
 
 register_expr(CA.Cast, TS.ALL_BASIC)
 
-for _cls in (S.Length, S.Upper, S.Lower, S.Concat, S.Substring, S.StartsWith,
-             S.EndsWith, S.Contains, S.Trim, S.LTrim, S.RTrim, S.Like,
-             S.RLike, S.RegExpReplace, S.RegExpExtract, S.Reverse,
-             S.InitCap, S.StringRepeat, S.LPad, S.RPad, S.StringLocate,
+_STR_IN = TS.TypeSig([T.StringType])
+for _cls in (S.Upper, S.Lower, S.Trim, S.LTrim, S.RTrim, S.Reverse,
+             S.InitCap):
+    register_expr(_cls, TS.ALL_BASIC, checks=TS.OpChecks(
+        _STR_IN, [TS.ParamCheck("str", _STR_IN)]))
+register_expr(S.Length, TS.ALL_BASIC, checks=TS.OpChecks(
+    TS.INTEGRAL, [TS.ParamCheck("str", TS.TypeSig([T.StringType,
+                                                   T.BinaryType]))]))
+for _cls in (S.StartsWith, S.EndsWith, S.Contains):
+    register_expr(_cls, TS.ALL_BASIC, checks=TS.OpChecks(
+        TS.BOOLEAN, [TS.ParamCheck("str", _STR_IN),
+                     TS.ParamCheck("search", _STR_IN)]))
+register_expr(S.Substring, TS.ALL_BASIC, checks=TS.OpChecks(
+    _STR_IN, [TS.ParamCheck("str", _STR_IN),
+              TS.ParamCheck("pos", TS.INTEGRAL),
+              TS.ParamCheck("len", TS.INTEGRAL)]))
+for _cls in (S.Concat, S.Like, S.RLike, S.RegExpReplace, S.RegExpExtract,
+             S.StringRepeat, S.LPad, S.RPad, S.StringLocate,
              S.StringTranslate, S.ConcatWs):
     register_expr(_cls, TS.ALL_BASIC)
 
@@ -157,10 +186,30 @@ for _cls in (CO.GetStructField, CO.CreateNamedStruct, CO.CreateMap,
 # Average/First/Last/StddevSamp/... registrations)
 from spark_rapids_tpu.expressions import aggregates as AG  # noqa: E402
 
-for _cls in (AG.Sum, AG.Count, AG.Min, AG.Max, AG.Average, AG.First,
-             AG.Last, AG.VarianceSamp, AG.VariancePop, AG.StddevSamp,
-             AG.StddevPop):
+# per-op input matrices (ExprChecks analog, TypeChecks.scala:1057):
+# Sum/Average take numeric inputs (decimal64 buffers; decimal128 buffers
+# rejected at the exec's buffer tag), Min/Max exclude strings/binary (no
+# device min/max string buffers yet — the runtime gap supported_ops.md
+# previously could not express), Count/First/Last take anything basic.
+_MINMAX_IN = TS.TypeSig(
+    [T.ByteType, T.ShortType, T.IntegerType, T.LongType, T.FloatType,
+     T.DoubleType, T.BooleanType, T.DateType, T.TimestampType,
+     T.DecimalType], True)
+register_expr(AG.Sum, TS.ALL_BASIC, checks=TS.OpChecks(
+    TS.NUMERIC_128, [TS.ParamCheck("value", TS.NUMERIC_128)]))
+register_expr(AG.Average, TS.ALL_BASIC, checks=TS.OpChecks(
+    TS.NUMERIC_128, [TS.ParamCheck("value", TS.NUMERIC_128)]))
+for _cls in (AG.Min, AG.Max):
+    register_expr(_cls, TS.ALL_BASIC, checks=TS.OpChecks(
+        _MINMAX_IN, [TS.ParamCheck("value", _MINMAX_IN)]))
+for _cls in (AG.Count, AG.First, AG.Last):
     register_expr(_cls, TS.ALL_BASIC)
+_VAR_IN = TS.TypeSig([T.ByteType, T.ShortType, T.IntegerType, T.LongType,
+                      T.FloatType, T.DoubleType])
+for _cls in (AG.VarianceSamp, AG.VariancePop, AG.StddevSamp,
+             AG.StddevPop):
+    register_expr(_cls, TS.ALL_BASIC, checks=TS.OpChecks(
+        TS.TypeSig([T.DoubleType]), [TS.ParamCheck("value", _VAR_IN)]))
 
 # variable-length-state aggregates: host tier (COMPLETE-mode planning)
 for _cls in (AG.CollectList, AG.CollectSet, AG.Percentile,
@@ -298,6 +347,31 @@ def fuse_device_stages(plan: Exec) -> Exec:
     return plan.transform_up(fix)
 
 
+def push_scan_predicates(plan: Exec) -> Exec:
+    """Filter-over-scan predicate pushdown (reference: the rapids file
+    scans receive Spark's pushed filters and prune row groups / stripes
+    with them — GpuParquetScan.scala footer filter, GpuOrcScan.scala host
+    stripe filter).  The Filter node STAYS above the scan: pushdown is
+    allowed to be conservative (stats-based pruning keeps false
+    positives), so exactness lives in the filter."""
+    from spark_rapids_tpu.exec.basic import CpuFilterExec
+    from spark_rapids_tpu.io.orc import CpuOrcScanExec
+    from spark_rapids_tpu.io.parquet import CpuParquetScanExec
+
+    def fix(node: Exec) -> Exec:
+        if isinstance(node, CpuFilterExec) and node.children:
+            child = node.children[0]
+            if isinstance(child, (CpuParquetScanExec, CpuOrcScanExec)) and \
+                    child.predicate is None:
+                import copy
+                scan = copy.copy(child)
+                scan.predicate = node.condition
+                return node.with_children([scan])
+        return node
+
+    return plan.transform_up(fix)
+
+
 def validate_all_on_device(plan: Exec, conf: TpuConf) -> None:
     """Test-mode assertion (reference: GpuTransitionOverrides
     assertIsOnTheGpu :616 + spark.rapids.sql.test.enabled)."""
@@ -331,8 +405,11 @@ class TpuOverrides:
         """``for_explain`` produces the would-be plan without the test-mode
         all-on-device assertion (introspection must not raise on fallback).
         ``skip_pruning`` is set by callers that already pruned (count())."""
+        from spark_rapids_tpu.plan.base import set_task_parallelism
         from spark_rapids_tpu.plan.meta import PlanMeta
         conf = self.conf
+        set_task_parallelism(conf.get(C.TASK_PARALLELISM.key))
+        plan = push_scan_predicates(plan)
         if not skip_pruning and conf.get(C.COLUMN_PRUNING_ENABLED.key, True):
             from spark_rapids_tpu.plan.pruning import prune_columns
             # test mode turns a pruning failure into an error instead of a
