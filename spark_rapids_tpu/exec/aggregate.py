@@ -114,6 +114,116 @@ class _AggLayout:
         return exprs
 
 
+#: re-partition fan-out per fallback level; 4 bits of the 32-bit key hash
+#: are consumed per level, so 7 levels exhaust the hash
+MERGE_BUCKETS = 16
+_MAX_REPARTITION_DEPTH = 7
+#: test hook: force the re-partition fallback while depth < this value
+#: (deterministic analog of arming forceSplitAndRetryOOM at exactly the
+#: merge site — the allocation-hook injection can fire at an earlier
+#: catalog add, which is outside the merge's catch scope by design)
+FORCE_REPARTITION_BELOW_DEPTH = 0
+#: observability: bumped once per re-partition pass (tests assert on it)
+REPARTITION_EVENTS = 0
+
+
+def _key_hash_u32(hb: HostColumnarBatch, lay: "_AggLayout") -> np.ndarray:
+    """murmur3 over the buffer batch's key columns (host tier)."""
+    from spark_rapids_tpu.expressions.base import BoundReference, EvalContext
+    from spark_rapids_tpu.expressions.evaluator import (host_batch_tcols,
+                                                        tcol_to_host_column)
+    from spark_rapids_tpu.expressions.hashing import Murmur3Hash
+    refs = [BoundReference(i, lay.grouping[i].data_type, True)
+            for i in range(lay.num_keys)]
+    ctx = EvalContext(host_batch_tcols(hb), "cpu", hb.row_count)
+    h = Murmur3Hash(*refs).eval_cpu(ctx)
+    hv = np.asarray(tcol_to_host_column(h, hb.row_count).arrow)
+    return hv.astype(np.int64).astype(np.uint32)  # two's-complement bits
+
+
+def _repartition_spillables(spill_batches, lay: "_AggLayout", depth: int):
+    """Splits spillable buffer batches into MERGE_BUCKETS disjoint-key
+    groups of spillable host batches, consuming 4 fresh hash bits per
+    recursion depth so a level-N bucket re-splits instead of collapsing
+    back into one bucket."""
+    import pyarrow as pa
+    from spark_rapids_tpu.columnar.batch import batch_from_arrow
+    from spark_rapids_tpu.memory.spillable import SpillableColumnarBatch
+    global REPARTITION_EVENTS
+    REPARTITION_EVENTS += 1
+    buckets = [[] for _ in range(MERGE_BUCKETS)]
+    for sb in spill_batches:
+        hb = sb.get_host_batch()
+        sb.close()
+        h = _key_hash_u32(hb, lay)
+        pid = ((h >> np.uint32(4 * depth)) % MERGE_BUCKETS).astype(np.int64)
+        order = np.argsort(pid, kind="stable")
+        counts = np.bincount(pid, minlength=MERGE_BUCKETS)
+        tab = pa.Table.from_batches([hb.to_arrow()]).take(pa.array(order))
+        off = 0
+        for k in range(MERGE_BUCKETS):
+            if counts[k]:
+                piece = batch_from_arrow(tab.slice(off, int(counts[k])))
+                piece.names = hb.names
+                buckets[k].append(SpillableColumnarBatch.from_host(piece))
+            off += int(counts[k])
+    return buckets
+
+
+def merge_partials_out_of_core(lay: "_AggLayout", spill_partials,
+                               depth: int = 0):
+    """Merges spillable buffer-layout partials, yielding DEVICE batches
+    whose key sets are pairwise disjoint.
+
+    Fast path: one concat + segmented merge under the retry frame.  When
+    that cannot fit — a SplitAndRetryOOM surfaces (injected or real), or
+    the estimated concat size exceeds half the free device pool — the
+    partials are hash-RE-partitioned on the host into MERGE_BUCKETS
+    spillable groups and each bucket merges independently, recursing on
+    a bucket that still doesn't fit.  Reference:
+    GpuMergeAggregateIterator (GpuAggregateExec.scala:711) — concat-and-
+    merge first, repartition-and-recurse on OOM.
+    """
+    from spark_rapids_tpu.memory.device_manager import free_device_headroom
+    from spark_rapids_tpu.memory.retry import (SplitAndRetryOOM,
+                                               maybe_inject_oom,
+                                               with_retry_no_split)
+    from spark_rapids_tpu.ops.agg_ops import segmented_aggregate
+    from spark_rapids_tpu.ops.batch_ops import concat_batches
+    nk = lay.num_keys
+
+    def attempt():
+        maybe_inject_oom()
+        batches = [sb.get_batch() for sb in spill_partials]
+        big = concat_batches(batches) if len(batches) > 1 else batches[0]
+        return segmented_aggregate(big, nk, lay.merge_specs())
+
+    too_big = False
+    if nk > 0 and depth < _MAX_REPARTITION_DEPTH:
+        too_big = depth < FORCE_REPARTITION_BELOW_DEPTH
+        if not too_big:
+            budget = free_device_headroom(2)
+            if budget is not None:
+                est = sum(sb.sized_nbytes for sb in spill_partials)
+                too_big = est > budget
+    if not too_big:
+        try:
+            merged = with_retry_no_split(None, attempt)
+            for sb in spill_partials:
+                sb.close()
+            yield merged
+            return
+        except SplitAndRetryOOM:
+            # merge state can't shrink by re-running; fall through to the
+            # re-partition fallback (a global agg has nothing to split on)
+            if nk == 0 or depth >= _MAX_REPARTITION_DEPTH:
+                raise
+    for bucket in _repartition_spillables(spill_partials, lay, depth):
+        if not bucket:
+            continue
+        yield from merge_partials_out_of_core(lay, bucket, depth + 1)
+
+
 class CpuHashAggregateExec(UnaryExec):
     """Arrow-groupby based oracle/fallback with the same buffer algebra."""
 
@@ -381,10 +491,15 @@ class TpuHashAggregateExec(CpuHashAggregateExec):
     def execute_partition(self, pidx):
         from spark_rapids_tpu.expressions.evaluator import eval_exprs_tpu
         from spark_rapids_tpu.memory.retry import with_retry_no_split
+        from spark_rapids_tpu.memory.spillable import SpillableColumnarBatch
         from spark_rapids_tpu.ops.agg_ops import segmented_aggregate
-        from spark_rapids_tpu.ops.batch_ops import concat_batches
         lay = self.layout
-        partials: List[ColumnarBatch] = []
+        # partials register spillable as they accumulate — under pressure
+        # the catalog can push earlier partials down a tier while later
+        # child batches are still aggregating (GpuMergeAggregateIterator's
+        # aggregated-batch queue semantics)
+        partials: List[SpillableColumnarBatch] = []
+        n_partials = 0
         for b in self.child.execute_partition(pidx):
             if self.mode in (PARTIAL, COMPLETE):
                 exprs = []
@@ -397,26 +512,29 @@ class TpuHashAggregateExec(CpuHashAggregateExec):
                     proj, lay.num_keys, lay.update_specs()))
             else:
                 p = b  # already in buffer layout (post-shuffle)
-            partials.append(p)
+            partials.append(SpillableColumnarBatch.from_device(p))
+            n_partials += 1
         if not partials:
             if lay.num_keys == 0 and self.mode in (COMPLETE, FINAL) and \
                     self.child.num_partitions == 1:
                 yield self._empty_reduction().to_device()
             return
-        merged = partials[0]
-        if len(partials) > 1 or self.mode == FINAL:
-            big = concat_batches(partials)
-            merged = with_retry_no_split(None, lambda: segmented_aggregate(
-                big, lay.num_keys, lay.merge_specs()))
-        if self.mode == PARTIAL:
-            merged.names = [lay.key_name(i) for i in range(lay.num_keys)] + \
-                [lay.buffer_name(j) for j in range(len(lay.flat))]
-            yield merged
-        elif lay.num_keys == 0 and merged.row_count == 0:
-            # global aggregation over zero rows still yields one row
-            yield self._empty_reduction().to_device()
+        if n_partials == 1 and self.mode != FINAL:
+            merged_batches = [partials[0].get_batch()]
+            partials[0].close()
         else:
-            yield eval_exprs_tpu(lay.final_exprs(), merged)
+            merged_batches = merge_partials_out_of_core(lay, partials)
+        names = [lay.key_name(i) for i in range(lay.num_keys)] + \
+            [lay.buffer_name(j) for j in range(len(lay.flat))]
+        for merged in merged_batches:
+            if self.mode == PARTIAL:
+                merged.names = list(names)
+                yield merged
+            elif lay.num_keys == 0 and merged.row_count == 0:
+                # global aggregation over zero rows still yields one row
+                yield self._empty_reduction().to_device()
+            else:
+                yield eval_exprs_tpu(lay.final_exprs(), merged)
 
     def node_desc(self):
         return "Tpu" + super().node_desc()

@@ -293,12 +293,103 @@ class TpuFusedAggExec(UnaryExec):
             cols.append(DeviceColumn(d, v, n, dt, ln))
         return ColumnarBatch(cols, n, names)
 
+    def _merge_final_eligible(self, partials: List[ColumnarBatch]) -> bool:
+        """The single-jit merge+final path needs in-trace concat: every
+        partial must share one plane layout (same 2-D widths, no nested
+        element-validity planes)."""
+        sig0 = _batch_signature(partials[0])
+        for b in partials[1:]:
+            if _batch_signature(b) != sig0:
+                return False
+        return all(c.elem_valid is None
+                   for b in partials for c in b.columns)
+
+    def _merge_final_fused(self, partials: List[ColumnarBatch]):
+        """ONE jit for the whole reduce side: in-trace concat of the
+        partial buffers -> merge pass -> final expression eval.  Collapses
+        three sequential dispatches (concat_batches, segmented_aggregate,
+        final project) into one — on a tunnel-attached TPU each dispatch
+        costs ~20ms of round-trip latency, so this halves the critical
+        path of every aggregate query's last mile."""
+        import jax
+        jnp = _jx()
+        lay = self.layout
+        nk = lay.num_keys
+        merge_specs = list(lay.merge_specs())
+        final_exprs = list(lay.final_exprs())
+        key = ("mergefinal", tuple(_batch_signature(b) for b in partials),
+               tuple(b.bucket for b in partials), nk,
+               tuple((o, k, cv, str(dt)) for o, k, cv, dt in merge_specs),
+               tuple((e.sql(), str(e.data_type)) for e in final_exprs))
+        fn = TpuFusedAggExec._CACHE.get(key)
+        if fn is None:
+            from spark_rapids_tpu.columnar.column import DeviceColumn
+            from spark_rapids_tpu.expressions.evaluator import \
+                tcol_to_device_column
+            from spark_rapids_tpu.ops.agg_ops import (_GLOBAL_OUT_BUCKET,
+                                                      global_agg_trace,
+                                                      keyed_agg_trace)
+            buckets = [b.bucket for b in partials]
+            total = sum(buckets)
+            in_dtypes = [c.data_type for c in partials[0].columns]
+
+            def run(arrs_list, rcs):
+                sel = jnp.concatenate(
+                    [jnp.arange(bk, dtype=np.int32) < rcs[pi]
+                     for pi, bk in enumerate(buckets)])
+                cols = []
+                for ci, dt in enumerate(in_dtypes):
+                    d = jnp.concatenate(
+                        [arrs_list[pi][ci][0] for pi in range(len(buckets))],
+                        axis=0)
+                    v = jnp.concatenate(
+                        [arrs_list[pi][ci][1] for pi in range(len(buckets))])
+                    lns = [arrs_list[pi][ci][2] for pi in range(len(buckets))]
+                    ln = None if lns[0] is None else jnp.concatenate(lns)
+                    cols.append(DeviceColumn(d, v, total, dt, ln))
+                if nk == 0:
+                    outs = global_agg_trace(cols, sel, merge_specs, jnp)
+                    ng = None
+                    out_bucket = _GLOBAL_OUT_BUCKET
+                else:
+                    outs, ng = keyed_agg_trace(cols, sel, nk, merge_specs,
+                                               total, jnp)
+                    out_bucket = total
+                tcols = []
+                for j, (d, v, ln) in enumerate(outs):
+                    dt = in_dtypes[j] if j < nk else merge_specs[j - nk][3]
+                    if ln is None and dt.np_dtype is not None and \
+                            d.dtype != np.dtype(dt.np_dtype):
+                        d = d.astype(dt.np_dtype)
+                    tcols.append(TCol(d, v, dt, lengths=ln))
+                ctx = EvalContext(tcols, "tpu", out_bucket)
+                fouts = []
+                for e in final_exprs:
+                    tc = e.eval_tpu(ctx)
+                    dc = tcol_to_device_column(tc, 0, out_bucket, jnp)
+                    fouts.append((dc.data, dc.validity, dc.lengths,
+                                  dc.elem_valid))
+                return fouts, ng
+
+            fn = jax.jit(run)
+            TpuFusedAggExec._CACHE[key] = fn
+
+        arrs_list = [[(c.data, c.validity, c.lengths) for c in b.columns]
+                     for b in partials]
+        rcs = [rc_traceable(b.row_count) for b in partials]
+        fouts, ng = fn(arrs_list, rcs)
+        n = 1 if nk == 0 else DeferredCount(ng)
+        from spark_rapids_tpu.expressions.evaluator import _out_names
+        fields = self.layout.result_schema.fields
+        cols = [DeviceColumn(d, v, n, f.data_type, ln, ev)
+                for (d, v, ln, ev), f in zip(fouts, fields)]
+        return ColumnarBatch(cols, n, _out_names(final_exprs) or
+                             [f.name for f in fields])
+
     def execute_partition(self, pidx):
         from spark_rapids_tpu.exec.aggregate import COMPLETE, FINAL, PARTIAL
         from spark_rapids_tpu.expressions.evaluator import eval_exprs_tpu
         from spark_rapids_tpu.memory.retry import with_retry_no_split
-        from spark_rapids_tpu.ops.agg_ops import segmented_aggregate
-        from spark_rapids_tpu.ops.batch_ops import concat_batches
         lay = self.layout
         partials: List[ColumnarBatch] = []
         for b in self.child.execute_partition(pidx):
@@ -313,22 +404,60 @@ class TpuFusedAggExec(UnaryExec):
                     lay.grouping, lay.aggs, self.mode,
                     self.child)._empty_reduction().to_device()
             return
-        merged = partials[0]
-        if len(partials) > 1 or self.mode == FINAL:
-            big = concat_batches(partials)
-            merged = with_retry_no_split(None, lambda: segmented_aggregate(
-                big, lay.num_keys, lay.merge_specs()))
-        if self.mode == PARTIAL:
-            merged.names = [lay.key_name(i) for i in range(lay.num_keys)] + \
-                [lay.buffer_name(j) for j in range(len(lay.flat))]
-            yield merged
-        elif lay.num_keys == 0 and merged.row_count == 0:
-            from spark_rapids_tpu.exec.aggregate import CpuHashAggregateExec
-            yield CpuHashAggregateExec(
-                lay.grouping, lay.aggs, self.mode,
-                self.child)._empty_reduction().to_device()
+        needs_merge = len(partials) > 1 or self.mode == FINAL
+        if not needs_merge:
+            merged_iter = iter(partials)
         else:
-            yield eval_exprs_tpu(lay.final_exprs(), merged)
+            from spark_rapids_tpu.exec.aggregate import \
+                merge_partials_out_of_core
+            from spark_rapids_tpu.memory.device_manager import \
+                free_device_headroom
+            from spark_rapids_tpu.memory.retry import (SplitAndRetryOOM,
+                                                       maybe_inject_oom)
+            from spark_rapids_tpu.memory.spillable import \
+                SpillableColumnarBatch
+            import spark_rapids_tpu.exec.aggregate as A
+            eligible = self.mode != PARTIAL and \
+                A.FORCE_REPARTITION_BELOW_DEPTH == 0 and \
+                self._merge_final_eligible(partials)
+            spills = [SpillableColumnarBatch.from_device(p)
+                      for p in partials]
+            partials = None  # only the spillable handles keep them alive
+            too_big = False
+            if lay.num_keys > 0:
+                budget = free_device_headroom(2)
+                if budget is not None:
+                    est = sum(sb.sized_nbytes for sb in spills)
+                    too_big = est > budget
+            if eligible and not too_big:
+                def attempt():
+                    maybe_inject_oom()
+                    return self._merge_final_fused(
+                        [sb.get_batch() for sb in spills])
+                try:
+                    out = with_retry_no_split(None, attempt)
+                    for sb in spills:
+                        sb.close()
+                    yield out
+                    return
+                except SplitAndRetryOOM:
+                    if lay.num_keys == 0:
+                        raise
+            merged_iter = merge_partials_out_of_core(lay, spills)
+        names = [lay.key_name(i) for i in range(lay.num_keys)] + \
+            [lay.buffer_name(j) for j in range(len(lay.flat))]
+        for merged in merged_iter:
+            if self.mode == PARTIAL:
+                merged.names = list(names)
+                yield merged
+            elif lay.num_keys == 0 and merged.row_count == 0:
+                from spark_rapids_tpu.exec.aggregate import \
+                    CpuHashAggregateExec
+                yield CpuHashAggregateExec(
+                    lay.grouping, lay.aggs, self.mode,
+                    self.child)._empty_reduction().to_device()
+            else:
+                yield eval_exprs_tpu(lay.final_exprs(), merged)
 
     def node_desc(self):
         chain = "+".join("F" if k == "filter" else "P"
