@@ -152,8 +152,90 @@ class CpuSortExec(UnaryExec):
         return f"Sort[{ks}]"
 
 
+#: test hook: force the external (sorted-runs + merge) path
+FORCE_OUT_OF_CORE_SORT = False
+#: observability: bumped once per external-sort merge pass
+EXTERNAL_SORT_EVENTS = 0
+#: rows per output batch of the external merge (bounds device residency
+#: of any single downstream batch)
+_MERGE_OUT_ROWS = 1 << 20
+
+
+def _word_bytes(w: "np.ndarray", n: int):
+    """One order word -> big-endian unsigned bytes (order preserved)."""
+    import numpy as np
+    if w.dtype == np.bool_:
+        u = w.astype(np.uint8)
+    elif w.dtype.kind == "i":
+        bits = w.dtype.itemsize * 8
+        ut = np.dtype(f"uint{bits}")
+        u = (w.view(ut) ^ ut.type(1 << (bits - 1)))
+    else:
+        u = w
+    be = np.ascontiguousarray(u.astype(u.dtype.newbyteorder(">")))
+    return be.view(np.uint8).reshape(n, u.dtype.itemsize)
+
+
+def merge_key_bytes(hb, specs: Sequence[SortSpec],
+                    string_widths: Optional[dict] = None) -> "np.ndarray":
+    """Per-row packed key bytes whose plain bytewise order == the SQL sort
+    order (host mirror of the device sortable-words normalization).  All
+    runs of one merge must pass the same ``string_widths`` so their word
+    counts agree."""
+    import numpy as np
+    from spark_rapids_tpu.expressions.base import EvalContext
+    from spark_rapids_tpu.expressions.evaluator import (host_batch_tcols,
+                                                        tcol_to_host_column)
+    from spark_rapids_tpu.ops.sort_ops import SortOrder, host_order_words
+    n = hb.row_count
+    ctx = EvalContext(host_batch_tcols(hb), "cpu", n)
+    planes = []
+    for i, s in enumerate(specs):
+        kc = tcol_to_host_column(s.expr.eval_cpu(ctx), n)
+        order = SortOrder(0, s.ascending, s.effective_nulls_first)
+        width = (string_widths or {}).get(i)
+        for w in host_order_words(kc, order, string_width=width):
+            planes.append(_word_bytes(np.asarray(w), n))
+    packed = np.concatenate(planes, axis=1) if planes else \
+        np.zeros((n, 1), dtype=np.uint8)
+    return packed.reshape(n, -1).view(f"|S{packed.shape[1]}").ravel()
+
+
+def probe_string_widths(host_batches, specs: Sequence[SortSpec]) -> dict:
+    """Max string rectangle width per string sort key across all runs."""
+    import pyarrow as pa
+    from spark_rapids_tpu.expressions.base import EvalContext
+    from spark_rapids_tpu.expressions.evaluator import (host_batch_tcols,
+                                                        tcol_to_host_column)
+    widths: dict = {}
+    for hb in host_batches:
+        ctx = EvalContext(host_batch_tcols(hb), "cpu", hb.row_count)
+        for i, s in enumerate(specs):
+            if not isinstance(s.expr.data_type, (T.StringType,
+                                                 T.BinaryType)):
+                continue
+            kc = tcol_to_host_column(s.expr.eval_cpu(ctx), hb.row_count)
+            arr = kc.arrow
+            lens = pa.compute.binary_length(arr)
+            mx = pa.compute.max(lens).as_py() or 0
+            widths[i] = max(widths.get(i, 1), int(mx), 1)
+    return widths
+
+
 class TpuSortExec(UnaryExec):
-    """Device sort (reference: GpuSortExec full-sort path)."""
+    """Device sort (reference: GpuSortExec.scala:633 full-sort path with
+    the out-of-core discipline).
+
+    Fast path: concat every input batch and sort once on device.  When
+    the estimated working set exceeds the free-pool headroom (or a
+    SplitAndRetryOOM surfaces), falls back to an EXTERNAL sort: inputs
+    group into device-budget-sized chunks, each chunk sorts on device
+    into a sorted run that is staged to spillable host memory, and the
+    runs merge by their packed order-word keys (numpy stable sort over
+    pre-sorted runs — C-speed, host tier), streaming device batches of
+    ``_MERGE_OUT_ROWS`` back out.  Device residency stays bounded by one
+    chunk + one output batch.
+    """
 
     is_device = True
 
@@ -163,12 +245,97 @@ class TpuSortExec(UnaryExec):
         self.specs = list(specs)
         self.global_sort = global_sort
 
+    def _run_budget(self):
+        from spark_rapids_tpu.memory.device_manager import \
+            free_device_headroom
+        # sort materializes the permuted copy -> 4x headroom
+        return free_device_headroom(4)
+
     def execute_partition(self, pidx):
+        from spark_rapids_tpu.memory.retry import (SplitAndRetryOOM,
+                                                   maybe_inject_oom,
+                                                   with_retry_no_split)
+        from spark_rapids_tpu.memory.spillable import SpillableColumnarBatch
         from spark_rapids_tpu.ops import concat_batches
-        batches = list(self.child.execute_partition(pidx))
-        if not batches:
+        spills = [SpillableColumnarBatch.from_device(b)
+                  for b in self.child.execute_partition(pidx)]
+        if not spills:
             return
-        yield device_sort_batch(concat_batches(batches), self.specs)
+        budget = self._run_budget()
+        est = sum(sb.sized_nbytes for sb in spills)
+        fits = not FORCE_OUT_OF_CORE_SORT and \
+            (budget is None or est <= budget)
+        if fits:
+            def attempt():
+                maybe_inject_oom()
+                bs = [sb.get_batch() for sb in spills]
+                big = concat_batches(bs) if len(bs) > 1 else bs[0]
+                return device_sort_batch(big, self.specs)
+            try:
+                out = with_retry_no_split(None, attempt)
+                for sb in spills:
+                    sb.close()
+                yield out
+                return
+            except SplitAndRetryOOM:
+                pass  # the input must be processed in pieces
+        yield from self._external_sort(spills, budget)
+
+    def _external_sort(self, spills, budget):
+        """Sorted runs -> spillable host staging -> packed-key merge."""
+        import numpy as np
+        import pyarrow as pa
+        from spark_rapids_tpu.columnar.batch import batch_from_arrow
+        from spark_rapids_tpu.exec.basic import upload_batches
+        from spark_rapids_tpu.memory.retry import with_retry_no_split
+        from spark_rapids_tpu.memory.spillable import SpillableColumnarBatch
+        from spark_rapids_tpu.ops import concat_batches
+        global EXTERNAL_SORT_EVENTS
+        EXTERNAL_SORT_EVENTS += 1
+        run_budget = budget if budget and budget > 0 else 64 << 20
+        # ---- build device-sorted runs, staged to spillable host memory ----
+        runs: List[SpillableColumnarBatch] = []
+        group: List[SpillableColumnarBatch] = []
+        group_bytes = 0
+
+        def flush_group():
+            nonlocal group, group_bytes
+            if not group:
+                return
+            bs = [sb.get_batch() for sb in group]
+            big = concat_batches(bs) if len(bs) > 1 else bs[0]
+            sorted_run = with_retry_no_split(
+                None, lambda: device_sort_batch(big, self.specs))
+            hb = sorted_run.to_host()
+            for sb in group:
+                sb.close()
+            runs.append(SpillableColumnarBatch.from_host(hb))
+            group, group_bytes = [], 0
+
+        for sb in spills:
+            if group and group_bytes + sb.sized_nbytes > run_budget:
+                flush_group()
+            group.append(sb)
+            group_bytes += sb.sized_nbytes
+        flush_group()
+        # ---- merge runs by packed order-word keys ----
+        host_runs = [r.get_host_batch() for r in runs]
+        widths = probe_string_widths(host_runs, self.specs)
+        keys = np.concatenate([merge_key_bytes(hb, self.specs, widths)
+                               for hb in host_runs])
+        order = np.argsort(keys, kind="stable")  # stable: run order on ties
+        tab = pa.Table.from_batches([hb.to_arrow() for hb in host_runs])
+        names = host_runs[0].names
+        for r in runs:
+            r.close()
+        total = tab.num_rows
+        out_host = []
+        for off in range(0, total, _MERGE_OUT_ROWS):
+            idx = order[off:off + _MERGE_OUT_ROWS]
+            piece = batch_from_arrow(tab.take(pa.array(idx)))
+            piece.names = names
+            out_host.append(piece)
+        yield from upload_batches(out_host)
 
     def node_desc(self):
         ks = ", ".join(f"{s.expr.sql()} {'ASC' if s.ascending else 'DESC'}"

@@ -147,3 +147,78 @@ def test_cpu_sort_large_int64_with_nulls():
     scan = CpuInMemoryScanExec([[hb]], hb.schema)
     out = _run_both(scan, [SortSpec(_ref(0), True)])
     assert out.to_pydict()["a"] == [None, big, big + 1, big + 2, big + 3]
+
+
+# -- out-of-core sort: sorted runs + packed-key merge (GpuSortExec:633) ----
+
+@pytest.fixture
+def force_external_sort():
+    from spark_rapids_tpu.exec import sort as S
+    S.FORCE_OUT_OF_CORE_SORT = True
+    yield S
+    S.FORCE_OUT_OF_CORE_SORT = False
+
+
+def _multi_batch_scan(rng, n=9000, batches=5, with_strings=True):
+    """One partition fed by several batches -> several sorted runs."""
+    per = n // batches
+    out = []
+    for i in range(batches):
+        d = {"a": rng.integers(-500, 500, per),
+             "f": np.where(rng.random(per) < 0.05, np.nan,
+                           rng.normal(size=per))}
+        if with_strings:
+            words = np.array(["", "a", "ab", "zz", "alpha", "Beta", "ζeta"])
+            d["s"] = words[rng.integers(0, len(words), per)]
+        out.append(batch_from_pydict(d))
+    return CpuInMemoryScanExec([out], out[0].schema)
+
+
+def test_external_sort_matches_oracle(rng, force_external_sort):
+    S = force_external_sort
+    before = S.EXTERNAL_SORT_EVENTS
+    scan = _multi_batch_scan(rng)
+    _run_both(scan, [SortSpec(_ref(0), True)])
+    assert S.EXTERNAL_SORT_EVENTS > before, "external path did not engage"
+
+
+def test_external_sort_multikey_strings_floats(rng, force_external_sort):
+    scan = _multi_batch_scan(rng)
+    _run_both(scan, [SortSpec(_ref(2, T.STRING), True),
+                     SortSpec(_ref(1, T.DOUBLE), False)])
+    _run_both(scan, [SortSpec(_ref(1, T.DOUBLE), True, nulls_first=False),
+                     SortSpec(_ref(0), False)])
+
+
+def test_external_sort_stability(force_external_sort):
+    """Equal keys keep input order across run boundaries (stable merge)."""
+    b1 = batch_from_pydict({"k": np.array([1, 1, 2]),
+                            "tag": np.array([10, 11, 12])})
+    b2 = batch_from_pydict({"k": np.array([1, 2, 2]),
+                            "tag": np.array([20, 21, 22])})
+    scan = CpuInMemoryScanExec([[b1, b2]], b1.schema)
+    out = _run_both(scan, [SortSpec(_ref(0), True)])
+    assert out.to_pydict()["tag"] == [10, 11, 20, 12, 21, 22]
+
+
+def test_sort_split_oom_injection_falls_back(rng):
+    """A SplitAndRetryOOM in the fast-path attempt (deterministically the
+    first tracked point after the per-batch spill registrations) must
+    push the sort to the external path, still matching the oracle."""
+    from spark_rapids_tpu.exec import sort as S
+    from spark_rapids_tpu.memory import retry as R
+    scan = _multi_batch_scan(rng, n=4000, batches=4, with_strings=False)
+    specs = [SortSpec(_ref(0), True)]
+    cpu = CpuSortExec(specs, scan).collect_host()
+    before = S.EXTERNAL_SORT_EVENTS
+    # 4 child batches -> 4 from_device catalog adds before the attempt
+    from spark_rapids_tpu.config import default_conf
+    from spark_rapids_tpu.plan.overrides import insert_transitions
+    plan = insert_transitions(TpuSortExec(specs, scan), default_conf())
+    R.force_split_and_retry_oom(1, skip=4)
+    try:
+        tpu = plan.collect_host()
+    finally:
+        R.force_split_and_retry_oom(0)
+    assert S.EXTERNAL_SORT_EVENTS > before, "fallback did not engage"
+    assert_batches_equal(cpu, tpu, check_order=True)
