@@ -320,26 +320,71 @@ class CpuWindowExec(UnaryExec):
                     if not isinstance(wv[0], float) else float(np.sum(wv))
             elif agg == "mean":
                 out[i] = float(np.sum(wv) / len(wv))
-            elif agg == "min":
-                out[i] = min(wv)
-            elif agg == "max":
-                out[i] = max(wv)
+            elif agg in ("min", "max"):
+                # Spark NaN-greatest: min skips NaN unless all-NaN; max is
+                # NaN when any NaN present (python min/max would propagate
+                # NaN position-dependently)
+                nan = [v for v in wv
+                       if isinstance(v, float) and math.isnan(v)]
+                real = [v for v in wv
+                        if not (isinstance(v, float) and math.isnan(v))]
+                if agg == "min":
+                    out[i] = min(real) if real else float("nan")
+                else:
+                    out[i] = float("nan") if nan else max(wv)
+
+
+#: test hook: force the batched running-window path
+FORCE_RUNNING_WINDOW = False
+#: observability: bumped once per running-window (batched) pass
+RUNNING_WINDOW_EVENTS = 0
+
+
+def _running_eligible(lowered: List[LoweredWindow]) -> bool:
+    """True when every window column is a running computation over
+    (UNBOUNDED PRECEDING, CURRENT ROW) ROWS frames or a rank-family
+    function — the shapes whose state is a fixed-size carry (reference:
+    GpuRunningWindowExec.scala:220 isRunningWindow)."""
+    for low in lowered:
+        k = low.func[0]
+        if k in ("row_number", "rank", "dense_rank"):
+            continue
+        if k == "agg":
+            _, agg, _, fk, lo, hi, _cvo = low.func
+            if agg in ("sum", "count", "min", "max") and fk == "rows" and \
+                    lo is None and hi == 0:
+                continue
+        return False
+    return True
+
+
+class _HandoffBatchesScan(Exec):
+    """Feeds already-produced device batches to a wrapping exec,
+    DESTRUCTIVELY: each yielded batch is dropped from the list, so once
+    the consumer has registered it (TpuSortExec wraps every input batch
+    spillable immediately), this scan no longer pins it — the catalog
+    can spill the whole input under pressure."""
+
+    is_device = True
+
+    def __init__(self, batches: List[ColumnarBatch], schema: T.StructType):
+        super().__init__([])
+        self._batches = batches
+        self._schema = schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def execute_partition(self, pidx):
+        while self._batches:
+            yield self._batches.pop(0)
 
 
 class TpuWindowExec(CpuWindowExec):
     is_device = True
 
-    def execute_partition(self, pidx):
-        from spark_rapids_tpu.exec.joins import _empty_device
-        from spark_rapids_tpu.expressions.evaluator import eval_exprs_tpu
-        from spark_rapids_tpu.ops.batch_ops import concat_batches
-        from spark_rapids_tpu.ops.window_ops import compute_windows
-        batches = [b for b in self.child.execute_partition(pidx)
-                   if b.row_count]
-        if not batches:
-            return
-        batch = concat_batches(batches)
-        np_ = batch.num_columns
+    def _funcs_with_ordinals(self, np_: int):
         pkeys = self.spec.partition_exprs
         okeys = [e for e, _, _ in self.spec.order_specs]
         extra = list(pkeys) + list(okeys)
@@ -352,7 +397,15 @@ class TpuWindowExec(CpuWindowExec):
                 f[f.index(-1)] = next_val
                 next_val += len(low.inputs)
             funcs.append(tuple(f))
-        # evaluate pkeys+okeys+inputs once, append to the batch
+        return funcs, extra
+
+    def _window_one(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Fused sort + window over ONE batch (the whole-partition path)."""
+        from spark_rapids_tpu.expressions.evaluator import eval_exprs_tpu
+        from spark_rapids_tpu.ops.window_ops import compute_windows
+        np_ = batch.num_columns
+        pkeys = self.spec.partition_exprs
+        funcs, extra = self._funcs_with_ordinals(np_)
         all_inputs = [x for low in self.lowered for x in low.inputs]
         aug_cols = list(batch.columns)
         if extra or all_inputs:
@@ -367,7 +420,243 @@ class TpuWindowExec(CpuWindowExec):
         out.names = list(batch.names or
                          [f.name for f in self.child.schema.fields]) + \
             [name for name, _ in self.window_cols]
-        yield out
+        return out
+
+    def _batch_budget(self):
+        from spark_rapids_tpu.memory.device_manager import \
+            free_device_headroom
+        return free_device_headroom(4)
+
+    def execute_partition(self, pidx):
+        from spark_rapids_tpu.ops.batch_ops import concat_batches
+        batches = [b for b in self.child.execute_partition(pidx)
+                   if b.row_count]
+        if not batches:
+            return
+        if _running_eligible(self.lowered):
+            # even ONE oversized batch profits: the sort stage below
+            # chunks its output under the same pressure, and the carry
+            # then bounds this exec to one chunk at a time
+            budget = self._batch_budget()
+            est = sum(b.nbytes() for b in batches)
+            if FORCE_RUNNING_WINDOW or (budget is not None and
+                                        est > budget):
+                yield from self._running_windows(batches)
+                batches = None   # handed off — nothing pinned here
+                return
+        yield self._window_one(concat_batches(batches))
+
+    def _running_windows(self, batches: List[ColumnarBatch]):
+        """Batched running windows (reference: GpuRunningWindowExec.scala:220
+        GpuRunningWindowIterator — fixed-size carry state across batches).
+
+        The input is first globally sorted by (partition keys, order keys)
+        through TpuSortExec — whose own out-of-core path bounds device
+        residency — then each sorted batch runs the fused per-batch window
+        kernel and a carry fix-up: rows continuing the previous batch's
+        last partition get their running aggregates/ranks shifted by the
+        carried state, and the state advances from the batch's last row.
+        The full partition is never resident at once.
+        """
+        global RUNNING_WINDOW_EVENTS
+        RUNNING_WINDOW_EVENTS += 1
+        from spark_rapids_tpu.exec.sort import SortSpec, TpuSortExec
+        scan = _HandoffBatchesScan(batches, self.child.schema)
+        specs = [SortSpec(e, True, True) for e in self.spec.partition_exprs]
+        specs += [SortSpec(e, a, nf if nf is not None else None)
+                  for e, a, nf in self.spec.order_specs]
+        sorter = TpuSortExec(specs, scan)
+        carry = None
+        for sorted_batch in sorter.execute_partition(0):
+            out = self._window_one(sorted_batch)
+            out, carry = self._apply_carry(out, carry)
+            yield out
+
+    def _apply_carry(self, out: ColumnarBatch, carry):
+        """Adjusts the leading rows of ``out`` (those continuing the
+        previous batch's last partition group) by the carried running
+        state, and extracts the new carry from the last row."""
+        import jax
+        from spark_rapids_tpu.columnar.column import (DeferredCount,
+                                                      DeviceColumn, _jnp,
+                                                      rc_traceable)
+        from spark_rapids_tpu.expressions.evaluator import eval_exprs_tpu
+        jnp = _jnp()
+        n_payload = out.num_columns - len(self.lowered)
+        pkeys = list(self.spec.partition_exprs)
+        okeys = [e for e, _, _ in self.spec.order_specs]
+        kb = eval_exprs_tpu(pkeys + okeys, out)
+        key_cols = list(kb.columns)
+        win_cols = list(out.columns[n_payload:])
+        sig = (tuple((str(c.data_type), tuple(c.data.shape),
+                      c.lengths is not None) for c in key_cols),
+               tuple((str(c.data_type), tuple(c.data.shape))
+                     for c in win_cols),
+               tuple(low.func[:2] for low in self.lowered),
+               len(pkeys), out.bucket, carry is None)
+        fn = _FIXUP_CACHE.get(sig)
+        if fn is None:
+            fn = jax.jit(_make_running_fixup(
+                [c.data_type for c in key_cols], len(pkeys),
+                [low.func for low in self.lowered],
+                [c.data_type for c in win_cols], out.bucket,
+                first=carry is None))
+            _FIXUP_CACHE[sig] = fn
+        key_arrs = [(c.data, c.validity, c.lengths) for c in key_cols]
+        win_arrs = [(c.data, c.validity) for c in win_cols]
+        fixed, new_carry = fn(key_arrs, win_arrs,
+                              rc_traceable(out.row_count), carry)
+        rc = out.row_count
+        n = rc if isinstance(rc, int) else DeferredCount(rc_traceable(rc))
+        cols = list(out.columns[:n_payload])
+        for (d, v), c in zip(fixed, win_cols):
+            cols.append(DeviceColumn(d, v, n, c.data_type, c.lengths))
+        return ColumnarBatch(cols, out.row_count, out.names), new_carry
+
+
+_FIXUP_CACHE: dict = {}
+
+
+def _spark_minmax(agg: str, a, b, jnp, dt):
+    """Two-value combine with Spark NaN-greatest float semantics."""
+    if isinstance(dt, (T.FloatType, T.DoubleType)):
+        na, nb = jnp.isnan(a), jnp.isnan(b)
+        if agg == "min":       # NaN is largest: prefer the non-NaN side
+            return jnp.where(na, b, jnp.where(nb, a, jnp.minimum(a, b)))
+        return jnp.where(na, a, jnp.where(nb, b, jnp.maximum(a, b)))
+    return jnp.minimum(a, b) if agg == "min" else jnp.maximum(a, b)
+
+
+def _make_running_fixup(key_dtypes, n_pkeys: int, funcs, win_dtypes,
+                        bucket: int, first: bool):
+    """Builds the traced carry fix-up: adjusts running outputs for rows
+    continuing the carried partition group and extracts the next carry
+    from the batch's last row.  One jit per signature."""
+    import numpy as np_
+
+    def run(key_arrs, win_arrs, rc, carry):
+        from spark_rapids_tpu.columnar.column import DeviceColumn, _jnp
+        from spark_rapids_tpu.ops.agg_ops import _masked_group_words
+        jnp = _jnp()
+        inrow = jnp.arange(bucket) < rc
+        last = jnp.clip(rc - 1, 0, bucket - 1)
+        kcols = [DeviceColumn(d, v, bucket, dt, ln)
+                 for (d, v, ln), dt in zip(key_arrs, key_dtypes)]
+        pw, ow = [], []
+        for i, c in enumerate(kcols):
+            (pw if i < n_pkeys else ow).extend(_masked_group_words(c, jnp))
+
+        def eq_words(words, carried):
+            # string keys produce ONE PACKED WORD PER 7 CHARS of the
+            # batch's char rectangle, so two batches of one stream can
+            # disagree on word count; the packing 0-fills beyond the
+            # string length, so the missing trailing words are exactly
+            # zero — extend the shorter side with zeros instead of
+            # silently truncating the comparison (zip would)
+            import itertools
+            eq = jnp.ones(bucket, dtype=bool)
+            for w, cw in itertools.zip_longest(words, carried):
+                if w is None:
+                    eq = eq & (cw == 0 if cw.ndim == 0
+                               else jnp.all(cw == 0))
+                    continue
+                if cw is None:
+                    if w.ndim == 1:
+                        eq = eq & (w == 0)
+                    else:
+                        eq = eq & jnp.all(w == 0, axis=-1)
+                    continue
+                if w.ndim == 1:
+                    eq = eq & (w == cw)
+                else:
+                    if cw.shape[0] != w.shape[1]:
+                        width = max(cw.shape[0], w.shape[1])
+                        cw = jnp.pad(cw, (0, width - cw.shape[0]))
+                        w = jnp.pad(w, ((0, 0), (0, width - w.shape[1])))
+                    eq = eq & jnp.all(w == cw[None, :], axis=-1)
+            return eq
+
+        def cum_all(mask):
+            return jnp.cumprod(mask.astype(np_.int32)).astype(bool)
+
+        zero = jnp.asarray(0, dtype=np_.int64)
+        if first:
+            prefix = jnp.zeros(bucket, dtype=bool)
+            same_peer = prefix
+            c_rows = c_rank = c_dense = zero
+            c_aggs = []
+        else:
+            prefix = cum_all(eq_words(pw, carry["pw"]) & inrow) & inrow
+            same_peer = cum_all(eq_words(pw, carry["pw"]) &
+                                eq_words(ow, carry["ow"]) & inrow) & prefix
+            c_rows, c_rank = carry["rows"], carry["rank"]
+            c_dense = carry["dense"]
+            c_aggs = carry["aggs"]
+        cont = same_peer[0]       # first row continues the carried peers
+
+        fixed = []
+        new_aggs = []
+        ai = 0
+        rank_last = dense_last = zero
+        for fi, (f, dt) in enumerate(zip(funcs, win_dtypes)):
+            d, v = win_arrs[fi]
+            kind = f[0]
+            if kind == "row_number":
+                d2 = jnp.where(prefix, d + c_rows.astype(d.dtype), d)
+                v2 = v
+            elif kind == "rank":
+                d2 = jnp.where(same_peer, c_rank.astype(d.dtype),
+                               jnp.where(prefix,
+                                         d + c_rows.astype(d.dtype), d))
+                v2 = v
+                rank_last = d2[last].astype(np_.int64)
+            elif kind == "dense_rank":
+                adj = c_dense - cont.astype(np_.int64)
+                d2 = jnp.where(prefix, d + adj.astype(d.dtype), d)
+                v2 = v
+                dense_last = d2[last].astype(np_.int64)
+            else:
+                agg = f[1]
+                if first:
+                    d2, v2 = d, v
+                else:
+                    acc_d, acc_v = c_aggs[2 * ai], c_aggs[2 * ai + 1]
+                    acc_d = acc_d.astype(d.dtype)
+                    if agg == "count":
+                        d2 = jnp.where(prefix, d + acc_d, d)
+                        v2 = v
+                    elif agg == "sum":
+                        base = jnp.where(v, d, jnp.zeros_like(d))
+                        addend = jnp.where(acc_v, acc_d,
+                                           jnp.zeros_like(acc_d))
+                        d2 = jnp.where(prefix, base + addend, d)
+                        v2 = v | (prefix & acc_v)
+                    else:  # min / max
+                        comb = _spark_minmax(agg, d, acc_d, jnp, dt)
+                        pick = jnp.where(v & acc_v, comb,
+                                         jnp.where(v, d, acc_d))
+                        d2 = jnp.where(prefix, pick, d)
+                        v2 = v | (prefix & acc_v)
+                new_aggs.append(d2[last])
+                new_aggs.append(v2[last])
+                ai += 1
+            fixed.append((d2, v2))
+        # next carry: rows of the batch's last partition group (+ the
+        # carried rows when the whole batch continued one group)
+        eql = eq_words(pw, [w[last] for w in pw])
+        count_last = jnp.sum((eql & inrow).astype(np_.int64))
+        rows_new = count_last + jnp.where(prefix[last], c_rows, zero)
+        new_carry = {
+            "pw": [w[last] for w in pw],
+            "ow": [w[last] for w in ow],
+            "rows": rows_new,
+            "rank": rank_last,
+            "dense": dense_last,
+            "aggs": new_aggs,
+        }
+        return fixed, new_carry
+
+    return run
 
 
 # plan-rewrite registration (reference: GpuOverrides WindowExec rule +

@@ -227,11 +227,6 @@ def _one_func(f, scols, jnp, rowpos, inrow, seg, sfp, slp, pfp, plp,
                 src = inrow
             else:
                 src = present
-            x = c.data
-            if agg != "count":
-                z = jnp.where(present, x, jnp.zeros_like(x))
-                cs = jnp.cumsum(z, axis=0)
-            n_ = jnp.cumsum(src.astype(np.int64))
 
             def win(csum, zrow):
                 at_hi = jnp.take(csum, jnp.clip(hi_pos, 0, bucket - 1),
@@ -241,57 +236,120 @@ def _one_func(f, scols, jnp, rowpos, inrow, seg, sfp, slp, pfp, plp,
                     jnp.take(zrow, lo_c, axis=0)
                 return at_hi - at_lo
 
+            n_ = jnp.cumsum(src.astype(np.int64))
             cnt = win(n_, src.astype(np.int64))
             cnt = jnp.where(empty, 0, cnt)
             if agg == "count":
                 return (cnt.astype(np.int64), inrow, None)
+            x = c.data
+            is_float = jnp.issubdtype(x.dtype, jnp.inexact)
+            if is_float:
+                # the prefix-sum difference trick NaN/inf-poisons: one NaN
+                # (or inf: inf - inf = NaN) anywhere in the batch corrupts
+                # every LATER window, across segment boundaries.  Sum the
+                # finite values only and recover IEEE results from exact
+                # integer occurrence counters per window.
+                isn = jnp.isnan(x)
+                isp = present & (x == np.inf)
+                ism = present & (x == -np.inf)
+                nan_i = (present & isn).astype(np.int64)
+                z = jnp.where(present & ~isn & ~isp & ~ism, x,
+                              jnp.zeros_like(x))
+            else:
+                z = jnp.where(present, x, jnp.zeros_like(x))
+            cs = jnp.cumsum(z, axis=0)
             s = win(cs, z)
             s = jnp.where(empty | (cnt == 0), jnp.zeros_like(s), s)
+            if is_float:
+                nan_w = win(jnp.cumsum(nan_i), nan_i) > 0
+                p_i = isp.astype(np.int64)
+                m_i = ism.astype(np.int64)
+                p_w = win(jnp.cumsum(p_i), p_i) > 0
+                m_w = win(jnp.cumsum(m_i), m_i) > 0
+                s = jnp.where(nan_w | (p_w & m_w),
+                              jnp.asarray(np.nan, s.dtype),
+                              jnp.where(p_w, jnp.asarray(np.inf, s.dtype),
+                                        jnp.where(m_w,
+                                                  jnp.asarray(-np.inf,
+                                                              s.dtype), s)))
             ok = inrow & (cnt > 0)
             if agg == "sum":
                 return (s, ok, None)
             mean = s / jnp.where(cnt > 0, cnt, 1).astype(s.dtype)
             return (mean, ok, None)
         if agg in ("min", "max"):
+            # Spark NaN-greatest float semantics: min skips NaN (NaN only
+            # when the frame has no real value); max is NaN when any NaN
+            # is present.  NaN must not ride jnp.minimum/maximum (both
+            # propagate it unconditionally).
             ident = _identity_for(agg, c.data.dtype, jnp)
-            z = jnp.where(present, c.data, ident)
+            is_float = jnp.issubdtype(c.data.dtype, jnp.inexact)
+            if is_float:
+                isn = jnp.isnan(c.data)
+                pres_val = present & ~isn      # contributes a real value
+                # aux indicator: min -> "any real value"; max -> "any NaN"
+                pres_aux = pres_val if agg == "min" else (present & isn)
+                nanv = jnp.asarray(np.nan, c.data.dtype)
+            else:
+                pres_val = present
+                pres_aux = None
+            z = jnp.where(pres_val, c.data, ident)
             op = jnp.minimum if agg == "min" else jnp.maximum
+
+            def patch(d, aux):
+                if not is_float:
+                    return d
+                if agg == "min":
+                    return jnp.where(aux, d, nanv)
+                return jnp.where(aux, nanv, d)
+
             bounded = lo is not None and hi is not None and fkind == "rows"
             if bounded:
                 acc = jnp.full(bucket, ident, dtype=c.data.dtype)
                 got = jnp.zeros(bucket, dtype=bool)
+                got_aux = jnp.zeros(bucket, dtype=bool)
                 for off in range(lo, hi + 1):
                     idx = rowpos + off
                     ok_i = (idx >= lo_pos) & (idx <= hi_pos)
                     safe = jnp.clip(idx, 0, bucket - 1)
                     val = jnp.take(z, safe, axis=0)
-                    pres = jnp.take(present, safe, axis=0) & ok_i
-                    acc = jnp.where(pres, op(acc, val), acc)
-                    got = got | pres
-                return (acc, got & inrow, None)
+                    pv = jnp.take(pres_val, safe, axis=0) & ok_i
+                    acc = jnp.where(pv, op(acc, val), acc)
+                    got = got | (jnp.take(present, safe, axis=0) & ok_i)
+                    if is_float:
+                        got_aux = got_aux | \
+                            (jnp.take(pres_aux, safe, axis=0) & ok_i)
+                return (patch(acc, got_aux), got & inrow, None)
             seg_b_here = rowpos == sfp
             if lo is None and (hi is None or fkind == "range" or hi == 0):
                 run_f = _seg_scan(z, seg_b_here, op, jnp)
                 have_f = _seg_scan(present.astype(np.int32), seg_b_here,
                                    jnp.add, jnp) > 0
+                aux_f = None if not is_float else _seg_scan(
+                    pres_aux.astype(np.int32), seg_b_here, jnp.add, jnp) > 0
                 if hi is None:       # whole partition
-                    d = jnp.take(run_f, slp, axis=0)
-                    v = jnp.take(have_f, slp, axis=0)
+                    pos = slp
                 else:
                     pos = plp if fkind == "range" else rowpos
-                    d = jnp.take(run_f, pos, axis=0)
-                    v = jnp.take(have_f, pos, axis=0)
+                d = jnp.take(run_f, pos, axis=0)
+                v = jnp.take(have_f, pos, axis=0)
+                if is_float:
+                    d = patch(d, jnp.take(aux_f, pos, axis=0))
                 return (d, v & inrow, None)
             if hi is None and lo == 0 and fkind == "rows":
                 # current-to-unbounded: reversed segmented scan
                 z_r = z[::-1]
-                pres_r = present[::-1]
                 # boundary in reversed domain = last row of each partition
                 b_r = (rowpos == slp)[::-1]
                 run_r = _seg_scan(z_r, b_r, op, jnp)[::-1]
-                have_r = _seg_scan(pres_r.astype(np.int32), b_r, jnp.add,
-                                   jnp)[::-1] > 0
-                return (run_r, have_r & inrow, None)
+                have_r = _seg_scan(present[::-1].astype(np.int32), b_r,
+                                   jnp.add, jnp)[::-1] > 0
+                d = run_r
+                if is_float:
+                    aux_r = _seg_scan(pres_aux[::-1].astype(np.int32), b_r,
+                                      jnp.add, jnp)[::-1] > 0
+                    d = patch(d, aux_r)
+                return (d, have_r & inrow, None)
             raise NotImplementedError(f"min/max frame {fkind} {lo} {hi}")
         raise NotImplementedError(f"window agg {agg}")
     raise NotImplementedError(f"window func {kind}")

@@ -229,3 +229,113 @@ def test_bounded_range_frame_rejected():
     with _pt.raises(NotImplementedError, match="RANGE"):
         df.select(F.Alias(F.sum("v").over(
             W_GO().range_between(-1, 0)), "a")).collect()
+
+
+# -- batched running windows (GpuRunningWindowExec.scala:220 analog) --------
+
+@pytest.fixture
+def force_running_window():
+    """Forces the running path AND the sort stage's external chunking
+    (small output chunks) so the carry crosses several batches — in
+    production both engage together under the same memory pressure."""
+    from spark_rapids_tpu.exec import sort as S
+    from spark_rapids_tpu.exec import window as W
+    W.FORCE_RUNNING_WINDOW = True
+    S.FORCE_OUT_OF_CORE_SORT = True
+    prev_rows = S._MERGE_OUT_ROWS
+    S._MERGE_OUT_ROWS = 700
+    yield W
+    W.FORCE_RUNNING_WINDOW = False
+    S.FORCE_OUT_OF_CORE_SORT = False
+    S._MERGE_OUT_ROWS = prev_rows
+
+
+def _big_data(n=6000, ngroups=7, seed=2):
+    rng = np.random.default_rng(seed)
+    g = rng.integers(0, ngroups, n)
+    o = rng.integers(0, 50, n)          # heavy ties -> peer groups
+    v = rng.normal(size=n)
+    v = np.where(rng.random(n) < 0.04, np.nan, v)
+    import pyarrow as pa
+    vmask = rng.random(n) < 0.08
+    return {"g": pa.array(g), "o": pa.array(o),
+            "v": pa.array(v, mask=vmask)}
+
+
+def _running_frame():
+    return W_GO().rows_between(Window.unbounded_preceding,
+                               Window.current_row)
+
+
+def test_running_window_ranks_multi_batch(force_running_window):
+    Wm = force_running_window
+    before = Wm.RUNNING_WINDOW_EVENTS
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_big_data(), num_partitions=4)
+        .select(F.col("g"), F.col("o"),
+                F.Alias(F.row_number().over(W_GO()), "rn"),
+                F.Alias(F.rank().over(W_GO()), "r"),
+                F.Alias(F.dense_rank().over(W_GO()), "dr")),
+        ignore_order=True)
+    assert Wm.RUNNING_WINDOW_EVENTS > before, "running path did not engage"
+
+
+def test_running_window_aggs_multi_batch(force_running_window):
+    # unique order keys: running sums over TIED keys are tie-order
+    # dependent and so not comparable across engines with NaN present
+    d = _big_data()
+    d["o"] = np.arange(len(d["o"]))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(d, num_partitions=4)
+        .select(F.col("g"), F.col("o"), F.col("v"),
+                F.Alias(F.sum("v").over(_running_frame()), "rs"),
+                F.Alias(F.count("v").over(_running_frame()), "rc"),
+                F.Alias(F.min("v").over(_running_frame()), "rmin"),
+                F.Alias(F.max("v").over(_running_frame()), "rmax")),
+        ignore_order=True, approx_float=True)
+
+
+def test_running_window_single_group_spans_batches(force_running_window):
+    """One partition key across every batch: the carry chains through
+    the whole stream."""
+    n = 3000
+    rng = np.random.default_rng(9)
+    d = {"g": np.ones(n, dtype=np.int64),
+         "o": np.arange(n) % 97,
+         "v": rng.integers(0, 10, n).astype(np.int64)}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(d, num_partitions=3)
+        .select(F.col("o"),
+                F.Alias(F.row_number().over(W_GO()), "rn"),
+                F.Alias(F.rank().over(W_GO()), "r"),
+                F.Alias(F.sum("v").over(_running_frame()), "rs")),
+        ignore_order=True)
+
+
+def test_running_window_not_eligible_falls_back(force_running_window):
+    """lag is not a running shape -> the concat path must be used and
+    still match."""
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_big_data(1500), num_partitions=3)
+        .select(F.col("g"), F.col("o"), F.col("v"),
+                F.Alias(F.lag("v", 1).over(W_GO()), "lg")),
+        ignore_order=True, approx_float=True)
+
+
+def test_window_sum_nan_inf_no_poison():
+    """One NaN/inf must affect only frames CONTAINING it — the prefix-sum
+    difference trick would otherwise poison every later row (found by the
+    running-window differential tests, fixed in ops/window_ops.py)."""
+    import pyarrow as pa
+    d = {"g": pa.array([0, 0, 0, 1, 1, 2, 2, 3, 3]),
+         "o": pa.array(list(range(9))),
+         "v": pa.array([1.0, float("nan"), 2.0, float("inf"), 3.0,
+                        float("-inf"), float("inf"), 4.0, 5.0])}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(d, num_partitions=1)
+        .select(F.col("g"), F.col("o"),
+                F.Alias(F.sum("v").over(_running_frame()), "rs"),
+                F.Alias(F.avg("v").over(_running_frame()), "ra"),
+                F.Alias(F.min("v").over(_running_frame()), "rmin"),
+                F.Alias(F.max("v").over(_running_frame()), "rmax")),
+        ignore_order=True, approx_float=True)
