@@ -17,17 +17,26 @@ from spark_rapids_tpu.io.multifile import (AUTO, MultiFileScanBase,
                                            chunked_write, tpu_scan_of)
 
 
+#: observability: stripes skipped by statistics since process start
+#: (tests assert the pushdown actually prunes)
+STRIPES_SKIPPED = 0
+
+
 class CpuOrcScanExec(MultiFileScanBase):
     format_name = "orc"
     file_ext = ".orc"
 
     def __init__(self, paths: Sequence[str],
                  columns: Optional[List[str]] = None,
+                 predicate=None,
                  reader_type: str = AUTO, batch_rows: int = 1 << 20,
                  num_threads: int = 8):
         super().__init__(paths, reader_type=reader_type,
                          batch_rows=batch_rows, num_threads=num_threads)
         self.columns = columns
+        #: pushed-down predicate: used for stats-based stripe skipping
+        #: (conservative — the planner keeps the exact Filter above)
+        self.predicate = predicate
 
     def infer_schema(self) -> T.StructType:
         import pyarrow.orc as porc
@@ -41,10 +50,16 @@ class CpuOrcScanExec(MultiFileScanBase):
 
     def read_file(self, path: str) -> Iterator[HostColumnarBatch]:
         import pyarrow.orc as porc
+        from spark_rapids_tpu.io.orc_meta import surviving_stripes
+        global STRIPES_SKIPPED
         f = porc.ORCFile(path)
         # stripe-at-a-time read (the reference decodes stripe ranges; stripes
-        # are the ORC row-group analog and bound host memory per step)
-        for i in range(f.nstripes):
+        # are the ORC row-group analog and bound host memory per step),
+        # filtered against the file-tail stripe statistics first
+        # (reference: GpuOrcScan.scala host stripe filter)
+        keep = surviving_stripes(path, self.predicate, f.nstripes)
+        STRIPES_SKIPPED += f.nstripes - len(keep)
+        for i in keep:
             tbl = f.read_stripe(i, columns=self.columns)
             import pyarrow as pa
             if isinstance(tbl, pa.RecordBatch):
@@ -60,7 +75,10 @@ TpuOrcScanExec, _orc_convert = tpu_scan_of(CpuOrcScanExec)
 from spark_rapids_tpu.plan.overrides import register_exec  # noqa: E402
 
 register_exec(CpuOrcScanExec, convert=_orc_convert,
-              desc="ORC scan (host stripe decode + device upload)")
+              exprs_of=lambda p: [p.predicate]
+              if p.predicate is not None else [],
+              desc="ORC scan (stripe-stats pruning + host stripe decode "
+                   "+ device upload)")
 
 
 def write_orc(batches, path: str, schema: Optional[T.StructType] = None):

@@ -1,0 +1,303 @@
+"""ORC file-tail metadata: postscript/footer/stripe-statistics parsing and
+stats-based stripe predicate filtering.
+
+Reference: ``GpuOrcScan.scala`` (the host side reads the ORC tail, filters
+stripes against the pushed-down predicate, and only decodes surviving
+stripe ranges — GpuOrcScan.scala:2918 host stripe filter).  pyarrow's ORC
+reader exposes no stripe statistics, so the tail is parsed here directly:
+a minimal protobuf TLV walk over the ORC spec's Postscript / Footer /
+Metadata messages (https://orc.apache.org/specification/ — public format),
+handling UNCOMPRESSED and ZLIB tails (pyarrow's writer emits these).
+
+Conservative contract: any stripe whose statistics cannot PROVE the
+predicate unsatisfiable is kept; unknown codecs/types keep every stripe.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import Expression
+
+
+# -- minimal protobuf wire-format walk --------------------------------------
+
+def _varint(buf: bytes, i: int) -> Tuple[int, int]:
+    shift = 0
+    out = 0
+    while True:
+        b = buf[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+
+
+def _zigzag(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)
+
+
+def _fields(buf: bytes):
+    """Yields (field_no, wire_type, value) over one protobuf message."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        tag, i = _varint(buf, i)
+        fno, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _varint(buf, i)
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = buf[i:i + 4]
+            i += 4
+        elif wt == 1:
+            v = buf[i:i + 8]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fno, wt, v
+
+
+def _decompress(buf: bytes, codec: int, block: int) -> bytes:
+    """ORC compressed streams: 3-byte chunk headers (len << 1 | original)."""
+    if codec == 0:           # NONE
+        return buf
+    out = bytearray()
+    i = 0
+    while i + 3 <= len(buf):
+        hdr = buf[i] | (buf[i + 1] << 8) | (buf[i + 2] << 16)
+        i += 3
+        ln = hdr >> 1
+        chunk = buf[i:i + ln]
+        i += ln
+        if hdr & 1:          # original (stored uncompressed)
+            out += chunk
+        elif codec == 1:     # ZLIB (raw deflate)
+            out += zlib.decompress(chunk, wbits=-15)
+        else:                # SNAPPY/LZO/LZ4/ZSTD: not parsed here
+            raise NotImplementedError(f"ORC codec {codec}")
+    return bytes(out)
+
+
+# -- column statistics -------------------------------------------------------
+
+class ColumnStats:
+    """min/max/has_null for one column of one stripe (None = unknown)."""
+
+    __slots__ = ("num_values", "minimum", "maximum", "has_null")
+
+    def __init__(self):
+        self.num_values: Optional[int] = None
+        self.minimum = None
+        self.maximum = None
+        self.has_null: Optional[bool] = None
+
+    def __repr__(self):
+        return (f"ColumnStats(n={self.num_values}, min={self.minimum!r}, "
+                f"max={self.maximum!r}, nulls={self.has_null})")
+
+
+def _parse_col_stats(buf: bytes) -> ColumnStats:
+    cs = ColumnStats()
+    for fno, wt, v in _fields(buf):
+        if fno == 1 and wt == 0:          # numberOfValues
+            cs.num_values = v
+        elif fno == 2 and wt == 2:        # IntegerStatistics
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1 and w2 == 0:
+                    cs.minimum = _zigzag(v2)
+                elif f2 == 2 and w2 == 0:
+                    cs.maximum = _zigzag(v2)
+        elif fno == 3 and wt == 2:        # DoubleStatistics
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1 and w2 == 1:
+                    cs.minimum = struct.unpack("<d", v2)[0]
+                elif f2 == 2 and w2 == 1:
+                    cs.maximum = struct.unpack("<d", v2)[0]
+        elif fno == 4 and wt == 2:        # StringStatistics
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1 and w2 == 2:
+                    cs.minimum = v2.decode("utf-8", "replace")
+                elif f2 == 2 and w2 == 2:
+                    cs.maximum = v2.decode("utf-8", "replace")
+        elif fno == 10 and wt == 0:       # hasNull
+            cs.has_null = bool(v)
+    return cs
+
+
+class OrcTail:
+    """Parsed ORC tail: stripe count + per-stripe per-column statistics.
+
+    ``stripe_stats[s][c]`` is the ColumnStats of flattened-schema column
+    ``c`` in stripe ``s`` (column 0 = root struct; top-level field i of a
+    flat schema maps to column i+1)."""
+
+    def __init__(self, nstripes: int,
+                 stripe_stats: List[List[ColumnStats]],
+                 field_names: List[str]):
+        self.nstripes = nstripes
+        self.stripe_stats = stripe_stats
+        self.field_names = field_names
+
+    def col_index(self, name: str) -> Optional[int]:
+        """Flattened column index of a TOP-LEVEL field (flat schemas)."""
+        try:
+            return self.field_names.index(name) + 1
+        except ValueError:
+            return None
+
+
+def read_orc_tail(path: str) -> Optional[OrcTail]:
+    """Parses the ORC tail; None when the tail cannot be parsed (unknown
+    codec, nested schema quirks) — callers then keep every stripe."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, 2)
+            size = f.tell()
+            take = min(size, 16 << 10)
+            f.seek(size - take)
+            tail = f.read(take)
+        ps_len = tail[-1]
+        ps = tail[-1 - ps_len:-1]
+        footer_len = meta_len = 0
+        codec = 0
+        block = 256 << 10
+        for fno, wt, v in _fields(ps):
+            if fno == 1 and wt == 0:
+                footer_len = v
+            elif fno == 2 and wt == 0:
+                codec = v
+            elif fno == 3 and wt == 0:
+                block = v
+            elif fno == 5 and wt == 0:
+                meta_len = v
+        need = 1 + ps_len + footer_len + meta_len
+        if need > len(tail):
+            with open(path, "rb") as f:
+                f.seek(size - need)
+                tail = f.read(need)
+        footer_buf = tail[-1 - ps_len - footer_len:-1 - ps_len]
+        meta_buf = tail[-1 - ps_len - footer_len - meta_len:
+                        -1 - ps_len - footer_len]
+        footer = _decompress(footer_buf, codec, block)
+        meta = _decompress(meta_buf, codec, block) if meta_len else b""
+        # Footer: field 3 = StripeInformation (repeated), field 4 = Type
+        nstripes = 0
+        field_names: List[str] = []
+        for fno, wt, v in _fields(footer):
+            if fno == 3 and wt == 2:
+                nstripes += 1
+            elif fno == 4 and wt == 2 and not field_names:
+                # first Type message = root struct; field 3 = fieldNames
+                for f2, w2, v2 in _fields(v):
+                    if f2 == 3 and w2 == 2:
+                        field_names.append(v2.decode("utf-8", "replace"))
+        # Metadata: field 1 = StripeStatistics { repeated colStats = 1 }
+        stripe_stats: List[List[ColumnStats]] = []
+        for fno, wt, v in _fields(meta):
+            if fno == 1 and wt == 2:
+                cols = [_parse_col_stats(v2)
+                        for f2, w2, v2 in _fields(v) if f2 == 1 and w2 == 2]
+                stripe_stats.append(cols)
+        return OrcTail(nstripes, stripe_stats, field_names)
+    except Exception:
+        return None
+
+
+# -- predicate vs statistics -------------------------------------------------
+
+def _stat_range(tail: OrcTail, stripe: int, name: str):
+    """(min, max, has_null) of a column in a stripe, or None if unknown."""
+    if stripe >= len(tail.stripe_stats):
+        return None
+    ci = tail.col_index(name)
+    if ci is None or ci >= len(tail.stripe_stats[stripe]):
+        return None
+    cs = tail.stripe_stats[stripe][ci]
+    if cs.minimum is None or cs.maximum is None:
+        return None
+    return cs.minimum, cs.maximum, cs.has_null
+
+
+def stripe_may_match(tail: OrcTail, stripe: int,
+                     predicate: Expression) -> bool:
+    """False only when the statistics PROVE no row of the stripe can pass
+    (reference: the SearchArgument evaluation in the ORC host filter)."""
+    from spark_rapids_tpu.expressions import predicates as P
+    from spark_rapids_tpu.expressions.base import (AttributeReference,
+                                                   BoundReference, Literal)
+
+    def col_name(e):
+        if isinstance(e, (AttributeReference, BoundReference)):
+            return getattr(e, "ref_name", None)
+        return None
+
+    def lit_value(e):
+        return e.value if isinstance(e, Literal) else None
+
+    e = predicate
+    if isinstance(e, P.And):
+        return all(stripe_may_match(tail, stripe, c) for c in e.children)
+    if isinstance(e, P.Or):
+        return any(stripe_may_match(tail, stripe, c) for c in e.children)
+    binops = (P.EqualTo, P.LessThan, P.GreaterThan, P.LessThanOrEqual,
+              P.GreaterThanOrEqual)
+    if isinstance(e, binops):
+        left, right = e.children
+        name, val = col_name(left), lit_value(right)
+        flipped = False
+        if name is None:
+            name, val = col_name(right), lit_value(left)
+            flipped = True
+        if name is None or val is None:
+            return True
+        rng = _stat_range(tail, stripe, name)
+        if rng is None:
+            return True
+        lo, hi, _nulls = rng
+        try:
+            if isinstance(e, P.EqualTo):
+                return lo <= val <= hi
+            if (isinstance(e, P.LessThan) and not flipped) or \
+                    (isinstance(e, P.GreaterThan) and flipped):
+                return lo < val          # some row < val possible
+            if (isinstance(e, P.GreaterThan) and not flipped) or \
+                    (isinstance(e, P.LessThan) and flipped):
+                return hi > val
+            if (isinstance(e, P.LessThanOrEqual) and not flipped) or \
+                    (isinstance(e, P.GreaterThanOrEqual) and flipped):
+                return lo <= val
+            return hi >= val
+        except TypeError:
+            return True                  # incomparable types: keep
+    if isinstance(e, P.IsNotNull):
+        name = col_name(e.children[0])
+        if name is None:
+            return True
+        rng = _stat_range(tail, stripe, name)
+        if rng is None:
+            return True
+        _lo, _hi, _nulls = rng
+        # min/max known => at least one non-null value exists
+        return True
+    return True
+
+
+def surviving_stripes(path: str, predicate: Optional[Expression],
+                      nstripes: int) -> List[int]:
+    """Stripe indices that may contain matching rows (all when stats are
+    unavailable or the predicate is None)."""
+    if predicate is None:
+        return list(range(nstripes))
+    tail = read_orc_tail(path)
+    if tail is None or not tail.stripe_stats:
+        return list(range(nstripes))
+    return [s for s in range(nstripes)
+            if stripe_may_match(tail, s, predicate)]

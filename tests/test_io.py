@@ -209,3 +209,68 @@ def test_text_format_roundtrip(tmp_path):
     rows = (s2.read.text(str(out))
             .select(Alias(F.upper(col("value")), "u")).collect())
     assert rows[0]["u"] == "ALPHA"
+
+
+# -- ORC stripe-statistics predicate pushdown (GpuOrcScan host filter) ------
+
+def _write_striped_orc(path, compression, n=200_000):
+    """Sorted column over several small stripes -> disjoint stat ranges."""
+    import pyarrow as pa
+    import pyarrow.orc as porc
+    a = np.arange(n, dtype=np.int64)
+    d = np.arange(n, dtype=np.float64) / 7.0
+    s = np.array([f"k{v:08d}" for v in a])
+    tbl = pa.table({"a": a, "d": d, "s": s})
+    porc.write_table(tbl, path, stripe_size=256 * 1024,
+                     compression=compression)
+    return porc.ORCFile(path).nstripes
+
+
+@pytest.mark.parametrize("compression", ["uncompressed", "zlib"])
+def test_orc_tail_parse_and_stats(tmp_path, compression):
+    from spark_rapids_tpu.io.orc_meta import read_orc_tail
+    p = str(tmp_path / "striped.orc")
+    n = 200_000
+    nstripes = _write_striped_orc(p, compression, n=n)
+    assert nstripes >= 3, f"test file must have several stripes: {nstripes}"
+    tail = read_orc_tail(p)
+    assert tail is not None and tail.nstripes == nstripes
+    assert len(tail.stripe_stats) == nstripes
+    mins = [st[tail.col_index("a")].minimum for st in tail.stripe_stats]
+    maxs = [st[tail.col_index("a")].maximum for st in tail.stripe_stats]
+    assert mins == sorted(mins) and maxs == sorted(maxs)
+    assert mins[0] == 0 and maxs[-1] == n - 1
+
+
+@pytest.mark.parametrize("compression", ["uncompressed", "zlib"])
+def test_orc_stripe_pushdown_prunes_and_is_exact(tmp_path, compression):
+    """Predicate over the sorted column must skip stripes AND return
+    exactly the rows an unpruned host filter returns."""
+    from spark_rapids_tpu.expressions import predicates as P
+    from spark_rapids_tpu.io import orc as orc_mod
+    p = str(tmp_path / "striped.orc")
+    n = 200_000
+    _write_striped_orc(p, compression, n=n)
+    s = tpu_session()
+    before = orc_mod.STRIPES_SKIPPED
+    rows = (s.read.orc(p)
+            .filter(P.GreaterThanOrEqual(col("a"),
+                                         lit(np.int64(n - 1000))))
+            .collect())
+    assert orc_mod.STRIPES_SKIPPED > before, "no stripes were skipped"
+    assert sorted(r["a"] for r in rows) == list(range(n - 1000, n))
+    # float predicate stays correct too
+    rows2 = (s.read.orc(p)
+             .filter(P.LessThan(col("d"), lit(1.0))).collect())
+    assert sorted(r["a"] for r in rows2) == list(range(7))
+
+
+def test_orc_pushdown_differential(tmp_path):
+    from spark_rapids_tpu.expressions import predicates as P
+    p = str(tmp_path / "striped2.orc")
+    _write_striped_orc(p, "zlib", n=50_000)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.orc(p).filter(P.And(
+            P.GreaterThan(col("a"), lit(np.int64(5_000))),
+            P.LessThanOrEqual(col("a"), lit(np.int64(5_100))))),
+        ignore_order=True)
