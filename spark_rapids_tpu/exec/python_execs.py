@@ -93,6 +93,218 @@ class CpuFlatMapGroupsInPandasExec(UnaryExec):
                 f"{getattr(self.fn, '__name__', 'fn')}]")
 
 
+def _eval_inputs_pandas(exprs, b):
+    """Evaluates input expressions on the host and returns pandas Series."""
+    from spark_rapids_tpu.expressions.base import Alias
+    from spark_rapids_tpu.expressions.evaluator import eval_exprs_cpu
+    hb = b.to_host() if hasattr(b, "bucket") else b
+    named = [Alias(e, f"u{i}") for i, e in enumerate(exprs)]
+    out = eval_exprs_cpu(named, hb)
+    import pyarrow as pa
+    return [pa.Table.from_batches([out.to_arrow()]).column(i).to_pandas()
+            for i in range(len(exprs))]
+
+
+class CpuArrowEvalPythonExec(UnaryExec):
+    """Scalar pandas UDFs inside a projection: ``udfs`` is
+    [(name, fn, input_exprs, dtype)] with ``fn(*pandas.Series) ->
+    pandas.Series`` per batch; appends one column per UDF (reference:
+    GpuArrowEvalPythonExec — batch -> arrow -> python -> arrow)."""
+
+    def __init__(self, udfs, child: Exec):
+        super().__init__(child)
+        self.udfs = list(udfs)
+
+    @property
+    def schema(self):
+        fields = list(self.child.schema.fields)
+        for name, _fn, _ins, dtype in self.udfs:
+            fields.append(T.StructField(name, dtype, True))
+        return T.StructType(fields)
+
+    def execute_partition(self, pidx):
+        import pyarrow as pa
+        for b in self.child.execute_partition(pidx):
+            hb = b.to_host() if hasattr(b, "bucket") else b
+            tab = pa.Table.from_batches([hb.to_arrow()])
+            # ONE host eval pass for every UDF's inputs (k separate
+            # passes would re-materialize the batch per UDF)
+            all_ins = [e for _n, _f, ins, _d in self.udfs for e in ins]
+            series = _eval_inputs_pandas(all_ins, hb) if all_ins else []
+            off = 0
+            for name, fn, ins, dtype in self.udfs:
+                args = series[off:off + len(ins)]
+                off += len(ins)
+                res = fn(*args)
+                tab = tab.append_column(
+                    name, pa.array(res, type=T.to_arrow(dtype)))
+            yield batch_from_arrow(tab)
+
+    def node_desc(self):
+        return "ArrowEvalPython[%s]" % ", ".join(n for n, *_ in self.udfs)
+
+
+class CpuAggregateInPandasExec(UnaryExec):
+    """Grouped pandas-UDF aggregation: ``fn(*pandas.Series) -> scalar``
+    per group; child is hash-partitioned by the keys; yields one row per
+    group: keys + one column per UDF (reference:
+    GpuAggregateInPandasExec)."""
+
+    def __init__(self, key_names: Sequence[str], udfs, child: Exec):
+        super().__init__(child)
+        self.key_names = list(key_names)
+        self.udfs = list(udfs)
+
+    @property
+    def schema(self):
+        child = self.child.schema
+        fields = [f for f in child.fields if f.name in self.key_names]
+        for name, _fn, _ins, dtype in self.udfs:
+            fields.append(T.StructField(name, dtype, True))
+        return T.StructType(fields)
+
+    def execute_partition(self, pidx):
+        import pandas as pd
+        import pyarrow as pa
+        frames = [_to_pandas(b) for b in self.child.execute_partition(pidx)]
+        if not frames:
+            return
+        pdf = pd.concat(frames, ignore_index=True) if len(frames) > 1 \
+            else frames[0]
+        if not len(pdf):
+            return
+        rows = {k: [] for k in self.key_names}
+        outs = {name: [] for name, *_ in self.udfs}
+        for key_vals, group in pdf.groupby(self.key_names, dropna=False,
+                                           sort=True):
+            if not isinstance(key_vals, tuple):
+                key_vals = (key_vals,)
+            for k, v in zip(self.key_names, key_vals):
+                rows[k].append(None if pd.isna(v) else v)
+            for name, fn, ins, _dtype in self.udfs:
+                args = [group[self._in_name(e)].reset_index(drop=True)
+                        for e in ins]
+                outs[name].append(fn(*args))
+        sch = self.schema
+        arrays = {}
+        for f in sch.fields:
+            src = rows.get(f.name, outs.get(f.name))
+            arrays[f.name] = pa.array(src, type=T.to_arrow(f.data_type))
+        yield batch_from_arrow(pa.table(arrays))
+
+    def _in_name(self, e) -> str:
+        name = getattr(e, "ref_name", None)
+        if name:
+            return name
+        raise ValueError("agg_in_pandas inputs must be plain columns")
+
+    def node_desc(self):
+        return "AggregateInPandas[%s]" % ", ".join(n for n, *_ in self.udfs)
+
+
+class CpuWindowInPandasExec(UnaryExec):
+    """Pandas UDF over the whole window partition (UNBOUNDED frame):
+    ``fn(*pandas.Series) -> scalar`` per partition group, broadcast to the
+    group's rows as an appended column (reference: GpuWindowInPandasExec
+    whole-partition frame).  Output rows come grouped by key."""
+
+    def __init__(self, key_names: Sequence[str], udfs, child: Exec):
+        super().__init__(child)
+        self.key_names = list(key_names)
+        self.udfs = list(udfs)
+
+    @property
+    def schema(self):
+        fields = list(self.child.schema.fields)
+        for name, _fn, _ins, dtype in self.udfs:
+            fields.append(T.StructField(name, dtype, True))
+        return T.StructType(fields)
+
+    def execute_partition(self, pidx):
+        import pandas as pd
+        import pyarrow as pa
+        frames = [_to_pandas(b) for b in self.child.execute_partition(pidx)]
+        if not frames:
+            return
+        pdf = pd.concat(frames, ignore_index=True) if len(frames) > 1 \
+            else frames[0]
+        if not len(pdf):
+            return
+        pieces = []
+        for _key, group in pdf.groupby(self.key_names, dropna=False,
+                                       sort=True):
+            g = group.reset_index(drop=True)
+            for name, fn, ins, _dtype in self.udfs:
+                args = [g[self._in_name(e)] for e in ins]
+                g[name] = fn(*args)
+            pieces.append(g)
+        out = pd.concat(pieces, ignore_index=True)
+        sch = self.schema
+        arrays = {f.name: pa.array(out[f.name], type=T.to_arrow(f.data_type))
+                  for f in sch.fields}
+        yield batch_from_arrow(pa.table(arrays))
+
+    _in_name = CpuAggregateInPandasExec._in_name
+
+    def node_desc(self):
+        return "WindowInPandas[%s]" % ", ".join(n for n, *_ in self.udfs)
+
+
+class CpuFlatMapCoGroupsInPandasExec(Exec):
+    """Co-grouped pandas apply: both children hash-partitioned by their
+    keys; per key ``fn(left_pdf, right_pdf) -> pdf`` (either side may be
+    empty) (reference: GpuFlatMapCoGroupsInPandasExec)."""
+
+    def __init__(self, left_keys: Sequence[str], right_keys: Sequence[str],
+                 fn: Callable, out_schema: T.StructType,
+                 left: Exec, right: Exec):
+        super().__init__([left, right])
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.fn = fn
+        self._schema = out_schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    @property
+    def num_partitions(self):
+        return self.children[0].num_partitions
+
+    def execute_partition(self, pidx):
+        import pandas as pd
+
+        def side(child, keys):
+            frames = [_to_pandas(b) for b in child.execute_partition(pidx)]
+            if not frames:
+                return {}
+            pdf = pd.concat(frames, ignore_index=True) if len(frames) > 1 \
+                else frames[0]
+            if not len(pdf):
+                return {}
+            return {k if isinstance(k, tuple) else (k,):
+                    g.reset_index(drop=True)
+                    for k, g in pdf.groupby(keys, dropna=False, sort=True)}
+
+        lgroups = side(self.children[0], self.left_keys)
+        rgroups = side(self.children[1], self.right_keys)
+        lcols = [f.name for f in self.children[0].schema.fields]
+        rcols = [f.name for f in self.children[1].schema.fields]
+        lempty = pd.DataFrame(columns=lcols)
+        rempty = pd.DataFrame(columns=rcols)
+        keys = sorted(set(lgroups) | set(rgroups),
+                      key=lambda t: tuple((v is None, v) for v in t))
+        for k in keys:
+            out = self.fn(lgroups.get(k, lempty), rgroups.get(k, rempty))
+            if out is not None and len(out):
+                yield _from_pandas(out, self._schema)
+
+    def node_desc(self):
+        return (f"FlatMapCoGroupsInPandas[{', '.join(self.left_keys)}; "
+                f"{getattr(self.fn, '__name__', 'fn')}]")
+
+
 # host tier: registered so tagging reports the honest reason
 from spark_rapids_tpu.plan import typechecks as TS  # noqa: E402
 from spark_rapids_tpu.plan.overrides import register_exec  # noqa: E402
@@ -103,9 +315,15 @@ def _host_only(meta):
                        "(arrow hand-off to python)")
 
 
-register_exec(CpuMapInPandasExec, convert=lambda p, m: p,
-              sig=TS.BASIC_WITH_ARRAYS, extra_tag=_host_only,
-              desc="vectorized python over arrow batches")
-register_exec(CpuFlatMapGroupsInPandasExec, convert=lambda p, m: p,
-              sig=TS.BASIC_WITH_ARRAYS, extra_tag=_host_only,
-              desc="grouped pandas apply over arrow batches")
+for _cls, _desc in (
+        (CpuMapInPandasExec, "vectorized python over arrow batches"),
+        (CpuFlatMapGroupsInPandasExec,
+         "grouped pandas apply over arrow batches"),
+        (CpuArrowEvalPythonExec, "scalar pandas UDFs in projections"),
+        (CpuAggregateInPandasExec, "grouped pandas-UDF aggregation"),
+        (CpuWindowInPandasExec, "pandas UDF over window partitions"),
+        (CpuFlatMapCoGroupsInPandasExec,
+         "co-grouped pandas apply over arrow batches")):
+    register_exec(_cls, convert=lambda p, m: p,
+                  sig=TS.BASIC_WITH_ARRAYS, extra_tag=_host_only,
+                  desc=_desc, host_only=True)

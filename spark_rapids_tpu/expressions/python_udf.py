@@ -1,0 +1,62 @@
+"""Scalar pandas UDF expression surface.
+
+Reference: Spark's ``PythonUDF`` expression + the reference's
+``GpuArrowEvalPythonExec`` (execution/python/GpuArrowEvalPythonExec.scala):
+a projection containing python UDFs is split — the UDFs evaluate in an
+ArrowEvalPython exec (arrow hand-off to python), the projection then
+references their output columns.  The DataFrame layer performs the same
+extraction (session.DataFrame._plan_pandas_udfs).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import Expression
+
+
+class PandasUDFCall(Expression):
+    """``pandas_udf(fn, dtype)(*cols)`` — evaluated only via
+    CpuArrowEvalPythonExec, never inline."""
+
+    foldable = False          # python fns are opaque: never constant-fold
+    deterministic = False
+
+    def __init__(self, fn: Callable, dtype: T.DataType,
+                 children: Sequence[Expression]):
+        super().__init__(children)
+        self.fn = fn
+        self._dtype = dtype
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def sql(self) -> str:
+        name = getattr(self.fn, "__name__", "pandas_udf")
+        return f"{name}({', '.join(c.sql() for c in self.children)})"
+
+    def eval_cpu(self, ctx):
+        raise NotImplementedError(
+            "PandasUDFCall must be extracted into ArrowEvalPython "
+            "(use it inside select()/with_column())")
+
+    eval_tpu = eval_cpu
+
+
+def pandas_udf(fn: Callable, return_type) -> Callable:
+    """pyspark-style: ``my = pandas_udf(lambda s: s * 2, T.DOUBLE);
+    df.select(my(col("a")).alias("x"))`` — ``fn(*pandas.Series) ->
+    pandas.Series``."""
+    dtype = return_type
+
+    def call(*cols) -> PandasUDFCall:
+        from spark_rapids_tpu.functions import _expr
+        return PandasUDFCall(fn, dtype, [_expr(c) for c in cols])
+
+    return call

@@ -11,6 +11,13 @@ from spark_rapids_tpu.expressions.base import (Alias, Expression,  # noqa: F401
                                                col, lit)
 
 
+def pandas_udf(fn, return_type):
+    """Scalar pandas UDF factory (reference: PythonUDF +
+    GpuArrowEvalPythonExec); see expressions.python_udf."""
+    from spark_rapids_tpu.expressions.python_udf import pandas_udf as _pu
+    return _pu(fn, return_type)
+
+
 def _expr(e) -> Expression:
     if isinstance(e, Expression):
         return e

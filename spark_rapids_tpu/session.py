@@ -43,6 +43,10 @@ class TpuSession:
         self.shuffle_env = init_shuffle_env(self.conf)
         #: temp views for the SQL front-end (name -> DataFrame)
         self._views: Dict[str, "DataFrame"] = {}
+        #: row-based Hive UDF passthrough (name -> (fn, return_type));
+        #: reference: rowBasedHiveUDFs.scala wraps metastore-registered
+        #: UDFs for row-at-a-time CPU evaluation
+        self._hive_udfs: Dict[str, tuple] = {}
         TpuSession._active = self
 
     # -- conf ---------------------------------------------------------------
@@ -61,6 +65,14 @@ class TpuSession:
 
     def create_or_replace_temp_view(self, name: str, df: "DataFrame") -> None:
         self._views[name.lower()] = df
+
+    def register_hive_udf(self, name: str, fn, return_type) -> None:
+        """Registers a row-based UDF callable from SQL by name — the
+        Hive-UDF passthrough analog (reference: rowBasedHiveUDFs.scala:
+        GpuRowBasedHiveSimpleUDF wraps the jar's function for CPU
+        row-at-a-time eval; here the python callable plays that role and
+        runs on the host tier with honest fallback tagging)."""
+        self._hive_udfs[name.lower()] = (fn, return_type)
 
     createOrReplaceTempView = create_or_replace_temp_view
 
@@ -165,6 +177,23 @@ class TpuSession:
                 CpuTextScanExec(list(paths),
                                 **self._common(C.READER_TYPE)), self._s)
 
+        def hive_text(self, *paths, schema=None, serde=None,
+                      columns=None) -> "DataFrame":
+            """Hive text table (LazySimpleSerDe subset; reference:
+            GpuHiveTableScanExec).  ``schema`` is required — the metastore
+            provides it in Spark; ``serde`` = {field.delim,
+            serialization.null.format, escape.delim}."""
+            from spark_rapids_tpu.hive.table import CpuHiveTextScanExec
+            sch = schema or self._schema
+            if sch is None:
+                raise ValueError("hive_text requires a schema (the "
+                                 "metastore's role)")
+            return DataFrame(
+                CpuHiveTextScanExec(list(paths), sch, serde=serde,
+                                    columns=columns,
+                                    **self._common(C.READER_TYPE)),
+                self._s)
+
         def avro(self, *paths, columns=None) -> "DataFrame":
             from spark_rapids_tpu.io.avro import CpuAvroScanExec
             return DataFrame(
@@ -212,7 +241,39 @@ class DataFrame:
         from spark_rapids_tpu.exec.basic import CpuProjectExec
         bound = [bind_references(_to_expr(e), self.schema) for e in exprs]
         plan, bound = self._plan_windows(bound)
+        plan, bound = self._plan_pandas_udfs(plan, bound)
         return DataFrame(CpuProjectExec(bound, plan), self._session)
+
+    def _plan_pandas_udfs(self, plan, bound_exprs):
+        """Extracts PandasUDFCalls from a projection into one
+        CpuArrowEvalPythonExec appending their result columns, then
+        rewrites the projection to reference them (reference:
+        GpuArrowEvalPythonExec extraction of PythonUDF)."""
+        from spark_rapids_tpu.exec.python_execs import CpuArrowEvalPythonExec
+        from spark_rapids_tpu.expressions.base import BoundReference
+        from spark_rapids_tpu.expressions.python_udf import PandasUDFCall
+        calls = []
+        for e in bound_exprs:
+            calls.extend(e.collect(lambda x: isinstance(x, PandasUDFCall)))
+        if not calls:
+            return plan, bound_exprs
+        base = len(plan.schema.fields)
+        udfs = []
+        replacement = {}
+        for i, c in enumerate(calls):
+            udfs.append((f"__pudf{base + i}", c.fn, list(c.children),
+                         c.data_type))
+            replacement[id(c)] = BoundReference(base + i, c.data_type, True)
+        plan = CpuArrowEvalPythonExec(udfs, plan)
+
+        def rewrite(e):
+            if id(e) in replacement:
+                return replacement[id(e)]
+            if not e.children:
+                return e
+            return e.with_children([rewrite(ch) for ch in e.children])
+
+        return plan, [rewrite(e) for e in bound_exprs]
 
     def _plan_windows(self, bound_exprs):
         """Extracts WindowExpressions from a projection: one CpuWindowExec
@@ -659,6 +720,12 @@ class DataFrame:
         from spark_rapids_tpu.io.parquet import write_parquet
         write_parquet(self._executed_plan().execute_all(), path, self.schema)
 
+    def write_hive_text(self, path: str, serde=None) -> None:
+        """Hive text table write (reference: GpuHiveTextFileFormat)."""
+        from spark_rapids_tpu.hive.table import write_hive_text
+        write_hive_text(self._executed_plan().execute_all(), path,
+                        self.schema, serde=serde)
+
     @property
     def write(self):
         """Directory-style writer: ``df.write.mode("overwrite").parquet(p)``."""
@@ -787,9 +854,17 @@ class GroupedData:
         from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
         from spark_rapids_tpu.expressions.aggregates import (
             AggregateExpression, AggregateFunction)
+        from spark_rapids_tpu.expressions.python_udf import PandasUDFCall
         from spark_rapids_tpu.plan.partitioning import (HashPartitioning,
                                                         SinglePartitioning)
         schema = self._df.schema
+        pandas_calls = [e for e in agg_exprs if isinstance(
+            e.children[0] if isinstance(e, Alias) else e, PandasUDFCall)]
+        if pandas_calls:
+            if len(pandas_calls) != len(agg_exprs):
+                raise TypeError("pandas-UDF aggregations cannot mix with "
+                                "builtin aggregates in one agg()")
+            return self._agg_in_pandas(agg_exprs)
         raw = []
         for e in agg_exprs:
             name = None
@@ -897,6 +972,81 @@ class GroupedData:
 
     _pivot = None
 
+    def _shuffled_child(self):
+        """Child hash-partitioned by the grouping keys (the raw-row
+        shuffle every grouped pandas exec needs)."""
+        from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
+        from spark_rapids_tpu.plan.partitioning import HashPartitioning
+        child = self._df._plan
+        if child.num_partitions > 1 and self._keys:
+            child = CpuShuffleExchangeExec(
+                HashPartitioning(self._keys, child.num_partitions), child,
+                shuffle_env=self._df._session.shuffle_env)
+        return child
+
+    def _grouping_key_names(self):
+        """Plain-column key names; grouped pandas execs group the pandas
+        frame BY NAME, so expression keys cannot be honored (clean
+        planning-time error instead of a KeyError mid-execution)."""
+        names = []
+        for k in self._keys:
+            name = getattr(k, "ref_name", None)
+            if not name:
+                raise ValueError(
+                    f"grouped pandas operations require plain column "
+                    f"grouping keys, got expression {k.sql()!r}; project "
+                    "it into a column first")
+            names.append(name)
+        return names
+
+    def _pandas_udf_specs(self, agg_exprs):
+        """[(out_name, fn, bound input exprs, dtype)] from
+        Alias(PandasUDFCall)/PandasUDFCall aggregates."""
+        from spark_rapids_tpu.expressions.python_udf import PandasUDFCall
+        schema = self._df.schema
+        udfs = []
+        for i, e in enumerate(agg_exprs):
+            name = None
+            if isinstance(e, Alias):
+                name, e = e.alias_name, e.children[0]
+            assert isinstance(e, PandasUDFCall)
+            bound = bind_references(e, schema)
+            udfs.append((name or bound.sql(), bound.fn,
+                         list(bound.children), bound.data_type))
+        return udfs
+
+    def _agg_in_pandas(self, agg_exprs) -> "DataFrame":
+        """group_by(keys).agg(pandas_udf(...)(col)): one output row per
+        group (reference GpuAggregateInPandasExec)."""
+        from spark_rapids_tpu.exec.python_execs import \
+            CpuAggregateInPandasExec
+        if self._grouping_sets is not None:
+            raise ValueError("pandas-UDF aggregation cannot follow "
+                             "rollup/cube")
+        return DataFrame(
+            CpuAggregateInPandasExec(self._grouping_key_names(),
+                                     self._pandas_udf_specs(agg_exprs),
+                                     self._shuffled_child()),
+            self._df._session)
+
+    def window_in_pandas(self, *agg_exprs) -> "DataFrame":
+        """Whole-partition pandas UDFs appended as columns, one value per
+        group broadcast to its rows (reference GpuWindowInPandasExec's
+        unbounded-frame shape)."""
+        from spark_rapids_tpu.exec.python_execs import CpuWindowInPandasExec
+        if self._grouping_sets is not None:
+            raise ValueError("window_in_pandas cannot follow rollup/cube")
+        return DataFrame(
+            CpuWindowInPandasExec(self._grouping_key_names(),
+                                  self._pandas_udf_specs(agg_exprs),
+                                  self._shuffled_child()),
+            self._df._session)
+
+    def cogroup(self, other: "GroupedData") -> "CoGroupedData":
+        """pyspark parity: df.group_by(k).cogroup(df2.group_by(k))
+        .apply_in_pandas(fn, schema)."""
+        return CoGroupedData(self, other)
+
     def apply_in_pandas(self, fn, schema: T.StructType) -> "DataFrame":
         """Grouped pandas apply: shuffle raw rows by the keys, then
         fn(group_pdf) -> pdf per group (reference
@@ -908,8 +1058,7 @@ class GroupedData:
         if self._grouping_sets is not None:
             raise ValueError("apply_in_pandas cannot follow rollup/cube")
         child = self._df._plan
-        key_names = [getattr(k, "ref_name", None) or k.sql()
-                     for k in self._keys]
+        key_names = self._grouping_key_names()
         if child.num_partitions > 1 and self._keys:
             child = CpuShuffleExchangeExec(
                 HashPartitioning(self._keys, child.num_partitions), child,
@@ -942,6 +1091,41 @@ class GroupedData:
         from spark_rapids_tpu.expressions.aggregates import Max
         return self.agg(*[Alias(Max(_to_expr(c)), f"max({c})")
                           for c in cols])
+
+
+class CoGroupedData:
+    """Two grouped frames co-grouped by their keys (reference:
+    GpuFlatMapCoGroupsInPandasExec)."""
+
+    def __init__(self, left: "GroupedData", right: "GroupedData"):
+        if len(left._keys) != len(right._keys):
+            raise ValueError("cogroup requires the same number of keys on "
+                             "both sides")
+        self._left = left
+        self._right = right
+
+    def apply_in_pandas(self, fn, schema: T.StructType) -> "DataFrame":
+        from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
+        from spark_rapids_tpu.exec.python_execs import \
+            CpuFlatMapCoGroupsInPandasExec
+        from spark_rapids_tpu.plan.partitioning import HashPartitioning
+        lplan = self._left._df._plan
+        rplan = self._right._df._plan
+        n = max(lplan.num_partitions, rplan.num_partitions)
+        senv = self._left._df._session.shuffle_env
+        if n > 1:
+            lplan = CpuShuffleExchangeExec(
+                HashPartitioning(self._left._keys, n), lplan,
+                shuffle_env=senv)
+            rplan = CpuShuffleExchangeExec(
+                HashPartitioning(self._right._keys, n), rplan,
+                shuffle_env=senv)
+        return DataFrame(
+            CpuFlatMapCoGroupsInPandasExec(
+                self._left._grouping_key_names(),
+                self._right._grouping_key_names(),
+                fn, schema, lplan, rplan),
+            self._left._df._session)
 
 
 def _bound_ref(i: int, schema: T.StructType):

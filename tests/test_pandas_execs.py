@@ -56,3 +56,113 @@ def test_map_in_pandas_schema_mismatch_clear_error():
     df = s.create_dataframe(_DATA).map_in_pandas(lambda p: p, schema)
     with pytest.raises(ValueError, match="missing"):
         df.collect()
+
+
+# -- round-4 family completion: ArrowEvalPython / AggregateInPandas /
+# -- WindowInPandas / FlatMapCoGroups (reference: execution/python/, 14 files)
+
+def test_arrow_eval_python_scalar_udf():
+    from spark_rapids_tpu import functions as F
+    plus_one = F.pandas_udf(lambda s: s + 1.0, T.DOUBLE)
+    times = F.pandas_udf(lambda a, b: a * b, T.DOUBLE)
+    for s in (cpu_session(),
+              tpu_session({"spark.rapids.sql.test.enabled": "false"})):
+        df = (s.create_dataframe(_DATA, num_partitions=2)
+              .select(col("g"),
+                      F.Alias(plus_one(col("v")), "v1"),
+                      F.Alias(times(col("v"), col("v")), "vv")))
+        rows = sorted(df.collect(), key=lambda r: (r["g"], r["v1"]))
+        assert rows[0] == {"g": 1, "v1": 2.0, "vv": 1.0}
+        assert rows[-1] == {"g": 3, "v1": 7.0, "vv": 36.0}
+
+
+def test_arrow_eval_python_inside_expression():
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.expressions import arithmetic as A
+    doubler = F.pandas_udf(lambda s: s * 2.0, T.DOUBLE)
+    s = cpu_session()
+    df = (s.create_dataframe(_DATA, num_partitions=2)
+          .select(col("g"),
+                  F.Alias(A.Add(doubler(col("v")), lit(0.5)), "x")))
+    rows = sorted(df.collect(), key=lambda r: r["x"])
+    assert rows[0]["x"] == 2.5 and rows[-1]["x"] == 12.5
+
+
+def test_aggregate_in_pandas():
+    from spark_rapids_tpu import functions as F
+    total = F.pandas_udf(lambda s: float(s.sum()), T.DOUBLE)
+    spread = F.pandas_udf(lambda s: float(s.max() - s.min()), T.DOUBLE)
+    for s in (cpu_session(),
+              tpu_session({"spark.rapids.sql.test.enabled": "false"})):
+        df = (s.create_dataframe(_DATA, num_partitions=3)
+              .group_by("g").agg(F.Alias(total(col("v")), "t"),
+                                 F.Alias(spread(col("v")), "sp")))
+        rows = sorted(df.collect(), key=lambda r: r["g"])
+        assert rows == [{"g": 1, "t": 3.0, "sp": 1.0},
+                        {"g": 2, "t": 12.0, "sp": 2.0},
+                        {"g": 3, "t": 6.0, "sp": 0.0}]
+
+
+def test_aggregate_in_pandas_rejects_mixed():
+    from spark_rapids_tpu import functions as F
+    total = F.pandas_udf(lambda s: float(s.sum()), T.DOUBLE)
+    s = cpu_session()
+    with pytest.raises(TypeError, match="mix"):
+        (s.create_dataframe(_DATA).group_by("g")
+         .agg(F.Alias(total(col("v")), "t"), F.sum("v").alias("s")))
+
+
+def test_window_in_pandas():
+    from spark_rapids_tpu import functions as F
+    gmean = F.pandas_udf(lambda s: float(s.mean()), T.DOUBLE)
+    for s in (cpu_session(),
+              tpu_session({"spark.rapids.sql.test.enabled": "false"})):
+        df = (s.create_dataframe(_DATA, num_partitions=2)
+              .group_by("g").window_in_pandas(
+                  F.Alias(gmean(col("v")), "gm")))
+        rows = sorted(df.collect(), key=lambda r: (r["g"], r["v"]))
+        assert len(rows) == 6
+        assert rows[0] == {"g": 1, "v": 1.0, "gm": 1.5}
+        assert rows[2] == {"g": 2, "v": 3.0, "gm": 4.0}
+        assert rows[-1] == {"g": 3, "v": 6.0, "gm": 6.0}
+
+
+def test_flat_map_cogroups_in_pandas():
+    import pandas as pd
+    def merge(l, r):
+        if not len(l):
+            return None
+        out = l.copy()
+        out["rn"] = float(len(r))
+        return out[["g", "v", "rn"]]
+
+    schema = T.StructType([T.StructField("g", T.LONG),
+                           T.StructField("v", T.DOUBLE),
+                           T.StructField("rn", T.DOUBLE)])
+    other = {"g": [1, 2, 2, 4], "w": [10.0, 20.0, 30.0, 40.0]}
+    for s in (cpu_session(),
+              tpu_session({"spark.rapids.sql.test.enabled": "false"})):
+        left = s.create_dataframe(_DATA, num_partitions=3).group_by("g")
+        right = s.create_dataframe(other, num_partitions=2).group_by("g")
+        df = left.cogroup(right).apply_in_pandas(merge, schema)
+        rows = sorted(df.collect(), key=lambda r: (r["g"], r["v"]))
+        assert len(rows) == 6
+        assert rows[0] == {"g": 1, "v": 1.0, "rn": 1.0}
+        assert rows[2] == {"g": 2, "v": 3.0, "rn": 2.0}
+        assert rows[-1] == {"g": 3, "v": 6.0, "rn": 0.0}
+
+
+def test_pandas_execs_fallback_tagged():
+    """The planner reports the honest host-tier reason for every member
+    of the family."""
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.plan.overrides import TpuOverrides
+    total = F.pandas_udf(lambda s: float(s.sum()), T.DOUBLE)
+    s = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+    df = (s.create_dataframe(_DATA, num_partitions=2)
+          .group_by("g").agg(F.Alias(total(col("v")), "t")))
+    ov = TpuOverrides(s.conf)
+    ov.apply(df._plan, for_explain=True)
+    text = ov.last_meta.explain(all_nodes=True)
+    assert "host tier" in text
