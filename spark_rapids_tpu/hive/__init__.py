@@ -1,0 +1,7 @@
+"""Hive integration: text-table scan/write + row-based Hive UDF
+passthrough (reference: org/apache/spark/sql/hive/rapids/ — 9 files,
+GpuHiveTableScanExec.scala, GpuHiveTextFileFormat.scala,
+rowBasedHiveUDFs.scala)."""
+
+from spark_rapids_tpu.hive.table import (CpuHiveTextScanExec,  # noqa: F401
+                                         write_hive_text)

@@ -1,0 +1,149 @@
+"""Hive text tables (LazySimpleSerDe subset).
+
+Reference: ``GpuHiveTableScanExec.scala`` + ``GpuHiveTextFileFormat.scala``
+— Hive's default text serde: '\\x01' field delimiter, ``\\N`` null
+sentinel, no header, no quoting (an escape char protects delimiters), and
+the schema comes from the metastore (here: passed by the caller, like the
+reference receives it from the catalog relation).  Supported serde
+properties: ``field.delim``, ``serialization.null.format``,
+``escape.delim`` — the same subset the reference checks before accepting a
+table (GpuHiveTextFileFormat.checkIfEnabled tagging).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, List, Optional, Sequence
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import (HostColumnarBatch,
+                                             batch_from_arrow)
+from spark_rapids_tpu.io.multifile import (AUTO, MultiFileScanBase,
+                                           tpu_scan_of)
+
+DEFAULT_FIELD_DELIM = "\x01"
+DEFAULT_NULL_FORMAT = "\\N"
+
+
+def serde_properties(props: Optional[dict]) -> dict:
+    """Normalizes a serde property dict to the supported subset; raises on
+    properties the serde cannot honor (the reference falls back to CPU for
+    these — here the host IS the text tier, so unknown props are errors)."""
+    props = dict(props or {})
+    out = {
+        "field.delim": props.pop("field.delim", DEFAULT_FIELD_DELIM),
+        "serialization.null.format": props.pop(
+            "serialization.null.format", DEFAULT_NULL_FORMAT),
+        # hive's LazySimpleSerDe has NO escaping unless escape.delim is
+        # set explicitly (and an explicit backslash escape would consume
+        # the \N null sentinel's backslash)
+        "escape.delim": props.pop("escape.delim", None),
+    }
+    props.pop("serialization.format", None)   # same as field.delim in hive
+    if props:
+        raise NotImplementedError(
+            f"unsupported Hive serde properties: {sorted(props)} "
+            "(supported: field.delim, serialization.null.format, "
+            "escape.delim)")
+    return out
+
+
+class CpuHiveTextScanExec(MultiFileScanBase):
+    """Hive text table scan: schema-required delimited text without
+    header/quoting (reference: GpuHiveTableScanExec)."""
+
+    format_name = "hivetext"
+    file_ext = ""          # hive table dirs contain bare part files
+
+    def __init__(self, paths: Sequence[str], table_schema: T.StructType,
+                 serde: Optional[dict] = None,
+                 columns: Optional[List[str]] = None,
+                 reader_type: str = AUTO, batch_rows: int = 1 << 20,
+                 num_threads: int = 8):
+        super().__init__(paths, reader_type=reader_type,
+                         batch_rows=batch_rows, num_threads=num_threads)
+        self.table_schema = table_schema
+        self.serde = serde_properties(serde)
+        self.columns = columns
+
+    def _scan_cache_extra(self):
+        return (self.table_schema.simple_name,
+                tuple(sorted((self.serde or {}).items())))
+
+    def infer_schema(self) -> T.StructType:
+        sch = self.table_schema
+        if self.columns is not None:
+            sch = T.StructType([f for f in sch.fields
+                                if f.name in self.columns])
+        return sch
+
+    def read_file(self, path: str) -> Iterator[HostColumnarBatch]:
+        import pyarrow as pa
+        import pyarrow.csv as pcsv
+        sch = self.table_schema
+        read = pcsv.ReadOptions(column_names=sch.names, block_size=1 << 24)
+        parse = pcsv.ParseOptions(
+            delimiter=self.serde["field.delim"],
+            quote_char=False,
+            escape_char=self.serde["escape.delim"] or False)
+        conv = pcsv.ConvertOptions(
+            null_values=[self.serde["serialization.null.format"]],
+            strings_can_be_null=True,
+            column_types={f.name: T.to_arrow(f.data_type)
+                          for f in sch.fields},
+            include_columns=self.columns or None)
+        if os.path.getsize(path) == 0:
+            return
+        with pcsv.open_csv(path, read_options=read, parse_options=parse,
+                           convert_options=conv) as rdr:
+            for rb in rdr:
+                if rb.num_rows:
+                    yield batch_from_arrow(pa.Table.from_batches([rb]))
+
+
+TpuHiveTextScanExec, _hive_convert = tpu_scan_of(CpuHiveTextScanExec)
+
+from spark_rapids_tpu.plan.overrides import register_exec  # noqa: E402
+
+register_exec(CpuHiveTextScanExec, convert=_hive_convert,
+              desc="Hive text table scan (LazySimpleSerDe subset; host "
+                   "decode + device upload)")
+
+
+def write_hive_text(batches, path: str, schema: T.StructType,
+                    serde: Optional[dict] = None) -> None:
+    """Hive text writer (reference: GpuHiveTextFileFormat): one part file,
+    '\\x01'-delimited, ``\\N`` nulls, booleans as true/false."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    props = serde_properties(serde)
+    delim = props["field.delim"]
+    nullf = props["serialization.null.format"]
+    esc = props["escape.delim"]
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        for b in batches:
+            if isinstance(b, ColumnarBatch):
+                b = b.to_host()
+            if b.row_count == 0:
+                continue
+            tab = pa.Table.from_batches([b.to_arrow()])
+            cols = []
+            for name in tab.column_names:
+                c = tab.column(name)
+                is_str = pa.types.is_string(c.type)
+                if pa.types.is_boolean(c.type):
+                    c = pc.if_else(c, pa.scalar("true"), pa.scalar("false"))
+                c = pc.cast(c, pa.string(), safe=False)
+                if esc and is_str:
+                    # writer mirrors the reader's escaping (LazySimpleSerDe
+                    # escapes the escape char and the field delimiter;
+                    # without escape.delim delimiter-bearing values corrupt
+                    # the row — hive's own raw-text behavior)
+                    c = pc.replace_substring(c, esc, esc + esc)
+                    c = pc.replace_substring(c, delim, esc + delim)
+                cols.append(pc.fill_null(c, nullf).to_pylist())
+            for row in zip(*cols):
+                f.write(delim.join(row))
+                f.write("\n")

@@ -1283,6 +1283,12 @@ class Analyzer:
         if name == "xxhash64":
             from spark_rapids_tpu.expressions.hashing import XxHash64
             return XxHash64(*args)
+        hive_udf = getattr(self.session, "_hive_udfs", {}).get(name)
+        if hive_udf is not None:
+            # row-based Hive UDF passthrough (rowBasedHiveUDFs.scala)
+            from spark_rapids_tpu.udf.api import PythonRowUDF
+            fn, rt = hive_udf
+            return PythonRowUDF(fn, rt, args, name=name)
         raise AnalysisError(f"unknown function {name}")
 
     def _window_call(self, e: A.FuncCall, rec) -> Expression:
