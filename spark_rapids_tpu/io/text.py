@@ -63,6 +63,11 @@ class CpuCsvScanExec(MultiFileScanBase):
         self.null_value = null_value
         self.columns = columns
 
+    def _scan_cache_extra(self):
+        return (self.user_schema.simple_name if self.user_schema else None,
+                self.header, self.sep, self.quote, self.escape,
+                self.comment, self.null_value)
+
     def _options(self):
         import pyarrow.csv as pcsv
         col_names = None

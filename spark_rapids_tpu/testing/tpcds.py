@@ -299,7 +299,18 @@ def generate_tables(sf: float = 0.01, seed: int = 20) -> Dict[str, dict]:
 
 
 def register_tables(session, sf: float = 0.01, num_partitions: int = 2,
-                    seed: int = 20, tables=None) -> None:
+                    seed: int = 20, tables=None,
+                    storage: str = "memory", data_dir=None) -> None:
+    """Registers the TPC-DS views.  ``storage="memory"`` builds
+    device-cacheable in-memory tables; ``storage="parquet"`` writes each
+    table to parquet once (cached on disk keyed by (sf, seed)) and
+    registers file scans, so the scan + shuffle layers participate in
+    every query (reference: TPC-DS over externally generated parquet,
+    integration_tests/ScaleTest.md)."""
+    if storage == "parquet":
+        _register_tables_parquet(session, sf, num_partitions, seed, tables,
+                                 data_dir)
+        return
     data = generate_tables(sf, seed)
     for name, cols in data.items():
         if tables is not None and name not in tables:
@@ -308,3 +319,39 @@ def register_tables(session, sf: float = 0.01, num_partitions: int = 2,
         parts = num_partitions if nrows >= 1000 else 1
         session.create_or_replace_temp_view(
             name, session.create_dataframe(cols, num_partitions=parts))
+
+
+def _register_tables_parquet(session, sf, num_partitions, seed, tables,
+                             data_dir) -> None:
+    import os
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    root = data_dir or os.path.join(tempfile.gettempdir(),
+                                    f"tpcds_sf{sf}_s{seed}")
+    marker = os.path.join(root, "_DONE")
+    if not os.path.exists(marker):
+        data = generate_tables(sf, seed)
+        os.makedirs(root, exist_ok=True)
+        for name, cols in data.items():
+            tdir = os.path.join(root, name)
+            os.makedirs(tdir, exist_ok=True)
+            tbl = pa.table({k: pa.array(v) for k, v in cols.items()})
+            nrows = tbl.num_rows
+            parts = num_partitions if nrows >= 1000 else 1
+            per = max(1, (nrows + parts - 1) // parts)
+            for i in range(parts):
+                piece = tbl.slice(i * per, per)
+                if piece.num_rows or i == 0:
+                    pq.write_table(piece,
+                                   os.path.join(tdir, f"part-{i}.parquet"))
+        with open(marker, "w") as f:
+            f.write("ok")
+    for name in _BASE:
+        if tables is not None and name not in tables:
+            continue
+        tdir = os.path.join(root, name)
+        if os.path.isdir(tdir):
+            session.create_or_replace_temp_view(name,
+                                                session.read.parquet(tdir))
