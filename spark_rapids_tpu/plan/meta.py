@@ -63,6 +63,9 @@ class ExprMeta(BaseMeta):
         r = sig.check(dt)
         if r is not None:
             self.will_not_work(f"expression {self.expr.name}: {r}")
+        if rule.checks is not None:
+            # per-parameter matrix (ExprChecks analog): per-slot reasons
+            rule.checks.check_expr(self.expr, self.will_not_work)
         reason = self.expr.tpu_supported(self.conf)
         if reason is not None:
             self.will_not_work(f"expression {self.expr.name}: {reason}")

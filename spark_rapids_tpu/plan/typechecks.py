@@ -86,6 +86,63 @@ BASIC_WITH_ARRAYS = TypeSig(ALL_BASIC.classes, True,
                             allow_device_arrays=True)
 
 
+class ParamCheck:
+    """One named input slot of an operator with its own TypeSig
+    (reference: TypeChecks.scala ParamCheck inside ExprChecks :1057)."""
+
+    def __init__(self, name: str, sig: TypeSig):
+        self.name = name
+        self.sig = sig
+
+
+class OpChecks:
+    """Per-operator input/output type matrix (reference:
+    ExecChecks :932 / ExprChecks :1057 in TypeChecks.scala).
+
+    ``params`` match an expression's children positionally; when the op
+    is variadic the LAST param repeats (``repeat_last``).  ``output``
+    checks the expression's own data type.  Tagging produces per-slot
+    reasons ("param 'value' of Sum: binary is not supported"), and
+    docsgen renders one matrix row per slot — the per-op depth the
+    single-sig registration couldn't express."""
+
+    def __init__(self, output: TypeSig, params: Iterable[ParamCheck] = (),
+                 repeat_last: bool = True, note: str = ""):
+        self.output = output
+        self.params = list(params)
+        self.repeat_last = repeat_last
+        self.note = note
+
+    def param_for(self, i: int) -> Optional[ParamCheck]:
+        if i < len(self.params):
+            return self.params[i]
+        if self.params and self.repeat_last:
+            return self.params[-1]
+        return None
+
+    def check_expr(self, expr, add_reason) -> None:
+        """Tags per-slot + output violations via ``add_reason(str)``."""
+        name = type(expr).__name__
+        for i, c in enumerate(expr.children):
+            pc = self.param_for(i)
+            if pc is None:
+                continue
+            try:
+                dt = c.data_type
+            except Exception:      # unresolved children tag elsewhere
+                continue
+            r = pc.sig.check(dt)
+            if r is not None:
+                add_reason(f"param {pc.name!r} of {name}: {r}")
+        try:
+            out_dt = expr.data_type
+        except Exception:
+            return
+        r = self.output.check(out_dt)
+        if r is not None:
+            add_reason(f"result of {name}: {r}")
+
+
 def no_array_keys(exprs, meta, what: str) -> None:
     """extra_tag helper: array-typed KEY expressions reject the device
     path (payload arrays are fine; the key word kernels are 1-D)."""

@@ -52,7 +52,11 @@ def validate_api() -> List[str]:
                     f"{name}.{method}: expected (self, pidx), got "
                     f"{list(sig.parameters)}")
         # a Tpu twin should exist in the same module (naming contract);
-        # conversion-only rules (e.g. mixin-generated) resolve dynamically
+        # conversion-only rules (e.g. mixin-generated) resolve dynamically,
+        # and deliberately host-tier rules (pandas/python hand-off execs)
+        # are exempt — their convert is the identity with an honest tag
+        if rule.host_only:
+            continue
         mod = inspect.getmodule(cls)
         twin = "Tpu" + name[3:]
         if mod is not None and not hasattr(mod, twin):

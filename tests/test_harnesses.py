@@ -84,3 +84,33 @@ def test_api_validation_passes():
     from spark_rapids_tpu.testing.api_validation import validate_api
     problems = validate_api()
     assert problems == [], problems
+
+
+def test_per_op_checks_param_level_reason():
+    """ExprChecks-style per-param matrices produce slot-level fallback
+    reasons (TypeChecks.scala:1057 analog): min over strings names the
+    'value' param."""
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.plan.overrides import TpuOverrides
+    from tests.asserts import tpu_session
+    s = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+    df = s.create_dataframe({"k": [1, 2], "s": ["a", "b"]},
+                            num_partitions=1)
+    q = df.group_by("k").agg(F.min("s").alias("m"))
+    ov = TpuOverrides(s.conf)
+    ov.apply(q._plan, for_explain=True)
+    text = ov.last_meta.explain(all_nodes=True)
+    assert "param 'value' of Min" in text, text
+
+
+def test_supported_ops_doc_has_param_rows():
+    from spark_rapids_tpu.testing.docsgen import generate_supported_ops
+    doc = generate_supported_ops()
+    assert "Sum `value`" in doc and "Sum `result`" in doc
+    assert "Min `value`" in doc
+    # the min/max string gap is now visible in the matrix: NS under STRING
+    row = [ln for ln in doc.splitlines() if ln.startswith("| Min `value`")][0]
+    cells = [c.strip() for c in row.split("|")]
+    header = [c.strip() for c in doc.splitlines()
+              [doc.splitlines().index("## Expressions") + 2].split("|")]
+    assert cells[header.index("STRING")] == "NS"
