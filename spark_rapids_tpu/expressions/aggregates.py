@@ -38,10 +38,9 @@ class BufferSpec:
 
 
 class AggregateFunction(Expression):
-    foldable = False   # never constant-fold aggregation/window context
-
     """Base; children are the raw input expressions."""
 
+    foldable = False   # never constant-fold aggregation/window context
     is_aggregate = True
     #: variable-length state: plan in COMPLETE mode after a key shuffle
     #: (Spark's ObjectHashAggregate pattern), no partial/merge stages

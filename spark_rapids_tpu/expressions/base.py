@@ -79,8 +79,13 @@ class Expression:
     """Base expression node."""
 
     #: False for expressions that must not be constant-folded even over
-    #: all-literal children (non-deterministic, context-dependent)
+    #: all-literal children (aggregation/window context dependence).
     foldable: bool = True
+    #: False for expressions whose value differs per evaluation (rand,
+    #: uuid, monotonically_increasing_id).  fold_constants refuses to fold
+    #: these regardless of ``foldable`` — any new non-deterministic
+    #: expression MUST set this or it would silently fold to one literal.
+    deterministic: bool = True
 
     def __init__(self, children: Sequence["Expression"] = ()):
         self.children: List[Expression] = list(children)
@@ -407,15 +412,18 @@ def fold_constants(expr: Expression) -> Expression:
 
     def fix(n: Expression) -> Expression:
         if (isinstance(n, (Literal, Alias)) or not n.children or
-                not n.foldable or
+                not n.foldable or not n.deterministic or
                 not all(isinstance(c, Literal) for c in n.children)):
             return n
         try:
             tc = n.eval_cpu(EvalContext([], "cpu", 1))
             v = tcol_to_host_column(tc, 1).arrow[0].as_py()
             return Literal(v, n.data_type)
-        except Exception:
-            return n   # not evaluable standalone; leave for runtime
+        except Exception:  # noqa: BLE001 — any eval failure (overflow,
+            # arrow conversion, host-only op) defers to runtime, where the
+            # engine's own error surfaces; folding is an optimization and
+            # must never turn a runnable plan into a planning error
+            return n
 
     return expr.transform_up(fix)
 

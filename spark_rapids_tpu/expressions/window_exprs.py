@@ -91,8 +91,6 @@ class WindowSpecDef:
 
 
 class WindowExpression(Expression):
-    foldable = False   # never constant-fold aggregation/window context
-
     """function OVER spec — the planner extracts these from projections and
     lowers each spec group to one WindowExec (reference: Spark's
     ExtractWindowExpressions + GpuWindowExecMeta).
@@ -100,6 +98,8 @@ class WindowExpression(Expression):
     The spec's partition/order expressions ARE children (after the
     function) so generic tree transforms — reference binding above all —
     reach them; ``with_children`` rebuilds the spec from the new list."""
+
+    foldable = False   # never constant-fold aggregation/window context
 
     def __init__(self, function: Expression, spec: WindowSpecDef):
         super().__init__([function] + list(spec.partition_exprs) +
@@ -129,10 +129,9 @@ class WindowExpression(Expression):
 
 
 class WindowFunction(Expression):
-    foldable = False   # never constant-fold aggregation/window context
-
     """Ranking/offset functions valid only inside a window spec."""
 
+    foldable = False   # never constant-fold aggregation/window context
     is_window_function = True
 
     def over(self, spec) -> WindowExpression:

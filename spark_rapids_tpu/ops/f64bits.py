@@ -147,29 +147,42 @@ def scale_exp2(x, e, jnp):
 def f64_ieee_bits(x, jnp):
     """Device f64 -> int64 IEEE-754 bit pattern of the value rounded to
     binary64, via arithmetic exponent/mantissa extraction (no 64-bit
-    bitcasts).  Canonicalizes -0.0 and NaN first.
+    bitcasts).  Canonicalizes -0.0 and NaN.
 
-    Device doubles always fall in the f64 *normal* range (the dd
-    representation bottoms out near 2^-149), so no subnormal encoding
-    is ever needed.
+    Zero/tiny classification happens at the BIT level of the dd words
+    (dd_split + 32-bit bitcasts, like f64_sortable_words): arithmetic
+    ``x == 0`` compares flush f32-subnormal magnitudes on TPU, which
+    would collapse distinct tiny keys to the bits of +0.0 and diverge
+    from the CPU oracle's exact bitcast (ADVICE r3).  Values whose hi
+    word is f32-subnormal (|x| < 2^-126; the dd representation bottoms
+    out at 2^-149, where lo is always ±0) get their bits reassembled
+    from the hi word's integer mantissa directly — arithmetic on such
+    magnitudes would flush.
     """
     import jax
-    x = dd_canonical(x, jnp)
     if f64_bitcast_ok():
+        x = dd_canonical(x, jnp)
         return jax.lax.bitcast_convert_type(x, np.int64)
     isnan = jnp.isnan(x)
     isinf = jnp.isinf(x)
-    nonzero = x != 0
-    finite = ~isnan & ~isinf & nonzero
+    hi, lo = dd_split(x, jnp)
+    uh = jax.lax.bitcast_convert_type(hi, np.uint32)
+    ul = jax.lax.bitcast_convert_type(lo, np.uint32)
+    mag_h = uh & np.uint32(0x7FFFFFFF)
+    mag_l = ul & np.uint32(0x7FFFFFFF)
+    nonzero = (mag_h != 0) | (mag_l != 0)
+    # hi in the f32-subnormal range: exponent bits all zero, mantissa set
+    tiny = (mag_h >> np.uint32(23) == 0) & nonzero & ~isnan & ~isinf
+    finite = ~isnan & ~isinf & nonzero & ~tiny
     a = jnp.abs(jnp.where(finite, x, jnp.ones_like(x)))
-    # lift f32-subnormal magnitudes into the normal range (exact scale)
+    # lift near-f32-subnormal magnitudes into the safe range (exact scale)
     small = a < 2.0 ** -60
     a = a * jnp.where(small, jnp.asarray(2.0 ** 64, a.dtype),
                       jnp.ones_like(a))
     off = jnp.where(small, -64, 0).astype(np.int32)
     # exponent estimate from the f32 hi part, corrected by one step
-    uh = jax.lax.bitcast_convert_type(a.astype(np.float32), np.uint32)
-    e0 = ((uh >> np.uint32(23)) & np.uint32(0xFF)).astype(np.int32) - 127
+    ua = jax.lax.bitcast_convert_type(a.astype(np.float32), np.uint32)
+    e0 = ((ua >> np.uint32(23)) & np.uint32(0xFF)).astype(np.int32) - 127
     m0 = scale_exp2(a, -e0, jnp)
     e1 = e0 + jnp.where(m0 >= 2.0, 1, 0) - jnp.where(m0 < 1.0, 1, 0)
     m = scale_exp2(a, -e1, jnp)           # in [1, 2)
@@ -178,7 +191,22 @@ def f64_ieee_bits(x, jnp):
     mant = jnp.clip(mant, 0, _MANT_MASK)
     bits = ((exp + np.int64(1023)) << np.int64(52)) | mant
     bits = jnp.where(finite, bits, np.int64(0))
+    # tiny path: |x| = m_int * 2^-149 exactly (m_int = hi's 23 mantissa
+    # bits; lo is ±0 here).  floor(log2 m_int) comes from the exact
+    # f32 representation of the INTEGER m_int — integer bit math only,
+    # no flushable arithmetic.
+    m_int = mag_h.astype(np.int64)
+    m_f = jnp.maximum(m_int, 1).astype(np.float32)    # exact for < 2^24
+    um = jax.lax.bitcast_convert_type(m_f, np.uint32)
+    e_m = ((um >> np.uint32(23)) & np.uint32(0xFF)).astype(np.int64) - 127
+    t_exp = e_m - 149
+    t_mant = (jnp.left_shift(m_int, (52 - e_m)) - np.int64(1 << 52)) \
+        & _MANT_MASK
+    t_bits = ((t_exp + np.int64(1023)) << np.int64(52)) | t_mant
+    bits = jnp.where(tiny, t_bits, bits)
     bits = jnp.where(isinf, _EXP_MASK, bits)
     bits = jnp.where(isnan, _NAN_BITS, bits)
-    sign = jnp.where((x < 0), np.int64(-2 ** 63), np.int64(0))
+    # sign from the hi word's bit, canonicalized: -0.0 -> +0.0, NaN -> +
+    neg = (uh >> np.uint32(31) != 0) & nonzero & ~isnan
+    sign = jnp.where(neg, np.int64(-2 ** 63), np.int64(0))
     return bits | sign

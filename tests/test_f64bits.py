@@ -1,0 +1,64 @@
+"""Bit-exactness of the TPU double-double f64 word/bits kernels, driven on
+CPU with the dd path forced (``_BITCAST64 = False``).
+
+The dd representation bottoms out at the f32-subnormal floor: XLA flushes
+f32-subnormal CAST results (verified on both CPU-XLA and TPU), so doubles
+with |x| < 2^-126 collapse to ±0 in ``dd_split`` — the contract is that
+every consumer (sort words, group words, ieee bits for hashing) sees the
+SAME flushed value, never a mix of flushed and unflushed views of one key
+(ADVICE r3: value-level compares in dd_canonical could diverge from the
+bit-level sort words).
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+
+@pytest.fixture
+def force_dd():
+    from spark_rapids_tpu.ops import f64bits
+    prev = f64bits._BITCAST64
+    f64bits._BITCAST64 = False
+    yield f64bits
+    f64bits._BITCAST64 = prev
+
+
+# the dd-representable domain: |x| in [2^-126, f32max], plus specials
+REPRESENTABLE = np.array(
+    [0.0, -0.0, 1.5, -2.75, np.pi, 1.0 / 3, 1e30, -1e30, 3e38,
+     2.0 ** -126, 2.0 ** -126 * 1.5, 1e-30, -1e-30, 1e-37,
+     np.inf, -np.inf, np.nan, 123456.789, -0.1], dtype=np.float64)
+
+TINY = np.array([1e-40, -1e-40, 5e-324, 2.0 ** -149, -(2.0 ** -149)],
+                dtype=np.float64)
+
+
+def test_ieee_bits_dd_path_exact(force_dd):
+    got = np.asarray(force_dd.f64_ieee_bits(jnp.asarray(REPRESENTABLE), jnp))
+    exp = np.where(REPRESENTABLE == 0.0, 0.0, REPRESENTABLE).view(np.int64)
+    exp = np.where(np.isnan(REPRESENTABLE),
+                   np.float64(np.nan).view(np.int64), exp)
+    assert (got == exp).all(), list(zip(REPRESENTABLE, got, exp))
+
+
+def test_ieee_bits_dd_tiny_flush_consistent(force_dd):
+    """Sub-2^-126 doubles flush to the bits of +0.0 — consistently, with
+    no sign leak from the flushed hi word."""
+    got = np.asarray(force_dd.f64_ieee_bits(jnp.asarray(TINY), jnp))
+    assert (got == 0).all(), [hex(int(v)) for v in got]
+
+
+def test_sort_words_and_bits_agree_on_zero_class(force_dd):
+    """Whatever the sort words flush to zero, the hash bits must too —
+    one key, one identity across sort/group/hash."""
+    vals = np.concatenate([REPRESENTABLE[~np.isnan(REPRESENTABLE)], TINY])
+    x = jnp.asarray(vals)
+    bits = np.asarray(force_dd.f64_ieee_bits(x, jnp))
+    words = [np.asarray(w) for w in force_dd.f64_sortable_words(x, jnp)]
+    assert len(words) == 2
+    zero_words = np.asarray(force_dd.f64_sortable_words(
+        jnp.asarray(np.array([0.0])), jnp))
+    word_zero = (words[0] == zero_words[0][0]) & (words[1] == zero_words[1][0])
+    assert (word_zero == (bits == 0)).all()
