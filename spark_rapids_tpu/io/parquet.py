@@ -60,6 +60,32 @@ def _expr_to_arrow_filter(expr: Expression):
     return None
 
 
+#: Spark writer metadata tags marking LEGACY (julian hybrid) datetime
+#: rebase (reference: datetimeRebaseUtils.scala reads the same tags)
+_LEGACY_DATETIME_TAG = b"org.apache.spark.legacyDateTime"
+_LEGACY_INT96_TAG = b"org.apache.spark.legacyINT96"
+
+
+def _widen(a, b):
+    """Least common arrow type for cross-file schema evolution (the safe
+    widenings Spark's vectorized reader performs: int upcasts, float ->
+    double, ts unit alignment); None = incompatible."""
+    import pyarrow as pa
+    if a.equals(b):
+        return a
+    ints = [pa.int8(), pa.int16(), pa.int32(), pa.int64()]
+    if a in ints and b in ints:
+        return ints[max(ints.index(a), ints.index(b))]
+    floats = [pa.float32(), pa.float64()]
+    if a in floats and b in floats:
+        return pa.float64()
+    if (a in ints and b in floats) or (a in floats and b in ints):
+        return pa.float64()
+    if pa.types.is_timestamp(a) and pa.types.is_timestamp(b):
+        return pa.timestamp("us")
+    return None
+
+
 class CpuParquetScanExec(MultiFileScanBase):
     format_name = "parquet"
     file_ext = ".parquet"
@@ -73,37 +99,144 @@ class CpuParquetScanExec(MultiFileScanBase):
                          batch_rows=batch_rows, num_threads=num_threads)
         self.columns = columns
         self.predicate = predicate
+        self._unified: Optional[object] = None  # arrow schema across files
 
     # -- planning-time metadata (host footer stage) -------------------------
-    def infer_schema(self) -> T.StructType:
+    def _unified_schema(self):
+        """Cross-file schema evolution (reference: the multi-file readers
+        resolve each file's footer schema against the read schema —
+        GpuParquetScan evolution handling): union of columns across every
+        footer with safe type widening; later files may add columns
+        (nulls elsewhere) or widen numeric types."""
+        if self._unified is not None:
+            return self._unified
+        import pyarrow as pa
         import pyarrow.parquet as pq
-        arrow_schema = pq.read_schema(self.paths[0])
-        fields = []
-        for f in arrow_schema:
+        fields: dict = {}
+        order: List[str] = []
+        for p in self.paths:
+            sch = pq.read_schema(p)
+            for f in sch:
+                if f.name not in fields:
+                    fields[f.name] = f.type
+                    order.append(f.name)
+                else:
+                    w = _widen(fields[f.name], f.type)
+                    if w is None:
+                        raise TypeError(
+                            f"parquet schema evolution cannot reconcile "
+                            f"column {f.name!r}: {fields[f.name]} vs "
+                            f"{f.type} ({p})")
+                    fields[f.name] = w
+        self._unified = pa.schema([pa.field(n, fields[n], nullable=True)
+                                   for n in order])
+        return self._unified
+
+    def infer_schema(self) -> T.StructType:
+        out = []
+        for f in self._unified_schema():
             if self.columns is not None and f.name not in self.columns:
                 continue
-            fields.append(T.StructField(f.name, T.from_arrow(f.type)))
-        return T.StructType(fields)
+            out.append(T.StructField(f.name, T.from_arrow(f.type)))
+        return T.StructType(out)
+
+    def _rebase_flags(self, pqfile):
+        """(legacy_datetime, legacy_int96, int96_columns) from the footer."""
+        md = pqfile.metadata.metadata or {}
+        legacy_dt = _LEGACY_DATETIME_TAG in md
+        legacy_96 = _LEGACY_INT96_TAG in md
+        int96_cols = set()
+        psch = pqfile.metadata.schema
+        for i in range(len(psch)):
+            col = psch.column(i)
+            if col.physical_type == "INT96":
+                int96_cols.add(col.name)
+        return legacy_dt, legacy_96, int96_cols
+
+    def _adapt(self, tbl, legacy_dt: bool, legacy_96: bool, int96_cols):
+        """Rebase + evolve one decoded table to the unified read schema."""
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        from spark_rapids_tpu.expressions.timezone_db import (
+            rebase_julian_to_gregorian_days,
+            rebase_julian_to_gregorian_micros)
+        unified = self._unified_schema()
+        canon_ts = T.to_arrow(T.TIMESTAMP)   # engine unit/tz convention
+        cols = {}
+        n = tbl.num_rows
+        for f in unified:
+            if self.columns is not None and f.name not in self.columns:
+                continue
+            if f.name in tbl.column_names:
+                c = tbl.column(f.name).combine_chunks()
+                want = canon_ts if pa.types.is_timestamp(f.type) \
+                    else f.type
+                if not c.type.equals(want):
+                    c = pc.cast(c, want, safe=False)
+                rebase_this = (legacy_dt or
+                               (legacy_96 and f.name in int96_cols))
+                if rebase_this and (pa.types.is_date(c.type) or
+                                    pa.types.is_timestamp(c.type)):
+                    mask = c.is_null().to_numpy(zero_copy_only=False)
+                    if pa.types.is_date(c.type):
+                        raw = c.cast(pa.int32()).fill_null(0) \
+                            .to_numpy(zero_copy_only=False)
+                        fixed = rebase_julian_to_gregorian_days(
+                            raw.astype(np.int64)).astype(np.int32)
+                        c = pa.array(fixed, type=pa.int32(),
+                                     mask=mask).cast(c.type)
+                    else:
+                        raw = c.cast(pa.int64()).fill_null(0) \
+                            .to_numpy(zero_copy_only=False)
+                        fixed = rebase_julian_to_gregorian_micros(raw)
+                        c = pa.array(fixed, type=pa.int64(),
+                                     mask=mask).cast(c.type)
+                cols[f.name] = c
+            else:
+                want = canon_ts if pa.types.is_timestamp(f.type) \
+                    else f.type
+                cols[f.name] = pa.nulls(n, type=want)
+        return pa.table(cols)
 
     def read_file(self, path: str):
         import pyarrow as pa
         import pyarrow.parquet as pq
+        f = pq.ParquetFile(path)
+        legacy_dt, legacy_96, int96_cols = self._rebase_flags(f)
+        # int96 (and any arrow ns-unit writer) decodes as timestamp[ns];
+        # the engine's timestamp unit is us, so those files adapt too
+        non_us_ts = any(pa.types.is_timestamp(fld.type) and
+                        str(fld.type.unit) != "us"
+                        for fld in f.schema_arrow)
+        # per-FILE evolution check: identically-schemaed part files (the
+        # common multi-file case) keep the arrow filter-pushdown fast path
+        evolved = len(self.paths) > 1 and \
+            not f.schema_arrow.equals(self._unified_schema())
+        needs_adapt = legacy_dt or bool(int96_cols) or non_us_ts or evolved
         flt = None if self.predicate is None else \
             _expr_to_arrow_filter(self.predicate)
-        cols = self.columns
-        if flt is not None:
+        file_cols = None
+        if self.columns is not None:
+            present = set(f.schema_arrow.names)
+            file_cols = [c for c in self.columns if c in present]
+        if flt is not None and not needs_adapt:
             import pyarrow.dataset as ds
             dataset = ds.dataset(path, format="parquet")
-            scanner = dataset.scanner(columns=cols, filter=flt,
+            scanner = dataset.scanner(columns=file_cols, filter=flt,
                                       batch_size=self.batch_rows)
             for rb in scanner.to_batches():
                 if rb.num_rows:
                     yield batch_from_arrow(pa.Table.from_batches([rb]))
             return
-        f = pq.ParquetFile(path)
-        for rb in f.iter_batches(batch_size=self.batch_rows, columns=cols):
-            if rb.num_rows:
-                yield batch_from_arrow(pa.Table.from_batches([rb]))
+        for rb in f.iter_batches(batch_size=self.batch_rows,
+                                 columns=file_cols):
+            if not rb.num_rows:
+                continue
+            tbl = pa.Table.from_batches([rb])
+            if needs_adapt:
+                tbl = self._adapt(tbl, legacy_dt, legacy_96, int96_cols)
+            yield batch_from_arrow(tbl)
 
 
 TpuParquetScanExec, _pq_convert = tpu_scan_of(CpuParquetScanExec)

@@ -274,3 +274,84 @@ def test_orc_pushdown_differential(tmp_path):
             P.GreaterThan(col("a"), lit(np.int64(5_000))),
             P.LessThanOrEqual(col("a"), lit(np.int64(5_100))))),
         ignore_order=True)
+
+
+# -- parquet depth: legacy rebase, int96, schema evolution ------------------
+
+def test_parquet_legacy_date_rebase(tmp_path):
+    """A file tagged org.apache.spark.legacyDateTime stores hybrid-julian
+    day counts; the scan rebases them to proleptic gregorian (reference:
+    datetimeRebaseUtils.scala)."""
+    import datetime
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.expressions.timezone_db import (
+        rebase_gregorian_to_julian_days, rebase_julian_to_gregorian_days)
+    # civil dates incl. pre-1582; what a LEGACY Spark writer would store
+    greg_days = np.array([-141500, -200000, -500000, 0, 19000],
+                         dtype=np.int64)
+    julian_days = rebase_gregorian_to_julian_days(greg_days)
+    assert (julian_days != greg_days).any(), "test needs pre-1582 dates"
+    tbl = pa.table({"d": pa.array(julian_days.astype(np.int32),
+                                  type=pa.int32()).cast(pa.date32()),
+                    "i": pa.array(range(5), type=pa.int64())})
+    tbl = tbl.replace_schema_metadata(
+        {b"org.apache.spark.legacyDateTime": b""})
+    p = str(tmp_path / "legacy.parquet")
+    pq.write_table(tbl, p)
+    s = cpu_session()
+    rows = s.read.parquet(p).collect()
+    got = sorted((r["i"], r["d"]) for r in rows)
+    epoch = datetime.date(1970, 1, 1)
+    want = sorted(
+        (i, epoch + datetime.timedelta(days=int(g)))
+        for i, g in enumerate(greg_days))
+    assert got == want
+
+
+def test_parquet_int96_timestamps(tmp_path):
+    import datetime
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    ts = [datetime.datetime(2001, 2, 3, 4, 5, 6, 789000),
+          datetime.datetime(1969, 12, 31, 23, 59, 59),
+          None]
+    tbl = pa.table({"t": pa.array(ts, type=pa.timestamp("us"))})
+    p = str(tmp_path / "i96.parquet")
+    pq.write_table(tbl, p, use_deprecated_int96_timestamps=True)
+    import pyarrow.parquet as pq2
+    assert pq2.ParquetFile(p).metadata.schema.column(0) \
+        .physical_type == "INT96"
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(p), ignore_order=True)
+    rows = cpu_session().read.parquet(p).collect()
+    got = [None if r["t"] is None else r["t"].replace(tzinfo=None)
+           for r in rows]
+    key = lambda v: (v is None, v or ts[0])   # noqa: E731
+    assert sorted(got, key=key) == sorted(ts, key=key)
+
+
+def test_parquet_schema_evolution_across_files(tmp_path):
+    """Later files add columns and widen types: missing columns read as
+    nulls, int32 widens to int64 (the multi-file evolution the reference
+    resolves per footer)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    d = tmp_path / "evo"
+    d.mkdir()
+    pq.write_table(pa.table({"a": pa.array([1, 2], type=pa.int32()),
+                             "b": pa.array(["x", "y"])}),
+                   str(d / "f1.parquet"))
+    pq.write_table(pa.table({"a": pa.array([3, 4], type=pa.int64()),
+                             "c": pa.array([1.5, 2.5])}),
+                   str(d / "f2.parquet"))
+    s = cpu_session()
+    df = s.read.parquet(str(d))
+    sch = {f.name: str(f.data_type) for f in df.schema.fields}
+    assert sch == {"a": "long", "b": "string", "c": "double"}
+    rows = sorted(df.collect(), key=lambda r: r["a"])
+    assert rows == [
+        {"a": 1, "b": "x", "c": None}, {"a": 2, "b": "y", "c": None},
+        {"a": 3, "b": None, "c": 1.5}, {"a": 4, "b": None, "c": 2.5}]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda sess: sess.read.parquet(str(d)), ignore_order=True)
