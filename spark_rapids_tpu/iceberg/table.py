@@ -1,4 +1,4 @@
-"""Iceberg v1 read path (+ a writer for tests).
+"""Iceberg v1/v2 read path (+ a writer for tests).
 
 Reference: sql-plugin/src/main/java/com/nvidia/spark/rapids/iceberg/ —
 the reference reimplements Iceberg's reader stack so data files decode on
@@ -10,8 +10,11 @@ the accelerator.  Same shape here, sized to the protocol's core:
   <table>/data/*.parquet                data files
 
 Reading: latest metadata -> current snapshot -> manifest list -> manifests
--> live data files -> the engine's multi-file parquet scan.  Deletes
-(v2 positional/equality files) are not supported and raise clearly."""
+-> live data files -> the engine's multi-file parquet scan.  v2 delete
+files (content=1 positional, content=2 equality) apply on read through a
+host-side DeleteFilter before batches reach the plan (see
+docs/compatibility.md for the NULL-equality and sequence-number
+simplifications)."""
 
 from __future__ import annotations
 
@@ -129,14 +132,20 @@ class IcebergTable:
             return None
         return next(s for s in md["snapshots"] if s["snapshot-id"] == sid)
 
-    def data_files(self) -> List[dict]:
+    def _classified_files(self):
+        """(data_files, positional_delete_files, equality_delete_files) —
+        v2 manifests carry delete files with content=1 (positional) and
+        content=2 (equality); reference: the iceberg reader stack's
+        DeleteFilter (sql-plugin/.../iceberg/, GpuDeleteFilter shape)."""
         snap = self.current_snapshot()
         if snap is None:
-            return []
+            return [], [], []
         mlist = snap["manifest-list"]
         if not os.path.isabs(mlist):
             mlist = os.path.join(self.path, mlist)
-        files: List[dict] = []
+        data: List[dict] = []
+        pos_del: List[dict] = []
+        eq_del: List[dict] = []
         for m in read_avro_records(mlist):
             mpath = m["manifest_path"]
             if not os.path.isabs(mpath):
@@ -145,34 +154,130 @@ class IcebergTable:
                 if entry["status"] == 2:      # deleted
                     continue
                 df = entry["data_file"]
-                if (df.get("content") or 0) != 0:
+                content = df.get("content") or 0
+                if content == 0:
+                    data.append(df)
+                elif content == 1:
+                    pos_del.append(df)
+                elif content == 2:
+                    eq_del.append(df)
+                else:
                     raise NotImplementedError(
-                        "iceberg v2 delete files are not supported")
-                files.append(df)
-        return files
+                        f"iceberg file content {content} not supported")
+        return data, pos_del, eq_del
+
+    def data_files(self) -> List[dict]:
+        return self._classified_files()[0]
 
     # -- read ----------------------------------------------------------------
+    def _abs(self, p: str) -> str:
+        if p.startswith("file:"):
+            p = p[5:]
+        if not os.path.isabs(p):
+            p = os.path.join(self.path, p)
+        return p
+
     def to_df(self):
-        files = self.data_files()
+        data, pos_del, eq_del = self._classified_files()
         schema = self.schema
-        paths = []
-        for df in files:
-            p = df["file_path"]
-            if p.startswith("file:"):
-                p = p[5:]
-            if not os.path.isabs(p):
-                p = os.path.join(self.path, p)
-            paths.append(p)
+        paths = [self._abs(df["file_path"]) for df in data]
         if not paths:
             from spark_rapids_tpu.columnar.batch import batch_from_pydict
             return self.session.create_dataframe(
                 batch_from_pydict({f.name: [] for f in schema.fields},
                                   schema))
-        return self.session.read.parquet(*paths)
+        if not pos_del and not eq_del:
+            return self.session.read.parquet(*paths)
+        return self._read_with_deletes(data, pos_del, eq_del)
+
+    def _read_with_deletes(self, data, pos_del, eq_del):
+        """v2 read: positional delete files hold (file_path, pos) rows;
+        equality delete files hold rows whose column set defines the
+        equality — a data row matching any delete row on those columns
+        drops.  Host-applied per data file, then handed to the engine
+        (the reference applies the same DeleteFilter before the decoded
+        batch reaches the plan).  Sequence-number scoping is simplified:
+        deletes apply to every live data file (our writer commits deletes
+        strictly after the data they target)."""
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        # positional: normalized data path -> sorted positions
+        pos_map: Dict[str, "np.ndarray"] = {}
+        for df in pos_del:
+            t = pq.read_table(self._abs(df["file_path"]))
+            fps = t.column("file_path").to_pylist()
+            ps = t.column("pos").to_pylist()
+            for fp, p in zip(fps, ps):
+                pos_map.setdefault(self._abs(fp), []).append(int(p))
+        pos_map = {k: np.unique(np.asarray(v, dtype=np.int64))
+                   for k, v in pos_map.items()}
+        eq_tables = [pq.read_table(self._abs(df["file_path"]))
+                     for df in eq_del]
+        out = []
+        for df in data:
+            p = self._abs(df["file_path"])
+            tbl = pq.read_table(p)
+            if p in pos_map:
+                drop = pos_map[p]
+                keep = np.ones(tbl.num_rows, dtype=bool)
+                keep[drop[drop < tbl.num_rows]] = False
+                tbl = tbl.take(pa.array(np.flatnonzero(keep)))
+            for et in eq_tables:
+                keys = et.column_names    # the file's columns ARE the
+                et_u = et.combine_chunks()  # equality column set
+                tbl = tbl.join(et_u.group_by(keys).aggregate([]),
+                               keys=keys, join_type="left anti")
+            out.append(tbl)
+        combined = pa.concat_tables(out, promote_options="default")
+        from spark_rapids_tpu.columnar.batch import batch_from_arrow
+        # restore declared column order (anti-join can reorder columns)
+        names = [f.name for f in self.schema.fields]
+        combined = combined.select(names)
+        return self.session.create_dataframe(batch_from_arrow(combined))
 
     def record_count(self) -> int:
-        """Metadata-only count (no data read) — the manifest stats path."""
-        return sum(df["record_count"] for df in self.data_files())
+        """Metadata-only count (no data read) when no deletes exist —
+        the manifest stats path; with v2 deletes the count requires
+        applying them."""
+        data, pos_del, eq_del = self._classified_files()
+        if not pos_del and not eq_del:
+            return sum(df["record_count"] for df in data)
+        return self.to_df().count()
+
+    # -- v2 delete commits (test harness / DML) ------------------------------
+    def add_positional_deletes(self, pairs) -> None:
+        """Commits a positional delete file: ``pairs`` =
+        [(data_file_path_as_written, position), ...]."""
+        import pyarrow as pa
+        tbl = pa.table({
+            "file_path": pa.array([p for p, _ in pairs], type=pa.string()),
+            "pos": pa.array([int(x) for _, x in pairs], type=pa.int64())})
+        self._append_delete_file(tbl, content=1)
+
+    def add_equality_deletes(self, rows: dict) -> None:
+        """Commits an equality delete file; the dict's columns define the
+        equality column set."""
+        import pyarrow as pa
+        self._append_delete_file(pa.table(rows), content=2)
+
+    def _append_delete_file(self, arrow_table, content: int) -> None:
+        import pyarrow.parquet as pq
+        previous = self._latest_metadata()
+        version = self._next_version()
+        kind = "pos" if content == 1 else "eq"
+        name = f"data/{uuid.uuid4().hex[:12]}-{kind}-deletes.parquet"
+        fpath = os.path.join(self.path, name)
+        pq.write_table(arrow_table, fpath)
+        entries = [{"status": 1, "data_file": {
+            "file_path": name, "file_format": "PARQUET",
+            "record_count": int(arrow_table.num_rows),
+            "file_size_in_bytes": os.path.getsize(fpath),
+            "content": content}}]
+        fields = (previous.get("schemas") or [previous["schema"]])[0][
+            "fields"]
+        self._commit_raw(entries, version, previous, fields,
+                         operation="delete", format_version=2)
 
     # -- write (test harness / CTAS) -----------------------------------------
     @classmethod
@@ -183,12 +288,15 @@ class IcebergTable:
         t._commit(df, version=1)
         return t
 
-    def append(self, df) -> None:
-        md = self._latest_metadata()
+    def _next_version(self) -> int:
         versions = [int(f[1:].split(".")[0])
                     for f in os.listdir(self.meta_dir)
                     if f.endswith(".metadata.json")]
-        self._commit(df, version=max(versions) + 1, previous=md)
+        return max(versions) + 1
+
+    def append(self, df) -> None:
+        md = self._latest_metadata()
+        self._commit(df, version=self._next_version(), previous=md)
 
     def _commit(self, df, version: int, previous: Optional[dict] = None):
         import pyarrow as pa
@@ -212,11 +320,21 @@ class IcebergTable:
                 "record_count": int(hb.row_count),
                 "file_size_in_bytes": os.path.getsize(fpath),
                 "content": 0}})
+        fields = [{"id": i + 1, "name": f.name,
+                   "required": not f.nullable,
+                   "type": _type_to_iceberg(f.data_type)}
+                  for i, f in enumerate(schema.fields)]
+        self._commit_raw(entries, version, previous, fields,
+                         operation="append", format_version=1)
+
+    def _commit_raw(self, entries, version: int, previous: Optional[dict],
+                    fields, operation: str, format_version: int) -> None:
+        """Shared snapshot commit: manifest + manifest list (carrying the
+        previous snapshot's manifests forward) + vN.metadata.json."""
         snap_id = version
         manifest = f"metadata/{uuid.uuid4().hex[:8]}-m0.avro"
         write_avro_records(os.path.join(self.path, manifest),
                            _MANIFEST_SCHEMA, entries)
-        # carry forward previous manifests (append semantics)
         manifests = [{"manifest_path": manifest,
                       "manifest_length": os.path.getsize(
                           os.path.join(self.path, manifest)),
@@ -233,15 +351,12 @@ class IcebergTable:
         mlist = f"metadata/snap-{snap_id}.avro"
         write_avro_records(os.path.join(self.path, mlist),
                            _MANIFEST_LIST_SCHEMA, manifests)
-        fields = [{"id": i + 1, "name": f.name,
-                   "required": not f.nullable,
-                   "type": _type_to_iceberg(f.data_type)}
-                  for i, f in enumerate(schema.fields)]
         snapshots = list((previous or {}).get("snapshots", []))
         snapshots.append({"snapshot-id": snap_id,
                           "manifest-list": mlist,
-                          "summary": {"operation": "append"}})
-        md = {"format-version": 1,
+                          "summary": {"operation": operation}})
+        prev_fv = (previous or {}).get("format-version", 1)
+        md = {"format-version": max(format_version, prev_fv),
               "table-uuid": (previous or {}).get("table-uuid",
                                                  str(uuid.uuid4())),
               "location": self.path,
