@@ -239,6 +239,33 @@ OOM_INJECTION_MODE = conf_str(
     "(reference RapidsConf.scala:1541 TEST_RETRY_OOM_INJECTION_MODE).",
     "false", ConfLevel.INTERNAL)
 
+FORCE_MERGE_REPARTITION_DEPTH = conf_int(
+    "spark.rapids.sql.test.agg.forceMergeRepartitionDepth",
+    "Test hook: force the aggregate merge's hash re-partition fallback "
+    "while recursion depth < N (0 = only under real pressure; reference "
+    "pattern: the spark.rapids.sql.test.* fault knobs).",
+    0, ConfLevel.INTERNAL)
+
+FORCE_OOC_SORT = conf_bool(
+    "spark.rapids.sql.test.sort.forceOutOfCore",
+    "Test hook: force the external (sorted-runs + merge) sort path "
+    "regardless of memory pressure.",
+    False, ConfLevel.INTERNAL)
+
+FORCE_RUNNING_WINDOW = conf_bool(
+    "spark.rapids.sql.test.window.forceRunning",
+    "Test hook: force the batched running-window path for eligible specs "
+    "regardless of memory pressure.",
+    False, ConfLevel.INTERNAL)
+
+SCAN_CACHE_ENABLED = conf_bool(
+    "spark.rapids.sql.scanCache.enabled",
+    "Keep decoded (host) and uploaded (device) scan batches resident for "
+    "repeated queries over static files (the file-cache + device-resident "
+    "catalog analog, filecache.scala).  Unbounded residency: intended for "
+    "benchmark/repeat-query sessions.",
+    False)
+
 SPILL_TO_DISK_DIR = conf_str(
     "spark.rapids.tpu.spill.dir",
     "Directory for the disk tier of the buffer catalog.",

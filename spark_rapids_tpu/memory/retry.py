@@ -53,6 +53,10 @@ class _TaskContext(threading.local):
         self.inject_retry_skip = 0
         self.inject_split_oom = 0
         self.inject_split_skip = 0
+        #: conf-armed injection only faults inside retry frames (the
+        #: reference's RMM-level retry covers EVERY allocation; ours is
+        #: frame-scoped, so an unframed fault would escape as an error)
+        self.inject_framed_only = False
         self.metrics = None  # TaskMetrics, attached by task_context()
 
 
@@ -63,11 +67,13 @@ def task_context() -> _TaskContext:
     return _TL
 
 
-def force_retry_oom(num_ooms: int = 1, skip: int = 0) -> None:
+def force_retry_oom(num_ooms: int = 1, skip: int = 0,
+                    framed_only: bool = False) -> None:
     """Arms deterministic RetryOOM injection for this thread
     (reference: RmmSpark.forceRetryOOM)."""
     _TL.inject_retry_oom = num_ooms
     _TL.inject_retry_skip = skip
+    _TL.inject_framed_only = framed_only
 
 
 def force_split_and_retry_oom(num_ooms: int = 1, skip: int = 0) -> None:
@@ -81,7 +87,9 @@ def maybe_inject_oom() -> None:
     """Called at tracked allocation points (catalog adds, kernel staging).
     Mirrors the allocation-hook injection in the RmmSpark state machine."""
     if _TL.inject_retry_oom > 0:
-        if _TL.inject_retry_skip > 0:
+        if _TL.inject_framed_only and _TL.retry_frame_depth == 0:
+            pass        # unframed point: a fault here would escape
+        elif _TL.inject_retry_skip > 0:
             _TL.inject_retry_skip -= 1
         else:
             _TL.inject_retry_oom -= 1

@@ -35,6 +35,35 @@ def set_task_parallelism(n: int) -> None:
     _task_parallelism = n
 
 
+#: per-task OOM injection mode from spark.rapids.sql.test.injectRetryOOM:
+#: 'false' | 'true' (first tracked alloc of each task) | '<n>' (n-th)
+_task_oom_injection = "false"
+
+
+def set_task_oom_injection(mode: str) -> None:
+    global _task_oom_injection
+    _task_oom_injection = (mode or "false").strip().lower()
+
+
+def _arm_task_injection() -> None:
+    from spark_rapids_tpu.memory.retry import force_retry_oom
+    mode = _task_oom_injection
+    if mode in ("", "false"):
+        # disarm: an injection left unconsumed by the previous task on
+        # this pooled thread must not fire in an unrelated query
+        force_retry_oom(0)
+        return
+    if mode == "true":
+        force_retry_oom(1, framed_only=True)
+    else:
+        try:
+            nth = int(mode)
+        except ValueError:
+            force_retry_oom(0)
+            return
+        force_retry_oom(1, skip=max(0, nth - 1), framed_only=True)
+
+
 def effective_task_parallelism() -> int:
     import os
     n = _task_parallelism
@@ -158,6 +187,10 @@ def run_task_iter(gen_fn, pidx: int):
     task_id = next(_task_ids)
     rt = get_runtime()
     with task_scope(task_id, rt.metrics if rt is not None else None):
+        # conf-driven per-task fault injection
+        # (spark.rapids.sql.test.injectRetryOOM; reference
+        # RapidsConf.scala:1541 TEST_RETRY_OOM_INJECTION_MODE)
+        _arm_task_injection()
         try:
             yield from gen_fn(pidx)
         finally:

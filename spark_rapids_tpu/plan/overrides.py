@@ -405,10 +405,24 @@ class TpuOverrides:
         """``for_explain`` produces the would-be plan without the test-mode
         all-on-device assertion (introspection must not raise on fallback).
         ``skip_pruning`` is set by callers that already pruned (count())."""
-        from spark_rapids_tpu.plan.base import set_task_parallelism
+        from spark_rapids_tpu.plan.base import (set_task_oom_injection,
+                                                set_task_parallelism)
         from spark_rapids_tpu.plan.meta import PlanMeta
         conf = self.conf
         set_task_parallelism(conf.get(C.TASK_PARALLELISM.key))
+        set_task_oom_injection(conf.get(C.OOM_INJECTION_MODE.key))
+        # conf-driven out-of-core test hooks (spark.rapids.sql.test.*)
+        import spark_rapids_tpu.exec.aggregate as _AG
+        import spark_rapids_tpu.exec.sort as _SO
+        import spark_rapids_tpu.exec.window as _WI
+        from spark_rapids_tpu.io.multifile import enable_scan_cache
+        _AG.FORCE_REPARTITION_BELOW_DEPTH = conf.get(
+            C.FORCE_MERGE_REPARTITION_DEPTH.key)
+        _SO.FORCE_OUT_OF_CORE_SORT = conf.get(C.FORCE_OOC_SORT.key)
+        _WI.FORCE_RUNNING_WINDOW = conf.get(C.FORCE_RUNNING_WINDOW.key)
+        # unconditional: false must clear a previously-enabled cache
+        # (process-global residency must not outlive the opting session)
+        enable_scan_cache(bool(conf.get(C.SCAN_CACHE_ENABLED.key)))
         plan = push_scan_predicates(plan)
         if not skip_pruning and conf.get(C.COLUMN_PRUNING_ENABLED.key, True):
             from spark_rapids_tpu.plan.pruning import prune_columns

@@ -52,3 +52,40 @@ def test_docs_generation():
     # every registered key appears
     for key in C.registry():
         assert key in docs
+
+
+def test_conf_driven_oom_injection_and_force_hooks():
+    """spark.rapids.sql.test.injectRetryOOM arms per-task injection
+    (RapidsConf.scala:1541 analog) and the out-of-core force hooks wire
+    through the session conf."""
+    import numpy as np
+    from spark_rapids_tpu.exec import aggregate as AG
+    from spark_rapids_tpu.exec import sort as SO
+    from spark_rapids_tpu import functions as F
+    from tests.asserts import tpu_session
+    s = tpu_session({
+        "spark.rapids.sql.test.enabled": "false",
+        "spark.rapids.sql.test.injectRetryOOM": "true",
+        "spark.rapids.sql.test.agg.forceMergeRepartitionDepth": "1",
+        "spark.rapids.sql.test.sort.forceOutOfCore": "true",
+    })
+    try:
+        rng = np.random.default_rng(6)
+        df = s.create_dataframe({"k": rng.integers(0, 50, 5000),
+                                 "v": rng.integers(0, 9, 5000)},
+                                num_partitions=2)
+        before_rep = AG.REPARTITION_EVENTS
+        rows = df.group_by("k").agg(F.sum("v").alias("s")).collect()
+        assert len(rows) == 50
+        assert AG.REPARTITION_EVENTS > before_rep, \
+            "forceMergeRepartitionDepth conf did not engage"
+        before_sort = SO.EXTERNAL_SORT_EVENTS
+        out = df.sort("k").collect()
+        assert len(out) == 5000
+        assert SO.EXTERNAL_SORT_EVENTS > before_sort, \
+            "forceOutOfCore sort conf did not engage"
+    finally:
+        AG.FORCE_REPARTITION_BELOW_DEPTH = 0
+        SO.FORCE_OUT_OF_CORE_SORT = False
+        from spark_rapids_tpu.plan.base import set_task_oom_injection
+        set_task_oom_injection("false")
