@@ -263,7 +263,9 @@ SCAN_CACHE_ENABLED = conf_bool(
     "Keep decoded (host) and uploaded (device) scan batches resident for "
     "repeated queries over static files (the file-cache + device-resident "
     "catalog analog, filecache.scala).  Unbounded residency: intended for "
-    "benchmark/repeat-query sessions.",
+    "benchmark/repeat-query sessions.  Process-sticky once enabled "
+    "(interleaved default-conf sessions do not clear it); release with "
+    "io.multifile.enable_scan_cache(False).",
     False)
 
 SPILL_TO_DISK_DIR = conf_str(
@@ -317,6 +319,14 @@ JOIN_NUM_SUBPARTITIONS = conf_int(
     "spark.rapids.sql.join.numSubPartitions",
     "Bucket count for oversized-join sub-partitioning.",
     16)
+
+EXCHANGE_REUSE_ENABLED = conf_bool(
+    "spark.sql.exchange.reuse",
+    "Collapse structurally identical exchange subtrees to one instance "
+    "so repeated subquery pipelines shuffle once (Spark's ReuseExchange; "
+    "the reference re-tags reused exchanges in updateForAdaptivePlan, "
+    "GpuOverrides.scala:4589).",
+    True)
 
 ADAPTIVE_COALESCE_ENABLED = conf_bool(
     "spark.sql.adaptive.coalescePartitions.enabled",

@@ -93,3 +93,53 @@ def test_skew_split_specs_cover_batches():
                for b in reader.execute_partition(p))
     want = sum(b.row_count for b in ex._store[0])
     assert rows == want
+
+
+# -- exchange reuse (Spark ReuseExchange; GpuOverrides updateForAdaptivePlan)
+
+def test_exchange_reuse_dedups_identical_subtrees():
+    import numpy as np
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
+    from spark_rapids_tpu.plan.overrides import TpuOverrides
+    from tests.asserts import tpu_session
+    s = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+    rng = np.random.default_rng(8)
+    df = s.create_dataframe({"k": rng.integers(0, 20, 4000),
+                             "v": rng.integers(0, 9, 4000)},
+                            num_partitions=3)
+    agg = df.group_by("k").agg(F.sum("v").alias("sv"))
+    u = agg.union_all(agg) if hasattr(agg, "union_all") else agg.union(agg)
+    plan = TpuOverrides(s.conf).apply(u._plan)
+    exchanges = plan.collect_nodes(
+        lambda n: isinstance(n, CpuShuffleExchangeExec))
+    assert len(exchanges) >= 2
+    assert len({id(e) for e in exchanges}) < len(exchanges), \
+        "identical exchange subtrees were not reused"
+    rows = sorted((r["k"], r["sv"]) for r in u.collect())
+    assert len(rows) == 40  # 20 groups x 2 branches
+
+
+def test_exchange_reuse_respects_differences():
+    import numpy as np
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
+    from spark_rapids_tpu.expressions.base import col, lit
+    from spark_rapids_tpu.expressions import predicates as P
+    from spark_rapids_tpu.plan.overrides import TpuOverrides
+    from tests.asserts import tpu_session
+    s = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+    rng = np.random.default_rng(8)
+    df = s.create_dataframe({"k": rng.integers(0, 20, 4000),
+                             "v": rng.integers(0, 9, 4000)},
+                            num_partitions=3)
+    a = df.filter(P.GreaterThan(col("v"), lit(2))) \
+        .group_by("k").agg(F.sum("v").alias("sv"))
+    b = df.filter(P.GreaterThan(col("v"), lit(5))) \
+        .group_by("k").agg(F.sum("v").alias("sv"))
+    u = a.union(b) if not hasattr(a, "union_all") else a.union_all(b)
+    plan = TpuOverrides(s.conf).apply(u._plan)
+    exchanges = plan.collect_nodes(
+        lambda n: isinstance(n, CpuShuffleExchangeExec))
+    assert len({id(e) for e in exchanges}) == len(exchanges), \
+        "differing subtrees must not share an exchange"

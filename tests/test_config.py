@@ -75,10 +75,17 @@ def test_conf_driven_oom_injection_and_force_hooks():
                                  "v": rng.integers(0, 9, 5000)},
                                 num_partitions=2)
         before_rep = AG.REPARTITION_EVENTS
+        from spark_rapids_tpu.memory.device_manager import get_runtime
+        rt = get_runtime()
+        before_retries = rt.metrics.total.retry_count if rt else 0
         rows = df.group_by("k").agg(F.sum("v").alias("s")).collect()
         assert len(rows) == 50
         assert AG.REPARTITION_EVENTS > before_rep, \
             "forceMergeRepartitionDepth conf did not engage"
+        # the armed injection must have actually FIRED (and been retried)
+        assert rt is not None and \
+            rt.metrics.total.retry_count > before_retries, \
+            "injectRetryOOM conf armed but no injected fault was retried"
         before_sort = SO.EXTERNAL_SORT_EVENTS
         out = df.sort("k").collect()
         assert len(out) == 5000
