@@ -118,14 +118,46 @@ def detect_skew(sizes: Sequence[int], factor: float = 5.0,
             if s > max(median * factor, min_bytes)]
 
 
+class SharedCoalesceSpecs:
+    """ONE coalesce plan for the two sides of a shuffled join: partition i
+    of both exchanges must merge identically or the key pairing breaks
+    (Spark coordinates AQE shuffle reads across join children the same
+    way).  Sizes are summed across sides so the target bound applies to
+    the pair."""
+
+    def __init__(self, left_ex, right_ex, target_bytes: int):
+        import threading
+        self._exs = (left_ex, right_ex)
+        self._target = target_bytes
+        self._specs: Optional[List[PartitionSpec]] = None
+        self._lock = threading.Lock()
+
+    def get(self) -> List[PartitionSpec]:
+        if self._specs is None:
+            from spark_rapids_tpu.plan.base import release_semaphore_for_wait
+            release_semaphore_for_wait()
+            with self._lock:
+                if self._specs is None:
+                    lsz = _partition_sizes(self._exs[0])
+                    rsz = _partition_sizes(self._exs[1])
+                    sizes = [a + b for a, b in zip(lsz, rsz)]
+                    # whole-partition coalescing only — a partial split
+                    # on one side without the other would break pairing
+                    self._specs = coalesce_specs(sizes, self._target)
+        return self._specs
+
+
 class AdaptiveShuffleReaderExec(UnaryExec):
     """Reads an exchange through derived partition specs."""
 
     def __init__(self, exchange, target_bytes: int = 64 << 20,
-                 specs: Optional[List[PartitionSpec]] = None):
+                 specs: Optional[List[PartitionSpec]] = None,
+                 shared: Optional[SharedCoalesceSpecs] = None):
         super().__init__(exchange)
         self.target_bytes = target_bytes
         self._specs = specs
+        #: coordinated specs shared with the sibling join side
+        self._shared = shared
 
     @property
     def is_device(self):  # type: ignore[override]
@@ -134,6 +166,9 @@ class AdaptiveShuffleReaderExec(UnaryExec):
     @property
     def specs(self) -> List[PartitionSpec]:
         if self._specs is None:
+            if self._shared is not None:
+                self._specs = self._shared.get()
+                return self._specs
             # materializes the child exchange: drop device admission and
             # serialize against concurrent tasks (plan/base.py semantics)
             from spark_rapids_tpu.plan.base import release_semaphore_for_wait
@@ -175,33 +210,92 @@ class AdaptiveShuffleReaderExec(UnaryExec):
 
 
 def insert_adaptive_readers(plan: Exec, target_bytes: int) -> Exec:
-    """Planner pass: wrap every shuffle exchange whose parent will iterate
-    its reduce partitions (coalescing is always safe: whole partitions
-    merge, so hash groups and range order are preserved)."""
+    """Planner pass (TOP-down): wrap every shuffle exchange whose parent
+    will iterate its reduce partitions (coalescing whole partitions is
+    safe: hash groups and range order are preserved).
+
+    Join inputs pair partition i with partition i, so the two sides of a
+    shuffled join read through ONE coordinated spec (Spark coordinates
+    AQE shuffle reads across join children identically); a join side
+    that CANNOT be coordinated gets no reader at all — an independently
+    coalesced side would silently mis-pair the join keys."""
+    from spark_rapids_tpu.exec.basic import TpuCoalesceBatchesExec
     from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
     from spark_rapids_tpu.plan.base import BinaryExec
 
     from spark_rapids_tpu.parallel.mesh import active_mesh
     mesh_on = active_mesh() is not None
 
-    def fix(node: Exec) -> Exec:
-        if isinstance(node, BinaryExec):
-            # join inputs pair partition i with partition i: independent
-            # re-coalescing would break the pairing (Spark coordinates
-            # these specs across both sides; that path is the join's)
-            return node
+    def unwrap(c):
+        """(exchange, rewrap) looking through the post-shuffle batch
+        coalescer the transition pass inserts."""
+        if isinstance(c, CpuShuffleExchangeExec):
+            return c, (lambda inner: inner)
+        if isinstance(c, TpuCoalesceBatchesExec) and \
+                isinstance(c.children[0], CpuShuffleExchangeExec):
+            return c.children[0], \
+                (lambda inner, outer=c: outer.with_children([inner]))
+        return None, None
+
+    #: identity memo: a node shared by several parents (ReuseExchange)
+    #: must map to ONE rewritten node, or the sharing silently splits
+    #: into per-parent copies that each re-materialize the shuffle
+    memo: dict = {}
+
+    def visit(node: Exec, no_wrap: bool = False) -> Exec:
+        # an exchange's own rebuild is flag-independent (no_wrap only
+        # tells the PARENT not to wrap it) — normalize the key so a
+        # shared exchange visited from join and non-join parents stays
+        # one instance
+        flag = (False if isinstance(node, CpuShuffleExchangeExec)
+                else no_wrap)
+        key = (id(node), flag)
+        if key in memo:
+            return memo[key]
+        out = _visit(node, no_wrap)
+        memo[key] = out
+        return out
+
+    def _visit(node: Exec, no_wrap: bool = False) -> Exec:
+        if isinstance(node, BinaryExec) and not mesh_on:
+            l, r = node.children
+            lex, lwrap = unwrap(l)
+            rex, rwrap = unwrap(r)
+            if (lex is not None and rex is not None and
+                    lex.num_partitions == rex.num_partitions and
+                    lex.num_partitions > 1):
+                # rebuild through the memoized visit so an exchange shared
+                # with other consumers (ReuseExchange) stays ONE instance
+                lex = visit(lex, no_wrap=True)
+                rex = visit(rex, no_wrap=True)
+                shared = SharedCoalesceSpecs(lex, rex, target_bytes)
+                return node.with_children([
+                    lwrap(AdaptiveShuffleReaderExec(lex, target_bytes,
+                                                    shared=shared)),
+                    rwrap(AdaptiveShuffleReaderExec(rex, target_bytes,
+                                                    shared=shared))])
+            # un-coordinatable: children recurse with their top-level
+            # exchange left unwrapped (partition pairing must hold)
+            return node.with_children([visit(c, no_wrap=True)
+                                       for c in node.children])
         new_children = []
         for c in node.children:
-            if isinstance(c, CpuShuffleExchangeExec) and \
-                    not isinstance(node, AdaptiveShuffleReaderExec):
+            # the batch coalescer is transparent: pass the no-wrap flag
+            # one level through it
+            child_no_wrap = no_wrap and isinstance(
+                node, TpuCoalesceBatchesExec)
+            c2 = visit(c, no_wrap=child_no_wrap)
+            if isinstance(c2, CpuShuffleExchangeExec) and \
+                    not isinstance(node, AdaptiveShuffleReaderExec) and \
+                    not child_no_wrap:
                 if mesh_on:
                     # mesh shuffles map reduce partitions 1:1 onto device
                     # shards; coalescing would concatenate batches living
                     # on different devices into one downstream kernel
-                    new_children.append(c)
+                    new_children.append(c2)
                     continue
-                c = AdaptiveShuffleReaderExec(c, target_bytes)
-            new_children.append(c)
+                c2 = AdaptiveShuffleReaderExec(c2, target_bytes)
+            new_children.append(c2)
         return node.with_children(new_children)
 
-    return plan.transform_up(fix)
+    return visit(plan)

@@ -536,16 +536,17 @@ class TpuOverrides:
         out = insert_transitions(converted, conf)
         out = self._coalesce_after_device_sources(out)
         out = fuse_device_stages(out)
+        if conf.get(C.EXCHANGE_REUSE_ENABLED.key):
+            out = reuse_exchanges(out)
         if conf.get(C.ADAPTIVE_COALESCE_ENABLED.key):
+            # runs AFTER reuse and is identity-memoized, so shared
+            # exchange instances stay shared (a plain transform_up would
+            # shallow-copy every occurrence apart) and the coordinated
+            # specs capture the exact in-tree exchanges
             from spark_rapids_tpu.exec.adaptive import \
                 insert_adaptive_readers
             out = insert_adaptive_readers(
                 out, C.parse_bytes(conf.get(C.ADVISORY_PARTITION_BYTES.key)))
-        if conf.get(C.EXCHANGE_REUSE_ENABLED.key):
-            # LAST tree transform: any later transform_up would copy the
-            # shared instances apart again (with_children shallow-copies
-            # every occurrence separately)
-            out = reuse_exchanges(out)
         if conf.is_test_enabled and not for_explain:
             validate_all_on_device(out, conf)
         from spark_rapids_tpu.aux.capture import ExecutionPlanCaptureCallback

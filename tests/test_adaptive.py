@@ -143,3 +143,41 @@ def test_exchange_reuse_respects_differences():
         lambda n: isinstance(n, CpuShuffleExchangeExec))
     assert len({id(e) for e in exchanges}) == len(exchanges), \
         "differing subtrees must not share an exchange"
+
+
+def test_coordinated_join_side_coalescing():
+    """Both sides of a shuffled join read through ONE coordinated spec:
+    tiny shuffle partitions coalesce identically on both sides (pairing
+    preserved) and the join result matches the oracle."""
+    import numpy as np
+    from spark_rapids_tpu.exec.adaptive import AdaptiveShuffleReaderExec
+    from spark_rapids_tpu.plan.overrides import TpuOverrides
+    from tests.asserts import (assert_tpu_and_cpu_are_equal_collect,
+                               tpu_session)
+    rng = np.random.default_rng(3)
+    da = {"k": rng.integers(0, 40, 3000), "v": rng.integers(0, 9, 3000)}
+    db = {"k": rng.integers(0, 40, 2000), "w": rng.integers(0, 9, 2000)}
+
+    def q(s):
+        a = s.create_dataframe(da, num_partitions=4)
+        b = s.create_dataframe(db, num_partitions=4)
+        return a.join(b, on="k")
+
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+    s = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+    plan = TpuOverrides(s.conf).apply(q(s)._plan)
+    readers = plan.collect_nodes(
+        lambda n: isinstance(n, AdaptiveShuffleReaderExec))
+    shared = [r for r in readers if r._shared is not None]
+    assert len(shared) >= 2, "join sides did not get coordinated readers"
+    assert shared[0]._shared is shared[1]._shared
+    # the shared specs must reference the IN-TREE exchanges (a later
+    # tree transform copying them apart would double-materialize every
+    # shuffled join -- found in review)
+    in_tree = {id(r.children[0]) for r in shared}
+    assert {id(e) for e in shared[0]._shared._exs} == in_tree
+    # tiny partitions genuinely coalesce (4 -> 1 on both sides)
+    assert shared[0].num_partitions == 1
+    assert shared[1].num_partitions == 1
+    rows = plan.collect_host().to_pydict()
+    assert rows and len(next(iter(rows.values()))) > 0
