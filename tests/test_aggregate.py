@@ -195,26 +195,11 @@ def test_pivot_conditional_aggregation():
 
 # -- out-of-core merge: re-partition fallback (GpuAggregateExec.scala:711) --
 
-@pytest.fixture
-def force_repartition():
-    """Forces the merge re-partition fallback below the given depth —
-    the deterministic analog of arming SplitAndRetryOOM at exactly the
-    merge site (the allocation-hook injection can land on an earlier
-    catalog add, outside the merge's catch scope by design)."""
+def test_merge_repartition_fallback_matches_oracle():
+    """Forced re-partition (via the session conf — the deterministic
+    analog of arming SplitAndRetryOOM at exactly the merge site) must
+    still match the CPU oracle, and the fallback must actually run."""
     from spark_rapids_tpu.exec import aggregate as A
-
-    def arm(depth=1):
-        A.FORCE_REPARTITION_BELOW_DEPTH = depth
-        return A
-    yield arm
-    A = arm  # noqa: F841
-    import spark_rapids_tpu.exec.aggregate as AG
-    AG.FORCE_REPARTITION_BELOW_DEPTH = 0
-
-
-def test_merge_repartition_fallback_matches_oracle(force_repartition):
-    """Forced re-partition during the agg merge must still match the
-    CPU oracle, and the fallback must actually have run."""
     from tests.asserts import cpu_session, tpu_session
     def q(s):
         return _df(s, n=30_000, parts=4, nkeys=991).group_by("k").agg(
@@ -222,9 +207,10 @@ def test_merge_repartition_fallback_matches_oracle(force_repartition):
             F.min("i").alias("mi"), F.max("v").alias("mv"))
     expected = sorted(q(cpu_session()).collect(),
                       key=lambda r: (r["k"] is None, r["k"]))
-    A = force_repartition(depth=1)
     before = A.REPARTITION_EVENTS
-    got = sorted(q(tpu_session()).collect(),
+    s = tpu_session({
+        "spark.rapids.sql.test.agg.forceMergeRepartitionDepth": "1"})
+    got = sorted(q(s).collect(),
                  key=lambda r: (r["k"] is None, r["k"]))
     assert A.REPARTITION_EVENTS > before, "fallback did not engage"
     assert len(got) == len(expected)
@@ -234,20 +220,21 @@ def test_merge_repartition_fallback_matches_oracle(force_repartition):
         assert g["mv"] == pytest.approx(e["mv"], rel=1e-12)
 
 
-def test_merge_repartition_recursion_two_levels(force_repartition):
+def test_merge_repartition_recursion_two_levels():
     """Depth-2 forcing: every level-0 bucket re-splits once more on
     FRESH hash bits (without the per-depth bit shift every row of a
     bucket would collapse back into a single sub-bucket)."""
+    from spark_rapids_tpu.exec import aggregate as A
     from tests.asserts import cpu_session, tpu_session
     def q(s):
         return _df(s, n=20_000, parts=4, nkeys=499).group_by("k").agg(
             F.sum("i").alias("si"), F.count().alias("c"))
     expected = {r["k"]: (r["si"], r["c"])
                 for r in q(cpu_session()).collect()}
-    A = force_repartition(depth=2)
     before = A.REPARTITION_EVENTS
-    got = {r["k"]: (r["si"], r["c"])
-           for r in q(tpu_session()).collect()}
+    s = tpu_session({
+        "spark.rapids.sql.test.agg.forceMergeRepartitionDepth": "2"})
+    got = {r["k"]: (r["si"], r["c"]) for r in q(s).collect()}
     # one level-0 pass + one per non-empty level-0 bucket
     assert A.REPARTITION_EVENTS - before > 2
     assert got == expected

@@ -233,20 +233,22 @@ def test_bounded_range_frame_rejected():
 
 # -- batched running windows (GpuRunningWindowExec.scala:220 analog) --------
 
+#: session conf forcing the running path AND the sort stage's external
+#: chunking — in production both engage together under the same memory
+#: pressure (module globals are overwritten from conf at every plan
+#: compile, so tests arm via conf)
+RUNNING_CONF = {"spark.rapids.sql.test.window.forceRunning": "true",
+                "spark.rapids.sql.test.sort.forceOutOfCore": "true"}
+
+
 @pytest.fixture
 def force_running_window():
-    """Forces the running path AND the sort stage's external chunking
-    (small output chunks) so the carry crosses several batches — in
-    production both engage together under the same memory pressure."""
+    """Small merge chunks so the carry crosses several batches."""
     from spark_rapids_tpu.exec import sort as S
     from spark_rapids_tpu.exec import window as W
-    W.FORCE_RUNNING_WINDOW = True
-    S.FORCE_OUT_OF_CORE_SORT = True
     prev_rows = S._MERGE_OUT_ROWS
     S._MERGE_OUT_ROWS = 700
     yield W
-    W.FORCE_RUNNING_WINDOW = False
-    S.FORCE_OUT_OF_CORE_SORT = False
     S._MERGE_OUT_ROWS = prev_rows
 
 
@@ -276,7 +278,7 @@ def test_running_window_ranks_multi_batch(force_running_window):
                 F.Alias(F.row_number().over(W_GO()), "rn"),
                 F.Alias(F.rank().over(W_GO()), "r"),
                 F.Alias(F.dense_rank().over(W_GO()), "dr")),
-        ignore_order=True)
+        ignore_order=True, conf=RUNNING_CONF)
     assert Wm.RUNNING_WINDOW_EVENTS > before, "running path did not engage"
 
 
@@ -292,7 +294,7 @@ def test_running_window_aggs_multi_batch(force_running_window):
                 F.Alias(F.count("v").over(_running_frame()), "rc"),
                 F.Alias(F.min("v").over(_running_frame()), "rmin"),
                 F.Alias(F.max("v").over(_running_frame()), "rmax")),
-        ignore_order=True, approx_float=True)
+        ignore_order=True, approx_float=True, conf=RUNNING_CONF)
 
 
 def test_running_window_single_group_spans_batches(force_running_window):
@@ -309,7 +311,7 @@ def test_running_window_single_group_spans_batches(force_running_window):
                 F.Alias(F.row_number().over(W_GO()), "rn"),
                 F.Alias(F.rank().over(W_GO()), "r"),
                 F.Alias(F.sum("v").over(_running_frame()), "rs")),
-        ignore_order=True)
+        ignore_order=True, conf=RUNNING_CONF)
 
 
 def test_running_window_not_eligible_falls_back(force_running_window):
@@ -319,7 +321,7 @@ def test_running_window_not_eligible_falls_back(force_running_window):
         lambda s: s.create_dataframe(_big_data(1500), num_partitions=3)
         .select(F.col("g"), F.col("o"), F.col("v"),
                 F.Alias(F.lag("v", 1).over(W_GO()), "lg")),
-        ignore_order=True, approx_float=True)
+        ignore_order=True, approx_float=True, conf=RUNNING_CONF)
 
 
 def test_window_sum_nan_inf_no_poison():
