@@ -338,6 +338,45 @@ class CollectSet(AggregateFunction):
         return refs[0]
 
 
+class CountDistinct(AggregateFunction):
+    """count(DISTINCT x): a distinct-set buffer (COMPLETE-mode planning,
+    Spark's ObjectHashAggregate pattern) sized at final.  Spark rewrites
+    distinct aggregates with Expand (RewriteDistinctAggregates); this
+    engine's complete pass reaches the same results — nulls are ignored
+    and the count is never null (reference: the cuDF collect-set-backed
+    distinct path, aggregateFunctions.scala)."""
+
+    requires_complete = True
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def sql(self):
+        return f"count(DISTINCT {self.children[0].sql()})"
+
+    def buffers(self):
+        set_t = T.ArrayType(self.children[0].data_type,
+                            contains_null=False)
+        return [BufferSpec("set", set_t, "distinct", "distinct")]
+
+    def evaluate(self, refs):
+        from spark_rapids_tpu.expressions.cast import Cast
+        from spark_rapids_tpu.expressions.collections import Size
+        from spark_rapids_tpu.expressions.conditional import Greatest
+        from spark_rapids_tpu.expressions.base import Literal
+        # size() of a null set is -1 (Spark legacy default, never null);
+        # an empty/all-null group must count 0
+        return Greatest(Cast(Size(refs[0]), T.LONG), Literal(0, T.LONG))
+
+
 class Percentile(AggregateFunction):
     """Exact percentile with Spark's 1-based-rank linear interpolation."""
 

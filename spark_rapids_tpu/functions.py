@@ -50,6 +50,14 @@ def count(e="*"):
     return Count(_expr(e))
 
 
+def count_distinct(e):
+    from spark_rapids_tpu.expressions.aggregates import CountDistinct
+    return CountDistinct(_expr(e))
+
+
+countDistinct = count_distinct
+
+
 def min(e):  # noqa: A001
     from spark_rapids_tpu.expressions.aggregates import Min
     return Min(_expr(e))

@@ -212,8 +212,9 @@ for _cls in (AG.VarianceSamp, AG.VariancePop, AG.StddevSamp,
         TS.TypeSig([T.DoubleType]), [TS.ParamCheck("value", _VAR_IN)]))
 
 # variable-length-state aggregates: host tier (COMPLETE-mode planning)
-for _cls in (AG.CollectList, AG.CollectSet, AG.Percentile,
-             AG.ApproximatePercentile, AG._PercentileFromList):
+for _cls in (AG.CollectList, AG.CollectSet, AG.CountDistinct,
+             AG.Percentile, AG.ApproximatePercentile,
+             AG._PercentileFromList):
     register_expr(_cls, TS.BASIC_WITH_ARRAYS)
 
 

@@ -161,11 +161,60 @@ def _ast_children(e: A.SqlExpr) -> List[A.SqlExpr]:
     return out
 
 
+def _split_disjuncts(e: A.SqlExpr) -> List[A.SqlExpr]:
+    if isinstance(e, A.BinaryOp) and e.op == "or":
+        return _split_disjuncts(e.left) + _split_disjuncts(e.right)
+    return [e]
+
+
+def _and_all(parts: List[A.SqlExpr]) -> A.SqlExpr:
+    out = parts[0]
+    for p in parts[1:]:
+        out = A.BinaryOp("and", out, p)
+    return out
+
+
+def _or_all(parts: List[A.SqlExpr]) -> A.SqlExpr:
+    out = parts[0]
+    for p in parts[1:]:
+        out = A.BinaryOp("or", out, p)
+    return out
+
+
+def _factor_or_common(e: A.SqlExpr) -> List[A.SqlExpr]:
+    """Hoists conjuncts common to EVERY branch of an OR:
+    ``(A and X) or (A and Y) -> A and (X or Y)``.
+
+    TPC-DS repeats join equalities inside each demographic OR branch
+    (q13/q48 shape); without factoring, the join planner sees no equi
+    keys and cross-joins the dimensions (Spark's optimizer performs the
+    same extraction before join planning)."""
+    branches = _split_disjuncts(e)
+    if len(branches) < 2:
+        return [e]
+    conj_lists = [_split_conjuncts(b) for b in branches]
+    common = [c for c in conj_lists[0]
+              if all(any(c == c2 for c2 in cl) for cl in conj_lists[1:])]
+    if not common:
+        return [e]
+    rests = []
+    for cl in conj_lists:
+        rest = [c for c in cl if not any(c == cm for cm in common)]
+        if not rest:       # a branch fully covered by the common part:
+            return common  # the OR is implied by it
+        rests.append(_and_all(rest))
+    return common + [_or_all(rests)]
+
+
 def _split_conjuncts(e: Optional[A.SqlExpr]) -> List[A.SqlExpr]:
     if e is None:
         return []
     if isinstance(e, A.BinaryOp) and e.op == "and":
         return _split_conjuncts(e.left) + _split_conjuncts(e.right)
+    if isinstance(e, A.BinaryOp) and e.op == "or":
+        factored = _factor_or_common(e)
+        if len(factored) > 1 or factored[0] is not e:
+            return [c for f in factored for c in _split_conjuncts(f)]
     return [e]
 
 
@@ -752,8 +801,13 @@ class Analyzer:
 
     def _agg_func(self, call: A.FuncCall, plan, scope, env) -> Expression:
         if call.distinct:
+            if call.name == "count" and len(call.args) == 1 and \
+                    not call.star:
+                return AG.CountDistinct(
+                    self._expr_sq(call.args[0], plan, scope, env))
             raise AnalysisError(
-                f"{call.name}(DISTINCT ...) not supported yet")
+                f"{call.name}(DISTINCT ...) not supported yet "
+                "(count(DISTINCT col) is)")
         if call.star or not call.args:
             if call.name != "count":
                 raise AnalysisError(f"{call.name}(*) is not valid")
@@ -1292,6 +1346,12 @@ class Analyzer:
         raise AnalysisError(f"unknown function {name}")
 
     def _window_call(self, e: A.FuncCall, rec) -> Expression:
+        if e.distinct:
+            # Spark rejects DISTINCT inside window functions too;
+            # silently computing the non-distinct form would be worse
+            raise AnalysisError(
+                f"DISTINCT is not allowed in window function "
+                f"{e.name}() OVER (...)")
         w = e.window
         part = [rec(p) for p in w.partition_by]
         order = []

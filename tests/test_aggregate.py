@@ -312,3 +312,38 @@ def test_high_cardinality_groupby_1m_groups():
             F.sum("sv").alias("tot"), F.sum("c").alias("rows"),
             F.count().alias("groups"))
     assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+
+
+# -- count(DISTINCT) (COMPLETE-mode distinct-set aggregate) -----------------
+
+def test_count_distinct_dataframe():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s, n=20_000, parts=4, nkeys=37).group_by("k").agg(
+            F.count_distinct("i").alias("cd"),
+            F.sum("v").alias("sv"),       # mixed with plain aggs
+            F.count("i").alias("ci")),
+        ignore_order=True,
+        # COMPLETE-mode distinct set is the host tier (like collect/
+        # percentile) — the strict all-on-device assertion must allow it
+        conf={"spark.rapids.sql.test.enabled": "false"})
+
+
+def test_count_distinct_sql_and_nulls():
+    from tests.asserts import cpu_session, tpu_session
+    import pyarrow as pa
+    d = {"k": pa.array([1, 1, 1, 2, 2, 3, 3, 3]),
+         "v": pa.array([5, 5, None, 7, 8, None, None, 9]),
+         "s": pa.array(["a", "b", "a", None, "c", "x", "x", None])}
+    for mk in (cpu_session,
+               lambda: tpu_session({"spark.rapids.sql.test.enabled":
+                                    "false"})):
+        s = mk()
+        s.create_or_replace_temp_view("t_cd", s.create_dataframe(
+            d, num_partitions=2))
+        rows = {r["k"]: (r["cv"], r["cs"]) for r in s.sql(
+            "select k, count(distinct v) as cv, count(distinct s) as cs "
+            "from t_cd group by k").collect()}
+        # nulls are ignored; all-null group counts 0
+        assert rows == {1: (1, 2), 2: (2, 1), 3: (1, 1)}
+        g = s.sql("select count(distinct v) as c from t_cd").collect()
+        assert g == [{"c": 4}]

@@ -190,8 +190,18 @@ def test_select_without_from():
     assert rows == [{"x": 3, "y": "hi"}]
 
 
-def test_count_distinct_unsupported_is_clear():
+def test_count_distinct_supported_others_clear_error():
+    from tests.asserts import cpu_session
+    s = _register(cpu_session())
+    rows = s.sql("select count(distinct k) as c from t").collect()
+    assert rows and rows[0]["c"] >= 1
+    with pytest.raises(Exception, match="DISTINCT"):
+        s.sql("select sum(distinct k) from t").collect()
+
+
+def test_count_distinct_in_window_rejected():
     from tests.asserts import cpu_session
     s = _register(cpu_session())
     with pytest.raises(Exception, match="DISTINCT"):
-        s.sql("select count(distinct k) from t").collect()
+        s.sql("select count(distinct v) over (partition by k) from t") \
+            .collect()
