@@ -37,6 +37,7 @@ _BASE = {
     "reason": 35,
     "web_site": 6,
     "catalog_page": 120,
+    "call_center": 6,
     "date_dim": 1_461,   # 4 years: 1998-2002
 }
 
@@ -101,6 +102,9 @@ def generate_tables(sf: float = 0.01, seed: int = 20) -> Dict[str, dict]:
         "d_week_seq": (5270 + (np.arange(nd) + 3) // 7).astype(np.int32),
         "d_month_seq": ((years - 1900) * 12 + months - 1).astype(np.int32),
         "d_day_name": _DAYS[(np.arange(nd) + 4) % 7].astype(object),
+        "d_quarter_name": np.array(
+            [f"{y}Q{(m - 1) // 3 + 1}" for y, m in zip(years, months)],
+            dtype=object),
     }
 
     # ---- small dimensions -------------------------------------------------
@@ -129,6 +133,17 @@ def generate_tables(sf: float = 0.01, seed: int = 20) -> Dict[str, dict]:
         "cp_catalog_page_sk": np.arange(1, ncp + 1, dtype=np.int64),
         "cp_catalog_page_id": np.array([f"cpage_{i}" for i in range(ncp)],
                                        dtype=object),
+    }
+    ncc = n["call_center"]
+    t["call_center"] = {
+        "cc_call_center_sk": np.arange(1, ncc + 1, dtype=np.int64),
+        "cc_call_center_id": np.array([f"cc_{i}" for i in range(ncc)],
+                                      dtype=object),
+        "cc_county": rng.choice(_COUNTIES[:5], ncc).astype(object),
+        "cc_name": np.array([f"center {i}" for i in range(ncc)],
+                            dtype=object),
+        "cc_manager": np.array([f"Mgr{i}" for i in range(ncc)],
+                               dtype=object),
     }
     nr = n["reason"]
     t["reason"] = {
@@ -201,6 +216,11 @@ def generate_tables(sf: float = 0.01, seed: int = 20) -> Dict[str, dict]:
         "c_preferred_cust_flag": rng.choice(np.array(["Y", "N"]),
                                             nc).astype(object),
         "c_birth_country": np.full(nc, "UNITED STATES", dtype=object),
+        "c_birth_month": rng.integers(1, 13, nc).astype(np.int32),
+        "c_birth_year": rng.integers(1930, 1995, nc).astype(np.int32),
+        "c_birth_day": rng.integers(1, 29, nc).astype(np.int32),
+        "c_email_address": np.array([f"c{i}@example.com"
+                                     for i in range(nc)], dtype=object),
     }
 
     # ---- item --------------------------------------------------------------
@@ -224,6 +244,8 @@ def generate_tables(sf: float = 0.01, seed: int = 20) -> Dict[str, dict]:
         # carry ~ni/250 items each — uniform random leaves them absent at
         # small scale and the differential oracle goes vacuous (0 == 0)
         "i_manufact_id": (np.arange(ni) % 250 + 1).astype(np.int64),
+        "i_manufact": np.array([f"manufact{i % 250}" for i in range(ni)],
+                               dtype=object),
         "i_category_id": rng.integers(1, 11, ni),
         "i_manager_id": rng.integers(1, 100, ni),
     }
@@ -247,6 +269,7 @@ def generate_tables(sf: float = 0.01, seed: int = 20) -> Dict[str, dict]:
                 rng.uniform(-5_000, 15_000, count), 2),
             f"{prefix}_net_paid": _money(rng, count, 1, 20_000),
             f"{prefix}_wholesale_cost": _money(rng, count, 1, 100),
+            f"{prefix}_ext_ship_cost": _money(rng, count, 1, 1_000),
         }
         if extra:
             m.update(extra)
@@ -259,12 +282,22 @@ def generate_tables(sf: float = 0.01, seed: int = 20) -> Dict[str, dict]:
         "ss_addr_sk": rng.integers(1, nca + 1, nss),
         "ss_store_sk": rng.integers(1, ns + 1, nss),
         "ss_promo_sk": rng.integers(1, npm + 1, nss),
+        "ss_ticket_number": (np.arange(nss) // 2 + 1).astype(np.int64),
     })
     nsr = n["store_returns"]
+    # returns reference REAL sales lines (item/customer/ticket copied from
+    # a sampled store_sales row) so the q17-style sale->return join is
+    # non-vacuous; the return date lands after the sale
+    sr_src = rng.integers(0, nss, nsr)
+    _sale_dates = t["store_sales"]["ss_sold_date_sk"][sr_src]
     t["store_returns"] = {
-        "sr_returned_date_sk": rng.choice(dsk, nsr),
-        "sr_item_sk": rng.integers(1, ni + 1, nsr),
-        "sr_customer_sk": rng.integers(1, nc + 1, nsr),
+        # return 1-90 days AFTER the referenced sale (clipped to the
+        # calendar) so date-ordered return-window queries stay sound
+        "sr_returned_date_sk": np.minimum(
+            _sale_dates + rng.integers(1, 91, nsr), dsk[-1]),
+        "sr_item_sk": t["store_sales"]["ss_item_sk"][sr_src],
+        "sr_customer_sk": t["store_sales"]["ss_customer_sk"][sr_src],
+        "sr_ticket_number": t["store_sales"]["ss_ticket_number"][sr_src],
         "sr_store_sk": rng.integers(1, ns + 1, nsr),
         "sr_return_amt": _money(rng, nsr, 1, 5_000),
         "sr_net_loss": _money(rng, nsr, 1, 2_000),
@@ -273,13 +306,23 @@ def generate_tables(sf: float = 0.01, seed: int = 20) -> Dict[str, dict]:
     ncs = n["catalog_sales"]
     t["catalog_sales"] = fact("cs", ncs, "cs_bill_customer_sk", {
         "cs_ship_customer_sk": rng.integers(1, nc + 1, ncs),
-        "cs_call_center_sk": rng.integers(1, 7, ncs),
+        "cs_bill_cdemo_sk": rng.integers(1, ncd + 1, ncs),
+        "cs_call_center_sk": rng.integers(1, ncc + 1, ncs),
         "cs_catalog_page_sk": rng.integers(1, ncp + 1, ncs),
+        # ~3 lines per order, several warehouses: q16's "ships from >1
+        # warehouse" EXISTS needs same-order rows with differing sk
+        "cs_order_number": (np.arange(ncs) // 3 + 1).astype(np.int64),
+        "cs_warehouse_sk": rng.integers(1, 6, ncs),
+        "cs_ship_date_sk": rng.choice(dsk, ncs),
+        "cs_ship_addr_sk": rng.integers(1, nca + 1, ncs),
     })
     ncr = n["catalog_returns"]
     t["catalog_returns"] = {
         "cr_returned_date_sk": rng.choice(dsk, ncr),
         "cr_catalog_page_sk": rng.integers(1, ncp + 1, ncr),
+        # a subset of real order numbers: q16's NOT EXISTS prunes them
+        "cr_order_number": rng.choice(
+            t["catalog_sales"]["cs_order_number"], ncr),
         "cr_return_amount": _money(rng, ncr, 1, 5_000),
         "cr_net_loss": _money(rng, ncr, 1, 2_000),
     }
