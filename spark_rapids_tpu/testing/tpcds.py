@@ -67,6 +67,12 @@ _ZIP_POOL = np.array([
     "85001", "19101", "48201", "63101", "37201", "40201", "23220", "29201"])
 
 
+#: bump when generate_tables changes shape/semantics — recorded in the
+#: parquet cache's _DONE marker; mismatches (incl. explicit data_dir)
+#: force regeneration
+_DATAGEN_VERSION = 2
+
+
 def _money(rng, n, lo=0.5, hi=300.0):
     return np.round(rng.uniform(lo, hi, n), 2)
 
@@ -371,10 +377,15 @@ def _register_tables_parquet(session, sf, num_partitions, seed, tables,
 
     import pyarrow as pa
     import pyarrow.parquet as pq
-    root = data_dir or os.path.join(tempfile.gettempdir(),
-                                    f"tpcds_sf{sf}_s{seed}")
+    root = data_dir or os.path.join(
+        tempfile.gettempdir(),
+        f"tpcds_sf{sf}_s{seed}_v{_DATAGEN_VERSION}")
     marker = os.path.join(root, "_DONE")
-    if not os.path.exists(marker):
+    stale = True
+    if os.path.exists(marker):
+        with open(marker) as f:
+            stale = f.read().strip() != str(_DATAGEN_VERSION)
+    if stale:
         data = generate_tables(sf, seed)
         os.makedirs(root, exist_ok=True)
         for name, cols in data.items():
@@ -390,7 +401,7 @@ def _register_tables_parquet(session, sf, num_partitions, seed, tables,
                     pq.write_table(piece,
                                    os.path.join(tdir, f"part-{i}.parquet"))
         with open(marker, "w") as f:
-            f.write("ok")
+            f.write(str(_DATAGEN_VERSION))
     for name in _BASE:
         if tables is not None and name not in tables:
             continue

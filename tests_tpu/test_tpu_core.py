@@ -32,7 +32,7 @@ _DIM = {"b": np.arange(50, dtype=np.int32),
         "name": [f"n{i}" for i in range(50)]}
 
 
-def _run_sql(query, views=None, n_parts=1, ignore_order=True):
+def _run_sql(query, views=None, n_parts=1, ignore_order=True, conf=None):
     views = views or {"t": _DATA}
 
     def fn(session):
@@ -42,9 +42,10 @@ def _run_sql(query, views=None, n_parts=1, ignore_order=True):
                                                num_partitions=n_parts))
         return session.sql(query)
 
+    full_conf = {"spark.rapids.sql.test.enabled": "false"}
+    full_conf.update(conf or {})
     assert_tpu_and_cpu_are_equal_collect(
-        fn, ignore_order=ignore_order,
-        conf={"spark.rapids.sql.test.enabled": "false"})
+        fn, ignore_order=ignore_order, conf=full_conf)
 
 
 def test_project_filter_arithmetic():
@@ -113,3 +114,35 @@ def test_hash_function_values():
 def test_sql_end_to_end():
     _run_sql("select b, count(*) as c, sum(a) as sa from t "
              "where a > -500 group by b order by b", ignore_order=False)
+
+
+def test_out_of_core_sort_on_chip():
+    """Round-4 external sort (device runs + packed-key merge) forced via
+    the session conf, on the real chip."""
+    _run_sql("select a, d from t order by d, a", ignore_order=False,
+             conf={"spark.rapids.sql.test.sort.forceOutOfCore": "true"})
+
+
+def test_agg_merge_repartition_on_chip():
+    """Round-4 out-of-core aggregate merge (hash re-partition fallback)
+    forced via conf, on the real chip."""
+    _run_sql("select b, count(*) as c, sum(a) as sa, min(a) as mn "
+             "from t group by b", n_parts=2,
+             conf={"spark.rapids.sql.test.agg.forceMergeRepartitionDepth":
+                   "1"})
+
+
+def test_running_window_carry_on_chip():
+    """Round-4 batched running windows: carry state across sort chunks on
+    the real chip (running aggregates + rank family)."""
+    _run_sql(
+        "select b, a, row_number() over (partition by b order by a) rn,"
+        " sum(a) over (partition by b order by a"
+        "              rows between unbounded preceding and current row"
+        "             ) rs from t where a <> 0", n_parts=2,
+        conf={"spark.rapids.sql.test.window.forceRunning": "true",
+              "spark.rapids.sql.test.sort.forceOutOfCore": "true"})
+
+
+def test_count_distinct_on_chip():
+    _run_sql("select b, count(distinct s) as cd from t group by b")
