@@ -544,15 +544,31 @@ def _tag_aggregate(meta) -> None:
     """Rejects device-unsupported agg shapes (planner fallback instead of
     wrong results — reference: GpuHashAggregateMeta.tagPlanForGpu)."""
     lay = meta.plan.layout
-    for j, (_ai, spec) in enumerate(lay.flat):
+    for j, (ai, spec) in enumerate(lay.flat):
         dt = spec.dtype
         if isinstance(dt, (T.StringType, T.BinaryType)) and \
                 spec.update_kind in ("min", "max"):
             meta.will_not_work(f"min/max over strings not on device yet "
                                f"(buffer {lay.buffer_name(j)})")
         if isinstance(dt, T.DecimalType) and dt.is_decimal128:
-            meta.will_not_work(f"decimal128 aggregation buffer "
-                               f"{lay.buffer_name(j)} not on device yet")
+            # SUM buffers ride the 4x32-bit limb segment-sum kernel; the
+            # scale-preserving widening cast covers the input projection.
+            # Buffers at the 38-digit clamp (input precision >= 28) stay
+            # host-tier: they can genuinely overflow, and the device
+            # kernel wraps mod 2^128 instead of nulling (Spark non-ANSI).
+            # Below the clamp Spark's +10-digit headroom means overflow
+            # would need > 10^10 rows.  Other kinds (min/max/first/last,
+            # avg's final divide) still lack decimal128 device kernels.
+            from spark_rapids_tpu.expressions.aggregates import Sum
+            func = lay.aggs[ai].func
+            sum_ok = (isinstance(func, Sum) and
+                      spec.update_kind == "sum" and
+                      spec.merge_kind == "sum" and dt.precision < 38)
+            if not sum_ok:
+                meta.will_not_work(
+                    f"decimal128 aggregation buffer "
+                    f"{lay.buffer_name(j)} not on device "
+                    "(sum below the 38-digit clamp is)")
         if spec.update_kind in ("list", "distinct"):
             meta.will_not_work(
                 f"variable-length aggregation buffer "

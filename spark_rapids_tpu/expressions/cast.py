@@ -50,6 +50,12 @@ class Cast(Expression):
         src, dst = self.child.data_type, self.to
         if isinstance(src, T.NullType):
             return None
+        if isinstance(src, T.DecimalType) and \
+                isinstance(dst, T.DecimalType) and \
+                src.scale == dst.scale and dst.precision >= src.precision:
+            # same-scale precision widening: pure limb sign-extension,
+            # never overflows (the decimal sum buffer cast)
+            return None
         if src.is_numeric and dst.is_numeric and not (
                 isinstance(src, T.DecimalType) or isinstance(dst, T.DecimalType)):
             return None
@@ -105,6 +111,16 @@ class Cast(Expression):
 
     # -- device kernels -----------------------------------------------------
     def _cast_device(self, c: TCol, src, dst, ctx, xp) -> TCol:
+        if isinstance(src, T.DecimalType) and isinstance(dst, T.DecimalType) \
+                and src.scale == dst.scale and \
+                dst.precision >= src.precision:
+            # same-scale widening: decimal64 -> [hi=sign, lo] limbs; a
+            # decimal128 source already carries the target layout
+            if src.is_decimal128 == dst.is_decimal128:
+                return TCol(c.data, c.valid, dst)
+            lo = c.data.astype(np.int64)
+            hi = xp.right_shift(lo, np.int64(63))   # arithmetic: sign
+            return TCol(xp.stack([hi, lo], axis=1), c.valid, dst)
         if src.is_numeric and dst.is_numeric:
             return TCol(_numeric_cast_dev(c.data, src, dst, xp), c.valid, dst)
         if isinstance(src, T.BooleanType) and dst.is_numeric:
@@ -135,6 +151,19 @@ class Cast(Expression):
     def _cast_host(self, c: TCol, src, dst, ctx) -> TCol:
         data, valid = c.data, valid_array(c, ctx)
         n = len(valid)
+        if isinstance(src, T.DecimalType) and isinstance(dst, T.DecimalType) \
+                and src.scale == dst.scale and \
+                dst.precision >= src.precision:
+            # same-scale widening on the host: EXACT unscaled ints — the
+            # generic numeric branch below would route through float64
+            # and corrupt values past 2^53 (host decimal128 repr = object
+            # array of python ints; decimal64 = int64)
+            if dst.is_decimal128 and not src.is_decimal128:
+                out = np.empty(n, dtype=object)
+                for i in range(n):
+                    out[i] = int(data[i]) if valid[i] else 0
+                return TCol(out, c.valid, dst)
+            return TCol(data, c.valid, dst)
         if src.is_numeric and dst.is_numeric:
             return TCol(_numeric_cast_dev(data, src, dst, np), c.valid, dst)
         if isinstance(src, T.BooleanType) and dst.is_numeric:

@@ -43,6 +43,38 @@ def select(cond, a: TCol, b: TCol, ctx: EvalContext, xp, dtype) -> TCol:
         lengths = xp.where(cond, a.lengths, b.lengths)
         valid = xp.where(cond, valid_array(a, ctx), valid_array(b, ctx))
         return TCol(data, valid, dtype, lengths=lengths)
+    if ctx.backend == "tpu" and isinstance(dtype, T.DecimalType) and \
+            dtype.is_decimal128:
+        # [n, 2] hi/lo limb planes; scalar branches (NULL, literals)
+        # densify to limb planes too
+        def _limbs(c: TCol):
+            if c.is_scalar:
+                if c.data is None:
+                    return xp.zeros((ctx.row_count, 2), dtype=np.int64)
+                import decimal as _dec
+                v = c.data
+                if isinstance(v, _dec.Decimal):
+                    # high-precision context: the default 28-digit one
+                    # would silently round wide literals
+                    cx = _dec.Context(prec=60)
+                    v = int(v.scaleb(dtype.scale, context=cx)
+                            .to_integral_value(context=cx))
+                u = int(v) % (1 << 128)
+                hi = (u >> 64) - (1 << 64 if (u >> 64) >= (1 << 63) else 0)
+                lo = (u & ((1 << 64) - 1))
+                lo = lo - (1 << 64) if lo >= (1 << 63) else lo
+                row = xp.asarray([hi, lo], dtype=np.int64)
+                return xp.broadcast_to(row, (ctx.row_count, 2))
+            d = c.data
+            if getattr(d, "ndim", 1) == 1:   # narrower decimal: widen
+                lo = d.astype(np.int64)
+                return xp.stack([xp.right_shift(lo, np.int64(63)), lo],
+                                axis=1)
+            return d
+        ad, bd = _limbs(a), _limbs(b)
+        data = xp.where(cond[:, None], ad, bd)
+        valid = xp.where(cond, valid_array(a, ctx), valid_array(b, ctx))
+        return TCol(data, valid, dtype)
     nd = dtype.np_dtype if not isinstance(dtype, (T.StringType, T.BinaryType)) \
         else np.dtype(object)
     ad = materialize(_cast_tcol(a, dtype), ctx, nd)

@@ -63,6 +63,21 @@ def _segment_reduce(kind: str, x, valid, seg, inrow, bucket, jnp,
                                   num_segments=bucket)
         return cnt, jnp.ones(bucket, dtype=bool)
     if kind == "sum":
+        if getattr(x, "ndim", 1) == 2:
+            # decimal128 (hi, lo) limbs: mod-2^128 two's-complement sum.
+            # 4x 32-bit limbs segment-summed in int64 lanes (limb < 2^32,
+            # rows < 2^31 -> no lane overflow), then ONE carry
+            # normalization; wrapped negatives add correctly mod 2^128.
+            from spark_rapids_tpu.expressions.decimal_math import (
+                _normalize, join128, split128)
+            limbs = split128(x[:, 0], x[:, 1], jnp)
+            limbs = [jnp.where(present, l, jnp.zeros_like(l))
+                     for l in limbs]
+            sums = [jax.ops.segment_sum(l, seg, num_segments=bucket)
+                    for l in limbs]
+            norm, _carry = _normalize(sums, jnp)
+            hi_s, lo_s = join128(norm, jnp)
+            return jnp.stack([hi_s, lo_s], axis=1), any_valid
         z = jnp.where(present, x, jnp.zeros_like(x))
         return jax.ops.segment_sum(z, seg, num_segments=bucket), any_valid
     if kind in ("min", "max"):
@@ -153,6 +168,16 @@ def _global_reduce(kind: str, x, valid, inrow, jnp, count_valid_only=True):
         src = present if count_valid_only else inrow
         return jnp.sum(src.astype(np.int64)), jnp.asarray(True)
     if kind == "sum":
+        if getattr(x, "ndim", 1) == 2:
+            # decimal128 limbs: see _segment_reduce's 4x32-bit scheme
+            from spark_rapids_tpu.expressions.decimal_math import (
+                _normalize, join128, split128)
+            limbs = split128(x[:, 0], x[:, 1], jnp)
+            sums = [jnp.sum(jnp.where(present, l, jnp.zeros_like(l)))
+                    for l in limbs]
+            norm, _carry = _normalize(sums, jnp)
+            hi_s, lo_s = join128(norm, jnp)
+            return jnp.stack([hi_s, lo_s]), any_valid
         return jnp.sum(jnp.where(present, x, jnp.zeros_like(x))), any_valid
     if kind in ("min", "max"):
         if jnp.issubdtype(x.dtype, jnp.inexact):
@@ -306,7 +331,9 @@ def global_agg_trace(cols, sel, specs, jnp):
         else:
             val, ok = _global_reduce(kind, c.data, c.validity, inrow, jnp,
                                      count_valid_only=cvo)
-            d, v = slot(val, ok)
+            # decimal128 sums return a (hi, lo) pair -> 2-wide plane
+            width = val.shape[0] if getattr(val, "ndim", 0) == 1 else None
+            d, v = slot(val, ok, width=width)
             outs.append((d, v, None))
         i += 1
     return outs

@@ -386,6 +386,8 @@ def _register_tables_parquet(session, sf, num_partitions, seed, tables,
         with open(marker) as f:
             stale = f.read().strip() != str(_DATAGEN_VERSION)
     if stale:
+        import shutil
+        shutil.rmtree(root, ignore_errors=True)  # stale parts must not mix
         data = generate_tables(sf, seed)
         os.makedirs(root, exist_ok=True)
         for name, cols in data.items():

@@ -263,3 +263,88 @@ def test_string_array_host_ops():
     assert rows[0]["mn"] == "apple" and rows[0]["mx"] == "pear"
     assert rows[1] == {"sa": [], "sd": [], "mn": None, "mx": None}
     assert rows[2] == {"sa": None, "sd": None, "mn": None, "mx": None}
+
+
+# -- device decimal128 SUM (4x32-bit limb segmented sums) -------------------
+
+def test_decimal128_sum_groupby_on_device():
+    """sum over decimals with >8 digits precision produces a decimal128
+    buffer — now a device kernel, not a fallback."""
+    from decimal import Decimal
+    import pyarrow as pa
+    from spark_rapids_tpu import functions as F
+    from tests.asserts import assert_tpu_and_cpu_are_equal_collect
+    import numpy as np
+    rng = np.random.default_rng(9)
+    n = 5000
+    cents = rng.integers(-10**10, 10**10, n)
+    vals = [None if rng.random() < 0.06 else
+            Decimal(int(c)).scaleb(-2) for c in cents]
+    d = {"k": pa.array(rng.integers(0, 40, n)),
+         "v": pa.array(vals, type=pa.decimal128(20, 2))}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(d, num_partitions=4)
+        .group_by("k").agg(F.sum("v").alias("sv"),
+                           F.count("v").alias("c")),
+        ignore_order=True,
+        conf={"spark.rapids.sql.test.enabled": "true"})
+
+
+def test_decimal128_sum_global_and_negatives():
+    from decimal import Decimal
+    import pyarrow as pa
+    from spark_rapids_tpu import functions as F
+    from tests.asserts import assert_tpu_and_cpu_are_equal_collect
+    vals = [Decimal("123456789012345.678"), Decimal("-123456789012345.679"),
+            Decimal("0.001"), None, Decimal("-99999999999999.999")]
+    d = {"v": pa.array(vals, type=pa.decimal128(25, 3))}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(d, num_partitions=2)
+        .agg(F.sum("v").alias("sv")),
+        ignore_order=True,
+        conf={"spark.rapids.sql.test.enabled": "true"})
+
+
+def test_decimal_minmax_still_falls_back():
+    from decimal import Decimal
+    import pyarrow as pa
+    from spark_rapids_tpu import functions as F
+    from tests.asserts import assert_tpu_fallback_collect
+    d = {"k": [1, 2], "v": pa.array([Decimal("1.5"), Decimal("2.5")],
+                                    type=pa.decimal128(20, 1))}
+    assert_tpu_fallback_collect(
+        lambda s: s.create_dataframe(d).group_by("k")
+        .agg(F.min("v").alias("m")), "CpuHashAggregateExec")
+
+
+def test_decimal128_sum_exact_past_2p53():
+    """Unscaled values beyond 2^53 must survive BOTH engines exactly (a
+    float64-routed host cast would round them — found in review)."""
+    from decimal import Decimal
+    import pyarrow as pa
+    from spark_rapids_tpu import functions as F
+    from tests.asserts import assert_tpu_and_cpu_are_equal_collect, cpu_session
+    vals = [Decimal("123456789012345.677"), Decimal("987654321098765.431"),
+            Decimal("-111111111111111.111")]
+    d = {"v": pa.array(vals, type=pa.decimal128(25, 3))}
+    exact = cpu_session().create_dataframe(d).agg(
+        F.sum("v").alias("s")).collect()
+    assert exact == [{"s": sum(vals)}], exact
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(d, num_partitions=1)
+        .agg(F.sum("v").alias("s")),
+        conf={"spark.rapids.sql.test.enabled": "true"})
+
+
+def test_decimal128_sum_at_precision_clamp_falls_back():
+    """Inputs at precision >= 28 produce a clamped 38-digit buffer that
+    can genuinely overflow -> host tier."""
+    from decimal import Decimal
+    import pyarrow as pa
+    from spark_rapids_tpu import functions as F
+    from tests.asserts import assert_tpu_fallback_collect
+    d = {"k": [1, 1], "v": pa.array([Decimal(9 * 10**36), Decimal(10**36)],
+                                    type=pa.decimal128(38, 0))}
+    assert_tpu_fallback_collect(
+        lambda s: s.create_dataframe(d).group_by("k")
+        .agg(F.sum("v").alias("s")), "CpuHashAggregateExec")
