@@ -49,6 +49,14 @@ def _remaining() -> float:
     return _BUDGET_S - (time.perf_counter() - _T0)
 
 
+def _swap_payload(out: dict):
+    """Updates the failsafe payload with the alarm quiesced: the handler
+    must never observe (and print) a half-applied update (ADVICE r4)."""
+    signal.alarm(0)
+    _PAYLOAD.update(out)
+    _arm(max(1.0, _remaining()))
+
+
 def _on_alarm(signum, frame):
     _PAYLOAD.setdefault("budget_exceeded", True)
     sys.stdout.write(json.dumps(_PAYLOAD) + "\n")
@@ -181,7 +189,7 @@ def main():
         # scarcer signal when the budget runs short
         tpcds: dict = {"partial": True}
         out["tpcds"] = tpcds
-        _PAYLOAD.update(out)
+        _swap_payload(out)
         try:
             _tpcds_phase(tpu, cpu, tpcds)
             tpcds.pop("partial", None)
@@ -213,7 +221,7 @@ def main():
             out["scaling_rows_per_sec"] = curve
         except Exception as e:  # keep the primary metric reportable
             out["scaling_error"] = f"{type(e).__name__}: {e}"
-        _PAYLOAD.update(out)
+        _swap_payload(out)
 
     signal.alarm(0)
     print(json.dumps(out))
@@ -258,9 +266,13 @@ def _tpcds_phase(tpu, cpu, res: dict):
     order = ["q3", "q7", "q9", "q8", "q6", "q1", "q10", "q2", "q5", "q4"]
     names = [q for q in order if q in QUERIES] + \
         [q for q in sorted(QUERIES) if q not in order]
+    # every query starts on the skip list and is removed when it FINISHES:
+    # an alarm firing mid-loop then reports the whole untouched tail (and
+    # the in-flight query) instead of a deceptively empty list (r4 bench
+    # showed skipped:[] with 11 queries unreported)
+    skipped.extend(names)
     for qname in names:
         if _remaining() < 25:
-            skipped.append(qname)
             continue
         sql = QUERIES[qname]
         t_rows = tpu.sql(sql).collect()       # warm (compile cache)
@@ -284,6 +296,7 @@ def _tpcds_phase(tpu, cpu, res: dict):
             per_query[qname]["empty"] = True   # vacuous: flag loudly
         if match and t_rows:
             speedups.append(t_cpu / t_tpu)
+        skipped.remove(qname)
         geomean = math.exp(sum(math.log(s) for s in speedups) /
                            len(speedups)) if speedups else 0.0
         res["geomean_speedup"] = round(geomean, 3)

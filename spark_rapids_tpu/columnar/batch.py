@@ -73,10 +73,19 @@ class ColumnarBatch:
         return sum(c.nbytes() for c in self.columns)
 
     def sized_nbytes(self) -> int:
-        """Unpadded logical size estimate (planner/coalesce sizing)."""
+        """Unpadded logical size estimate (planner/coalesce sizing).
+
+        A deferred row count is NOT forced here (spill registration sits on
+        the hot path and a host sync per batch dominates tunnel latency);
+        the padded size is returned instead — conservative, and truthful
+        about what HBM actually holds."""
         if self.bucket == 0:
             return 0
-        return int(self.nbytes() * (self.row_count / max(self.bucket, 1)))
+        from spark_rapids_tpu.columnar.column import DeferredCount
+        rc = self.row_count
+        if isinstance(rc, DeferredCount) and not rc.is_forced:
+            return self.nbytes()
+        return int(self.nbytes() * (int(rc) / max(self.bucket, 1)))
 
     def to_host(self) -> "HostColumnarBatch":
         from spark_rapids_tpu.columnar.transfer import download_host_batch

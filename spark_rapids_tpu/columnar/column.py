@@ -153,6 +153,21 @@ def rc_traceable(rc):
     return rc.traceable() if isinstance(rc, DeferredCount) else rc
 
 
+def force_counts(rcs) -> None:
+    """Forces many deferred counts with ONE device sync (stacked fetch).
+    Callers that need several batches' exact row counts (AQE partition
+    sizing) must not pay a tunnel round trip per batch."""
+    jnp = _jnp()
+    pending = [rc for rc in rcs
+               if isinstance(rc, DeferredCount) and not rc.is_forced]
+    if not pending:
+        return
+    stacked = np.asarray(jnp.stack([jnp.asarray(rc.traceable())
+                                    for rc in pending]))
+    for rc, v in zip(pending, stacked):
+        rc._val = int(v)
+
+
 def sum_counts(rcs) -> int:
     """Totals row counts with at most ONE device sync (batches already
     forced contribute host-side; the rest are summed on device first)."""

@@ -38,9 +38,18 @@ class PartialPartitionSpec:
 PartitionSpec = Union[CoalescedPartitionSpec, PartialPartitionSpec]
 
 
-def _partition_sizes(exchange) -> List[int]:
+def _partition_sizes(exchange, target_bytes: Optional[int] = None
+                     ) -> List[int]:
     """Materializes the exchange and sizes each reduce partition (the AQE
-    'query stage statistics' step)."""
+    'query stage statistics' step).
+
+    Sync discipline: padded (bucket) sizes are computable WITHOUT a device
+    round trip; logical sizes need the deferred counts forced (~150ms
+    tunnel sync per exchange).  When the padded total already fits
+    ``target_bytes``, the coalesce decision ("merge everything") is
+    identical either way — the padded sizes are returned and the sync is
+    skipped entirely (the common case for every exchange of a small-SF
+    query)."""
     import numpy as np
     exchange._materialize()
     if getattr(exchange, "_collective", None) is not None:
@@ -52,21 +61,32 @@ def _partition_sizes(exchange) -> List[int]:
             if getattr(f.data_type, "np_dtype", None) is not None else 16
             for f in schema.fields) + len(schema.fields)
         return [int(c) * row_bytes for c in counts_h]
-    sizes = []
-    for p in range(exchange.num_partitions):
-        total = 0
-        for b in exchange._store[p]:
-            # sized_nbytes: logical rows * row width.  The device-resident
-            # DEFAULT shuffle store keeps full bucket-padded planes per
-            # reduce partition, so physical nbytes() would report ~the
-            # whole map output for EVERY partition — coalesce would never
-            # merge and skew detection would see uniform huge partitions.
-            if hasattr(b, "sized_nbytes"):
-                total += b.sized_nbytes()
-            elif hasattr(b, "nbytes"):
-                total += b.nbytes()
-        sizes.append(total)
-    return sizes
+    def sizes_now():
+        out = []
+        for p in range(exchange.num_partitions):
+            total = 0
+            for b in exchange._store[p]:
+                if hasattr(b, "sized_nbytes"):
+                    total += b.sized_nbytes()
+                elif hasattr(b, "nbytes"):
+                    total += b.nbytes()
+            out.append(total)
+        return out
+
+    padded = sizes_now()   # no sync: unforced counts report bucket bytes
+    if target_bytes is not None and sum(padded) <= target_bytes:
+        return padded
+    # above target: the decision needs logical sizes — force the deferred
+    # counts in ONE sync so sized_nbytes reports rows-x-width (padded
+    # sizes would make every partition look uniformly huge and disable
+    # coalesce/skew decisions entirely)
+    from spark_rapids_tpu.columnar.column import force_counts
+    force_counts([b.row_count
+                  for p in range(exchange.num_partitions)
+                  for b in exchange._store[p]
+                  if hasattr(b, "row_count")])
+    # counts forced: sized_nbytes now reports logical rows x width
+    return sizes_now()
 
 
 def coalesce_specs(sizes: Sequence[int],
@@ -138,8 +158,10 @@ class SharedCoalesceSpecs:
             release_semaphore_for_wait()
             with self._lock:
                 if self._specs is None:
-                    lsz = _partition_sizes(self._exs[0])
-                    rsz = _partition_sizes(self._exs[1])
+                    # halve the target per side: the padded-fits-target
+                    # shortcut must hold for the SUM of both sides
+                    lsz = _partition_sizes(self._exs[0], self._target // 2)
+                    rsz = _partition_sizes(self._exs[1], self._target // 2)
                     sizes = [a + b for a, b in zip(lsz, rsz)]
                     # whole-partition coalescing only — a partial split
                     # on one side without the other would break pairing
@@ -175,7 +197,8 @@ class AdaptiveShuffleReaderExec(UnaryExec):
             release_semaphore_for_wait()
             with self._exec_lock:
                 if self._specs is None:
-                    sizes = _partition_sizes(self.children[0])
+                    sizes = _partition_sizes(self.children[0],
+                                             self.target_bytes)
                     self._specs = coalesce_specs(sizes, self.target_bytes)
         return self._specs
 
@@ -280,10 +303,17 @@ def insert_adaptive_readers(plan: Exec, target_bytes: int) -> Exec:
                                        for c in node.children])
         new_children = []
         for c in node.children:
-            # the batch coalescer is transparent: pass the no-wrap flag
-            # one level through it
-            child_no_wrap = no_wrap and isinstance(
-                node, TpuCoalesceBatchesExec)
+            # partition-preserving unary nodes (coalescer, project, filter,
+            # fused stages...) are transparent to partition pairing: the
+            # no-wrap flag must flow through ALL of them down to the next
+            # exchange, or a join input reached through e.g. a project
+            # would get an independently coalesced reader and silently
+            # mis-pair join partitions (ADVICE r4).  Exchanges reset
+            # partitioning, so propagation stops there.
+            child_no_wrap = (
+                no_wrap and isinstance(node, UnaryExec) and
+                not isinstance(node, CpuShuffleExchangeExec) and
+                node.num_partitions == node.children[0].num_partitions)
             c2 = visit(c, no_wrap=child_no_wrap)
             if isinstance(c2, CpuShuffleExchangeExec) and \
                     not isinstance(node, AdaptiveShuffleReaderExec) and \

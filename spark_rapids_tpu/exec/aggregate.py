@@ -459,6 +459,11 @@ class CpuHashAggregateExec(UnaryExec):
             buf = self._merge(hb)
         if self.mode == PARTIAL:
             yield buf
+        elif lay.num_keys == 0 and buf.row_count == 0 and \
+                self.child.num_partitions == 1:
+            # empty INPUT BATCHES (a drained filter/join still yields
+            # 0-row batches): global aggregation must emit its one row
+            yield self._empty_reduction()
         else:
             yield self._finalize(buf)
 
@@ -470,7 +475,10 @@ class CpuHashAggregateExec(UnaryExec):
         lay = self.layout
         cols = {}
         for j, (_ai, spec) in enumerate(lay.flat):
-            k = spec.update_kind if self.mode == COMPLETE else spec.merge_kind
+            # the SEMANTIC kind decides the empty value: a count slot is 0
+            # on empty input even in FINAL mode, where merge_kind is "sum"
+            # (merging counts) and would wrongly produce null
+            k = spec.update_kind
             zero = 0 if k == "count" or k.startswith("m2") else None
             if spec.dtype == T.DOUBLE and zero == 0:
                 zero = 0.0

@@ -366,20 +366,85 @@ class TpuLimitExec(UnaryExec):
         self.n = n
 
     def execute_partition(self, pidx):
+        from spark_rapids_tpu.columnar.column import (DeferredCount, _jnp,
+                                                      rc_traceable)
         from spark_rapids_tpu.ops import take_front
-        left = self.n
+        jnp = _jnp()
+        left = self.n   # int until a deferred count is consumed
+        deferred_batches = 0
         for b in self.child.execute_partition(pidx):
-            if left <= 0:
+            if isinstance(left, int) and left <= 0:
                 break
-            if b.row_count <= left:
-                left -= b.row_count
-                yield b
-            else:
-                yield take_front(b, left)
-                left = 0
+            rc = b.row_count
+            if isinstance(left, int) and \
+                    not (isinstance(rc, DeferredCount) and not rc.is_forced):
+                if int(rc) <= left:
+                    left -= int(rc)
+                    yield b
+                else:
+                    yield take_front(b, left)
+                    left = 0
+                continue
+            # deferred path: the remaining budget rides on device —
+            # forcing each batch's count would cost a sync per batch.
+            # Amortized early exit: every 8th deferred batch forces the
+            # budget once so a satisfied limit stops pulling the child
+            # (a purely deferred budget could never break the loop)
+            out = take_front(b, left if isinstance(left, int)
+                             else DeferredCount(left))
+            left = jnp.maximum(
+                jnp.asarray(rc_traceable(left)) -
+                jnp.asarray(rc_traceable(out.row_count)), 0)
+            yield out
+            deferred_batches += 1
+            if deferred_batches % 8 == 0:
+                import numpy as _np
+                left = int(_np.asarray(left))
 
     def node_desc(self):
         return f"TpuLimit[{self.n}]"
+
+
+class CpuCteCacheExec(UnaryExec):
+    """Materializes a multiply-referenced CTE subtree ONCE and replays the
+    batches to every reference (Spark analog: WithCTE + ReusedExchangeExec
+    collapse repeated CTE branches; the reference relies on Spark for this
+    and only sees the deduped plan).  The analyzer wraps a CTE plan in
+    this node when the statement references it more than once; conversion
+    copies are re-merged by the exchange-reuse pass keyed on ``origin``
+    (plan/overrides.py reuse_exchanges)."""
+
+    def __init__(self, child: Exec):
+        super().__init__(child)
+        self._cache = None
+        #: identity of the logical (analyzer-built) node — survives the
+        #: shallow copies the rewrite passes make, letting reuse collapse
+        #: converted copies back into one caching instance
+        self.origin = id(self)
+
+    def execute_partition(self, pidx):
+        from spark_rapids_tpu.plan.base import release_semaphore_for_wait
+        if self._cache is None:
+            release_semaphore_for_wait()
+            with self._exec_lock:
+                if self._cache is None:
+                    self._cache = [list(self.child.execute_partition(p))
+                                   for p in range(self.child.num_partitions)]
+        yield from self._cache[pidx]
+
+    def node_desc(self):
+        return "CteCache"
+
+
+class TpuCteCacheExec(CpuCteCacheExec):
+    is_device = True
+
+    def __init__(self, child: Exec, origin: int):
+        super().__init__(child)
+        self.origin = origin
+
+    def node_desc(self):
+        return "TpuCteCache"
 
 
 class CpuGlobalLimitExec(UnaryExec):

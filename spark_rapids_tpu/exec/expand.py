@@ -156,7 +156,7 @@ class TpuTakeOrderedAndProjectExec(CpuTakeOrderedAndProjectExec):
         if not batches:
             return None
         b = device_sort_batch(concat_batches(batches), self.specs)
-        return take_front(b, min(self.n, b.row_count))
+        return take_front(b, self.n)   # take_front clamps without a sync
 
     def execute_partition(self, pidx):
         from spark_rapids_tpu.ops import concat_batches, take_front
@@ -165,7 +165,7 @@ class TpuTakeOrderedAndProjectExec(CpuTakeOrderedAndProjectExec):
         if not tops:
             return
         merged = device_sort_batch(concat_batches(tops), self.specs)
-        merged = take_front(merged, min(self.n, merged.row_count))
+        merged = take_front(merged, self.n)
         if self.project is not None:
             merged = eval_exprs_tpu(self.project, merged)
         yield merged

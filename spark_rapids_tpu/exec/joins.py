@@ -33,9 +33,19 @@ from spark_rapids_tpu.columnar.batch import (ColumnarBatch, HostColumnarBatch,
                                              concat_host_batches)
 from spark_rapids_tpu.expressions.base import EvalContext, Expression
 from spark_rapids_tpu.ops import join_ops as J
+from spark_rapids_tpu.ops import speculation
 from spark_rapids_tpu.plan.base import BinaryExec, Exec
 
 _PAIR_TYPES = (J.INNER, J.LEFT_OUTER, J.RIGHT_OUTER, J.FULL_OUTER, J.CROSS)
+
+
+def _known_empty(rc) -> bool:
+    """True only when a batch is empty WITHOUT forcing a deferred count
+    (a host sync per batch would dominate the join wall time)."""
+    from spark_rapids_tpu.columnar.column import DeferredCount
+    if isinstance(rc, DeferredCount):
+        return rc.is_forced and int(rc) == 0
+    return int(rc) == 0
 
 
 def _normalize_how(how: str) -> str:
@@ -135,6 +145,11 @@ def _encode_key_array(hc, null_safe: bool):
         x[x == 0] = 0.0                       # -0.0 -> 0.0
         x[np.isnan(x)] = np.nan               # canonical NaN bits
         arr = pa.array(x.view(bits), mask=~hc.validity_np())
+    if isinstance(dt, (T.DateType, T.TimestampType)):
+        # equality on temporals == equality on their integer storage;
+        # the null-safe filler below cannot be cast to temporal types
+        storage = pa.int32() if isinstance(dt, T.DateType) else pa.int64()
+        arr = arr.view(storage) if hasattr(arr, "view") else arr.cast(storage)
     if null_safe:
         nulls = pc.is_null(arr)
         if pa.types.is_string(arr.type) or pa.types.is_binary(arr.type):
@@ -305,23 +320,34 @@ class _TpuJoinCore(_JoinBase):
 
     def _join_device(self, probe_batches: Iterator[ColumnarBatch],
                      build_batches: List[ColumnarBatch],
-                     build_cache: Optional[dict] = None):
+                     build_cache: Optional[dict] = None,
+                     swapped: bool = False):
         """Yields output batches for one partition.  ``build_cache`` (dict)
         carries the concatenated/keyed/sorted build side across calls —
         broadcast joins pass a per-exec dict so the build work happens once
-        for all probe partitions."""
+        for all probe partitions.
+
+        ``swapped=True`` (inner equi-joins only): the PROBE stream is the
+        RIGHT child and the build side the LEFT — the runtime build-side
+        choice (reference: Spark/GpuShuffledHashJoinExec pick the smaller
+        side to build; our planner joins in SQL order, which puts fact
+        tables on the build side in star queries).  Output column order
+        stays left-then-right via argument swap at gather time."""
         from spark_rapids_tpu.ops.batch_ops import concat_batches
         jt = self.join_type
         names = self._out_names
         ls, rs = self.left.schema, self.right.schema
+        probe_keys = self.right_keys if swapped else self.left_keys
+        build_keys = self.left_keys if swapped else self.right_keys
         cache = build_cache if build_cache is not None else {}
         use_hash = bool(self.left_keys) and jt != J.CROSS
         if "build" in cache:
             build, build_aug, build_ords = cache["build"]
         else:
-            build_batches = [b for b in build_batches if b.row_count]
+            build_batches = [b for b in build_batches
+                             if not _known_empty(b.row_count)]
             build = concat_batches(build_batches) if build_batches else \
-                _empty_device(rs)
+                _empty_device(ls if swapped else rs)
             # concat_batches passes a single input through unchanged —
             # never mutate it (it may be a shared/cached batch); rewrap
             # to drop names instead
@@ -329,7 +355,7 @@ class _TpuJoinCore(_JoinBase):
             build_aug, build_ords = (build, ())
             if use_hash:
                 build_aug, build_ords = self._augment_keys(build,
-                                                           self.right_keys)
+                                                           build_keys)
             cache["build"] = (build, build_aug, build_ords)
         # string-key word widths depend on the probe batch -> keyed sub-cache
         built_by_widths = cache.setdefault("built_by_widths", {})
@@ -337,11 +363,11 @@ class _TpuJoinCore(_JoinBase):
         semi_anti = jt in (J.LEFT_SEMI, J.LEFT_ANTI)
         empty_right = ColumnarBatch([], 0) if semi_anti else None
         for probe in probe_batches:
-            if probe.row_count == 0:
+            if _known_empty(probe.row_count):
                 continue
             if use_hash:
                 probe_aug, probe_ords = self._augment_keys(probe,
-                                                           self.left_keys)
+                                                           probe_keys)
                 pk = [probe_aug.columns[i] for i in probe_ords]
                 wkey = tuple(J._n_value_words(c) for c in pk)
                 built = built_by_widths.get(wkey)
@@ -350,9 +376,20 @@ class _TpuJoinCore(_JoinBase):
                     built_by_widths[wkey] = built
                 lo, counts, offsets, total = J._probe_ranges(
                     [probe_aug.columns[i] for i in probe_ords], built)
+                spec = speculation.active()
+                if spec is not None:
+                    # optimistic pair table = probe bucket (exact for the
+                    # FK->PK joins that dominate star schemas: <=1 build
+                    # match per probe row); overflow checked at collect,
+                    # action replays in exact mode if it ever fired
+                    out_bucket = probe_aug.bucket
+                    spec.add(total > out_bucket)
+                else:
+                    total = int(total)       # the per-join sizing sync
+                    out_bucket = J.bucket_rows(max(total, 1))
                 l_idx, r_idx, keep, pair_bucket = J._expand_verify(
                     probe_aug, probe_ords, built, self.null_safe, lo,
-                    offsets, total)
+                    offsets, total, out_bucket)
             else:
                 l_idx, r_idx, keep, pair_bucket = J.cross_pairs(probe, build)
             probe_pay = probe
@@ -370,20 +407,25 @@ class _TpuJoinCore(_JoinBase):
                     rows, n = J.unmatched_positions(flags, probe.row_count)
                 else:
                     rows, n = J.unmatched_positions(~flags, probe.row_count)
-                rmap = np.full(n, -1, dtype=np.int64)
                 yield J.gather_join_output(probe_pay, empty_right,
-                                           np.asarray(rows)[:n], rmap, n,
-                                           names)
+                                           rows, None, n, names,
+                                           out_bucket=probe.bucket)
                 continue
             l, r, n = J.compact_pairs(l_idx, r_idx, keep)
-            parts = [(l, r, n)]
             if jt in (J.LEFT_OUTER, J.FULL_OUTER):
                 flags = J.matched_flags(l_idx, keep, probe.bucket)
                 ul, un = J.unmatched_positions(flags, probe.row_count)
-                parts.append((ul, np.full(un, -1, dtype=np.int64), un))
-            lmap, rmap, total_out = J.concat_index_maps(parts)
-            yield J.gather_join_output(probe_pay, build_pay, lmap, rmap,
-                                       total_out, names)
+                lmap, rmap, total_out, ob = J.concat_matched_unmatched(
+                    l, r, n, ul, un)
+                yield J.gather_join_output(probe_pay, build_pay, lmap, rmap,
+                                           total_out, names, out_bucket=ob)
+            elif swapped:
+                # emit left-then-right: build side IS the left child here
+                yield J.gather_join_output(build_pay, probe_pay, r, l, n,
+                                           names, out_bucket=pair_bucket)
+            else:
+                yield J.gather_join_output(probe_pay, build_pay, l, r, n,
+                                           names, out_bucket=pair_bucket)
         # outer-join: unmatched build rows after the probe stream drains
         if jt in (J.RIGHT_OUTER, J.FULL_OUTER):
             if build_matched is None:
@@ -391,11 +433,9 @@ class _TpuJoinCore(_JoinBase):
                 jnp = _jnp()
                 build_matched = jnp.zeros(build.bucket, dtype=bool)
             ub, un = J.unmatched_positions(build_matched, build.row_count)
-            if un:
-                probe_empty = _empty_device(ls)
-                lmap = np.full(un, -1, dtype=np.int64)
-                yield J.gather_join_output(probe_empty, build, lmap,
-                                           np.asarray(ub)[:un], un, names)
+            probe_empty = _empty_device(ls)
+            yield J.gather_join_output(probe_empty, build, None, ub, un,
+                                       names, out_bucket=build.bucket)
 
 
 # ---------------------------------------------------------------------------
@@ -425,10 +465,32 @@ class TpuShuffledHashJoinExec(_TpuJoinCore):
     def num_partitions(self):
         return self.left.num_partitions
 
-    def execute_partition(self, pidx):
+    def _maybe_swapped(self, pidx):
         build = list(self.right.execute_partition(pidx))
-        yield from self._join_device(self.left.execute_partition(pidx),
-                                     build)
+        return self._maybe_swapped_with(build, pidx)
+
+    def _maybe_swapped_with(self, build, pidx):
+        """Runtime build-side choice for inner equi-joins: build on the
+        smaller side (reference: GpuShuffledHashJoinExec's build side is
+        planner-chosen by size; our SQL planner joins in source order,
+        which would build on the FACT side in star queries — wrong both
+        for memory and for the speculative pair sizing)."""
+        bb = sum(b.nbytes() for b in build)
+        if self.join_type == J.INNER and self.condition is None and \
+                self.left_keys and bb <= (256 << 20):
+            # comparing sides requires materializing the probe partition;
+            # bound that by only considering a swap when the build side is
+            # modest (an oversized build falls to sub-partitioning anyway)
+            probe = list(self.left.execute_partition(pidx))
+            pb = sum(b.nbytes() for b in probe)
+            if bb > pb:
+                return iter(build), probe, True
+            return iter(probe), build, False
+        return self.left.execute_partition(pidx), build, False
+
+    def execute_partition(self, pidx):
+        probe, build, swapped = self._maybe_swapped(pidx)
+        yield from self._join_device(probe, build, swapped=swapped)
 
 
 class CpuBroadcastHashJoinExec(_CpuJoinCore):
@@ -658,8 +720,8 @@ class TpuSubPartitionHashJoinExec(_SubPartitionMixin, TpuShuffledHashJoinExec):
     def execute_partition(self, pidx):
         build = list(self.right.execute_partition(pidx))
         if not self._build_oversized(build):
-            yield from self._join_device(
-                self.left.execute_partition(pidx), build)
+            probe, build, swapped = self._maybe_swapped_with(build, pidx)
+            yield from self._join_device(probe, build, swapped=swapped)
             return
         k = self.num_subpartitions
         probe = list(self.left.execute_partition(pidx))

@@ -150,10 +150,24 @@ class IcebergTable:
             mpath = m["manifest_path"]
             if not os.path.isabs(mpath):
                 mpath = os.path.join(self.path, mpath)
+            # v2 sequence-number inheritance: ADDED entries written with a
+            # null sequence_number inherit the MANIFEST-LIST entry's number
+            # (the layout standard writers produce; iceberg spec "Sequence
+            # Number Inheritance")
+            m_seq = m.get("sequence_number")
             for entry in read_avro_records(mpath):
                 if entry["status"] == 2:      # deleted
                     continue
                 df = entry["data_file"]
+                # entry-level data sequence number (v2 foreign writers);
+                # None for our own commits and v1 tables
+                seq = entry.get("sequence_number")
+                if seq is None:
+                    seq = entry.get("data_sequence_number")
+                if seq is None and entry.get("status") == 1:
+                    seq = m_seq
+                df = dict(df)
+                df["_seq"] = seq
                 content = df.get("content") or 0
                 if content == 0:
                     data.append(df)
@@ -196,34 +210,56 @@ class IcebergTable:
         equality — a data row matching any delete row on those columns
         drops.  Host-applied per data file, then handed to the engine
         (the reference applies the same DeleteFilter before the decoded
-        batch reaches the plan).  Sequence-number scoping is simplified:
-        deletes apply to every live data file (our writer commits deletes
-        strictly after the data they target)."""
+        batch reaches the plan).
+
+        Sequence-number scoping (iceberg v2 spec): a positional delete
+        applies to data files with data_seq <= delete_seq; an equality
+        delete applies strictly to OLDER data files (data_seq <
+        delete_seq).  Entries without sequence numbers (this engine's own
+        commits, v1 tables) keep the legacy rule — deletes apply to every
+        live data file (our writer commits deletes strictly after the
+        data they target) — via data_seq=0 / delete_seq=+inf defaults, so
+        a foreign table where data was appended AFTER a delete commit no
+        longer silently drops the newer rows (ADVICE r4)."""
         import numpy as np
         import pyarrow as pa
         import pyarrow.parquet as pq
-        # positional: normalized data path -> sorted positions
-        pos_map: Dict[str, "np.ndarray"] = {}
+        INF = float("inf")
+
+        def dseq(df):          # data files: unknown -> oldest
+            return 0 if df.get("_seq") is None else df["_seq"]
+
+        def xseq(df):          # delete files: unknown -> newest
+            return INF if df.get("_seq") is None else df["_seq"]
+
+        # positional: normalized data path -> [(delete_seq, positions)]
+        pos_map: Dict[str, list] = {}
         for df in pos_del:
             t = pq.read_table(self._abs(df["file_path"]))
             fps = t.column("file_path").to_pylist()
             ps = t.column("pos").to_pylist()
+            by_path: Dict[str, list] = {}
             for fp, p in zip(fps, ps):
-                pos_map.setdefault(self._abs(fp), []).append(int(p))
-        pos_map = {k: np.unique(np.asarray(v, dtype=np.int64))
-                   for k, v in pos_map.items()}
-        eq_tables = [pq.read_table(self._abs(df["file_path"]))
+                by_path.setdefault(self._abs(fp), []).append(int(p))
+            for fp, plist in by_path.items():
+                pos_map.setdefault(fp, []).append(
+                    (xseq(df), np.asarray(plist, dtype=np.int64)))
+        eq_tables = [(xseq(df), pq.read_table(self._abs(df["file_path"])))
                      for df in eq_del]
         out = []
         for df in data:
             p = self._abs(df["file_path"])
+            sq = dseq(df)
             tbl = pq.read_table(p)
-            if p in pos_map:
-                drop = pos_map[p]
+            hits = [ps for (s, ps) in pos_map.get(p, []) if sq <= s]
+            if hits:
+                drop = np.unique(np.concatenate(hits))
                 keep = np.ones(tbl.num_rows, dtype=bool)
                 keep[drop[drop < tbl.num_rows]] = False
                 tbl = tbl.take(pa.array(np.flatnonzero(keep)))
-            for et in eq_tables:
+            for s, et in eq_tables:
+                if not (sq < s):
+                    continue
                 keys = et.column_names    # the file's columns ARE the
                 et_u = et.combine_chunks()  # equality column set
                 tbl = tbl.join(et_u.group_by(keys).aggregate([]),

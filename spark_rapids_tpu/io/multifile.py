@@ -209,11 +209,16 @@ class MultiFileScanBase(LeafExec):
         files = tuple((p, os.path.getmtime(p) if os.path.exists(p) else 0)
                       for p in self.paths)
         pred = getattr(self, "predicate", None)
+        # key by the partition's ACTUAL file group, not the bare index:
+        # two scans over the same files under different reader conf
+        # (reader_type, coalesce target) map pidx to different groups and
+        # must not alias each other's cache entries (ADVICE r4)
+        group = tuple(self._plan_partitions()[pidx])
         return (self.format_name, files,
                 tuple(self.columns or ()) if hasattr(self, "columns")
                 else (),
                 None if pred is None else pred.sql(),
-                self._scan_cache_extra(), pidx, tier)
+                self._scan_cache_extra(), group, tier)
 
     def execute_partition(self, pidx: int):
         if SCAN_CACHE_ENABLED:

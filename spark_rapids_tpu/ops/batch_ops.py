@@ -157,15 +157,20 @@ def slice_batch(batch: ColumnarBatch, start: int, length: int) -> ColumnarBatch:
     return gather_batch(batch, idx, length, idx_valid=valid_rows)
 
 
-def take_front(batch: ColumnarBatch, n: int) -> ColumnarBatch:
-    """First n rows (limit); no data movement, just count + validity mask."""
+def take_front(batch: ColumnarBatch, n) -> ColumnarBatch:
+    """First n rows (limit); no data movement, just count + validity mask.
+    ``n`` may itself be deferred/a device scalar (limit budget carried on
+    device across batches — no per-batch sync)."""
     jnp = _jx()
     rc = batch.row_count
-    if isinstance(rc, DeferredCount) and not rc.is_forced:
-        n_t = jnp.minimum(jnp.asarray(n), rc.traceable())
+    n_deferred = isinstance(n, DeferredCount) or not isinstance(n, int)
+    if n_deferred or (isinstance(rc, DeferredCount) and not rc.is_forced):
+        from spark_rapids_tpu.columnar.column import rc_traceable
+        n_t = jnp.minimum(jnp.asarray(rc_traceable(n)),
+                          jnp.asarray(rc_traceable(rc)))
         n = DeferredCount(n_t)
     else:
-        n = min(n, int(rc))
+        n = min(int(n), int(rc))
         n_t = n
     keep = jnp.arange(batch.bucket) < n_t
     cols = [DeviceColumn(c.data, c.validity & keep, n, c.data_type, c.lengths,
@@ -190,8 +195,20 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
         return batches[0]
     import jax
     jnp = _jx()
-    total = sum_counts([b.row_count for b in batches])   # one sync at most
-    out_bucket = bucket_rows(total)
+    if any(isinstance(b.row_count, DeferredCount) and not b.row_count.is_forced
+           for b in batches):
+        # deferred inputs: size by the (static) bucket sum — a host sync
+        # per concat costs a ~185ms tunnel round trip; the scatter kernel
+        # masks by traced counts either way, so a roomier bucket only pads
+        from spark_rapids_tpu.columnar.column import rc_traceable as _rt
+        out_bucket = bucket_rows(sum(b.bucket for b in batches))
+        tot = jnp.asarray(_rt(batches[0].row_count), dtype=np.int64)
+        for b in batches[1:]:
+            tot = tot + jnp.asarray(_rt(b.row_count), dtype=np.int64)
+        total = DeferredCount(tot)
+    else:
+        total = sum_counts([b.row_count for b in batches])
+        out_bucket = bucket_rows(total)
     ncols = batches[0].num_columns
     # per-column max string/array width across inputs
     widths = []

@@ -191,16 +191,18 @@ def _probe_ranges(probe_keys: List[DeviceColumn], built: BuiltSide):
     from spark_rapids_tpu.columnar.column import rc_traceable
     lo, counts, offsets, total = fn(arrs, rc_traceable(probe_keys[0].row_count),
                                     built.hashes_sorted)
-    return lo, counts, offsets, int(total)
+    return lo, counts, offsets, total   # total: 0-d device (caller decides)
 
 
 def _expand_verify(probe: ColumnarBatch, probe_ordinals, built: BuiltSide,
-                   null_safe: Tuple[bool, ...], lo, offsets, total: int):
+                   null_safe: Tuple[bool, ...], lo, offsets, total,
+                   out_bucket: int):
     """Expands candidate ranges to a padded pair table and verifies true key
-    equality.  Returns (l_idx, r_idx, keep, pair_bucket)."""
+    equality.  Returns (l_idx, r_idx, keep, pair_bucket).  ``total`` may be
+    a 0-d device scalar (speculative sizing: caller picked ``out_bucket``
+    and tracks overflow via ops/speculation.py) or a host int (exact)."""
     import jax
     jnp = _jx()
-    out_bucket = bucket_rows(max(total, 1))
     pkeys = [probe.columns[i] for i in probe_ordinals]
     bkeys = [built.batch.columns[i] for i in built.key_ordinals]
     key = ("pairs", out_bucket, tuple(_col_sig(c) for c in pkeys),
@@ -258,7 +260,8 @@ def cross_pairs(probe: ColumnarBatch, build: ColumnarBatch):
     Returns (l_idx, r_idx, keep, pair_bucket)."""
     import jax
     jnp = _jx()
-    total = probe.row_count * build.row_count
+    from spark_rapids_tpu.columnar.column import rc_traceable
+    total = int(probe.row_count) * int(build.row_count)
     out_bucket = bucket_rows(max(total, 1))
     key = ("cross", out_bucket)
     fn = _PAIR_CACHE.get(key)
@@ -273,7 +276,7 @@ def cross_pairs(probe: ColumnarBatch, build: ColumnarBatch):
 
         fn = jax.jit(run)
         _PAIR_CACHE[key] = fn
-    l_idx, r_idx, keep = fn(total, build.row_count)
+    l_idx, r_idx, keep = fn(total, rc_traceable(build.row_count))
     return l_idx, r_idx, keep, out_bucket
 
 
@@ -294,8 +297,14 @@ def matched_flags(idx, keep, side_bucket: int):
 
 
 def compact_pairs(l_idx, r_idx, keep):
-    """Moves kept pairs to the front; returns (l, r, count)."""
+    """Moves kept pairs to the front; returns (l, r, count).
+
+    The count stays a :class:`DeferredCount` — forcing it here would cost a
+    host round trip per probe batch (the dominant latency on a
+    tunnel-attached chip); consumers size their output by the pair bucket
+    (static) and mask by the deferred count instead."""
     import jax
+    from spark_rapids_tpu.columnar.column import DeferredCount
     jnp = _jx()
     key = ("cpairs", int(l_idx.shape[0]))
     fn = _FINAL_CACHE.get(key)
@@ -308,12 +317,14 @@ def compact_pairs(l_idx, r_idx, keep):
         fn = jax.jit(run)
         _FINAL_CACHE[key] = fn
     l, r, n = fn(l_idx, r_idx, keep)
-    return l, r, int(n)
+    return l, r, DeferredCount(n)
 
 
 def unmatched_positions(flags, row_count: int):
-    """Row positions with no kept match, compacted; returns (idx, count)."""
+    """Row positions with no kept match, compacted; returns
+    (idx, DeferredCount) — no host sync (see compact_pairs)."""
     import jax
+    from spark_rapids_tpu.columnar.column import DeferredCount
     jnp = _jx()
     bucket = int(flags.shape[0])
     key = ("unmatched", bucket)
@@ -329,40 +340,53 @@ def unmatched_positions(flags, row_count: int):
         _FINAL_CACHE[key] = fn
     from spark_rapids_tpu.columnar.column import rc_traceable as _rt2
     idx, n = fn(flags, _rt2(row_count))
-    return idx, int(n)
+    return idx, DeferredCount(n)
 
 
 def gather_join_output(probe: ColumnarBatch, build: ColumnarBatch,
-                       l_map, r_map, count: int,
-                       names: Optional[List[str]] = None) -> ColumnarBatch:
+                       l_map, r_map, count,
+                       names: Optional[List[str]] = None,
+                       out_bucket: Optional[int] = None) -> ColumnarBatch:
     """Materializes join output rows: probe columns gathered by ``l_map``,
     build columns by ``r_map``; a negative map entry yields a null row for
-    that side (outer-join null extension).  Maps may be longer than the
-    output bucket — they are truncated/padded to ``bucket_rows(count)``."""
+    that side (outer-join null extension).  ``count`` may be a
+    :class:`DeferredCount` (no host sync) when ``out_bucket`` is given;
+    either map may be ``None``, meaning "all null rows for that side"
+    (the constant -1 map is generated inside the program — shipping a
+    bucket-sized host constant would cost a real transfer)."""
     import jax
+    from spark_rapids_tpu.columnar.column import (DeferredCount,
+                                                  rc_traceable)
     jnp = _jx()
-    out_bucket = bucket_rows(max(count, 1))
+    if out_bucket is None:
+        out_bucket = bucket_rows(max(int(count), 1))
     # pad maps to a bucketed length so the program caches across batches
-    maps_bucket = bucket_rows(max(int(l_map.shape[0]), 1))
-    if int(l_map.shape[0]) != maps_bucket:
-        pad = maps_bucket - int(l_map.shape[0])
-        l_map = jnp.pad(jnp.asarray(l_map), (0, pad), constant_values=-1)
-        r_map = jnp.pad(jnp.asarray(r_map), (0, pad), constant_values=-1)
+    some_map = l_map if l_map is not None else r_map
+    maps_bucket = bucket_rows(max(int(some_map.shape[0]), 1))
+
+    def _pad(m):
+        if m is None or int(m.shape[0]) == maps_bucket:
+            return m
+        pad = maps_bucket - int(m.shape[0])
+        return jnp.pad(jnp.asarray(m), (0, pad), constant_values=-1)
+
+    l_map, r_map = _pad(l_map), _pad(r_map)
     key = ("jgather", out_bucket, maps_bucket,
+           l_map is None, r_map is None,
            tuple(_col_sig(c) for c in probe.columns),
            tuple(_col_sig(c) for c in build.columns))
     fn = _GATHER_CACHE.get(key)
     if fn is None:
         p_bucket, b_bucket = probe.bucket, build.bucket
-        pdt = [c.data_type for c in probe.columns]
-        bdt = [c.data_type for c in build.columns]
+        no_l, no_r = l_map is None, r_map is None
 
         def run(parrs, barrs, l_map, r_map, count):
             r = jnp.arange(out_bucket, dtype=np.int64)
             live = r < count
             safe_r = jnp.clip(r, 0, maps_bucket - 1)
-            lm = jnp.take(l_map, safe_r)
-            rm = jnp.take(r_map, safe_r)
+            neg = jnp.full(out_bucket, -1, dtype=np.int64)
+            lm = neg if no_l else jnp.take(l_map, safe_r)
+            rm = neg if no_r else jnp.take(r_map, safe_r)
             outs = []
             for (d, v, ln, ev) in parrs:
                 sl = jnp.clip(lm, 0, p_bucket - 1)
@@ -380,13 +404,19 @@ def gather_join_output(probe: ColumnarBatch, build: ColumnarBatch,
                 outs.append((nd, nv, nl, ne))
             return outs
 
-        fn = jax.jit(run)
+        fn = jax.jit(run, static_argnames=())
         _GATHER_CACHE[key] = fn
     parrs = [(c.data, c.validity, c.lengths, c.elem_valid)
              for c in probe.columns]
     barrs = [(c.data, c.validity, c.lengths, c.elem_valid)
              for c in build.columns]
-    outs = fn(parrs, barrs, l_map, r_map, count)
+    zero = np.zeros(0, np.int64)
+    outs = fn(parrs, barrs,
+              zero if l_map is None else l_map,
+              zero if r_map is None else r_map,
+              rc_traceable(count))
+    if isinstance(count, DeferredCount) and count.is_forced:
+        count = int(count)
     cols = []
     all_dt = [c.data_type for c in probe.columns] + \
         [c.data_type for c in build.columns]
@@ -395,16 +425,37 @@ def gather_join_output(probe: ColumnarBatch, build: ColumnarBatch,
     return ColumnarBatch(cols, count, names)
 
 
-def concat_index_maps(parts: Sequence[Tuple[object, object, int]]):
-    """Concatenates (l_map, r_map, count) fragments into one pair of host
-    numpy maps + total (small index arrays; host assembly is fine)."""
-    ls, rs, total = [], [], 0
-    for l, r, n in parts:
-        if n <= 0:
-            continue
-        ls.append(np.asarray(l)[:n])
-        rs.append(np.asarray(r)[:n])
-        total += n
-    if not ls:
-        return np.zeros(0, np.int64), np.zeros(0, np.int64), 0
-    return np.concatenate(ls), np.concatenate(rs), total
+def concat_matched_unmatched(l, r, n, ul, un):
+    """Concatenates the matched-pair maps (l, r, count n) with null-extended
+    unmatched probe rows (positions ul, count un) entirely on device:
+    returns (l_map, r_map, DeferredCount(total), out_bucket).  The
+    fragments keep their kept entries front-compacted, so writing fragment
+    2 at traced offset ``n`` overwrites fragment 1's dead tail; positions
+    past ``n + un`` are masked by the deferred total downstream."""
+    import jax
+    from spark_rapids_tpu.columnar.column import DeferredCount, rc_traceable
+    jnp = _jx()
+    b1, b2 = int(l.shape[0]), int(ul.shape[0])
+    out_bucket = bucket_rows(max(b1 + b2, 1))
+    key = ("concat_mu", b1, b2)
+    fn = _FINAL_CACHE.get(key)
+    if fn is None:
+        def run(l, r, n, ul, un):
+            lmap = jnp.full(out_bucket, -1, dtype=np.int64)
+            rmap = jnp.full(out_bucket, -1, dtype=np.int64)
+            lmap = jax.lax.dynamic_update_slice(
+                lmap, l.astype(np.int64), (jnp.zeros((), np.int64),))
+            rmap = jax.lax.dynamic_update_slice(
+                rmap, r.astype(np.int64), (jnp.zeros((), np.int64),))
+            lmap = jax.lax.dynamic_update_slice(
+                lmap, ul.astype(np.int64), (n.astype(np.int64),))
+            rmap = jax.lax.dynamic_update_slice(
+                rmap, jnp.full(b2, -1, dtype=np.int64),
+                (n.astype(np.int64),))
+            return lmap, rmap, n + un
+        fn = jax.jit(run)
+        _FINAL_CACHE[key] = fn
+    jnp_n = jnp.asarray(rc_traceable(n), dtype=np.int64)
+    jnp_un = jnp.asarray(rc_traceable(un), dtype=np.int64)
+    lmap, rmap, total = fn(l, r, jnp_n, ul, jnp_un)
+    return lmap, rmap, DeferredCount(total), out_bucket

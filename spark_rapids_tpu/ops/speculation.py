@@ -1,0 +1,96 @@
+"""Optimistic device-side sizing with replay-on-overflow.
+
+The static-shape discipline needs a host-known bucket for every padded
+output, but fetching an exact size costs a ~185ms tunnel round trip per
+fetch — per-JOIN syncs dominated TPC-DS wall time.  This module lets an
+operator GUESS a bucket from static information (e.g. join pair table =
+probe bucket: exact for the FK->PK joins that dominate star schemas),
+record a 0-d device overflow flag, and defer the truth test to the one
+sync the query already pays at collect.  If any flag fired, the action
+replays with speculation disabled (exact, sync-per-join sizing).
+
+Reference analog: the retry-OOM framework (RmmRapidsRetryIterator.scala)
+re-executes work when a resource guess was wrong; here the guessed
+resource is an output shape instead of memory.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+from typing import List
+
+_LOCK = threading.Lock()
+#: active context stack — a contextvar, so concurrent collects on
+#: different threads never see each other's contexts.  Partition tasks on
+#: the pool run inside a COPY of the submitting thread's context
+#: (plan/base.py iter_partition_tasks), which routes their overflow flags
+#: to the right collect.
+_STACK: "contextvars.ContextVar[tuple]" = contextvars.ContextVar(
+    "speculation_stack", default=())
+#: replay mode: operators must size exactly (same contextvar propagation)
+_DISABLED: "contextvars.ContextVar[int]" = contextvars.ContextVar(
+    "speculation_disabled", default=0)
+
+
+class SpeculationOverflow(Exception):
+    """A speculative bucket was too small; the action must replay."""
+
+
+class SpeculationContext:
+    def __init__(self):
+        self._flags = []
+        self._lock = threading.Lock()
+
+    def add(self, flag) -> None:
+        """Registers a 0-d bool device array: True = overflow."""
+        with self._lock:
+            self._flags.append(flag)
+
+    def check(self) -> None:
+        """ONE device sync over every flag; raises on any overflow."""
+        with self._lock:
+            flags, self._flags = self._flags, []
+        if not flags:
+            return
+        import numpy as np
+        from spark_rapids_tpu.columnar.column import _jnp
+        jnp = _jnp()
+        if bool(np.asarray(jnp.any(jnp.stack(flags)))):
+            raise SpeculationOverflow()
+
+
+def active() -> "SpeculationContext | None":
+    if _DISABLED.get():
+        return None
+    stack = _STACK.get()
+    return stack[-1] if stack else None
+
+
+class speculation_scope:
+    """``with speculation_scope() as ctx:`` — ctx is None in replay mode."""
+
+    def __enter__(self):
+        if _DISABLED.get():
+            self._ctx = None
+            self._token = None
+            return None
+        self._ctx = SpeculationContext()
+        self._token = _STACK.set(_STACK.get() + (self._ctx,))
+        return self._ctx
+
+    def __exit__(self, *exc):
+        if self._token is not None:
+            _STACK.reset(self._token)
+        return False
+
+
+class no_speculation:
+    """Replay mode: every operator sizes exactly (sync-per-decision)."""
+
+    def __enter__(self):
+        self._token = _DISABLED.set(_DISABLED.get() + 1)
+
+    def __exit__(self, *exc):
+        _DISABLED.reset(self._token)
+        return False

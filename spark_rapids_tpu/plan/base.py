@@ -277,11 +277,17 @@ def iter_partition_tasks(task_fn, n: int, workers: Optional[int] = None):
         finally:
             put(q, _DONE)
 
+    # each task runs inside a COPY of the submitting thread's context so
+    # contextvars (the speculation scope of the owning collect) propagate
+    # to pool threads — two concurrent collects must not mix their
+    # overflow flags
+    import contextvars
+    ctx = contextvars.copy_context()
     pool = ThreadPoolExecutor(max_workers=workers,
                               thread_name_prefix="tpu-task")
     try:
         for p in range(n):
-            pool.submit(drive, p)
+            pool.submit(ctx.copy().run, drive, p)
         for p in range(n):
             while True:
                 item = qs[p].get()

@@ -247,6 +247,11 @@ def _register_basic_execs():
                   convert=lambda p, m: X.TpuLimitExec(p.n, p.children[0]),
                   sig=TS.BASIC_WITH_ARRAYS,
                   desc="limit")
+    register_exec(X.CpuCteCacheExec,
+                  convert=lambda p, m: X.TpuCteCacheExec(p.children[0],
+                                                         p.origin),
+                  sig=TS.BASIC_WITH_ARRAYS,
+                  desc="CTE materialization reuse")
     register_exec(X.CpuCoalescePartitionsExec,
                   convert=lambda p, m: X.TpuCoalescePartitionsExec(
                       p.n, p.children[0]),
@@ -442,6 +447,21 @@ def reuse_exchanges(plan: Exec) -> Exec:
     seen = {}
 
     def fix(node: Exec) -> Exec:
+        from spark_rapids_tpu.exec.basic import CpuCteCacheExec
+        if isinstance(node, CpuCteCacheExec):
+            # the rewrite passes shallow-copy a DAG-shared CTE node apart
+            # per parent; collapse the copies back onto ONE caching
+            # instance so the CTE executes once.  Keyed on the logical
+            # node's identity + output schema (column pruning may have
+            # narrowed references differently — only identical shapes
+            # merge)
+            k = ("cte", node.origin, node.is_device,
+                 tuple((f.name, str(f.data_type))
+                       for f in node.schema.fields))
+            if k in seen:
+                return seen[k]
+            seen[k] = node
+            return node
         if isinstance(node, CpuShuffleExchangeExec):
             k = sig(node)
             if k in seen:

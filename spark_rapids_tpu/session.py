@@ -682,7 +682,20 @@ class DataFrame:
         return overrides.apply(self._plan)
 
     def collect_batch(self) -> HostColumnarBatch:
-        return self._executed_plan().collect_host()
+        from spark_rapids_tpu.ops.speculation import (SpeculationOverflow,
+                                                      no_speculation,
+                                                      speculation_scope)
+        try:
+            with speculation_scope() as ctx:
+                out = self._executed_plan().collect_host()
+                if ctx is not None:
+                    ctx.check()   # one sync over every overflow flag
+                return out
+        except SpeculationOverflow:
+            # a speculative output bucket was too small somewhere: replay
+            # the whole action with exact (sync-per-decision) sizing
+            with no_speculation():
+                return self._executed_plan().collect_host()
 
     def to_pydict(self) -> Dict[str, list]:
         return self.collect_batch().to_pydict()
@@ -801,6 +814,8 @@ class GroupedData:
         self._grouping_sets = grouping_sets  # list of tuples of key indices
         self._key_names = key_names
         self._pivot = None
+        #: expose __grouping_id as the LAST output column (grouping())
+        self._keep_gid = False
 
     def _expand_for_grouping_sets(self):
         """Lowers ROLLUP/CUBE/GROUPING SETS to Expand + regular group-by
@@ -953,10 +968,14 @@ class GroupedData:
             final_keys = [_bound_ref(i, partial.schema)
                           for i in range(len(new_keys))]
             plan = CpuHashAggregateExec(final_keys, aggs, FINAL, exchange)
-        # drop the internal grouping id: keys, then agg outputs
+        # drop the internal grouping id: keys, then agg outputs — unless
+        # grouping() needs it, in which case it rides LAST so key/agg
+        # ordinal math stays unchanged
         out = [_bound_ref(i, plan.schema) for i in range(nk)]
         out += [_bound_ref(i, plan.schema)
                 for i in range(nk + 1, len(plan.schema.fields))]
+        if self._keep_gid:
+            out.append(Alias(_bound_ref(nk, plan.schema), "__grouping_id"))
         return DataFrame(CpuProjectExec(out, plan), self._df._session)
 
     def pivot(self, pivot_col, values) -> "GroupedData":

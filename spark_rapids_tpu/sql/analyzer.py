@@ -218,6 +218,29 @@ def _split_conjuncts(e: Optional[A.SqlExpr]) -> List[A.SqlExpr]:
     return [e]
 
 
+def _count_table_refs(node, name: str, skip=None) -> int:
+    """How many times ``name`` is referenced as a table anywhere in the
+    statement AST (relations, subqueries, sibling CTE bodies).  ``skip``
+    excludes the CTE's own definition.  Shadowing by an inner CTE of the
+    same name overcounts — harmless: it only wraps a single-use CTE in a
+    cache node."""
+    import dataclasses as _dc
+    cnt = 0
+    stack = [node]
+    while stack:
+        x = stack.pop()
+        if x is skip:
+            continue
+        if isinstance(x, A.TableRef) and x.name.lower() == name:
+            cnt += 1
+        if _dc.is_dataclass(x) and not isinstance(x, type):
+            for f in _dc.fields(x):
+                stack.append(getattr(x, f.name))
+        elif isinstance(x, (list, tuple)):
+            stack.extend(x)
+    return cnt
+
+
 def _has_subquery(e: A.SqlExpr) -> bool:
     if isinstance(e, (A.InSubquery, A.Exists, A.ScalarSubquery)):
         return True
@@ -315,8 +338,15 @@ class Analyzer:
         if key in cte_env:
             entry = cte_env[key]
             if entry["plan"] is None:
-                entry["plan"], _ = self._select(entry["ast"],
-                                                entry["env"], outer=None)
+                plan, _ = self._select(entry["ast"], entry["env"],
+                                       outer=None)
+                if entry.get("multi"):
+                    # referenced more than once: materialize once and
+                    # share (the q4/q11 year_total CTE would otherwise
+                    # execute per reference)
+                    from spark_rapids_tpu.exec.basic import CpuCteCacheExec
+                    plan = CpuCteCacheExec(plan)
+                entry["plan"] = plan
             return entry["plan"]
         df = self.session.catalog_lookup(name)
         if df is None:
@@ -398,7 +428,9 @@ class Analyzer:
                                                  CpuProjectExec)
         env = dict(cte_env)
         for name, sub in q.ctes:
-            env[name.lower()] = {"ast": sub, "env": dict(env), "plan": None}
+            env[name.lower()] = {"ast": sub, "env": dict(env), "plan": None,
+                                 "multi": _count_table_refs(q, name.lower(),
+                                                            skip=sub) > 1}
 
         if not q.relations:
             plan = self._values_plan(q)
@@ -675,6 +707,16 @@ class Analyzer:
         for it in order_items:
             collect(it.expr)
 
+        def _has_grouping_call(e) -> bool:
+            if isinstance(e, A.FuncCall) and e.name == "grouping":
+                return True
+            return any(_has_grouping_call(c) for c in _ast_children(e))
+
+        need_gid = (any(_has_grouping_call(p) for p in projections) or
+                    (q.having is not None and
+                     _has_grouping_call(q.having)) or
+                    any(_has_grouping_call(it.expr) for it in order_items))
+
         key_bound = [self._expr_sq(g, plan, scope, env)
                      for g in group_exprs]
         agg_exprs = []
@@ -701,6 +743,10 @@ class Analyzer:
                              grouping_sets=[tuple(sorted(
                                  name_to_idx[x] for x in s)) for s in sets],
                              key_names=key_names)
+            gd._keep_gid = need_gid
+        elif need_gid:
+            raise AnalysisError(
+                "grouping() requires ROLLUP/CUBE/GROUPING SETS")
         agg_df = gd.agg(*agg_exprs)
         aplan = agg_df._plan
 
@@ -718,15 +764,47 @@ class Analyzer:
                 idx = len(key_bound) + ai
                 f = agg_schema.fields[idx]
                 return BoundReference(idx, f.data_type, f.nullable)
-            if isinstance(e, A.FuncCall) and e.name == "grouping":
-                raise AnalysisError("grouping() not supported yet")
+            g = _grouping_bit(e)
+            if g is not None:
+                return g
             return self._expr_generic(e, rewrite_leaf, None)
+
+        def _grouping_bit(e) -> Optional[Expression]:
+            """grouping(col) = bit of __grouping_id (appended last by
+            _agg_grouping_sets when _keep_gid): 1 when col is aggregated
+            away in this grouping set (Spark semantics)."""
+            if not (isinstance(e, A.FuncCall) and e.name == "grouping"):
+                return None
+            arg = e.args[0]
+            ki = next((i for i, g in enumerate(group_exprs)
+                       if arg == g), None)
+            if ki is None:
+                raise AnalysisError(
+                    f"grouping() argument {arg} is not a grouping column")
+            gid_idx = len(agg_schema.fields) - 1
+            gidref = BoundReference(gid_idx, T.LONG, False)
+            bit = len(group_exprs) - 1 - ki
+            return AR.Remainder(
+                AR.IntegralDivide(gidref, Literal(1 << bit, T.LONG)),
+                Literal(2, T.LONG))
 
         def rewrite_leaf(e: A.SqlExpr) -> Optional[Expression]:
             for ki, g in enumerate(group_exprs):
                 if e == g:
                     f = agg_schema.fields[ki]
                     return BoundReference(ki, f.data_type, f.nullable)
+            gb = _grouping_bit(e)
+            if gb is not None:
+                return gb
+            if isinstance(e, A.ScalarSubquery):
+                # uncorrelated scalar in HAVING / post-agg projections
+                # (q23/q24/q44): evaluate eagerly, inline as literal
+                from spark_rapids_tpu.session import DataFrame
+                p_, _ = self._select(e.query, env, outer=None)
+                rows = DataFrame(p_, self.session).collect()
+                if not rows:
+                    return lit(None)
+                return lit(rows[0][list(rows[0].keys())[0]])
             if _is_agg_call(e):
                 ai = agg_calls.index(e)
                 idx = len(key_bound) + ai
@@ -780,6 +858,18 @@ class Analyzer:
             hidden.append(Alias(bound, hname))
             new_order.append(A.SortItem(A.ColumnRef(hname), it.ascending,
                                         it.nulls_first))
+
+        # windows over aggregate output (q36's rank() over grouped sums):
+        # extract WindowExpressions, insert the window exec over the agg
+        # plan, and rebind the projections to its appended columns
+        from spark_rapids_tpu.expressions.window_exprs import \
+            WindowExpression as _WExpr
+        if any(e.collect(lambda x: isinstance(x, _WExpr))
+               for e in out_exprs + hidden):
+            wdf = DataFrame(plan, self.session)
+            plan, rebound = wdf._plan_windows(out_exprs + hidden)
+            out_exprs = rebound[:len(out_exprs)]
+            hidden = rebound[len(out_exprs):]
 
         proj = out_exprs + hidden
         plan = CpuProjectExec(proj, plan)
@@ -899,10 +989,15 @@ class Analyzer:
                     in_outer = self._resolves(e, outer_scope)
                     sides.append((e, in_inner, in_outer))
                 (le, li, lo), (re_, ri, ro) = sides
-                if li and not lo and ro and not ri:
+                # the inner side may ALSO resolve in the outer scope (a
+                # bare column name shared by both relations, q41's
+                # i_manufact = i1.i_manufact): innermost scope wins per
+                # SQL scoping, so only the outer side must be strictly
+                # outer-only
+                if li and ro and not ri:
                     pairs.append((re_, le))
                     continue
-                if ri and not ro and lo and not li:
+                if ri and lo and not li:
                     pairs.append((le, re_))
                     continue
             inner.append(c)

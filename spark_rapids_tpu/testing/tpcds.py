@@ -39,6 +39,8 @@ _BASE = {
     "catalog_page": 120,
     "call_center": 6,
     "date_dim": 1_461,   # 4 years: 1998-2002
+    "warehouse": 5,
+    "inventory": 20_000,
 }
 
 _STATES = np.array(["TN", "GA", "AL", "SC", "NC", "KY", "VA", "FL", "MS",
@@ -70,7 +72,7 @@ _ZIP_POOL = np.array([
 #: bump when generate_tables changes shape/semantics — recorded in the
 #: parquet cache's _DONE marker; mismatches (incl. explicit data_dir)
 #: force regeneration
-_DATAGEN_VERSION = 2
+_DATAGEN_VERSION = 3
 
 
 def _money(rng, n, lo=0.5, hi=300.0):
@@ -83,7 +85,7 @@ def generate_tables(sf: float = 0.01, seed: int = 20) -> Dict[str, dict]:
     n = {t: max(4, int(b * sf)) if t not in
          ("date_dim", "store", "reason", "web_site", "promotion",
           "catalog_page", "customer_demographics",
-          "household_demographics") else b
+          "household_demographics", "warehouse") else b
          for t, b in _BASE.items()}
     t: Dict[str, dict] = {}
 
@@ -127,6 +129,26 @@ def generate_tables(sf: float = 0.01, seed: int = 20) -> Dict[str, dict]:
         # substr(s_zip,1,2) = substr(ca_zip,1,2) prefix join has matches
         "s_zip": rng.choice(_ZIP_POOL, ns).astype(object),
         "s_gmt_offset": np.full(ns, -5.0),
+        "s_market_id": (np.arange(ns) % 10 + 1).astype(np.int32),
+        "s_county": rng.choice(_COUNTIES[:5], ns).astype(object),
+        "s_city": rng.choice(np.array(["Midway", "Fairview", "Oak Grove",
+                                       "Glendale", "Centerville"]),
+                             ns).astype(object),
+        "s_number_employees": rng.integers(200, 301, ns).astype(np.int32),
+    }
+    nwh = n["warehouse"]
+    t["warehouse"] = {
+        "w_warehouse_sk": np.arange(1, nwh + 1, dtype=np.int64),
+        "w_warehouse_name": np.array(
+            [f"Warehouse number {i}" for i in range(1, nwh + 1)],
+            dtype=object),
+        "w_warehouse_sq_ft": rng.integers(50_000, 1_000_001, nwh).astype(
+            np.int32),
+        "w_city": rng.choice(np.array(["Midway", "Fairview", "Oak Grove"]),
+                             nwh).astype(object),
+        "w_county": rng.choice(_COUNTIES[:5], nwh).astype(object),
+        "w_state": rng.choice(_STATES[:4], nwh).astype(object),
+        "w_country": np.full(nwh, "United States", dtype=object),
     }
     nw = n["web_site"]
     t["web_site"] = {
@@ -206,6 +228,10 @@ def generate_tables(sf: float = 0.01, seed: int = 20) -> Dict[str, dict]:
         "ca_county": rng.choice(_COUNTIES, nca).astype(object),
         "ca_country": np.full(nca, "United States", dtype=object),
         "ca_gmt_offset": rng.choice(np.array([-5.0, -6.0, -7.0]), nca),
+        "ca_city": rng.choice(np.array(["Midway", "Fairview", "Oak Grove",
+                                        "Glendale", "Centerville",
+                                        "Pleasant Hill", "Springdale"]),
+                              nca).astype(object),
     }
     nc = n["customer"]
     t["customer"] = {
@@ -227,6 +253,10 @@ def generate_tables(sf: float = 0.01, seed: int = 20) -> Dict[str, dict]:
         "c_birth_day": rng.integers(1, 29, nc).astype(np.int32),
         "c_email_address": np.array([f"c{i}@example.com"
                                      for i in range(nc)], dtype=object),
+        "c_salutation": rng.choice(np.array(["Mr.", "Mrs.", "Ms.", "Dr.",
+                                             "Sir"]), nc).astype(object),
+        "c_login": np.array([f"login{i}" for i in range(nc)], dtype=object),
+        "c_last_review_date_sk": rng.choice(dsk, nc),
     }
 
     # ---- item --------------------------------------------------------------
@@ -254,6 +284,31 @@ def generate_tables(sf: float = 0.01, seed: int = 20) -> Dict[str, dict]:
                                dtype=object),
         "i_category_id": rng.integers(1, 11, ni),
         "i_manager_id": rng.integers(1, 100, ni),
+        # q24/q41 name specific colors; cycle a pool that includes them
+        "i_color": np.array(["pale", "chiffon", "orchid", "powder", "peach",
+                             "saddle", "sienna", "spring", "snow", "metallic",
+                             "smoke", "almond", "khaki", "dim", "frosted",
+                             "forest", "lime", "ghost", "navajo", "slate"])[
+            np.arange(ni) % 20].astype(object),
+        "i_units": np.array(["Ounce", "Oz", "Bunch", "Ton", "N/A", "Dozen",
+                             "Box", "Pound", "Pallet", "Gross", "Cup",
+                             "Dram", "Each", "Tbl", "Lb", "Bundle"])[
+            np.arange(ni) % 16].astype(object),
+        "i_size": np.array(["petite", "small", "medium", "large",
+                            "extra large", "economy", "N/A"])[
+            np.arange(ni) % 7].astype(object),
+        "i_product_name": np.array([f"product{i}" for i in range(ni)],
+                                   dtype=object),
+        "i_wholesale_cost": _money(rng, ni, 0.5, 80.0),
+    }
+    nin = n["inventory"]
+    # weekly snapshots: every 7th date, items cycling, warehouses cycling
+    inv_dates = dsk[::7]
+    t["inventory"] = {
+        "inv_date_sk": inv_dates[np.arange(nin) % len(inv_dates)],
+        "inv_item_sk": (np.arange(nin) % ni + 1).astype(np.int64),
+        "inv_warehouse_sk": (np.arange(nin) % nwh + 1).astype(np.int64),
+        "inv_quantity_on_hand": rng.integers(0, 1001, nin).astype(np.int32),
     }
 
     # ---- facts -------------------------------------------------------------
@@ -318,19 +373,24 @@ def generate_tables(sf: float = 0.01, seed: int = 20) -> Dict[str, dict]:
         # ~3 lines per order, several warehouses: q16's "ships from >1
         # warehouse" EXISTS needs same-order rows with differing sk
         "cs_order_number": (np.arange(ncs) // 3 + 1).astype(np.int64),
-        "cs_warehouse_sk": rng.integers(1, 6, ncs),
+        "cs_warehouse_sk": rng.integers(1, nwh + 1, ncs),
         "cs_ship_date_sk": rng.choice(dsk, ncs),
         "cs_ship_addr_sk": rng.integers(1, nca + 1, ncs),
+        "cs_promo_sk": rng.integers(1, npm + 1, ncs),
     })
     ncr = n["catalog_returns"]
+    # returns reference REAL catalog sale lines (order + item copied from a
+    # sampled row) so the q40-style cs->cr outer join is non-vacuous
+    cr_src = rng.integers(0, ncs, ncr)
     t["catalog_returns"] = {
         "cr_returned_date_sk": rng.choice(dsk, ncr),
         "cr_catalog_page_sk": rng.integers(1, ncp + 1, ncr),
-        # a subset of real order numbers: q16's NOT EXISTS prunes them
-        "cr_order_number": rng.choice(
-            t["catalog_sales"]["cs_order_number"], ncr),
+        "cr_order_number": t["catalog_sales"]["cs_order_number"][cr_src],
+        "cr_item_sk": t["catalog_sales"]["cs_item_sk"][cr_src],
         "cr_return_amount": _money(rng, ncr, 1, 5_000),
+        "cr_refunded_cash": _money(rng, ncr, 1, 3_000),
         "cr_net_loss": _money(rng, ncr, 1, 2_000),
+        "cr_returning_customer_sk": rng.integers(1, nc + 1, ncr),
     }
     nws = n["web_sales"]
     t["web_sales"] = fact("ws", nws, "ws_bill_customer_sk", {
@@ -343,6 +403,10 @@ def generate_tables(sf: float = 0.01, seed: int = 20) -> Dict[str, dict]:
         "wr_web_page_sk": rng.integers(1, 61, nwr),
         "wr_return_amt": _money(rng, nwr, 1, 5_000),
         "wr_net_loss": _money(rng, nwr, 1, 2_000),
+        "wr_returning_customer_sk": rng.integers(1, nc + 1, nwr),
+        "wr_returning_addr_sk": rng.integers(1, nca + 1, nwr),
+        "wr_item_sk": rng.integers(1, ni + 1, nwr),
+        "wr_order_number": (np.arange(nwr) + 1).astype(np.int64),
     }
     return t
 

@@ -606,3 +606,675 @@ where d_date between cast('1999-02-01' as date)
 order by order_count
 limit 100
 """
+
+QUERIES["q21"] = """
+select * from
+ (select w_warehouse_name, i_item_id,
+         sum(case when d_date < cast('2000-03-11' as date)
+                  then inv_quantity_on_hand else 0 end) as inv_before,
+         sum(case when d_date >= cast('2000-03-11' as date)
+                  then inv_quantity_on_hand else 0 end) as inv_after
+  from inventory, warehouse, item, date_dim
+  where i_current_price between 0.99 and 1.49
+    and i_item_sk = inv_item_sk
+    and inv_warehouse_sk = w_warehouse_sk
+    and inv_date_sk = d_date_sk
+    and d_date between cast('2000-02-10' as date)
+                   and cast('2000-04-10' as date)
+  group by w_warehouse_name, i_item_id) x
+where (case when inv_before > 0 then inv_after / inv_before
+            else null end) >= 2.0 / 3.0
+  and (case when inv_before > 0 then inv_after / inv_before
+            else null end) <= 3.0 / 2.0
+order by w_warehouse_name, i_item_id
+limit 100
+"""
+
+QUERIES["q22"] = """
+select i_product_name, i_brand, i_class, i_category,
+       avg(inv_quantity_on_hand) qoh
+from inventory, date_dim, item
+where inv_date_sk = d_date_sk
+  and inv_item_sk = i_item_sk
+  and d_month_seq between 1200 and 1200 + 11
+group by rollup(i_product_name, i_brand, i_class, i_category)
+order by qoh, i_product_name, i_brand, i_class, i_category
+limit 100
+"""
+
+QUERIES["q23"] = """
+with frequent_ss_items as
+ (select substr(i_item_desc, 1, 30) itemdesc, i_item_sk item_sk,
+         d_date solddate, count(*) cnt
+  from store_sales, date_dim, item
+  where ss_sold_date_sk = d_date_sk
+    and ss_item_sk = i_item_sk
+    and d_year in (2000, 2001, 2002, 2003)
+  group by substr(i_item_desc, 1, 30), i_item_sk, d_date
+  having count(*) > 2),
+ max_store_sales as
+ (select max(csales) tpcds_cmax
+  from (select c_customer_sk, sum(ss_quantity * ss_sales_price) csales
+        from store_sales, customer, date_dim
+        where ss_customer_sk = c_customer_sk
+          and ss_sold_date_sk = d_date_sk
+          and d_year in (2000, 2001, 2002, 2003)
+        group by c_customer_sk) t),
+ best_ss_customer as
+ (select c_customer_sk, sum(ss_quantity * ss_sales_price) ssales
+  from store_sales, customer
+  where ss_customer_sk = c_customer_sk
+  group by c_customer_sk
+  having sum(ss_quantity * ss_sales_price) >
+         0.5 * (select tpcds_cmax from max_store_sales m))
+select sum(sales)
+from (select cs_quantity * cs_list_price sales
+      from catalog_sales, date_dim
+      where d_year = 2000 and d_moy = 2
+        and cs_sold_date_sk = d_date_sk
+        and cs_item_sk in (select item_sk from frequent_ss_items f1)
+        and cs_bill_customer_sk in
+            (select c_customer_sk from best_ss_customer b1)
+      union all
+      select ws_quantity * ws_list_price sales
+      from web_sales, date_dim
+      where d_year = 2000 and d_moy = 2
+        and ws_sold_date_sk = d_date_sk
+        and ws_item_sk in (select item_sk from frequent_ss_items f2)
+        and ws_bill_customer_sk in
+            (select c_customer_sk from best_ss_customer b2)) u
+limit 100
+"""
+
+QUERIES["q24"] = """
+with ssales as
+ (select c_last_name, c_first_name, s_store_name, ca_state, s_state,
+         i_color, i_current_price, i_manager_id, i_units, i_size,
+         sum(ss_net_paid) netpaid
+  from store_sales, store_returns, store, item, customer, customer_address
+  where ss_ticket_number = sr_ticket_number
+    and ss_item_sk = sr_item_sk
+    and ss_customer_sk = c_customer_sk
+    and ss_item_sk = i_item_sk
+    and ss_store_sk = s_store_sk
+    and c_current_addr_sk = ca_address_sk
+    and c_birth_country <> upper(ca_country)
+    and s_zip = ca_zip
+    and s_market_id = 8
+  group by c_last_name, c_first_name, s_store_name, ca_state, s_state,
+           i_color, i_current_price, i_manager_id, i_units, i_size)
+select c_last_name, c_first_name, s_store_name, sum(netpaid) paid
+from ssales
+where i_color = 'pale'
+group by c_last_name, c_first_name, s_store_name
+having sum(netpaid) > (select 0.05 * avg(netpaid) from ssales s2)
+order by c_last_name, c_first_name, s_store_name
+"""
+
+QUERIES["q25"] = """
+select i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_net_profit) as store_sales_profit,
+       sum(sr_net_loss) as store_returns_loss,
+       sum(cs_net_profit) as catalog_sales_profit
+from store_sales, store_returns, catalog_sales, date_dim d1, date_dim d2,
+     date_dim d3, store, item
+where d1.d_moy = 4 and d1.d_year = 2001
+  and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk
+  and ss_customer_sk = sr_customer_sk
+  and ss_item_sk = sr_item_sk
+  and ss_ticket_number = sr_ticket_number
+  and sr_returned_date_sk = d2.d_date_sk
+  and d2.d_moy between 4 and 10 and d2.d_year = 2001
+  and sr_customer_sk = cs_bill_customer_sk
+  and sr_item_sk = cs_item_sk
+  and cs_sold_date_sk = d3.d_date_sk
+  and d3.d_moy between 4 and 10 and d3.d_year = 2001
+group by i_item_id, i_item_desc, s_store_id, s_store_name
+order by i_item_id, i_item_desc, s_store_id, s_store_name
+limit 100
+"""
+
+QUERIES["q26"] = """
+select i_item_id,
+       avg(cs_quantity) agg1, avg(cs_list_price) agg2,
+       avg(cs_coupon_amt) agg3, avg(cs_sales_price) agg4
+from catalog_sales, customer_demographics, date_dim, item, promotion
+where cs_sold_date_sk = d_date_sk
+  and cs_item_sk = i_item_sk
+  and cs_bill_cdemo_sk = cd_demo_sk
+  and cs_promo_sk = p_promo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and (p_channel_email = 'N' or p_channel_event = 'N')
+  and d_year = 2000
+group by i_item_id
+order by i_item_id
+limit 100
+"""
+
+QUERIES["q27"] = """
+select i_item_id, s_state, grouping(s_state) g_state,
+       avg(ss_quantity) agg1, avg(ss_list_price) agg2,
+       avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
+from store_sales, customer_demographics, date_dim, store, item
+where ss_sold_date_sk = d_date_sk
+  and ss_item_sk = i_item_sk
+  and ss_store_sk = s_store_sk
+  and ss_cdemo_sk = cd_demo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and d_year = 2002
+  and s_state = 'TN'
+group by rollup(i_item_id, s_state)
+order by i_item_id, s_state
+limit 100
+"""
+
+QUERIES["q28"] = """
+select * from
+ (select avg(ss_list_price) b1_lp, count(ss_list_price) b1_cnt,
+         count(distinct ss_list_price) b1_cntd
+  from store_sales
+  where ss_quantity between 0 and 5
+    and (ss_list_price between 8 and 8 + 10
+         or ss_coupon_amt between 459 and 459 + 1000
+         or ss_wholesale_cost between 57 and 57 + 20)) b1,
+ (select avg(ss_list_price) b2_lp, count(ss_list_price) b2_cnt,
+         count(distinct ss_list_price) b2_cntd
+  from store_sales
+  where ss_quantity between 6 and 10
+    and (ss_list_price between 90 and 90 + 10
+         or ss_coupon_amt between 2323 and 2323 + 1000
+         or ss_wholesale_cost between 31 and 31 + 20)) b2,
+ (select avg(ss_list_price) b3_lp, count(ss_list_price) b3_cnt,
+         count(distinct ss_list_price) b3_cntd
+  from store_sales
+  where ss_quantity between 11 and 15
+    and (ss_list_price between 142 and 142 + 10
+         or ss_coupon_amt between 12214 and 12214 + 1000
+         or ss_wholesale_cost between 79 and 79 + 20)) b3,
+ (select avg(ss_list_price) b4_lp, count(ss_list_price) b4_cnt,
+         count(distinct ss_list_price) b4_cntd
+  from store_sales
+  where ss_quantity between 16 and 20
+    and (ss_list_price between 135 and 135 + 10
+         or ss_coupon_amt between 6071 and 6071 + 1000
+         or ss_wholesale_cost between 38 and 38 + 20)) b4,
+ (select avg(ss_list_price) b5_lp, count(ss_list_price) b5_cnt,
+         count(distinct ss_list_price) b5_cntd
+  from store_sales
+  where ss_quantity between 21 and 25
+    and (ss_list_price between 122 and 122 + 10
+         or ss_coupon_amt between 836 and 836 + 1000
+         or ss_wholesale_cost between 17 and 17 + 20)) b5,
+ (select avg(ss_list_price) b6_lp, count(ss_list_price) b6_cnt,
+         count(distinct ss_list_price) b6_cntd
+  from store_sales
+  where ss_quantity between 26 and 30
+    and (ss_list_price between 154 and 154 + 10
+         or ss_coupon_amt between 7326 and 7326 + 1000
+         or ss_wholesale_cost between 25 and 25 + 20)) b6
+limit 100
+"""
+
+QUERIES["q29"] = """
+select i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_quantity) as store_sales_quantity,
+       sum(sr_return_quantity) as store_returns_quantity,
+       sum(cs_quantity) as catalog_sales_quantity
+from store_sales, store_returns, catalog_sales, date_dim d1, date_dim d2,
+     date_dim d3, store, item
+where d1.d_moy = 9 and d1.d_year = 1999
+  and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk
+  and ss_customer_sk = sr_customer_sk
+  and ss_item_sk = sr_item_sk
+  and ss_ticket_number = sr_ticket_number
+  and sr_returned_date_sk = d2.d_date_sk
+  and d2.d_moy between 9 and 9 + 3 and d2.d_year = 1999
+  and sr_customer_sk = cs_bill_customer_sk
+  and sr_item_sk = cs_item_sk
+  and cs_sold_date_sk = d3.d_date_sk
+  and d3.d_year in (1999, 2000, 2001)
+group by i_item_id, i_item_desc, s_store_id, s_store_name
+order by i_item_id, i_item_desc, s_store_id, s_store_name
+limit 100
+"""
+
+QUERIES["q30"] = """
+with customer_total_return as
+ (select wr_returning_customer_sk as ctr_customer_sk,
+         ca_state as ctr_state,
+         sum(wr_return_amt) as ctr_total_return
+  from web_returns, date_dim, customer_address
+  where wr_returned_date_sk = d_date_sk and d_year = 2002
+    and wr_returning_addr_sk = ca_address_sk
+  group by wr_returning_customer_sk, ca_state)
+select c_customer_id, c_salutation, c_first_name, c_last_name,
+       c_preferred_cust_flag, c_birth_day, c_birth_month, c_birth_year,
+       c_birth_country, c_login, c_email_address, ctr_total_return
+from customer_total_return ctr1, customer_address, customer
+where ctr1.ctr_total_return > (select avg(ctr_total_return) * 1.2
+                               from customer_total_return ctr2
+                               where ctr1.ctr_state = ctr2.ctr_state)
+  and ca_address_sk = c_current_addr_sk
+  and ca_state = 'GA'
+  and ctr1.ctr_customer_sk = c_customer_sk
+order by c_customer_id, c_salutation, c_first_name, c_last_name,
+         c_preferred_cust_flag, c_birth_day, c_birth_month, c_birth_year,
+         c_birth_country, c_login, c_email_address, ctr_total_return
+limit 100
+"""
+
+QUERIES["q31"] = """
+with ss as
+ (select ca_county, d_qoy, d_year, sum(ss_ext_sales_price) as store_sales
+  from store_sales, date_dim, customer_address
+  where ss_sold_date_sk = d_date_sk and ss_addr_sk = ca_address_sk
+  group by ca_county, d_qoy, d_year),
+ ws as
+ (select ca_county, d_qoy, d_year, sum(ws_ext_sales_price) as web_sales
+  from web_sales, date_dim, customer_address
+  where ws_sold_date_sk = d_date_sk and ws_bill_customer_sk in
+        (select c_customer_sk from customer
+         where c_current_addr_sk = ca_address_sk)
+  group by ca_county, d_qoy, d_year)
+select ss1.ca_county,
+       ss1.d_year,
+       ws2.web_sales / ws1.web_sales web_q1_q2_increase,
+       ss2.store_sales / ss1.store_sales store_q1_q2_increase,
+       ws3.web_sales / ws2.web_sales web_q2_q3_increase,
+       ss3.store_sales / ss2.store_sales store_q2_q3_increase
+from ss ss1, ss ss2, ss ss3, ws ws1, ws ws2, ws ws3
+where ss1.d_qoy = 1 and ss1.d_year = 2000
+  and ss1.ca_county = ss2.ca_county
+  and ss2.d_qoy = 2 and ss2.d_year = 2000
+  and ss2.ca_county = ss3.ca_county
+  and ss3.d_qoy = 3 and ss3.d_year = 2000
+  and ss1.ca_county = ws1.ca_county
+  and ws1.d_qoy = 1 and ws1.d_year = 2000
+  and ws1.ca_county = ws2.ca_county
+  and ws2.d_qoy = 2 and ws2.d_year = 2000
+  and ws1.ca_county = ws3.ca_county
+  and ws3.d_qoy = 3 and ws3.d_year = 2000
+  and case when ws1.web_sales > 0 then ws2.web_sales / ws1.web_sales
+           else null end >
+      case when ss1.store_sales > 0 then ss2.store_sales / ss1.store_sales
+           else null end
+  and case when ws2.web_sales > 0 then ws3.web_sales / ws2.web_sales
+           else null end >
+      case when ss2.store_sales > 0 then ss3.store_sales / ss2.store_sales
+           else null end
+order by ss1.ca_county
+"""
+
+QUERIES["q32"] = """
+select sum(cs_ext_discount_amt) as excess_discount_amount
+from catalog_sales cs0, item, date_dim
+where i_manufact_id = 77
+  and i_item_sk = cs0.cs_item_sk
+  and d_date between cast('2000-01-27' as date)
+                 and (cast('2000-01-27' as date) + interval 90 day)
+  and d_date_sk = cs0.cs_sold_date_sk
+  and cs0.cs_ext_discount_amt >
+      (select 1.3 * avg(cs_ext_discount_amt)
+       from catalog_sales cs2, date_dim d2
+       where cs2.cs_item_sk = cs0.cs_item_sk
+         and d2.d_date between cast('2000-01-27' as date)
+                          and (cast('2000-01-27' as date) + interval 90 day)
+         and d2.d_date_sk = cs2.cs_sold_date_sk)
+limit 100
+"""
+
+QUERIES["q33"] = """
+with ss as
+ (select i_manufact_id, sum(ss_ext_sales_price) total_sales
+  from store_sales, date_dim, customer_address, item
+  where i_manufact_id in (select i_manufact_id from item
+                          where i_category in ('Electronics'))
+    and ss_item_sk = i_item_sk
+    and ss_sold_date_sk = d_date_sk
+    and d_year = 1998 and d_moy = 5
+    and ss_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_manufact_id),
+ cs as
+ (select i_manufact_id, sum(cs_ext_sales_price) total_sales
+  from catalog_sales, date_dim, customer_address, item
+  where i_manufact_id in (select i_manufact_id from item
+                          where i_category in ('Electronics'))
+    and cs_item_sk = i_item_sk
+    and cs_sold_date_sk = d_date_sk
+    and d_year = 1998 and d_moy = 5
+    and cs_ship_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_manufact_id),
+ ws as
+ (select i_manufact_id, sum(ws_ext_sales_price) total_sales
+  from web_sales, date_dim, customer_address, item
+  where i_manufact_id in (select i_manufact_id from item
+                          where i_category in ('Electronics'))
+    and ws_item_sk = i_item_sk
+    and ws_sold_date_sk = d_date_sk
+    and d_year = 1998 and d_moy = 5
+    and ws_bill_customer_sk in
+        (select c_customer_sk from customer
+         where c_current_addr_sk = ca_address_sk)
+    and ca_gmt_offset = -5
+  group by i_manufact_id)
+select i_manufact_id, sum(total_sales) total_sales
+from (select * from ss
+      union all
+      select * from cs
+      union all
+      select * from ws) tmp1
+group by i_manufact_id
+order by total_sales, i_manufact_id
+limit 100
+"""
+
+QUERIES["q34"] = """
+select c_last_name, c_first_name, c_salutation, c_preferred_cust_flag,
+       ss_ticket_number, cnt
+from (select ss_ticket_number, ss_customer_sk, count(*) cnt
+      from store_sales, date_dim, store, household_demographics
+      where ss_sold_date_sk = d_date_sk
+        and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk
+        and (d_dom between 1 and 3 or d_dom between 25 and 28)
+        and (hd_buy_potential = '>10000' or hd_buy_potential = 'Unknown')
+        and hd_vehicle_count > 0
+        and (case when hd_vehicle_count > 0
+                  then hd_dep_count / hd_vehicle_count else null end) > 1.2
+        and d_year in (1999, 2000, 2001)
+        and s_county in ('Rush County', 'Toole County', 'Jefferson County',
+                         'Dona Ana County', 'La Porte County')
+      group by ss_ticket_number, ss_customer_sk) dn, customer
+where ss_customer_sk = c_customer_sk
+  and cnt between 15 and 20
+order by c_last_name, c_first_name, c_salutation,
+         c_preferred_cust_flag desc, ss_ticket_number
+"""
+
+QUERIES["q35"] = """
+select ca_state, cd_gender, cd_marital_status, cd_dep_count,
+       count(*) cnt1, min(cd_dep_count) mn1, max(cd_dep_count) mx1,
+       avg(cd_dep_count) av1,
+       cd_dep_employed_count,
+       count(*) cnt2, min(cd_dep_employed_count) mn2,
+       max(cd_dep_employed_count) mx2, avg(cd_dep_employed_count) av2,
+       cd_dep_college_count,
+       count(*) cnt3, min(cd_dep_college_count) mn3,
+       max(cd_dep_college_count) mx3, avg(cd_dep_college_count) av3
+from customer c, customer_address ca, customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+  and cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select * from store_sales, date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk
+                and d_year = 2002 and d_qoy < 4)
+  and (exists (select * from web_sales, date_dim
+               where c.c_customer_sk = ws_bill_customer_sk
+                 and ws_sold_date_sk = d_date_sk
+                 and d_year = 2002 and d_qoy < 4)
+       or exists (select * from catalog_sales, date_dim
+                  where c.c_customer_sk = cs_ship_customer_sk
+                    and cs_sold_date_sk = d_date_sk
+                    and d_year = 2002 and d_qoy < 4))
+group by ca_state, cd_gender, cd_marital_status, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+order by ca_state, cd_gender, cd_marital_status, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+limit 100
+"""
+
+QUERIES["q36"] = """
+select sum(ss_net_profit) / sum(ss_ext_sales_price) as gross_margin,
+       i_category, i_class,
+       grouping(i_category) + grouping(i_class) as lochierarchy,
+       rank() over (partition by grouping(i_category) + grouping(i_class),
+                    case when grouping(i_class) = 0 then i_category end
+                    order by sum(ss_net_profit) / sum(ss_ext_sales_price)
+                    asc) as rank_within_parent
+from store_sales, date_dim d1, item, store
+where d1.d_year = 2001
+  and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk
+  and s_state = 'TN'
+group by rollup(i_category, i_class)
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category end,
+         rank_within_parent
+limit 100
+"""
+
+QUERIES["q37"] = """
+select i_item_id, i_item_desc, i_current_price
+from item, inventory, date_dim, catalog_sales
+where i_current_price between 68 and 68 + 30
+  and inv_item_sk = i_item_sk
+  and d_date_sk = inv_date_sk
+  and d_date between cast('2000-02-01' as date)
+                 and (cast('2000-02-01' as date) + interval 60 day)
+  and i_manufact_id in (3, 31, 70, 169)
+  and inv_quantity_on_hand between 100 and 500
+  and cs_item_sk = i_item_sk
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id
+limit 100
+"""
+
+QUERIES["q38"] = """
+select count(*) from (
+  select distinct c_last_name, c_first_name, d_date
+  from store_sales, date_dim, customer
+  where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+    and store_sales.ss_customer_sk = customer.c_customer_sk
+    and d_month_seq between 1200 and 1200 + 11
+  intersect
+  select distinct c_last_name, c_first_name, d_date
+  from catalog_sales, date_dim, customer
+  where catalog_sales.cs_sold_date_sk = date_dim.d_date_sk
+    and catalog_sales.cs_bill_customer_sk = customer.c_customer_sk
+    and d_month_seq between 1200 and 1200 + 11
+  intersect
+  select distinct c_last_name, c_first_name, d_date
+  from web_sales, date_dim, customer
+  where web_sales.ws_sold_date_sk = date_dim.d_date_sk
+    and web_sales.ws_bill_customer_sk = customer.c_customer_sk
+    and d_month_seq between 1200 and 1200 + 11
+) hot_cust
+limit 100
+"""
+
+QUERIES["q39"] = """
+with inv as
+ (select w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy, stdev, mean,
+         case mean when 0 then null else stdev / mean end cov
+  from (select w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy,
+               stddev_samp(inv_quantity_on_hand) stdev,
+               avg(inv_quantity_on_hand) mean
+        from inventory, item, warehouse, date_dim
+        where inv_item_sk = i_item_sk
+          and inv_warehouse_sk = w_warehouse_sk
+          and inv_date_sk = d_date_sk
+          and d_year = 2001
+        group by w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy) foo
+  where case mean when 0 then 0 else stdev / mean end > 1)
+select inv1.w_warehouse_sk, inv1.i_item_sk, inv1.d_moy, inv1.mean,
+       inv1.cov, inv2.w_warehouse_sk w2, inv2.i_item_sk i2, inv2.d_moy m2,
+       inv2.mean mean2, inv2.cov cov2
+from inv inv1, inv inv2
+where inv1.i_item_sk = inv2.i_item_sk
+  and inv1.w_warehouse_sk = inv2.w_warehouse_sk
+  and inv1.d_moy = 1
+  and inv2.d_moy = 1 + 1
+order by inv1.w_warehouse_sk, inv1.i_item_sk, inv1.d_moy, inv1.mean,
+         inv1.cov, inv2.d_moy, inv2.mean, inv2.cov
+"""
+
+QUERIES["q40"] = """
+select w_state, i_item_id,
+       sum(case when d_date < cast('2000-03-11' as date)
+                then cs_sales_price - coalesce(cr_refunded_cash, 0)
+                else 0 end) as sales_before,
+       sum(case when d_date >= cast('2000-03-11' as date)
+                then cs_sales_price - coalesce(cr_refunded_cash, 0)
+                else 0 end) as sales_after
+from catalog_sales
+     left outer join catalog_returns
+       on (cs_order_number = cr_order_number and cs_item_sk = cr_item_sk),
+     warehouse, item, date_dim
+where i_current_price between 0.99 and 1.49
+  and i_item_sk = cs_item_sk
+  and cs_warehouse_sk = w_warehouse_sk
+  and cs_sold_date_sk = d_date_sk
+  and d_date between cast('2000-02-10' as date)
+                 and cast('2000-04-10' as date)
+group by w_state, i_item_id
+order by w_state, i_item_id
+limit 100
+"""
+
+QUERIES["q41"] = """
+select distinct i_product_name
+from item i1
+where i_manufact_id between 100 and 100 + 40
+  and (select count(*) as item_cnt
+       from item
+       where i_manufact = i1.i_manufact
+         and ((i_category = 'Women'
+               and (i_color = 'powder' or i_color = 'khaki')
+               and (i_units = 'Ounce' or i_units = 'Oz')
+               and (i_size = 'medium' or i_size = 'extra large'))
+              or (i_category = 'Women'
+                  and (i_color = 'brown' or i_color = 'honeydew')
+                  and (i_units = 'Bunch' or i_units = 'Ton')
+                  and (i_size = 'N/A' or i_size = 'small'))
+              or (i_category = 'Men'
+                  and (i_color = 'floral' or i_color = 'deep')
+                  and (i_units = 'N/A' or i_units = 'Dozen')
+                  and (i_size = 'petite' or i_size = 'large'))
+              or (i_category = 'Men'
+                  and (i_color = 'light' or i_color = 'cornflower')
+                  and (i_units = 'Box' or i_units = 'Pound')
+                  and (i_size = 'medium' or i_size = 'extra large'))
+              or (i_category = 'Women'
+                  and (i_color = 'midnight' or i_color = 'snow')
+                  and (i_units = 'Pallet' or i_units = 'Gross')
+                  and (i_size = 'medium' or i_size = 'extra large'))
+              or (i_category = 'Women'
+                  and (i_color = 'cyan' or i_color = 'papaya')
+                  and (i_units = 'Cup' or i_units = 'Dram')
+                  and (i_size = 'N/A' or i_size = 'small'))
+              or (i_category = 'Men'
+                  and (i_color = 'orange' or i_color = 'frosted')
+                  and (i_units = 'Each' or i_units = 'Tbl')
+                  and (i_size = 'petite' or i_size = 'large'))
+              or (i_category = 'Men'
+                  and (i_color = 'forest' or i_color = 'ghost')
+                  and (i_units = 'Lb' or i_units = 'Bundle')
+                  and (i_size = 'medium' or i_size = 'extra large')))
+       ) > 0
+order by i_product_name
+limit 100
+"""
+
+QUERIES["q42"] = """
+select d_year, i_category_id, i_category, sum(ss_ext_sales_price) s
+from date_dim dt, store_sales, item
+where dt.d_date_sk = store_sales.ss_sold_date_sk
+  and store_sales.ss_item_sk = item.i_item_sk
+  and item.i_manager_id = 1
+  and dt.d_moy = 11
+  and dt.d_year = 2000
+group by d_year, i_category_id, i_category
+order by s desc, d_year, i_category_id, i_category
+limit 100
+"""
+
+QUERIES["q43"] = """
+select s_store_name, s_store_id,
+       sum(case when (d_day_name = 'Sunday') then ss_sales_price
+                else null end) sun_sales,
+       sum(case when (d_day_name = 'Monday') then ss_sales_price
+                else null end) mon_sales,
+       sum(case when (d_day_name = 'Tuesday') then ss_sales_price
+                else null end) tue_sales,
+       sum(case when (d_day_name = 'Wednesday') then ss_sales_price
+                else null end) wed_sales,
+       sum(case when (d_day_name = 'Thursday') then ss_sales_price
+                else null end) thu_sales,
+       sum(case when (d_day_name = 'Friday') then ss_sales_price
+                else null end) fri_sales,
+       sum(case when (d_day_name = 'Saturday') then ss_sales_price
+                else null end) sat_sales
+from date_dim, store_sales, store
+where d_date_sk = ss_sold_date_sk
+  and s_store_sk = ss_store_sk
+  and s_gmt_offset = -5
+  and d_year = 2000
+group by s_store_name, s_store_id
+order by s_store_name, s_store_id, sun_sales, mon_sales, tue_sales,
+         wed_sales, thu_sales, fri_sales, sat_sales
+limit 100
+"""
+
+QUERIES["q44"] = """
+select asceding.rnk, i1.i_product_name best_performing,
+       i2.i_product_name worst_performing
+from (select * from (select item_sk, rank() over (order by rank_col asc) rnk
+                     from (select ss_item_sk item_sk,
+                                  avg(ss_net_profit) rank_col
+                           from store_sales ss1
+                           where ss_store_sk = 4
+                           group by ss_item_sk
+                           having avg(ss_net_profit) >
+                                  0.9 * (select avg(ss_net_profit) rank_col
+                                         from store_sales
+                                         where ss_store_sk = 4
+                                           and ss_hdemo_sk is null
+                                         group by ss_store_sk)) v1) v11
+      where rnk < 11) asceding,
+     (select * from (select item_sk,
+                            rank() over (order by rank_col desc) rnk
+                     from (select ss_item_sk item_sk,
+                                  avg(ss_net_profit) rank_col
+                           from store_sales ss1
+                           where ss_store_sk = 4
+                           group by ss_item_sk
+                           having avg(ss_net_profit) >
+                                  0.9 * (select avg(ss_net_profit) rank_col
+                                         from store_sales
+                                         where ss_store_sk = 4
+                                           and ss_hdemo_sk is null
+                                         group by ss_store_sk)) v2) v21
+      where rnk < 11) descending,
+     item i1, item i2
+where asceding.rnk = descending.rnk
+  and i1.i_item_sk = asceding.item_sk
+  and i2.i_item_sk = descending.item_sk
+order by asceding.rnk
+limit 100
+"""
+
+QUERIES["q45"] = """
+select ca_zip, ca_city, sum(ws_sales_price) s
+from web_sales, customer, customer_address, date_dim, item
+where ws_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and ws_item_sk = i_item_sk
+  and (substr(ca_zip, 1, 5) in ('85669', '86197', '88274', '83405',
+                                '86475', '85392', '85460', '80348', '81792')
+       or i_item_id in (select i_item_id from item
+                        where i_item_sk in (2, 3, 5, 7, 11, 13, 17, 19, 23)))
+  and ws_sold_date_sk = d_date_sk
+  and d_qoy = 2 and d_year = 2001
+group by ca_zip, ca_city
+order by ca_zip, ca_city
+limit 100
+"""

@@ -54,3 +54,18 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if os.path.basename(str(item.fspath)) in SMOKE_FILES:
             item.add_marker(pytest.mark.smoke)
+
+
+@pytest.fixture(autouse=True)
+def _bound_process_memory(request):
+    """The TPC-DS differential tier runs 44 queries x 2 engines in one
+    process; per-shape jitted programs and process-wide scan caches
+    accumulate to many GB and segfault the interpreter around test #40.
+    Dropping the jit caches between heavy tests keeps RSS bounded (CPU
+    recompiles are cheap; the correctness signal is unchanged)."""
+    yield
+    if os.path.basename(str(request.fspath)) in (
+            "test_tpcds.py", "test_harnesses.py"):
+        import gc
+        jax.clear_caches()
+        gc.collect()
