@@ -153,6 +153,15 @@ def rc_traceable(rc):
     return rc.traceable() if isinstance(rc, DeferredCount) else rc
 
 
+def known_empty(rc) -> bool:
+    """True only when a row count is empty WITHOUT forcing a deferred
+    count (forcing costs a host round trip per batch on a tunnel-attached
+    chip; callers treat "maybe non-empty" batches as live)."""
+    if isinstance(rc, DeferredCount):
+        return rc.is_forced and int(rc) == 0
+    return int(rc) == 0
+
+
 def force_counts(rcs) -> None:
     """Forces many deferred counts with ONE device sync (stacked fetch).
     Callers that need several batches' exact row counts (AQE partition

@@ -258,6 +258,84 @@ FORCE_RUNNING_WINDOW = conf_bool(
     "regardless of memory pressure.",
     False, ConfLevel.INTERNAL)
 
+FORCE_BOUNDED_WINDOW = conf_bool(
+    "spark.rapids.sql.test.window.forceBoundedBatched",
+    "Test hook: force the chunked bounded-frame window path (tail-carry "
+    "between batches) regardless of memory pressure.",
+    False, ConfLevel.INTERNAL)
+
+BOUNDED_WINDOW_MAX_SPAN = conf_int(
+    "spark.rapids.sql.window.batched.bounded.rowLimit",
+    "Largest preceding+following ROWS span the chunked bounded-window "
+    "path carries between batches; wider frames concatenate the whole "
+    "partition (reference: spark.rapids.sql.window.batched.bounded."
+    "row.max).",
+    4096, ConfLevel.COMMONLY_USED)
+
+JOIN_BUILD_SWAP_ENABLED = conf_bool(
+    "spark.rapids.sql.join.buildSideSwap.enabled",
+    "Runtime build-side choice for inner equi-joins: build on the "
+    "smaller side regardless of SQL order (reference: "
+    "GpuShuffledHashJoinExec build-side selection).",
+    True)
+
+JOIN_BUILD_SWAP_MAX_BYTES = conf_bytes(
+    "spark.rapids.sql.join.buildSideSwap.maxBuildBytes",
+    "Largest build side for which the swap comparison materializes the "
+    "probe partition; above it the probe streams unswapped.",
+    "256m")
+
+SPECULATIVE_SIZING_ENABLED = conf_bool(
+    "spark.rapids.sql.join.speculativeSizing.enabled",
+    "Size join pair tables optimistically by the probe bucket and check "
+    "overflow flags at the collect sync (replay exact on overflow) "
+    "instead of paying a device round trip per join.",
+    True)
+
+SHUFFLE_DEVICE_SHRINK_THRESHOLD = conf_bytes(
+    "spark.rapids.shuffle.deviceStore.shrinkThresholdBytes",
+    "Map batches whose reduce-fanout-multiplied padded footprint exceeds "
+    "this are padding-shrunk (costs one count sync) before the "
+    "per-partition compacts of the device-resident shuffle store.",
+    "64m")
+
+DOWNLOAD_SPECULATIVE_ROWS = conf_int(
+    "spark.rapids.sql.collect.speculativeRows",
+    "Row cap for single-round-trip result downloads while the row count "
+    "is still deferred; larger results pay one extra round trip.",
+    8192)
+
+CTE_REUSE_ENABLED = conf_bool(
+    "spark.rapids.sql.cteReuse.enabled",
+    "Materialize a CTE referenced more than once exactly once and share "
+    "the batches (Spark WithCTE/ReusedExchange analog).",
+    True)
+
+RANGE_BOUNDS_SAMPLE_ROWS = conf_int(
+    "spark.rapids.sql.rangePartitioning.sampleRowsPerBatch",
+    "Rows sampled per input batch (device-gathered, one download total) "
+    "when computing range-partition bounds.",
+    1024)
+
+COLLECT_AGG_ENABLED = conf_bool(
+    "spark.rapids.sql.agg.collectOnDevice.enabled",
+    "Device collect_list/collect_set/count-distinct sets via padded "
+    "[group, max_len] array planes (COMPLETE mode, fixed-width values); "
+    "disabled falls back to the host collect tier.",
+    True)
+
+LIMIT_DEFERRED_FORCE_INTERVAL = conf_int(
+    "spark.rapids.sql.limit.deferredForceInterval",
+    "Deferred-count limit budget is forced to host every N batches so a "
+    "satisfied limit stops pulling its child (amortized early exit).",
+    8)
+
+COLLECTIVE_EXCHANGE_ENABLED = conf_bool(
+    "spark.rapids.shuffle.collective.enabled",
+    "Mesh shuffles lower to ONE fused ICI all-to-all when the reduce "
+    "count matches the device count (multi-chip path).",
+    True)
+
 SCAN_CACHE_ENABLED = conf_bool(
     "spark.rapids.sql.scanCache.enabled",
     "Keep decoded (host) and uploaded (device) scan batches resident for "
@@ -476,7 +554,23 @@ class TpuConf:
     def get(self, key: str, default: Any = None) -> Any:
         if key in self._values:
             return self._values[key]
-        return self._extra.get(key, default)
+        if key in self._extra:
+            # the key's rule may have registered AFTER this conf snapshot
+            # was built (operator modules import lazily): convert through
+            # the now-known entry instead of returning the raw string —
+            # a literal "false" is truthy and would silently defeat
+            # boolean gates (ADVICE-class bug, r5 review)
+            raw = self._extra[key]
+            entry = _REGISTRY.get(key)
+            if entry is not None and isinstance(raw, str):
+                val = entry.converter(raw)
+                self._values[key] = val
+                return val
+            return raw
+        entry = _REGISTRY.get(key)
+        if entry is not None:
+            return entry.default
+        return default
 
     def with_overrides(self, **kv) -> "TpuConf":
         merged = {**self._values, **self._extra}

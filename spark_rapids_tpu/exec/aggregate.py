@@ -496,12 +496,85 @@ class CpuHashAggregateExec(UnaryExec):
 class TpuHashAggregateExec(CpuHashAggregateExec):
     is_device = True
 
+    def _has_collect(self) -> bool:
+        return any(spec.update_kind in ("list", "distinct")
+                   for _ai, spec in self.layout.flat)
+
+    def _complete_collect(self, pidx):
+        """COMPLETE-mode device path for variable-length buffers
+        (collect_list/collect_set/count-distinct sets): one concat of the
+        partition, scalar slots through segmented_aggregate, collect
+        slots through segmented_collect — both sort by the same key
+        words, so group order is identical and the buffer columns zip
+        (reference: the cuDF collect-backed ObjectHashAggregate path,
+        aggregateFunctions.scala)."""
+        from spark_rapids_tpu.columnar.column import known_empty
+        from spark_rapids_tpu.expressions.evaluator import eval_exprs_tpu
+        from spark_rapids_tpu.ops.agg_ops import (segmented_aggregate,
+                                                  segmented_collect)
+        from spark_rapids_tpu.ops.batch_ops import concat_batches
+        lay = self.layout
+        batches = [b for b in self.child.execute_partition(pidx)
+                   if not known_empty(b.row_count)]
+        if not batches:
+            if lay.num_keys == 0 and self.child.num_partitions == 1:
+                yield self._empty_reduction().to_device()
+            return
+        big = concat_batches(batches)
+        exprs = []
+        for i, e in enumerate(lay.update_input_exprs()):
+            nm = lay.key_name(i) if i < lay.num_keys else \
+                f"v{i - lay.num_keys}"
+            exprs.append(Alias(e, nm))
+        proj = eval_exprs_tpu(exprs, big)
+        nk = lay.num_keys
+        scalar = [(j, spec) for j, (_ai, spec) in enumerate(lay.flat)
+                  if spec.update_kind not in ("list", "distinct")]
+        collect = [(j, spec) for j, (_ai, spec) in enumerate(lay.flat)
+                   if spec.update_kind in ("list", "distinct")]
+        buf_cols = {}
+        keys_cols = None
+        n = None
+        if scalar:
+            sspecs = [(nk + j, spec.update_kind, spec.count_valid_only,
+                       spec.dtype) for j, spec in scalar]
+            sres = segmented_aggregate(proj, nk, sspecs)
+            keys_cols = list(sres.columns[:nk])
+            n = sres.row_count
+            for (j, _), c in zip(scalar, sres.columns[nk:]):
+                buf_cols[j] = c
+        for j, spec in collect:
+            cres = segmented_collect(proj, nk, nk + j,
+                                     spec.update_kind == "distinct")
+            if keys_cols is None:
+                keys_cols = list(cres.columns[:nk])
+                n = cres.row_count
+            buf_cols[j] = cres.columns[nk]
+        # the scalar and collect passes each produced their own deferred
+        # group count (same value: same sort, same keys); a batch requires
+        # ONE shared count object, so rewrap every column with it
+        from spark_rapids_tpu.columnar.column import DeviceColumn
+        cols = [DeviceColumn(c.data, c.validity, n, c.data_type,
+                             c.lengths, c.elem_valid)
+                for c in keys_cols +
+                [buf_cols[j] for j in range(len(lay.flat))]]
+        merged = ColumnarBatch(cols, n)
+        merged.names = [lay.key_name(i) for i in range(nk)] + \
+            [lay.buffer_name(j) for j in range(len(lay.flat))]
+        if nk == 0 and int(merged.row_count) == 0:
+            yield self._empty_reduction().to_device()
+        else:
+            yield eval_exprs_tpu(lay.final_exprs(), merged)
+
     def execute_partition(self, pidx):
         from spark_rapids_tpu.expressions.evaluator import eval_exprs_tpu
         from spark_rapids_tpu.memory.retry import with_retry_no_split
         from spark_rapids_tpu.memory.spillable import SpillableColumnarBatch
         from spark_rapids_tpu.ops.agg_ops import segmented_aggregate
         lay = self.layout
+        if self.mode == COMPLETE and self._has_collect():
+            yield from self._complete_collect(pidx)
+            return
         # partials register spillable as they accumulate — under pressure
         # the catalog can push earlier partials down a tier while later
         # child batches are still aggregating (GpuMergeAggregateIterator's
@@ -552,6 +625,11 @@ def _tag_aggregate(meta) -> None:
     """Rejects device-unsupported agg shapes (planner fallback instead of
     wrong results — reference: GpuHashAggregateMeta.tagPlanForGpu)."""
     lay = meta.plan.layout
+    for g in lay.grouping:
+        if g.data_type.is_nested:
+            meta.will_not_work(
+                f"grouping key of type {g.data_type.simple_name} "
+                "(nested keys have no device sort words)")
     for j, (ai, spec) in enumerate(lay.flat):
         dt = spec.dtype
         if isinstance(dt, (T.StringType, T.BinaryType)) and \
@@ -578,19 +656,39 @@ def _tag_aggregate(meta) -> None:
                     f"{lay.buffer_name(j)} not on device "
                     "(sum below the 38-digit clamp is)")
         if spec.update_kind in ("list", "distinct"):
-            meta.will_not_work(
-                f"variable-length aggregation buffer "
-                f"{lay.buffer_name(j)} is host tier (collect/percentile)")
+            from spark_rapids_tpu.expressions.aggregates import (
+                CollectList, CollectSet, CountDistinct)
+            func = lay.aggs[ai].func
+            ins = func.inputs()
+            vdt = ins[spec.input_ordinal].data_type if ins else None
+            from spark_rapids_tpu import config as _C
+            device_ok = (
+                meta.conf.get(_C.COLLECT_AGG_ENABLED.key) and
+                meta.plan.mode == COMPLETE and
+                isinstance(func, (CollectList, CollectSet,
+                                  CountDistinct)) and
+                vdt is not None and not vdt.is_nested and
+                not isinstance(vdt, (T.StringType, T.BinaryType)) and
+                not (isinstance(vdt, T.DecimalType) and vdt.is_decimal128))
+            if not device_ok:
+                meta.will_not_work(
+                    f"variable-length aggregation buffer "
+                    f"{lay.buffer_name(j)} is host tier (device collect "
+                    "covers COMPLETE-mode fixed-width values)")
 
 
 from spark_rapids_tpu.plan.overrides import register_exec  # noqa: E402
+
+from spark_rapids_tpu.plan import typechecks as _AGG_TS  # noqa: E402
 
 register_exec(
     CpuHashAggregateExec,
     convert=lambda p, m: TpuHashAggregateExec(p.layout.grouping,
                                               p.layout.aggs, p.mode,
                                               p.children[0]),
+    sig=_AGG_TS.BASIC_WITH_ARRAYS,
     exprs_of=lambda p: list(p.layout.grouping) +
     [a.func for a in p.layout.aggs],
     extra_tag=_tag_aggregate,
-    desc="hash aggregate (sort + segmented reduction)")
+    desc="hash aggregate (sort + segmented reduction; device collect "
+         "via padded array planes)")

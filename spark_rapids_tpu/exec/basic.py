@@ -358,6 +358,10 @@ class CpuLimitExec(UnaryExec):
         return f"Limit[{self.n}]"
 
 
+#: conf-driven (spark.rapids.sql.limit.deferredForceInterval)
+LIMIT_DEFERRED_FORCE_INTERVAL = 8
+
+
 class TpuLimitExec(UnaryExec):
     is_device = True
 
@@ -397,7 +401,7 @@ class TpuLimitExec(UnaryExec):
                 jnp.asarray(rc_traceable(out.row_count)), 0)
             yield out
             deferred_batches += 1
-            if deferred_batches % 8 == 0:
+            if deferred_batches % LIMIT_DEFERRED_FORCE_INTERVAL == 0:
                 import numpy as _np
                 left = int(_np.asarray(left))
 
@@ -487,8 +491,42 @@ class TpuGlobalLimitExec(CpuGlobalLimitExec):
     is_device = True
 
     def execute_partition(self, pidx):
+        # same deferred-budget discipline as TpuLimitExec: comparing a
+        # deferred count against the remaining budget would force a
+        # ~185ms sync per batch
+        from spark_rapids_tpu.columnar.column import (DeferredCount, _jnp,
+                                                      rc_traceable)
         from spark_rapids_tpu.ops import take_front
-        yield from self._limited(take_front)
+        jnp = _jnp()
+        left = self.n
+        deferred_batches = 0
+        for cp in range(self.child.num_partitions):
+            if isinstance(left, int) and left <= 0:
+                return
+            for b in self.child.execute_partition(cp):
+                if isinstance(left, int) and left <= 0:
+                    return
+                rc = b.row_count
+                if isinstance(left, int) and not (
+                        isinstance(rc, DeferredCount) and
+                        not rc.is_forced):
+                    if int(rc) <= left:
+                        left -= int(rc)
+                        yield b
+                    else:
+                        yield take_front(b, left)
+                        left = 0
+                    continue
+                out = take_front(b, left if isinstance(left, int)
+                                 else DeferredCount(left))
+                left = jnp.maximum(
+                    jnp.asarray(rc_traceable(left)) -
+                    jnp.asarray(rc_traceable(out.row_count)), 0)
+                yield out
+                deferred_batches += 1
+                if deferred_batches % LIMIT_DEFERRED_FORCE_INTERVAL == 0:
+                    import numpy as _np
+                    left = int(_np.asarray(left))
 
     def node_desc(self):
         return f"TpuGlobalLimit[{self.n}]"

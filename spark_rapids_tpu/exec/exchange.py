@@ -54,6 +54,12 @@ def _sample_bounds(part: RangePartitioning, sample_rows, to_host_batch):
     return cc(rows) if rows else HostColumnarBatch([], 0, [])
 
 
+#: conf-driven (plan/overrides.apply)
+SHRINK_THRESHOLD_BYTES = 64 << 20
+RANGE_BOUNDS_SAMPLE_ROWS = 1024
+COLLECTIVE_ENABLED = True
+
+
 class _LazyPartitions:
     """Reduce-side view over mode-specific storage: partitions fetch on
     first access (the reduce task's fetch) and cache for re-execution.
@@ -299,6 +305,8 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
         from spark_rapids_tpu import types as T
         from spark_rapids_tpu.parallel.mesh import active_mesh
         from spark_rapids_tpu.plan.partitioning import HashPartitioning
+        if not COLLECTIVE_ENABLED:
+            return None
         ctx = active_mesh()
         if ctx is None or not isinstance(part, HashPartitioning):
             return None
@@ -388,7 +396,7 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
         #: padding-shrink (shrink needs the exact count -> a ~185ms tunnel
         #: sync); below the threshold the compacts just keep the input
         #: bucket and counts stay deferred (sync-free map side)
-        shrink_threshold = 64 << 20
+        shrink_threshold = SHRINK_THRESHOLD_BYTES
 
         def map_gen(mp):
             p_eff = part
@@ -527,7 +535,7 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
                 # evenly spaced over the LIVE rows (a stride over the
                 # bucket would collapse to ~1 sample for a filtered batch
                 # whose count is far below its padding)
-                k = 1024
+                k = RANGE_BOUNDS_SAMPLE_ROWS
                 rc_t = jnp.asarray(rc_traceable(b.row_count),
                                    dtype=np.int64)
                 j = jnp.arange(k, dtype=np.int64)

@@ -38,14 +38,13 @@ from spark_rapids_tpu.plan.base import BinaryExec, Exec
 
 _PAIR_TYPES = (J.INNER, J.LEFT_OUTER, J.RIGHT_OUTER, J.FULL_OUTER, J.CROSS)
 
+#: conf-driven (spark.rapids.sql.join.buildSideSwap.*; set per plan
+#: compile by plan/overrides.apply)
+BUILD_SWAP_ENABLED = True
+BUILD_SWAP_MAX_BYTES = 256 << 20
 
-def _known_empty(rc) -> bool:
-    """True only when a batch is empty WITHOUT forcing a deferred count
-    (a host sync per batch would dominate the join wall time)."""
-    from spark_rapids_tpu.columnar.column import DeferredCount
-    if isinstance(rc, DeferredCount):
-        return rc.is_forced and int(rc) == 0
-    return int(rc) == 0
+
+from spark_rapids_tpu.columnar.column import known_empty as _known_empty
 
 
 def _normalize_how(how: str) -> str:
@@ -476,8 +475,9 @@ class TpuShuffledHashJoinExec(_TpuJoinCore):
         which would build on the FACT side in star queries — wrong both
         for memory and for the speculative pair sizing)."""
         bb = sum(b.nbytes() for b in build)
-        if self.join_type == J.INNER and self.condition is None and \
-                self.left_keys and bb <= (256 << 20):
+        if BUILD_SWAP_ENABLED and self.join_type == J.INNER and \
+                self.condition is None and \
+                self.left_keys and bb <= BUILD_SWAP_MAX_BYTES:
             # comparing sides requires materializing the probe partition;
             # bound that by only considering a swap when the build side is
             # modest (an oversized build falls to sub-partitioning anyway)

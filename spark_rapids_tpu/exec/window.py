@@ -339,6 +339,41 @@ FORCE_RUNNING_WINDOW = False
 #: observability: bumped once per running-window (batched) pass
 RUNNING_WINDOW_EVENTS = 0
 
+FORCE_BOUNDED_WINDOW = False
+#: observability: bumped once per bounded-window (batched) pass
+BOUNDED_WINDOW_EVENTS = 0
+
+#: largest preceding+following row span the batched bounded path carries
+#: between chunks; wider frames concat the whole partition
+BOUNDED_WINDOW_MAX_SPAN = 4096
+
+
+def _bounded_span(lowered: List[LoweredWindow]):
+    """(max_preceding, max_following) when every window column is a
+    fixed-bound ROWS-frame aggregate or a lag/lead — the shapes whose
+    chunked evaluation needs only a (P+F)-row tail carried between
+    batches (reference: GpuBatchedBoundedWindowExec.scala).  None when
+    any column needs more (running/rank shapes go through
+    _running_windows; everything else concats the partition)."""
+    P = F = 0
+    for low in lowered:
+        k = low.func[0]
+        if k == "offset":
+            off = low.func[2]
+            P = max(P, max(0, -off))
+            F = max(F, max(0, off))
+            continue
+        if k == "agg":
+            _, _agg, _, fk, lo, hi, _cvo = low.func
+            if fk == "rows" and lo is not None and hi is not None:
+                P = max(P, max(0, -lo))
+                F = max(F, max(0, hi))
+                continue
+        return None
+    if P + F == 0 or P + F > BOUNDED_WINDOW_MAX_SPAN:
+        return None
+    return P, F
+
 
 def _running_eligible(lowered: List[LoweredWindow]) -> bool:
     """True when every window column is a running computation over
@@ -444,6 +479,15 @@ class TpuWindowExec(CpuWindowExec):
                 yield from self._running_windows(batches)
                 batches = None   # handed off — nothing pinned here
                 return
+        span = _bounded_span(self.lowered)
+        if span is not None:
+            budget = self._batch_budget()
+            est = sum(b.nbytes() for b in batches)
+            if FORCE_BOUNDED_WINDOW or (budget is not None and
+                                        est > budget):
+                yield from self._bounded_windows(batches, *span)
+                batches = None
+                return
         yield self._window_one(concat_batches(batches))
 
     def _running_windows(self, batches: List[ColumnarBatch]):
@@ -471,6 +515,70 @@ class TpuWindowExec(CpuWindowExec):
             out = self._window_one(sorted_batch)
             out, carry = self._apply_carry(out, carry)
             yield out
+
+    def _bounded_windows(self, batches: List[ColumnarBatch], P: int,
+                         F: int):
+        """Chunked fixed-bound ROWS frames (reference:
+        GpuBatchedBoundedWindowExec.scala — carry max(preceding) rows of
+        context plus the last max(following) rows whose frames were
+        incomplete, instead of concatenating the partition).
+
+        Overlap re-computation scheme: each sorted chunk is prepended with
+        the previous chunk's (P+F)-row tail, the fused per-batch window
+        kernel runs over the combined batch (partition-segmented, so
+        context rows from an earlier partition never pollute), and only
+        rows whose frames lie fully inside the combined batch are emitted:
+        positions [carried - held, rc - F).  The final chunk's trailing
+        rows are complete by definition and flush at stream end.  All
+        cursors are device scalars — no per-chunk host sync."""
+        global BOUNDED_WINDOW_EVENTS
+        BOUNDED_WINDOW_EVENTS += 1
+        from spark_rapids_tpu.columnar.column import (DeferredCount,
+                                                      bucket_rows, _jnp,
+                                                      rc_traceable)
+        from spark_rapids_tpu.exec.sort import SortSpec, TpuSortExec
+        from spark_rapids_tpu.ops.batch_ops import (compact_batch,
+                                                    concat_batches,
+                                                    gather_batch)
+        jnp = _jnp()
+        span = P + F
+        scan = _HandoffBatchesScan(batches, self.child.schema)
+        specs = [SortSpec(e, True, True) for e in self.spec.partition_exprs]
+        specs += [SortSpec(e, a, nf if nf is not None else None)
+                  for e, a, nf in self.spec.order_specs]
+        sorter = TpuSortExec(specs, scan)
+        carry = None          # (P+F)-row tail batch of the prev combined
+        skip_t = None         # device scalar: rows of carry already emitted
+        last = None           # (windowed combined, rc_t, skip_t) to flush
+        for sb in sorter.execute_partition(0):
+            combined = sb if carry is None else concat_batches([carry, sb])
+            out = self._window_one(combined)
+            rc_t = jnp.asarray(rc_traceable(out.row_count), dtype=np.int64)
+            skip = jnp.zeros((), np.int64) if skip_t is None else skip_t
+            pos = jnp.arange(out.bucket, dtype=np.int64)
+            emit_hi = jnp.maximum(rc_t - F, skip)
+            emitted = compact_batch(out, (pos >= skip) & (pos < emit_hi))
+            emitted.names = out.names
+            yield emitted
+            # tail for the next chunk: last min(rc, span) rows of combined
+            carried_t = jnp.minimum(rc_t, span)
+            idx = jnp.maximum(rc_t - span, 0) + \
+                jnp.arange(bucket_rows(span), dtype=np.int64)
+            carry = gather_batch(
+                combined, jnp.minimum(idx, jnp.maximum(rc_t - 1, 0)),
+                DeferredCount(carried_t))
+            carry.names = combined.names
+            # of the carried rows, the last min(F, rc) were NOT emitted
+            skip_t = carried_t - jnp.minimum(jnp.asarray(F, np.int64),
+                                             rc_t - skip)
+            last = (out, rc_t, emit_hi)
+        if last is not None:
+            out, rc_t, emit_hi = last
+            # flush: the final chunk's trailing rows' frames are complete
+            pos = jnp.arange(out.bucket, dtype=np.int64)
+            tail = compact_batch(out, (pos >= emit_hi) & (pos < rc_t))
+            tail.names = out.names
+            yield tail
 
     def _apply_carry(self, out: ColumnarBatch, carry):
         """Adjusts the leading rows of ``out`` (those continuing the

@@ -519,3 +519,151 @@ def keyed_agg_trace(cols, sel, num_keys, specs, bucket, jnp):
     gv = jnp.arange(bucket) < num_groups
     outs = [(d, v & gv, ln) for (d, v, ln) in outs]
     return outs, num_groups
+
+
+# ---------------------------------------------------------------------------
+# device collect_list / collect_set (reference: aggregateFunctions.scala
+# collect ops over cuDF lists; TPU-first reformulation = stable sort by
+# keys [+ value for sets], segment boundaries, scatter into a padded
+# [group, max_len] plane)
+# ---------------------------------------------------------------------------
+
+_COLLECT_CACHE: Dict[Tuple, object] = {}
+
+
+def segmented_collect(batch: ColumnarBatch, num_keys: int, value_ord: int,
+                      distinct: bool):
+    """Collects the value column per group into a device array column.
+
+    Returns (keys+array ColumnarBatch with the SAME bucket/group order as
+    ``segmented_aggregate`` over the same keys, group-count DeferredCount).
+    Null values are skipped (Spark collect semantics); ``distinct``
+    dedupes by sorting (key, value) and keeping first occurrences — set
+    ORDER is value-sorted, which Spark leaves unspecified.
+
+    Sync discipline: ONE host fetch for the max group length (the padded
+    plane's static width); the group count stays deferred."""
+    import jax
+    from spark_rapids_tpu.columnar.column import (DeferredCount,
+                                                  bucket_strlen,
+                                                  rc_traceable)
+    from spark_rapids_tpu.ops.sort_ops import SortOrder, _order_words
+    jnp = _jx()
+    bucket = batch.bucket
+    vcol = batch.columns[value_ord]
+    sig = ("collect1", tuple(_col_sig(c) for c in batch.columns), num_keys,
+           value_ord, distinct)
+    fn = _COLLECT_CACHE.get(sig)
+    if fn is None:
+        dtypes = [c.data_type for c in batch.columns]
+
+        def phase1(arrs, row_count):
+            cols = [DeviceColumn(d, v, bucket, dtypes[i], ln)
+                    for i, (d, v, ln) in enumerate(arrs)]
+            rowpos = jnp.arange(bucket, dtype=np.int32)
+            inrow = rowpos < row_count
+            orders = [SortOrder(i, True, True) for i in range(num_keys)]
+            words = [(~inrow).astype(np.int8)]
+            for o in orders:
+                words.extend(_order_words(cols[o.ordinal], o, jnp))
+            n_keywords = len(words)
+            if distinct:
+                words.extend(_order_words(
+                    cols[value_ord], SortOrder(value_ord, True, True), jnp))
+            flat = []
+            for c in cols:
+                flat.append(c.data)
+                flat.append(c.validity)
+                if c.lengths is not None:
+                    flat.append(c.lengths)
+            sorted_ops = jax.lax.sort(tuple(words) + (rowpos,) + tuple(flat),
+                                      num_keys=len(words), is_stable=True)
+            perm = sorted_ops[len(words)]
+            flat_s = list(sorted_ops[len(words) + 1:])
+            scols = []
+            fi = 0
+            for c in cols:
+                d = flat_s[fi]; fi += 1
+                v = flat_s[fi]; fi += 1
+                ln = None
+                if c.lengths is not None:
+                    ln = flat_s[fi]; fi += 1
+                scols.append(DeviceColumn(d, v, bucket, c.data_type, ln))
+            inrow_s = jnp.take(inrow, perm, axis=0)
+            # group boundaries on KEY words only
+            boundary = jnp.zeros(bucket, dtype=bool).at[0].set(True)
+            for kcol in scols[:num_keys]:
+                for w in _masked_group_words(kcol, jnp):
+                    diff = (w[1:] != w[:-1]) if w.ndim == 1 else \
+                        jnp.any(w[1:] != w[:-1], axis=-1)
+                    boundary = boundary.at[1:].max(diff)
+            boundary = boundary | (rowpos == row_count)
+            seg = jnp.cumsum(boundary.astype(np.int32)) - 1
+            num_groups = jnp.max(jnp.where(inrow_s, seg, -1)) + 1
+            sval = scols[value_ord]
+            kept = inrow_s & sval.validity
+            if distinct:
+                first = boundary.copy()
+                for w in _masked_group_words(sval, jnp):
+                    diff = (w[1:] != w[:-1]) if w.ndim == 1 else \
+                        jnp.any(w[1:] != w[:-1], axis=-1)
+                    first = first.at[1:].max(diff)
+                kept = kept & first
+            # position within the group counting only kept rows
+            ck = jnp.cumsum(kept.astype(np.int64))
+            base = jax.ops.segment_min(
+                jnp.where(inrow_s, ck - kept, 1 << 62), seg,
+                num_segments=bucket)
+            pos = ck - 1 - jnp.take(base, seg)
+            lengths = jax.ops.segment_sum(kept.astype(np.int32), seg,
+                                          num_segments=bucket)
+            maxw = jnp.max(lengths)
+            # group key rows (same rule as keyed_agg_trace)
+            first_pos = jax.ops.segment_min(
+                jnp.where(inrow_s, rowpos.astype(np.int64), bucket), seg,
+                num_segments=bucket)
+            key_outs = []
+            safe_first = jnp.clip(first_pos, 0, bucket - 1)
+            gvalid = jnp.arange(bucket) < num_groups
+            for kcol in scols[:num_keys]:
+                d = jnp.take(kcol.data, safe_first, axis=0)
+                v = jnp.take(kcol.validity, safe_first, axis=0) & gvalid
+                ln = None if kcol.lengths is None else \
+                    jnp.take(kcol.lengths, safe_first, axis=0)
+                key_outs.append((d, v, ln))
+            return (sval.data, kept, seg, pos, lengths, num_groups, maxw,
+                    key_outs)
+
+        fn = jax.jit(phase1)
+        _COLLECT_CACHE[sig] = fn
+    arrs = [(c.data, c.validity, c.lengths) for c in batch.columns]
+    (svals, kept, seg, pos, lengths, ng, maxw_d,
+     key_outs) = fn(arrs, rc_traceable(batch.row_count))
+    maxw = int(np.asarray(maxw_d))          # the one sync
+    W = bucket_strlen(max(maxw, 1))
+    sig2 = ("collect2", bucket, W, str(svals.dtype))
+    fn2 = _COLLECT_CACHE.get(sig2)
+    if fn2 is None:
+        def phase2(svals, kept, seg, pos, lengths, ng):
+            plane = jnp.zeros((bucket, W), dtype=svals.dtype)
+            dest_g = jnp.where(kept, seg.astype(np.int64), bucket)
+            dest_p = jnp.clip(pos, 0, W - 1)
+            plane = plane.at[(dest_g, dest_p)].set(svals, mode="drop")
+            ev = jnp.arange(W)[None, :] < lengths[:, None]
+            gvalid = jnp.arange(bucket) < ng
+            return plane, ev, gvalid
+
+        fn2 = jax.jit(phase2)
+        _COLLECT_CACHE[sig2] = fn2
+    plane, ev, gvalid = fn2(svals, kept, seg, pos, lengths, ng)
+    n = DeferredCount(ng)
+    arr_col = DeviceColumn(plane, gvalid, n,
+                           T.ArrayType(vcol.data_type, contains_null=False),
+                           lengths=lengths.astype(np.int32),
+                           elem_valid=ev)
+    cols = []
+    names = (batch.names or [f"c{i}" for i in range(batch.num_columns)])
+    for j, (d, v, ln) in enumerate(key_outs):
+        cols.append(DeviceColumn(d, v, n, batch.columns[j].data_type, ln))
+    cols.append(arr_col)
+    return ColumnarBatch(cols, n, names[:num_keys] + ["collected"])

@@ -54,6 +54,10 @@ class ExprMeta(BaseMeta):
             self.will_not_work(
                 f"expression {self.expr.name} has no TPU implementation")
             return
+        ekey = getattr(rule, "enable_key", None)
+        if ekey is not None and not self.conf.get(ekey, True):
+            self.will_not_work(
+                f"expression {self.expr.name} disabled by {ekey}")
         sig = rule.sig or self.sig or TS.ALL_BASIC
         try:
             dt = self.expr.data_type
@@ -95,6 +99,9 @@ class PlanMeta(BaseMeta):
             self.will_not_work(
                 f"exec {self.plan.name} has no TPU implementation")
             return
+        ekey = getattr(self.rule, "enable_key", None)
+        if ekey is not None and not self.conf.get(ekey, True):
+            self.will_not_work(f"exec {self.plan.name} disabled by {ekey}")
         sig = self.rule.sig or TS.ALL_BASIC
         r = TS.check_output_types(self.plan.schema, sig)
         if r is not None:

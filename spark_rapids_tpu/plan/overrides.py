@@ -60,13 +60,40 @@ _EXEC_RULES: Dict[type, ExecRule] = {}
 
 
 def register_expr(cls, sig=None, desc="", extra_tag=None, checks=None):
-    _EXPR_RULES[cls] = ExprRule(cls, sig, desc, extra_tag, checks)
+    rule = ExprRule(cls, sig, desc, extra_tag, checks)
+    rule.enable_key = _register_op_enable("expression", cls, desc)
+    _EXPR_RULES[cls] = rule
+
+
+def _op_enable_key(kind: str, cls) -> str:
+    name = cls.__name__
+    if name.startswith("Cpu"):
+        name = name[3:]
+    return f"spark.rapids.sql.{kind}.{name}"
+
+
+def _register_op_enable(kind: str, cls, desc: str) -> str:
+    """Every registered operator gets its own enable conf (reference:
+    GpuOverrides registers spark.rapids.sql.exec.* /
+    spark.rapids.sql.expression.* per rule; RapidsConf.isOperatorEnabled).
+    Setting it false tags the op off the device — a real planner gate,
+    surfaced by docgen."""
+    from spark_rapids_tpu import config as C
+    key = _op_enable_key(kind, cls)
+    if key not in C.registry():
+        C.conf_bool(key,
+                    f"Enable the device {kind} {cls.__name__}"
+                    + (f" ({desc})" if desc else "") + ".",
+                    True, C.ConfLevel.COMMONLY_USED)
+    return key
 
 
 def register_exec(cls, convert, sig=None, expr_sig=None, desc="",
                   exprs_of=lambda p: [], extra_tag=None, host_only=False):
-    _EXEC_RULES[cls] = ExecRule(cls, convert, sig, expr_sig, desc, exprs_of,
-                                extra_tag, host_only)
+    rule = ExecRule(cls, convert, sig, expr_sig, desc, exprs_of,
+                    extra_tag, host_only)
+    rule.enable_key = _register_op_enable("exec", cls, desc)
+    _EXEC_RULES[cls] = rule
 
 
 def expr_rule_for(cls) -> Optional[ExprRule]:
@@ -337,7 +364,10 @@ def fuse_device_stages(plan: Exec) -> Exec:
                 return list(reversed(ops)), cur
 
     def fix(node: Exec) -> Exec:
-        if isinstance(node, TpuHashAggregateExec) and node.mode != FINAL:
+        if isinstance(node, TpuHashAggregateExec) and node.mode != FINAL \
+                and not node._has_collect():
+            # variable-length (collect) buffers run the dedicated
+            # segmented_collect path in the exec, not the fused kernel
             ops, base = chain_of(node.children[0])
             lay = node.layout
             return TpuFusedAggExec(ops, lay, node.mode, base)
@@ -520,6 +550,25 @@ class TpuOverrides:
             C.FORCE_MERGE_REPARTITION_DEPTH.key)
         _SO.FORCE_OUT_OF_CORE_SORT = conf.get(C.FORCE_OOC_SORT.key)
         _WI.FORCE_RUNNING_WINDOW = conf.get(C.FORCE_RUNNING_WINDOW.key)
+        _WI.FORCE_BOUNDED_WINDOW = conf.get(C.FORCE_BOUNDED_WINDOW.key)
+        _WI.BOUNDED_WINDOW_MAX_SPAN = conf.get(
+            C.BOUNDED_WINDOW_MAX_SPAN.key)
+        # round-5 behavior knobs ride the same module-global pattern
+        import spark_rapids_tpu.columnar.transfer as _TR
+        import spark_rapids_tpu.exec.basic as _XB2
+        import spark_rapids_tpu.exec.exchange as _XC
+        import spark_rapids_tpu.exec.joins as _XJ
+        _XJ.BUILD_SWAP_ENABLED = conf.get(C.JOIN_BUILD_SWAP_ENABLED.key)
+        _XJ.BUILD_SWAP_MAX_BYTES = C.parse_bytes(
+            conf.get(C.JOIN_BUILD_SWAP_MAX_BYTES.key))
+        _XC.SHRINK_THRESHOLD_BYTES = C.parse_bytes(
+            conf.get(C.SHUFFLE_DEVICE_SHRINK_THRESHOLD.key))
+        _XC.RANGE_BOUNDS_SAMPLE_ROWS = conf.get(
+            C.RANGE_BOUNDS_SAMPLE_ROWS.key)
+        _XC.COLLECTIVE_ENABLED = conf.get(C.COLLECTIVE_EXCHANGE_ENABLED.key)
+        _TR._DL_SPEC_ROWS = conf.get(C.DOWNLOAD_SPECULATIVE_ROWS.key)
+        _XB2.LIMIT_DEFERRED_FORCE_INTERVAL = conf.get(
+            C.LIMIT_DEFERRED_FORCE_INTERVAL.key)
         # ENABLE-only: benchmark setups interleave an enabled session
         # with a default-conf sanity session, whose every plan compile
         # would otherwise wipe the cache mid-run; releasing the process-

@@ -682,9 +682,13 @@ class DataFrame:
         return overrides.apply(self._plan)
 
     def collect_batch(self) -> HostColumnarBatch:
+        from spark_rapids_tpu import config as C
         from spark_rapids_tpu.ops.speculation import (SpeculationOverflow,
                                                       no_speculation,
                                                       speculation_scope)
+        if not self._session.conf.get(C.SPECULATIVE_SIZING_ENABLED.key):
+            with no_speculation():
+                return self._executed_plan().collect_host()
         try:
             with speculation_scope() as ctx:
                 out = self._executed_plan().collect_host()

@@ -340,7 +340,9 @@ class Analyzer:
             if entry["plan"] is None:
                 plan, _ = self._select(entry["ast"], entry["env"],
                                        outer=None)
-                if entry.get("multi"):
+                from spark_rapids_tpu import config as C
+                if entry.get("multi") and \
+                        self.session.conf.get(C.CTE_REUSE_ENABLED.key):
                     # referenced more than once: materialize once and
                     # share (the q4/q11 year_total CTE would otherwise
                     # execute per reference)
@@ -1426,6 +1428,17 @@ class Analyzer:
         if name == "rpad":
             return ST.RPad(args[0], args[1], args[2] if len(args) > 2
                            else lit(" "))
+        if name == "sort_array":
+            from spark_rapids_tpu.expressions.collections import SortArray
+            return SortArray(args[0],
+                             args[1] if len(args) > 1 else None)
+        if name == "size" or name == "cardinality":
+            from spark_rapids_tpu.expressions.collections import Size
+            return Size(args[0])
+        if name == "array_contains":
+            from spark_rapids_tpu.expressions.collections import \
+                ArrayContains
+            return ArrayContains(args[0], args[1])
         if name == "hash":
             from spark_rapids_tpu.expressions.hashing import Murmur3Hash
             return Murmur3Hash(*args)

@@ -92,11 +92,90 @@ def _queries() -> List:
     def q_union_count(t):
         return t["fact"].union(t["fact"]).group_by("key2").count()
 
+    def q_join_full(t):
+        return t["fact"].join(t["dim"], on="key", how="full")
+
+    def q_join_semi(t):
+        return t["fact"].join(t["dim"], on="key", how="semi")
+
+    def q_join_anti(t):
+        return t["fact"].join(t["dim"], on="key", how="anti")
+
+    def q_window_running_sum(t):
+        from spark_rapids_tpu.functions import Window
+        spec = (Window.partition_by("key2").order_by("v2")
+                .rows_between(Window.unbounded_preceding,
+                              Window.current_row))
+        return t["fact"].select(col("key2"), col("v2"),
+                                Alias(F.sum(col("v1")).over(spec), "rs"))
+
+    def q_window_bounded(t):
+        from spark_rapids_tpu.functions import Window
+        spec = (Window.partition_by("key2").order_by("v2")
+                .rows_between(-3, 3))
+        return t["fact"].select(col("key2"), col("v2"),
+                                Alias(F.avg(col("v1")).over(spec), "ma"))
+
+    def q_window_lag_lead(t):
+        from spark_rapids_tpu.functions import Window, lag, lead
+        spec = Window.partition_by("key2").order_by("v2")
+        return t["fact"].select(col("key2"), col("v2"),
+                                Alias(lag(col("v2"), 1).over(spec), "lg"),
+                                Alias(lead(col("v2"), 1).over(spec), "ld"))
+
+    def q_rollup(t):
+        return (t["fact"].rollup("key2", "s")
+                .agg(Alias(F.sum(col("v1")), "sv")))
+
+    def q_count_distinct(t):
+        return (t["fact"].group_by("key2")
+                .agg(Alias(F.count_distinct(col("v2")), "cd")))
+
+    def q_collect(t):
+        return (t["fact"].group_by("key2")
+                .agg(Alias(F.collect_set(col("v2")), "cs")))
+
+    def q_string_ops(t):
+        return (t["fact"]
+                .select(Alias(F.upper(col("s")), "u"),
+                        Alias(F.substring(col("s"), 1, 4), "pre"),
+                        Alias(F.concat(col("s"), lit("_x")), "c"))
+                .group_by("pre").count())
+
+    def q_skew_join(t):
+        # every fact row keyed to ONE dim key: worst-case join skew
+        skewed = t["fact"].select(Alias(col("key") * lit(0), "key"),
+                                  col("v1"))
+        return skewed.join(t["dim"], on="key", how="inner") \
+            .group_by("name").agg(Alias(F.sum(col("v1")), "sv"))
+
+    def q_intersect(t):
+        a = t["fact"].filter(col("v2") > lit(0)).select(col("key2"))
+        b = t["fact"].filter(col("v2") < lit(500)).select(col("key2"))
+        return a.intersect(b)
+
+    def q_range_sort(t):
+        return t["fact"].order_by("v1")
+
+    def q_date_agg(t):
+        return (t["fact"].group_by("d")
+                .agg(Alias(F.count(col("v1")), "c"))
+                .order_by("d").limit(50))
+
     return [("agg_sum", q_agg_sum), ("agg_multi", q_agg_multi),
             ("join_inner", q_join_inner), ("join_left", q_join_left),
             ("join_two_dims", q_join_two), ("sort_limit", q_sort_limit),
             ("filter_project", q_filter_project), ("distinct", q_distinct),
-            ("window_rank", q_window_rank), ("union_count", q_union_count)]
+            ("window_rank", q_window_rank), ("union_count", q_union_count),
+            ("join_full", q_join_full), ("join_semi", q_join_semi),
+            ("join_anti", q_join_anti),
+            ("window_running_sum", q_window_running_sum),
+            ("window_bounded", q_window_bounded),
+            ("window_lag_lead", q_window_lag_lead),
+            ("rollup", q_rollup), ("count_distinct", q_count_distinct),
+            ("collect_set", q_collect), ("string_ops", q_string_ops),
+            ("skew_join", q_skew_join), ("intersect", q_intersect),
+            ("range_sort", q_range_sort), ("date_agg", q_date_agg)]
 
 
 def run_scale_test(session, scale_rows: int = 10_000, seed: int = 7,

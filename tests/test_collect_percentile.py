@@ -77,3 +77,25 @@ def test_collect_falls_back_honestly():
     ex = df.explain()
     assert "will run on TPU" not in ex.split("HashAggregate")[1][:200] or True
     assert df.count() == 7
+
+
+def test_collect_on_device_no_fallback():
+    """Round-5: fixed-width collect_list/collect_set run ON DEVICE in
+    COMPLETE mode (ARRAY-valued aggregation buffers as padded planes) —
+    the plan must carry no host fallback for the aggregate."""
+    import numpy as np
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.session import TpuSession
+    s = TpuSession(TpuConf({"spark.rapids.sql.enabled": "true",
+                            "spark.rapids.sql.test.enabled": "true",
+                            "spark.rapids.sql.test.allowedNonGpu":
+                                "CpuInMemoryScanExec"}))
+    df = s.create_dataframe({"g": np.array([1, 1, 2, 2, 2]),
+                             "v": np.array([3, 1, 2, 2, 5])},
+                            num_partitions=1)
+    s.create_or_replace_temp_view("t", df)
+    rows = s.sql("select g, sort_array(collect_list(v)) l, "
+                 "sort_array(collect_set(v)) cs from t group by g "
+                 "order by g").collect()
+    assert rows[0]["l"] == [1, 3] and rows[0]["cs"] == [1, 3]
+    assert rows[1]["l"] == [2, 2, 5] and rows[1]["cs"] == [2, 5]

@@ -341,3 +341,94 @@ def test_window_sum_nan_inf_no_poison():
                 F.Alias(F.min("v").over(_running_frame()), "rmin"),
                 F.Alias(F.max("v").over(_running_frame()), "rmax")),
         ignore_order=True, approx_float=True)
+
+
+# ---------------------------------------------------------------------------
+# chunked bounded-frame windows (reference: GpuBatchedBoundedWindowExec —
+# carry a max(preceding)+max(following) tail between batches)
+# ---------------------------------------------------------------------------
+
+BOUNDED_CONF = {"spark.rapids.sql.test.window.forceBoundedBatched": "true",
+                "spark.rapids.sql.test.sort.forceOutOfCore": "true"}
+
+
+@pytest.fixture
+def force_bounded_window():
+    from spark_rapids_tpu.exec import sort as S
+    from spark_rapids_tpu.exec import window as W
+    prev_rows = S._MERGE_OUT_ROWS
+    S._MERGE_OUT_ROWS = 700
+    yield W
+    S._MERGE_OUT_ROWS = prev_rows
+
+
+def _bounded_frame(p, f):
+    return W_GO().rows_between(-p, f)
+
+
+def test_bounded_window_aggs_multi_batch(force_bounded_window):
+    Wm = force_bounded_window
+    before = Wm.BOUNDED_WINDOW_EVENTS
+    d = _big_data(5000)
+    d["o"] = np.arange(len(d["o"]))    # unique order: frame-deterministic
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(d, num_partitions=4)
+        .select(F.col("g"), F.col("o"), F.col("v"),
+                F.Alias(F.sum("v").over(_bounded_frame(3, 2)), "bs"),
+                F.Alias(F.count("v").over(_bounded_frame(3, 2)), "bc"),
+                F.Alias(F.min("v").over(_bounded_frame(5, 0)), "bmin"),
+                F.Alias(F.max("v").over(_bounded_frame(0, 4)), "bmax")),
+        ignore_order=True, approx_float=True, conf=BOUNDED_CONF)
+    assert Wm.BOUNDED_WINDOW_EVENTS > before, "bounded path did not engage"
+
+
+def test_bounded_window_single_group_spans_batches(force_bounded_window):
+    """One partition across every chunk: tails chain through the whole
+    stream; frames straddling chunk boundaries must match the one-shot
+    oracle exactly."""
+    n = 3000
+    rng = np.random.default_rng(5)
+    d = {"g": np.ones(n, dtype=np.int64),
+         "o": np.arange(n),
+         "v": rng.integers(0, 100, n).astype(np.int64)}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(d, num_partitions=3)
+        .select(F.col("o"),
+                F.Alias(F.sum("v").over(_bounded_frame(7, 7)), "bs"),
+                F.Alias(F.avg("v").over(_bounded_frame(2, 2)), "ba")),
+        ignore_order=True, approx_float=True, conf=BOUNDED_CONF)
+
+
+def test_bounded_window_lag_lead_multi_batch(force_bounded_window):
+    """lag/lead ride the bounded tail-carry path (their offsets define
+    the span)."""
+    Wm = force_bounded_window
+    before = Wm.BOUNDED_WINDOW_EVENTS
+    n = 2500
+    d = {"g": (np.arange(n) // 500).astype(np.int64),
+         "o": np.arange(n),
+         "v": np.arange(n, dtype=np.int64) * 3}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(d, num_partitions=3)
+        .select(F.col("g"), F.col("o"),
+                F.Alias(F.lag("v", 2).over(W_GO()), "lg"),
+                F.Alias(F.lead("v", 3).over(W_GO()), "ld")),
+        ignore_order=True, conf=BOUNDED_CONF)
+    assert Wm.BOUNDED_WINDOW_EVENTS > before
+
+
+def test_bounded_window_oom_injection(force_bounded_window):
+    """The chunked path under deterministic OOM injection: retries must
+    not corrupt the carried tail."""
+    n = 2000
+    rng = np.random.default_rng(11)
+    d = {"g": (np.arange(n) % 5).astype(np.int64),
+         "o": np.arange(n),
+         "v": rng.integers(0, 50, n).astype(np.int64)}
+    conf = dict(BOUNDED_CONF)
+    conf["spark.rapids.sql.test.injectRetryOOM"] = "2"
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(d, num_partitions=2)
+        .select(F.col("g"), F.col("o"),
+                F.Alias(F.sum("v").over(_bounded_frame(4, 1)), "bs")),
+        ignore_order=True, conf=conf)

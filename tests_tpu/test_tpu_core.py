@@ -146,3 +146,28 @@ def test_running_window_carry_on_chip():
 
 def test_count_distinct_on_chip():
     _run_sql("select b, count(distinct s) as cd from t group by b")
+
+
+def test_bounded_window_tail_carry_on_chip():
+    """Round-5 chunked bounded frames: (P+F)-row tail carried across sort
+    chunks on the real chip (frames straddling chunk boundaries)."""
+    _run_sql(
+        "select b, a,"
+        " sum(a) over (partition by b order by a"
+        "              rows between 3 preceding and 2 following) bs,"
+        " count(a) over (partition by b order by a"
+        "                rows between 5 preceding and current row) bc"
+        " from t where a <> 0", n_parts=2,
+        conf={"spark.rapids.sql.test.window.forceBoundedBatched": "true",
+              "spark.rapids.sql.test.sort.forceOutOfCore": "true"})
+
+
+def test_speculative_join_sizing_on_chip():
+    """Round-5 speculative pair-table sizing: an exploding join (every
+    probe row matches many build rows) must overflow the probe-bucket
+    guess and replay exactly, transparently."""
+    dup = {"b": np.repeat(np.arange(10, dtype=np.int32), 40),
+           "v": np.arange(400, dtype=np.int64)}
+    _run_sql("select t.b, count(d.v) c from t join d on t.b = d.b "
+             "group by t.b order by t.b",
+             views={"t": _DATA, "d": dup})
