@@ -477,8 +477,14 @@ class CpuHashAggregateExec(UnaryExec):
         for j, (_ai, spec) in enumerate(lay.flat):
             # the SEMANTIC kind decides the empty value: a count slot is 0
             # on empty input even in FINAL mode, where merge_kind is "sum"
-            # (merging counts) and would wrongly produce null
+            # (merging counts) and would wrongly produce null; collect
+            # buffers are EMPTY ARRAYS, never null (Spark CollectList/
+            # CollectSet semantics)
             k = spec.update_kind
+            if k in ("list", "distinct"):
+                cols[lay.buffer_name(j)] = pa.array(
+                    [[]], type=T.to_arrow(spec.dtype))
+                continue
             zero = 0 if k == "count" or k.startswith("m2") else None
             if spec.dtype == T.DOUBLE and zero == 0:
                 zero = 0.0
@@ -552,12 +558,22 @@ class TpuHashAggregateExec(CpuHashAggregateExec):
             buf_cols[j] = cres.columns[nk]
         # the scalar and collect passes each produced their own deferred
         # group count (same value: same sort, same keys); a batch requires
-        # ONE shared count object, so rewrap every column with it
+        # ONE shared count object, so rewrap every column with it.  For a
+        # GLOBAL aggregation the scalar pass reduces to a tiny bucket
+        # while collect keeps the input bucket — slice collect planes down
+        # (the single group always fits)
         from spark_rapids_tpu.columnar.column import DeviceColumn
-        cols = [DeviceColumn(c.data, c.validity, n, c.data_type,
-                             c.lengths, c.elem_valid)
-                for c in keys_cols +
-                [buf_cols[j] for j in range(len(lay.flat))]]
+        raw = keys_cols + [buf_cols[j] for j in range(len(lay.flat))]
+        target = min(int(c.data.shape[0]) for c in raw)
+        cols = []
+        for c in raw:
+            d, v = c.data, c.validity
+            ln, ev = c.lengths, c.elem_valid
+            if int(d.shape[0]) != target:
+                d, v = d[:target], v[:target]
+                ln = None if ln is None else ln[:target]
+                ev = None if ev is None else ev[:target]
+            cols.append(DeviceColumn(d, v, n, c.data_type, ln, ev))
         merged = ColumnarBatch(cols, n)
         merged.names = [lay.key_name(i) for i in range(nk)] + \
             [lay.buffer_name(j) for j in range(len(lay.flat))]

@@ -362,6 +362,44 @@ class CpuLimitExec(UnaryExec):
 LIMIT_DEFERRED_FORCE_INTERVAL = 8
 
 
+def _deferred_limited(batches, n: int):
+    """Limit over a batch stream with the remaining budget kept ON DEVICE
+    while counts are deferred (forcing each batch's count would cost a
+    tunnel sync per batch).  Amortized early exit: every
+    LIMIT_DEFERRED_FORCE_INTERVAL-th deferred batch forces the budget
+    once so a satisfied limit stops pulling the source."""
+    import numpy as _np
+
+    from spark_rapids_tpu.columnar.column import (DeferredCount, _jnp,
+                                                  rc_traceable)
+    from spark_rapids_tpu.ops import take_front
+    jnp = _jnp()
+    left = n   # int until a deferred count is consumed
+    deferred_batches = 0
+    for b in batches:
+        if isinstance(left, int) and left <= 0:
+            return
+        rc = b.row_count
+        if isinstance(left, int) and \
+                not (isinstance(rc, DeferredCount) and not rc.is_forced):
+            if int(rc) <= left:
+                left -= int(rc)
+                yield b
+            else:
+                yield take_front(b, left)
+                left = 0
+            continue
+        out = take_front(b, left if isinstance(left, int)
+                         else DeferredCount(left))
+        left = jnp.maximum(
+            jnp.asarray(rc_traceable(left)) -
+            jnp.asarray(rc_traceable(out.row_count)), 0)
+        yield out
+        deferred_batches += 1
+        if deferred_batches % LIMIT_DEFERRED_FORCE_INTERVAL == 0:
+            left = int(_np.asarray(left))
+
+
 class TpuLimitExec(UnaryExec):
     is_device = True
 
@@ -370,40 +408,8 @@ class TpuLimitExec(UnaryExec):
         self.n = n
 
     def execute_partition(self, pidx):
-        from spark_rapids_tpu.columnar.column import (DeferredCount, _jnp,
-                                                      rc_traceable)
-        from spark_rapids_tpu.ops import take_front
-        jnp = _jnp()
-        left = self.n   # int until a deferred count is consumed
-        deferred_batches = 0
-        for b in self.child.execute_partition(pidx):
-            if isinstance(left, int) and left <= 0:
-                break
-            rc = b.row_count
-            if isinstance(left, int) and \
-                    not (isinstance(rc, DeferredCount) and not rc.is_forced):
-                if int(rc) <= left:
-                    left -= int(rc)
-                    yield b
-                else:
-                    yield take_front(b, left)
-                    left = 0
-                continue
-            # deferred path: the remaining budget rides on device —
-            # forcing each batch's count would cost a sync per batch.
-            # Amortized early exit: every 8th deferred batch forces the
-            # budget once so a satisfied limit stops pulling the child
-            # (a purely deferred budget could never break the loop)
-            out = take_front(b, left if isinstance(left, int)
-                             else DeferredCount(left))
-            left = jnp.maximum(
-                jnp.asarray(rc_traceable(left)) -
-                jnp.asarray(rc_traceable(out.row_count)), 0)
-            yield out
-            deferred_batches += 1
-            if deferred_batches % LIMIT_DEFERRED_FORCE_INTERVAL == 0:
-                import numpy as _np
-                left = int(_np.asarray(left))
+        yield from _deferred_limited(self.child.execute_partition(pidx),
+                                     self.n)
 
     def node_desc(self):
         return f"TpuLimit[{self.n}]"
@@ -491,42 +497,10 @@ class TpuGlobalLimitExec(CpuGlobalLimitExec):
     is_device = True
 
     def execute_partition(self, pidx):
-        # same deferred-budget discipline as TpuLimitExec: comparing a
-        # deferred count against the remaining budget would force a
-        # ~185ms sync per batch
-        from spark_rapids_tpu.columnar.column import (DeferredCount, _jnp,
-                                                      rc_traceable)
-        from spark_rapids_tpu.ops import take_front
-        jnp = _jnp()
-        left = self.n
-        deferred_batches = 0
-        for cp in range(self.child.num_partitions):
-            if isinstance(left, int) and left <= 0:
-                return
-            for b in self.child.execute_partition(cp):
-                if isinstance(left, int) and left <= 0:
-                    return
-                rc = b.row_count
-                if isinstance(left, int) and not (
-                        isinstance(rc, DeferredCount) and
-                        not rc.is_forced):
-                    if int(rc) <= left:
-                        left -= int(rc)
-                        yield b
-                    else:
-                        yield take_front(b, left)
-                        left = 0
-                    continue
-                out = take_front(b, left if isinstance(left, int)
-                                 else DeferredCount(left))
-                left = jnp.maximum(
-                    jnp.asarray(rc_traceable(left)) -
-                    jnp.asarray(rc_traceable(out.row_count)), 0)
-                yield out
-                deferred_batches += 1
-                if deferred_batches % LIMIT_DEFERRED_FORCE_INTERVAL == 0:
-                    import numpy as _np
-                    left = int(_np.asarray(left))
+        def stream():
+            for cp in range(self.child.num_partitions):
+                yield from self.child.execute_partition(cp)
+        yield from _deferred_limited(stream(), self.n)
 
     def node_desc(self):
         return f"TpuGlobalLimit[{self.n}]"

@@ -60,6 +60,28 @@ def _normalize_how(how: str) -> str:
     }[h]
 
 
+def expand_struct_key_pairs(left_keys, right_keys, null_safe=None):
+    """Struct-CONSTRUCTOR key pairs -> field-wise NULL-SAFE pairs (Spark
+    struct equality).  Shared by join construction AND the hash
+    partitionings the planner builds above the join (both sides must
+    shuffle by the same decomposed keys)."""
+    from spark_rapids_tpu.expressions.collections import \
+        CreateNamedStruct as _CNS
+    ns_in = list(null_safe or [False] * len(list(left_keys)))
+    lks, rks, nss = [], [], []
+    for lk, rk, ns in zip(list(left_keys), list(right_keys), ns_in):
+        if isinstance(lk, _CNS) and isinstance(rk, _CNS) and \
+                len(lk.children) == len(rk.children):
+            lks.extend(lk.children)
+            rks.extend(rk.children)
+            nss.extend([True] * len(lk.children))
+        else:
+            lks.append(lk)
+            rks.append(rk)
+            nss.append(ns)
+    return lks, rks, nss
+
+
 class _JoinBase(BinaryExec):
     """Shared schema/condition plumbing for all join execs."""
 
@@ -68,11 +90,16 @@ class _JoinBase(BinaryExec):
                  condition: Optional[Expression], left: Exec, right: Exec,
                  null_safe: Optional[Sequence[bool]] = None):
         super().__init__(left, right)
-        self.left_keys = list(left_keys)
-        self.right_keys = list(right_keys)
+        # struct-CONSTRUCTOR key pairs decompose into field-wise NULL-SAFE
+        # pairs (Spark struct equality semantics; constructors are never
+        # null themselves) — no device struct plane needed
+        lks, rks, nss = expand_struct_key_pairs(left_keys, right_keys,
+                                                null_safe)
+        self.left_keys = lks
+        self.right_keys = rks
         self.join_type = join_type
         self.condition = condition
-        self.null_safe = tuple(null_safe or [False] * len(self.left_keys))
+        self.null_safe = tuple(nss)
         if len(self.left_keys) != len(self.right_keys):
             raise ValueError("left/right key counts differ")
         for lk, rk in zip(self.left_keys, self.right_keys):

@@ -402,7 +402,15 @@ def bind_references(expr: Expression, schema: T.StructType) -> Expression:
 
 def fold_constants(expr: Expression) -> Expression:
     """Evaluates deterministic all-literal subtrees once on the host and
-    replaces them with Literals (Spark's ConstantFolding logical rule).
+    replaces them with Literals (Spark's ConstantFolding logical rule),
+    and simplifies struct CONSTRUCTOR forms so they never need a device
+    struct plane (Spark's SimplifyExtractValueOps + struct-equality
+    expansion):
+
+    - ``struct(a, b).a``             -> ``a``
+    - ``struct(a, b) = struct(c, d)`` -> ``a <=> c AND b <=> d``
+      (struct equality is field-wise NULL-SAFE in Spark; the constructor
+      itself is never null, so no outer null term is needed)
 
     First-order device win: ``cast('2000-08-23' as date)`` inside a filter
     otherwise drags the whole operator to host because string->date casts
@@ -411,6 +419,9 @@ def fold_constants(expr: Expression) -> Expression:
     from spark_rapids_tpu.expressions.evaluator import tcol_to_host_column
 
     def fix(n: Expression) -> Expression:
+        simplified = _simplify_struct_node(n)
+        if simplified is not None:
+            return simplified
         if (isinstance(n, (Literal, Alias)) or not n.children or
                 not n.foldable or not n.deterministic or
                 not all(isinstance(c, Literal) for c in n.children)):
@@ -426,6 +437,34 @@ def fold_constants(expr: Expression) -> Expression:
             return n
 
     return expr.transform_up(fix)
+
+
+def _simplify_struct_node(n: Expression):
+    """Struct-constructor simplifications (see fold_constants docstring).
+    Returns the replacement or None."""
+    from spark_rapids_tpu.expressions.collections import (CreateNamedStruct,
+                                                          GetStructField)
+    from spark_rapids_tpu.expressions import predicates as PR
+    if isinstance(n, GetStructField) and \
+            isinstance(n.children[0], CreateNamedStruct):
+        st = n.children[0]
+        # SQL identifiers resolve case-insensitively (Spark default)
+        want = n.field_name.lower()
+        for nm, child in zip(st.names, st.children):
+            if nm.lower() == want:
+                return child
+        return None   # unknown field: defer to GetStructField's own error
+    if isinstance(n, PR.EqualTo):
+        l, r = n.children
+        if isinstance(l, CreateNamedStruct) and \
+                isinstance(r, CreateNamedStruct) and \
+                len(l.children) == len(r.children):
+            out = None
+            for lc, rc in zip(l.children, r.children):
+                term = PR.EqualNullSafe(lc, rc)
+                out = term if out is None else PR.And(out, term)
+            return out
+    return None
 
 
 def col(name: str) -> AttributeReference:

@@ -533,24 +533,40 @@ _COLLECT_CACHE: Dict[Tuple, object] = {}
 
 def segmented_collect(batch: ColumnarBatch, num_keys: int, value_ord: int,
                       distinct: bool):
-    """Collects the value column per group into a device array column.
+    """Collects ONE value column per group into a device array column —
+    see segmented_collect_many (the multi-slot form that batches the
+    max-width sync)."""
+    return segmented_collect_many(batch, num_keys,
+                                  [(value_ord, distinct)])[0]
 
-    Returns (keys+array ColumnarBatch with the SAME bucket/group order as
-    ``segmented_aggregate`` over the same keys, group-count DeferredCount).
+
+def segmented_collect_many(batch: ColumnarBatch, num_keys: int,
+                           slots):
+    """Collects several value columns per group into device array
+    columns: ``slots`` = [(value_ordinal, distinct)], returns one
+    keys+array ColumnarBatch per slot, all sharing segmented_aggregate's
+    group order.
+
     Null values are skipped (Spark collect semantics); ``distinct``
     dedupes by sorting (key, value) and keeping first occurrences — set
     ORDER is value-sorted, which Spark leaves unspecified.
 
-    Sync discipline: ONE host fetch for the max group length (the padded
-    plane's static width); the group count stays deferred."""
+    Sync discipline: ONE host fetch total for every slot's max group
+    length (stacked — a fetch per slot would cost ~185ms each on a
+    tunnel-attached chip); group counts stay deferred."""
+    phase1 = [_collect_phase1(batch, num_keys, o, d) for o, d in slots]
+    maxws = np.asarray(_jx().stack([p[6] for p in phase1]))  # the one sync
+    return [_collect_phase2(batch, num_keys, o, p, int(w))
+            for (o, _d), p, w in zip(slots, phase1, maxws)]
+
+
+def _collect_phase1(batch: ColumnarBatch, num_keys: int, value_ord: int,
+                    distinct: bool):
     import jax
-    from spark_rapids_tpu.columnar.column import (DeferredCount,
-                                                  bucket_strlen,
-                                                  rc_traceable)
+    from spark_rapids_tpu.columnar.column import rc_traceable
     from spark_rapids_tpu.ops.sort_ops import SortOrder, _order_words
     jnp = _jx()
     bucket = batch.bucket
-    vcol = batch.columns[value_ord]
     sig = ("collect1", tuple(_col_sig(c) for c in batch.columns), num_keys,
            value_ord, distinct)
     fn = _COLLECT_CACHE.get(sig)
@@ -637,9 +653,18 @@ def segmented_collect(batch: ColumnarBatch, num_keys: int, value_ord: int,
         fn = jax.jit(phase1)
         _COLLECT_CACHE[sig] = fn
     arrs = [(c.data, c.validity, c.lengths) for c in batch.columns]
-    (svals, kept, seg, pos, lengths, ng, maxw_d,
-     key_outs) = fn(arrs, rc_traceable(batch.row_count))
-    maxw = int(np.asarray(maxw_d))          # the one sync
+    return fn(arrs, rc_traceable(batch.row_count))
+
+
+def _collect_phase2(batch: ColumnarBatch, num_keys: int, value_ord: int,
+                    p1, maxw: int):
+    import jax
+    from spark_rapids_tpu.columnar.column import (DeferredCount,
+                                                  bucket_strlen)
+    jnp = _jx()
+    bucket = batch.bucket
+    vcol = batch.columns[value_ord]
+    (svals, kept, seg, pos, lengths, ng, _maxw_d, key_outs) = p1
     W = bucket_strlen(max(maxw, 1))
     sig2 = ("collect2", bucket, W, str(svals.dtype))
     fn2 = _COLLECT_CACHE.get(sig2)

@@ -133,6 +133,8 @@ def _ast_children(e: A.SqlExpr) -> List[A.SqlExpr]:
     out = []
     if isinstance(e, A.Alias):
         out = [e.expr]
+    elif isinstance(e, A.FieldAccess):
+        out = [e.operand]
     elif isinstance(e, A.BinaryOp):
         out = [e.left, e.right]
     elif isinstance(e, A.UnaryOp):
@@ -413,6 +415,10 @@ class Analyzer:
                     f"{kind} join requires at least one equality condition")
             return JX.CpuBroadcastNestedLoopJoinExec([], [], how, cond,
                                                      lplan, rplan)
+        # decompose struct-constructor pairs BEFORE building the hash
+        # partitionings: both sides must shuffle by the same field keys
+        # the join will probe with
+        lkeys, rkeys, nsafe = JX.expand_struct_key_pairs(lkeys, rkeys)
         nparts = max(lplan.num_partitions, rplan.num_partitions)
         if nparts > 1:
             env = self.session.shuffle_env
@@ -421,7 +427,7 @@ class Analyzer:
             rplan = CpuShuffleExchangeExec(
                 HashPartitioning(rkeys, nparts), rplan, shuffle_env=env)
         return JX.CpuShuffledHashJoinExec(lkeys, rkeys, how, cond, lplan,
-                                          rplan)
+                                          rplan, null_safe=nsafe)
 
     # -- select core --------------------------------------------------------
     def _select(self, q: A.Select, cte_env, outer: Optional[Scope]):
@@ -721,6 +727,25 @@ class Analyzer:
 
         key_bound = [self._expr_sq(g, plan, scope, env)
                      for g in group_exprs]
+        # struct-constructor grouping keys decompose into their field
+        # exprs (struct equality/grouping is field-wise; no device struct
+        # plane needed — Spark's RemoveRedundantAliases-era rewrite
+        # family).  key_map: original ki -> (start, width, struct|None)
+        from spark_rapids_tpu.expressions.collections import \
+            CreateNamedStruct as _CNS
+        key_map = []
+        if not (rollup or cube):
+            expanded = []
+            for k in key_bound:
+                if isinstance(k, _CNS):
+                    key_map.append((len(expanded), len(k.children), k))
+                    expanded.extend(k.children)
+                else:
+                    key_map.append((len(expanded), 1, None))
+                    expanded.append(k)
+            key_bound = expanded
+        else:
+            key_map = [(i, 1, None) for i in range(len(key_bound))]
         agg_exprs = []
         for i, call in enumerate(agg_calls):
             agg_exprs.append(Alias(self._agg_func(call, plan, scope, env),
@@ -755,12 +780,23 @@ class Analyzer:
         # scope over agg output: keys (by structural AST match) + agg slots
         agg_schema = aplan.schema
 
+        def _key_ref(ki: int) -> Expression:
+            start, width, st = key_map[ki]
+            if st is None:
+                f = agg_schema.fields[start]
+                return BoundReference(start, f.data_type, f.nullable)
+            # struct key: reassemble from its decomposed field columns
+            refs = [BoundReference(start + i,
+                                   agg_schema.fields[start + i].data_type,
+                                   agg_schema.fields[start + i].nullable)
+                    for i in range(width)]
+            return _CNS(st.names, refs)
+
         def rewrite(e: A.SqlExpr) -> Expression:
             # grouping key? structural match against group_exprs
             for ki, g in enumerate(group_exprs):
                 if e == g:
-                    f = agg_schema.fields[ki]
-                    return BoundReference(ki, f.data_type, f.nullable)
+                    return _key_ref(ki)
             if _is_agg_call(e):
                 ai = agg_calls.index(e)
                 idx = len(key_bound) + ai
@@ -793,8 +829,7 @@ class Analyzer:
         def rewrite_leaf(e: A.SqlExpr) -> Optional[Expression]:
             for ki, g in enumerate(group_exprs):
                 if e == g:
-                    f = agg_schema.fields[ki]
-                    return BoundReference(ki, f.data_type, f.nullable)
+                    return _key_ref(ki)
             gb = _grouping_bit(e)
             if gb is not None:
                 return gb
@@ -819,8 +854,7 @@ class Analyzer:
                             g.name.lower() == e.name.lower() and \
                             (e.qualifier is None or g.qualifier is None or
                              g.qualifier.lower() == e.qualifier.lower()):
-                        f = agg_schema.fields[ki]
-                        return BoundReference(ki, f.data_type, f.nullable)
+                        return _key_ref(ki)
                 raise AnalysisError(
                     f"column {e.name} is neither grouped nor aggregated")
             return None
@@ -1252,6 +1286,10 @@ class Analyzer:
             return scope.resolve(e.name, e.qualifier).ref()
         if isinstance(e, A.Alias):
             return Alias(rec(e.expr), e.name)
+        if isinstance(e, A.FieldAccess):
+            from spark_rapids_tpu.expressions.collections import \
+                GetStructField
+            return GetStructField(rec(e.operand), e.field)
         if isinstance(e, A.UnaryOp):
             if e.op == "not":
                 return PR.Not(rec(e.operand))
@@ -1428,6 +1466,24 @@ class Analyzer:
         if name == "rpad":
             return ST.RPad(args[0], args[1], args[2] if len(args) > 2
                            else lit(" "))
+        if name == "struct":
+            from spark_rapids_tpu.expressions.collections import \
+                CreateNamedStruct
+            return CreateNamedStruct([f"col{i + 1}" for i in
+                                      range(len(args))], args)
+        if name == "named_struct":
+            from spark_rapids_tpu.expressions.base import Literal as _L
+            from spark_rapids_tpu.expressions.collections import \
+                CreateNamedStruct
+            if len(args) % 2:
+                raise AnalysisError("named_struct needs name/value pairs")
+            names2 = []
+            for a in args[0::2]:
+                if not isinstance(a, _L):
+                    raise AnalysisError(
+                        "named_struct field names must be literals")
+                names2.append(str(a.value))
+            return CreateNamedStruct(names2, args[1::2])
         if name == "sort_array":
             from spark_rapids_tpu.expressions.collections import SortArray
             return SortArray(args[0],

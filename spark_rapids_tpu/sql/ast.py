@@ -38,6 +38,14 @@ class ColumnRef(SqlExpr):
 
 
 @dataclasses.dataclass
+class FieldAccess(SqlExpr):
+    """Postfix struct field access on a non-identifier primary:
+    ``struct(a, b).col1``."""
+    operand: "SqlExpr"
+    field: str
+
+
+@dataclasses.dataclass
 class Star(SqlExpr):
     qualifier: Optional[str] = None
 

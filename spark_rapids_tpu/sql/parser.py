@@ -349,7 +349,14 @@ class Parser:
         if self.at_op("+"):
             self.next()
             return self.unary_expr()
-        return self.primary_expr()
+        e = self.primary_expr()
+        # postfix struct field access: struct(a, b).col1 (column
+        # qualifiers are consumed inside primary_expr; this only fires on
+        # non-identifier primaries, e.g. function-call results)
+        while self.at_op(".") and not isinstance(e, A.ColumnRef):
+            self.next()
+            e = A.FieldAccess(e, self.ident())
+        return e
 
     def primary_expr(self) -> A.SqlExpr:
         t = self.peek()

@@ -99,3 +99,18 @@ def test_collect_on_device_no_fallback():
                  "order by g").collect()
     assert rows[0]["l"] == [1, 3] and rows[0]["cs"] == [1, 3]
     assert rows[1]["l"] == [2, 2, 5] and rows[1]["cs"] == [2, 5]
+
+
+def test_collect_empty_input_returns_empty_array():
+    """Spark: collect_list/collect_set over zero rows is [], never null
+    (shared-oracle blind spot found by review; both engines fixed)."""
+    import numpy as np
+    from tests.asserts import cpu_session, tpu_session
+    for s in (cpu_session(), tpu_session(
+            {"spark.rapids.sql.test.enabled": "false"})):
+        df = s.create_dataframe({"g": np.array([1, 2]),
+                                 "v": np.array([1, 2])}, num_partitions=2)
+        s.create_or_replace_temp_view("e", df)
+        rows = s.sql("select collect_list(v) l, collect_set(v) cs, "
+                     "count(distinct v) cd from e where v > 99").collect()
+        assert rows == [{"l": [], "cs": [], "cd": 0}], rows

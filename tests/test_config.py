@@ -126,3 +126,20 @@ def test_config_docs_cover_registry():
     docs = generate_docs()
     missing = [k for k in registry() if k not in docs]
     assert not missing, missing[:5]
+
+
+def test_committed_config_docs_not_stale():
+    """docs/configs.md is a generated artifact: the committed file must
+    contain every key the FULL operator surface registers (a docgen run
+    that missed module imports silently documented an incomplete set —
+    r5 review)."""
+    import os
+    from spark_rapids_tpu.config import registry
+    from spark_rapids_tpu.testing.docsgen import import_all_rules
+    import_all_rules()
+    path = os.path.join(os.path.dirname(__file__), "..", "docs",
+                        "configs.md")
+    committed = open(path).read()
+    missing = [k for k in registry() if k not in committed]
+    assert not missing, (f"docs/configs.md is stale; regenerate "
+                         f"(missing {missing[:5]}...)")

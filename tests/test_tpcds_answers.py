@@ -1,0 +1,140 @@
+"""Third-oracle TPC-DS answer validation (VERDICT r4 weak #5).
+
+The differential tier compares the TPU engine against the repo's own CPU
+engine — a shared semantics bug would be invisible.  The datagen is
+synthetic (documented deviation: docs/compatibility.md), so the published
+qualification answer sets do not apply; instead, representative queries
+are re-implemented HERE in pandas — an independent third implementation
+of the SQL semantics — over the same generated tables, and all three
+must agree row for row.
+"""
+
+import math
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.testing.tpcds import generate_tables, register_tables
+from spark_rapids_tpu.testing.tpcds_queries import QUERIES
+
+from tests.asserts import cpu_session, tpu_session
+
+SF = 0.05
+
+
+@pytest.fixture(scope="module")
+def frames():
+    return {name: pd.DataFrame(cols)
+            for name, cols in generate_tables(sf=SF).items()}
+
+
+def _engine_rows(qname):
+    out = []
+    for s in (cpu_session(),
+              tpu_session({"spark.rapids.sql.test.enabled": "false"})):
+        register_tables(s, sf=SF)
+        out.append(s.sql(QUERIES[qname]).collect())
+    return out
+
+
+def _assert_all_match(expected, qname):
+    cpu_rows, tpu_rows = _engine_rows(qname)
+    for label, rows in (("cpu", cpu_rows), ("tpu", tpu_rows)):
+        assert len(rows) == len(expected), \
+            f"{qname} {label}: {len(rows)} rows vs pandas {len(expected)}"
+        for i, (got, want) in enumerate(zip(rows, expected)):
+            for k, wv in want.items():
+                gv = got[k]
+                if isinstance(wv, float) and not (wv is None or
+                                                  math.isnan(wv)):
+                    assert gv == pytest.approx(wv, rel=1e-9), \
+                        f"{qname} {label} row {i} col {k}: {gv} vs {wv}"
+                else:
+                    assert gv == wv, \
+                        f"{qname} {label} row {i} col {k}: {gv} vs {wv}"
+
+
+def test_q3_answers(frames):
+    """q3: store_sales x date_dim x item, manufact 128, November,
+    group by (d_year, brand_id, brand), order by d_year, sum desc."""
+    ss = frames["store_sales"]
+    dd = frames["date_dim"]
+    it = frames["item"]
+    j = ss.merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk")
+    j = j.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    j = j[(j.i_manufact_id == 128) & (j.d_moy == 11)]
+    g = (j.groupby(["d_year", "i_brand_id", "i_brand"], as_index=False)
+         .agg(sum_agg=("ss_ext_sales_price", "sum")))
+    g = g.sort_values(["d_year", "sum_agg", "i_brand_id"],
+                      ascending=[True, False, True]).head(100)
+    expected = [{"d_year": int(r.d_year), "brand_id": int(r.i_brand_id),
+                 "brand": r.i_brand, "sum_agg": float(r.sum_agg)}
+                for r in g.itertuples()]
+    _assert_all_match(expected, "q3")
+
+
+def test_q42_answers(frames):
+    """q42: (d_year, i_category_id, i_category) sums for manager 1,
+    November 2000, ordered by sum desc."""
+    ss = frames["store_sales"]
+    dd = frames["date_dim"]
+    it = frames["item"]
+    j = ss.merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk")
+    j = j.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    j = j[(j.i_manager_id == 1) & (j.d_moy == 11) & (j.d_year == 2000)]
+    g = (j.groupby(["d_year", "i_category_id", "i_category"],
+                   as_index=False)
+         .agg(s=("ss_ext_sales_price", "sum")))
+    g = g.sort_values(["s", "d_year", "i_category_id", "i_category"],
+                      ascending=[False, True, True, True]).head(100)
+    expected = [{"d_year": int(r.d_year),
+                 "i_category_id": int(r.i_category_id),
+                 "i_category": r.i_category, "s": float(r.s)}
+                for r in g.itertuples()]
+    _assert_all_match(expected, "q42")
+
+
+def test_q43_answers(frames):
+    """q43: per-store day-name pivot sums for year 2000, gmt offset -5."""
+    ss = frames["store_sales"]
+    dd = frames["date_dim"]
+    st = frames["store"]
+    j = ss.merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk")
+    j = j.merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+    j = j[(j.d_year == 2000) & (j.s_gmt_offset == -5)]
+    days = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+            "Friday", "Saturday"]
+    cols = ["sun_sales", "mon_sales", "tue_sales", "wed_sales",
+            "thu_sales", "fri_sales", "sat_sales"]
+    rows = []
+    for (name, sid), grp in j.groupby(["s_store_name", "s_store_id"]):
+        rec = {"s_store_name": name, "s_store_id": sid}
+        for d, c in zip(days, cols):
+            v = grp.loc[grp.d_day_name == d, "ss_sales_price"].sum()
+            rec[c] = float(v) if (grp.d_day_name == d).any() else None
+        rows.append(rec)
+    rows.sort(key=lambda r: (r["s_store_name"], r["s_store_id"]))
+    expected = rows[:100]
+    _assert_all_match(expected, "q43")
+
+
+def test_q38_answers(frames):
+    """q38: count of (last, first, date) triples present in ALL three
+    sales channels within the month window (INTERSECT semantics)."""
+    dd = frames["date_dim"]
+    cu = frames["customer"]
+    win = dd[(dd.d_month_seq >= 1200) & (dd.d_month_seq <= 1211)]
+
+    def triples(fact, datecol, custcol):
+        j = frames[fact].merge(win, left_on=datecol, right_on="d_date_sk")
+        j = j.merge(cu, left_on=custcol, right_on="c_customer_sk")
+        return set(zip(j.c_last_name, j.c_first_name, j.d_date))
+
+    common = (triples("store_sales", "ss_sold_date_sk", "ss_customer_sk")
+              & triples("catalog_sales", "cs_sold_date_sk",
+                        "cs_bill_customer_sk")
+              & triples("web_sales", "ws_sold_date_sk",
+                        "ws_bill_customer_sk"))
+    expected = [{"col0": len(common)}]
+    _assert_all_match(expected, "q38")
