@@ -271,9 +271,9 @@ def _tpcds_phase(tpu, cpu, res: dict):
     order = ["q3", "q1", "q7", "q8", "q15", "q12", "q13", "q20", "q19",
              "q16", "q17", "q10", "q18", "q6", "q9", "q2", "q11", "q5",
              "q4"]
+    slow_tail = ["q48", "q9", "q2", "q11", "q5", "q4"]
     fast_new = [q for q in sorted(QUERIES, key=lambda s: int(s[1:]))
-                if q not in order]
-    slow_tail = ["q9", "q2", "q11", "q5", "q4"]
+                if q not in order and q not in slow_tail]
     names = [q for q in order if q in QUERIES and q not in slow_tail] + \
         fast_new + [q for q in slow_tail if q in QUERIES]
     # every query starts on the skip list and is removed when it FINISHES:

@@ -517,7 +517,7 @@ class TpuHashAggregateExec(CpuHashAggregateExec):
         from spark_rapids_tpu.columnar.column import known_empty
         from spark_rapids_tpu.expressions.evaluator import eval_exprs_tpu
         from spark_rapids_tpu.ops.agg_ops import (segmented_aggregate,
-                                                  segmented_collect)
+                                                  segmented_collect_many)
         from spark_rapids_tpu.ops.batch_ops import concat_batches
         lay = self.layout
         batches = [b for b in self.child.execute_partition(pidx)
@@ -549,13 +549,16 @@ class TpuHashAggregateExec(CpuHashAggregateExec):
             n = sres.row_count
             for (j, _), c in zip(scalar, sres.columns[nk:]):
                 buf_cols[j] = c
-        for j, spec in collect:
-            cres = segmented_collect(proj, nk, nk + j,
-                                     spec.update_kind == "distinct")
-            if keys_cols is None:
-                keys_cols = list(cres.columns[:nk])
-                n = cres.row_count
-            buf_cols[j] = cres.columns[nk]
+        if collect:
+            # ONE stacked max-width sync for every collect slot
+            many = segmented_collect_many(
+                proj, nk, [(nk + j, spec.update_kind == "distinct")
+                           for j, spec in collect])
+            for (j, _spec), cres in zip(collect, many):
+                if keys_cols is None:
+                    keys_cols = list(cres.columns[:nk])
+                    n = cres.row_count
+                buf_cols[j] = cres.columns[nk]
         # the scalar and collect passes each produced their own deferred
         # group count (same value: same sort, same keys); a batch requires
         # ONE shared count object, so rewrap every column with it.  For a

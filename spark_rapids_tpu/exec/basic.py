@@ -376,8 +376,15 @@ def _deferred_limited(batches, n: int):
     jnp = _jnp()
     left = n   # int until a deferred count is consumed
     deferred_batches = 0
-    for b in batches:
+    it = iter(batches)
+    while True:
+        # budget check BEFORE pulling: a satisfied limit must not start
+        # the next partition's pipeline just to discard its first batch
         if isinstance(left, int) and left <= 0:
+            return
+        try:
+            b = next(it)
+        except StopIteration:
             return
         rc = b.row_count
         if isinstance(left, int) and \

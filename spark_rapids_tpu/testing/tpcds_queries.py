@@ -1278,3 +1278,421 @@ group by ca_zip, ca_city
 order by ca_zip, ca_city
 limit 100
 """
+
+QUERIES["q48"] = """
+select sum(ss_quantity) s
+from store_sales, store, customer_demographics, customer_address, date_dim
+where s_store_sk = ss_store_sk
+  and ss_sold_date_sk = d_date_sk and d_year = 2000
+  and ((cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'M'
+        and cd_education_status = '4 yr Degree'
+        and ss_sales_price between 100.00 and 150.00)
+       or (cd_demo_sk = ss_cdemo_sk
+           and cd_marital_status = 'D'
+           and cd_education_status = '2 yr Degree'
+           and ss_sales_price between 50.00 and 100.00)
+       or (cd_demo_sk = ss_cdemo_sk
+           and cd_marital_status = 'S'
+           and cd_education_status = 'College'
+           and ss_sales_price between 150.00 and 200.00))
+  and ((ss_addr_sk = ca_address_sk
+        and ca_country = 'United States'
+        and ca_state in ('CO', 'OH', 'TX')
+        and ss_net_profit between 0 and 2000)
+       or (ss_addr_sk = ca_address_sk
+           and ca_country = 'United States'
+           and ca_state in ('OR', 'MN', 'KY')
+           and ss_net_profit between 150 and 3000)
+       or (ss_addr_sk = ca_address_sk
+           and ca_country = 'United States'
+           and ca_state in ('VA', 'CA', 'MS')
+           and ss_net_profit between 50 and 25000))
+"""
+
+QUERIES["q52"] = """
+select dt.d_year, item.i_brand_id brand_id, item.i_brand brand,
+       sum(ss_ext_sales_price) ext_price
+from date_dim dt, store_sales, item
+where dt.d_date_sk = store_sales.ss_sold_date_sk
+  and store_sales.ss_item_sk = item.i_item_sk
+  and item.i_manager_id = 1
+  and dt.d_moy = 11 and dt.d_year = 2000
+group by dt.d_year, item.i_brand_id, item.i_brand
+order by dt.d_year, ext_price desc, brand_id
+limit 100
+"""
+
+QUERIES["q53"] = """
+select * from
+ (select i_manufact_id, sum(ss_sales_price) sum_sales,
+         avg(sum(ss_sales_price)) over (partition by i_manufact_id)
+             avg_quarterly_sales
+  from item, store_sales, date_dim, store
+  where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+    and ss_store_sk = s_store_sk
+    and d_month_seq in (1200, 1201, 1202, 1203, 1204, 1205, 1206, 1207,
+                        1208, 1209, 1210, 1211)
+    and ((i_category in ('Books', 'Children', 'Electronics')
+          and i_class in ('class1', 'class2', 'class3'))
+         or (i_category in ('Women', 'Music', 'Men')
+             and i_class in ('class4', 'class5', 'class6')))
+  group by i_manufact_id, d_qoy) tmp1
+where case when avg_quarterly_sales > 0
+           then abs(sum_sales - avg_quarterly_sales) / avg_quarterly_sales
+           else null end > 0.1
+order by avg_quarterly_sales, sum_sales, i_manufact_id
+limit 100
+"""
+
+QUERIES["q55"] = """
+select i_brand_id brand_id, i_brand brand, sum(ss_ext_sales_price) ext_price
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk
+  and ss_item_sk = i_item_sk
+  and i_manager_id = 28
+  and d_moy = 11 and d_year = 1999
+group by i_brand_id, i_brand
+order by ext_price desc, brand_id
+limit 100
+"""
+
+QUERIES["q56"] = """
+with ss as
+ (select i_item_id, sum(ss_ext_sales_price) total_sales
+  from store_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item
+                      where i_color in ('slate', 'blanched', 'burnished'))
+    and ss_item_sk = i_item_sk
+    and ss_sold_date_sk = d_date_sk
+    and d_year = 2001 and d_moy = 2
+    and ss_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_item_id),
+ cs as
+ (select i_item_id, sum(cs_ext_sales_price) total_sales
+  from catalog_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item
+                      where i_color in ('slate', 'blanched', 'burnished'))
+    and cs_item_sk = i_item_sk
+    and cs_sold_date_sk = d_date_sk
+    and d_year = 2001 and d_moy = 2
+    and cs_ship_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_item_id),
+ ws as
+ (select i_item_id, sum(ws_ext_sales_price) total_sales
+  from web_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item
+                      where i_color in ('slate', 'blanched', 'burnished'))
+    and ws_item_sk = i_item_sk
+    and ws_sold_date_sk = d_date_sk
+    and d_year = 2001 and d_moy = 2
+    and ws_bill_customer_sk in
+        (select c_customer_sk from customer
+         where c_current_addr_sk = ca_address_sk)
+    and ca_gmt_offset = -5
+  group by i_item_id)
+select i_item_id, sum(total_sales) total_sales
+from (select * from ss
+      union all
+      select * from cs
+      union all
+      select * from ws) tmp1
+group by i_item_id
+order by total_sales, i_item_id
+limit 100
+"""
+
+QUERIES["q59"] = """
+with wss as
+ (select d_week_seq, ss_store_sk,
+         sum(case when (d_day_name = 'Sunday') then ss_sales_price
+                  else null end) sun_sales,
+         sum(case when (d_day_name = 'Monday') then ss_sales_price
+                  else null end) mon_sales,
+         sum(case when (d_day_name = 'Tuesday') then ss_sales_price
+                  else null end) tue_sales,
+         sum(case when (d_day_name = 'Wednesday') then ss_sales_price
+                  else null end) wed_sales,
+         sum(case when (d_day_name = 'Thursday') then ss_sales_price
+                  else null end) thu_sales,
+         sum(case when (d_day_name = 'Friday') then ss_sales_price
+                  else null end) fri_sales,
+         sum(case when (d_day_name = 'Saturday') then ss_sales_price
+                  else null end) sat_sales
+  from store_sales, date_dim
+  where d_date_sk = ss_sold_date_sk
+  group by d_week_seq, ss_store_sk)
+select s_store_name1, s_store_id1, d_week_seq1,
+       sun_sales1 / sun_sales2, mon_sales1 / mon_sales2,
+       tue_sales1 / tue_sales2, wed_sales1 / wed_sales2,
+       thu_sales1 / thu_sales2, fri_sales1 / fri_sales2,
+       sat_sales1 / sat_sales2
+from
+ (select s_store_name s_store_name1, wss.d_week_seq d_week_seq1,
+         s_store_id s_store_id1, sun_sales sun_sales1,
+         mon_sales mon_sales1, tue_sales tue_sales1, wed_sales wed_sales1,
+         thu_sales thu_sales1, fri_sales fri_sales1, sat_sales sat_sales1
+  from wss, store, date_dim d
+  where d.d_week_seq = wss.d_week_seq and ss_store_sk = s_store_sk
+    and d_month_seq between 1200 and 1200 + 11) y,
+ (select s_store_name s_store_name2, wss.d_week_seq d_week_seq2,
+         s_store_id s_store_id2, sun_sales sun_sales2,
+         mon_sales mon_sales2, tue_sales tue_sales2, wed_sales wed_sales2,
+         thu_sales thu_sales2, fri_sales fri_sales2, sat_sales sat_sales2
+  from wss, store, date_dim d
+  where d.d_week_seq = wss.d_week_seq and ss_store_sk = s_store_sk
+    and d_month_seq between 1200 + 12 and 1200 + 23) x
+where s_store_id1 = s_store_id2
+  and d_week_seq1 = d_week_seq2 - 52
+order by s_store_name1, s_store_id1, d_week_seq1
+limit 100
+"""
+
+QUERIES["q60"] = """
+with ss as
+ (select i_item_id, sum(ss_ext_sales_price) total_sales
+  from store_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item
+                      where i_category in ('Music'))
+    and ss_item_sk = i_item_sk
+    and ss_sold_date_sk = d_date_sk
+    and d_year = 1998 and d_moy = 9
+    and ss_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_item_id),
+ cs as
+ (select i_item_id, sum(cs_ext_sales_price) total_sales
+  from catalog_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item
+                      where i_category in ('Music'))
+    and cs_item_sk = i_item_sk
+    and cs_sold_date_sk = d_date_sk
+    and d_year = 1998 and d_moy = 9
+    and cs_ship_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_item_id),
+ ws as
+ (select i_item_id, sum(ws_ext_sales_price) total_sales
+  from web_sales, date_dim, customer_address, item
+  where i_item_id in (select i_item_id from item
+                      where i_category in ('Music'))
+    and ws_item_sk = i_item_sk
+    and ws_sold_date_sk = d_date_sk
+    and d_year = 1998 and d_moy = 9
+    and ws_bill_customer_sk in
+        (select c_customer_sk from customer
+         where c_current_addr_sk = ca_address_sk)
+    and ca_gmt_offset = -5
+  group by i_item_id)
+select i_item_id, sum(total_sales) total_sales
+from (select * from ss
+      union all
+      select * from cs
+      union all
+      select * from ws) tmp1
+group by i_item_id
+order by i_item_id, total_sales
+limit 100
+"""
+
+QUERIES["q63"] = """
+select * from
+ (select i_manager_id, sum(ss_sales_price) sum_sales,
+         avg(sum(ss_sales_price)) over (partition by i_manager_id)
+             avg_monthly_sales
+  from item, store_sales, date_dim, store
+  where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+    and ss_store_sk = s_store_sk
+    and d_month_seq in (1200, 1201, 1202, 1203, 1204, 1205, 1206, 1207,
+                        1208, 1209, 1210, 1211)
+    and ((i_category in ('Books', 'Children', 'Electronics')
+          and i_class in ('class1', 'class2', 'class3'))
+         or (i_category in ('Women', 'Music', 'Men')
+             and i_class in ('class4', 'class5', 'class6')))
+  group by i_manager_id, d_moy) tmp1
+where case when avg_monthly_sales > 0
+           then abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           else null end > 0.1
+order by i_manager_id, avg_monthly_sales, sum_sales
+limit 100
+"""
+
+QUERIES["q65"] = """
+select s_store_name, i_item_desc, sc.revenue, i_current_price,
+       i_wholesale_cost, i_brand
+from store, item,
+     (select ss_store_sk, avg(revenue) as ave
+      from (select ss_store_sk, ss_item_sk, sum(ss_sales_price) as revenue
+            from store_sales, date_dim
+            where ss_sold_date_sk = d_date_sk
+              and d_month_seq between 1176 and 1176 + 11
+            group by ss_store_sk, ss_item_sk) sa
+      group by ss_store_sk) sb,
+     (select ss_store_sk, ss_item_sk, sum(ss_sales_price) as revenue
+      from store_sales, date_dim
+      where ss_sold_date_sk = d_date_sk
+        and d_month_seq between 1176 and 1176 + 11
+      group by ss_store_sk, ss_item_sk) sc
+where sb.ss_store_sk = sc.ss_store_sk
+  and sc.revenue <= 0.1 * sb.ave
+  and s_store_sk = sc.ss_store_sk
+  and i_item_sk = sc.ss_item_sk
+order by s_store_name, i_item_desc, sc.revenue
+limit 100
+"""
+
+QUERIES["q68"] = """
+select c_last_name, c_first_name, ca_city, bought_city, ss_ticket_number,
+       extended_price, extended_tax, list_price
+from (select ss_ticket_number, ss_customer_sk, ca_city bought_city,
+             sum(ss_ext_sales_price) extended_price,
+             sum(ss_ext_list_price) list_price,
+             sum(ss_ext_discount_amt) extended_tax
+      from store_sales, date_dim, store, household_demographics,
+           customer_address
+      where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        and store_sales.ss_store_sk = store.s_store_sk
+        and store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        and store_sales.ss_addr_sk = customer_address.ca_address_sk
+        and date_dim.d_dom between 1 and 2
+        and (household_demographics.hd_dep_count = 4
+             or household_demographics.hd_vehicle_count = 3)
+        and date_dim.d_year in (1999, 2000, 2001)
+        and store.s_city in ('Midway', 'Fairview')
+      group by ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city) dn,
+     customer, customer_address current_addr
+where ss_customer_sk = c_customer_sk
+  and customer.c_current_addr_sk = current_addr.ca_address_sk
+  and current_addr.ca_city <> bought_city
+order by c_last_name, ss_ticket_number
+limit 100
+"""
+
+QUERIES["q69"] = """
+select cd_gender, cd_marital_status, cd_education_status, count(*) cnt1,
+       cd_purchase_estimate, count(*) cnt2, cd_credit_rating, count(*) cnt3
+from customer c, customer_address ca, customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+  and ca_state in ('KY', 'GA', 'NM')
+  and cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select * from store_sales, date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk
+                and d_year = 2001 and d_moy between 4 and 6)
+  and (not exists (select * from web_sales, date_dim
+                   where c.c_customer_sk = ws_bill_customer_sk
+                     and ws_sold_date_sk = d_date_sk
+                     and d_year = 2001 and d_moy between 4 and 6)
+       and not exists (select * from catalog_sales, date_dim
+                       where c.c_customer_sk = cs_ship_customer_sk
+                         and cs_sold_date_sk = d_date_sk
+                         and d_year = 2001 and d_moy between 4 and 6))
+group by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating
+order by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating
+limit 100
+"""
+
+QUERIES["q73"] = """
+select c_last_name, c_first_name, c_salutation, c_preferred_cust_flag,
+       ss_ticket_number, cnt
+from (select ss_ticket_number, ss_customer_sk, count(*) cnt
+      from store_sales, date_dim, store, household_demographics
+      where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        and store_sales.ss_store_sk = store.s_store_sk
+        and store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        and date_dim.d_dom between 1 and 2
+        and (household_demographics.hd_buy_potential = '>10000'
+             or household_demographics.hd_buy_potential = 'Unknown')
+        and household_demographics.hd_vehicle_count > 0
+        and case when household_demographics.hd_vehicle_count > 0
+                 then household_demographics.hd_dep_count /
+                      household_demographics.hd_vehicle_count
+                 else null end > 1
+        and date_dim.d_year in (1999, 2000, 2001)
+        and store.s_county in ('Rush County', 'Toole County',
+                               'Jefferson County', 'Dona Ana County')
+      group by ss_ticket_number, ss_customer_sk) dj, customer
+where ss_customer_sk = c_customer_sk
+  and cnt between 1 and 5
+order by cnt desc, c_last_name asc
+"""
+
+QUERIES["q86"] = """
+select sum(ws_net_paid) as total_sum, i_category, i_class,
+       grouping(i_category) + grouping(i_class) as lochierarchy,
+       rank() over (partition by grouping(i_category) + grouping(i_class),
+                    case when grouping(i_class) = 0 then i_category end
+                    order by sum(ws_net_paid) desc) as rank_within_parent
+from web_sales, date_dim d1, item
+where d1.d_month_seq between 1200 and 1200 + 11
+  and d1.d_date_sk = ws_sold_date_sk
+  and i_item_sk = ws_item_sk
+group by rollup(i_category, i_class)
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category end,
+         rank_within_parent
+limit 100
+"""
+
+QUERIES["q89"] = """
+select * from
+ (select i_category, i_class, i_brand, s_store_name, s_store_id,
+         d_moy, sum(ss_sales_price) sum_sales,
+         avg(sum(ss_sales_price)) over (partition by i_category, i_brand,
+                                        s_store_name, s_store_id)
+             avg_monthly_sales
+  from item, store_sales, date_dim, store
+  where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+    and ss_store_sk = s_store_sk
+    and d_year in (1999)
+    and ((i_category in ('Books', 'Electronics', 'Sports')
+          and i_class in ('class1', 'class2', 'class3'))
+        or (i_category in ('Men', 'Jewelry', 'Women')
+            and i_class in ('class4', 'class5', 'class6')))
+  group by i_category, i_class, i_brand, s_store_name, s_store_id,
+           d_moy) tmp1
+where case when avg_monthly_sales <> 0
+           then abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           else null end > 0.1
+order by sum_sales - avg_monthly_sales, s_store_name
+limit 100
+"""
+
+QUERIES["q92"] = """
+select sum(ws_ext_discount_amt) as excess_discount_amount
+from web_sales ws0, item, date_dim
+where i_manufact_id = 150
+  and i_item_sk = ws0.ws_item_sk
+  and d_date between cast('2000-01-27' as date)
+                 and (cast('2000-01-27' as date) + interval 90 day)
+  and d_date_sk = ws0.ws_sold_date_sk
+  and ws0.ws_ext_discount_amt >
+      (select 1.3 * avg(ws_ext_discount_amt)
+       from web_sales ws2, date_dim d2
+       where ws2.ws_item_sk = ws0.ws_item_sk
+         and d2.d_date between cast('2000-01-27' as date)
+                          and (cast('2000-01-27' as date) + interval 90 day)
+         and d2.d_date_sk = ws2.ws_sold_date_sk)
+order by excess_discount_amount
+limit 100
+"""
+
+QUERIES["q98"] = """
+select i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(ss_ext_sales_price) as itemrevenue,
+       sum(ss_ext_sales_price) * 100 /
+       sum(sum(ss_ext_sales_price)) over (partition by i_class)
+           as revenueratio
+from store_sales, item, date_dim
+where ss_item_sk = i_item_sk
+  and i_category in ('Sports', 'Books', 'Home')
+  and ss_sold_date_sk = d_date_sk
+  and d_date between cast('1999-02-22' as date)
+                 and (cast('1999-02-22' as date) + interval 30 day)
+group by i_item_id, i_item_desc, i_category, i_class, i_current_price
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio
+"""
