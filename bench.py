@@ -182,6 +182,13 @@ def main():
     signal.alarm(0)          # quiesce while the payload is swapped
     _PAYLOAD.clear()
     _PAYLOAD.update(out)
+    # ALSO snapshot it NOW to STDERR: SIGALRM delivery can be starved by a
+    # native call holding the GIL (a PJRT executable load); if the
+    # driver's outer timeout then kills the process, the merged-stream
+    # tail still carries this snapshot.  STDOUT keeps the one-line
+    # contract: exactly one JSON line per successful run, printed last.
+    sys.stderr.write(json.dumps(out) + "\n")
+    sys.stderr.flush()
     _arm(_remaining())
 
     if os.environ.get("BENCH_SKIP_TPCDS", "") != "1" and _remaining() > 45:
@@ -243,10 +250,11 @@ def _tpcds_phase(tpu, cpu, res: dict):
     from spark_rapids_tpu.testing.rowcompare import rows_equal
     from spark_rapids_tpu.testing.tpcds import register_tables
     from spark_rapids_tpu.testing.tpcds_queries import QUERIES
-    # SF 1 (5x round-4's 0.2): the CPU oracle's work grows linearly while
-    # the device is latency-flat at these sizes, so the ratio reflects
-    # engine throughput, not tunnel round trips
-    sf = float(os.environ.get("BENCH_TPCDS_SF", 1.0))
+    # SF 0.2: every implemented query returns rows here, and the persistent
+    # compile cache covers these shapes (each REMOTE compile costs 30-900s
+    # on the tunnel — a higher SF's fresh shapes would spend the whole
+    # budget in the compiler; raise via BENCH_TPCDS_SF once primed)
+    sf = float(os.environ.get("BENCH_TPCDS_SF", 0.2))
     storage = os.environ.get("BENCH_TPCDS_STORAGE", "parquet")
     per_query = {}
     speedups = []
@@ -311,6 +319,12 @@ def _tpcds_phase(tpu, cpu, res: dict):
                            len(speedups)) if speedups else 0.0
         res["geomean_speedup"] = round(geomean, 3)
         res["queries_counted"] = len(speedups)
+        # refresh the STDERR tail after every finished query: a hard
+        # kill (outer timeout during a GIL-held compile/load) leaves the
+        # most complete snapshot as the merged-stream tail, while stdout
+        # keeps its one-line contract
+        sys.stderr.write(json.dumps(_PAYLOAD) + "\n")
+        sys.stderr.flush()
     return res
 
 

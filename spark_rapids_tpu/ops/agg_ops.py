@@ -531,15 +531,6 @@ def keyed_agg_trace(cols, sel, num_keys, specs, bucket, jnp):
 _COLLECT_CACHE: Dict[Tuple, object] = {}
 
 
-def segmented_collect(batch: ColumnarBatch, num_keys: int, value_ord: int,
-                      distinct: bool):
-    """Collects ONE value column per group into a device array column —
-    see segmented_collect_many (the multi-slot form that batches the
-    max-width sync)."""
-    return segmented_collect_many(batch, num_keys,
-                                  [(value_ord, distinct)])[0]
-
-
 def segmented_collect_many(batch: ColumnarBatch, num_keys: int,
                            slots):
     """Collects several value columns per group into device array

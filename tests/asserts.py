@@ -22,6 +22,14 @@ def tpu_session(extra=None) -> TpuSession:
     conf = {"spark.rapids.sql.enabled": "true",
             "spark.rapids.sql.test.enabled": "true"}
     conf.update(extra or {})
+    # debugging hook: SRT_TEST_EXTRA_CONF='{"key": "value"}' overlays the
+    # TPU session conf of every differential test (bisecting an
+    # order-dependent failure against a feature toggle)
+    import json
+    import os
+    env_extra = os.environ.get("SRT_TEST_EXTRA_CONF")
+    if env_extra:
+        conf.update(json.loads(env_extra))
     return TpuSession(TpuConf(conf))
 
 
@@ -30,7 +38,17 @@ from spark_rapids_tpu.testing.rowcompare import rows_equal, val_eq as _val_eq
 
 def _compare_rows(expected_rows, actual_rows, check_order, approx_float,
                   labels=("expected", "actual")):
+    import os
     diff = rows_equal(expected_rows, actual_rows, check_order, approx_float)
+    if diff is not None and os.environ.get("SRT_TEST_DUMP_ON_DIFF"):
+        # debugging hook: full row sets for order-dependent mismatches
+        import sys
+        print(f"\n--- {labels[0]} rows ---", file=sys.stderr)
+        for r in expected_rows[:50]:
+            print(r, file=sys.stderr)
+        print(f"--- {labels[1]} rows ---", file=sys.stderr)
+        for r in actual_rows[:50]:
+            print(r, file=sys.stderr)
     assert diff is None, f"({labels[0]} vs {labels[1]}) {diff}"
 
 
