@@ -136,7 +136,17 @@ def main():
             best = min(best, time.perf_counter() - t0)
         return best, result
 
-    tpu = TpuSession(TpuConf({"spark.rapids.sql.enabled": "true"}))
+    try:
+        tpu = TpuSession(TpuConf({"spark.rapids.sql.enabled": "true"}))
+    except Exception as e:  # noqa: BLE001 — device backend unavailable
+        # (tunnel down / misconfigured): record an honest error line
+        # instead of dying output-less; only session INIT is wrapped so a
+        # genuine engine failure during measurement keeps its own face
+        signal.alarm(0)
+        _PAYLOAD["error"] = \
+            f"device backend unavailable: {type(e).__name__}: {e}"[:300]
+        print(json.dumps(_PAYLOAD))
+        return 1
     best_tpu, r_tpu = measure(tpu, warmups=2, runs=reps)
 
     cpu = TpuSession(TpuConf({"spark.rapids.sql.enabled": "false"}),
