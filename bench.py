@@ -148,6 +148,11 @@ def main():
         print(json.dumps(_PAYLOAD))
         return 1
     best_tpu, r_tpu = measure(tpu, warmups=2, runs=reps)
+    # per-query attribution of the LAST timed device run (query-scoped
+    # tracing): node-level rows/batches/opTime plus spill/retry/semaphore
+    # totals, so this payload is attributable, not just a wall-clock
+    from spark_rapids_tpu.aux.tracing import last_query_summary
+    tpu_query_metrics = _compact_summary(last_query_summary())
 
     cpu = TpuSession(TpuConf({"spark.rapids.sql.enabled": "false"}),
                      init_device=False)
@@ -188,6 +193,8 @@ def main():
         "cpu_s": round(best_cpu, 4),
         "results_match": True,
     }
+    if tpu_query_metrics:
+        out["query_metrics"] = tpu_query_metrics
     # primary number exists: from here on the failsafe prints it verbatim
     signal.alarm(0)          # quiesce while the payload is swapped
     _PAYLOAD.clear()
@@ -243,6 +250,25 @@ def main():
     signal.alarm(0)
     print(json.dumps(out))
     return 0
+
+
+def _compact_summary(qm, max_nodes: int = 8):
+    """Trims a tracing query summary for the one-line payload: the
+    query-level counters plus the top-opTime nodes."""
+    if not qm:
+        return None
+    out = {k: qm[k] for k in (
+        "query_id", "duration_s", "tasks", "spill_count", "spill_bytes",
+        "retry_count", "split_retry_count", "oom_count",
+        "semaphore_wait_s", "max_device_bytes") if k in qm}
+    nodes = sorted(qm.get("nodes", []),
+                   key=lambda n: n.get("opTime", 0), reverse=True)
+    out["nodes"] = [
+        {k: n[k] for k in ("node", "numOutputRows", "numOutputBatches",
+                           "opTime", "spill_bytes", "retry_count")
+         if k in n}
+        for n in nodes[:max_nodes]]
+    return out
 
 
 def _tpcds_phase(tpu, cpu, res: dict):
@@ -307,6 +333,8 @@ def _tpcds_phase(tpu, cpu, res: dict):
         t0 = time.perf_counter()
         t_rows = tpu.sql(sql).collect()
         t_tpu = time.perf_counter() - t0
+        from spark_rapids_tpu.aux.tracing import last_query_summary
+        qsum = last_query_summary() or {}
         t0 = time.perf_counter()              # one pass: result + timing
         c_rows = cpu.sql(sql).collect()
         t_cpu = time.perf_counter() - t0
@@ -318,6 +346,13 @@ def _tpcds_phase(tpu, cpu, res: dict):
                             "speedup": round(t_cpu / t_tpu, 3),
                             "rows": len(t_rows),
                             "match": match}
+        # attribution: only the nonzero pressure counters, kept compact
+        attrib = {k: qsum[k] for k in (
+            "tasks", "spill_count", "spill_bytes", "retry_count",
+            "split_retry_count", "oom_count", "semaphore_wait_s")
+            if qsum.get(k)}
+        if attrib:
+            per_query[qname]["metrics"] = attrib
         if not match:
             per_query[qname]["diff"] = diff[:160]
         if len(t_rows) == 0:

@@ -1,10 +1,16 @@
 """Auxiliary subsystems (SURVEY.md §2.13/§5): op metrics with verbosity
-levels, profiler trace ranges, debug batch dumps, execution-plan capture,
-and the cost-based optimizer's helpers."""
+levels, the query-scoped tracing/event subsystem (span tree, event log,
+Prometheus exposition), profiler trace ranges, debug batch dumps,
+execution-plan capture, and the cost-based optimizer's helpers."""
 
-from spark_rapids_tpu.aux.capture import (  # noqa: F401
-    ExecutionPlanCaptureCallback)
-from spark_rapids_tpu.aux.metrics import (  # noqa: F401
-    MetricLevel, OpMetric, collect_metrics, instrument_plan)
+from spark_rapids_tpu.aux.events import (  # noqa: F401
+    Event, EventSink, JsonlEventLogSink, RingBufferSink, emit,
+    parse_event_line, render_prometheus)
 from spark_rapids_tpu.aux.profiler import (  # noqa: F401
     Profiler, op_range)
+from spark_rapids_tpu.aux.metrics import (  # noqa: F401
+    MetricLevel, OpMetric, collect_metrics, instrument_plan, reset_metrics)
+from spark_rapids_tpu.aux.tracing import (  # noqa: F401
+    QueryExecution, Span, last_query_summary, query_scope)
+from spark_rapids_tpu.aux.capture import (  # noqa: F401
+    ExecutionPlanCaptureCallback)

@@ -1,0 +1,278 @@
+"""Query event bus + pluggable sinks.
+
+Reference: the Spark event log (SparkListenerEvent JSON lines consumed by
+the history server) crossed with the plugin's accumulators — the reference
+surfaces semaphore/retry/spill via GpuTaskMetrics and NVTX; here every
+layer emits a typed ``Event`` through one process-wide bus:
+
+- ``emit(kind, **payload)`` is the single hook the memory / shuffle /
+  task layers call.  It is zero-cost when nothing listens: one contextvar
+  read when no ``QueryExecution`` is active and no global sink is
+  registered.
+- Events route to the active query's ring buffer + sinks (the query id
+  and span id are stamped there), or to process-global sinks for
+  daemon-thread emitters that run outside any query (heartbeats,
+  shuffle workers).
+
+Sinks: ``JsonlEventLogSink`` (the event-log file analog, conf
+``spark.rapids.sql.eventLog.path``), ``RingBufferSink`` (in-memory, for
+tests and ``explain(analyze=True)``), and ``render_prometheus()`` — a
+text exposition of the registry's gauges/counters for scrapers.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextvars
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+EVENT_SCHEMA_VERSION = 1
+
+#: stamped on events emitted outside any query / span scope
+NO_QUERY = -1
+NO_SPAN = -1
+
+
+@dataclasses.dataclass
+class Event:
+    """One observability record.  ``ts`` is ``time.monotonic()`` — event
+    ordering within a query is meaningful, wall-clock is not."""
+    kind: str
+    query_id: int
+    span_id: int
+    ts: float
+    payload: Dict
+
+    def to_json(self) -> str:
+        return json.dumps({"event": self.kind, "query_id": self.query_id,
+                           "span_id": self.span_id, "ts": self.ts,
+                           "v": EVENT_SCHEMA_VERSION, **self.payload},
+                          default=str)
+
+
+def parse_event_line(line: str) -> Event:
+    """Inverse of ``Event.to_json`` (the round-trip contract the event-log
+    schema test pins): raises on lines missing the required envelope."""
+    d = json.loads(line)
+    kind = d.pop("event")
+    query_id = d.pop("query_id")
+    span_id = d.pop("span_id")
+    ts = d.pop("ts")
+    d.pop("v", None)
+    return Event(kind, query_id, span_id, ts, d)
+
+
+# ---------------------------------------------------------------------------
+# sinks
+# ---------------------------------------------------------------------------
+
+class EventSink:
+    def emit(self, event: Event) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class RingBufferSink(EventSink):
+    """Bounded in-memory sink (tests / explain(analyze)); drops oldest
+    beyond ``capacity`` and counts the drops — a truncated buffer must
+    never read as complete."""
+
+    def __init__(self, capacity: int = 2048):
+        self._buf = collections.deque(maxlen=max(1, int(capacity)))
+        self._lock = threading.Lock()
+        self.dropped = 0
+
+    def emit(self, event: Event) -> None:
+        with self._lock:
+            if len(self._buf) == self._buf.maxlen:
+                self.dropped += 1
+            self._buf.append(event)
+
+    def events(self) -> List[Event]:
+        with self._lock:
+            return list(self._buf)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+
+class JsonlEventLogSink(EventSink):
+    """Appends one JSON object per event to ``path`` (Spark event-log
+    analog; multiple queries interleave lines, keyed by ``query_id``)."""
+
+    #: events between fsync-visible flushes; writes themselves are
+    #: buffered memcpys, so emitters (which may hold the query or
+    #: catalog lock) only pay disk latency once per batch
+    FLUSH_EVERY = 64
+
+    def __init__(self, path: str):
+        self.path = path
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._lock = threading.Lock()
+        self._f = open(path, "a", encoding="utf-8")
+        self._unflushed = 0
+
+    def emit(self, event: Event) -> None:
+        with self._lock:
+            if self._f.closed:
+                return
+            self._f.write(event.to_json() + "\n")
+            self._unflushed += 1
+            if self._unflushed >= self.FLUSH_EVERY:
+                self._f.flush()
+                self._unflushed = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+
+# ---------------------------------------------------------------------------
+# routing: active query (contextvar) + per-thread span stack + global sinks
+# ---------------------------------------------------------------------------
+
+_ACTIVE: "contextvars.ContextVar[Optional[object]]" = contextvars.ContextVar(
+    "srt_active_query", default=None)
+
+
+def active_query():
+    """The QueryExecution the calling context runs under, or None.
+    Task-pool threads see the right query because iter_partition_tasks
+    copies the submitting thread's context (plan/base.py)."""
+    return _ACTIVE.get()
+
+
+def _activate(query):
+    return _ACTIVE.set(query)
+
+
+def _deactivate(token) -> None:
+    _ACTIVE.reset(token)
+
+
+class _SpanStack(threading.local):
+    def __init__(self):
+        self.stack: List[int] = []
+
+
+_SPANS = _SpanStack()
+
+
+def push_span(span_id: int) -> None:
+    """Marks the calling thread as executing inside ``span_id`` — events
+    emitted deeper in the call stack (a spill inside a kernel staging
+    alloc) attribute to the operator that triggered them."""
+    _SPANS.stack.append(span_id)
+
+
+def pop_span() -> None:
+    if _SPANS.stack:
+        _SPANS.stack.pop()
+
+
+def current_span_id() -> Optional[int]:
+    st = _SPANS.stack
+    return st[-1] if st else None
+
+
+_GLOBAL_SINKS: List[EventSink] = []
+_GLOBAL_LOCK = threading.Lock()
+
+
+def add_global_sink(sink: EventSink) -> None:
+    """Receives events emitted OUTSIDE any query context (heartbeat
+    threads, shuffle worker processes)."""
+    with _GLOBAL_LOCK:
+        _GLOBAL_SINKS.append(sink)
+
+
+def remove_global_sink(sink: EventSink) -> None:
+    with _GLOBAL_LOCK:
+        if sink in _GLOBAL_SINKS:
+            _GLOBAL_SINKS.remove(sink)
+
+
+def emit(kind: str, **payload) -> None:
+    """The one hook every layer calls.  No active query and no global
+    sink = no allocation, no lock."""
+    q = _ACTIVE.get()
+    if q is not None:
+        q.record_event(kind, payload)
+        return
+    if _GLOBAL_SINKS:
+        ev = Event(kind, NO_QUERY, current_span_id() or NO_SPAN,
+                   time.monotonic(), payload)
+        with _GLOBAL_LOCK:
+            sinks = list(_GLOBAL_SINKS)
+        for s in sinks:
+            s.emit(ev)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus-style exposition of the process-wide registries
+# ---------------------------------------------------------------------------
+
+def render_prometheus() -> str:
+    """Text exposition of the runtime's gauges/counters (catalog tiers,
+    task-metric accumulators, semaphore, operator ranges) in the
+    Prometheus format a scraper or test can parse."""
+    lines: List[str] = []
+
+    def add(name: str, mtype: str, value, help_text: str) -> None:
+        full = f"spark_rapids_tpu_{name}"
+        lines.append(f"# HELP {full} {help_text}")
+        lines.append(f"# TYPE {full} {mtype}")
+        lines.append(f"{full} {value}")
+
+    from spark_rapids_tpu.memory.device_manager import get_runtime
+    rt = get_runtime()
+    if rt is not None:
+        st = rt.catalog.stats()
+        add("device_pool_bytes", "gauge", st["device_bytes"],
+            "Catalog-tracked device bytes")
+        add("device_pool_limit_bytes", "gauge", st["device_limit"],
+            "Device pool budget")
+        add("host_spill_bytes", "gauge", st["host_bytes"],
+            "Catalog-tracked host-tier bytes")
+        add("disk_spill_bytes", "gauge", st["disk_bytes"],
+            "Catalog-tracked disk-tier bytes")
+        add("catalog_buffers", "gauge", st["buffers"],
+            "Live buffers in the catalog")
+        add("spill_total", "counter", st["spill_count"],
+            "Buffers pushed down a storage tier")
+        total, finished = rt.metrics.snapshot()
+        add("tasks_finished_total", "counter", finished,
+            "Tasks reported to the metrics registry")
+        add("retry_total", "counter", total.retry_count,
+            "RetryOOM attempts across tasks")
+        add("split_retry_total", "counter", total.split_retry_count,
+            "SplitAndRetryOOM splits across tasks")
+        add("oom_total", "counter", total.oom_count,
+            "Device pool exhaustions signalled to tasks")
+        add("task_spill_bytes_total", "counter", total.spill_bytes,
+            "Bytes spilled attributed to tasks")
+        add("semaphore_wait_seconds_total", "counter",
+            round(total.semaphore_wait_seconds, 6),
+            "Seconds tasks blocked on device admission")
+        add("semaphore_max_concurrent", "gauge",
+            rt.semaphore.max_concurrent,
+            "Device admission permits (concurrentGpuTasks)")
+    from spark_rapids_tpu.aux import profiler as _prof
+    for op, s in sorted(_prof.range_stats().items()):
+        full = "spark_rapids_tpu_op_range_seconds_total"
+        if f"# TYPE {full} counter" not in lines:
+            lines.append(f"# HELP {full} Wall seconds inside operator "
+                         "ranges")
+            lines.append(f"# TYPE {full} counter")
+        lines.append(f'{full}{{op="{op}"}} {s["total_s"]}')
+    return "\n".join(lines) + "\n"

@@ -5,8 +5,12 @@ with levels ESSENTIAL/MODERATE/DEBUG selected by
 ``spark.rapids.sql.metrics.level``; standard names (opTime,
 numOutputRows, numOutputBatches, ...).
 
-Instrumentation wraps each exec's ``execute_partition`` with counters and
-a wall-clock timer; ``collect_metrics`` renders the tree's totals."""
+Instrumentation wraps each exec's ``execute_partition`` with counters,
+a wall-clock timer, the profiler's operator range (NVTX analog, gated on
+the ranges-enabled flag so the disabled path stays zero-cost) and — when
+a ``QueryExecution`` is active — a per-partition child span so layer
+events attribute to the operator that triggered them.
+``collect_metrics`` renders the tree's totals."""
 
 from __future__ import annotations
 
@@ -14,6 +18,8 @@ import enum
 import time
 from typing import Dict, List, Optional
 
+from spark_rapids_tpu.aux import events as EV
+from spark_rapids_tpu.aux import profiler as _prof
 from spark_rapids_tpu.plan.base import Exec
 
 
@@ -25,9 +31,11 @@ class MetricLevel(enum.IntEnum):
     @staticmethod
     def parse(s: str) -> "MetricLevel":
         try:
-            return MetricLevel[str(s).upper()]
+            return MetricLevel[str(s).strip().upper()]
         except KeyError:
-            return MetricLevel.MODERATE
+            raise ValueError(
+                f"invalid metrics level {s!r}; expected one of "
+                f"{', '.join(MetricLevel.__members__)}") from None
 
 
 # standard metric names (reference GpuExec.scala:49-120) with their levels
@@ -40,15 +48,36 @@ STANDARD_METRICS = {
 
 
 class OpMetric:
-    __slots__ = ("name", "level", "value")
+    __slots__ = ("name", "level", "value", "pending")
 
     def __init__(self, name: str, level: MetricLevel):
         self.name = name
         self.level = level
         self.value = 0
+        #: DeferredCounts observed before they were forced; resolved
+        #: (without a sync) once the query's download forces them
+        self.pending = None
 
     def add(self, v) -> None:
         self.value += v
+
+    def defer(self, count) -> None:
+        if self.pending is None:
+            self.pending = []
+        self.pending.append(count)
+
+    def resolve(self) -> None:
+        """Folds deferred counts the query has since forced into the
+        value; never syncs (unforced counts stay pending)."""
+        if not self.pending:
+            return
+        still = []
+        for c in self.pending:
+            if c.is_forced:
+                self.value += int(c)
+            else:
+                still.append(c)
+        self.pending = still or None
 
     def __repr__(self):
         return f"{self.name}={self.value}"
@@ -63,10 +92,19 @@ def _ensure_metrics(node: Exec, level: MetricLevel) -> Dict[str, OpMetric]:
     return ms
 
 
+_END = object()
+
+
 def instrument_plan(plan: Exec, level: MetricLevel) -> Exec:
     """Wraps every node's execute_partition with metric recording (the
-    GpuMetric counters around internalDoExecuteColumnar)."""
+    GpuMetric counters around internalDoExecuteColumnar).
 
+    Metrics are reset first: plan rewrites shallow-copy nodes but SHARE
+    the metrics dicts, so without the reset repeated actions on the same
+    DataFrame accumulate across queries (the re-run staleness bug) and
+    ``collect_metrics`` / ``explain(analyze=True)`` stop being per-query.
+    """
+    reset_metrics(plan)
     for node in plan.collect_nodes():
         if getattr(node, "_instrumented", False):
             continue
@@ -75,29 +113,71 @@ def instrument_plan(plan: Exec, level: MetricLevel) -> Exec:
             continue
         inner = node.execute_partition
 
-        def wrapped(pidx, _inner=inner, _ms=ms):
-            t0 = time.perf_counter()
+        def wrapped(pidx, _inner=inner, _ms=ms, _name=node.name):
             rows = _ms.get("numOutputRows")
             batches = _ms.get("numOutputBatches")
             optime = _ms.get("opTime")
-            for b in _inner(pidx):
-                if rows is not None:
-                    # deferred device counts must not sync here; count rows
-                    # lazily only when already forced, else count batches
-                    rc = b.row_count
-                    from spark_rapids_tpu.columnar.column import DeferredCount
-                    if not isinstance(rc, DeferredCount) or rc.is_forced:
-                        rows.add(int(rc))
-                if batches is not None:
-                    batches.add(1)
-                if optime is not None:
-                    optime.add(time.perf_counter() - t0)
-                yield b
-                t0 = time.perf_counter()
+            q = EV.active_query()
+            pspan = q.start_partition(id(_ms), pidx) if q is not None \
+                else None
+            it = _inner(pidx)
+            try:
+                while True:
+                    t0 = time.perf_counter()
+                    if pspan is not None:
+                        EV.push_span(pspan.span_id)
+                    try:
+                        # NVTX-range analog around the pull that does this
+                        # operator's work; ranges_enabled() keeps the
+                        # disabled path to one module-global read
+                        if _prof.ranges_enabled():
+                            with _prof.op_range(_name):
+                                b = next(it, _END)
+                        else:
+                            b = next(it, _END)
+                    finally:
+                        if pspan is not None:
+                            EV.pop_span()
+                    if b is _END:
+                        break
+                    dt = time.perf_counter() - t0
+                    if rows is not None:
+                        # deferred device counts must not sync here; track
+                        # them and fold in lazily once the query's own
+                        # download forces them (resolve())
+                        rc = b.row_count
+                        from spark_rapids_tpu.columnar.column import \
+                            DeferredCount
+                        if not isinstance(rc, DeferredCount) or rc.is_forced:
+                            n = int(rc)
+                            rows.add(n)
+                            if pspan is not None:
+                                pspan.rows += n
+                        else:
+                            rows.defer(rc)
+                    if batches is not None:
+                        batches.add(1)
+                    if optime is not None:
+                        optime.add(dt)
+                    if pspan is not None:
+                        pspan.batches += 1
+                    yield b
+            finally:
+                if q is not None and pspan is not None:
+                    q.end_partition(pspan)
 
         node.execute_partition = wrapped
         node._instrumented = True
     return plan
+
+
+def reset_metrics(plan: Exec) -> None:
+    """Zeroes every node's OpMetric counters so the next action reports
+    per-query values (called at query start by ``instrument_plan``)."""
+    for node in plan.collect_nodes():
+        for m in (getattr(node, "metrics", None) or {}).values():
+            m.value = 0
+            m.pending = None
 
 
 def collect_metrics(plan: Exec) -> List[Dict]:
@@ -107,6 +187,8 @@ def collect_metrics(plan: Exec) -> List[Dict]:
     for node in plan.collect_nodes():
         ms = getattr(node, "metrics", None) or {}
         if ms:
+            for m in ms.values():
+                m.resolve()
             out.append({"node": node.node_desc(),
                         **{m.name: round(m.value, 6) if
                            isinstance(m.value, float) else m.value

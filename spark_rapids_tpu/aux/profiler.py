@@ -24,6 +24,12 @@ def set_ranges_enabled(on: bool) -> None:
     _ENABLED = bool(on)
 
 
+def ranges_enabled() -> bool:
+    """Hot-path gate for callers that wrap work in op_range (the exec
+    instrumentation): one module-global read when disabled."""
+    return _ENABLED
+
+
 @contextlib.contextmanager
 def op_range(name: str):
     """NVTX-range analog: annotates the jax trace when profiling and always

@@ -1,0 +1,380 @@
+"""Query-scoped observability: the span tree + metric/event funnel.
+
+Reference: the plugin's per-exec ``GpuMetric`` map + ``GpuTaskMetrics``
+accumulators + the Spark SQL UI's per-query execution graph.  A
+``QueryExecution`` plays the SQLExecution role: it assigns a query id,
+mirrors the physical plan as a span tree (one span per exec node, child
+spans for partitions = tasks), and funnels every existing signal into
+one place —
+
+- ``OpMetric`` counters from ``instrument_plan`` (rows/batches/opTime),
+- ``TaskMetrics`` deltas from the runtime's ``MetricsRegistry``
+  (spill bytes, retry/split-retry/OOM counts, semaphore wait),
+- events emitted by the memory / shuffle layers (``aux.events.emit``),
+  attributed to the operator span whose pull triggered them.
+
+``DataFrame.explain(analyze=True)`` and bench attribution render from
+here; the JSONL event log (``spark.rapids.sql.eventLog.path``) receives
+queryStart / spanMetrics / queryEnd plus every layer event.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.aux import events as EV
+
+_query_ids = itertools.count(1)
+_span_ids = itertools.count(1)
+
+_LAST_LOCK = threading.Lock()
+_LAST_SUMMARY: Optional[dict] = None
+
+
+def last_query_summary() -> Optional[dict]:
+    """Summary dict of the most recently finished query in this process
+    (bench.py embeds this so BENCH_*.json is attributable)."""
+    with _LAST_LOCK:
+        return _LAST_SUMMARY
+
+
+class Span:
+    """One node of the query's span tree.  ``kind`` is ``query`` (root),
+    ``exec`` (one physical plan node) or ``partition`` (one task of an
+    exec node)."""
+
+    __slots__ = ("span_id", "parent_id", "name", "desc", "kind", "device",
+                 "children", "start", "end", "metrics", "rows", "batches",
+                 "pidx")
+
+    def __init__(self, name: str, parent_id: Optional[int] = None,
+                 desc: str = "", kind: str = "exec", device: bool = False,
+                 pidx: Optional[int] = None):
+        self.span_id = next(_span_ids)
+        self.parent_id = parent_id
+        self.name = name
+        self.desc = desc or name
+        self.kind = kind
+        self.device = device
+        self.children: List[Span] = []
+        self.start = time.monotonic()
+        self.end: Optional[float] = None
+        self.metrics: Dict = {}
+        self.rows = 0
+        self.batches = 0
+        self.pidx = pidx
+
+    @property
+    def duration_s(self) -> float:
+        return (self.end if self.end is not None else time.monotonic()) \
+            - self.start
+
+
+#: event kinds folded into per-node attribution at finish
+_ATTR_ZERO = {"spill_count": 0, "spill_bytes": 0, "retry_count": 0,
+              "split_retry_count": 0, "oom_count": 0}
+
+
+class QueryExecution:
+    """Context manager scoping one query (one DataFrame action).
+
+    Entering activates this query for the context (and, through the
+    task pool's contextvar copies, for every task thread of the query);
+    ``attach_plan`` builds the exec-span tree from the physical plan the
+    overrides produced; exiting harvests metrics, emits
+    spanMetrics/queryEnd, and publishes the summary."""
+
+    def __init__(self, description: str = "",
+                 sinks: Optional[List[EV.EventSink]] = None,
+                 ring_size: int = 2048):
+        self.query_id = next(_query_ids)
+        self.description = description
+        self.root = Span("query", kind="query", desc=description or "query")
+        self.ring = EV.RingBufferSink(ring_size)
+        self._sinks = list(sinks or [])
+        self._lock = threading.Lock()
+        #: id(node.metrics) -> exec span.  The metrics dict is the stable
+        #: identity: plan rewrites shallow-copy nodes but SHARE the
+        #: metrics dict, so the instrumentation wrapper (bound to the
+        #: dict) and the attached plan's copies resolve to the same span.
+        self._node_spans: Dict[int, Span] = {}
+        self._span_index: Dict[int, Span] = {self.root.span_id: self.root}
+        self._plan = None
+        self._token = None
+        self._start_snapshot = None
+        self.summary_dict: Optional[dict] = None
+        self.finished = False
+
+    @staticmethod
+    def from_conf(conf=None, description: str = "") -> "QueryExecution":
+        from spark_rapids_tpu import config as C
+        sinks: List[EV.EventSink] = []
+        ring = 2048
+        if conf is not None:
+            path = conf.get(C.EVENT_LOG_PATH.key, "")
+            if path:
+                sinks.append(EV.JsonlEventLogSink(path))
+            ring = conf.get(C.EVENT_LOG_RING_SIZE.key, 2048)
+        return QueryExecution(description, sinks, ring)
+
+    # -- lifecycle -----------------------------------------------------------
+    def __enter__(self) -> "QueryExecution":
+        self._token = EV._activate(self)
+        from spark_rapids_tpu.memory.device_manager import get_runtime
+        rt = get_runtime()
+        self._start_snapshot = rt.metrics.snapshot() if rt is not None \
+            else None
+        self.record_event("queryStart",
+                          {"description": self.description})
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        try:
+            self.finish(error=exc)
+        finally:
+            EV._deactivate(self._token)
+        return False
+
+    # -- span tree -----------------------------------------------------------
+    def attach_plan(self, plan) -> None:
+        """Mirrors the executed physical plan as exec spans.  Re-attaching
+        (a speculation replay re-applies the overrides) rebuilds the tree
+        for the plan that actually runs; already-recorded events keep
+        their span ids and fall back to the root for attribution."""
+        with self._lock:
+            self._plan = plan
+            self._node_spans.clear()
+            self.root.children = []
+            self._span_index = {self.root.span_id: self.root}
+
+            def build(node, parent: Span) -> None:
+                sp = Span(node.name, parent.span_id, desc=node.node_desc(),
+                          device=getattr(node, "is_device", False))
+                parent.children.append(sp)
+                self._span_index[sp.span_id] = sp
+                self._node_spans[id(getattr(node, "metrics", None))] = sp
+                for c in node.children:
+                    build(c, sp)
+
+            build(plan, self.root)
+
+    def start_partition(self, node_key: int, pidx: int) -> Span:
+        """Child span for one partition (task) of an exec node; called by
+        the instrumentation wrapper at generator start."""
+        with self._lock:
+            parent = self._node_spans.get(node_key, self.root)
+            sp = Span(f"partition-{pidx}", parent.span_id,
+                      kind="partition", pidx=pidx)
+            parent.children.append(sp)
+            self._span_index[sp.span_id] = sp
+            return sp
+
+    def end_partition(self, span: Span) -> None:
+        span.end = time.monotonic()
+
+    def events(self) -> List[EV.Event]:
+        return self.ring.events()
+
+    # -- event funnel --------------------------------------------------------
+    def record_event(self, kind: str, payload: dict,
+                     span_id: Optional[int] = None) -> None:
+        with self._lock:
+            sid = span_id if span_id is not None else EV.current_span_id()
+            if sid is None or sid not in self._span_index:
+                sid = self.root.span_id
+            # ts assigned AND delivered under the lock: sink (file) order
+            # is timestamp order, which the event-log schema test pins
+            ev = EV.Event(kind, self.query_id, sid, time.monotonic(),
+                          dict(payload))
+            self.ring.emit(ev)
+            for s in self._sinks:
+                s.emit(ev)
+
+    def _attribute_events(self) -> Dict[int, dict]:
+        """Folds layer events onto their exec span (partition spans roll
+        up to their parent node) for per-node spill/retry columns."""
+        per: Dict[int, dict] = {}
+        for ev in self.ring.events():
+            # span ids orphaned by a replay's attach_plan rebuild fall
+            # back to the root so pressure events still count
+            sp = self._span_index.get(ev.span_id) or self.root
+            if sp.kind == "partition":
+                sp = self._span_index.get(sp.parent_id, self.root)
+            if sp.kind == "query" and ev.kind not in ("spill", "retryOOM",
+                                                      "splitRetry", "oom"):
+                continue
+            d = per.setdefault(sp.span_id, dict(_ATTR_ZERO))
+            if ev.kind == "spill":
+                d["spill_count"] += 1
+                d["spill_bytes"] += int(ev.payload.get("bytes", 0))
+            elif ev.kind == "retryOOM":
+                d["retry_count"] += 1
+            elif ev.kind == "splitRetry":
+                d["split_retry_count"] += 1
+            elif ev.kind == "oom":
+                d["oom_count"] += 1
+        return per
+
+    # -- finish / summary ----------------------------------------------------
+    def finish(self, error=None) -> dict:
+        if self.finished:
+            return self.summary_dict
+        self.finished = True
+        now = time.monotonic()
+        # harvest final OpMetric values into the exec spans
+        plan = self._plan
+        if plan is not None:
+            with self._lock:
+                node_spans = dict(self._node_spans)
+            for node in plan.collect_nodes():
+                ms = getattr(node, "metrics", None) or {}
+                sp = node_spans.get(id(ms))
+                if sp is None:
+                    continue
+                sp.end = now
+                for m in ms.values():
+                    m.resolve()
+                sp.metrics = {m.name: (round(m.value, 6)
+                                       if isinstance(m.value, float)
+                                       else m.value)
+                              for m in ms.values()}
+        attr = self._attribute_events()
+        # per-query TaskMetrics delta from the process registry
+        delta = {}
+        from spark_rapids_tpu.memory.device_manager import get_runtime
+        rt = get_runtime()
+        if rt is not None and self._start_snapshot is not None:
+            total, finished = rt.metrics.snapshot()
+            t0, f0 = self._start_snapshot
+            delta = {
+                "tasks": finished - f0,
+                "retry_count": total.retry_count - t0.retry_count,
+                "split_retry_count":
+                    total.split_retry_count - t0.split_retry_count,
+                "oom_count": total.oom_count - t0.oom_count,
+                "spill_count": total.spill_count - t0.spill_count,
+                "spill_bytes": total.spill_bytes - t0.spill_bytes,
+                "semaphore_wait_s": round(
+                    total.semaphore_wait_seconds
+                    - t0.semaphore_wait_seconds, 6),
+                # max cannot be snapshot-subtracted like the counters;
+                # take THIS query's peak from its tasks' taskEnd events
+                "max_device_bytes": max(
+                    (int(ev.payload.get("max_device_bytes", 0))
+                     for ev in self.ring.events()
+                     if ev.kind == "taskEnd"), default=0),
+            }
+        self.root.end = now
+        nodes = []
+        for sp in self._exec_spans():
+            row = {"span_id": sp.span_id, "node": sp.name,
+                   "desc": sp.desc[:120], **sp.metrics}
+            extra = attr.get(sp.span_id)
+            if extra:
+                row.update({k: v for k, v in extra.items() if v})
+            nodes.append(row)
+            self.record_event("spanMetrics", row, span_id=sp.span_id)
+        summary = {
+            "query_id": self.query_id,
+            "description": self.description,
+            "status": "error" if error is not None else "ok",
+            "duration_s": round(self.root.duration_s, 6),
+            "events": len(self.ring) + self.ring.dropped,
+            "events_dropped": self.ring.dropped,
+            **delta,
+            "nodes": nodes,
+        }
+        self.summary_dict = summary
+        self.record_event("queryEnd",
+                          {k: v for k, v in summary.items()
+                           if k != "nodes"})
+        for s in self._sinks:
+            s.close()
+        global _LAST_SUMMARY
+        with _LAST_LOCK:
+            _LAST_SUMMARY = summary
+        return summary
+
+    def _exec_spans(self) -> List[Span]:
+        out: List[Span] = []
+
+        def walk(sp: Span) -> None:
+            if sp.kind == "exec":
+                out.append(sp)
+            for c in sp.children:
+                walk(c)
+
+        walk(self.root)
+        return out
+
+    # -- rendering -----------------------------------------------------------
+    def render_tree(self, show_partitions: bool = False) -> str:
+        """The EXPLAIN ANALYZE body: the plan tree annotated with
+        rows/batches/opTime (and spill/retry where attributed), plus the
+        query-level summary footer."""
+        attr = self._attribute_events()
+        lines = [f"== Analyzed Plan: query {self.query_id} "
+                 f"{self.description!r} ({self.root.duration_s:.3f}s) =="]
+
+        _SHORT = {"numOutputRows": "rows", "numOutputBatches": "batches",
+                  "opTime": "opTime", "streamTime": "streamTime"}
+
+        def fmt(sp: Span) -> str:
+            bits = []
+            for key, short in _SHORT.items():
+                if key in sp.metrics:
+                    v = sp.metrics[key]
+                    bits.append(f"{short}={v}{'s' if 'Time' in key else ''}")
+            extra = attr.get(sp.span_id) or {}
+            for k, v in extra.items():
+                if v:
+                    bits.append(f"{k}={v}")
+            return f" [{' '.join(bits)}]" if bits else ""
+
+        def walk(sp: Span, indent: int) -> None:
+            if sp.kind == "partition":
+                if not show_partitions:
+                    return
+                lines.append("  " * indent
+                             + f"{sp.name} rows={sp.rows} "
+                             f"batches={sp.batches} "
+                             f"time={sp.duration_s:.4f}s")
+                return
+            mark = "*" if sp.device else " "
+            lines.append("  " * indent + mark + sp.desc + fmt(sp))
+            for c in sp.children:
+                walk(c, indent + 1)
+
+        for c in self.root.children:
+            walk(c, 0)
+        summary = self.summary_dict or {}
+        lines.append("== Query Summary ==")
+        lines.append(" ".join(
+            f"{k}={summary[k]}" for k in
+            ("tasks", "retry_count", "split_retry_count", "oom_count",
+             "spill_count", "spill_bytes", "semaphore_wait_s",
+             "max_device_bytes") if k in summary))
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def query_scope(conf=None, description: str = ""):
+    """Action-level wrapper: opens a QueryExecution unless one is already
+    active (nested actions — cache materialization, explain(analyze) —
+    join the outer query) or tracing is disabled by conf."""
+    active = EV.active_query()
+    if active is not None:
+        yield active
+        return
+    if conf is not None:
+        from spark_rapids_tpu import config as C
+        if not conf.get(C.TRACING_ENABLED.key, True):
+            yield None
+            return
+    qe = QueryExecution.from_conf(conf, description)
+    with qe:
+        yield qe

@@ -103,8 +103,9 @@ def parse_bytes(s) -> int:
     return _bytes_conv(str(s))
 
 
-def conf_str(key, doc, default, level=ConfLevel.COMMONLY_USED) -> ConfEntry[str]:
-    return ConfEntry(key, doc, default, str, level)
+def conf_str(key, doc, default, level=ConfLevel.COMMONLY_USED,
+             checker=None) -> ConfEntry[str]:
+    return ConfEntry(key, doc, default, str, level, checker)
 
 
 def conf_bytes(key, doc, default, level=ConfLevel.COMMONLY_USED) -> ConfEntry[int]:
@@ -436,7 +437,31 @@ SHUFFLE_PARTITIONS = conf_int(
 METRICS_LEVEL = conf_str(
     "spark.rapids.sql.metrics.level",
     "Metric verbosity: ESSENTIAL | MODERATE | DEBUG (reference GpuExec.scala:36).",
-    "MODERATE")
+    "MODERATE",
+    checker=lambda v: str(v).strip().upper() in ("ESSENTIAL", "MODERATE",
+                                                 "DEBUG"))
+
+TRACING_ENABLED = conf_bool(
+    "spark.rapids.tpu.tracing.enabled",
+    "Wrap every DataFrame action in a QueryExecution trace: a span tree "
+    "mirroring the physical plan that funnels operator metrics, task "
+    "metrics and spill/retry/semaphore/shuffle events into one query "
+    "summary (explain(analyze=True), event log, bench attribution).",
+    True)
+
+EVENT_LOG_PATH = conf_str(
+    "spark.rapids.sql.eventLog.path",
+    "When set, every traced query appends its events to this JSONL file "
+    "(Spark event-log analog): one JSON object per line carrying the "
+    "event kind, query_id, span_id and a monotonic timestamp.",
+    "")
+
+EVENT_LOG_RING_SIZE = conf_int(
+    "spark.rapids.sql.eventLog.ringBufferSize",
+    "Events retained per query in the in-memory ring buffer (the "
+    "test/introspection sink); older events beyond it drop and the drop "
+    "count is reported in the query summary.",
+    2048)
 
 STABLE_SORT = conf_bool(
     "spark.rapids.sql.stableSort.enabled",

@@ -121,6 +121,9 @@ class BufferCatalog:
             mt = task_context().metrics
             if mt is not None:
                 mt.oom_count += 1
+            from spark_rapids_tpu.aux.events import emit
+            emit("oom", needed=nbytes, used=self.device_bytes,
+                 limit=self.device_limit, freed=freed)
             raise RetryOOM(
                 f"device pool exhausted: need {nbytes}, used {self.device_bytes}"
                 f"/{self.device_limit}, freed only {freed}")
@@ -169,6 +172,7 @@ class BufferCatalog:
         self.reserve(est)
         dev = host.to_device()
         nbytes = dev.nbytes()
+        promoted = False
         with self._lock:
             buf = self._buffers.get(handle.id)
             if buf is None:  # removed concurrently
@@ -185,9 +189,17 @@ class BufferCatalog:
                     buf.host_batch = None
                     buf.host_nbytes = 0
                 buf.tier = StorageTier.DEVICE
+                promoted = True
             else:
                 _delete_device_batch(dev)  # raced with another unspiller
-            return buf.device_batch
+            out = buf.device_batch
+        if promoted:
+            # exactly one event per actual promotion (race losers skip);
+            # emitted outside the lock
+            from spark_rapids_tpu.aux.events import emit
+            emit("unspill", bytes=nbytes, rows=host.row_count,
+                 buffer_id=handle.id)
+        return out
 
     def get_host_batch(self, handle: BufferHandle) -> HostColumnarBatch:
         with self._lock:
@@ -258,6 +270,9 @@ class BufferCatalog:
             if mt is not None:
                 mt.spill_count += 1
                 mt.spill_bytes += buf.host_nbytes
+            from spark_rapids_tpu.aux.events import emit
+            emit("spill", tier="device->host", bytes=buf.host_nbytes,
+                 buffer_id=buf.handle.id, priority=buf.handle.priority)
         self._maybe_spill_host_locked()
         return freed
 
@@ -286,9 +301,13 @@ class BufferCatalog:
         buf.host_batch = None
         buf.host_nbytes = 0
         buf.disk_path = path
-        self.disk_bytes += os.path.getsize(path)
+        disk_nbytes = os.path.getsize(path)
+        self.disk_bytes += disk_nbytes
         buf.tier = StorageTier.DISK
         self.spill_count += 1
+        from spark_rapids_tpu.aux.events import emit
+        emit("spill", tier="host->disk", bytes=disk_nbytes,
+             buffer_id=buf.handle.id, priority=buf.handle.priority)
 
     def _host_batch_locked(self, buf: _Buffer) -> HostColumnarBatch:
         if buf.host_batch is not None:

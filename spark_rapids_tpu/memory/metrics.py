@@ -61,6 +61,14 @@ class MetricsRegistry:
             self.total.merge(m)
             self.finished_tasks += 1
 
+    def snapshot(self):
+        """(totals copy, finished_tasks) under one lock — the delta basis
+        for per-query summaries (aux/tracing.QueryExecution)."""
+        with self._lock:
+            s = TaskMetrics()
+            s.merge(self.total)
+            return s, self.finished_tasks
+
 
 @contextlib.contextmanager
 def task_scope(task_id: int, registry: Optional[MetricsRegistry] = None):
@@ -77,6 +85,13 @@ def task_scope(task_id: int, registry: Optional[MetricsRegistry] = None):
     finally:
         if registry is not None:
             registry.report(ctx.metrics)
+        m = ctx.metrics
+        from spark_rapids_tpu.aux.events import emit
+        emit("taskEnd", task_id=task_id, retry_count=m.retry_count,
+             split_retry_count=m.split_retry_count, oom_count=m.oom_count,
+             spill_count=m.spill_count, spill_bytes=m.spill_bytes,
+             semaphore_wait_s=round(m.semaphore_wait_seconds, 6),
+             max_device_bytes=m.max_device_bytes)
         # release the semaphore if the task still holds it (completion listener)
         from spark_rapids_tpu.memory.device_manager import get_runtime
         rt = get_runtime()

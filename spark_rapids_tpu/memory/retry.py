@@ -144,6 +144,9 @@ def _relieve_pressure(caused_by: BaseException) -> None:
         rt.catalog.synchronous_spill(target_free_bytes=None)
     if _TL.metrics is not None:
         _TL.metrics.retry_count += 1
+    from spark_rapids_tpu.aux.events import emit
+    emit("retryOOM", task_id=_TL.task_id,
+         cause=f"{type(caused_by).__name__}: {caused_by}"[:160])
     time.sleep(0)  # yield
 
 
@@ -213,6 +216,9 @@ def _with_retry_gen(queue, fn, split_policy, max_retries, top_level):
                     if _TL.metrics is not None:
                         _TL.metrics.split_retry_count += 1
                     pieces = split_policy(item)
+                    from spark_rapids_tpu.aux.events import emit
+                    emit("splitRetry", task_id=_TL.task_id,
+                         pieces=len(pieces))
                     queue = pieces + queue
                     break
     finally:

@@ -43,6 +43,8 @@ class TpuSemaphore:
         mt = task_context().metrics
         if mt is not None:
             mt.semaphore_wait_seconds += wait
+        from spark_rapids_tpu.aux.events import emit
+        emit("semaphoreAcquired", task_id=tid, wait_s=round(wait, 6))
         with self._lock:
             entry = self._holders.get(tid)
             if entry is not None:

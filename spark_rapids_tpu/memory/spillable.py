@@ -58,7 +58,8 @@ class SpillableColumnarBatch:
     # -- access -------------------------------------------------------------
     def get_batch(self) -> ColumnarBatch:
         """Device batch; unspills if it was pushed down a tier
-        (reference: SpillableColumnarBatchImpl.getColumnarBatch)."""
+        (reference: SpillableColumnarBatchImpl.getColumnarBatch); the
+        catalog emits the ``unspill`` event for the call that promotes."""
         return self._catalog.get_device_batch(self._handle)
 
     def get_host_batch(self) -> HostColumnarBatch:

@@ -621,10 +621,15 @@ class TpuOverrides:
             validate_all_on_device(out, conf)
         from spark_rapids_tpu.aux.capture import ExecutionPlanCaptureCallback
         ExecutionPlanCaptureCallback.capture_if_needed(plan, out, meta)
-        from spark_rapids_tpu.aux.metrics import (MetricLevel,
-                                                  instrument_plan)
-        level = MetricLevel.parse(conf.get(C.METRICS_LEVEL.key, "MODERATE"))
-        instrument_plan(out, level)
+        if not for_explain:
+            # never on the explain path: instrument_plan resets the shared
+            # per-node counters, and introspection must not zero the
+            # metrics of a query that ran (or is running) the same nodes
+            from spark_rapids_tpu.aux.metrics import (MetricLevel,
+                                                      instrument_plan)
+            level = MetricLevel.parse(
+                conf.get(C.METRICS_LEVEL.key, "MODERATE"))
+            instrument_plan(out, level)
         from spark_rapids_tpu.aux import profiler as _prof
         _prof.set_ranges_enabled(bool(conf.get(C.RANGES_ENABLED.key)))
         return out

@@ -679,9 +679,21 @@ class DataFrame:
     # -- actions ------------------------------------------------------------
     def _executed_plan(self) -> Exec:
         overrides = TpuOverrides(self._session.conf)
-        return overrides.apply(self._plan)
+        plan = overrides.apply(self._plan)
+        # an active QueryExecution mirrors the plan it is about to run as
+        # its span tree (re-attaching on a speculation replay is fine)
+        from spark_rapids_tpu.aux import events as EV
+        q = EV.active_query()
+        if q is not None:
+            q.attach_plan(plan)
+        return plan
 
     def collect_batch(self) -> HostColumnarBatch:
+        from spark_rapids_tpu.aux.tracing import query_scope
+        with query_scope(self._session.conf, "collect"):
+            return self._collect_batch_traced()
+
+    def _collect_batch_traced(self) -> HostColumnarBatch:
         from spark_rapids_tpu import config as C
         from spark_rapids_tpu.ops.speculation import (SpeculationOverflow,
                                                       no_speculation,
@@ -718,6 +730,8 @@ class DataFrame:
             if names else []
 
     def count(self) -> int:
+        from spark_rapids_tpu.aux import events as EV
+        from spark_rapids_tpu.aux.tracing import query_scope
         from spark_rapids_tpu.columnar.column import sum_counts
         from spark_rapids_tpu.plan.pruning import prune_columns
         # count needs row counts only: prune every column the plan's own
@@ -727,21 +741,29 @@ class DataFrame:
         if self._session.conf.get(C.COLUMN_PRUNING_ENABLED.key, True):
             plan = prune_columns(plan, required=set())
         overrides = TpuOverrides(self._session.conf)
-        # already pruned above (with the tighter empty required-set);
-        # don't pay a second tree walk inside apply()
-        return sum_counts([b.row_count for b in
-                           overrides.apply(plan, skip_pruning=True)
-                           .execute_all()])
+        with query_scope(self._session.conf, "count"):
+            # already pruned above (with the tighter empty required-set);
+            # don't pay a second tree walk inside apply()
+            executed = overrides.apply(plan, skip_pruning=True)
+            q = EV.active_query()
+            if q is not None:
+                q.attach_plan(executed)
+            return sum_counts([b.row_count for b in executed.execute_all()])
 
     def write_parquet(self, path: str) -> None:
+        from spark_rapids_tpu.aux.tracing import query_scope
         from spark_rapids_tpu.io.parquet import write_parquet
-        write_parquet(self._executed_plan().execute_all(), path, self.schema)
+        with query_scope(self._session.conf, "write_parquet"):
+            write_parquet(self._executed_plan().execute_all(), path,
+                          self.schema)
 
     def write_hive_text(self, path: str, serde=None) -> None:
         """Hive text table write (reference: GpuHiveTextFileFormat)."""
+        from spark_rapids_tpu.aux.tracing import query_scope
         from spark_rapids_tpu.hive.table import write_hive_text
-        write_hive_text(self._executed_plan().execute_all(), path,
-                        self.schema, serde=serde)
+        with query_scope(self._session.conf, "write_hive_text"):
+            write_hive_text(self._executed_plan().execute_all(), path,
+                            self.schema, serde=serde)
 
     @property
     def write(self):
@@ -750,9 +772,24 @@ class DataFrame:
         return DataFrameWriter(self)
 
     # -- introspection ------------------------------------------------------
-    def explain(self, mode: str = "formatted") -> str:
+    def explain(self, mode: str = "formatted",
+                analyze: bool = False) -> str:
         """Shows CPU plan, TPU-rewritten plan, and fallback reasons
-        (reference: ExplainPlan.explainPotentialGpuPlan)."""
+        (reference: ExplainPlan.explainPotentialGpuPlan).
+
+        ``analyze=True`` (Spark's EXPLAIN ANALYZE) EXECUTES the plan under
+        a QueryExecution trace and renders the tree annotated with
+        per-node rows/batches/opTime plus attributed spill/retry, and the
+        query-level task-metric summary."""
+        if analyze:
+            from spark_rapids_tpu.aux.tracing import QueryExecution
+            qe = QueryExecution.from_conf(self._session.conf,
+                                          "explain(analyze=True)")
+            with qe:
+                # joins this QueryExecution via query_scope's
+                # already-active path; attach happens in _executed_plan
+                self.collect_batch()
+            return qe.render_tree()
         overrides = TpuOverrides(self._session.conf)
         final = overrides.apply(self._plan, for_explain=True)
         reasons = overrides.last_meta.explain(all_nodes=True) \

@@ -141,6 +141,10 @@ class ShuffleServer:
         conn = self.transport.connect(peer)
         while not state.done:
             state.send_next(conn)
+        from spark_rapids_tpu.aux.events import emit
+        emit("shuffleSend", peer=peer, req_id=msg.req_id,
+             blocks=len(msg.blocks), frames=len(state.frames),
+             bytes=sum(len(f[3]) for f in state.frames))
 
 
 class ShuffleClient:
@@ -256,6 +260,11 @@ class ShuffleClient:
                     raise ConnectionError(
                         f"short transfer: {got}/{expected} frames")
                 _time.sleep(0.005)
+            from spark_rapids_tpu.aux.events import emit
+            emit("shuffleFetch", peer=self._peer_id(server_or_peer),
+                 shuffle_id=shuffle_id, partition=partition_id,
+                 blocks=len(meta.blocks), frames=expected,
+                 bytes=sum(m.nbytes for m in meta.blocks))
             return [m.block for m in meta.blocks]
         finally:
             # error or success: release tracking + any partial chunks so a

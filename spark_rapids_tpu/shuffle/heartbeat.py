@@ -45,7 +45,12 @@ class ShuffleHeartbeatManager:
             self._executors[executor_id] = ExecutorInfo(
                 executor_id, endpoint, self._clock(), self._order)
             self._last_seen_order[executor_id] = self._order
-            return [e for e in self._sorted() if e.executor_id != executor_id]
+            peers = [e for e in self._sorted()
+                     if e.executor_id != executor_id]
+        from spark_rapids_tpu.aux.events import emit
+        emit("executorRegistered", executor_id=executor_id,
+             peers=len(peers))
+        return peers
 
     def executor_heartbeat(self, executor_id: str) -> List[ExecutorInfo]:
         """Refreshes liveness; returns peers registered since this
@@ -70,7 +75,10 @@ class ShuffleHeartbeatManager:
             for eid in dead:
                 del self._executors[eid]
                 self._last_seen_order.pop(eid, None)
-            return dead
+        from spark_rapids_tpu.aux.events import emit
+        for eid in dead:
+            emit("executorLost", executor_id=eid)
+        return dead
 
     def live_executors(self) -> List[ExecutorInfo]:
         with self._lock:

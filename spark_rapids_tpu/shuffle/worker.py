@@ -88,6 +88,12 @@ def run_worker(executor_id: str, port: int, ctrl) -> None:
             catalog.add_batch(blk, hb.slice(0, half))
             catalog.add_batch(blk, hb.slice(half, n_rows - half))
             ksum = int(np.sum(np.asarray(hb.columns[0].arrow)))
+            # observability hook: routes to any sink the worker process
+            # registered (aux.events global sinks); otherwise free
+            from spark_rapids_tpu.aux.events import emit
+            emit("shuffleBlockLoaded", executor_id=executor_id,
+                 shuffle_id=_sid, map_id=_mid, partition=_pid,
+                 rows=n_rows)
             ctrl.send(("loaded", n_rows, ksum))
         elif kind == "fetch":
             peer_id, sid, pid = cmd[1:]
@@ -101,6 +107,10 @@ def run_worker(executor_id: str, port: int, ctrl) -> None:
                         ksum += int(np.sum(np.asarray(
                             hb.columns[0].arrow)))
                     received.drop(b)
+                from spark_rapids_tpu.aux.events import emit
+                emit("shuffleWorkerFetch", executor_id=executor_id,
+                     peer=peer_id, shuffle_id=sid, partition=pid,
+                     rows=rows)
                 ctrl.send(("ok", rows, ksum))
             except Exception as e:    # noqa: BLE001 - fetch failure signal
                 ctrl.send(("fetch_failed",

@@ -47,6 +47,7 @@ SMOKE_FILES = {
     "test_f64bits.py", "test_sort.py", "test_io.py", "test_hive.py",
     "test_pandas_execs.py", "test_collect_percentile.py", "test_expand.py",
     "test_aux.py", "test_native.py", "test_e2e_basic.py",
+    "test_tracing.py",
 }
 
 

@@ -232,3 +232,70 @@ class TestTaskScope:
             R.with_retry_no_split(None, lambda: R.maybe_inject_oom() or 1)
         assert reg.finished_tasks == 1
         assert reg.total.retry_count == 1
+
+
+class TestMetricsAggregation:
+    """TaskMetrics.merge / MetricsRegistry under concurrent report()
+    (the accumulator funnel every query summary is built from)."""
+
+    def test_merge_accumulates_every_field(self):
+        from spark_rapids_tpu.memory.metrics import TaskMetrics
+        a = TaskMetrics(task_id=1, semaphore_wait_seconds=0.5,
+                        retry_count=2, split_retry_count=1, oom_count=3,
+                        spill_count=4, spill_bytes=100,
+                        op_time_seconds={"sort": 1.0}, max_device_bytes=50)
+        b = TaskMetrics(task_id=2, semaphore_wait_seconds=0.25,
+                        retry_count=1, split_retry_count=2, oom_count=1,
+                        spill_count=1, spill_bytes=11,
+                        op_time_seconds={"sort": 0.5, "join": 2.0},
+                        max_device_bytes=80)
+        a.merge(b)
+        assert a.semaphore_wait_seconds == pytest.approx(0.75)
+        assert (a.retry_count, a.split_retry_count, a.oom_count) == (3, 3, 4)
+        assert (a.spill_count, a.spill_bytes) == (5, 111)
+        assert a.op_time_seconds == {"sort": 1.5, "join": 2.0}
+        assert a.max_device_bytes == 80  # max, not sum
+
+    def test_registry_concurrent_reports(self):
+        import threading
+        from spark_rapids_tpu.memory.metrics import TaskMetrics
+        reg = MetricsRegistry()
+        n_threads, per_thread = 8, 100
+
+        def reporter(tid):
+            for i in range(per_thread):
+                m = TaskMetrics(task_id=tid * 1000 + i, retry_count=1,
+                                spill_count=2, spill_bytes=10,
+                                semaphore_wait_seconds=0.001,
+                                op_time_seconds={"op": 0.5},
+                                max_device_bytes=tid)
+                reg.report(m)
+
+        threads = [threading.Thread(target=reporter, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total_reports = n_threads * per_thread
+        assert reg.finished_tasks == total_reports
+        assert reg.total.retry_count == total_reports
+        assert reg.total.spill_count == 2 * total_reports
+        assert reg.total.spill_bytes == 10 * total_reports
+        assert reg.total.op_time_seconds["op"] == \
+            pytest.approx(0.5 * total_reports)
+        assert reg.total.max_device_bytes == n_threads - 1
+        assert reg.total.semaphore_wait_seconds == \
+            pytest.approx(0.001 * total_reports)
+
+    def test_snapshot_is_isolated_copy(self):
+        from spark_rapids_tpu.memory.metrics import TaskMetrics
+        reg = MetricsRegistry()
+        reg.report(TaskMetrics(retry_count=1, spill_bytes=5))
+        snap, finished = reg.snapshot()
+        assert (snap.retry_count, snap.spill_bytes, finished) == (1, 5, 1)
+        reg.report(TaskMetrics(retry_count=2, spill_bytes=7))
+        # the snapshot must not alias the live totals
+        assert (snap.retry_count, snap.spill_bytes) == (1, 5)
+        snap2, finished2 = reg.snapshot()
+        assert (snap2.retry_count, snap2.spill_bytes, finished2) == (3, 12, 2)
