@@ -6,6 +6,9 @@ execution-plan capture, and the cost-based optimizer's helpers."""
 from spark_rapids_tpu.aux.events import (  # noqa: F401
     Event, EventSink, JsonlEventLogSink, RingBufferSink, emit,
     parse_event_line, render_prometheus)
+from spark_rapids_tpu.aux.faults import (  # noqa: F401
+    CircuitBreaker, InjectedFault, arm_fault, arm_from_conf, disarm,
+    disarm_all, fault_stats, maybe_fire, recovery_stats)
 from spark_rapids_tpu.aux.profiler import (  # noqa: F401
     Profiler, op_range)
 from spark_rapids_tpu.aux.metrics import (  # noqa: F401

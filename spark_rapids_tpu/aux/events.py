@@ -106,10 +106,16 @@ class RingBufferSink(EventSink):
 
 class JsonlEventLogSink(EventSink):
     """Appends one JSON object per event to ``path`` (Spark event-log
-    analog; multiple queries interleave lines, keyed by ``query_id``)."""
+    analog; multiple queries interleave lines, keyed by ``query_id``).
 
-    #: events between fsync-visible flushes; writes themselves are
-    #: buffered memcpys, so emitters (which may hold the query or
+    Line-atomic under concurrency: pending lines batch in memory and hit
+    the O_APPEND fd in ONE unbuffered write per batch — a second query's
+    sink on the same path can interleave between batches but never split
+    a line (a torn line would break the ``parse_event_line`` contract).
+    A stdio buffer would instead flush at SIZE boundaries, tearing lines
+    mid-JSON."""
+
+    #: events between writes; emitters (which may hold the query or
     #: catalog lock) only pay disk latency once per batch
     FLUSH_EVERY = 64
 
@@ -118,22 +124,26 @@ class JsonlEventLogSink(EventSink):
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         self._lock = threading.Lock()
-        self._f = open(path, "a", encoding="utf-8")
-        self._unflushed = 0
+        self._f = open(path, "ab", buffering=0)
+        self._pending: List[str] = []
 
     def emit(self, event: Event) -> None:
         with self._lock:
             if self._f.closed:
                 return
-            self._f.write(event.to_json() + "\n")
-            self._unflushed += 1
-            if self._unflushed >= self.FLUSH_EVERY:
-                self._f.flush()
-                self._unflushed = 0
+            self._pending.append(event.to_json() + "\n")
+            if len(self._pending) >= self.FLUSH_EVERY:
+                self._write_pending()
+
+    def _write_pending(self) -> None:
+        if self._pending:
+            self._f.write("".join(self._pending).encode("utf-8"))
+            self._pending = []
 
     def close(self) -> None:
         with self._lock:
             if not self._f.closed:
+                self._write_pending()
                 self._f.close()
 
 

@@ -268,6 +268,15 @@ class QueryExecution:
                      for ev in self.ring.events()
                      if ev.kind == "taskEnd"), default=0),
             }
+        # recovery ledger: what resilience cost THIS query (chaos/fault
+        # recovery transitions emitted by the shuffle/task layers; the
+        # kind->key vocabulary lives in aux/faults.py)
+        from spark_rapids_tpu.aux.faults import RECOVERY_KINDS
+        recovery: Dict[str, int] = {}
+        for ev in self.ring.events():
+            key = RECOVERY_KINDS.get(ev.kind)
+            if key is not None:
+                recovery[key] = recovery.get(key, 0) + 1
         self.root.end = now
         nodes = []
         for sp in self._exec_spans():
@@ -288,6 +297,8 @@ class QueryExecution:
             **delta,
             "nodes": nodes,
         }
+        if recovery:
+            summary["recovery"] = recovery
         self.summary_dict = summary
         self.record_event("queryEnd",
                           {k: v for k, v in summary.items()
@@ -358,6 +369,11 @@ class QueryExecution:
             ("tasks", "retry_count", "split_retry_count", "oom_count",
              "spill_count", "spill_bytes", "semaphore_wait_s",
              "max_device_bytes") if k in summary))
+        rec = summary.get("recovery")
+        if rec:
+            lines.append("== Recovery ==")
+            lines.append(" ".join(f"{k}={v}" for k, v in sorted(
+                rec.items())))
         return "\n".join(lines)
 
 

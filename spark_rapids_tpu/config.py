@@ -370,6 +370,101 @@ SHUFFLE_READER_THREADS = conf_int(
     "Thread pool size for multithreaded shuffle reads.",
     8)
 
+def _chaos_spec_ok(v) -> bool:
+    from spark_rapids_tpu.aux.faults import chaos_spec_ok
+    return chaos_spec_ok(v)
+
+
+SHUFFLE_FETCH_TIMEOUT_MS = conf_int(
+    "spark.rapids.shuffle.fetch.timeoutMs",
+    "Per-attempt wait for in-flight shuffle data frames after a transfer "
+    "ack (replaces the old hardcoded 30s client timeout; validated > 0 at "
+    "set_conf).",
+    30_000,
+    checker=lambda v: int(v) > 0)
+
+SHUFFLE_FETCH_MAX_RETRIES = conf_int(
+    "spark.rapids.shuffle.fetch.maxRetries",
+    "Fetch attempts per peer beyond the first before giving up on that "
+    "peer (then failing over to an alternate replica if one is known; "
+    "reference: lost UCX peers surface as fetch failures -> retry).",
+    3,
+    checker=lambda v: int(v) >= 0)
+
+SHUFFLE_FETCH_RETRY_WAIT_MS = conf_int(
+    "spark.rapids.shuffle.fetch.retryWaitMs",
+    "Base backoff between fetch retries; doubles per attempt with "
+    "deterministic jitter, capped at retryMaxWaitMs.",
+    50,
+    checker=lambda v: int(v) >= 0)
+
+SHUFFLE_FETCH_RETRY_MAX_WAIT_MS = conf_int(
+    "spark.rapids.shuffle.fetch.retryMaxWaitMs",
+    "Backoff ceiling for fetch retries.",
+    2_000,
+    checker=lambda v: int(v) >= 0)
+
+TASK_MAX_FAILURES = conf_int(
+    "spark.rapids.task.maxFailures",
+    "Attempts per task before its failure propagates (the "
+    "spark.task.maxFailures analog).  Only failures that strike BEFORE a "
+    "task yields output are retried — a partially-consumed task cannot "
+    "re-run without duplicating rows.",
+    2,
+    checker=lambda v: int(v) >= 1)
+
+TASK_BREAKER_THRESHOLD = conf_int(
+    "spark.rapids.task.breaker.threshold",
+    "Task failures within one stage that trip the circuit breaker: the "
+    "rest of the stage degrades to single-threaded inline execution "
+    "instead of failing the query.  0 disables the breaker.",
+    3,
+    checker=lambda v: int(v) >= 0)
+
+CHAOS_SHUFFLE_FETCH = conf_str(
+    "spark.rapids.chaos.shuffle.fetch",
+    "Deterministic fault injection at the shuffle-fetch point: 'n' or "
+    "'n:skip' raises ConnectionError on the n triggers after skipping "
+    "skip (generalizes spark.rapids.sql.test.injectRetryOOM to the "
+    "shuffle layer; empty disables).",
+    "", ConfLevel.INTERNAL,
+    checker=_chaos_spec_ok)
+
+CHAOS_SHUFFLE_SEND = conf_str(
+    "spark.rapids.chaos.shuffle.send",
+    "Fault injection at the server block-send point ('n' or 'n:skip').",
+    "", ConfLevel.INTERNAL,
+    checker=_chaos_spec_ok)
+
+CHAOS_SHUFFLE_CONNECT = conf_str(
+    "spark.rapids.chaos.shuffle.connect",
+    "Fault injection at transport connection setup ('n' or 'n:skip').",
+    "", ConfLevel.INTERNAL,
+    checker=_chaos_spec_ok)
+
+CHAOS_TASK_RUN = conf_str(
+    "spark.rapids.chaos.task.run",
+    "Fault injection at task start in the parallel runner ('n' or "
+    "'n:skip'); exercises task-level retry + the stage circuit breaker.",
+    "", ConfLevel.INTERNAL,
+    checker=_chaos_spec_ok)
+
+CHAOS_PARALLEL_COLLECTIVE = conf_str(
+    "spark.rapids.chaos.parallel.collective",
+    "Fault injection at the mesh collective shuffle ('n' or 'n:skip'); "
+    "exercises the fallback to the host-staged exchange path.",
+    "", ConfLevel.INTERNAL,
+    checker=_chaos_spec_ok)
+
+CHAOS_MEMORY_ALLOC = conf_str(
+    "spark.rapids.chaos.memory.alloc",
+    "Fault injection at tracked allocation points: raises RetryOOM "
+    "through the shared chaos mechanism ('n' or 'n:skip'); the thread-"
+    "scoped spark.rapids.sql.test.injectRetryOOM remains for framed "
+    "per-task injection.",
+    "", ConfLevel.INTERNAL,
+    checker=_chaos_spec_ok)
+
 SHUFFLE_COMPRESSION_CODEC = conf_str(
     "spark.rapids.shuffle.compression.codec",
     "Codec for shuffle payloads: none | lz4 | zlib (reference nvcomp "

@@ -220,24 +220,98 @@ class CpuShuffleExchangeExec(UnaryExec):
     def _materialize_cached(self, env, n: int):
         """CACHED mode (reference UCX shuffle): map output registered in
         the ShuffleBufferCatalog, reduce side fetches through the
-        client/server state machines over the transport."""
+        client/server state machines over the transport.
+
+        Resilient reduce side: the exchange remembers which blocks each
+        reduce partition expects (lineage metadata).  A fetch that comes
+        back short — the producing executor died and heartbeat expiry
+        invalidated its blocks — RE-RUNS the producing map tasks to
+        regenerate exactly the missing blocks, then refetches (the
+        FetchFailed -> stage-retry story, scoped to the lost maps)."""
         from spark_rapids_tpu.shuffle.catalog import ShuffleBlockId
+        from spark_rapids_tpu.shuffle.client_server import \
+            ShuffleFetchFailed
         catalog, client, server = env.cached_machinery()
         sid = env.next_shuffle_id()
-        for mp in range(self.child.num_partitions):
+        written: Dict[int, set] = {p: set() for p in range(n)}
+
+        def write_map(mp: int, only_pidx: Optional[int] = None) -> None:
             for p, sub in self._map_pairs(mp, n):
-                catalog.add_batch(ShuffleBlockId(sid, mp, p), sub)
+                if only_pidx is not None and p != only_pidx:
+                    continue
+                blk = ShuffleBlockId(sid, mp, p)
+                catalog.add_batch(blk, sub, owner=server.executor_id)
+                written[p].add(blk)
+
+        for mp in range(self.child.num_partitions):
+            write_map(mp)
 
         def fetch(pidx):
-            blocks = client.do_fetch(server, sid, pidx)
-            out = []
-            for b in blocks:
-                out.extend(client.received.read_batches(b))
-                client.received.drop(b)
-            # the fetched partition is cached by _LazyPartitions; release
-            # the map-side frames (reference: unregisterShuffle on consume)
-            catalog.drop_partition(sid, pidx)
-            return out
+            from spark_rapids_tpu.aux.events import emit
+            from spark_rapids_tpu.aux.faults import note_recovery
+            expected = written[pidx]
+            if not expected:
+                return []
+            # up to 3 passes: a transport-only failure earns one clean
+            # refetch, and ONE lineage re-run is attempted for missing
+            # blocks whenever the loss is detected (pass 0 or later)
+            reran = False
+            last_cause = "fetch kept failing with intact blocks"
+            for attempt in range(3):
+                try:
+                    blocks = client.do_fetch(server, sid, pidx)
+                    missing = expected - set(blocks)
+                except ShuffleFetchFailed as e:
+                    if attempt == 2:
+                        raise
+                    # a transport-level failure does NOT mean the blocks
+                    # are gone: only regenerate what the catalog actually
+                    # lost, else re-adding frames to intact blocks would
+                    # DOUBLE their rows on the refetch
+                    blocks = []
+                    missing = expected - \
+                        set(catalog.block_ids(sid, pidx))
+                    last_cause = e.cause
+                if not missing:
+                    if not blocks:
+                        # blocks intact, fetch failed anyway (transport):
+                        # one more fetch pass, then surface the failure
+                        continue
+                    out = []
+                    for b in blocks:
+                        out.extend(client.received.read_batches(b))
+                        client.received.drop(b)
+                    # the fetched partition is cached by _LazyPartitions;
+                    # release the map-side frames (reference:
+                    # unregisterShuffle on consume)
+                    catalog.drop_partition(sid, pidx)
+                    return out
+                if reran:
+                    # give up — but not before releasing the frames this
+                    # attempt DID fetch (the env-lifetime received
+                    # catalog outlives the query; leaking here pins host
+                    # memory until process exit)
+                    for b in blocks:
+                        client.received.drop(b)
+                    raise ShuffleFetchFailed(
+                        sid, pidx, server.executor_id,
+                        f"{len(missing)} blocks missing after map re-run")
+                # blocks invalidated (dead executor): re-run the
+                # producing map tasks; write_map regenerates only this
+                # partition's blocks (absent from the catalog, so the
+                # re-add cannot duplicate frames)
+                for b in expected:    # drop partial frames: refetch is
+                    client.received.drop(b)   # all-or-nothing
+                lost_maps = sorted({b.map_id for b in missing})
+                note_recovery("map_reruns", len(lost_maps))
+                emit("mapRerun", shuffle_id=sid, partition=pidx,
+                     maps=len(lost_maps),
+                     missing_blocks=len(missing))
+                for mp in lost_maps:
+                    write_map(mp, only_pidx=pidx)
+                reran = True
+            raise ShuffleFetchFailed(sid, pidx, server.executor_id,
+                                     last_cause)
         return _LazyPartitions(n, fetch)
 
     def _compute_bounds(self):
@@ -361,8 +435,23 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
         if mode == "DEFAULT":
             ctx = self._collective_eligible(part)
             if ctx is not None:
-                self._materialize_collective(ctx)
-                return
+                from spark_rapids_tpu.plan.base import _is_retryable
+                try:
+                    self._materialize_collective(ctx)
+                    return
+                except Exception as e:   # noqa: BLE001 - classified below
+                    if not _is_retryable(e):
+                        raise
+                    # a lost chip fails the whole collective step:
+                    # degrade to the single-device store below instead
+                    # of failing the query (Theseus-style: finish the
+                    # plan when a participant dies mid-shuffle)
+                    from spark_rapids_tpu.aux.events import emit
+                    from spark_rapids_tpu.aux.faults import note_recovery
+                    note_recovery("collective_fallbacks")
+                    emit("collectiveFallback",
+                         error=f"{type(e).__name__}: {e}"[:160])
+                    self._collective = None
         if mode != "DEFAULT":
             super()._materialize()
             return

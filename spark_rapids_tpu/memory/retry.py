@@ -62,6 +62,12 @@ class _TaskContext(threading.local):
 
 _TL = _TaskContext()
 
+# chaos hook bound once: maybe_inject_oom sits on the allocation hot path
+# and must not pay a module lookup per tracked alloc (aux.faults has no
+# import-time dependency on this module, so a top-of-call-graph bind is
+# safe; the hook itself is one dict check when nothing is armed)
+from spark_rapids_tpu.aux.faults import maybe_fire as _chaos_fire  # noqa: E402
+
 
 def task_context() -> _TaskContext:
     return _TL
@@ -85,7 +91,13 @@ def force_split_and_retry_oom(num_ooms: int = 1, skip: int = 0) -> None:
 
 def maybe_inject_oom() -> None:
     """Called at tracked allocation points (catalog adds, kernel staging).
-    Mirrors the allocation-hook injection in the RmmSpark state machine."""
+    Mirrors the allocation-hook injection in the RmmSpark state machine.
+
+    Two injection sources share this hook: the thread-local counters armed
+    by ``force_retry_oom`` (per-task, frame-aware) and the process-wide
+    chaos registry's ``memory.alloc`` point (``spark.rapids.chaos.*`` via
+    aux/faults.py — the same mechanism the shuffle and task layers use)."""
+    _chaos_fire("memory.alloc")
     if _TL.inject_retry_oom > 0:
         if _TL.inject_framed_only and _TL.retry_frame_depth == 0:
             pass        # unframed point: a fault here would escape
@@ -192,8 +204,20 @@ def with_retry(spillables, fn: Callable[..., X],
     return _with_retry_gen(queue, fn, split_policy, max_retries, top_level)
 
 
+def _close_quietly(spillable) -> None:
+    close = getattr(spillable, "close", None)
+    if close is None:
+        return
+    try:
+        close()
+    except Exception:   # noqa: BLE001 - cleanup must not mask the cause
+        pass
+
+
 def _with_retry_gen(queue, fn, split_policy, max_retries, top_level):
     _TL.retry_frame_depth += 1
+    item = None
+    done = False
     try:
         while queue:
             item = queue.pop(0)
@@ -201,7 +225,16 @@ def _with_retry_gen(queue, fn, split_policy, max_retries, top_level):
             while True:
                 try:
                     yield fn(item)
+                    # consumed: ownership passed through fn/the caller —
+                    # a later failure must not close it behind their back
+                    item = None
                     break
+                except GeneratorExit:
+                    # abandoned while suspended at the yield: this item's
+                    # result was already delivered, so only the queue is
+                    # unconsumed
+                    item = None
+                    raise
                 except RetryOOM as e:
                     attempts += 1
                     _TL.retry_count += 1
@@ -216,13 +249,28 @@ def _with_retry_gen(queue, fn, split_policy, max_retries, top_level):
                     if _TL.metrics is not None:
                         _TL.metrics.split_retry_count += 1
                     pieces = split_policy(item)
+                    # the policy closed the original and owns the pieces
+                    # via the queue now (a policy that raises instead
+                    # leaves `item` set for the finally-cleanup)
+                    item = None
                     from spark_rapids_tpu.aux.events import emit
                     emit("splitRetry", task_id=_TL.task_id,
                          pieces=len(pieces))
                     queue = pieces + queue
                     break
+        done = True
     finally:
         _TL.retry_frame_depth -= 1
+        if not done:
+            # early exit — max-retries MemoryError, split exhaustion, or
+            # the caller abandoning iteration (GeneratorExit): close the
+            # in-flight item and everything still queued instead of
+            # leaking catalog-registered spillables (they would pin
+            # device/host bytes until process exit)
+            if item is not None:
+                _close_quietly(item)
+            for pending in queue:
+                _close_quietly(pending)
 
 
 def drain_with_retry(spillables, fn: Callable[..., X],

@@ -169,7 +169,14 @@ def collective_hash_shuffle(ctx: MeshContext, cols, counts, pids):
 
     Returns (cols', counts') in the same layout: device d ends up with
     every row whose pid == d, bucket n*B per device.
+
+    Chaos point ``parallel.collective`` fires here (a lost chip fails the
+    whole SPMD step); the exchange catches the retryable failure and
+    degrades to the host-staged per-partition path instead of failing
+    the query.
     """
+    from spark_rapids_tpu.aux.faults import maybe_fire
+    maybe_fire("parallel.collective")
     import jax
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P

@@ -72,6 +72,75 @@ def effective_task_parallelism() -> int:
     return n
 
 
+#: task-retry policy from spark.rapids.task.* (set by TpuOverrides.apply,
+#: same module-global pattern as _task_parallelism)
+_task_max_failures = 2
+_breaker_threshold = 3
+
+
+def set_task_retry_policy(max_failures: int, breaker_threshold: int) -> None:
+    global _task_max_failures, _breaker_threshold
+    _task_max_failures = max(1, int(max_failures))
+    _breaker_threshold = max(0, int(breaker_threshold))
+
+
+def _is_retryable(exc: BaseException) -> bool:
+    """Failures worth re-attempting: transient data-movement errors and
+    injected chaos.  Logic errors (TypeError, AssertionError, ...) are
+    not — re-running deterministic breakage just hides it."""
+    from spark_rapids_tpu.aux.faults import InjectedFault
+    return isinstance(exc, (InjectedFault, ConnectionError, TimeoutError))
+
+
+def _should_retry_task(e: BaseException, produced: int, attempts: int,
+                       p: int, breaker=None, stop_on_trip: bool = False,
+                       stop=None):
+    """THE task-retry decision (shared by the serial/degraded iterator and
+    the pooled driver so classification, budget, breaker accounting and
+    the taskRetry emit cannot drift apart).  Returns (retry, zero_yield_
+    retryable); emits taskRetry when retry is granted."""
+    retryable = _is_retryable(e) and produced == 0
+    if retryable and breaker is not None:
+        breaker.record_failure()
+    retry = (retryable and attempts < _task_max_failures
+             and not (stop_on_trip and breaker is not None
+                      and breaker.tripped)
+             and not (stop is not None and stop.is_set()))
+    if retry:
+        from spark_rapids_tpu.aux.events import emit
+        from spark_rapids_tpu.aux.faults import note_recovery
+        note_recovery("task_retries")
+        emit("taskRetry", pidx=p, attempt=attempts,
+             error=f"{type(e).__name__}: {e}"[:160])
+    return retry, retryable
+
+
+def _task_attempts_iter(task_fn, p: int, breaker=None):
+    """Drives ``task_fn(p)`` with task-level retry: a retryable failure
+    that strikes BEFORE the first item is yielded re-runs the task (fresh
+    task id, fresh injection arming) up to the attempt budget; a failure
+    after partial output cannot re-run without duplicating rows and
+    propagates.  Each retryable failure feeds the stage breaker.  Used
+    for serial stages AND as the degraded inline runner after a breaker
+    trip (hence no stop_on_trip: the degraded path must keep retrying)."""
+    attempts = 0
+    while True:
+        produced = 0
+        try:
+            for item in task_fn(p):
+                produced += 1
+                yield item
+            return
+        except GeneratorExit:
+            raise
+        except BaseException as e:
+            attempts += 1
+            retry, _ = _should_retry_task(e, produced, attempts, p,
+                                          breaker)
+            if not retry:
+                raise
+
+
 class Exec:
     """Physical operator."""
 
@@ -191,6 +260,10 @@ def run_task_iter(gen_fn, pidx: int):
         # (spark.rapids.sql.test.injectRetryOOM; reference
         # RapidsConf.scala:1541 TEST_RETRY_OOM_INJECTION_MODE)
         _arm_task_injection()
+        # chaos layer: spark.rapids.chaos.task.run faults the task at
+        # start — before any output — so the retry path stays lossless
+        from spark_rapids_tpu.aux.faults import maybe_fire
+        maybe_fire("task.run")
         try:
             yield from gen_fn(pidx)
         finally:
@@ -213,10 +286,14 @@ def release_semaphore_for_wait() -> None:
 
 
 class _PartitionError:
-    __slots__ = ("exc",)
+    __slots__ = ("exc", "can_rerun")
 
-    def __init__(self, exc: BaseException):
+    def __init__(self, exc: BaseException, can_rerun: bool = False):
         self.exc = exc
+        #: True when the task failed retryably with ZERO items delivered —
+        #: the consumer may re-run it inline (degraded mode) without
+        #: duplicating output
+        self.can_rerun = can_rerun
 
 
 _DONE = object()
@@ -234,12 +311,13 @@ def iter_partition_tasks(task_fn, n: int, workers: Optional[int] = None):
     short-circuiting limit).  Used by ``Exec.execute_all`` and by exchange
     map sides (the reference's task slots / multithreaded shuffle writer
     pools, RapidsShuffleInternalManagerBase.scala:120-218)."""
+    from spark_rapids_tpu.aux.faults import CircuitBreaker
     if workers is None:
         workers = effective_task_parallelism()
     workers = min(workers, n)
     if workers <= 1:
         for p in range(n):
-            yield from task_fn(p)
+            yield from _task_attempts_iter(task_fn, p)
         return
 
     import queue as qmod
@@ -247,6 +325,10 @@ def iter_partition_tasks(task_fn, n: int, workers: Optional[int] = None):
 
     qs = [qmod.Queue(maxsize=4) for _ in range(n)]
     stop = threading.Event()
+    #: stage-scoped: repeated retryable task failures trip it, degrading
+    #: the remainder of the stage to single-threaded inline execution in
+    #: the consumer thread instead of failing the query
+    breaker = CircuitBreaker(_breaker_threshold, name=f"stage-{n}p")
 
     def put(q, item) -> bool:
         released = False
@@ -268,12 +350,25 @@ def iter_partition_tasks(task_fn, n: int, workers: Optional[int] = None):
 
     def drive(p: int) -> None:
         q = qs[p]
+        attempts = 0
         try:
-            for b in task_fn(p):
-                if stop.is_set() or not put(q, b):
+            while True:
+                produced = 0
+                try:
+                    for b in task_fn(p):
+                        produced += 1
+                        if stop.is_set() or not put(q, b):
+                            return
                     return
-        except BaseException as e:  # propagated to the consumer
-            put(q, _PartitionError(e))
+                except BaseException as e:  # propagated to the consumer
+                    attempts += 1
+                    retry, retryable = _should_retry_task(
+                        e, produced, attempts, p, breaker,
+                        stop_on_trip=True, stop=stop)
+                    if retry:
+                        continue
+                    put(q, _PartitionError(e, can_rerun=retryable))
+                    return
         finally:
             put(q, _DONE)
 
@@ -294,6 +389,24 @@ def iter_partition_tasks(task_fn, n: int, workers: Optional[int] = None):
                 if item is _DONE:
                     break
                 if isinstance(item, _PartitionError):
+                    if item.can_rerun and breaker.tripped:
+                        # degraded mode: the breaker tripped on repeated
+                        # faults — run this partition inline on THIS
+                        # thread (single-threaded, no pool) instead of
+                        # failing the query; zero items were delivered,
+                        # so the re-run cannot duplicate output
+                        while qs[p].get() is not _DONE:
+                            pass
+                        from spark_rapids_tpu.aux.events import emit
+                        from spark_rapids_tpu.aux.faults import \
+                            note_recovery
+                        note_recovery("tasks_degraded")
+                        emit("taskDegraded", pidx=p,
+                             error=f"{type(item.exc).__name__}: "
+                                   f"{item.exc}"[:160])
+                        yield from _task_attempts_iter(task_fn, p,
+                                                       breaker)
+                        break
                     raise item.exc
                 yield item
     finally:

@@ -536,11 +536,19 @@ class TpuOverrides:
         all-on-device assertion (introspection must not raise on fallback).
         ``skip_pruning`` is set by callers that already pruned (count())."""
         from spark_rapids_tpu.plan.base import (set_task_oom_injection,
-                                                set_task_parallelism)
+                                                set_task_parallelism,
+                                                set_task_retry_policy)
         from spark_rapids_tpu.plan.meta import PlanMeta
         conf = self.conf
         set_task_parallelism(conf.get(C.TASK_PARALLELISM.key))
         set_task_oom_injection(conf.get(C.OOM_INJECTION_MODE.key))
+        set_task_retry_policy(conf.get(C.TASK_MAX_FAILURES.key),
+                              conf.get(C.TASK_BREAKER_THRESHOLD.key))
+        # chaos layer: sync armed fault points with spark.rapids.chaos.*
+        # (each action re-arms, so every query sees its conf's fault
+        # budget and a pooled thread never inherits stale chaos)
+        from spark_rapids_tpu.aux.faults import arm_from_conf
+        arm_from_conf(conf)
         # conf-driven out-of-core test hooks (spark.rapids.sql.test.*)
         import spark_rapids_tpu.exec.aggregate as _AG
         import spark_rapids_tpu.exec.sort as _SO

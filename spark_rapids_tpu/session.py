@@ -41,6 +41,10 @@ class TpuSession:
             self.runtime = None
         from spark_rapids_tpu.shuffle.env import init_shuffle_env
         self.shuffle_env = init_shuffle_env(self.conf)
+        # chaos layer: arm/disarm fault points from spark.rapids.chaos.*
+        # at session construction (overrides.apply re-syncs per action)
+        from spark_rapids_tpu.aux.faults import arm_from_conf
+        arm_from_conf(self.conf)
         #: temp views for the SQL front-end (name -> DataFrame)
         self._views: Dict[str, "DataFrame"] = {}
         #: row-based Hive UDF passthrough (name -> (fn, return_type));
@@ -51,7 +55,18 @@ class TpuSession:
 
     # -- conf ---------------------------------------------------------------
     def set_conf(self, key: str, value) -> "TpuSession":
+        """Sets one conf key.  Registered keys validate here (converter +
+        checker run in the TpuConf rebuild — a bad
+        ``spark.rapids.shuffle.fetch.timeoutMs`` or malformed chaos spec
+        raises immediately, not mid-query); ``spark.rapids.chaos.*`` keys
+        additionally re-arm the fault registry so chaos takes effect for
+        the very next action."""
         self.conf = self.conf.set(key, value)
+        if key.startswith("spark.rapids.chaos."):
+            from spark_rapids_tpu.aux.faults import arm_from_conf
+            arm_from_conf(self.conf)
+        elif key.startswith("spark.rapids.shuffle.fetch."):
+            self.shuffle_env.update_fetch_retry(self.conf)
         return self
 
     # -- SQL ----------------------------------------------------------------

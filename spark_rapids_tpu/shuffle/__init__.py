@@ -17,6 +17,8 @@ it is what the mocked-transport tests exercise without a cluster
 
 from spark_rapids_tpu.shuffle.catalog import (  # noqa: F401
     ShuffleBlockId, ShuffleBufferCatalog, ShuffleReceivedBufferCatalog)
+from spark_rapids_tpu.shuffle.client_server import (  # noqa: F401
+    FetchRetryPolicy, ShuffleClient, ShuffleFetchFailed, ShuffleServer)
 from spark_rapids_tpu.shuffle.protocol import (  # noqa: F401
     BlockMeta, MetadataRequest, MetadataResponse, TransferRequest,
     TransferResponse, decode_message, encode_message)

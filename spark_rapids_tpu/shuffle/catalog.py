@@ -36,16 +36,39 @@ class ShuffleBufferCatalog:
         self.codec = codec
         self._lock = threading.Lock()
         self._blocks: Dict[ShuffleBlockId, List[bytes]] = {}
+        #: block -> owning executor id (None for locally produced blocks);
+        #: drop_owner invalidates a dead executor's blocks on heartbeat
+        #: expiry so stale data can never serve a post-expiry fetch
+        self._owners: Dict[ShuffleBlockId, Optional[str]] = {}
 
-    def add_batch(self, block: ShuffleBlockId, hb) -> int:
+    def add_batch(self, block: ShuffleBlockId, hb,
+                  owner: Optional[str] = None) -> int:
         """Serializes and registers one batch; returns frame length."""
         frame = serialize_batch(hb, self.codec)
-        self.add_frame(block, frame)
+        self.add_frame(block, frame, owner=owner)
         return len(frame)
 
-    def add_frame(self, block: ShuffleBlockId, frame: bytes) -> None:
+    def add_frame(self, block: ShuffleBlockId, frame: bytes,
+                  owner: Optional[str] = None) -> None:
         with self._lock:
             self._blocks.setdefault(block, []).append(frame)
+            if owner is not None or block not in self._owners:
+                self._owners[block] = owner
+
+    def drop_owner(self, executor_id: str) -> List[ShuffleBlockId]:
+        """FetchFailed-style invalidation: removes every block registered
+        as owned by ``executor_id`` (wired to heartbeat expiry); returns
+        the dropped block ids so callers can schedule map re-runs."""
+        with self._lock:
+            dead = [b for b, o in self._owners.items() if o == executor_id]
+            for b in dead:
+                self._blocks.pop(b, None)
+                self._owners.pop(b, None)
+        if dead:
+            from spark_rapids_tpu.aux.events import emit
+            emit("shuffleBlocksInvalidated", executor_id=executor_id,
+                 blocks=len(dead))
+        return sorted(dead)
 
     def block_ids(self, shuffle_id: int,
                   partition_id: Optional[int] = None) -> List[ShuffleBlockId]:
@@ -81,12 +104,14 @@ class ShuffleBufferCatalog:
                     and b.partition_id == partition_id]
             for b in dead:
                 del self._blocks[b]
+                self._owners.pop(b, None)
 
     def unregister_shuffle(self, shuffle_id: int) -> int:
         with self._lock:
             dead = [b for b in self._blocks if b.shuffle_id == shuffle_id]
             for b in dead:
                 del self._blocks[b]
+                self._owners.pop(b, None)
             return len(dead)
 
     def nbytes(self) -> int:

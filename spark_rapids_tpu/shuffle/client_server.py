@@ -15,7 +15,9 @@ bounce buffers).  The flow is the reference's, byte-for-byte simpler:
 
 from __future__ import annotations
 
+import dataclasses
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from spark_rapids_tpu.shuffle.catalog import (ShuffleBlockId,
@@ -30,6 +32,53 @@ from spark_rapids_tpu.shuffle.protocol import (BlockFrameHeader, BlockMeta,
 from spark_rapids_tpu.shuffle.transport import (BounceBufferManager,
                                                 Connection,
                                                 TransactionStatus)
+
+
+class ShuffleFetchFailed(ConnectionError):
+    """A reduce partition could not be fetched after exhausting retries
+    and failover peers (the FetchFailedException analog): carries enough
+    lineage identity for the exchange to re-run the producing map tasks."""
+
+    def __init__(self, shuffle_id: int, partition_id: int, peer: str,
+                 cause: str):
+        super().__init__(
+            f"fetch failed: shuffle {shuffle_id} partition {partition_id} "
+            f"from {peer!r}: {cause}")
+        self.shuffle_id = shuffle_id
+        self.partition_id = partition_id
+        self.peer = peer
+        self.cause = cause
+
+
+@dataclasses.dataclass
+class FetchRetryPolicy:
+    """Client-side fetch resilience knobs (conf: the
+    ``spark.rapids.shuffle.fetch.*`` keys; ShuffleEnv materializes one per
+    session).  Backoff doubles per attempt with deterministic jitter —
+    attempt k of request r waits ``base * 2**k`` perturbed by a hash of
+    (r, k), capped at ``max_wait_s`` — so chaos tests replay identically."""
+
+    timeout_s: float = 30.0       # per-attempt data-frame wait
+    max_retries: int = 3          # attempts beyond the first, per peer
+    base_wait_s: float = 0.05
+    max_wait_s: float = 2.0
+
+    @staticmethod
+    def from_conf(conf) -> "FetchRetryPolicy":
+        from spark_rapids_tpu import config as C
+        return FetchRetryPolicy(
+            timeout_s=conf.get(C.SHUFFLE_FETCH_TIMEOUT_MS.key) / 1000.0,
+            max_retries=conf.get(C.SHUFFLE_FETCH_MAX_RETRIES.key),
+            base_wait_s=conf.get(C.SHUFFLE_FETCH_RETRY_WAIT_MS.key) / 1000.0,
+            max_wait_s=conf.get(
+                C.SHUFFLE_FETCH_RETRY_MAX_WAIT_MS.key) / 1000.0)
+
+    def backoff_s(self, req_id: int, attempt: int) -> float:
+        base = min(self.base_wait_s * (2 ** attempt), self.max_wait_s)
+        # deterministic jitter in [0.5, 1.0) x base: decorrelates peers
+        # retrying in lockstep without wall-clock/PRNG nondeterminism
+        frac = 0.5 + (hash((req_id, attempt)) % 1024) / 2048.0
+        return base * frac
 
 
 class BufferSendState:
@@ -136,6 +185,8 @@ class ShuffleServer:
             self._reply_to[req_id] = peer_executor_id
 
     def _send_blocks(self, msg: TransferRequest, peer: str) -> None:
+        from spark_rapids_tpu.aux.faults import maybe_fire
+        maybe_fire("shuffle.send")
         state = BufferSendState(msg.req_id, msg.blocks, self.catalog,
                                 self.bounce)
         conn = self.transport.connect(peer)
@@ -151,21 +202,31 @@ class ShuffleClient:
     """Fetches blocks from peer executors (reference: RapidsShuffleClient).
 
     One instance per executor; receives data frames via the transport
-    handler interface and reassembles them into the received catalog."""
-
-    #: max wait for in-flight data frames after a transfer ack
-    data_timeout_s = 30.0
+    handler interface and reassembles them into the received catalog.
+    Transient failures (dropped frames, peer restarts, injected chaos)
+    retry with bounded exponential backoff per the ``FetchRetryPolicy``;
+    exhausted peers fail over to alternates before surfacing a
+    ``ShuffleFetchFailed`` for the lineage layer."""
 
     def __init__(self, executor_id: str, transport,
-                 received: Optional[ShuffleReceivedBufferCatalog] = None):
+                 received: Optional[ShuffleReceivedBufferCatalog] = None,
+                 retry: Optional[FetchRetryPolicy] = None):
         self.executor_id = executor_id
         self.transport = transport
         self.received = received or ShuffleReceivedBufferCatalog()
+        self.retry = retry or FetchRetryPolicy()
         self._req_counter = 0
         self._lock = threading.Lock()
         self._pending: Dict[int, Dict] = {}
         self._partial: Dict = {}        # (req, block, frame) -> bytearray
         self._partial_got: Dict = {}
+
+    @property
+    def data_timeout_s(self) -> float:
+        """Per-attempt wait for in-flight data frames after a transfer ack
+        (the policy is the single source of truth — was a hardcoded class
+        attribute before the conf-driven FetchRetryPolicy)."""
+        return self.retry.timeout_s
 
     def _next_req(self) -> int:
         with self._lock:
@@ -187,6 +248,12 @@ class ShuffleClient:
         total = h.total_bytes or h.nbytes
         key = (h.req_id, h.block, h.frame_index)
         with self._lock:
+            if h.req_id not in self._pending:
+                # late frame of a request that already timed out/failed:
+                # registering it would combine with the RETRY's frames
+                # and duplicate rows (and stale _partial chunks would
+                # accrete forever) — drop it on the floor
+                return
             buf = self._partial.get(key)
             if buf is None:
                 buf = self._partial[key] = bytearray(total)
@@ -197,10 +264,12 @@ class ShuffleClient:
                 return
             frame = bytes(self._partial.pop(key))
             self._partial_got.pop(key)
-            st = self._pending.get(h.req_id)
-            if st is not None:
-                st["frames"] += 1
-        self.received.add_frame(h.block, frame)
+            self._pending[h.req_id]["frames"] += 1
+            # registered under the SAME lock hold as the pending check:
+            # the attempt's failure cleanup (which drops these blocks)
+            # serializes against us, so a frame is either visible to
+            # that cleanup or rejected at entry — never added late
+            self.received.add_frame(h.block, frame)
 
     # -- fetch flow ---------------------------------------------------------
     @staticmethod
@@ -221,16 +290,72 @@ class ShuffleClient:
         return resp
 
     def do_fetch(self, server_or_peer, shuffle_id: int,
-                 partition_id: int) -> List[ShuffleBlockId]:
-        """Full fetch of one reduce partition from one peer (a local
-        ShuffleServer or a remote peer's executor id); returns the fetched
-        block ids (frames land in self.received)."""
+                 partition_id: int,
+                 alternates: Sequence = ()) -> List[ShuffleBlockId]:
+        """Full fetch of one reduce partition (retry + failover wrapper
+        around ``_do_fetch_once``): transient errors retry against the
+        same peer with backoff; a peer that exhausts its attempt budget
+        fails over to the next candidate in ``alternates`` (a restarted
+        or replica executor the heartbeat layer re-registered).  Raises
+        ``ShuffleFetchFailed`` when every candidate is exhausted — the
+        signal the exchange's lineage recovery consumes."""
+        from spark_rapids_tpu.aux.events import emit
+        from spark_rapids_tpu.aux.faults import note_recovery
+        policy = self.retry
+        candidates = [server_or_peer, *alternates]
+        last_error = "?"
+        for ci, cand in enumerate(candidates):
+            peer = self._peer_id(cand)
+            if ci > 0:
+                note_recovery("fetch_failovers")
+                emit("fetchFailover",
+                     from_peer=self._peer_id(candidates[ci - 1]),
+                     to_peer=peer, shuffle_id=shuffle_id,
+                     partition=partition_id)
+            for attempt in range(policy.max_retries + 1):
+                from spark_rapids_tpu.aux.faults import maybe_fire
+                try:
+                    maybe_fire("shuffle.fetch")
+                    return self._do_fetch_once(cand, shuffle_id,
+                                               partition_id)
+                except ConnectionError as e:
+                    last_error = f"{type(e).__name__}: {e}"
+                    if attempt >= policy.max_retries:
+                        break
+                    wait = policy.backoff_s(self._req_counter, attempt)
+                    note_recovery("fetch_retries")
+                    emit("fetchRetry", peer=peer, shuffle_id=shuffle_id,
+                         partition=partition_id, attempt=attempt + 1,
+                         wait_ms=round(wait * 1000, 3),
+                         error=last_error[:160])
+                    if wait > 0:
+                        time.sleep(wait)
+        raise ShuffleFetchFailed(shuffle_id, partition_id,
+                                 self._peer_id(candidates[-1]), last_error)
+
+    def _do_fetch_once(self, server_or_peer, shuffle_id: int,
+                       partition_id: int) -> List[ShuffleBlockId]:
+        """One fetch attempt of one reduce partition from one peer (a
+        local ShuffleServer or a remote peer's executor id); returns the
+        fetched block ids (frames land in self.received)."""
         meta = self.fetch_metadata(server_or_peer, shuffle_id, partition_id)
         if not meta.blocks:
             return []
         req_id = self._next_req()
         with self._lock:
             self._pending[req_id] = {"frames": 0}
+        try:
+            return self._transfer(server_or_peer, shuffle_id, partition_id,
+                                  meta, req_id)
+        except BaseException:
+            # an attempt is all-or-nothing: frames already reassembled
+            # into the received catalog would DUPLICATE on retry
+            for m in meta.blocks:
+                self.received.drop(m.block)
+            raise
+
+    def _transfer(self, server_or_peer, shuffle_id: int, partition_id: int,
+                  meta: MetadataResponse, req_id: int):
         try:
             expected = sum(m.num_frames for m in meta.blocks)
             treq = TransferRequest(req_id,

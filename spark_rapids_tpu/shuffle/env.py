@@ -43,6 +43,10 @@ class ShuffleEnv:
                 f"{self.codec!r} (supported: {', '.join(self.CODECS)})")
         self.writer_threads = int(conf.get(C.SHUFFLE_WRITER_THREADS.key))
         self.reader_threads = int(conf.get(C.SHUFFLE_READER_THREADS.key))
+        # fetch resilience knobs (spark.rapids.shuffle.fetch.*): one
+        # policy per session, handed to every client this env creates
+        from spark_rapids_tpu.shuffle.client_server import FetchRetryPolicy
+        self.fetch_retry = FetchRetryPolicy.from_conf(conf)
         self._dir = None
         self._atexit_registered = False
         self._lock = threading.Lock()
@@ -52,12 +56,47 @@ class ShuffleEnv:
         self._transport = None
         self._client = None
         self._server = None
+        self._hb_manager = None
         self._shuffle_counter = 0
 
     def next_shuffle_id(self) -> int:
         with self._lock:
             self._shuffle_counter += 1
             return self._shuffle_counter
+
+    def heartbeat_manager(self, timeout_s: float = 60.0):
+        """The session's driver-side liveness registry, pre-wired so
+        heartbeat expiry invalidates the dead executor's blocks in this
+        env's shuffle catalog (the FetchFailed-style invalidation feeding
+        the exchange's lineage recovery).  Deployments that assemble
+        their own manager/catalog pair must wire
+        ``manager.add_expiry_listener(catalog.drop_owner)`` themselves —
+        this accessor is where the engine does it."""
+        from spark_rapids_tpu.shuffle.heartbeat import \
+            ShuffleHeartbeatManager
+        with self._lock:
+            if self._hb_manager is None:
+                mgr = ShuffleHeartbeatManager(timeout_s=timeout_s)
+
+                def drop_dead_blocks(eid: str) -> None:
+                    cat = self._catalog    # may register after the mgr
+                    if cat is not None:
+                        cat.drop_owner(eid)
+
+                mgr.add_expiry_listener(drop_dead_blocks)
+                self._hb_manager = mgr
+            return self._hb_manager
+
+    def update_fetch_retry(self, conf) -> None:
+        """Re-reads the spark.rapids.shuffle.fetch.* keys (set_conf after
+        session init must take effect, not just validate) and pushes the
+        new policy into the already-created client, if any."""
+        from spark_rapids_tpu.shuffle.client_server import FetchRetryPolicy
+        policy = FetchRetryPolicy.from_conf(conf)
+        with self._lock:
+            self.fetch_retry = policy
+            if self._client is not None:
+                self._client.retry = policy
 
     @property
     def shuffle_dir(self) -> str:
@@ -108,7 +147,8 @@ class ShuffleEnv:
                 self._server = ShuffleServer("exec-0", self._catalog,
                                              self._transport)
                 self._client = ShuffleClient("exec-0-client",
-                                             self._transport)
+                                             self._transport,
+                                             retry=self.fetch_retry)
                 self._transport.register_handler("exec-0", self._server)
                 self._transport.register_handler("exec-0-client",
                                                  self._client)

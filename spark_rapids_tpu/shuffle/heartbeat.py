@@ -37,6 +37,14 @@ class ShuffleHeartbeatManager:
         self._executors: Dict[str, ExecutorInfo] = {}
         self._order = 0
         self._last_seen_order: Dict[str, int] = {}
+        #: called with each expired executor id (shuffle catalogs register
+        #: here to invalidate the dead executor's blocks — the
+        #: FetchFailed-style invalidation feeding lineage recovery)
+        self._expiry_listeners: List[Callable[[str], None]] = []
+
+    def add_expiry_listener(self, cb: Callable[[str], None]) -> None:
+        with self._lock:
+            self._expiry_listeners.append(cb)
 
     def register_executor(self, executor_id: str,
                           endpoint: str = "") -> List[ExecutorInfo]:
@@ -67,7 +75,10 @@ class ShuffleHeartbeatManager:
                     and e.executor_id != executor_id]
 
     def expire_dead(self) -> List[str]:
-        """Drops executors whose heartbeat aged out; returns their ids."""
+        """Drops executors whose heartbeat aged out; returns their ids.
+        Each expiry emits a ``workerExpired`` event (plus the legacy
+        ``executorLost``) and notifies expiry listeners so shuffle
+        catalogs can drop the dead executor's blocks."""
         now = self._clock()
         with self._lock:
             dead = [eid for eid, e in self._executors.items()
@@ -75,9 +86,21 @@ class ShuffleHeartbeatManager:
             for eid in dead:
                 del self._executors[eid]
                 self._last_seen_order.pop(eid, None)
+            listeners = list(self._expiry_listeners)
         from spark_rapids_tpu.aux.events import emit
+        from spark_rapids_tpu.aux.faults import note_recovery
         for eid in dead:
+            note_recovery("workers_expired")
+            emit("workerExpired", executor_id=eid,
+                 timeout_s=self._timeout)
             emit("executorLost", executor_id=eid)
+            for cb in listeners:
+                try:
+                    cb(eid)
+                except Exception:   # noqa: BLE001 - one bad listener
+                    import logging  # must not block liveness accounting
+                    logging.getLogger(__name__).exception(
+                        "shuffle expiry listener failed for %s", eid)
         return dead
 
     def live_executors(self) -> List[ExecutorInfo]:

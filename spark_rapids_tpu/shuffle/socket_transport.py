@@ -61,7 +61,8 @@ class _SocketConnection(Connection):
                  owner: "SocketTransport"):
         super().__init__(peer_executor_id)
         self._owner = owner
-        self._sock = socket.create_connection(addr, timeout=10)
+        self._sock = socket.create_connection(
+            addr, timeout=owner.connect_timeout_s)
         self._sock.settimeout(None)
         self._wlock = threading.Lock()
         self._send_lock = threading.Lock()
@@ -144,8 +145,11 @@ class SocketTransport(Transport):
     (ExecutorInfo.endpoint carries the address, heartbeat.py)."""
 
     def __init__(self, executor_id: str, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, connect_timeout_s: float = 10.0):
         self.executor_id = executor_id
+        #: connection-setup deadline (was hardcoded); a dead peer must
+        #: fail fast enough for the client's retry/failover budget
+        self.connect_timeout_s = connect_timeout_s
         self._server_handler = None
         self._data_handler = None
         self._peers: Dict[str, Tuple[str, int]] = {}
@@ -218,6 +222,8 @@ class SocketTransport(Transport):
 
     # -- outbound ------------------------------------------------------------
     def connect(self, peer_executor_id: str) -> Connection:
+        from spark_rapids_tpu.aux.faults import maybe_fire
+        maybe_fire("shuffle.connect")
         with self._lock:
             conn = self._conns.get(peer_executor_id)
             if conn is not None and conn._dead is None:

@@ -12,6 +12,8 @@ plane is the real socket transport between workers):
   ("fetch", peer_id, shuffle_id, partition)    fetch over the socket;
                                                replies ("ok", rows, ksum)
                                                or ("fetch_failed", why)
+  ("chaos", point, n, skip)                    arm a fault point inside
+                                               the worker (aux/faults.py)
   ("exit",)                                    shut down
 
 The worker heartbeats ("hb", executor_id) over the pipe every 0.2s; the
@@ -36,7 +38,8 @@ def run_worker(executor_id: str, port: int, ctrl) -> None:
     from spark_rapids_tpu.shuffle.catalog import (ShuffleBlockId,
                                                   ShuffleBufferCatalog,
                                                   ShuffleReceivedBufferCatalog)
-    from spark_rapids_tpu.shuffle.client_server import (ShuffleClient,
+    from spark_rapids_tpu.shuffle.client_server import (FetchRetryPolicy,
+                                                        ShuffleClient,
                                                         ShuffleServer)
     from spark_rapids_tpu.shuffle.socket_transport import SocketTransport
 
@@ -44,8 +47,13 @@ def run_worker(executor_id: str, port: int, ctrl) -> None:
     catalog = ShuffleBufferCatalog()
     received = ShuffleReceivedBufferCatalog()
     server = ShuffleServer(executor_id, catalog, transport)
-    client = ShuffleClient(executor_id, transport, received)
-    client.data_timeout_s = 10.0
+    # short per-attempt timeout + tight backoff: a dead peer must surface
+    # as fetch_failed well inside the test harness timeout
+    client = ShuffleClient(executor_id, transport, received,
+                           retry=FetchRetryPolicy(timeout_s=10.0,
+                                                  max_retries=1,
+                                                  base_wait_s=0.05,
+                                                  max_wait_s=0.2))
     transport.set_handlers(server, client)
 
     stop = threading.Event()
@@ -95,6 +103,12 @@ def run_worker(executor_id: str, port: int, ctrl) -> None:
                  shuffle_id=_sid, map_id=_mid, partition=_pid,
                  rows=n_rows)
             ctrl.send(("loaded", n_rows, ksum))
+        elif kind == "chaos":
+            from spark_rapids_tpu.aux import faults
+            _point, _n, _skip = cmd[1:]
+            exc = faults.CHAOS_POINTS.get(_point, (None, None))[1]
+            faults.arm_fault(_point, _n, _skip, exc)
+            ctrl.send(("chaos_ok", _point))
         elif kind == "fetch":
             peer_id, sid, pid = cmd[1:]
             try:

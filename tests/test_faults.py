@@ -1,0 +1,535 @@
+"""Fault-injection chaos layer + resilient shuffle/task-retry tests.
+
+Reference methodology: RmmSparkRetrySuiteBase arms deterministic OOMs and
+asserts the retry discipline recovers bit-identically; here the same
+discipline covers the shuffle fetch path, the parallel task runner's
+retry + circuit breaker, and dead-executor lineage recovery.  Every chaos
+test asserts (a) results identical to the fault-free run and (b) the
+recovery events that prove the faults actually fired and were absorbed.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.aux import events as EV
+from spark_rapids_tpu.aux import faults as F
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.session import TpuSession
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    F.disarm_all()
+    F.reset_recovery_stats()
+    yield
+    F.disarm_all()
+
+
+# ---------------------------------------------------------------------------
+# framework semantics
+# ---------------------------------------------------------------------------
+
+class TestFaultRegistry:
+    def test_arm_fire_skip_disarm(self):
+        F.arm_fault("p", n=2, skip=1)
+        F.maybe_fire("p")                       # skipped
+        with pytest.raises(F.InjectedFault):
+            F.maybe_fire("p")
+        with pytest.raises(F.InjectedFault):
+            F.maybe_fire("p")
+        F.maybe_fire("p")                       # exhausted: disarmed
+        assert not F.is_armed("p")
+        assert F.fault_stats().get("p", 0) >= 2
+
+    def test_arm_zero_disarms(self):
+        F.arm_fault("p", n=1)
+        F.arm_fault("p", n=0)
+        F.maybe_fire("p")                       # no raise
+
+    def test_custom_exception(self):
+        F.arm_fault("p", n=1, exc=lambda pt: TimeoutError(pt))
+        with pytest.raises(TimeoutError):
+            F.maybe_fire("p")
+
+    def test_parse_chaos_spec(self):
+        assert F.parse_chaos_spec("2") == (2, 0)
+        assert F.parse_chaos_spec("2:3") == (2, 3)
+        assert F.parse_chaos_spec("") is None
+        assert F.parse_chaos_spec("0") is None
+        for bad in ("a", "1:b", "1:2:3", "-1"):
+            with pytest.raises(ValueError):
+                F.parse_chaos_spec(bad)
+
+    def test_conf_arming_and_validation(self):
+        conf = TpuConf({"spark.rapids.chaos.shuffle.fetch": "2:1"})
+        assert F.arm_from_conf(conf) == ["shuffle.fetch"]
+        F.maybe_fire("shuffle.fetch")           # skip
+        with pytest.raises(ConnectionError):
+            F.maybe_fire("shuffle.fetch")
+        # defaults disarm (a later session must not inherit chaos)
+        F.arm_from_conf(TpuConf({}))
+        assert not F.is_armed("shuffle.fetch")
+        with pytest.raises(ValueError):
+            TpuConf({"spark.rapids.chaos.task.run": "nope"})
+
+    def test_set_conf_validates_and_arms(self):
+        s = TpuSession(TpuConf({"spark.rapids.sql.enabled": "false"}),
+                       init_device=False)
+        with pytest.raises(ValueError):
+            s.set_conf("spark.rapids.shuffle.fetch.timeoutMs", "0")
+        with pytest.raises(ValueError):
+            s.set_conf("spark.rapids.chaos.shuffle.fetch", "x:y")
+        s.set_conf("spark.rapids.chaos.shuffle.send", "1")
+        assert F.is_armed("shuffle.send")
+        s.set_conf("spark.rapids.chaos.shuffle.send", "")
+        assert not F.is_armed("shuffle.send")
+
+    def test_memory_alloc_point_raises_retry_oom(self):
+        from spark_rapids_tpu.memory import retry as R
+        F.arm_from_conf(TpuConf({"spark.rapids.chaos.memory.alloc": "2"}))
+        calls = []
+
+        def work():
+            R.maybe_inject_oom()
+            calls.append(1)
+            return 7
+
+        # the shared chaos point rides the SAME retry discipline the
+        # thread-local force_retry_oom uses
+        assert R.with_retry_no_split(None, work) == 7
+        assert len(calls) == 1
+
+
+class TestCircuitBreaker:
+    def test_trips_once_at_threshold(self):
+        b = F.CircuitBreaker(2)
+        assert not b.record_failure()
+        assert not b.tripped
+        assert b.record_failure()       # True exactly on the tripping one
+        assert b.tripped
+        assert not b.record_failure()
+        assert b.failures == 3
+
+    def test_zero_threshold_disabled(self):
+        b = F.CircuitBreaker(0)
+        for _ in range(10):
+            assert not b.record_failure()
+        assert not b.tripped
+
+
+# ---------------------------------------------------------------------------
+# resilient fetch: client retry / failover over the in-process transport
+# ---------------------------------------------------------------------------
+
+def _hb(n=100, seed=0):
+    from spark_rapids_tpu.columnar.batch import batch_from_pydict
+    rng = np.random.default_rng(seed)
+    return batch_from_pydict({
+        "k": rng.integers(0, 50, n).astype(np.int64),
+        "s": [f"row-{i}" for i in range(n)],
+    })
+
+
+def _machinery(executor_id="exec-A", client_id="exec-B", **policy):
+    from spark_rapids_tpu.shuffle.catalog import ShuffleBufferCatalog
+    from spark_rapids_tpu.shuffle.client_server import (FetchRetryPolicy,
+                                                        ShuffleClient,
+                                                        ShuffleServer)
+    from spark_rapids_tpu.shuffle.transport import InProcessTransport
+    transport = InProcessTransport()
+    catalog = ShuffleBufferCatalog()
+    server = ShuffleServer(executor_id, catalog, transport)
+    pol = FetchRetryPolicy(**{"timeout_s": 5.0, "max_retries": 3,
+                              "base_wait_s": 0.0, "max_wait_s": 0.0,
+                              **policy})
+    client = ShuffleClient(client_id, transport, retry=pol)
+    transport.register_handler(executor_id, server)
+    transport.register_handler(client_id, client)
+    return transport, catalog, server, client
+
+
+def test_fetch_retries_through_send_faults():
+    from spark_rapids_tpu.shuffle.catalog import ShuffleBlockId
+    _, catalog, server, client = _machinery()
+    hb = _hb(300, 1)
+    catalog.add_batch(ShuffleBlockId(7, 0, 3), hb)
+    F.arm_fault("shuffle.send", n=2,
+                exc=lambda p: ConnectionError(f"injected at {p}"))
+    sink = EV.RingBufferSink()
+    EV.add_global_sink(sink)
+    try:
+        blocks = client.do_fetch(server, 7, 3)
+    finally:
+        EV.remove_global_sink(sink)
+    got = [b for blk in blocks for b in client.received.read_batches(blk)]
+    assert got[0].to_pydict() == hb.to_pydict()
+    kinds = [e.kind for e in sink.events()]
+    assert kinds.count("fetchRetry") == 2
+    assert "shuffleFetch" in kinds
+
+
+def test_fetch_retry_does_not_duplicate_frames():
+    """A failed attempt that delivered SOME frames must not leave them
+    behind: the retried fetch would otherwise double the rows."""
+    from spark_rapids_tpu.shuffle.catalog import ShuffleBlockId
+    _, catalog, server, client = _machinery()
+    blk = ShuffleBlockId(7, 0, 3)
+    hb = _hb(200, 2)
+    catalog.add_batch(blk, hb)
+    catalog.add_batch(blk, _hb(100, 3))
+    # fault the SECOND send: attempt 1 delivers block frames partially
+    F.arm_fault("shuffle.send", n=1, skip=1,
+                exc=lambda p: ConnectionError("late drop"))
+    # the in-process server sends all frames of a request inside ONE
+    # handle_request, so fault the whole second *fetch attempt* instead
+    blocks = client.do_fetch(server, 7, 3)
+    total = sum(b2.row_count for b in blocks
+                for b2 in client.received.read_batches(b))
+    assert total == 300
+
+
+def test_fetch_fails_over_to_alternate_peer():
+    from spark_rapids_tpu.shuffle.catalog import ShuffleBlockId
+    transport, catalog, server, client = _machinery(max_retries=1)
+    catalog.add_batch(ShuffleBlockId(9, 0, 1), _hb(64, 4))
+    sink = EV.RingBufferSink()
+    EV.add_global_sink(sink)
+    try:
+        # primary peer is not registered: connect/request fails; the
+        # client must fail over to the live replica
+        blocks = client.do_fetch("exec-DEAD", 9, 1,
+                                 alternates=[server])
+    finally:
+        EV.remove_global_sink(sink)
+    assert len(blocks) == 1
+    kinds = [e.kind for e in sink.events()]
+    assert "fetchFailover" in kinds
+    assert kinds.count("fetchRetry") >= 1
+
+
+def test_fetch_failed_carries_lineage_identity():
+    from spark_rapids_tpu.shuffle.client_server import ShuffleFetchFailed
+    _, catalog, server, client = _machinery(max_retries=0)
+    with pytest.raises(ShuffleFetchFailed) as ei:
+        client.do_fetch("exec-DEAD", 5, 2)
+    assert (ei.value.shuffle_id, ei.value.partition_id) == (5, 2)
+    assert ei.value.peer == "exec-DEAD"
+
+
+def test_backoff_is_bounded_and_deterministic():
+    from spark_rapids_tpu.shuffle.client_server import FetchRetryPolicy
+    pol = FetchRetryPolicy(base_wait_s=0.05, max_wait_s=0.4)
+    for req in (1, 77):
+        for attempt in range(8):
+            w = pol.backoff_s(req, attempt)
+            assert 0 < w <= 0.4
+            assert w == pol.backoff_s(req, attempt)   # deterministic
+    assert pol.backoff_s(1, 0) <= 0.05
+
+
+@pytest.mark.slow
+def test_backoff_actually_waits():
+    """Wall-clock variant: with real backoff waits the retried fetch
+    takes at least the sum of the scheduled sleeps."""
+    import time
+    from spark_rapids_tpu.shuffle.catalog import ShuffleBlockId
+    _, catalog, server, client = _machinery(base_wait_s=0.1,
+                                            max_wait_s=0.1)
+    catalog.add_batch(ShuffleBlockId(1, 0, 0), _hb(32, 5))
+    F.arm_fault("shuffle.send", n=2,
+                exc=lambda p: ConnectionError("injected"))
+    t0 = time.monotonic()
+    client.do_fetch(server, 1, 0)
+    # two retries, each >= 0.05s (jitter floor is base/2)
+    assert time.monotonic() - t0 >= 0.1
+
+
+# ---------------------------------------------------------------------------
+# chaos-driven queries: the acceptance scenario
+# ---------------------------------------------------------------------------
+
+_DATA = None
+
+
+def _data():
+    global _DATA
+    if _DATA is None:
+        rng = np.random.default_rng(5)
+        _DATA = {"g": rng.integers(0, 17, 3000).astype(np.int64),
+                 "v": rng.standard_normal(3000)}
+    return _DATA
+
+
+def _agg_query(s):
+    from spark_rapids_tpu import functions as Fn
+    from spark_rapids_tpu.expressions.base import Alias, col
+    return s.create_dataframe(_data(), num_partitions=4) \
+        .group_by("g").agg(Alias(Fn.sum(col("v")), "sv"))
+
+
+def _collect_sorted(s):
+    return sorted(map(str, _agg_query(s).collect()))
+
+
+_BASE_CONF = {"spark.rapids.sql.enabled": "true",
+              "spark.rapids.shuffle.mode": "CACHED",
+              "spark.rapids.shuffle.fetch.retryWaitMs": "1"}
+
+_RETRY_KINDS = ("fetchRetry", "fetchFailover", "taskRetry", "taskDegraded",
+                "breakerTrip", "mapRerun", "workerExpired",
+                "collectiveFallback", "faultInjected")
+
+
+def test_chaos_fetch_query_bit_identical_with_events():
+    """The acceptance scenario: two injected fetch failures + an injected
+    task fault; results bit-identical to the fault-free run, recovery
+    events recorded; unarmed run shows NO retry events."""
+    from spark_rapids_tpu.aux.tracing import last_query_summary
+    expect = _collect_sorted(TpuSession(TpuConf(_BASE_CONF)))
+    clean = last_query_summary()
+    assert not (clean or {}).get("recovery"), clean.get("recovery")
+
+    got = _collect_sorted(TpuSession(TpuConf({
+        **_BASE_CONF,
+        "spark.rapids.chaos.shuffle.fetch": "2",
+        "spark.rapids.chaos.task.run": "1"})))
+    assert got == expect
+    rec = (last_query_summary() or {}).get("recovery") or {}
+    assert rec.get("fetch_retries", 0) >= 2, rec
+    assert rec.get("task_retries", 0) >= 1, rec
+
+    # chaos disarms after its budget: a fresh default session is clean
+    again = _collect_sorted(TpuSession(TpuConf(_BASE_CONF)))
+    assert again == expect
+    rec2 = (last_query_summary() or {}).get("recovery") or {}
+    assert not rec2, rec2
+
+
+def test_chaos_events_in_event_log(tmp_path):
+    """fetchRetry/taskRetry land in the JSONL event log."""
+    from spark_rapids_tpu.aux.events import parse_event_line
+    path = str(tmp_path / "events.jsonl")
+    _collect_sorted(TpuSession(TpuConf({
+        **_BASE_CONF,
+        "spark.rapids.sql.eventLog.path": path,
+        "spark.rapids.chaos.shuffle.fetch": "1",
+        "spark.rapids.chaos.task.run": "1"})))
+    kinds = [parse_event_line(l).kind for l in open(path)]
+    assert "fetchRetry" in kinds
+    assert "taskRetry" in kinds
+    assert "faultInjected" in kinds
+
+
+def test_chaos_fetch_beyond_retry_budget_no_duplication():
+    """More injected fetch faults than one fetch's retry budget: the
+    recovery pass must NOT re-run map tasks for blocks that are still
+    intact (re-adding frames would silently double rows — the exact
+    corruption the all-or-nothing invariant exists to prevent)."""
+    from spark_rapids_tpu.aux.tracing import last_query_summary
+    expect = _collect_sorted(TpuSession(TpuConf(_BASE_CONF)))
+    got = _collect_sorted(TpuSession(TpuConf({
+        **_BASE_CONF,
+        "spark.rapids.chaos.shuffle.fetch": "5"})))
+    assert got == expect
+    rec = (last_query_summary() or {}).get("recovery") or {}
+    assert rec.get("fetch_retries", 0) >= 3, rec
+    assert not rec.get("map_reruns"), rec   # blocks were never lost
+
+
+def test_set_conf_updates_live_fetch_policy():
+    s = TpuSession(TpuConf({"spark.rapids.sql.enabled": "false",
+                            "spark.rapids.shuffle.mode": "CACHED"}),
+                   init_device=False)
+    _, client, _ = s.shuffle_env.cached_machinery()
+    assert client.retry.max_retries == 3
+    s.set_conf("spark.rapids.shuffle.fetch.maxRetries", "1")
+    s.set_conf("spark.rapids.shuffle.fetch.timeoutMs", "5000")
+    assert client.retry.max_retries == 1
+    assert client.data_timeout_s == pytest.approx(5.0)
+
+
+def test_event_log_line_atomic_under_concurrent_sinks(tmp_path):
+    """Two queries logging to one event-log path must never tear a line
+    (each sink batches pending lines and appends them in ONE unbuffered
+    write; a stdio buffer would flush at size boundaries mid-JSON)."""
+    import threading
+    from spark_rapids_tpu.aux.events import (Event, JsonlEventLogSink,
+                                             parse_event_line)
+    path = str(tmp_path / "ev.jsonl")
+    sinks = [JsonlEventLogSink(path) for _ in range(3)]
+
+    def hammer(si):
+        for i in range(400):
+            sinks[si].emit(Event("probe", si, i, 0.0,
+                                 {"pad": "x" * 120}))
+        sinks[si].close()
+
+    ts = [threading.Thread(target=hammer, args=(i,)) for i in range(3)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    lines = open(path).readlines()
+    assert len(lines) == 1200
+    for line in lines:
+        parse_event_line(line)          # raises on a torn line
+
+
+def test_dead_worker_lineage_recovery():
+    """Mid-query executor death: heartbeat expiry invalidates the dead
+    executor's blocks; the exchange re-runs the producing map tasks and
+    the query completes bit-identically (workerExpired + mapRerun)."""
+    from spark_rapids_tpu.plan.base import run_task
+    from spark_rapids_tpu.plan.overrides import TpuOverrides
+    from spark_rapids_tpu.shuffle.heartbeat import ShuffleHeartbeatManager
+    conf = {**_BASE_CONF,
+            "spark.sql.adaptive.coalescePartitions.enabled": "false"}
+
+    def run_plan(s, kill_after_p0):
+        catalog, client, server = s.shuffle_env.cached_machinery()
+        plan = TpuOverrides(s.conf).apply(_agg_query(s)._plan)
+        assert plan.num_partitions > 1
+        out = list(run_task(plan, 0))
+        if kill_after_p0:
+            clock = [0.0]
+            mgr = ShuffleHeartbeatManager(timeout_s=5,
+                                          clock=lambda: clock[0])
+            mgr.add_expiry_listener(catalog.drop_owner)
+            mgr.register_executor(server.executor_id)
+            assert catalog.nbytes() > 0
+            clock[0] = 10.0
+            assert mgr.expire_dead() == [server.executor_id]
+            assert catalog.nbytes() == 0      # blocks invalidated
+        for p in range(1, plan.num_partitions):
+            out.extend(run_task(plan, p))
+        rows = []
+        for b in out:
+            hb = b.to_host() if hasattr(b, "to_host") else b
+            names = list(hb.to_pydict().keys())
+            rows += [str(dict(zip(names, r)))
+                     for r in zip(*hb.to_pydict().values())]
+        return sorted(rows)
+
+    expect = run_plan(TpuSession(TpuConf(conf)), kill_after_p0=False)
+    sink = EV.RingBufferSink(8192)
+    EV.add_global_sink(sink)
+    try:
+        got = run_plan(TpuSession(TpuConf(conf)), kill_after_p0=True)
+    finally:
+        EV.remove_global_sink(sink)
+    assert got == expect
+    kinds = [e.kind for e in sink.events()]
+    assert "workerExpired" in kinds
+    assert "shuffleBlocksInvalidated" in kinds
+    assert kinds.count("mapRerun") >= 1
+
+
+def test_env_heartbeat_manager_wires_invalidation():
+    """The engine-owned wiring: ShuffleEnv.heartbeat_manager() expiry
+    drops dead-executor blocks from the env's catalog."""
+    from spark_rapids_tpu.shuffle.catalog import ShuffleBlockId
+    s = TpuSession(TpuConf({"spark.rapids.sql.enabled": "false",
+                            "spark.rapids.shuffle.mode": "CACHED"}),
+                   init_device=False)
+    mgr = s.shuffle_env.heartbeat_manager(timeout_s=0.0)
+    catalog, _, server = s.shuffle_env.cached_machinery()
+    catalog.add_frame(ShuffleBlockId(1, 0, 0), b"x",
+                      owner=server.executor_id)
+    mgr.register_executor(server.executor_id)
+    assert s.shuffle_env.heartbeat_manager() is mgr   # one per env
+    import time
+    time.sleep(0.01)                                  # age past timeout 0
+    assert mgr.expire_dead() == [server.executor_id]
+    assert catalog.frames(ShuffleBlockId(1, 0, 0)) == []
+
+
+def test_task_retry_serial_and_parallel():
+    for par in ("1", "4"):
+        base = _collect_sorted(TpuSession(TpuConf(
+            {"spark.rapids.sql.enabled": "true",
+             "spark.rapids.tpu.taskParallelism": par})))
+        got = _collect_sorted(TpuSession(TpuConf(
+            {"spark.rapids.sql.enabled": "true",
+             "spark.rapids.tpu.taskParallelism": par,
+             "spark.rapids.chaos.task.run": "1"})))
+        assert got == base, f"parallelism {par} diverged under task chaos"
+
+
+def test_breaker_degrades_stage_instead_of_failing():
+    from spark_rapids_tpu.aux.tracing import last_query_summary
+    base = _collect_sorted(TpuSession(TpuConf(
+        {"spark.rapids.sql.enabled": "true"})))
+    got = _collect_sorted(TpuSession(TpuConf(
+        {"spark.rapids.sql.enabled": "true",
+         "spark.rapids.tpu.taskParallelism": "4",
+         "spark.rapids.task.maxFailures": "1",
+         "spark.rapids.task.breaker.threshold": "1",
+         "spark.rapids.chaos.task.run": "3"})))
+    assert got == base
+    rec = (last_query_summary() or {}).get("recovery") or {}
+    assert rec.get("breaker_trips", 0) >= 1, rec
+    assert rec.get("tasks_degraded", 0) >= 1, rec
+
+
+def test_nonretryable_task_failure_still_propagates():
+    """The retry layer must not mask logic errors."""
+    from spark_rapids_tpu.plan.base import iter_partition_tasks
+
+    def bad(p):
+        raise TypeError("logic bug")
+        yield  # noqa: unreachable - makes this a generator
+
+    with pytest.raises(TypeError):
+        list(iter_partition_tasks(bad, 2, workers=2))
+    with pytest.raises(TypeError):
+        list(iter_partition_tasks(bad, 2, workers=1))
+
+
+def test_task_budget_exhaustion_fails_without_breaker():
+    """With the breaker disabled, a task that keeps failing retryably
+    exhausts its budget and the error surfaces (no silent infinite
+    retry)."""
+    from spark_rapids_tpu.plan.base import (iter_partition_tasks,
+                                            set_task_retry_policy)
+
+    set_task_retry_policy(2, 0)      # breaker off
+    try:
+        def flaky(p):
+            raise ConnectionError("always down")
+            yield  # noqa: unreachable
+
+        with pytest.raises(ConnectionError):
+            list(iter_partition_tasks(flaky, 2, workers=2))
+    finally:
+        set_task_retry_policy(2, 3)
+
+
+def test_collective_chaos_falls_back_to_host_staged():
+    """A faulted mesh collective degrades to the per-partition store
+    instead of failing the query."""
+    from spark_rapids_tpu.aux.tracing import last_query_summary
+    from spark_rapids_tpu.parallel import data_mesh
+    from spark_rapids_tpu.parallel.mesh import set_active_mesh
+    rng = np.random.default_rng(9)
+    data = {"k": rng.integers(0, 40, 2000).astype(np.int64),
+            "v": np.round(rng.standard_normal(2000), 3)}
+
+    def q(s):
+        from spark_rapids_tpu import functions as Fn
+        df = s.create_dataframe(data, num_partitions=8)
+        return df.group_by("k").agg(Fn.sum("v").alias("sv"),
+                                    Fn.count("*").alias("c"))
+
+    cpu = TpuSession(TpuConf({"spark.rapids.sql.enabled": "false"}),
+                     init_device=False)
+    expect = sorted(map(str, q(cpu).collect()))
+    ctx = data_mesh(8)
+    set_active_mesh(ctx)
+    try:
+        s = TpuSession(TpuConf(
+            {"spark.rapids.sql.enabled": "true",
+             "spark.rapids.chaos.parallel.collective": "1"}))
+        got = sorted(map(str, q(s).collect()))
+    finally:
+        set_active_mesh(None)
+    assert got == expect
+    rec = (last_query_summary() or {}).get("recovery") or {}
+    assert rec.get("collective_fallbacks", 0) >= 1, rec

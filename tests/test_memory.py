@@ -161,6 +161,57 @@ class TestRetry:
 
         outer()
 
+    def test_gen_early_exit_closes_queued_spillables(self, catalog):
+        """Max-retries MemoryError mid-queue: the failing input and every
+        spillable still queued must close (they would otherwise pin
+        catalog bytes until process exit)."""
+        sbs = [SpillableColumnarBatch.from_device(make_batch(100, seed=i),
+                                                  catalog=catalog)
+               for i in range(3)]
+        calls = []
+
+        def work(s):
+            calls.append(s)
+            R.maybe_inject_oom()
+            return s.row_count
+
+        gen = R.with_retry(list(sbs), work, max_retries=1)
+        assert next(gen) == 100            # sbs[0] passes clean
+        R.force_retry_oom(5)               # more faults than the budget
+        with pytest.raises(MemoryError):
+            list(gen)
+        ctx = R.task_context()
+        ctx.inject_retry_oom = 0           # disarm leftovers
+        # sbs[1] failed out, sbs[2] never ran: both closed; sbs[0] was
+        # consumed (ownership passed to `work`) and stays open
+        assert not sbs[0].closed
+        assert sbs[1].closed and sbs[2].closed
+
+    def test_gen_abandoned_iteration_closes_queue(self, catalog):
+        """Caller abandons iteration (short-circuiting limit): queued
+        spillables close; the item whose result was already delivered
+        belongs to the caller and stays open."""
+        sbs = [SpillableColumnarBatch.from_device(make_batch(50, seed=i),
+                                                  catalog=catalog)
+               for i in range(3)]
+        gen = R.with_retry(list(sbs), lambda s: s.row_count)
+        assert next(gen) == 50
+        gen.close()                        # abandon after one item
+        assert not sbs[0].closed
+        assert sbs[1].closed and sbs[2].closed
+
+    def test_gen_split_exhaustion_closes_remaining(self, catalog):
+        sbs = [SpillableColumnarBatch.from_device(make_batch(1, seed=i),
+                                                  catalog=catalog)
+               for i in range(2)]
+        R.force_split_and_retry_oom(10)
+        with pytest.raises(R.SplitAndRetryOOM):
+            list(R.with_retry(list(sbs), lambda s: R.maybe_inject_oom()))
+        ctx = R.task_context()
+        ctx.inject_split_oom = 0
+        # the 1-row batch cannot split: it and the queued one must close
+        assert sbs[0].closed and sbs[1].closed
+
     def test_auto_closeable_target_size(self):
         t = R.AutoCloseableTargetSize(1000, 300)
         t2 = t.split()

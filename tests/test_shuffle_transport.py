@@ -186,14 +186,19 @@ def test_client_surfaces_transport_errors():
 
 def test_client_detects_short_transfer():
     """Transfer acked but fewer data frames arrived than metadata promised
-    (the reference's degenerate-buffer case)."""
+    (the reference's degenerate-buffer case).  Retries pinned to zero so
+    the short-transfer cause surfaces directly (the retry wrapper would
+    otherwise re-attempt and wrap it in ShuffleFetchFailed)."""
+    from spark_rapids_tpu.shuffle.client_server import FetchRetryPolicy
     conn = MockConnection()
     b = ShuffleBlockId(5, 0, 2)
     conn.responses.append((TransactionStatus.SUCCESS, encode_message(
         MetadataResponse(1, (BlockMeta(b, 64, 2),)))))
     conn.responses.append((TransactionStatus.SUCCESS, encode_message(
         TransferResponse(2, True))))
-    client = ShuffleClient("c", MockTransport(conn))
+    client = ShuffleClient("c", MockTransport(conn),
+                           retry=FetchRetryPolicy(timeout_s=0.2,
+                                                  max_retries=0))
 
     class FakeServer:
         executor_id = "mock-peer"
@@ -294,6 +299,69 @@ def test_heartbeat_expiry():
     assert [e.executor_id for e in mgr.live_executors()] == ["e2"]
     with pytest.raises(KeyError):
         mgr.executor_heartbeat("e1")
+
+
+def test_heartbeat_expiry_full_lifecycle():
+    """register -> miss heartbeats -> expire (workerExpired event +
+    expiry listeners fired) -> re-register rejoins cleanly."""
+    from spark_rapids_tpu.aux.events import RingBufferSink, add_global_sink, \
+        remove_global_sink
+    clock = [0.0]
+    mgr = ShuffleHeartbeatManager(timeout_s=5, clock=lambda: clock[0])
+    invalidated = []
+    mgr.add_expiry_listener(invalidated.append)
+    mgr.register_executor("e1", endpoint="h1:1")
+    mgr.register_executor("e2", endpoint="h2:2")
+    # e2 keeps heartbeating, e1 goes silent
+    clock[0] = 4.0
+    mgr.executor_heartbeat("e2")
+    sink = RingBufferSink()
+    add_global_sink(sink)
+    try:
+        clock[0] = 7.0
+        assert mgr.expire_dead() == ["e1"]
+    finally:
+        remove_global_sink(sink)
+    assert invalidated == ["e1"]
+    kinds = [e.kind for e in sink.events()]
+    assert "workerExpired" in kinds
+    ev = next(e for e in sink.events() if e.kind == "workerExpired")
+    assert ev.payload["executor_id"] == "e1"
+    # a second sweep is idempotent
+    assert mgr.expire_dead() == []
+    assert invalidated == ["e1"]
+    # re-registration (worker restart at a new endpoint) rejoins: e2's
+    # next heartbeat learns the NEW incarnation
+    peers = mgr.register_executor("e1", endpoint="h1:99")
+    assert [p.executor_id for p in peers] == ["e2"]
+    new = mgr.executor_heartbeat("e2")
+    assert [(p.executor_id, p.endpoint) for p in new] == [("e1", "h1:99")]
+    assert {e.executor_id for e in mgr.live_executors()} == {"e1", "e2"}
+
+
+def test_heartbeat_expiry_listener_failure_does_not_block():
+    clock = [0.0]
+    mgr = ShuffleHeartbeatManager(timeout_s=1, clock=lambda: clock[0])
+    seen = []
+    mgr.add_expiry_listener(lambda eid: 1 / 0)     # broken listener
+    mgr.add_expiry_listener(seen.append)
+    mgr.register_executor("e1")
+    clock[0] = 5.0
+    assert mgr.expire_dead() == ["e1"]
+    assert seen == ["e1"]
+
+
+def test_catalog_drop_owner_invalidates_blocks():
+    cat = ShuffleBufferCatalog()
+    cat.add_batch(ShuffleBlockId(1, 0, 0), _hb(10), owner="e1")
+    cat.add_batch(ShuffleBlockId(1, 1, 0), _hb(10), owner="e2")
+    cat.add_frame(ShuffleBlockId(1, 2, 0), b"x")   # ownerless (local)
+    dropped = cat.drop_owner("e1")
+    assert dropped == [ShuffleBlockId(1, 0, 0)]
+    assert cat.frames(ShuffleBlockId(1, 0, 0)) == []
+    assert cat.frames(ShuffleBlockId(1, 1, 0)) != []
+    assert cat.frames(ShuffleBlockId(1, 2, 0)) == [b"x"]
+    assert cat.drop_owner("e1") == []              # idempotent
 
 
 def test_heartbeat_endpoint_wires_new_peers():
