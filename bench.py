@@ -200,6 +200,11 @@ def main():
     # Zeros are the healthy baseline; a regression here means the engine
     # is paying recovery cost on the happy path.
     out["chaos"] = _chaos_payload()
+    # pipelining ledger (PR-4 overlap layer): measured overlap ratio,
+    # producer/consumer stall seconds and peak spool depth across the run
+    # so BENCH_*.json tracks whether decode/transfer/compute actually
+    # overlapped (overlap_ratio 0 = fully serial boundaries)
+    out["pipeline"] = _pipeline_payload()
     # primary number exists: from here on the failsafe prints it verbatim
     signal.alarm(0)          # quiesce while the payload is swapped
     _PAYLOAD.clear()
@@ -212,6 +217,18 @@ def main():
     sys.stderr.write(json.dumps(out) + "\n")
     sys.stderr.flush()
     _arm(_remaining())
+
+    if os.environ.get("BENCH_SKIP_PIPELINE", "") != "1" and _remaining() > 30:
+        # transfer-overlap microbenchmark: the primary pipeline with
+        # prefetch spools on vs off, plus the overlap ratio measured over
+        # the pipelined runs (stall time below the serial sum = win)
+        try:
+            out["pipeline"]["microbench"] = \
+                _pipeline_microbench(tpu, data, parts)
+        except Exception as e:  # keep the primary metric reportable
+            out["pipeline"]["microbench_error"] = \
+                f"{type(e).__name__}: {e}"
+        _swap_payload(out)
 
     if os.environ.get("BENCH_SKIP_TPCDS", "") != "1" and _remaining() > 45:
         # TPC-DS before the scaling curve: per-query speedups are the
@@ -252,8 +269,15 @@ def main():
             out["scaling_error"] = f"{type(e).__name__}: {e}"
         _swap_payload(out)
 
-    # refresh the ledger with anything the follow-on phases absorbed
+    # refresh the ledgers with anything the follow-on phases absorbed
+    # (carrying the microbench result — or its failure marker — forward:
+    # a persistently failing microbenchmark must stay visible)
     out["chaos"] = _chaos_payload()
+    prev = out.get("pipeline", {})
+    out["pipeline"] = _pipeline_payload()
+    for k in ("microbench", "microbench_error"):
+        if k in prev:
+            out["pipeline"][k] = prev[k]
     signal.alarm(0)
     print(json.dumps(out))
     return 0
@@ -270,6 +294,53 @@ def _chaos_payload() -> dict:
     payload.update(recovery_stats())
     payload["faults_injected"] = sum(fault_stats().values())
     return payload
+
+
+def _pipeline_payload() -> dict:
+    """Pipelining counters observed so far this process (exec/pipeline.py
+    ledger): spool count, batches/bytes staged, producer/consumer stall
+    seconds, peak queue depth and the derived overlap ratio."""
+    from spark_rapids_tpu.exec.pipeline import pipeline_stats
+    return pipeline_stats()
+
+
+def _pipeline_microbench(tpu, data, parts) -> dict:
+    """Times the primary filter+project+agg pipeline with prefetch spools
+    disabled (fully serial boundaries) vs enabled over a fresh moderate
+    table, and reports the overlap ratio measured across the pipelined
+    runs.  Fresh tables per mode keep the comparison honest: both sides
+    pay the same upload/decode work the spools are meant to hide."""
+    from spark_rapids_tpu.exec.pipeline import pipeline_stats
+    n = min(2_000_000, len(next(iter(data.values()))))
+    sub = {k: v[:n] for k, v in data.items()}
+    res = {"rows": n}
+    before = None
+    try:
+        for key, flag in (("serial_s", "false"), ("piped_s", "true")):
+            tpu.set_conf("spark.rapids.pipeline.enabled", flag)
+            table = tpu.create_dataframe(sub, num_partitions=parts)
+            _query(table).collect()           # warm (compile + upload)
+            if flag == "true":
+                before = pipeline_stats()     # delta covers timed runs
+            best = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                _query(table).collect()
+                best = min(best, time.perf_counter() - t0)
+            res[key] = round(best, 4)
+    finally:
+        tpu.set_conf("spark.rapids.pipeline.enabled", "true")
+    after = pipeline_stats()
+    busy = after["producer_busy_s"] - before["producer_busy_s"]
+    stall = after["consumer_stall_s"] - before["consumer_stall_s"]
+    res["overlap_ratio"] = round(max(0.0, 1.0 - stall / busy), 4) \
+        if busy > 0 else 0.0
+    # (no peak_depth here: the ledger's peak is a run-wide max that can't
+    # be delta'd to this window; the top-level pipeline payload carries it)
+    if res["piped_s"] > 0:
+        res["speedup_vs_serial"] = round(res["serial_s"] / res["piped_s"],
+                                         3)
+    return res
 
 
 def _compact_summary(qm, max_nodes: int = 8):

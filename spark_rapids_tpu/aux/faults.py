@@ -15,6 +15,7 @@ same discipline covers every data-movement layer:
 - ``shuffle.connect``   transport connection setup (socket_transport.py)
 - ``task.run``          task start in the parallel runner (plan/base.py)
 - ``parallel.collective``  mesh collective shuffle (parallel/collective.py)
+- ``pipeline.prefetch`` prefetch-spool start (exec/pipeline.py producer)
 
 Semantics (mirroring ``force_retry_oom(num_ooms, skip)``): arming a point
 with ``n`` and ``skip`` makes the next ``skip`` triggers pass and the
@@ -212,6 +213,7 @@ CHAOS_POINTS: Dict[str, Tuple[str, Callable[[str], BaseException]]] = {
     "task.run": ("task.run", _default_exc),
     "parallel.collective": ("parallel.collective", _default_exc),
     "memory.alloc": ("memory.alloc", _retry_oom),
+    "pipeline.prefetch": ("pipeline.prefetch", _default_exc),
 }
 
 _CHAOS_PREFIX = "spark.rapids.chaos."

@@ -332,7 +332,13 @@ class QueryExecution:
                  f"{self.description!r} ({self.root.duration_s:.3f}s) =="]
 
         _SHORT = {"numOutputRows": "rows", "numOutputBatches": "batches",
-                  "opTime": "opTime", "streamTime": "streamTime"}
+                  "opTime": "opTime", "streamTime": "streamTime",
+                  # pipelining boundaries (exec/pipeline.py): measured
+                  # overlap per boundary — how long each side of the spool
+                  # waited on the other, and the deepest the queue ran
+                  "producerStallTime": "pStall",
+                  "consumerStallTime": "cStall",
+                  "peakQueueDepth": "qDepth"}
 
         def fmt(sp: Span) -> str:
             bits = []

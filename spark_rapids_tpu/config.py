@@ -108,8 +108,9 @@ def conf_str(key, doc, default, level=ConfLevel.COMMONLY_USED,
     return ConfEntry(key, doc, default, str, level, checker)
 
 
-def conf_bytes(key, doc, default, level=ConfLevel.COMMONLY_USED) -> ConfEntry[int]:
-    return ConfEntry(key, doc, default, _bytes_conv, level)
+def conf_bytes(key, doc, default, level=ConfLevel.COMMONLY_USED,
+               checker=None) -> ConfEntry[int]:
+    return ConfEntry(key, doc, default, _bytes_conv, level, checker)
 
 
 # ---------------------------------------------------------------------------
@@ -453,6 +454,38 @@ CHAOS_PARALLEL_COLLECTIVE = conf_str(
     "spark.rapids.chaos.parallel.collective",
     "Fault injection at the mesh collective shuffle ('n' or 'n:skip'); "
     "exercises the fallback to the host-staged exchange path.",
+    "", ConfLevel.INTERNAL,
+    checker=_chaos_spec_ok)
+
+PIPELINE_ENABLED = conf_bool(
+    "spark.rapids.pipeline.enabled",
+    "Pipelined execution: the planner inserts bounded-depth, thread-backed "
+    "prefetch boundaries (exec/pipeline.py) so host decode, host<->device "
+    "transfer and TPU compute overlap instead of serializing per batch.",
+    True)
+
+PIPELINE_DEPTH = conf_int(
+    "spark.rapids.pipeline.depth",
+    "Batches buffered per pipeline boundary (the prefetch spool's queue "
+    "depth).  Validated >= 1 at set_conf.",
+    2,
+    checker=lambda v: int(v) >= 1)
+
+PIPELINE_MAX_IN_FLIGHT_BYTES = conf_bytes(
+    "spark.rapids.pipeline.maxInFlightBytes",
+    "Byte budget for in-flight prefetched batches per boundary; a "
+    "producer blocks (releasing device admission) once queued bytes "
+    "exceed it.  Queued device batches also register with the spill "
+    "framework, so they count against — and can be evicted from — the "
+    "device-store budget.",
+    "256m",
+    checker=lambda v: int(v) >= 1)
+
+CHAOS_PIPELINE_PREFETCH = conf_str(
+    "spark.rapids.chaos.pipeline.prefetch",
+    "Fault injection at prefetch-spool start ('n' or 'n:skip'); exercises "
+    "producer-thread failure re-raise at the consumer and the task-retry "
+    "recovery path over pipelined plans.",
     "", ConfLevel.INTERNAL,
     checker=_chaos_spec_ok)
 

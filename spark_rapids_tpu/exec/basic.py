@@ -107,11 +107,13 @@ def upload_batches(batches):
     """Host->device upload with device admission (the semaphore is acquired
     before the first device use; released by run_task at task completion)."""
     from spark_rapids_tpu.memory.device_manager import get_runtime
+    from spark_rapids_tpu.plan.base import closing_source
     rt = get_runtime()
-    for hb in batches:
-        if rt is not None:
-            rt.semaphore.acquire_if_necessary()
-        yield hb.to_device()
+    with closing_source(iter(batches)) as it:
+        for hb in it:
+            if rt is not None:
+                rt.semaphore.acquire_if_necessary()
+            yield hb.to_device()
 
 
 class TpuInMemoryScanExec(CpuInMemoryScanExec):
@@ -343,16 +345,24 @@ class CpuLimitExec(UnaryExec):
         self.n = n
 
     def execute_partition(self, pidx):
+        from spark_rapids_tpu.plan.base import closing_source
         left = self.n
-        for b in self.child.execute_partition(pidx):
-            if left <= 0:
-                break
-            if b.row_count <= left:
-                left -= b.row_count
-                yield b
-            else:
-                yield b.slice(0, left)
-                left = 0
+        # budget check BEFORE pulling: a satisfied limit must not make
+        # the source decode one more batch just to discard it, and the
+        # deterministic close propagates the early exit upstream (stops
+        # prefetch producers, releases queued spillables)
+        with closing_source(self.child.execute_partition(pidx)) as it:
+            while left > 0:
+                try:
+                    b = next(it)
+                except StopIteration:
+                    return
+                if b.row_count <= left:
+                    left -= b.row_count
+                    yield b
+                else:
+                    yield b.slice(0, left)
+                    left = 0
 
     def node_desc(self):
         return f"Limit[{self.n}]"
@@ -373,38 +383,42 @@ def _deferred_limited(batches, n: int):
     from spark_rapids_tpu.columnar.column import (DeferredCount, _jnp,
                                                   rc_traceable)
     from spark_rapids_tpu.ops import take_front
+    from spark_rapids_tpu.plan.base import closing_source
     jnp = _jnp()
     left = n   # int until a deferred count is consumed
     deferred_batches = 0
-    it = iter(batches)
-    while True:
-        # budget check BEFORE pulling: a satisfied limit must not start
-        # the next partition's pipeline just to discard its first batch
-        if isinstance(left, int) and left <= 0:
-            return
-        try:
-            b = next(it)
-        except StopIteration:
-            return
-        rc = b.row_count
-        if isinstance(left, int) and \
-                not (isinstance(rc, DeferredCount) and not rc.is_forced):
-            if int(rc) <= left:
-                left -= int(rc)
-                yield b
-            else:
-                yield take_front(b, left)
-                left = 0
-            continue
-        out = take_front(b, left if isinstance(left, int)
-                         else DeferredCount(left))
-        left = jnp.maximum(
-            jnp.asarray(rc_traceable(left)) -
-            jnp.asarray(rc_traceable(out.row_count)), 0)
-        yield out
-        deferred_batches += 1
-        if deferred_batches % LIMIT_DEFERRED_FORCE_INTERVAL == 0:
-            left = int(_np.asarray(left))
+    # the satisfied-limit return (and a downstream close) must stop the
+    # source deterministically, not at GC time
+    with closing_source(iter(batches)) as it:
+        while True:
+            # budget check BEFORE pulling: a satisfied limit must not
+            # start the next partition's pipeline just to discard its
+            # first batch
+            if isinstance(left, int) and left <= 0:
+                return
+            try:
+                b = next(it)
+            except StopIteration:
+                return
+            rc = b.row_count
+            if isinstance(left, int) and \
+                    not (isinstance(rc, DeferredCount) and not rc.is_forced):
+                if int(rc) <= left:
+                    left -= int(rc)
+                    yield b
+                else:
+                    yield take_front(b, left)
+                    left = 0
+                continue
+            out = take_front(b, left if isinstance(left, int)
+                             else DeferredCount(left))
+            left = jnp.maximum(
+                jnp.asarray(rc_traceable(left)) -
+                jnp.asarray(rc_traceable(out.row_count)), 0)
+            yield out
+            deferred_batches += 1
+            if deferred_batches % LIMIT_DEFERRED_FORCE_INTERVAL == 0:
+                left = int(_np.asarray(left))
 
 
 class TpuLimitExec(UnaryExec):
@@ -479,19 +493,25 @@ class CpuGlobalLimitExec(UnaryExec):
         return 1
 
     def _limited(self, slicer):
+        from spark_rapids_tpu.plan.base import closing_source
         left = self.n
         for cp in range(self.child.num_partitions):
             if left <= 0:
                 return
-            for b in self.child.execute_partition(cp):
-                if left <= 0:
-                    return
-                if b.row_count <= left:
-                    left -= b.row_count
-                    yield b
-                else:
-                    yield slicer(b, left)
-                    left = 0
+            # check before every pull so a budget exhausted mid-partition
+            # never decodes the discarded next batch
+            with closing_source(self.child.execute_partition(cp)) as it:
+                while left > 0:
+                    try:
+                        b = next(it)
+                    except StopIteration:
+                        break
+                    if b.row_count <= left:
+                        left -= b.row_count
+                        yield b
+                    else:
+                        yield slicer(b, left)
+                        left = 0
 
     def execute_partition(self, pidx):
         yield from self._limited(lambda b, k: b.slice(0, k))

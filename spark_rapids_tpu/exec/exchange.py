@@ -76,6 +76,7 @@ class _LazyPartitions:
         self._cache: Dict[int, List] = {}
         self._inflight: Dict[int, "threading.Event"] = {}
         self._lock = threading.Lock()
+        self._bg = None
 
     #: optional callback fired once every partition has been fetched
     #: (storage can be released; results stay in the cache)
@@ -92,6 +93,14 @@ class _LazyPartitions:
             else:
                 ev = (ev, "waiter")
         if isinstance(ev, tuple):
+            # blocking on another thread's in-flight fetch: drop device
+            # admission first (the fetcher may be a bare warm thread whose
+            # CACHED-mode map re-run needs a permit — holding ours while
+            # waiting on it would deadlock the semaphore); re-acquired
+            # lazily at the next device section / spool dequeue
+            from spark_rapids_tpu.plan.base import \
+                release_semaphore_for_wait
+            release_semaphore_for_wait()
             ev[0].wait()
             return self[pidx]   # cached now; re-fetches if the owner failed
         try:
@@ -115,6 +124,52 @@ class _LazyPartitions:
 
     def __len__(self):
         return self._n
+
+    def prefetch(self, pidx: int) -> None:
+        """Asynchronously warms ``pidx`` (pipelined shuffle read: the next
+        reduce partition's frames fetch/deserialize while the current one
+        is joined/aggregated).  At most ONE background fetch runs per
+        store; errors are swallowed — the consumer's own access retries
+        through the normal failure path, so a failed warm can neither
+        poison the cache nor double-report a fault."""
+        import contextvars
+        import threading
+        if pidx < 0 or pidx >= self._n:
+            return
+        with self._lock:
+            if pidx in self._cache or pidx in self._inflight:
+                return
+            bg = self._bg
+            if bg is not None and bg.is_alive():
+                return
+
+            def warm():
+                try:
+                    self[pidx]
+                except BaseException:   # noqa: BLE001 - see docstring
+                    pass
+                finally:
+                    # a CACHED-mode short fetch re-runs map tasks whose
+                    # device sections acquire admission under THIS
+                    # thread's identity; no task-completion listener
+                    # covers a warm thread, so drop any hold ourselves
+                    # (a leaked holder entry would pin a permit forever)
+                    from spark_rapids_tpu.memory.device_manager import \
+                        get_runtime
+                    rt = get_runtime()
+                    if rt is not None:
+                        rt.semaphore.release_all()
+
+            # carry the active query context so fetch events attribute
+            ctx = contextvars.copy_context()
+            t = threading.Thread(target=ctx.run, args=(warm,),
+                                 name="tpu-prefetch-shuffle", daemon=True)
+            self._bg = t
+            # started INSIDE the lock: a not-yet-started thread reads as
+            # not alive, and a concurrent prefetch would slip past the
+            # single-flight guard (the warm itself blocks on this lock
+            # only momentarily at its own bookkeeping)
+            t.start()
 
 
 class CpuShuffleExchangeExec(UnaryExec):
@@ -154,12 +209,17 @@ class CpuShuffleExchangeExec(UnaryExec):
         return out
 
     def _map_pairs(self, mp: int, n: int):
+        from spark_rapids_tpu.plan.base import closing_source
         part = self.partitioning
         if isinstance(part, RoundRobinPartitioning):
             part = RoundRobinPartitioning(n, start=mp)
-        for hb in self.child.execute_partition(mp):
-            pids = part.partition_ids_cpu(hb)
-            yield from self._split_pairs(hb, pids, n)
+        # early exit (a stopped map task) must close the child chain
+        # deterministically — queued spillables/prefetch threads upstream
+        # release now, not at GC
+        with closing_source(self.child.execute_partition(mp)) as it:
+            for hb in it:
+                pids = part.partition_ids_cpu(hb)
+                yield from self._split_pairs(hb, pids, n)
 
     def _materialize(self):
         if self._store is not None:
@@ -343,7 +403,17 @@ class CpuShuffleExchangeExec(UnaryExec):
             release_semaphore_for_wait()
             with self._exec_lock:
                 self._materialize()
+        self._prefetch_next(pidx)
         yield from self._store[pidx]
+
+    def _prefetch_next(self, pidx: int) -> None:
+        """Pipelined shuffle read: while this reduce partition streams to
+        its consumer, the NEXT one's fetch/deserialize runs in the
+        background (lazy stores only — an eager store is already local)."""
+        import spark_rapids_tpu.exec.pipeline as _PL
+        if _PL.PIPELINE_ENABLED and isinstance(self._store,
+                                               _LazyPartitions):
+            self._store.prefetch(pidx + 1)
 
     def node_desc(self):
         return f"Exchange[{self.partitioning.desc()}]"
@@ -488,6 +558,7 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
         shrink_threshold = SHRINK_THRESHOLD_BYTES
 
         def map_gen(mp):
+            from spark_rapids_tpu.plan.base import closing_source
             p_eff = part
             if isinstance(part, RoundRobinPartitioning):
                 p_eff = RoundRobinPartitioning(n, start=mp)
@@ -495,8 +566,13 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
             # count syncs would defeat the host-staging fallback below):
             # only batches whose n-fold footprint is material pay the
             # shrink (and its one count sync); small batches flow through
-            # sync-free with deferred counts
-            for b in self.child.execute_partition(mp):
+            # sync-free with deferred counts.  closing_source: an
+            # abandoned map task stops the chain now, not at GC
+            with closing_source(self.child.execute_partition(mp)) as it:
+                yield from _map_core(it, mp, p_eff)
+
+        def _map_core(it, mp, p_eff):
+            for b in it:
                 # cap the n-fold storage cost: drop padding before the
                 # per-partition compacts
                 if b.nbytes() * n > shrink_threshold:
@@ -572,6 +648,8 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
             release_semaphore_for_wait()
             with self._exec_lock:
                 self._materialize()
+        if self._store is not None:
+            self._prefetch_next(pidx)
         if self._collective is not None:
             from spark_rapids_tpu.parallel import collective as C
             ctx, cols, counts, schema = self._collective
@@ -592,11 +670,13 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
         """Device shuffle write: pid eval + stable sort-by-pid on device,
         ONE host copy, then arrow slicing per reduce partition (shared
         per-batch core: ``_slice_host_pairs``)."""
+        from spark_rapids_tpu.plan.base import closing_source
         part = self.partitioning
         if isinstance(part, RoundRobinPartitioning):
             part = RoundRobinPartitioning(n, start=mp)
-        for b in self.child.execute_partition(mp):
-            yield from self._slice_host_pairs(b, part, n)
+        with closing_source(self.child.execute_partition(mp)) as it:
+            for b in it:
+                yield from self._slice_host_pairs(b, part, n)
 
     def _compute_bounds(self):
         self._compute_bounds_tpu()

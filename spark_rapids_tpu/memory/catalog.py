@@ -61,7 +61,7 @@ class BufferHandle:
 
 class _Buffer:
     __slots__ = ("handle", "tier", "device_batch", "host_batch", "disk_path",
-                 "device_nbytes", "host_nbytes", "spillable")
+                 "device_nbytes", "host_nbytes", "spillable", "owned")
 
     def __init__(self, handle: BufferHandle):
         self.handle = handle
@@ -72,6 +72,14 @@ class _Buffer:
         self.device_nbytes = 0
         self.host_nbytes = 0
         self.spillable = True
+        #: True = the catalog exclusively owns the device arrays and may
+        #: .delete() them on spill/remove.  False = the arrays may be
+        #: shared with other holders (scan device caches, exchange
+        #: stores, a consumer using the batch right now): spill/remove
+        #: only DROP the catalog's reference — HBM frees when the last
+        #: Python reference does.  In-flight pipeline prefetch registers
+        #: this way (exec/pipeline.py).
+        self.owned = True
 
 
 def _delete_device_batch(batch: ColumnarBatch) -> None:
@@ -131,7 +139,8 @@ class BufferCatalog:
     # -- registration -------------------------------------------------------
     def add_device_batch(self, batch: ColumnarBatch,
                          priority: int = SpillPriority.ACTIVE_BATCHING,
-                         spillable: bool = True) -> BufferHandle:
+                         spillable: bool = True,
+                         owned: bool = True) -> BufferHandle:
         nbytes = batch.nbytes()
         self.reserve(nbytes)
         with self._lock:
@@ -140,6 +149,7 @@ class BufferCatalog:
             buf.device_batch = batch
             buf.device_nbytes = nbytes
             buf.spillable = spillable
+            buf.owned = owned
             buf.tier = StorageTier.DEVICE
             self._buffers[handle.id] = buf
             self.device_bytes += nbytes
@@ -224,7 +234,8 @@ class BufferCatalog:
                 return
             if buf.device_batch is not None:
                 self.device_bytes -= buf.device_nbytes
-                _delete_device_batch(buf.device_batch)
+                if buf.owned:
+                    _delete_device_batch(buf.device_batch)
             if buf.host_batch is not None:
                 self.host_bytes -= buf.host_nbytes
             if buf.disk_path is not None:
@@ -257,7 +268,8 @@ class BufferCatalog:
             if freed >= needed:
                 break
             host = buf.device_batch.to_host()
-            _delete_device_batch(buf.device_batch)
+            if buf.owned:
+                _delete_device_batch(buf.device_batch)
             self.device_bytes -= buf.device_nbytes
             freed += buf.device_nbytes
             buf.device_batch = None

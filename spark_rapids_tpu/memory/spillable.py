@@ -38,10 +38,15 @@ class SpillableColumnarBatch:
     @staticmethod
     def from_device(batch: ColumnarBatch,
                     priority: int = SpillPriority.ACTIVE_BATCHING,
-                    catalog: Optional[BufferCatalog] = None
-                    ) -> "SpillableColumnarBatch":
+                    catalog: Optional[BufferCatalog] = None,
+                    owned: bool = True) -> "SpillableColumnarBatch":
+        """``owned=False`` registers WITHOUT transferring array ownership:
+        spill/close drop the catalog's reference instead of .delete()ing —
+        required when the batch's arrays may be shared (scan device
+        caches, exchange stores) or are handed onward while registered
+        (pipeline prefetch queues)."""
         cat = catalog or _default_catalog()
-        handle = cat.add_device_batch(batch, priority)
+        handle = cat.add_device_batch(batch, priority, owned=owned)
         return SpillableColumnarBatch(handle, cat, batch.row_count,
                                       batch.sized_nbytes(), priority)
 

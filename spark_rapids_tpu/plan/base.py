@@ -14,6 +14,7 @@ preserve it, exchanges change it (shuffle layer).
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import threading
 from typing import Iterator, List, Optional, Sequence
@@ -115,6 +116,34 @@ def _should_retry_task(e: BaseException, produced: int, attempts: int,
     return retry, retryable
 
 
+def close_iter(it) -> None:
+    """Explicitly closes a generator/iterator if it supports close().
+
+    Abandoning a suspended generator leaves its cleanup to GC; the
+    pipelined chains (exec/pipeline.py spools, spillable-queueing retry
+    generators) need DETERMINISTIC close propagation so early exit
+    releases queued spillables and stops producer threads immediately."""
+    close = getattr(it, "close", None)
+    if close is None:
+        return
+    try:
+        close()
+    except Exception:   # noqa: BLE001 - cleanup must not mask the cause
+        pass
+
+
+@contextlib.contextmanager
+def closing_source(it):
+    """``with closing_source(child.execute_partition(p)) as it:`` — the
+    generator-chain form of ``close_iter``: whatever exits the block
+    (exhaustion, failure, or a downstream ``.close()`` arriving as
+    GeneratorExit) closes the source deterministically."""
+    try:
+        yield it
+    finally:
+        close_iter(it)
+
+
 def _task_attempts_iter(task_fn, p: int, breaker=None):
     """Drives ``task_fn(p)`` with task-level retry: a retryable failure
     that strikes BEFORE the first item is yielded re-runs the task (fresh
@@ -126,8 +155,9 @@ def _task_attempts_iter(task_fn, p: int, breaker=None):
     attempts = 0
     while True:
         produced = 0
+        it = task_fn(p)
         try:
-            for item in task_fn(p):
+            for item in it:
                 produced += 1
                 yield item
             return
@@ -139,6 +169,11 @@ def _task_attempts_iter(task_fn, p: int, breaker=None):
                                           breaker)
             if not retry:
                 raise
+        finally:
+            # runs on exhaustion (no-op), on failure, and when the
+            # consumer closes THIS generator at the yield (GeneratorExit):
+            # the task's chain tears down deterministically either way
+            close_iter(it)
 
 
 class Exec:
@@ -354,8 +389,9 @@ def iter_partition_tasks(task_fn, n: int, workers: Optional[int] = None):
         try:
             while True:
                 produced = 0
+                it = task_fn(p)
                 try:
-                    for b in task_fn(p):
+                    for b in it:
                         produced += 1
                         if stop.is_set() or not put(q, b):
                             return
@@ -369,6 +405,11 @@ def iter_partition_tasks(task_fn, n: int, workers: Optional[int] = None):
                         continue
                     put(q, _PartitionError(e, can_rerun=retryable))
                     return
+                finally:
+                    # a consumer that abandoned the stage (stop set) must
+                    # not leave this task's chain to GC: close releases
+                    # queued spillables / prefetch threads upstream NOW
+                    close_iter(it)
         finally:
             put(q, _DONE)
 

@@ -577,6 +577,13 @@ class TpuOverrides:
         _TR._DL_SPEC_ROWS = conf.get(C.DOWNLOAD_SPECULATIVE_ROWS.key)
         _XB2.LIMIT_DEFERRED_FORCE_INTERVAL = conf.get(
             C.LIMIT_DEFERRED_FORCE_INTERVAL.key)
+        # pipelined-execution knobs (exec/pipeline.py spools + the
+        # shuffle-read next-partition warm in exec/exchange.py)
+        import spark_rapids_tpu.exec.pipeline as _PL
+        _PL.PIPELINE_ENABLED = conf.get(C.PIPELINE_ENABLED.key)
+        _PL.PIPELINE_DEPTH = conf.get(C.PIPELINE_DEPTH.key)
+        _PL.PIPELINE_MAX_BYTES = C.parse_bytes(
+            conf.get(C.PIPELINE_MAX_IN_FLIGHT_BYTES.key))
         # ENABLE-only: benchmark setups interleave an enabled session
         # with a default-conf sanity session, whose every plan compile
         # would otherwise wipe the cache mid-run; releasing the process-
@@ -629,6 +636,13 @@ class TpuOverrides:
             validate_all_on_device(out, conf)
         from spark_rapids_tpu.aux.capture import ExecutionPlanCaptureCallback
         ExecutionPlanCaptureCallback.capture_if_needed(plan, out, meta)
+        if conf.get(C.PIPELINE_ENABLED.key):
+            # LAST structural pass (after validate/capture: the prefetch
+            # boundary is transparent to placement assertions and plan-
+            # shape tests): overlap decode / transfer / compute / download
+            from spark_rapids_tpu.exec.pipeline import \
+                insert_pipeline_prefetch
+            out = insert_pipeline_prefetch(out)
         if not for_explain:
             # never on the explain path: instrument_plan resets the shared
             # per-node counters, and introspection must not zero the

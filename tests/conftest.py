@@ -58,6 +58,30 @@ def pytest_collection_modifyitems(config, items):
 
 
 @pytest.fixture(autouse=True)
+def _no_prefetch_thread_leaks():
+    """Pipelining leak guard (exec/pipeline.py): every prefetch producer /
+    shuffle-warm thread must be gone after the test that spawned it —
+    early-exit paths (limits, abandoned fetches) included.  A short grace
+    covers producers mid-pull at teardown; anything still alive after it
+    is a stranded thread and fails the test."""
+    yield
+    import threading
+    import time
+
+    def stray():
+        return [t for t in threading.enumerate()
+                if t.is_alive() and t.name.startswith("tpu-prefetch")]
+
+    leaked = stray()
+    deadline = time.monotonic() + 5.0
+    while leaked and time.monotonic() < deadline:
+        time.sleep(0.02)
+        leaked = stray()
+    assert not leaked, \
+        f"leaked prefetch threads: {[t.name for t in leaked]}"
+
+
+@pytest.fixture(autouse=True)
 def _bound_process_memory(request):
     """The TPC-DS differential tier runs 44 queries x 2 engines in one
     process; per-shape jitted programs and process-wide scan caches

@@ -1,0 +1,500 @@
+"""Pipelined-execution tests: bounded-depth prefetch spools.
+
+Methodology mirrors test_faults.py: every behavior test asserts
+(a) results bit-identical to the fully serial path and (b) the
+bookkeeping that proves the pipelining actually engaged (spool stats,
+pipelineSpool events) or tore down (no stranded threads — enforced for
+EVERY test by the autouse conftest fixture — and no leaked spillables).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.exec import pipeline as PL
+from spark_rapids_tpu.expressions import arithmetic as A
+from spark_rapids_tpu.expressions import predicates as P
+from spark_rapids_tpu.expressions.base import Alias, col, lit
+from spark_rapids_tpu.session import TpuSession
+
+
+@pytest.fixture(autouse=True)
+def _fresh_pipeline_state():
+    from spark_rapids_tpu.aux import faults as FA
+    FA.disarm_all()
+    PL.reset_pipeline_stats()
+    yield
+    FA.disarm_all()
+
+
+def _session(**overrides):
+    conf = {"spark.rapids.sql.enabled": "true"}
+    conf.update(overrides)
+    return TpuSession(TpuConf(conf))
+
+
+RNG = np.random.default_rng(7)
+N = 4000
+
+
+def _data():
+    return {
+        "k": RNG.integers(0, 13, N).astype(np.int64),
+        "v": RNG.standard_normal(N),
+        "w": RNG.integers(-50, 50, N).astype(np.int32),
+    }
+
+
+_DATA = _data()
+
+
+def _rows(df):
+    return [tuple(sorted(r.items())) for r in df.collect()]
+
+
+# ---------------------------------------------------------------------------
+# spool unit semantics
+# ---------------------------------------------------------------------------
+
+class TestPrefetchSpool:
+    def test_order_and_exhaustion(self):
+        spool = PL.PrefetchSpool(lambda: iter(range(20)), depth=3,
+                                 max_bytes=1 << 20, boundary="t")
+        assert list(spool) == list(range(20))
+        assert spool.produced == 20
+        assert spool.peak_depth <= 3
+        spool.close()   # idempotent after exhaustion
+
+    def test_error_reraises_original_exception(self):
+        marker = ConnectionError("boom")
+
+        def gen():
+            yield 1
+            raise marker
+
+        spool = PL.PrefetchSpool(lambda: gen(), depth=2,
+                                 max_bytes=1 << 20, boundary="t")
+        it = iter(spool)
+        assert next(it) == 1
+        with pytest.raises(ConnectionError) as ei:
+            while True:
+                next(it)
+        # the ORIGINAL exception object travels (lineage/classification
+        # for the task-retry machinery stays intact)
+        assert ei.value is marker
+        spool.close()
+
+    def test_error_before_first_item_is_zero_yield(self):
+        """A producer failure before any item reaches the consumer must
+        surface before the consumer yields anything — the precondition
+        for PR 3's lossless task re-run."""
+        def gen():
+            raise TimeoutError("early")
+            yield  # noqa: unreachable - makes this a generator
+
+        spool = PL.PrefetchSpool(lambda: gen(), depth=2,
+                                 max_bytes=1 << 20, boundary="t")
+        with pytest.raises(TimeoutError):
+            next(iter(spool))
+        spool.close()
+
+    def test_close_stops_producer_and_closes_source(self):
+        state = {"closed": False, "produced": 0}
+
+        def gen():
+            try:
+                for i in range(10_000):
+                    state["produced"] += 1
+                    yield i
+            finally:
+                state["closed"] = True
+
+        spool = PL.PrefetchSpool(lambda: gen(), depth=2,
+                                 max_bytes=1 << 30, boundary="t")
+        it = iter(spool)
+        assert next(it) == 0
+        spool.close()
+        t = spool._thread
+        t.join(timeout=5)
+        assert not t.is_alive()
+        # upstream generator was close()d IN the producer thread, and the
+        # bounded queue kept it from racing ahead
+        assert state["closed"]
+        assert state["produced"] < 10_000
+
+    def test_depth_bound_blocks_producer(self):
+        ev = threading.Event()
+
+        def gen():
+            for i in range(50):
+                yield i
+            ev.set()
+
+        spool = PL.PrefetchSpool(lambda: gen(), depth=2,
+                                 max_bytes=1 << 30, boundary="t")
+        it = iter(spool)
+        next(it)
+        # producer must park on the full queue, not run to exhaustion
+        assert not ev.wait(0.3)
+        assert spool.peak_depth <= 2
+        assert list(it) == list(range(1, 50))
+        spool.close()
+
+    def test_byte_budget_admits_at_least_one(self):
+        class Fat:
+            def nbytes(self):
+                return 1 << 20
+
+        spool = PL.PrefetchSpool(lambda: iter([Fat(), Fat(), Fat()]),
+                                 depth=8, max_bytes=10, boundary="t")
+        out = list(spool)
+        assert len(out) == 3            # oversize items still flow
+        assert spool.peak_depth == 1    # ...one at a time
+        spool.close()
+
+    def test_queued_device_batches_register_and_release(self):
+        """In-flight prefetched device batches are catalog-registered
+        (spillable, budget-counted) and released on dequeue AND on early
+        close — without destroying arrays the upstream still shares."""
+        from spark_rapids_tpu.columnar.batch import batch_from_pydict
+        from spark_rapids_tpu.memory.device_manager import get_runtime, \
+            initialize
+        rt = get_runtime() or initialize()
+        cat = rt.catalog
+        base = cat.stats()["buffers"]
+
+        batches = [batch_from_pydict(
+            {"x": np.arange(64, dtype=np.int64)}).to_device()
+            for _ in range(4)]
+
+        def gen():
+            yield from batches
+
+        spool = PL.PrefetchSpool(lambda: gen(), depth=4,
+                                 max_bytes=1 << 30, boundary="t")
+        it = iter(spool)
+        got = next(it)
+        # let the producer queue the rest
+        deadline = time.monotonic() + 5
+        while spool.produced < 4 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert cat.stats()["buffers"] > base   # queued = registered
+        spool.close()
+        assert cat.stats()["buffers"] == base  # closed = released
+        # the batch handed out (and the upstream's shared arrays) survive
+        assert int(np.asarray(got.columns[0].data)[5]) == 5
+        for b in batches:
+            assert int(np.asarray(b.columns[0].data)[3]) == 3
+
+
+# ---------------------------------------------------------------------------
+# bit-identical results: pipelining on (default) vs off
+# ---------------------------------------------------------------------------
+
+class TestBitIdentical:
+    def _check(self, build):
+        on = _session()
+        off = _session(**{"spark.rapids.pipeline.enabled": "false"})
+        r_on = sorted(_rows(build(on)))
+        r_off = sorted(_rows(build(off)))
+        assert r_on == r_off
+        return r_on
+
+    def test_scan_filter_project(self):
+        rows = self._check(
+            lambda s: s.create_dataframe(_DATA, num_partitions=3)
+            .filter(P.GreaterThan(col("w"), lit(0)))
+            .select(col("k"), Alias(A.Multiply(col("v"), lit(2.0)), "v2")))
+        assert rows   # non-vacuous
+
+    def test_aggregate(self):
+        rows = self._check(
+            lambda s: s.create_dataframe(_DATA, num_partitions=4)
+            .group_by("k").agg(F.sum("v").alias("sv"),
+                               F.count("v").alias("c")))
+        assert len(rows) == 13
+
+    def test_join(self):
+        dim = {"k": np.arange(13, dtype=np.int64),
+               "name": [f"g{i}" for i in range(13)]}
+
+        def build(s):
+            left = s.create_dataframe(_DATA, num_partitions=3)
+            right = s.create_dataframe(dim, num_partitions=2)
+            return left.join(right, on="k").group_by("name").agg(
+                F.sum("w").alias("sw"))
+
+        rows = self._check(build)
+        assert len(rows) == 13
+
+    def test_limit(self):
+        rows = self._check(
+            lambda s: s.create_dataframe(_DATA, num_partitions=4)
+            .select(col("k")).limit(37))
+        assert len(rows) == 37
+
+    def test_multithreaded_shuffle_read(self):
+        """The lazy shuffle store's next-partition warm must not change
+        results (MULTITHREADED mode exercises _LazyPartitions)."""
+        rows = self._check(
+            lambda s: s.create_dataframe(
+                _DATA, num_partitions=3)
+            .group_by("k").agg(F.sum("v").alias("sv")))
+        assert rows
+
+    def test_pipelining_engaged_and_observable(self):
+        PL.reset_pipeline_stats()
+        s = _session()
+        df = s.create_dataframe(_DATA, num_partitions=3) \
+            .filter(P.GreaterThan(col("w"), lit(0))) \
+            .group_by("k").agg(F.sum("v").alias("sv"))
+        df.collect()
+        st = PL.pipeline_stats()
+        assert st["spools"] > 0 and st["batches"] > 0
+        assert "overlap_ratio" in st
+        # explain(analyze=True) shows the per-boundary stall metrics
+        text = df.explain(analyze=True)
+        assert "Prefetch[" in text and "pStall" in text
+
+    def test_pipeline_spool_events_in_query_ring(self):
+        from spark_rapids_tpu.aux.tracing import QueryExecution
+        s = _session()
+        df = s.create_dataframe(_DATA, num_partitions=2) \
+            .select(Alias(A.Add(col("k"), lit(1)), "k1"))
+        qe = QueryExecution.from_conf(s.conf, "pipeline-events")
+        with qe:
+            df.collect_batch()
+        kinds = {ev.kind for ev in qe.events()}
+        assert "pipelineSpool" in kinds
+
+
+# ---------------------------------------------------------------------------
+# early exit: a satisfied limit stops the source
+# ---------------------------------------------------------------------------
+
+class _CountingSource:
+    """Leaf exec recording how many batches each partition decoded and
+    whether its generator was closed."""
+
+    def __init__(self, parts=2, batches=6, rows=10):
+        from spark_rapids_tpu.exec.basic import CpuInMemoryScanExec  # noqa
+        self.parts = parts
+        self.batches = batches
+        self.rows = rows
+        self.pulled = [0] * parts
+        self.closed = [False] * parts
+
+    def make_exec(self):
+        from spark_rapids_tpu import types as T
+        from spark_rapids_tpu.columnar.batch import batch_from_pydict
+        from spark_rapids_tpu.plan.base import LeafExec
+        src = self
+
+        class _Exec(LeafExec):
+            @property
+            def schema(self):
+                return T.StructType([T.StructField("x", T.LONG, False)])
+
+            @property
+            def num_partitions(self):
+                return src.parts
+
+            def execute_partition(self, pidx):
+                try:
+                    for i in range(src.batches):
+                        src.pulled[pidx] += 1
+                        yield batch_from_pydict(
+                            {"x": np.arange(src.rows, dtype=np.int64)})
+                finally:
+                    src.closed[pidx] = True
+
+        return _Exec()
+
+
+class TestLimitEarlyExit:
+    def test_local_limit_stops_before_next_pull(self):
+        from spark_rapids_tpu.exec.basic import CpuLimitExec
+        src = _CountingSource(parts=1, batches=6, rows=10)
+        out = list(CpuLimitExec(20, src.make_exec()).execute_partition(0))
+        assert sum(b.row_count for b in out) == 20
+        # 2 batches satisfy the limit; the third is never decoded
+        assert src.pulled[0] == 2
+        assert src.closed[0]
+
+    def test_global_limit_skips_later_partitions(self):
+        from spark_rapids_tpu.exec.basic import CpuGlobalLimitExec
+        src = _CountingSource(parts=3, batches=4, rows=10)
+        out = list(CpuGlobalLimitExec(
+            15, src.make_exec()).execute_partition(0))
+        assert sum(b.row_count for b in out) == 15
+        assert src.pulled[0] == 2       # exact budget, no discard pull
+        assert src.pulled[1] == 0 and src.pulled[2] == 0
+        assert src.closed[0]
+
+    def test_deferred_limited_closes_source(self):
+        from spark_rapids_tpu.exec.basic import _deferred_limited
+        state = {"closed": False}
+
+        def gen():
+            from spark_rapids_tpu.columnar.batch import batch_from_pydict
+            try:
+                while True:
+                    yield batch_from_pydict(
+                        {"x": np.arange(8, dtype=np.int64)}).to_device()
+            finally:
+                state["closed"] = True
+
+        out = list(_deferred_limited(gen(), 12))
+        total = sum(int(b.row_count) for b in out)
+        assert total == 12
+        assert state["closed"]
+
+    def test_limit_over_pipelined_plan_no_thread_leak(self):
+        """End to end: a short limit over a pipelined multi-partition plan
+        tears every spool down (the conftest leak fixture enforces the
+        thread side; spillable release is the spool-close contract)."""
+        s = _session()
+        df = s.create_dataframe(_DATA, num_partitions=4) \
+            .filter(P.GreaterThanOrEqual(col("w"), lit(-100))) \
+            .select(col("k"), col("v")).limit(11)
+        assert len(df.collect()) == 11
+
+
+# ---------------------------------------------------------------------------
+# failure propagation: chaos at the prefetch point
+# ---------------------------------------------------------------------------
+
+class TestPrefetchChaos:
+    def test_injected_prefetch_fault_recovers_bit_identical(self):
+        from spark_rapids_tpu.aux.tracing import last_query_summary
+        expected = sorted(_rows(
+            _session().create_dataframe(_DATA, num_partitions=3)
+            .group_by("k").agg(F.sum("v").alias("sv"))))
+        s = _session(**{"spark.rapids.chaos.pipeline.prefetch": "1"})
+        got = sorted(_rows(
+            s.create_dataframe(_DATA, num_partitions=3)
+            .group_by("k").agg(F.sum("v").alias("sv"))))
+        assert got == expected
+        summary = last_query_summary()
+        rec = (summary or {}).get("recovery", {})
+        # the fault fired in a producer thread, re-raised at the consumer
+        # with zero output, and the task-level retry absorbed it
+        assert rec.get("faults_injected", 0) >= 1
+        assert rec.get("task_retries", 0) >= 1
+
+    def test_unrecoverable_after_output_propagates(self):
+        """A fault that strikes after a spool delivered output cannot be
+        retried losslessly — it must surface, not silently re-run."""
+        marker = ValueError("not retryable")
+
+        def gen():
+            yield 1
+            raise marker
+
+        spool = PL.PrefetchSpool(lambda: gen(), depth=1,
+                                 max_bytes=1 << 20, boundary="t")
+        it = iter(spool)
+        next(it)
+        with pytest.raises(ValueError):
+            while True:
+                next(it)
+        spool.close()
+
+
+# ---------------------------------------------------------------------------
+# lazy shuffle store warm
+# ---------------------------------------------------------------------------
+
+class TestLazyPartitionPrefetch:
+    def test_prefetch_warms_next_partition(self):
+        from spark_rapids_tpu.exec.exchange import _LazyPartitions
+        calls = []
+        lp = _LazyPartitions(3, lambda p: (calls.append(p), [p])[1])
+        lp.prefetch(1)
+        deadline = time.monotonic() + 5
+        while 1 not in lp._cache and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert lp[1] == [1]
+        assert calls == [1]             # the warm WAS the fetch
+
+    def test_failed_prefetch_does_not_poison(self):
+        from spark_rapids_tpu.exec.exchange import _LazyPartitions
+        state = {"n": 0}
+
+        def fetch(p):
+            state["n"] += 1
+            if state["n"] == 1:
+                raise ConnectionError("transient")
+            return [p]
+
+        lp = _LazyPartitions(2, fetch)
+        lp.prefetch(0)
+        bg = lp._bg
+        if bg is not None:
+            bg.join(timeout=5)
+        assert lp[0] == [0]             # consumer's own access refetches
+
+    def test_out_of_range_is_noop(self):
+        from spark_rapids_tpu.exec.exchange import _LazyPartitions
+        lp = _LazyPartitions(2, lambda p: [p])
+        lp.prefetch(2)
+        lp.prefetch(-1)
+        assert lp._bg is None
+
+
+# ---------------------------------------------------------------------------
+# conf validation + docs
+# ---------------------------------------------------------------------------
+
+class TestPipelineConfs:
+    def test_depth_validates_at_set_conf(self):
+        s = TpuSession(TpuConf({"spark.rapids.sql.enabled": "false"}),
+                       init_device=False)
+        with pytest.raises(ValueError):
+            s.set_conf("spark.rapids.pipeline.depth", "0")
+        s.set_conf("spark.rapids.pipeline.depth", "4")
+        assert s.conf.get("spark.rapids.pipeline.depth") == 4
+
+    def test_byte_budget_parses_and_validates(self):
+        s = TpuSession(TpuConf({"spark.rapids.sql.enabled": "false"}),
+                       init_device=False)
+        with pytest.raises(ValueError):
+            s.set_conf("spark.rapids.pipeline.maxInFlightBytes", "0")
+        with pytest.raises(ValueError):
+            s.set_conf("spark.rapids.pipeline.maxInFlightBytes", "wat")
+        s.set_conf("spark.rapids.pipeline.maxInFlightBytes", "64m")
+        assert s.conf.get(
+            "spark.rapids.pipeline.maxInFlightBytes") == 64 << 20
+
+    def test_chaos_spec_validates(self):
+        s = TpuSession(TpuConf({"spark.rapids.sql.enabled": "false"}),
+                       init_device=False)
+        with pytest.raises(ValueError):
+            s.set_conf("spark.rapids.chaos.pipeline.prefetch", "x:y")
+        from spark_rapids_tpu.aux import faults as FA
+        s.set_conf("spark.rapids.chaos.pipeline.prefetch", "1")
+        assert FA.is_armed("pipeline.prefetch")
+        s.set_conf("spark.rapids.chaos.pipeline.prefetch", "")
+        assert not FA.is_armed("pipeline.prefetch")
+
+    def test_disabled_plan_has_no_prefetch_nodes(self):
+        s = _session(**{"spark.rapids.pipeline.enabled": "false"})
+        df = s.create_dataframe(_DATA, num_partitions=2).select(col("k"))
+        assert "Prefetch[" not in df._executed_plan().tree_string()
+
+    def test_insert_pass_is_idempotent(self):
+        """The pass mutates trees in place; a re-application (a future
+        re-plan over a cached tree) must not stack spools at any
+        boundary."""
+        s = _session()
+        df = s.create_dataframe(_DATA, num_partitions=2) \
+            .group_by("k").agg(F.sum("v").alias("sv"))
+        plan = df._executed_plan()
+        once = plan.tree_string()
+        assert "Prefetch[" in once
+        twice = PL.insert_pipeline_prefetch(plan).tree_string()
+        assert twice == once
