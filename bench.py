@@ -136,8 +136,24 @@ def main():
             best = min(best, time.perf_counter() - t0)
         return best, result
 
+    # event log for offline attribution: every traced query of the run
+    # appends here, and the payload records the path + a smoke parse via
+    # the offline toolkit (tools profile must always read what bench wrote)
+    ev_log = os.environ.get("BENCH_EVENT_LOG", "/tmp/bench_events.jsonl")
     try:
-        tpu = TpuSession(TpuConf({"spark.rapids.sql.enabled": "true"}))
+        # clear the base file AND its rotated siblings (the same set the
+        # reader would ingest), or a previous run's queries leak into
+        # this run's event_log payload
+        from spark_rapids_tpu.tools.reader import log_file_set
+        for stale in log_file_set(ev_log):
+            os.remove(stale)
+    except OSError:
+        ev_log = ""
+    tpu_conf = {"spark.rapids.sql.enabled": "true"}
+    if ev_log:
+        tpu_conf["spark.rapids.sql.eventLog.path"] = ev_log
+    try:
+        tpu = TpuSession(TpuConf(tpu_conf))
     except Exception as e:  # noqa: BLE001 — device backend unavailable
         # (tunnel down / misconfigured): record an honest error line
         # instead of dying output-less; only session INIT is wrapped so a
@@ -195,6 +211,10 @@ def main():
     }
     if tpu_query_metrics:
         out["query_metrics"] = tpu_query_metrics
+    # offline-toolkit smoke assertion: the log this run just wrote must
+    # parse through tools profile (reader + attribution) without error
+    if ev_log:
+        out["event_log"] = _event_log_payload(ev_log)
     # recovery-overhead ledger (PR-3 robustness layer): how many fetch
     # retries / failovers / task retries / breaker trips the run absorbed.
     # Zeros are the healthy baseline; a regression here means the engine
@@ -278,9 +298,32 @@ def main():
     for k in ("microbench", "microbench_error"):
         if k in prev:
             out["pipeline"][k] = prev[k]
+    if ev_log:
+        # re-parse so the payload covers the follow-on phases' queries too
+        out["event_log"] = _event_log_payload(ev_log)
     signal.alarm(0)
     print(json.dumps(out))
     return 0
+
+
+def _event_log_payload(path: str) -> dict:
+    """Smoke-parses the run's event log through the offline toolkit
+    (reader + per-query attribution) and records the verdict, so a
+    schema drift between the sink and the tools surfaces in BENCH_*.json
+    instead of months later on a real incident log."""
+    try:
+        from spark_rapids_tpu.tools.profile import attribute
+        from spark_rapids_tpu.tools.reader import load_profiles
+        profiles, diag = load_profiles(path)
+        for qp in profiles:
+            attribute(qp)     # attribution must never raise on own logs
+        return {"path": path, "profile_ok": True,
+                "queries": len(profiles),
+                "events": diag.parsed,
+                "truncated_lines": diag.truncated_lines}
+    except Exception as e:  # noqa: BLE001 - keep the primary metric alive
+        return {"path": path, "profile_ok": False,
+                "error": f"{type(e).__name__}: {e}"[:200]}
 
 
 def _chaos_payload() -> dict:

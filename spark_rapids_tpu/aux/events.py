@@ -12,30 +12,69 @@ layer emits a typed ``Event`` through one process-wide bus:
 - Events route to the active query's ring buffer + sinks (the query id
   and span id are stamped there), or to process-global sinks for
   daemon-thread emitters that run outside any query (heartbeats,
-  shuffle workers).
+  shuffle workers, the resource sampler).
 
 Sinks: ``JsonlEventLogSink`` (the event-log file analog, conf
-``spark.rapids.sql.eventLog.path``), ``RingBufferSink`` (in-memory, for
-tests and ``explain(analyze=True)``), and ``render_prometheus()`` — a
-text exposition of the registry's gauges/counters for scrapers.
+``spark.rapids.sql.eventLog.path``, with size-based rotation and optional
+gzip compression), ``RingBufferSink`` (in-memory, for tests and
+``explain(analyze=True)``), and ``render_prometheus()`` — a text
+exposition of the registry's gauges/counters for scrapers.
+
+Every ``emit(kind=...)`` call site in the package must use a kind from
+``EVENT_KINDS`` (pinned by a tier-1 ast test) so the offline reader
+(``spark_rapids_tpu.tools``) can rely on known schemas.
 """
 
 from __future__ import annotations
 
+import atexit
 import collections
 import contextvars
 import dataclasses
+import gzip
 import json
 import os
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional
 
-EVENT_SCHEMA_VERSION = 1
+#: v1 = PR 1 envelope (event/query_id/span_id/ts).  v2 adds the offline
+#: reader's structural fields: spanMetrics rows carry parent_id / depth /
+#: start_s / end_s / partitions, queryStart carries the non-default conf
+#: snapshot, and files open with an ``eventLogHeader`` line.  The reader
+#: (tools/reader.py) accepts both.
+EVENT_SCHEMA_VERSION = 2
 
 #: stamped on events emitted outside any query / span scope
 NO_QUERY = -1
 NO_SPAN = -1
+
+#: THE event-kind catalog: every ``emit(kind=...)`` / ``record_event``
+#: call site in the package uses one of these (tier-1 ast test), so the
+#: offline reader can rely on a closed vocabulary.  Grouped by emitter.
+EVENT_KINDS = frozenset({
+    # tracing lifecycle (aux/tracing.py)
+    "queryStart", "queryEnd", "spanMetrics",
+    # event-log file framing (this module)
+    "eventLogHeader",
+    # memory layer (memory/catalog.py, retry.py, semaphore.py, metrics.py)
+    "spill", "unspill", "oom", "retryOOM", "splitRetry",
+    "semaphoreAcquired", "taskEnd",
+    # task runner (plan/base.py)
+    "taskRetry", "taskDegraded",
+    # pipelined execution (exec/pipeline.py)
+    "pipelineSpool",
+    # shuffle layer (shuffle/*.py, exec/exchange.py)
+    "shuffleSend", "shuffleFetch", "fetchRetry", "fetchFailover",
+    "shuffleBlockLoaded", "shuffleWorkerFetch", "shuffleBlocksInvalidated",
+    "executorRegistered", "executorLost", "workerExpired", "mapRerun",
+    "collectiveFallback",
+    # chaos / resilience (aux/faults.py)
+    "faultInjected", "breakerTrip",
+    # live resource sampler (aux/sampler.py)
+    "resourceSample",
+})
 
 
 @dataclasses.dataclass
@@ -79,20 +118,62 @@ class EventSink:
         pass
 
 
+class _DropCell:
+    """One ring's drop count, kept alive past the ring itself: at ring
+    GC a finalizer retires the cell's value into the process total, so
+    ``ring_dropped_total()`` stays monotonic without the hot emit path
+    ever touching a process-global lock."""
+
+    __slots__ = ("n",)
+
+    def __init__(self):
+        self.n = 0
+
+
+_DROP_LOCK = threading.Lock()
+_RETIRED_DROPS = 0
+_LIVE_DROP_CELLS: set = set()
+
+
+def _retire_drop_cell(cell: _DropCell) -> None:
+    global _RETIRED_DROPS
+    with _DROP_LOCK:
+        _LIVE_DROP_CELLS.discard(cell)
+        _RETIRED_DROPS += cell.n
+
+
+def ring_dropped_total() -> int:
+    """Process-lifetime count of events dropped by ring-buffer sinks —
+    the truncation marker ``render_prometheus()`` and the offline
+    profiler surface so a silently-trimmed buffer is never mistaken for
+    'nothing happened'."""
+    with _DROP_LOCK:
+        return _RETIRED_DROPS + sum(c.n for c in _LIVE_DROP_CELLS)
+
+
 class RingBufferSink(EventSink):
     """Bounded in-memory sink (tests / explain(analyze)); drops oldest
     beyond ``capacity`` and counts the drops — a truncated buffer must
-    never read as complete."""
+    never read as complete.  Drops also tally into the process-wide
+    ``ring_dropped_total()`` counter (via a per-ring cell: the emit path
+    only touches this ring's lock)."""
 
     def __init__(self, capacity: int = 2048):
         self._buf = collections.deque(maxlen=max(1, int(capacity)))
         self._lock = threading.Lock()
-        self.dropped = 0
+        self._drop_cell = _DropCell()
+        with _DROP_LOCK:
+            _LIVE_DROP_CELLS.add(self._drop_cell)
+        weakref.finalize(self, _retire_drop_cell, self._drop_cell)
+
+    @property
+    def dropped(self) -> int:
+        return self._drop_cell.n
 
     def emit(self, event: Event) -> None:
         with self._lock:
             if len(self._buf) == self._buf.maxlen:
-                self.dropped += 1
+                self._drop_cell.n += 1
             self._buf.append(event)
 
     def events(self) -> List[Event]:
@@ -104,6 +185,30 @@ class RingBufferSink(EventSink):
             return len(self._buf)
 
 
+#: live event-log sinks, flushed at interpreter exit so short-lived
+#: processes (bench runs, scripts) don't lose the sub-batch tail
+_LIVE_EVENTLOG_SINKS: "weakref.WeakSet" = weakref.WeakSet()
+_ATEXIT_ARMED = False
+
+
+def _flush_eventlog_sinks() -> None:
+    """atexit hook (also directly testable): flush every live sink's
+    pending lines without closing it."""
+    for sink in list(_LIVE_EVENTLOG_SINKS):
+        try:
+            sink.flush()
+        except Exception:   # noqa: BLE001 - exit path must not raise
+            pass
+
+
+def _register_eventlog_sink(sink: "JsonlEventLogSink") -> None:
+    global _ATEXIT_ARMED
+    _LIVE_EVENTLOG_SINKS.add(sink)
+    if not _ATEXIT_ARMED:
+        _ATEXIT_ARMED = True
+        atexit.register(_flush_eventlog_sinks)
+
+
 class JsonlEventLogSink(EventSink):
     """Appends one JSON object per event to ``path`` (Spark event-log
     analog; multiple queries interleave lines, keyed by ``query_id``).
@@ -113,32 +218,105 @@ class JsonlEventLogSink(EventSink):
     sink on the same path can interleave between batches but never split
     a line (a torn line would break the ``parse_event_line`` contract).
     A stdio buffer would instead flush at SIZE boundaries, tearing lines
-    mid-JSON."""
+    mid-JSON.
+
+    Hardening (conf ``spark.rapids.sql.eventLog.*``):
+
+    - a fresh (empty) file opens with an ``eventLogHeader`` line carrying
+      the schema version, so the offline reader knows what it is parsing;
+    - ``max_bytes`` > 0 rotates the file once it crosses the budget: the
+      current file renames to ``path.N`` (N increasing, oldest smallest)
+      and a fresh file (with header) takes its place — the reader walks
+      the rotated set in order;
+    - ``compress=True`` writes each batch as ONE complete gzip member
+      (``gzip.compress`` of the batch) to the O_APPEND fd, so the
+      one-write-per-batch atomicity survives compression and readers see
+      a standard multi-member gzip stream (sniffed by magic, not
+      extension);
+    - pending lines flush via ``atexit`` so short-lived processes don't
+      lose the tail.
+    """
 
     #: events between writes; emitters (which may hold the query or
     #: catalog lock) only pay disk latency once per batch
     FLUSH_EVERY = 64
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, max_bytes: int = 0,
+                 compress: bool = False,
+                 flush_every: Optional[int] = None):
         self.path = path
+        self.max_bytes = max(0, int(max_bytes or 0))
+        self.compress = bool(compress)
+        self._flush_every = max(1, int(flush_every or self.FLUSH_EVERY))
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         self._lock = threading.Lock()
-        self._f = open(path, "ab", buffering=0)
         self._pending: List[str] = []
+        self._f = None
+        self._open_file()
+        _register_eventlog_sink(self)
 
+    # -- file lifecycle ------------------------------------------------------
+    def _open_file(self) -> None:
+        self._f = open(self.path, "ab", buffering=0)
+        if os.fstat(self._f.fileno()).st_size == 0:
+            header = Event("eventLogHeader", NO_QUERY, NO_SPAN,
+                           time.monotonic(),
+                           {"format": "spark-rapids-tpu-eventlog",
+                            "compress": self.compress})
+            self._write_raw(header.to_json() + "\n")
+
+    def _write_raw(self, text: str) -> None:
+        data = text.encode("utf-8")
+        if self.compress:
+            data = gzip.compress(data)
+        self._f.write(data)
+
+    def _rotate_locked(self) -> None:
+        self._f.close()
+        n = 1
+        while os.path.exists(f"{self.path}.{n}"):
+            n += 1
+        os.replace(self.path, f"{self.path}.{n}")
+        self._open_file()
+
+    # -- sink API ------------------------------------------------------------
     def emit(self, event: Event) -> None:
         with self._lock:
             if self._f.closed:
                 return
             self._pending.append(event.to_json() + "\n")
-            if len(self._pending) >= self.FLUSH_EVERY:
+            if len(self._pending) >= self._flush_every:
                 self._write_pending()
 
     def _write_pending(self) -> None:
         if self._pending:
-            self._f.write("".join(self._pending).encode("utf-8"))
+            self._write_raw("".join(self._pending))
             self._pending = []
+        if not self.max_bytes:
+            return
+        # several sinks may share this path (per-query sinks + the
+        # sampler's): judge the budget by the REAL file size, not this
+        # sink's private write count, and never rename a file another
+        # sink already rotated us away from — migrate to the fresh file
+        # instead
+        try:
+            st_fd = os.fstat(self._f.fileno())
+            st_path = os.stat(self.path)
+        except OSError:
+            return      # mid-rotation window elsewhere; re-check next batch
+        if st_path.st_ino != st_fd.st_ino:
+            self._f.close()
+            self._open_file()
+            return
+        if st_fd.st_size >= self.max_bytes:
+            self._rotate_locked()
+
+    def flush(self) -> None:
+        """Pushes pending lines to disk without closing (atexit hook)."""
+        with self._lock:
+            if not self._f.closed:
+                self._write_pending()
 
     def close(self) -> None:
         with self._lock:
@@ -201,7 +379,7 @@ _GLOBAL_LOCK = threading.Lock()
 
 def add_global_sink(sink: EventSink) -> None:
     """Receives events emitted OUTSIDE any query context (heartbeat
-    threads, shuffle worker processes)."""
+    threads, shuffle worker processes, the resource sampler)."""
     with _GLOBAL_LOCK:
         _GLOBAL_SINKS.append(sink)
 
@@ -232,6 +410,13 @@ def emit(kind: str, **payload) -> None:
 # Prometheus-style exposition of the process-wide registries
 # ---------------------------------------------------------------------------
 
+def escape_label_value(v: str) -> str:
+    """Prometheus exposition-format label escaping: backslash, double
+    quote and newline must be escaped inside label values."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def render_prometheus() -> str:
     """Text exposition of the runtime's gauges/counters (catalog tiers,
     task-metric accumulators, semaphore, operator ranges) in the
@@ -252,6 +437,10 @@ def render_prometheus() -> str:
             "Catalog-tracked device bytes")
         add("device_pool_limit_bytes", "gauge", st["device_limit"],
             "Device pool budget")
+        add("device_pool_peak_bytes", "gauge", st["device_peak_bytes"],
+            "High-watermark of catalog-tracked device bytes")
+        add("device_spillable_bytes", "gauge", st["spillable_bytes"],
+            "Device-tier bytes the spill framework may evict")
         add("host_spill_bytes", "gauge", st["host_bytes"],
             "Catalog-tracked host-tier bytes")
         add("disk_spill_bytes", "gauge", st["disk_bytes"],
@@ -277,6 +466,8 @@ def render_prometheus() -> str:
         add("semaphore_max_concurrent", "gauge",
             rt.semaphore.max_concurrent,
             "Device admission permits (concurrentGpuTasks)")
+    add("events_ring_dropped_total", "counter", ring_dropped_total(),
+        "Events dropped by bounded ring-buffer sinks (truncation marker)")
     from spark_rapids_tpu.aux import profiler as _prof
     for op, s in sorted(_prof.range_stats().items()):
         full = "spark_rapids_tpu_op_range_seconds_total"
@@ -284,5 +475,6 @@ def render_prometheus() -> str:
             lines.append(f"# HELP {full} Wall seconds inside operator "
                          "ranges")
             lines.append(f"# TYPE {full} counter")
-        lines.append(f'{full}{{op="{op}"}} {s["total_s"]}')
+        lines.append(f'{full}{{op="{escape_label_value(op)}"}} '
+                     f'{s["total_s"]}')
     return "\n".join(lines) + "\n"

@@ -1,0 +1,186 @@
+"""Live resource sampler: a continuous signal between query events.
+
+Reference: the spark-rapids ``ProfilerOnExecutor``/``ProfilerOnDriver``
+pair runs an always-on, low-overhead collector beside the query engine so
+offline tools see resource state BETWEEN the discrete events the layers
+emit.  Here a single daemon thread wakes every
+``spark.rapids.sample.intervalMs`` and emits one ``resourceSample`` event
+through the process event bus (``aux.events.emit``) carrying read-only
+snapshots of:
+
+- the buffer catalog (pool used / limit / high-watermark, spillable
+  bytes, host/disk spill tiers, live buffer count),
+- the device admission semaphore (permits, holders, queued waiters),
+- the prefetch spools (live spool count, queued batches/bytes),
+- the task registry (active = started − finished tasks).
+
+Samples are emitted OUTSIDE any query context, so they route to global
+sinks; when the session has ``spark.rapids.sql.eventLog.path`` set the
+sampler registers its own ``JsonlEventLogSink`` on the same path (appends
+are line-atomic, so query events and samples interleave cleanly) and the
+offline reader (``spark_rapids_tpu.tools``) aligns samples to queries by
+timestamp.  Sampling never touches query data or results — every hook is
+a counter read under an existing lock.
+
+Lifecycle: ``TpuSession`` calls ``sync_from_conf`` at construction and on
+``set_conf`` of any ``spark.rapids.sample.*`` / eventLog key; the sampler
+is a process-wide singleton (one thread regardless of session count) and
+stops at ``session.stop()``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from spark_rapids_tpu.aux import events as EV
+
+
+def collect_sample() -> dict:
+    """One read-only resource snapshot (the resourceSample payload).
+    Cheap by construction: a handful of counter reads; no syncs, no
+    device traffic, no allocation beyond the payload dict."""
+    payload = {}
+    from spark_rapids_tpu.memory.device_manager import get_runtime
+    rt = get_runtime()
+    if rt is not None:
+        st = rt.catalog.stats()
+        payload.update(
+            pool_used_bytes=st["device_bytes"],
+            pool_limit_bytes=st["device_limit"],
+            pool_peak_bytes=st["device_peak_bytes"],
+            spillable_bytes=st["spillable_bytes"],
+            host_spill_bytes=st["host_bytes"],
+            disk_spill_bytes=st["disk_bytes"],
+            buffers=st["buffers"],
+        )
+        sem = rt.semaphore.stats()
+        payload.update(
+            semaphore_permits=sem["max_concurrent"],
+            semaphore_holders=sem["holders"],
+            semaphore_waiting=sem["waiting"],
+        )
+        payload["active_tasks"] = rt.metrics.active_count()
+    from spark_rapids_tpu.exec.pipeline import live_spool_stats
+    ls = live_spool_stats()
+    payload.update(
+        prefetch_spools=ls["spools"],
+        prefetch_queued_batches=ls["queued_batches"],
+        prefetch_queued_bytes=ls["queued_bytes"],
+    )
+    return payload
+
+
+class ResourceSampler:
+    """The background sampling thread + its (optional) event-log sink."""
+
+    def __init__(self, interval_ms: int, log_path: Optional[str] = None,
+                 max_bytes: int = 0, compress: bool = False):
+        self.interval_ms = int(interval_ms)
+        self.log_path = log_path or None
+        self.max_bytes = int(max_bytes or 0)
+        self.compress = bool(compress)
+        self._sink: Optional[EV.JsonlEventLogSink] = None
+        if self.log_path:
+            # small batches: a sampler ticking every 100ms must not sit on
+            # 6s of samples before they reach disk
+            self._sink = EV.JsonlEventLogSink(
+                self.log_path, max_bytes=max_bytes, compress=compress,
+                flush_every=8)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.samples = 0
+
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def start(self) -> None:
+        if self.running:
+            return
+        self._stop.clear()
+        if self._sink is not None:
+            EV.add_global_sink(self._sink)
+        t = threading.Thread(target=self._run, name="tpu-resource-sampler",
+                             daemon=True)
+        self._thread = t
+        t.start()
+
+    def sample_once(self) -> dict:
+        """Takes and emits one sample immediately (tests / manual use)."""
+        payload = collect_sample()
+        payload["interval_ms"] = self.interval_ms
+        EV.emit("resourceSample", **payload)
+        self.samples += 1
+        return payload
+
+    def _run(self) -> None:
+        interval_s = max(0.001, self.interval_ms / 1000.0)
+        while not self._stop.wait(interval_s):
+            try:
+                self.sample_once()
+            except Exception:   # noqa: BLE001 - a failed sample is skipped,
+                pass            # never fatal to the engine
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
+        self._thread = None
+        if self._sink is not None:
+            EV.remove_global_sink(self._sink)
+            self._sink.close()
+
+
+_LOCK = threading.Lock()
+_SAMPLER: Optional[ResourceSampler] = None
+
+
+def active_sampler() -> Optional[ResourceSampler]:
+    with _LOCK:
+        return _SAMPLER
+
+
+def stop_sampler() -> None:
+    global _SAMPLER
+    with _LOCK:
+        cur, _SAMPLER = _SAMPLER, None
+    if cur is not None:
+        cur.stop()
+
+
+def sync_from_conf(conf) -> Optional[ResourceSampler]:
+    """Reconciles the singleton with ``spark.rapids.sample.*``: enabling
+    starts it, disabling stops it, a changed interval / log path restarts
+    it.  Idempotent — safe to call on every session init / set_conf."""
+    global _SAMPLER
+    from spark_rapids_tpu import config as C
+    enabled = conf.get(C.SAMPLE_ENABLED.key, False)
+    interval = conf.get(C.SAMPLE_INTERVAL_MS.key, 100)
+    path = conf.get(C.EVENT_LOG_PATH.key, "") or None
+    max_bytes = int(conf.get(C.EVENT_LOG_MAX_BYTES.key, 0) or 0)
+    compress = bool(conf.get(C.EVENT_LOG_COMPRESS.key, False))
+    stale = None
+    with _LOCK:
+        cur = _SAMPLER
+        if not enabled:
+            _SAMPLER, stale = None, cur
+        elif cur is not None and cur.running and \
+                cur.interval_ms == interval and cur.log_path == path and \
+                cur.max_bytes == max_bytes and cur.compress == compress:
+            # every knob the sink was built from matches — keep it; a
+            # changed compress/maxBytes must rebuild the sink or it would
+            # keep writing the OLD format to the shared path
+            return cur
+        else:
+            stale = cur
+            _SAMPLER = ResourceSampler(interval, path,
+                                       max_bytes=max_bytes,
+                                       compress=compress)
+            _SAMPLER.start()
+        out = _SAMPLER
+    if stale is not None:
+        stale.stop()
+    return out

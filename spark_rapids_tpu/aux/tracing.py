@@ -42,6 +42,22 @@ def last_query_summary() -> Optional[dict]:
         return _LAST_SUMMARY
 
 
+def _nondefault_conf(conf) -> dict:
+    """Registered conf values that differ from their defaults, JSON-safe.
+    Rides the queryStart event so the offline AutoTuner recommends FROM
+    the session's actual settings (an absent key = registry default)."""
+    from spark_rapids_tpu import config as C
+    out = {}
+    for key, entry in C.registry().items():
+        try:
+            v = conf.get(key)
+        except Exception:   # noqa: BLE001 - snapshot must never fail a query
+            continue
+        if v != entry.default:
+            out[key] = v if isinstance(v, (bool, int, float)) else str(v)
+    return out
+
+
 class Span:
     """One node of the query's span tree.  ``kind`` is ``query`` (root),
     ``exec`` (one physical plan node) or ``partition`` (one task of an
@@ -108,6 +124,10 @@ class QueryExecution:
         self._start_snapshot = None
         self.summary_dict: Optional[dict] = None
         self.finished = False
+        #: non-default conf values captured at from_conf (v2 event-log
+        #: schema: rides the queryStart payload so the offline AutoTuner
+        #: knows what it is tuning FROM)
+        self.conf_snapshot: Dict = {}
 
     @staticmethod
     def from_conf(conf=None, description: str = "") -> "QueryExecution":
@@ -117,9 +137,15 @@ class QueryExecution:
         if conf is not None:
             path = conf.get(C.EVENT_LOG_PATH.key, "")
             if path:
-                sinks.append(EV.JsonlEventLogSink(path))
+                sinks.append(EV.JsonlEventLogSink(
+                    path,
+                    max_bytes=conf.get(C.EVENT_LOG_MAX_BYTES.key, 0),
+                    compress=conf.get(C.EVENT_LOG_COMPRESS.key, False)))
             ring = conf.get(C.EVENT_LOG_RING_SIZE.key, 2048)
-        return QueryExecution(description, sinks, ring)
+        qe = QueryExecution(description, sinks, ring)
+        if conf is not None:
+            qe.conf_snapshot = _nondefault_conf(conf)
+        return qe
 
     # -- lifecycle -----------------------------------------------------------
     def __enter__(self) -> "QueryExecution":
@@ -128,8 +154,10 @@ class QueryExecution:
         rt = get_runtime()
         self._start_snapshot = rt.metrics.snapshot() if rt is not None \
             else None
-        self.record_event("queryStart",
-                          {"description": self.description})
+        start_payload = {"description": self.description}
+        if self.conf_snapshot:
+            start_payload["conf"] = dict(self.conf_snapshot)
+        self.record_event("queryStart", start_payload)
         return self
 
     def __exit__(self, exc_type, exc, tb):
@@ -278,10 +306,31 @@ class QueryExecution:
             if key is not None:
                 recovery[key] = recovery.get(key, 0) + 1
         self.root.end = now
+        # span depth map: the offline reader (tools/reader.py) rebuilds
+        # the tree from parent_id/depth — the in-memory children links
+        # don't survive the JSONL round trip
+        depths: Dict[int, int] = {}
+
+        def _depth_walk(sp: Span, d: int) -> None:
+            depths[sp.span_id] = d
+            for c in sp.children:
+                _depth_walk(c, d + 1)
+
+        _depth_walk(self.root, 0)
         nodes = []
         for sp in self._exec_spans():
-            row = {"span_id": sp.span_id, "node": sp.name,
-                   "desc": sp.desc[:120], **sp.metrics}
+            row = {"span_id": sp.span_id, "parent_id": sp.parent_id,
+                   "depth": depths.get(sp.span_id, 1), "node": sp.name,
+                   "desc": sp.desc[:120],
+                   "start_s": round(sp.start, 6),
+                   "end_s": round(sp.end if sp.end is not None else now, 6),
+                   **sp.metrics}
+            parts = [{"pidx": c.pidx, "start_s": round(c.start, 6),
+                      "end_s": round(c.end if c.end is not None else now, 6),
+                      "rows": c.rows, "batches": c.batches}
+                     for c in sp.children if c.kind == "partition"]
+            if parts:
+                row["partitions"] = parts
             extra = attr.get(sp.span_id)
             if extra:
                 row.update({k: v for k, v in extra.items() if v})

@@ -584,6 +584,39 @@ EVENT_LOG_PATH = conf_str(
     "event kind, query_id, span_id and a monotonic timestamp.",
     "")
 
+EVENT_LOG_MAX_BYTES = conf_bytes(
+    "spark.rapids.sql.eventLog.maxBytes",
+    "Size-based event-log rotation: once the JSONL file crosses this many "
+    "bytes it renames to <path>.N (N increasing, oldest smallest) and a "
+    "fresh file (with a schema-version header) takes its place; the "
+    "offline profiler reads the rotated set in order.  0 = never rotate.",
+    0,
+    checker=lambda v: int(v) >= 0)
+
+EVENT_LOG_COMPRESS = conf_bool(
+    "spark.rapids.sql.eventLog.compress",
+    "Gzip-compress the event log: each write batch lands as one complete "
+    "gzip member, preserving line atomicity; readers sniff the gzip magic "
+    "(no extension requirement).  Do not mix compressed and plain sinks "
+    "on one path.",
+    False)
+
+SAMPLE_ENABLED = conf_bool(
+    "spark.rapids.sample.enabled",
+    "Background resource sampler (aux/sampler.py): a low-overhead daemon "
+    "thread periodically emits resourceSample events (memory pool "
+    "used/watermark, spillable bytes, semaphore holders/waiters, prefetch "
+    "spool depth, active tasks) into the event bus so offline timelines "
+    "have a continuous signal between query events (reference: the "
+    "always-on ProfilerOnExecutor).",
+    False)
+
+SAMPLE_INTERVAL_MS = conf_int(
+    "spark.rapids.sample.intervalMs",
+    "Milliseconds between resource samples.  Validated > 0 at set_conf.",
+    100,
+    checker=lambda v: int(v) > 0)
+
 EVENT_LOG_RING_SIZE = conf_int(
     "spark.rapids.sql.eventLog.ringBufferSize",
     "Events retained per query in the in-memory ring buffer (the "

@@ -53,6 +53,7 @@ import collections
 import contextvars
 import threading
 import time
+import weakref
 from typing import Optional
 
 from spark_rapids_tpu.plan.base import (Exec, UnaryExec,
@@ -125,6 +126,27 @@ def reset_pipeline_stats() -> None:
         _STATS = _zero_stats()
 
 
+#: live (unfinished) spools, for the resource sampler's point-in-time
+#: queue-depth gauge; weak so a dropped spool never leaks through here
+_LIVE_SPOOLS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def live_spool_stats() -> dict:
+    """Read-only snapshot of in-flight prefetch spools (sampler gauge).
+    Depth reads race the producers by design — a sample is a sample."""
+    spools = 0
+    queued = 0
+    queued_bytes = 0
+    for s in list(_LIVE_SPOOLS):
+        if s._finished:
+            continue
+        spools += 1
+        queued += s._depth
+        queued_bytes += s._bytes
+    return {"spools": spools, "queued_batches": queued,
+            "queued_bytes": queued_bytes}
+
+
 # ---------------------------------------------------------------------------
 # the spool
 # ---------------------------------------------------------------------------
@@ -165,6 +187,7 @@ class PrefetchSpool:
         tc = task_context()
         self._task_id = tc.task_id
         self._task_metrics = tc.metrics
+        _LIVE_SPOOLS.add(self)
 
     # -- producer ------------------------------------------------------------
     def _start(self) -> None:

@@ -21,6 +21,7 @@ import itertools
 import os
 import tempfile
 import threading
+import time
 from typing import Dict, Optional
 
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, HostColumnarBatch
@@ -105,6 +106,8 @@ class BufferCatalog:
         self._buffers: Dict[int, _Buffer] = {}
         self._lock = threading.RLock()
         self.device_bytes = 0
+        #: high-watermark of device_bytes (resource sampler / Prometheus)
+        self.device_peak_bytes = 0
         self.host_bytes = 0
         self.disk_bytes = 0
         self.spill_count = 0
@@ -153,6 +156,8 @@ class BufferCatalog:
             buf.tier = StorageTier.DEVICE
             self._buffers[handle.id] = buf
             self.device_bytes += nbytes
+            self.device_peak_bytes = max(self.device_peak_bytes,
+                                         self.device_bytes)
             return handle
 
     def add_host_batch(self, batch: HostColumnarBatch,
@@ -180,7 +185,9 @@ class BufferCatalog:
         # padding is < 2x the host payload + validity/length vectors)
         est = 2 * host.nbytes() + 16 * max(host.row_count, 1024)
         self.reserve(est)
+        t0 = time.monotonic()
         dev = host.to_device()
+        unspill_s = time.monotonic() - t0
         nbytes = dev.nbytes()
         promoted = False
         with self._lock:
@@ -192,6 +199,8 @@ class BufferCatalog:
                 buf.device_batch = dev
                 buf.device_nbytes = nbytes
                 self.device_bytes += nbytes
+                self.device_peak_bytes = max(self.device_peak_bytes,
+                                             self.device_bytes)
                 # single-tier ownership: promotion drops the host copy and its
                 # charge (prevents double-count on the next spill cycle)
                 if buf.host_batch is not None:
@@ -208,7 +217,7 @@ class BufferCatalog:
             # emitted outside the lock
             from spark_rapids_tpu.aux.events import emit
             emit("unspill", bytes=nbytes, rows=host.row_count,
-                 buffer_id=handle.id)
+                 buffer_id=handle.id, duration_s=round(unspill_s, 6))
         return out
 
     def get_host_batch(self, handle: BufferHandle) -> HostColumnarBatch:
@@ -267,7 +276,9 @@ class BufferCatalog:
         for buf in candidates:
             if freed >= needed:
                 break
+            t0 = time.monotonic()
             host = buf.device_batch.to_host()
+            spill_s = time.monotonic() - t0
             if buf.owned:
                 _delete_device_batch(buf.device_batch)
             self.device_bytes -= buf.device_nbytes
@@ -284,7 +295,8 @@ class BufferCatalog:
                 mt.spill_bytes += buf.host_nbytes
             from spark_rapids_tpu.aux.events import emit
             emit("spill", tier="device->host", bytes=buf.host_nbytes,
-                 buffer_id=buf.handle.id, priority=buf.handle.priority)
+                 buffer_id=buf.handle.id, priority=buf.handle.priority,
+                 duration_s=round(spill_s, 6))
         self._maybe_spill_host_locked()
         return freed
 
@@ -306,6 +318,7 @@ class BufferCatalog:
         d = self._disk_dir or tempfile.gettempdir()
         os.makedirs(d, exist_ok=True)
         path = os.path.join(d, f"spill-{buf.handle.id}.arrow")
+        t0 = time.monotonic()
         rb = buf.host_batch.to_arrow()
         with ipc.RecordBatchFileWriter(path, rb.schema) as w:
             w.write_batch(rb)
@@ -319,7 +332,8 @@ class BufferCatalog:
         self.spill_count += 1
         from spark_rapids_tpu.aux.events import emit
         emit("spill", tier="host->disk", bytes=disk_nbytes,
-             buffer_id=buf.handle.id, priority=buf.handle.priority)
+             buffer_id=buf.handle.id, priority=buf.handle.priority,
+             duration_s=round(time.monotonic() - t0, 6))
 
     def _host_batch_locked(self, buf: _Buffer) -> HostColumnarBatch:
         if buf.host_batch is not None:
@@ -352,9 +366,13 @@ class BufferCatalog:
     # -- introspection ------------------------------------------------------
     def stats(self) -> dict:
         with self._lock:
+            spillable = sum(b.device_nbytes for b in self._buffers.values()
+                            if b.tier == StorageTier.DEVICE and b.spillable)
             return {
                 "device_bytes": self.device_bytes,
                 "device_limit": self.device_limit,
+                "device_peak_bytes": self.device_peak_bytes,
+                "spillable_bytes": spillable,
                 "host_bytes": self.host_bytes,
                 "host_limit": self.host_limit,
                 "disk_bytes": self.disk_bytes,

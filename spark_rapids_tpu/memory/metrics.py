@@ -55,6 +55,17 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self.total = TaskMetrics()
         self.finished_tasks = 0
+        self.started_tasks = 0
+
+    def note_started(self) -> None:
+        with self._lock:
+            self.started_tasks += 1
+
+    def active_count(self) -> int:
+        """Tasks started but not yet reported (the resource sampler's
+        active-task gauge)."""
+        with self._lock:
+            return max(0, self.started_tasks - self.finished_tasks)
 
     def report(self, m: TaskMetrics) -> None:
         with self._lock:
@@ -80,6 +91,8 @@ def task_scope(task_id: int, registry: Optional[MetricsRegistry] = None):
     prev_id, prev_metrics = ctx.task_id, ctx.metrics
     ctx.task_id = task_id
     ctx.metrics = TaskMetrics(task_id=task_id)
+    if registry is not None:
+        registry.note_started()
     try:
         yield ctx.metrics
     finally:

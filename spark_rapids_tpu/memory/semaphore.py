@@ -20,6 +20,7 @@ class TpuSemaphore:
         self._sem = threading.Semaphore(max_concurrent)
         self._holders: Dict[int, dict] = {}
         self._lock = threading.Lock()
+        self._waiting = 0
 
     @staticmethod
     def _tid(task_id: Optional[int]) -> int:
@@ -38,7 +39,13 @@ class TpuSemaphore:
                 self._holders[tid]["depth"] += 1
                 return
         t0 = time.monotonic()
-        self._sem.acquire()
+        with self._lock:
+            self._waiting += 1
+        try:
+            self._sem.acquire()
+        finally:
+            with self._lock:
+                self._waiting -= 1
         wait = time.monotonic() - t0
         mt = task_context().metrics
         if mt is not None:
@@ -80,6 +87,14 @@ class TpuSemaphore:
     def held_by(self, task_id: int) -> bool:
         with self._lock:
             return task_id in self._holders
+
+    def stats(self) -> dict:
+        """Read-only snapshot for the resource sampler: permit budget,
+        current holders and threads queued on admission."""
+        with self._lock:
+            return {"max_concurrent": self.max_concurrent,
+                    "holders": len(self._holders),
+                    "waiting": self._waiting}
 
     def dump_active_holders(self) -> str:
         """reference: GpuSemaphore.dumpActiveStackTracesToLog"""

@@ -45,6 +45,10 @@ class TpuSession:
         # at session construction (overrides.apply re-syncs per action)
         from spark_rapids_tpu.aux.faults import arm_from_conf
         arm_from_conf(self.conf)
+        # live resource sampler (spark.rapids.sample.*): start/stop the
+        # process singleton to match this session's conf
+        from spark_rapids_tpu.aux.sampler import sync_from_conf
+        sync_from_conf(self.conf)
         #: temp views for the SQL front-end (name -> DataFrame)
         self._views: Dict[str, "DataFrame"] = {}
         #: row-based Hive UDF passthrough (name -> (fn, return_type));
@@ -67,6 +71,12 @@ class TpuSession:
             arm_from_conf(self.conf)
         elif key.startswith("spark.rapids.shuffle.fetch."):
             self.shuffle_env.update_fetch_retry(self.conf)
+        elif key.startswith(("spark.rapids.sample.",
+                             "spark.rapids.sql.eventLog.")):
+            # the sampler singleton tracks both its own knobs and the
+            # event-log destination it mirrors samples into
+            from spark_rapids_tpu.aux.sampler import sync_from_conf
+            sync_from_conf(self.conf)
         return self
 
     # -- SQL ----------------------------------------------------------------
@@ -220,6 +230,8 @@ class TpuSession:
         return TpuSession._Reader(self)
 
     def stop(self):
+        from spark_rapids_tpu.aux.sampler import stop_sampler
+        stop_sampler()
         from spark_rapids_tpu.memory.device_manager import shutdown
         shutdown()
         if self.shuffle_env is not None:

@@ -1,0 +1,88 @@
+"""CLI entry point: ``python -m spark_rapids_tpu.tools <cmd> ...``.
+
+Commands:
+
+- ``profile <event-log>``: per-query timeline + bottleneck decomposition
+  + operator ranking from a JSONL event log (rotated/.gz sets handled).
+- ``autotune <event-log>``: rule-based conf recommendations with cited
+  evidence; ``--json`` prints the ready-to-apply conf dict.
+- ``compare <bench.json ...>``: diff BENCH payloads across runs/PRs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m spark_rapids_tpu.tools",
+        description="Offline diagnostics over spark_rapids_tpu event logs")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    prof = sub.add_parser("profile",
+                          help="timeline + bottleneck attribution report")
+    prof.add_argument("log", help="JSONL event log path "
+                                  "(rotated .N siblings read automatically)")
+    prof.add_argument("--query", type=int, default=None,
+                      help="only this query id")
+    prof.add_argument("--samples", action="store_true",
+                      help="list individual resource samples")
+    prof.add_argument("--no-timeline", action="store_true",
+                      help="skip the per-partition gantt")
+    prof.add_argument("--json", action="store_true",
+                      help="machine-readable output")
+
+    at = sub.add_parser("autotune",
+                        help="rule-based conf recommendations")
+    at.add_argument("log")
+    at.add_argument("--json", action="store_true",
+                    help="print only the ready-to-apply conf dict")
+
+    cmp_p = sub.add_parser("compare", help="diff BENCH_r*.json payloads")
+    cmp_p.add_argument("files", nargs="+")
+    cmp_p.add_argument("--json", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.cmd == "profile":
+        from spark_rapids_tpu.tools.profile import (profiles_to_json,
+                                                    render_report)
+        from spark_rapids_tpu.tools.reader import load_profiles
+        profiles, diag = load_profiles(args.log)
+        if args.json:
+            print(json.dumps(profiles_to_json(profiles, diag), indent=2))
+        else:
+            sys.stdout.write(render_report(
+                profiles, diag, query_id=args.query,
+                show_samples=args.samples,
+                show_timeline=not args.no_timeline))
+        return 0
+    if args.cmd == "autotune":
+        from spark_rapids_tpu.tools.autotune import (autotune,
+                                                     render_recommendations,
+                                                     to_conf_dict)
+        from spark_rapids_tpu.tools.reader import load_profiles
+        profiles, _diag = load_profiles(args.log)
+        recs = autotune(profiles)
+        if args.json:
+            print(json.dumps(to_conf_dict(recs), indent=2))
+        else:
+            sys.stdout.write(render_recommendations(recs))
+        return 0
+    if args.cmd == "compare":
+        from spark_rapids_tpu.tools.compare import compare, render_compare
+        if args.json:
+            print(json.dumps(compare(args.files), indent=2))
+        else:
+            sys.stdout.write(render_compare(args.files))
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

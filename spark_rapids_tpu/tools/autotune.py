@@ -1,0 +1,243 @@
+"""Rule-based config AutoTuner over profiled queries.
+
+Reference: the ``spark-rapids-tools`` AutoTuner consumes a profiled
+event log and emits ready-to-apply conf deltas, each justified by the
+evidence that triggered it.  Same contract here: every
+``Recommendation`` names the conf key, the value it tunes FROM (the
+queryStart conf snapshot when present, else the registry default), the
+value it recommends, and the *evidence events* — so a recommendation is
+an argument, never an oracle.
+
+Rules (see docs/tools.md for the full semantics):
+
+1. **producer-stall dominated** → deepen the prefetch spool
+   (``spark.rapids.pipeline.depth``): producers blocked on a full queue
+   mean the consumer drains slower than the producer fills at the
+   current depth; more slack absorbs bursts.
+2. **spill / OOM-retry dominated** → shed device pressure: lower
+   ``spark.rapids.sql.concurrentGpuTasks``; when SplitAndRetry splits
+   fired too, also halve ``spark.rapids.sql.batchSizeBytes``.
+3. **fetch-retry dominated** → widen
+   ``spark.rapids.shuffle.fetch.timeoutMs`` (and the backoff ceiling):
+   repeated transient fetch failures burn backoff time recovery can't
+   hide.
+4. **semaphore-wait dominated** (and NO memory pressure) → raise
+   ``spark.rapids.sql.concurrentGpuTasks``: admission, not memory, is
+   the limiter.
+5. **ring-buffer drops** → grow
+   ``spark.rapids.sql.eventLog.ringBufferSize`` so the next profile is
+   not a lower bound.
+
+Thresholds are fractions of query wall time; rules stay silent without
+their evidence, and rules 2 and 4 are mutually exclusive by
+construction (4 requires zero memory pressure).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.tools.profile import Attribution, attribute
+from spark_rapids_tpu.tools.reader import QueryProfile
+
+#: a bucket "dominates" past this fraction of wall time
+STALL_FRACTION = 0.15
+SPILL_FRACTION = 0.05
+RECOVERY_FRACTION = 0.05
+SEMAPHORE_FRACTION = 0.25
+
+
+@dataclasses.dataclass
+class Recommendation:
+    key: str
+    current: object
+    recommended: object
+    reason: str
+    #: human-readable citations of the events that justify the change
+    evidence: List[str]
+    query_id: int
+
+    def as_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+def _conf_value(profile: QueryProfile, key: str):
+    """The session's value for ``key``: queryStart snapshot first, then
+    the registry default (the snapshot only carries non-defaults)."""
+    if key in profile.conf:
+        return profile.conf[key]
+    from spark_rapids_tpu import config as C
+    entry = C.registry().get(key)
+    return entry.default if entry is not None else None
+
+
+def _cite(events, fmt, limit: int = 3) -> List[str]:
+    out = []
+    for ev in events[:limit]:
+        out.append(fmt(ev))
+    if len(events) > limit:
+        out.append(f"... and {len(events) - limit} more")
+    return out
+
+
+def autotune_query(profile: QueryProfile,
+                   att: Optional[Attribution] = None
+                   ) -> List[Recommendation]:
+    """Applies every rule to one profiled query."""
+    att = att or attribute(profile)
+    wall = max(att.wall_s, 1e-9)
+    recs: List[Recommendation] = []
+    qid = profile.query_id
+
+    # rule 1: producer stall dominates -> deepen the pipeline
+    p_stall = att.raw.get("producer_stall", 0.0)
+    c_stall = att.raw.get("consumer_stall", 0.0)
+    cur = int(_conf_value(profile, "spark.rapids.pipeline.depth") or 2)
+    if p_stall / wall >= STALL_FRACTION and p_stall > c_stall and cur < 16:
+        # (at the 16 cap the rule stays silent — a depth -> depth no-op
+        # would contradict the ready-to-apply contract)
+        spools = sorted(profile.events_of("pipelineSpool"),
+                        key=lambda e: -float(
+                            e.payload.get("producer_stall_s", 0) or 0))
+        recs.append(Recommendation(
+            "spark.rapids.pipeline.depth", cur, min(16, cur * 2),
+            f"producers spent {p_stall:.3f}s ({p_stall / wall * 100:.0f}% "
+            f"of wall) blocked on full prefetch queues (consumer stall "
+            f"only {c_stall:.3f}s); deeper spools absorb the bursts",
+            _cite(spools, lambda e:
+                  f"pipelineSpool boundary={e.payload.get('boundary')} "
+                  f"producer_stall_s={e.payload.get('producer_stall_s')} "
+                  f"peak_depth={e.payload.get('peak_depth')}"),
+            qid))
+
+    # rule 2: spill / OOM-retry pressure -> shed device concurrency
+    spill_s = att.raw.get("spill", 0.0)
+    spill_evs = profile.events_of("spill")
+    retry_evs = profile.events_of("retryOOM", "oom")
+    split_evs = profile.events_of("splitRetry")
+    pressured = (spill_s / wall >= SPILL_FRACTION
+                 or len(retry_evs) >= 3 or len(split_evs) >= 1)
+    if pressured and (spill_evs or retry_evs or split_evs):
+        cur = int(_conf_value(
+            profile, "spark.rapids.sql.concurrentGpuTasks") or 2)
+        spill_bytes = sum(int(e.payload.get("bytes", 0) or 0)
+                          for e in spill_evs)
+        ev = _cite(spill_evs, lambda e:
+                   f"spill tier={e.payload.get('tier')} "
+                   f"bytes={e.payload.get('bytes')} "
+                   f"duration_s={e.payload.get('duration_s')}") + \
+            _cite(retry_evs, lambda e:
+                  f"{e.kind} payload={e.payload}", 2)
+        if cur > 1:
+            recs.append(Recommendation(
+                "spark.rapids.sql.concurrentGpuTasks", cur, cur - 1,
+                f"device pressure: {len(spill_evs)} spill(s) "
+                f"({spill_bytes} bytes, {spill_s:.3f}s), "
+                f"{len(retry_evs)} OOM/retry event(s); fewer concurrent "
+                "device tasks shrink the working set",
+                ev, qid))
+        if split_evs:
+            cur_b = _conf_value(profile, "spark.rapids.sql.batchSizeBytes")
+            from spark_rapids_tpu.config import parse_bytes
+            cur_b = parse_bytes(cur_b) if cur_b is not None else 512 << 20
+            recs.append(Recommendation(
+                "spark.rapids.sql.batchSizeBytes", cur_b,
+                max(1 << 20, cur_b // 2),
+                f"{len(split_evs)} SplitAndRetry split(s): whole batches "
+                "did not fit even after spilling — smaller target batches "
+                "avoid the split round trips",
+                _cite(split_evs, lambda e:
+                      f"splitRetry payload={e.payload}"), qid))
+
+    # rule 3: fetch retry/backoff time -> widen fetch timeouts
+    fetch_evs = profile.events_of("fetchRetry", "fetchFailover")
+    backoff_s = att.raw.get("recovery", 0.0)
+    if fetch_evs and (backoff_s / wall >= RECOVERY_FRACTION
+                      or len(fetch_evs) >= 3):
+        cur = int(_conf_value(
+            profile, "spark.rapids.shuffle.fetch.timeoutMs") or 30_000)
+        recs.append(Recommendation(
+            "spark.rapids.shuffle.fetch.timeoutMs", cur, cur * 2,
+            f"{len(fetch_evs)} fetch retry/failover event(s) burned "
+            f"{backoff_s:.3f}s of backoff; a wider per-attempt timeout "
+            "rides out slow peers instead of retrying them",
+            _cite(fetch_evs, lambda e:
+                  f"{e.kind} peer={e.payload.get('peer', e.payload.get('to_peer'))} "
+                  f"shuffle_id={e.payload.get('shuffle_id')} "
+                  f"wait_ms={e.payload.get('wait_ms', '-')}"),
+            qid))
+
+    # rule 4: admission-bound with NO memory pressure -> more permits
+    sem_s = att.raw.get("semaphore", 0.0)
+    if sem_s / wall >= SEMAPHORE_FRACTION and not pressured \
+            and not spill_evs and not retry_evs:
+        cur = int(_conf_value(
+            profile, "spark.rapids.sql.concurrentGpuTasks") or 2)
+        sem_evs = sorted(profile.events_of("semaphoreAcquired"),
+                         key=lambda e: -float(
+                             e.payload.get("wait_s", 0) or 0))
+        recs.append(Recommendation(
+            "spark.rapids.sql.concurrentGpuTasks", cur, cur + 1,
+            f"tasks queued {sem_s:.3f}s ({sem_s / wall * 100:.0f}% of "
+            "wall) on device admission with zero spill/OOM pressure — "
+            "the permit count, not memory, is the limiter",
+            _cite(sem_evs, lambda e:
+                  f"semaphoreAcquired task={e.payload.get('task_id')} "
+                  f"wait_s={e.payload.get('wait_s')}"),
+            qid))
+
+    # rule 5: observability truncation -> bigger ring
+    dropped = int((profile.summary or {}).get("events_dropped", 0) or 0)
+    if dropped > 0:
+        cur = int(_conf_value(
+            profile, "spark.rapids.sql.eventLog.ringBufferSize") or 2048)
+        recs.append(Recommendation(
+            "spark.rapids.sql.eventLog.ringBufferSize", cur, cur * 2,
+            f"{dropped} event(s) dropped from the query ring buffer — "
+            "every other number in this profile is a lower bound until "
+            "the ring fits the query",
+            [f"queryEnd events_dropped={dropped}"], qid))
+    return recs
+
+
+def autotune(profiles: List[QueryProfile]) -> List[Recommendation]:
+    """All rules over all queries, deduplicated to the strongest form of
+    each key (recommendations from different queries for the same key
+    keep the one backed by the slowest query)."""
+    by_key: Dict[str, Recommendation] = {}
+    by_key_wall: Dict[str, float] = {}
+    for qp in profiles:
+        att = attribute(qp)
+        for rec in autotune_query(qp, att):
+            if rec.key not in by_key or att.wall_s > by_key_wall[rec.key]:
+                by_key[rec.key] = rec
+                by_key_wall[rec.key] = att.wall_s
+    return list(by_key.values())
+
+
+def to_conf_dict(recs: List[Recommendation]) -> Dict[str, str]:
+    """The ready-to-apply output: pass straight to ``TpuConf``/
+    ``set_conf`` (values stringified the way a conf file would carry
+    them)."""
+    return {r.key: str(r.recommended) for r in recs}
+
+
+def render_recommendations(recs: List[Recommendation]) -> str:
+    if not recs:
+        return ("No recommendations: nothing dominated the profiled "
+                "queries' wall time.\n")
+    lines = [f"== AutoTuner: {len(recs)} recommendation(s) =="]
+    for r in recs:
+        lines.append("")
+        lines.append(f"  {r.key}: {r.current} -> {r.recommended}   "
+                     f"(query {r.query_id})")
+        lines.append(f"    why: {r.reason}")
+        for e in r.evidence:
+            lines.append(f"    evidence: {e}")
+    lines.append("")
+    lines.append("  Ready-to-apply conf:")
+    import json
+    for line in json.dumps(to_conf_dict(recs), indent=2).splitlines():
+        lines.append("    " + line)
+    return "\n".join(lines) + "\n"

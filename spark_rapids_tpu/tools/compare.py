@@ -1,0 +1,148 @@
+"""BENCH payload comparison: diff the one-line JSON artifacts across PRs.
+
+``bench.py`` prints one JSON line per run; the repo keeps them as
+``BENCH_r*.json``.  ``tools compare`` lines those payloads up so a
+regression (rows/s down, overlap ratio down, recovery overhead up) is
+one command away instead of a by-eye diff of nested JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+#: (label, dotted path into the payload, higher-is-better or None)
+METRICS: List[Tuple[str, str, Optional[bool]]] = [
+    ("rows/s", "value", True),
+    ("vs CPU baseline", "vs_baseline", True),
+    ("TPU wall s", "tpu_s", False),
+    ("CPU wall s", "cpu_s", False),
+    ("HBM fraction", "hbm_frac", True),
+    ("bytes/s", "bytes_per_sec", True),
+    ("pipeline overlap", "pipeline.overlap_ratio", True),
+    ("producer stall s", "pipeline.producer_stall_s", False),
+    ("consumer stall s", "pipeline.consumer_stall_s", False),
+    ("peak spool depth", "pipeline.peak_depth", None),
+    ("TPC-DS geomean", "tpcds.geomean_speedup", True),
+    ("TPC-DS queries", "tpcds.queries_counted", True),
+    ("faults injected", "chaos.faults_injected", None),
+    ("task retries", "chaos.task_retries", False),
+    ("fetch retries", "chaos.fetch_retries", False),
+    ("query tasks", "query_metrics.tasks", None),
+    ("query spill bytes", "query_metrics.spill_bytes", False),
+]
+
+
+def _dig(payload: Dict, dotted: str):
+    cur = payload
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def load_bench(path: str) -> Dict:
+    """One BENCH payload, whichever capture shape it arrived in:
+
+    - the committed ``BENCH_r*.json`` driver wrapper (a pretty-printed
+      doc whose ``parsed`` field holds the payload, with the raw stream
+      tail under ``tail``),
+    - bench.py's own stdout (one JSON line, possibly preceded by stderr
+      snapshots in merged-stream captures — the LAST parseable line
+      wins, matching the 'final stdout line is the payload' contract).
+    """
+    text = open(path).read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict):
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict):
+            return parsed
+        if "tail" in doc and isinstance(doc["tail"], str):
+            # no parsed payload: fall through to line-scanning the tail
+            text = doc["tail"]
+        else:
+            return doc
+    last = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict):
+            last = d
+    if last is None:
+        raise ValueError(f"{path!r} contains no JSON payload line")
+    return last
+
+
+def compare(paths: List[str]) -> Dict:
+    """Structured diff: every known metric across every payload, with a
+    relative delta of last vs first where both are numeric.  A payload
+    that doesn't load (a crashed run's capture) shows as an empty column
+    and is listed under ``errors`` instead of aborting the comparison."""
+    payloads = []
+    errors: Dict[str, str] = {}
+    for p in paths:
+        name = os.path.basename(p)
+        try:
+            payloads.append((name, load_bench(p)))
+        except (OSError, ValueError) as e:
+            errors[name] = str(e)
+            payloads.append((name, {}))
+    rows = []
+    for label, dotted, higher_better in METRICS:
+        values = [_dig(pl, dotted) for _, pl in payloads]
+        if all(v is None for v in values):
+            continue
+        row = {"metric": label, "path": dotted, "values": values}
+        first = next((v for v in values if isinstance(v, (int, float))),
+                     None)
+        last = next((v for v in reversed(values)
+                     if isinstance(v, (int, float))), None)
+        if first not in (None, 0) and last is not None:
+            delta = (last - first) / abs(first)
+            row["delta_pct"] = round(delta * 100, 2)
+            if higher_better is not None:
+                row["regression"] = (delta < -0.05 if higher_better
+                                     else delta > 0.05)
+        rows.append(row)
+    return {"files": [name for name, _ in payloads], "rows": rows,
+            "errors": errors}
+
+
+def render_compare(paths: List[str]) -> str:
+    out = compare(paths)
+    names = out["files"]
+    w = max(18, *(len(n) for n in names)) + 2
+    lines = ["== BENCH comparison =="]
+    header = f"{'metric':<20}" + "".join(f"{n:>{w}}" for n in names) \
+        + f"{'Δ last/first':>14}"
+    lines.append(header)
+    lines.append("-" * len(header))
+    for row in out["rows"]:
+        cells = ""
+        for v in row["values"]:
+            s = "-" if v is None else (
+                f"{v:,}" if isinstance(v, int) else f"{v:.4g}")
+            cells += f"{s:>{w}}"
+        delta = row.get("delta_pct")
+        ds = "-" if delta is None else f"{delta:+.1f}%"
+        if row.get("regression"):
+            ds += " !!"
+        lines.append(f"{row['metric']:<20}{cells}{ds:>14}")
+    regressions = [r["metric"] for r in out["rows"] if r.get("regression")]
+    if regressions:
+        lines.append("")
+        lines.append("!! regressions (>5% the wrong way): "
+                     + ", ".join(regressions))
+    for name, msg in out.get("errors", {}).items():
+        lines.append(f"!! {name}: no payload loaded ({msg})")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,346 @@
+"""Bottleneck attribution + text report over a reconstructed query.
+
+Theseus (PAPERS.md) frames a device query engine's wall time as a
+contest between data-movement resources — decode, transfer, compute,
+spill — and argues the engine must KNOW which one bounds each query.
+This module decomposes a query's wall clock into those buckets from the
+event log alone:
+
+- per-operator **exclusive time**: each exec span's ``opTime`` minus its
+  children's (opTime is inclusive — a node's pull timer contains its
+  whole upstream chain), clamped at zero where prefetch overlap makes a
+  child's producer-thread time exceed the consumer's wait;
+- **stall buckets** from the prefetch spools' measured
+  producer/consumer stall metrics (``pipelineSpool`` events + the
+  Prefetch spans' OpMetrics);
+- **spill / recovery / semaphore** from the layer events (spill and
+  unspill carry measured ``duration_s``; fetch retries carry their
+  backoff waits; semaphore wait comes from the queryEnd summary).
+
+Tracked seconds overlap (tasks run in parallel, producers overlap
+consumers), so raw bucket sums routinely exceed — or, with untracked
+driver time, undershoot — the wall clock.  The report therefore shows
+BOTH: the raw per-resource seconds, and the same buckets scaled
+proportionally onto the wall clock (an ``other`` bucket absorbs
+untracked time), so the scaled decomposition always totals the query's
+wall time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu.tools.reader import (QueryProfile, ReadDiagnostics,
+                                           SpanNode)
+
+#: decomposition buckets, render order
+BUCKETS = ("decode", "h2d", "compute", "d2h", "shuffle",
+           "producer_stall", "consumer_stall", "spill", "recovery",
+           "semaphore", "other")
+
+_DECODE_MARKERS = ("Scan", "Range", "InMemory", "Csv", "Parquet", "Json",
+                   "Orc", "Avro", "Hive", "Text", "Cached")
+_SHUFFLE_MARKERS = ("Shuffle", "Exchange", "Collective", "Broadcast")
+
+
+def classify_node(name: str) -> str:
+    """Maps an exec span's node name onto a resource bucket."""
+    if name.startswith("Prefetch"):
+        # handled specially in attribute(): its exclusive time is spool
+        # handoff/wait, split via its stall metrics
+        return "consumer_stall"
+    if "HostToDevice" in name:
+        return "h2d"
+    if "DeviceToHost" in name:
+        return "d2h"
+    if any(m in name for m in _SHUFFLE_MARKERS):
+        return "shuffle"
+    if any(m in name for m in _DECODE_MARKERS):
+        return "decode"
+    return "compute"
+
+
+@dataclasses.dataclass
+class OperatorCost:
+    span_id: int
+    name: str
+    desc: str
+    bucket: str
+    exclusive_s: float
+    inclusive_s: float
+    rows: int
+    batches: int
+    extras: Dict
+
+
+@dataclasses.dataclass
+class Attribution:
+    """The decomposition for one query."""
+    wall_s: float
+    #: raw tracked seconds per bucket (overlapping resources — may exceed
+    #: wall under parallelism)
+    raw: Dict[str, float]
+    #: raw scaled proportionally onto the wall clock; totals wall_s
+    scaled: Dict[str, float]
+    operators: List[OperatorCost]
+    #: dominant bucket of the scaled decomposition (ignoring 'other')
+    bottleneck: str
+    recovery_counts: Dict[str, int]
+
+    def scaled_total(self) -> float:
+        return sum(self.scaled.values())
+
+
+def _exclusive_times(profile: QueryProfile) -> Dict[int, float]:
+    excl: Dict[int, float] = {}
+    for sp in profile.exec_spans():
+        child_t = sum(c.op_time() for c in sp.children)
+        excl[sp.span_id] = max(0.0, sp.op_time() - child_t)
+    return excl
+
+
+def attribute(profile: QueryProfile) -> Attribution:
+    """Decomposes one query's wall clock into resource buckets."""
+    wall = profile.wall_s
+    raw = {b: 0.0 for b in BUCKETS}
+    excl = _exclusive_times(profile)
+    operators: List[OperatorCost] = []
+    for sp in profile.exec_spans():
+        e = excl.get(sp.span_id, 0.0)
+        m = sp.metrics
+        if sp.name.startswith("Prefetch"):
+            # measured stall split; any residual handoff time lands in
+            # the boundary's own bucket via the leftover below
+            p_stall = float(m.get("producerStallTime", 0.0) or 0.0)
+            c_stall = float(m.get("consumerStallTime", 0.0) or 0.0)
+            raw["producer_stall"] += p_stall
+            raw["consumer_stall"] += min(e, c_stall) if e else c_stall
+            leftover = max(0.0, e - c_stall)
+            raw["other"] += leftover
+            bucket = "consumer_stall"
+        else:
+            bucket = classify_node(sp.name)
+            raw[bucket] += e
+        operators.append(OperatorCost(
+            sp.span_id, sp.name, sp.desc, bucket, round(e, 6),
+            round(sp.op_time(), 6),
+            int(m.get("numOutputRows", 0) or 0),
+            int(m.get("numOutputBatches", 0) or 0),
+            {k: v for k, v in m.items()
+             if k in ("spill_count", "spill_bytes", "retry_count",
+                      "split_retry_count", "oom_count", "peakQueueDepth")
+             and v}))
+    # spools that never became plan nodes (pipelineSpool events carry the
+    # measured stalls even when the span metrics were dropped)
+    if raw["producer_stall"] == 0.0 and raw["consumer_stall"] == 0.0:
+        for ev in profile.events_of("pipelineSpool"):
+            raw["producer_stall"] += float(
+                ev.payload.get("producer_stall_s", 0.0) or 0.0)
+            raw["consumer_stall"] += float(
+                ev.payload.get("consumer_stall_s", 0.0) or 0.0)
+    for ev in profile.events_of("spill", "unspill"):
+        raw["spill"] += float(ev.payload.get("duration_s", 0.0) or 0.0)
+    for ev in profile.events_of("fetchRetry"):
+        raw["recovery"] += float(ev.payload.get("wait_ms", 0.0) or 0.0) \
+            / 1000.0
+    summary = profile.summary or {}
+    raw["semaphore"] += float(summary.get("semaphore_wait_s", 0.0) or 0.0)
+    # recovery transition counts (no duration carried for task retries —
+    # reported as counts, their re-run time shows in the operator buckets)
+    recovery_counts: Dict[str, int] = {}
+    from spark_rapids_tpu.aux.faults import RECOVERY_KINDS
+    for ev in profile.events:
+        key = RECOVERY_KINDS.get(ev.kind)
+        if key:
+            recovery_counts[key] = recovery_counts.get(key, 0) + 1
+    # scale tracked seconds onto the wall clock; 'other' absorbs the
+    # untracked remainder so the decomposition always totals wall_s
+    tracked_total = sum(raw.values())
+    scaled = {b: 0.0 for b in BUCKETS}
+    if wall <= 0.0:
+        pass
+    elif tracked_total <= 0.0:
+        scaled["other"] = wall
+    elif tracked_total > wall:
+        f = wall / tracked_total
+        for b in BUCKETS:
+            scaled[b] = raw[b] * f
+    else:
+        for b in BUCKETS:
+            scaled[b] = raw[b]
+        scaled["other"] += wall - tracked_total
+    candidates = {b: v for b, v in scaled.items() if b != "other"}
+    bottleneck = max(candidates, key=candidates.get) \
+        if any(candidates.values()) else "other"
+    operators.sort(key=lambda o: o.exclusive_s, reverse=True)
+    return Attribution(wall, {b: round(v, 6) for b, v in raw.items()},
+                       {b: round(v, 6) for b, v in scaled.items()},
+                       operators, bottleneck, recovery_counts)
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+_BAR_WIDTH = 28
+
+
+def _bar(frac: float) -> str:
+    n = int(round(max(0.0, min(1.0, frac)) * _BAR_WIDTH))
+    return "#" * n + "." * (_BAR_WIDTH - n)
+
+
+def _fmt_bytes(n: int) -> str:
+    f = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if f < 1024 or unit == "GiB":
+            return f"{f:.1f}{unit}" if unit != "B" else f"{int(f)}B"
+        f /= 1024
+    return f"{f:.1f}GiB"
+
+
+def _render_timeline(profile: QueryProfile, lines: List[str],
+                     top_n: int = 6) -> None:
+    """Per-partition gantt over the query window for the heaviest spans."""
+    if profile.start_ts is None or profile.end_ts is None:
+        return
+    t0, t1 = profile.start_ts, profile.end_ts
+    window = max(t1 - t0, 1e-9)
+    width = 40
+    ranked = sorted((sp for sp in profile.exec_spans() if sp.partitions),
+                    key=lambda s: s.op_time(), reverse=True)[:top_n]
+    if not ranked:
+        return
+    lines.append("  Partition timeline "
+                 f"(window {window:.3f}s, '=' is active):")
+    for sp in ranked:
+        for part in sorted(sp.partitions,
+                           key=lambda p: (p.get("pidx") is None,
+                                          p.get("pidx"))):
+            ps, pe = part.get("start_s"), part.get("end_s")
+            if ps is None or pe is None:
+                continue
+            a = int((max(ps, t0) - t0) / window * width)
+            b = max(a + 1, int((min(pe, t1) - t0) / window * width))
+            track = " " * a + "=" * (b - a) + " " * max(0, width - b)
+            pidx = part.get("pidx")
+            pid = "?" if pidx is None else str(pidx)
+            lines.append(
+                f"    {sp.name[:24]:<24} p{pid:<3}"
+                f" |{track}| {max(0.0, pe - ps):.4f}s"
+                f" rows={part.get('rows', 0)}")
+
+
+def render_report(profiles: List[QueryProfile], diag: ReadDiagnostics,
+                  query_id: Optional[int] = None,
+                  show_samples: bool = False,
+                  show_timeline: bool = True) -> str:
+    """The ``tools profile`` output: per-query wall-clock decomposition,
+    operator ranking, timelines, recovery ledger and truncation notices."""
+    lines: List[str] = []
+    lines.append(f"== Event log: {diag.files[0] if diag.files else '?'} "
+                 f"({len(diag.files)} file(s), {diag.lines} lines, "
+                 f"{diag.parsed} events) ==")
+    if diag.truncated_lines:
+        lines.append(f"!! {diag.truncated_lines} torn/unparseable line(s) "
+                     "skipped (process killed mid-write?)")
+    if diag.dropped_events:
+        lines.append(f"!! {diag.dropped_events} event(s) dropped by ring "
+                     "buffers BEFORE reaching this log — counts below are "
+                     "lower bounds")
+    if diag.unknown_kinds:
+        lines.append(f"!! unknown event kinds carried through: "
+                     f"{', '.join(diag.unknown_kinds)}")
+    selected = [p for p in profiles
+                if query_id is None or p.query_id == query_id]
+    if not selected:
+        lines.append("no queries found"
+                     if query_id is None else
+                     f"query {query_id} not found "
+                     f"(have {[p.query_id for p in profiles]})")
+        return "\n".join(lines) + "\n"
+    for qp in selected:
+        att = attribute(qp)
+        status = "" if qp.complete else "  [INCOMPLETE: no queryEnd]"
+        lines.append("")
+        lines.append(f"== Query {qp.query_id} {qp.description!r} "
+                     f"wall {att.wall_s:.4f}s "
+                     f"bottleneck={att.bottleneck}{status} ==")
+        if qp.summary and qp.summary.get("events_dropped"):
+            lines.append(f"  !! {qp.summary['events_dropped']} event(s) "
+                         "dropped from this query's ring buffer")
+        lines.append("  Wall-clock decomposition (scaled; raw tracked "
+                     "seconds in parens):")
+        for b in BUCKETS:
+            s = att.scaled.get(b, 0.0)
+            r = att.raw.get(b, 0.0)
+            if s <= 0.0 and r <= 0.0:
+                continue
+            frac = s / att.wall_s if att.wall_s > 0 else 0.0
+            lines.append(f"    {b:<15} {s:8.4f}s {frac * 100:5.1f}% "
+                         f"|{_bar(frac)}| ({r:.4f}s)")
+        total = att.scaled_total()
+        lines.append(f"    {'total':<15} {total:8.4f}s  (wall "
+                     f"{att.wall_s:.4f}s)")
+        ops = [o for o in att.operators if o.exclusive_s > 0][:10]
+        if ops:
+            lines.append("  Top operators by exclusive time:")
+            for o in ops:
+                extra = " ".join(f"{k}={_fmt_bytes(v)}"
+                                 if k == "spill_bytes" else f"{k}={v}"
+                                 for k, v in sorted(o.extras.items()))
+                lines.append(
+                    f"    {o.exclusive_s:8.4f}s  {o.name:<28} "
+                    f"[{o.bucket}] rows={o.rows} batches={o.batches}"
+                    + (f" {extra}" if extra else ""))
+        if att.recovery_counts:
+            lines.append("  Recovery ledger: " + " ".join(
+                f"{k}={v}" for k, v in sorted(att.recovery_counts.items())))
+        if show_timeline:
+            _render_timeline(qp, lines)
+        if qp.samples:
+            peak = max((s.payload.get("pool_used_bytes", 0)
+                        for s in qp.samples), default=0)
+            busiest = max((s.payload.get("active_tasks", 0)
+                           for s in qp.samples), default=0)
+            lines.append(f"  Resource samples: {len(qp.samples)} in window "
+                         f"(peak pool {_fmt_bytes(peak)}, "
+                         f"peak active tasks {busiest})")
+            if show_samples:
+                for s in qp.samples:
+                    lines.append(
+                        f"    t={s.ts - (qp.start_ts or 0.0):8.3f}s "
+                        f"pool={_fmt_bytes(s.payload.get('pool_used_bytes', 0))}"
+                        f" spillable="
+                        f"{_fmt_bytes(s.payload.get('spillable_bytes', 0))}"
+                        f" sem={s.payload.get('semaphore_holders', 0)}"
+                        f"+{s.payload.get('semaphore_waiting', 0)}w"
+                        f" spool={s.payload.get('prefetch_queued_batches', 0)}"
+                        f" tasks={s.payload.get('active_tasks', 0)}")
+    return "\n".join(lines) + "\n"
+
+
+def profiles_to_json(profiles: List[QueryProfile],
+                     diag: ReadDiagnostics) -> Dict:
+    """Machine-readable form of the report (``profile --json``)."""
+    out = {"files": diag.files, "lines": diag.lines,
+           "truncated_lines": diag.truncated_lines,
+           "dropped_events": diag.dropped_events,
+           "queries": []}
+    for qp in profiles:
+        att = attribute(qp)
+        out["queries"].append({
+            "query_id": qp.query_id,
+            "description": qp.description,
+            "complete": qp.complete,
+            "wall_s": round(att.wall_s, 6),
+            "bottleneck": att.bottleneck,
+            "buckets_scaled_s": att.scaled,
+            "buckets_raw_s": att.raw,
+            "recovery": att.recovery_counts,
+            "samples": len(qp.samples),
+            "operators": [dataclasses.asdict(o)
+                          for o in att.operators[:10]],
+        })
+    return out

@@ -368,9 +368,12 @@ def test_event_log_line_atomic_under_concurrent_sinks(tmp_path):
     [t.start() for t in ts]
     [t.join() for t in ts]
     lines = open(path).readlines()
-    assert len(lines) == 1200
-    for line in lines:
-        parse_event_line(line)          # raises on a torn line
+    # 1200 events + the ONE schema header (only the sink that opened the
+    # empty file writes it; the later sinks see a non-empty file)
+    assert len(lines) == 1201
+    parsed = [parse_event_line(line) for line in lines]  # raises on tear
+    assert parsed[0].kind == "eventLogHeader"
+    assert sum(1 for e in parsed if e.kind == "eventLogHeader") == 1
 
 
 def test_dead_worker_lineage_recovery():

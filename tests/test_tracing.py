@@ -94,6 +94,10 @@ def test_event_log_roundtrip(tmp_path):
     df.count()
     lines = log.read_text().splitlines()
     assert lines, "event log must not be empty"
+    # a fresh file opens with the schema-version header line
+    head = json.loads(lines[0])
+    assert head["event"] == "eventLogHeader"
+    assert head["v"] == EV.EVENT_SCHEMA_VERSION
     kinds = set()
     last_ts = {}
     for line in lines:
@@ -101,6 +105,9 @@ def test_event_log_roundtrip(tmp_path):
         raw = json.loads(line)
         for key in ("event", "query_id", "span_id", "ts", "v"):
             assert key in raw, f"event missing {key}: {line}"
+        if ev.kind == "eventLogHeader":
+            assert raw["query_id"] == EV.NO_QUERY
+            continue
         assert raw["query_id"] > 0
         assert raw["span_id"] > 0
         assert isinstance(raw["ts"], float)
