@@ -61,6 +61,9 @@ EVENT_KINDS = frozenset({
     # memory layer (memory/catalog.py, retry.py, semaphore.py, metrics.py)
     "spill", "unspill", "oom", "retryOOM", "splitRetry",
     "semaphoreAcquired", "taskEnd",
+    # cooperative memory arbitration + hung-query watchdog
+    # (memory/arbiter.py)
+    "threadBlocked", "deadlockBreak", "watchdogDump", "taskCancelled",
     # task runner (plan/base.py)
     "taskRetry", "taskDegraded",
     # pipelined execution (exec/pipeline.py)
@@ -463,9 +466,27 @@ def render_prometheus() -> str:
         add("semaphore_wait_seconds_total", "counter",
             round(total.semaphore_wait_seconds, 6),
             "Seconds tasks blocked on device admission")
+        add("alloc_wait_seconds_total", "counter",
+            round(total.alloc_wait_seconds, 6),
+            "Seconds tasks parked in BLOCKED_ON_ALLOC awaiting releases")
         add("semaphore_max_concurrent", "gauge",
             rt.semaphore.max_concurrent,
             "Device admission permits (concurrentGpuTasks)")
+    from spark_rapids_tpu.memory.arbiter import get_arbiter
+    ast = get_arbiter().stats()
+    add("arbiter_blocked_threads", "gauge", ast["blocked_threads"],
+        "Task threads currently in a blocked arbiter state")
+    add("arbiter_blocked_on_alloc_total", "counter",
+        ast["blocked_on_alloc_total"],
+        "Allocation parks taken by the cooperative arbiter")
+    add("deadlock_breaks_total", "counter", ast["deadlock_breaks"],
+        "Forced victim wakes by the deadlock detector")
+    add("forced_splits_total", "counter", ast["forced_splits"],
+        "Deadlock breaks escalated to SplitAndRetryOOM")
+    add("tasks_cancelled_total", "counter", ast["tasks_cancelled"],
+        "Wedged tasks cancelled by the hung-query watchdog")
+    add("watchdog_dumps_total", "counter", ast["watchdog_dumps"],
+        "Hung-query watchdog thread-state dumps")
     add("events_ring_dropped_total", "counter", ring_dropped_total(),
         "Events dropped by bounded ring-buffer sinks (truncation marker)")
     from spark_rapids_tpu.aux import profiler as _prof

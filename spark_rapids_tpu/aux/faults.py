@@ -16,6 +16,11 @@ same discipline covers every data-movement layer:
 - ``task.run``          task start in the parallel runner (plan/base.py)
 - ``parallel.collective``  mesh collective shuffle (parallel/collective.py)
 - ``pipeline.prefetch`` prefetch-spool start (exec/pipeline.py producer)
+- ``memory.block``      allocation admission (memory/catalog.py reserve):
+                        an injected never-releasing hold the watchdog
+                        must detect, dump and cancel
+- ``watchdog.sweep``    inside the watchdog sweep (memory/arbiter.py):
+                        the daemon must survive a faulted pass
 
 Semantics (mirroring ``force_retry_oom(num_ooms, skip)``): arming a point
 with ``n`` and ``skip`` makes the next ``skip`` triggers pass and the
@@ -147,6 +152,9 @@ RECOVERY_KINDS: Dict[str, str] = {
     "workerExpired": "workers_expired",
     "collectiveFallback": "collective_fallbacks",
     "faultInjected": "faults_injected",
+    "deadlockBreak": "deadlock_breaks",
+    "taskCancelled": "tasks_cancelled",
+    "watchdogDump": "watchdog_dumps",
 }
 
 
@@ -205,6 +213,11 @@ def _conn_error(point: str) -> BaseException:
     return ConnectionError(f"injected connection fault at {point!r}")
 
 
+def _block_hold(point: str) -> BaseException:
+    from spark_rapids_tpu.memory.arbiter import InjectedBlockHold
+    return InjectedBlockHold(f"injected allocation hold at {point!r}")
+
+
 #: chaos conf key suffix -> (fault point, exception factory)
 CHAOS_POINTS: Dict[str, Tuple[str, Callable[[str], BaseException]]] = {
     "shuffle.fetch": ("shuffle.fetch", _conn_error),
@@ -214,6 +227,8 @@ CHAOS_POINTS: Dict[str, Tuple[str, Callable[[str], BaseException]]] = {
     "parallel.collective": ("parallel.collective", _default_exc),
     "memory.alloc": ("memory.alloc", _retry_oom),
     "pipeline.prefetch": ("pipeline.prefetch", _default_exc),
+    "memory.block": ("memory.block", _block_hold),
+    "watchdog.sweep": ("watchdog.sweep", _default_exc),
 }
 
 _CHAOS_PREFIX = "spark.rapids.chaos."

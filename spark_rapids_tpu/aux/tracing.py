@@ -92,7 +92,9 @@ class Span:
 
 #: event kinds folded into per-node attribution at finish
 _ATTR_ZERO = {"spill_count": 0, "spill_bytes": 0, "retry_count": 0,
-              "split_retry_count": 0, "oom_count": 0}
+              "split_retry_count": 0, "oom_count": 0,
+              "blocked_count": 0, "blocked_wait_s": 0.0,
+              "deadlock_breaks": 0}
 
 
 class QueryExecution:
@@ -233,7 +235,9 @@ class QueryExecution:
             if sp.kind == "partition":
                 sp = self._span_index.get(sp.parent_id, self.root)
             if sp.kind == "query" and ev.kind not in ("spill", "retryOOM",
-                                                      "splitRetry", "oom"):
+                                                      "splitRetry", "oom",
+                                                      "threadBlocked",
+                                                      "deadlockBreak"):
                 continue
             d = per.setdefault(sp.span_id, dict(_ATTR_ZERO))
             if ev.kind == "spill":
@@ -245,6 +249,13 @@ class QueryExecution:
                 d["split_retry_count"] += 1
             elif ev.kind == "oom":
                 d["oom_count"] += 1
+            elif ev.kind == "threadBlocked":
+                d["blocked_count"] += 1
+                d["blocked_wait_s"] = round(
+                    d["blocked_wait_s"]
+                    + float(ev.payload.get("wait_s", 0.0) or 0.0), 6)
+            elif ev.kind == "deadlockBreak":
+                d["deadlock_breaks"] += 1
         return per
 
     # -- finish / summary ----------------------------------------------------
@@ -289,6 +300,9 @@ class QueryExecution:
                 "semaphore_wait_s": round(
                     total.semaphore_wait_seconds
                     - t0.semaphore_wait_seconds, 6),
+                # cooperative-arbitration parks (memory/arbiter.py)
+                "alloc_wait_s": round(
+                    total.alloc_wait_seconds - t0.alloc_wait_seconds, 6),
                 # max cannot be snapshot-subtracted like the counters;
                 # take THIS query's peak from its tasks' taskEnd events
                 "max_device_bytes": max(
@@ -423,7 +437,7 @@ class QueryExecution:
             f"{k}={summary[k]}" for k in
             ("tasks", "retry_count", "split_retry_count", "oom_count",
              "spill_count", "spill_bytes", "semaphore_wait_s",
-             "max_device_bytes") if k in summary))
+             "alloc_wait_s", "max_device_bytes") if k in summary))
         rec = summary.get("recovery")
         if rec:
             lines.append("== Recovery ==")

@@ -228,6 +228,49 @@ PAGEABLE_POOL_SIZE = conf_bytes(
     "Host allocation pool size.",
     1 << 30, ConfLevel.STARTUP)
 
+MEMORY_ARBITRATION_ENABLED = conf_bool(
+    "spark.rapids.memory.arbitration.enabled",
+    "Cooperative memory arbitration (memory/arbiter.py): a registered "
+    "task thread that cannot allocate BLOCKS until concurrent tasks "
+    "release memory, and only a detected deadlock (every device-holding "
+    "task blocked) wakes one victim with a forced Retry/SplitAndRetry "
+    "OOM (reference: the RmmSpark/SparkResourceAdaptor thread-state "
+    "machine).  Disabled, reserve() raises RetryOOM on first shortfall "
+    "as before.",
+    True)
+
+MEMORY_ARBITRATION_MAX_BLOCK_MS = conf_int(
+    "spark.rapids.memory.arbitration.maxBlockMs",
+    "Liveness backstop: the longest ONE allocation park may wait before "
+    "falling back to a plain RetryOOM toward the task's retry frame.  "
+    "Validated > 0 at set_conf.",
+    10_000,
+    checker=lambda v: int(v) > 0)
+
+WATCHDOG_ENABLED = conf_bool(
+    "spark.rapids.watchdog.enabled",
+    "Hung-query watchdog (memory/arbiter.py): a daemon observing "
+    "per-task last-progress timestamps (task-runner heartbeats, spool "
+    "progress, alloc/semaphore wait entries).  A task with no progress "
+    "for timeoutMs gets a full thread-state + holder-stack dump "
+    "(watchdogDump event), then a forced arbitration round, then "
+    "cancellation — surfacing as a retryable TaskCancelled the "
+    "task-retry/circuit-breaker machinery re-executes or degrades.",
+    False)
+
+WATCHDOG_TIMEOUT_MS = conf_int(
+    "spark.rapids.watchdog.timeoutMs",
+    "Per-task no-progress budget before the watchdog dumps and "
+    "escalates.  Validated > 0 at set_conf.",
+    60_000,
+    checker=lambda v: int(v) > 0)
+
+WATCHDOG_POLL_MS = conf_int(
+    "spark.rapids.watchdog.pollMs",
+    "Watchdog sweep interval.  Validated > 0 at set_conf.",
+    100,
+    checker=lambda v: int(v) > 0)
+
 OOM_RETRY_COUNT = conf_int(
     "spark.rapids.memory.gpu.oomDumpRetryCount",
     "How many synchronous spill-and-retry attempts on device alloc failure "
@@ -376,6 +419,16 @@ def _chaos_spec_ok(v) -> bool:
     return chaos_spec_ok(v)
 
 
+SHUFFLE_TRANSPORT_TIMEOUT_MS = conf_int(
+    "spark.rapids.shuffle.transport.timeoutMs",
+    "Default bound for otherwise-unbounded transport waits: "
+    "Transaction.wait(None) and bounce-buffer acquire(None) resolve to "
+    "this, so a dead peer surfaces as a retryable TimeoutError through "
+    "the fetch-retry policy instead of pinning a sender thread forever.  "
+    "Validated > 0 at set_conf.",
+    120_000,
+    checker=lambda v: int(v) > 0)
+
 SHUFFLE_FETCH_TIMEOUT_MS = conf_int(
     "spark.rapids.shuffle.fetch.timeoutMs",
     "Per-attempt wait for in-flight shuffle data frames after a transfer "
@@ -495,6 +548,23 @@ CHAOS_MEMORY_ALLOC = conf_str(
     "through the shared chaos mechanism ('n' or 'n:skip'); the thread-"
     "scoped spark.rapids.sql.test.injectRetryOOM remains for framed "
     "per-task injection.",
+    "", ConfLevel.INTERNAL,
+    checker=_chaos_spec_ok)
+
+CHAOS_MEMORY_BLOCK = conf_str(
+    "spark.rapids.chaos.memory.block",
+    "Fault injection at the allocation admission point ('n' or "
+    "'n:skip'): an injected NEVER-RELEASING allocation hold — the task "
+    "parks arbitration-immune until the hung-query watchdog dumps, "
+    "escalates and cancels it.  Exercises the hang-detection path "
+    "deterministically.",
+    "", ConfLevel.INTERNAL,
+    checker=_chaos_spec_ok)
+
+CHAOS_WATCHDOG_SWEEP = conf_str(
+    "spark.rapids.chaos.watchdog.sweep",
+    "Fault injection inside the watchdog's sweep loop ('n' or "
+    "'n:skip'); exercises the daemon's survive-a-bad-sweep discipline.",
     "", ConfLevel.INTERNAL,
     checker=_chaos_spec_ok)
 

@@ -239,11 +239,16 @@ class PrefetchSpool:
 
     def _produce(self) -> None:
         # adopt the consumer task's identity: semaphore acquires in this
-        # thread key to the task and release with it (run_task's finally)
+        # thread key to the task and release with it (run_task's finally),
+        # and the arbiter tracks this thread under the task so the
+        # deadlock detector sees the task's FULL thread set
+        from spark_rapids_tpu.memory.arbiter import get_arbiter
         from spark_rapids_tpu.memory.retry import task_context
         tc = task_context()
         tc.task_id = self._task_id
         tc.metrics = self._task_metrics
+        arb = get_arbiter()
+        adopted = arb.adopt_thread(self._task_id)
         src = None
         try:
             from spark_rapids_tpu.aux.faults import maybe_fire
@@ -260,11 +265,14 @@ class PrefetchSpool:
                 if not self._put(entry):
                     self._close_entry(entry)
                     break
+                arb.note_progress(self._task_id)
         except BaseException as e:   # noqa: BLE001 - re-raised by consumer
             with self._cond:
                 self._q.append(_SpoolError(e))
                 self._cond.notify_all()
         finally:
+            if adopted:
+                arb.drop_thread(self._task_id)
             if src is not None:
                 # the producer owns the upstream generator: closing it HERE
                 # (never from the consumer thread, which would race a
@@ -287,23 +295,26 @@ class PrefetchSpool:
                 self._cond.notify_all()
 
     def _put(self, entry) -> bool:
+        from spark_rapids_tpu.memory.arbiter import TaskState, get_arbiter
+        arb = get_arbiter()
         nb = entry[2]
         with self._cond:
-            t0 = None
-            # admit at least one item regardless of its size, else a batch
-            # larger than the byte budget would deadlock the spool
-            while not self._stop and (
+            # admit at least one item regardless of its size, else a
+            # batch larger than the byte budget would deadlock the spool.
+            # NO semaphore release while backpressured: the device hold
+            # is keyed by the task id this producer SHARES with its
+            # consumer, and that consumer is the thread draining this
+            # very queue — the task keeps progressing, and a whole-task
+            # release would strip admission from a sibling mid-kernel
+            # (over-admitting past concurrentGpuTasks)
+            t0 = arb.wait_cancellable(
+                self._cond,
+                lambda: not self._stop and (
                     self._depth >= self.depth or
-                    (self._depth > 0 and self._bytes + nb > self.max_bytes)):
-                if t0 is None:
-                    t0 = time.monotonic()
-                # NO semaphore release here: the device hold is keyed by
-                # the task id this producer SHARES with its consumer, and
-                # that consumer is the thread draining this very queue —
-                # the task keeps progressing, and a whole-task release
-                # would strip admission from a sibling mid-kernel
-                # (over-admitting past concurrentGpuTasks)
-                self._cond.wait()
+                    (self._depth > 0
+                     and self._bytes + nb > self.max_bytes)),
+                TaskState.BLOCKED_ON_SPOOL, slice_s=0.1,
+                task_id=self._task_id)
             if t0 is not None:
                 self.producer_stall_s += time.monotonic() - t0
             if self._stop:
@@ -322,22 +333,29 @@ class PrefetchSpool:
         return self
 
     def __next__(self):
+        from spark_rapids_tpu.memory.arbiter import TaskState, get_arbiter
+        arb = get_arbiter()
         if self._thread is None:
             self._start()
         with self._cond:
-            t0 = None
-            while not self._q:
-                if t0 is None:
-                    t0 = time.monotonic()
-                    if self._task_id is None:
-                        # untasked caller (direct-exec tests): the
-                        # producer acquires under its OWN thread identity
-                        # and could block on this thread's hold — drop it
-                        # while waiting.  Tasked callers share one hold
-                        # with the producer, so waiting with it held is
-                        # deadlock-free and keeps admission honest.
-                        release_semaphore_for_wait()
-                self._cond.wait()
+            def _on_first_wait():
+                if self._task_id is None:
+                    # untasked caller (direct-exec tests): the producer
+                    # acquires under its OWN thread identity and could
+                    # block on this thread's hold — drop it while
+                    # waiting.  Tasked callers share one hold with the
+                    # producer, so waiting with it held is deadlock-free
+                    # and keeps admission honest.
+                    release_semaphore_for_wait()
+
+            # waiting on our own producer: a tracked blocked state (the
+            # producer may itself be parked on an allocation — the task
+            # is then FULLY blocked and must count toward deadlock
+            # detection)
+            t0 = arb.wait_cancellable(
+                self._cond, lambda: not self._q,
+                TaskState.BLOCKED_ON_SPOOL, slice_s=0.1,
+                task_id=self._task_id, on_first_wait=_on_first_wait)
             if t0 is not None:
                 self.consumer_stall_s += time.monotonic() - t0
             entry = self._q.popleft()
@@ -361,6 +379,7 @@ class PrefetchSpool:
                 payload = spill.get_batch()
             finally:
                 spill.close()
+        arb.note_progress(self._task_id)    # spool handoff = task progress
         self._reacquire_admission(payload)
         return payload
 

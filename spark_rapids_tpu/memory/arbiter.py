@@ -1,0 +1,908 @@
+"""Cooperative memory arbitration: the thread-state machine behind OOMs.
+
+Reference: ``RmmSpark`` / ``SparkResourceAdaptor`` (spark-rapids-jni) — the
+heart of the plugin's "retryable OOM handling" is not the retry frames but
+the per-thread state machine behind them: a task that cannot allocate
+*blocks* until concurrent tasks release memory, and only when every active
+task is blocked (a true deadlock) is one victim woken with a forced OOM.
+Sparkle's analysis of memory partitioning among concurrent Spark workers
+(PAPERS.md) identifies exactly this cooperation as the limiter for
+shared-memory scale-up.
+
+Three cooperating pieces:
+
+``ResourceArbiter``
+    A process-wide registry of every active task thread's state
+    (RUNNING, BLOCKED_ON_ALLOC, BLOCKED_ON_SEMAPHORE, BLOCKED_ON_SPOOL,
+    BUFN).  ``BufferCatalog.reserve`` parks a short thread in
+    BLOCKED_ON_ALLOC on the arbiter's condition variable — signalled by
+    every catalog ``remove``/spill — instead of raising ``RetryOOM`` on
+    first shortfall, so concurrent tasks cooperate instead of thrashing
+    through rollbacks.
+
+Deadlock detection + forced-split victim selection
+    Run inline on every transition-to-blocked (plus the watchdog's
+    low-frequency sweep): when every registered *device-holding* task is
+    blocked and at least one waits on an allocation, the arbiter picks a
+    victim by ``(spill priority, wake count, most recently started)`` and
+    wakes it with a forced OOM.  The first wake of a task is a
+    ``RetryOOM`` (spill-everything-and-retry may still succeed); a task
+    that blocks again without progress is BUFN — "blocked until further
+    notice" — and its next forced wake is a ``SplitAndRetryOOM`` (or
+    ``RetryOOM`` again when the thread holds no splittable input).  The
+    existing ``with_retry`` / ``with_retry_no_split`` frames in
+    ``memory/retry.py`` absorb the thrown OOMs unchanged.
+
+``HungQueryWatchdog``
+    A conf-armed daemon (``spark.rapids.watchdog.{enabled,timeoutMs,
+    pollMs}``) observing per-task last-progress timestamps (fed by
+    task-runner heartbeats in ``plan/base.py``, spool progress in
+    ``exec/pipeline.py`` and semaphore/alloc wait entries).  On expiry it
+    dumps every thread state + holder stacks (``watchdogDump``), then
+    escalates: first a forced arbitration round, then cancelling the
+    wedged task with ``TaskCancelled`` — a ``TimeoutError`` the task
+    runner classifies retryable, so the PR 3 task-retry/circuit-breaker
+    machinery re-executes or degrades it.
+
+Lock discipline: callers may hold the catalog lock, a semaphore condition
+or a spool condition when calling in (their lock -> arbiter lock); the
+arbiter NEVER calls back into the catalog, semaphore or spools, so the
+ordering is one-directional and deadlock-free by construction.
+"""
+
+from __future__ import annotations
+
+import enum
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu.memory.retry import (RetryOOM, SplitAndRetryOOM,
+                                           task_context)
+
+#: conf-driven (plan/overrides.apply): spark.rapids.memory.arbitration.*
+ARBITRATION_ENABLED = True
+#: cap on ONE alloc park before falling back to a plain RetryOOM (the
+#: pre-arbiter behavior) — a liveness backstop for waits nothing can
+#: break cooperatively (e.g. an unregistered thread pinning the pool)
+MAX_BLOCK_MS = 10_000
+
+
+class TaskState(enum.Enum):
+    """reference: RmmSparkThreadState (spark-rapids-jni SparkResourceAdaptor)"""
+    RUNNING = "running"
+    BLOCKED_ON_ALLOC = "blocked_on_alloc"
+    BLOCKED_ON_SEMAPHORE = "blocked_on_semaphore"
+    BLOCKED_ON_SPOOL = "blocked_on_spool"
+    BUFN = "bufn"
+
+
+class TaskCancelled(TimeoutError):
+    """The watchdog cancelled a wedged task.  A ``TimeoutError`` so the
+    task runner's retryable classification (plan/base.py) re-executes or
+    degrades it through the existing machinery."""
+
+    def __init__(self, task_id, reason: str):
+        super().__init__(f"task {task_id} cancelled: {reason}")
+        self.task_id = task_id
+        self.reason = reason
+
+
+class InjectedBlockHold(Exception):
+    """Chaos-only (``spark.rapids.chaos.memory.block``): simulates a
+    never-releasing allocation hold.  ``BufferCatalog.reserve`` converts
+    it into an arbitration-immune park that only watchdog cancellation
+    (or a generous expiry backstop) can break."""
+
+
+_BLOCKED_STATES = frozenset({TaskState.BLOCKED_ON_ALLOC,
+                             TaskState.BLOCKED_ON_SEMAPHORE,
+                             TaskState.BLOCKED_ON_SPOOL})
+
+
+class _ThreadSlot:
+    __slots__ = ("ident", "name", "state", "since", "nbytes",
+                 "split_capable", "hold", "wake_exc", "break_info")
+
+    def __init__(self, ident: int, name: str):
+        self.ident = ident
+        self.name = name
+        self.state = TaskState.RUNNING
+        self.since = time.monotonic()
+        self.nbytes = 0
+        self.split_capable = False
+        #: True while parked in an injected memory.block hold: visible to
+        #: dumps as blocked, invisible to victim selection (a hang is not
+        #: a memory wait — arbitration cannot relieve it)
+        self.hold = False
+        self.wake_exc = None            # exception CLASS set by the waker
+        self.break_info: Optional[dict] = None
+
+
+class _TaskEntry:
+    __slots__ = ("task_id", "seq", "threads", "holds_device",
+                 "holds_memory", "spill_priority", "wake_count", "bufn",
+                 "last_progress", "cancelled", "cancel_reason",
+                 "cancel_reported")
+
+    def __init__(self, task_id: int, seq: int):
+        self.task_id = task_id
+        self.seq = seq                  # registration order (victim ties)
+        self.threads: Dict[int, _ThreadSlot] = {}
+        self.holds_device = False
+        #: registered catalog device buffers (sticky for the task's life:
+        #: a task that held memory stays deadlock-relevant — conservative
+        #: toward the MAX_BLOCK_MS fallback, never toward spurious wakes)
+        self.holds_memory = False
+        #: min priority of registered buffers; None until the task
+        #: registers one (a positive-priority buffer must not compare
+        #: against a phantom 0 that marks the task most-evictable)
+        self.spill_priority: Optional[int] = None
+        self.wake_count = 0             # forced wakes received
+        self.bufn = False               # blocked-until-further-notice
+        self.last_progress = time.monotonic()
+        self.cancelled = False
+        self.cancel_reason = ""
+        self.cancel_reported = False    # counted/emitted once per episode
+
+
+class ResourceArbiter:
+    """The process-wide task thread-state registry + blocking-allocation
+    rendezvous (reference: SparkResourceAdaptor's thread registry)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._tasks: Dict[int, _TaskEntry] = {}
+        #: task ids currently BUFN, mirrored from the entries so the
+        #: catalog's fast path can test membership WITHOUT the arbiter
+        #: lock (mutated only under it; a stale read merely defers the
+        #: clear to the next allocation)
+        self._bufn_tasks: set = set()
+        self._seq = 0
+        #: bumped by every release-ish transition; alloc parkers wait for
+        #: it to move and then re-try admission
+        self._release_seq = 0
+        # process-lifetime counters (render_prometheus / tests)
+        self.blocked_on_alloc_total = 0
+        self.deadlock_breaks = 0
+        self.forced_splits = 0
+        self.forced_retries = 0
+        self.tasks_cancelled = 0
+        self.watchdog_dumps = 0
+
+    # -- registration --------------------------------------------------------
+    def register_task(self, task_id: Optional[int]) -> None:
+        """Registers the calling thread as ``task_id``'s primary thread
+        (task start in ``plan/base.run_task_iter``)."""
+        if task_id is None:
+            return
+        t = threading.current_thread()
+        with self._cond:
+            self._seq += 1
+            e = self._tasks.get(task_id)
+            if e is None:
+                e = self._tasks[task_id] = _TaskEntry(task_id, self._seq)
+            e.threads[t.ident] = _ThreadSlot(t.ident, t.name)
+
+    def deregister_task(self, task_id: Optional[int]) -> None:
+        if task_id is None:
+            return
+        with self._cond:
+            self._bufn_tasks.discard(task_id)
+            if self._tasks.pop(task_id, None) is None:
+                return
+            # the task's buffers / permits free with it: blocked peers
+            # wake, re-try admission, and — still short — RE-park, which
+            # re-runs the deadlock check against the post-exit registry
+            # (checking here instead would victimize a peer that the
+            # departing task's releases are about to satisfy)
+            self._release_seq += 1
+            self._cond.notify_all()
+
+    def adopt_thread(self, task_id: Optional[int]) -> bool:
+        """Registers an EXTRA thread under an existing task (the pipeline
+        prefetch producer adopting its consumer's identity)."""
+        if task_id is None:
+            return False
+        t = threading.current_thread()
+        with self._cond:
+            e = self._tasks.get(task_id)
+            if e is None:
+                return False
+            e.threads[t.ident] = _ThreadSlot(t.ident, t.name)
+            return True
+
+    def drop_thread(self, task_id: Optional[int]) -> None:
+        if task_id is None:
+            return
+        ident = threading.get_ident()
+        with self._cond:
+            e = self._tasks.get(task_id)
+            if e is None:
+                return
+            e.threads.pop(ident, None)
+            # one fewer thread can change "all blocked": wake parkers so
+            # their re-park re-evaluates against the new thread set
+            self._release_seq += 1
+            self._cond.notify_all()
+
+    # -- cheap notes (hot paths guard on the empty registry) -----------------
+    def note_progress(self, task_id: Optional[int] = None) -> None:
+        """Heartbeat: the task moved data (a batch yielded, a spool item
+        exchanged, an unspill).  Feeds the watchdog and clears BUFN."""
+        if not self._tasks:
+            return
+        if task_id is None:
+            task_id = task_context().task_id
+        if task_id is None:
+            return
+        with self._cond:
+            e = self._tasks.get(task_id)
+            if e is not None:
+                e.last_progress = time.monotonic()
+                # progress disproves "wedged": a cancellation the task
+                # outran must not kill it at its NEXT legitimate wait.
+                # (BUFN is NOT cleared here — only a successful
+                # allocation disproves "cannot allocate", else a retry's
+                # own heartbeats would reset the forced-split escalation)
+                e.cancelled = False
+                e.cancel_reason = ""
+                e.cancel_reported = False
+
+    def is_bufn(self, task_id: Optional[int] = None) -> bool:
+        """Lock-free BUFN probe for ``reserve``'s fast path: only a BUFN
+        task's success needs the locked clear below."""
+        if not self._bufn_tasks:
+            return False
+        if task_id is None:
+            task_id = task_context().task_id
+        return task_id in self._bufn_tasks
+
+    def note_alloc_success(self, task_id: Optional[int]) -> None:
+        """ANY successful reserve disproves "cannot allocate": the task
+        is no longer blocked-until-further-notice."""
+        with self._cond:
+            self._bufn_tasks.discard(task_id)
+            e = self._tasks.get(task_id)
+            if e is not None:
+                e.bufn = False
+                e.last_progress = time.monotonic()
+                e.cancelled = False
+                e.cancel_reason = ""
+                e.cancel_reported = False
+
+    def note_device_held(self, task_id: Optional[int], held: bool) -> None:
+        """Semaphore acquire/release keeps the registry's device-holder
+        view current (the arbiter never queries the semaphore — lock
+        ordering stays one-directional)."""
+        if task_id is None or not self._tasks:
+            return
+        with self._cond:
+            e = self._tasks.get(task_id)
+            if e is None:
+                return
+            e.holds_device = held
+            if not held:
+                # released admission: a blocked peer may now win it
+                self._release_seq += 1
+                self._cond.notify_all()
+
+    def note_buffer_priority(self, task_id: Optional[int],
+                             priority: int) -> None:
+        """Victim-selection input: the task's most-evictable registered
+        buffer (lower spills first, and its owner loses arbitration
+        first)."""
+        if task_id is None or not self._tasks:
+            return
+        with self._cond:
+            e = self._tasks.get(task_id)
+            if e is not None:
+                e.holds_memory = True
+                if e.spill_priority is None or priority < e.spill_priority:
+                    e.spill_priority = priority
+
+    def notify_release(self) -> None:
+        """Catalog hook: device bytes were freed (remove / spill) — every
+        alloc parker re-tries admission."""
+        if not self._tasks:
+            return
+        with self._cond:
+            self._release_seq += 1
+            self._cond.notify_all()
+
+    def release_seq(self) -> int:
+        """Sampled by ``BufferCatalog.reserve`` BEFORE its admission
+        check and handed back to ``block_on_alloc``: a release landing
+        between the failed check and the park moves the seq past the
+        sample, so the parker retries immediately instead of waiting for
+        a future release that may never come."""
+        with self._cond:
+            return self._release_seq
+
+    # -- cancellation --------------------------------------------------------
+    def cancel_task(self, task_id: int, reason: str) -> bool:
+        """Watchdog escalation: every blocking primitive of the task
+        raises ``TaskCancelled`` at its next wait check."""
+        with self._cond:
+            e = self._tasks.get(task_id)
+            if e is None or e.cancelled:
+                return False
+            e.cancelled = True
+            e.cancel_reason = reason
+            self._cond.notify_all()
+            return True
+
+    def check_cancelled(self, task_id: Optional[int] = None) -> None:
+        """Raises ``TaskCancelled`` (and emits ``taskCancelled``) when the
+        watchdog cancelled the calling task.  Blocking wait loops
+        (semaphore, spools) poll this between wait slices."""
+        if not self._tasks:
+            return
+        if task_id is None:
+            task_id = task_context().task_id
+        if task_id is None:
+            return
+        with self._cond:
+            e = self._tasks.get(task_id)
+            if e is None or not e.cancelled:
+                return
+            reason = e.cancel_reason
+        self._raise_cancelled(task_id, reason)
+
+    def _raise_cancelled(self, task_id, reason: str):
+        # every blocked thread of the task raises, but the cancellation
+        # is ONE event: count/emit only the first reporter per episode
+        first = False
+        with self._cond:
+            e = self._tasks.get(task_id)
+            if e is not None and not e.cancel_reported:
+                e.cancel_reported = True
+                first = True
+        if first:
+            self.tasks_cancelled += 1
+            from spark_rapids_tpu.aux.events import emit
+            from spark_rapids_tpu.aux.faults import note_recovery
+            note_recovery("tasks_cancelled")
+            emit("taskCancelled", task_id=task_id, reason=reason[:160])
+        raise TaskCancelled(task_id, reason)
+
+    # -- blocked-state transitions -------------------------------------------
+    def enter_blocked(self, state: TaskState) -> Optional[_ThreadSlot]:
+        """Marks the calling thread blocked (semaphore/spool waits).  The
+        transition runs the inline deadlock check: this thread going
+        quiet may complete the all-blocked condition.  Returns the slot
+        for ``exit_blocked`` (None when unregistered/disabled)."""
+        if not ARBITRATION_ENABLED or not self._tasks:
+            return None
+        task_id = task_context().task_id
+        if task_id is None:
+            return None
+        ident = threading.get_ident()
+        with self._cond:
+            e = self._tasks.get(task_id)
+            slot = e.threads.get(ident) if e is not None else None
+            if slot is None or slot.state is not TaskState.RUNNING:
+                return None
+            slot.state = state
+            slot.since = time.monotonic()
+            self._check_deadlock_locked()
+            return slot
+
+    def exit_blocked(self, slot: Optional[_ThreadSlot],
+                     state: TaskState) -> None:
+        if slot is None:
+            return
+        with self._cond:
+            if slot.state is state:
+                slot.state = TaskState.RUNNING
+
+    def wait_cancellable(self, cond: threading.Condition, should_wait,
+                         state: TaskState, slice_s: float = 0.05,
+                         task_id: Optional[int] = None,
+                         on_first_wait=None) -> Optional[float]:
+        """THE blocking-primitive wait discipline, shared by the
+        semaphore and the spool ends: slice-waits on ``cond`` (which the
+        caller already holds) while ``should_wait()`` is true, tracked
+        in the registry as ``state`` and polling watchdog cancellation
+        between slices.  ``on_first_wait`` runs once, before the first
+        wait slice.  Returns the monotonic time of the first wait (for
+        the caller's stall accounting), or None when it never waited."""
+        t0 = None
+        slot = None
+        try:
+            while should_wait():
+                if t0 is None:
+                    t0 = time.monotonic()
+                    # lock order: caller's cond -> arbiter lock; the
+                    # arbiter never calls back into the caller
+                    slot = self.enter_blocked(state)
+                    if on_first_wait is not None:
+                        on_first_wait()
+                self.check_cancelled(task_id)
+                cond.wait(slice_s)
+        finally:
+            self.exit_blocked(slot, state)
+        return t0
+
+    # -- the blocking allocation rendezvous ----------------------------------
+    def can_block(self) -> bool:
+        """True when the calling thread belongs to a registered task and
+        arbitration is on — the gate ``BufferCatalog.reserve`` consults
+        before parking instead of raising."""
+        if not ARBITRATION_ENABLED or not self._tasks:
+            return False
+        task_id = task_context().task_id
+        if task_id is None:
+            return False
+        with self._cond:
+            e = self._tasks.get(task_id)
+            return e is not None and threading.get_ident() in e.threads
+
+    def block_on_alloc(self, nbytes: int,
+                       seen_seq: Optional[int] = None) -> str:
+        """Parks the calling thread in BLOCKED_ON_ALLOC until memory is
+        released ("retry": the caller re-tries admission), the deadlock
+        detector picks it as victim (raises the forced OOM), the watchdog
+        cancels it (raises ``TaskCancelled``), or ``MAX_BLOCK_MS``
+        expires ("timeout": the caller falls back to plain RetryOOM).
+
+        ``seen_seq`` is the ``release_seq()`` sample the caller took
+        before its failed admission check: a release in the gap bumps
+        past it and the park degenerates to an immediate "retry"."""
+        ctx = task_context()
+        task_id = ctx.task_id
+        ident = threading.get_ident()
+        t0 = time.monotonic()
+        deadline = t0 + max(1, MAX_BLOCK_MS) / 1000.0
+        exc_cls = None
+        break_info = None
+        cancel_reason = None
+        with self._cond:
+            e = self._tasks.get(task_id)
+            slot = e.threads.get(ident) if e is not None else None
+            if slot is None:
+                return "unregistered"
+            slot.state = TaskState.BLOCKED_ON_ALLOC
+            slot.since = t0
+            slot.nbytes = int(nbytes)
+            # only a top-level with_retry frame can absorb a split
+            slot.split_capable = ctx.split_frames > 0
+            self.blocked_on_alloc_total += 1
+            if seen_seq is None:
+                seen_seq = self._release_seq
+            self._check_deadlock_locked()
+            outcome = None
+            while outcome is None:
+                if slot.wake_exc is not None:
+                    exc_cls, slot.wake_exc = slot.wake_exc, None
+                    break_info, slot.break_info = slot.break_info, None
+                    outcome = "forced"
+                elif e.cancelled:
+                    cancel_reason = e.cancel_reason
+                    outcome = "cancelled"
+                elif self._release_seq != seen_seq:
+                    outcome = "retry"
+                else:
+                    now = time.monotonic()
+                    if now >= deadline:
+                        outcome = "timeout"
+                    else:
+                        self._cond.wait(min(0.25, deadline - now))
+            slot.state = TaskState.RUNNING
+            slot.nbytes = 0
+        wait_s = time.monotonic() - t0
+        if ctx.metrics is not None:
+            ctx.metrics.alloc_wait_seconds += wait_s
+        from spark_rapids_tpu.aux.events import emit
+        emit("threadBlocked", task_id=task_id, nbytes=int(nbytes),
+             wait_s=round(wait_s, 6), outcome=outcome)
+        if outcome == "forced":
+            from spark_rapids_tpu.aux.faults import note_recovery
+            note_recovery("deadlock_breaks")
+            emit("deadlockBreak", task_id=task_id,
+                 exc=exc_cls.__name__, **(break_info or {}))
+            raise exc_cls(
+                f"forced {exc_cls.__name__} by arbitration: task {task_id} "
+                f"lost the deadlock break (needed {nbytes} bytes)")
+        if outcome == "cancelled":
+            self._raise_cancelled(task_id, cancel_reason or "cancelled")
+        return outcome
+
+    def hold_until_cancelled(self) -> None:
+        """The injected ``memory.block`` hang: parks arbitration-immune
+        until the watchdog cancels the task.  A generous expiry backstop
+        (10x MAX_BLOCK_MS) keeps watchdog-less runs from hanging a test
+        process forever."""
+        ctx = task_context()
+        task_id = ctx.task_id
+        ident = threading.get_ident()
+        deadline = time.monotonic() + 10 * max(1, MAX_BLOCK_MS) / 1000.0
+        reason = None
+        with self._cond:
+            e = self._tasks.get(task_id)
+            slot = e.threads.get(ident) if e is not None else None
+            if slot is not None:
+                slot.state = TaskState.BLOCKED_ON_ALLOC
+                slot.since = time.monotonic()
+                slot.hold = True
+            try:
+                while True:
+                    if e is not None and e.cancelled:
+                        reason = e.cancel_reason
+                        break
+                    now = time.monotonic()
+                    if now >= deadline:
+                        reason = "injected memory.block hold expired " \
+                                 "without watchdog cancellation"
+                        if e is not None:
+                            e.cancelled = True
+                            e.cancel_reason = reason
+                        break
+                    self._cond.wait(min(0.05, deadline - now))
+            finally:
+                if slot is not None:
+                    slot.state = TaskState.RUNNING
+                    slot.hold = False
+        self._raise_cancelled(task_id, reason or "cancelled")
+
+    # -- deadlock detection + victim selection -------------------------------
+    def _check_deadlock_locked(self, force: bool = False,
+                               only_task: Optional[int] = None) -> bool:
+        """All registered device-holding tasks blocked and somebody
+        waiting on an allocation = a true deadlock: pick ONE victim and
+        wake it with a forced OOM.  ``force=True`` (watchdog escalation)
+        skips the all-blocked requirement and goes straight to the
+        split-capable exception; ``only_task`` confines victim selection
+        to the expired task so escalation never force-splits a healthy
+        bystander."""
+        candidates: List[Tuple[_TaskEntry, _ThreadSlot]] = []
+        for e in self._tasks.values():
+            if only_task is not None and e.task_id != only_task:
+                continue
+            slots = list(e.threads.values())
+            if not slots:
+                continue
+            alloc = [s for s in slots
+                     if s.state is TaskState.BLOCKED_ON_ALLOC
+                     and not s.hold and s.wake_exc is None]
+            relevant = e.holds_device or e.holds_memory or any(
+                s.state is TaskState.BLOCKED_ON_ALLOC for s in slots)
+            if not relevant:
+                continue        # cannot free device memory either way
+            if not force and any(s.state is TaskState.RUNNING
+                                 or s.wake_exc is not None for s in slots):
+                return False    # somebody can still release
+            candidates.extend((e, s) for s in alloc)
+        if not candidates:
+            return False
+        # buffer-less tasks sort last: they have nothing to spill, so
+        # victimizing a task with real evictable buffers frees more
+        entry, slot = min(
+            candidates,
+            key=lambda es: (es[0].spill_priority
+                            if es[0].spill_priority is not None
+                            else float("inf"),
+                            es[0].wake_count, -es[0].seq))
+        # first wake: RetryOOM (spill-everything-and-retry may suffice);
+        # a BUFN task blocking again escalates to a forced split
+        if (force or entry.bufn) and slot.split_capable:
+            exc_cls = SplitAndRetryOOM
+            self.forced_splits += 1
+        else:
+            exc_cls = RetryOOM
+            self.forced_retries += 1
+        entry.bufn = True
+        self._bufn_tasks.add(entry.task_id)
+        entry.wake_count += 1
+        self.deadlock_breaks += 1
+        slot.wake_exc = exc_cls
+        slot.break_info = {
+            "blocked_tasks": sum(
+                1 for t in self._tasks.values()
+                if t.threads and all(s.state is not TaskState.RUNNING
+                                     for s in t.threads.values())),
+            "forced": bool(force),
+            "split_capable": slot.split_capable,
+            "spill_priority": entry.spill_priority,
+            "wake_count": entry.wake_count,
+        }
+        self._cond.notify_all()
+        return True
+
+    def force_arbitration(self, task_id: Optional[int] = None) -> bool:
+        """Watchdog escalation step 1: break the wait NOW, all-blocked or
+        not.  ``task_id`` confines the wake to the expired task — if the
+        wedged task is alloc-parked, forcing IT to retry/split is the
+        right escalation; waking a healthy bystander would defer the
+        wedged task's recovery while costing the bystander its work.
+        Returns True when a victim was woken."""
+        with self._cond:
+            return self._check_deadlock_locked(force=True,
+                                               only_task=task_id)
+
+    # -- introspection -------------------------------------------------------
+    def task_held(self, task_id: int) -> bool:
+        """True when the task sits in an injected ``memory.block`` hold —
+        known unrecoverable, so the watchdog cancels it at the first
+        detection instead of granting the post-dump grace."""
+        with self._cond:
+            e = self._tasks.get(task_id)
+            return e is not None and any(s.hold
+                                         for s in e.threads.values())
+
+    def waiting_on_live_holder(self, task_id: int) -> bool:
+        """True when the task's ONLY blockage is the device-admission
+        queue while some other registered task holds the device and
+        still has a runnable thread: queued behind a live worker, not
+        wedged — the watchdog must leave it alone (cancelling it would
+        fail a query that was merely waiting its turn)."""
+        with self._cond:
+            e = self._tasks.get(task_id)
+            if e is None or not e.threads:
+                return False
+            if not all(s.state is TaskState.BLOCKED_ON_SEMAPHORE
+                       for s in e.threads.values()):
+                return False
+            return any(o.task_id != task_id and o.holds_device
+                       and any(s.state is TaskState.RUNNING
+                               for s in o.threads.values())
+                       for o in self._tasks.values())
+
+    def global_progress_age(self) -> float:
+        """Seconds since ANY registered task progressed — the watchdog's
+        process-liveness test: while something is moving, an idle task
+        may just be starved, and cancellation can wait."""
+        with self._cond:
+            if not self._tasks:
+                return 0.0
+            return time.monotonic() - max(e.last_progress
+                                          for e in self._tasks.values())
+
+    def expired_tasks(self, timeout_s: float) -> List[Tuple[int, float]]:
+        """(task_id, idle_s) for tasks with no progress for timeout_s.
+        Cancelled tasks stay listed: one that never reaches a
+        cancellation checkpoint must keep its watchdog episode alive
+        (periodic re-dumps) instead of going silent."""
+        now = time.monotonic()
+        out = []
+        with self._cond:
+            for e in self._tasks.values():
+                idle = now - e.last_progress
+                if idle >= timeout_s:
+                    out.append((e.task_id, idle))
+        return out
+
+    def stats(self) -> dict:
+        with self._cond:
+            blocked = sum(
+                1 for e in self._tasks.values()
+                for s in e.threads.values() if s.state in _BLOCKED_STATES)
+            return {
+                "tasks": len(self._tasks),
+                "threads": sum(len(e.threads)
+                               for e in self._tasks.values()),
+                "blocked_threads": blocked,
+                "bufn_tasks": sum(1 for e in self._tasks.values()
+                                  if e.bufn),
+                "blocked_on_alloc_total": self.blocked_on_alloc_total,
+                "deadlock_breaks": self.deadlock_breaks,
+                "forced_splits": self.forced_splits,
+                "forced_retries": self.forced_retries,
+                "tasks_cancelled": self.tasks_cancelled,
+                "watchdog_dumps": self.watchdog_dumps,
+            }
+
+    def dump(self) -> str:
+        """Thread-state + stack dump for the watchdog (extends the
+        semaphore's holder dump with every registered task thread's live
+        stack via ``sys._current_frames``)."""
+        frames = sys._current_frames()
+        lines: List[str] = []
+        now = time.monotonic()
+        with self._cond:
+            entries = [(e.task_id, e.holds_device, e.bufn, e.cancelled,
+                        now - e.last_progress, list(e.threads.values()))
+                       for e in self._tasks.values()]
+        lines.append(f"== arbiter: {len(entries)} task(s) ==")
+        for tid, held, bufn, cancelled, idle, slots in entries:
+            flags = "".join(f for f, on in
+                            (("D", held), ("B", bufn), ("C", cancelled))
+                            if on)
+            lines.append(f"task {tid} [{flags or '-'}] idle={idle:.1f}s")
+            for s in slots:
+                age = now - s.since
+                lines.append(f"  thread {s.name} state={s.state.value} "
+                             f"for {age:.1f}s"
+                             + (f" waiting {s.nbytes}B" if s.nbytes else "")
+                             + (" (injected hold)" if s.hold else ""))
+                f = frames.get(s.ident)
+                if f is not None:
+                    for fl in traceback.format_stack(f)[-4:]:
+                        lines.extend("    " + x
+                                     for x in fl.rstrip().splitlines())
+        from spark_rapids_tpu.memory.device_manager import get_runtime
+        rt = get_runtime()
+        if rt is not None:
+            lines.append(rt.semaphore.dump_active_holders())
+        return "\n".join(lines)
+
+    def _reset_for_tests(self) -> None:
+        with self._cond:
+            self._tasks.clear()
+            self._bufn_tasks.clear()
+            self._cond.notify_all()
+
+
+_ARBITER = ResourceArbiter()
+
+
+def get_arbiter() -> ResourceArbiter:
+    return _ARBITER
+
+
+def note_progress_current() -> None:
+    """Module-level heartbeat helper for hot paths (spillable unspills,
+    spool handoffs): zero-cost when no task is registered."""
+    if _ARBITER._tasks:
+        _ARBITER.note_progress()
+
+
+# ---------------------------------------------------------------------------
+# hung-query watchdog (conf: spark.rapids.watchdog.*)
+# ---------------------------------------------------------------------------
+
+class HungQueryWatchdog:
+    """Daemon sweeping the arbiter registry every ``poll_ms``: a task with
+    no progress for ``timeout_ms`` gets (1) a full thread-state + holder
+    stack dump (``watchdogDump``), (2) a forced arbitration round, and —
+    when arbitration had nothing to wake, or the task is still wedged a
+    full timeout after the dump — (3) cancellation through
+    ``TaskCancelled`` so the task-retry machinery re-executes it."""
+
+    def __init__(self, timeout_ms: int, poll_ms: int):
+        self.timeout_ms = int(timeout_ms)
+        self.poll_ms = int(poll_ms)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: task_id -> monotonic time of its dump (one per episode)
+        self._dumped: Dict[int, float] = {}
+        self.sweeps = 0
+        self.sweep_faults = 0
+
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def start(self) -> None:
+        if self.running:
+            return
+        self._stop.clear()
+        t = threading.Thread(target=self._run, name="tpu-watchdog",
+                             daemon=True)
+        self._thread = t
+        t.start()
+
+    def _run(self) -> None:
+        poll_s = max(0.001, self.poll_ms / 1000.0)
+        while not self._stop.wait(poll_s):
+            try:
+                self.sweep()
+            except Exception:   # noqa: BLE001 - the daemon must survive
+                self.sweep_faults += 1
+
+    def sweep(self) -> None:
+        """One detection pass (directly callable in tests)."""
+        self.sweeps += 1
+        from spark_rapids_tpu.aux.faults import maybe_fire
+        try:
+            maybe_fire("watchdog.sweep")
+        except Exception:   # noqa: BLE001 - injected sweep fault: the
+            self.sweep_faults += 1      # daemon skips one pass, survives
+            return
+        arb = get_arbiter()
+        timeout_s = self.timeout_ms / 1000.0
+        now = time.monotonic()
+        expired = arb.expired_tasks(timeout_s)
+        live = {tid for tid, _ in expired}
+        for tid in list(self._dumped):
+            if tid not in live:
+                del self._dumped[tid]   # progressed or finished: episode over
+        # while ANY registered task is progressing, an idle one may just
+        # be starved: cancellation (never the dump) waits for the stall
+        stalled = arb.global_progress_age() >= timeout_s
+        for tid, idle in expired:
+            if arb.waiting_on_live_holder(tid):
+                continue    # queued behind a live worker: not wedged
+            dumped_at = self._dumped.get(tid)
+            if dumped_at is None:
+                self._dumped[tid] = now
+                arb.watchdog_dumps += 1
+                from spark_rapids_tpu.aux.events import emit
+                from spark_rapids_tpu.aux.faults import note_recovery
+                note_recovery("watchdog_dumps")
+                emit("watchdogDump", task_id=tid, idle_s=round(idle, 3),
+                     timeout_ms=self.timeout_ms, dump=arb.dump()[:8000])
+                if not arb.force_arbitration(tid) and arb.task_held(tid):
+                    # an injected memory.block hold is KNOWN
+                    # unrecoverable: skip the grace rung.  Every other
+                    # task — even fully blocked — gets a full timeout of
+                    # post-dump grace first (one rung per detection)
+                    arb.cancel_task(
+                        tid, f"watchdog: no progress for {idle:.1f}s "
+                             f"(timeout {self.timeout_ms}ms)")
+            else:
+                if stalled and now - dumped_at >= timeout_s:
+                    # dumped + arbitrated a full timeout ago, still no
+                    # progress anywhere (cancel_task latches: re-firing
+                    # on an already-cancelled task is a no-op)
+                    arb.cancel_task(
+                        tid, f"watchdog: still wedged {idle:.1f}s "
+                             f"after dump")
+                if now - dumped_at >= 10 * timeout_s:
+                    # a cancelled task that never reaches a cancellation
+                    # checkpoint must not go silent: re-dump on a slow
+                    # cadence so the operator keeps seeing the hang
+                    self._dumped[tid] = now
+                    arb.watchdog_dumps += 1
+                    from spark_rapids_tpu.aux.events import emit
+                    from spark_rapids_tpu.aux.faults import note_recovery
+                    note_recovery("watchdog_dumps")
+                    emit("watchdogDump", task_id=tid,
+                         idle_s=round(idle, 3),
+                         timeout_ms=self.timeout_ms,
+                         dump=arb.dump()[:8000])
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
+        self._thread = None
+
+
+_WD_LOCK = threading.Lock()
+_WATCHDOG: Optional[HungQueryWatchdog] = None
+
+
+def active_watchdog() -> Optional[HungQueryWatchdog]:
+    with _WD_LOCK:
+        return _WATCHDOG
+
+
+def stop_watchdog() -> None:
+    global _WATCHDOG
+    with _WD_LOCK:
+        cur, _WATCHDOG = _WATCHDOG, None
+    if cur is not None:
+        cur.stop()
+
+
+def sync_watchdog_from_conf(conf) -> Optional[HungQueryWatchdog]:
+    """Reconciles the process-singleton watchdog with
+    ``spark.rapids.watchdog.*`` (same lifecycle pattern as the resource
+    sampler): enabling starts it, disabling stops it, changed knobs
+    restart it.  Idempotent — called from session init and set_conf."""
+    global _WATCHDOG
+    from spark_rapids_tpu import config as C
+    enabled = conf.get(C.WATCHDOG_ENABLED.key, False)
+    timeout_ms = conf.get(C.WATCHDOG_TIMEOUT_MS.key, 60_000)
+    poll_ms = conf.get(C.WATCHDOG_POLL_MS.key, 100)
+    stale = None
+    with _WD_LOCK:
+        cur = _WATCHDOG
+        if not enabled:
+            _WATCHDOG, stale = None, cur
+        elif cur is not None and cur.running and \
+                cur.timeout_ms == timeout_ms and cur.poll_ms == poll_ms:
+            return cur
+        else:
+            stale = cur
+            _WATCHDOG = HungQueryWatchdog(timeout_ms, poll_ms)
+            _WATCHDOG.start()
+        out = _WATCHDOG
+    if stale is not None:
+        stale.stop()
+    return out

@@ -10,8 +10,14 @@ TPU-first: XLA owns physical HBM, so the device store is an accounting layer
 over catalog-tracked jax buffers.  ``reserve()`` is the admission point every
 operator calls before materializing a large result; on budget exhaustion it
 synchronously spills lowest-priority buffers (the reference's event handler
-does this inside the RMM callback) and raises ``RetryOOM`` toward the task if
-spilling wasn't enough.
+does this inside the RMM callback).  If spilling wasn't enough, a registered
+task thread PARKS in the resource arbiter (``memory/arbiter.py`` —
+BLOCKED_ON_ALLOC on a condition variable signalled by every ``remove``/
+spill) instead of raising ``RetryOOM`` immediately: concurrent tasks
+cooperate, and only a detected deadlock (or the MAX_BLOCK_MS backstop)
+surfaces a forced Retry/SplitAndRetry OOM toward the task's retry frame.
+Unregistered threads (direct-catalog tests, driver code) keep the original
+raise-immediately behavior.
 """
 
 from __future__ import annotations
@@ -25,6 +31,7 @@ import time
 from typing import Dict, Optional
 
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, HostColumnarBatch
+from spark_rapids_tpu.memory import arbiter as _ARB
 from spark_rapids_tpu.memory.retry import RetryOOM, maybe_inject_oom, task_context
 
 
@@ -118,26 +125,61 @@ class BufferCatalog:
         """Admission check before materializing ``nbytes`` on device.
 
         Mirrors DeviceMemoryEventHandler: on shortfall, synchronously spill
-        spillable device buffers; if still short, signal RetryOOM so the
-        calling task's retry frame can block/split.
+        spillable device buffers.  Still short, a registered task thread
+        blocks in the arbiter until concurrent tasks release memory (a
+        detected deadlock wakes one victim with a forced OOM); an
+        unregistered thread — or an expired MAX_BLOCK_MS wait — signals
+        RetryOOM so the calling retry frame can spill/split as before.
         """
         maybe_inject_oom()
-        with self._lock:
-            if self.device_bytes + nbytes <= self.device_limit:
-                return
-            needed = self.device_bytes + nbytes - self.device_limit
-            freed = self._spill_device_locked(needed)
-            if self.device_bytes + nbytes <= self.device_limit:
-                return
+        from spark_rapids_tpu.aux.faults import maybe_fire
+        try:
+            # chaos point memory.block: an injected never-releasing
+            # allocation hold (only watchdog cancellation breaks it)
+            maybe_fire("memory.block")
+        except _ARB.InjectedBlockHold:
+            _ARB.get_arbiter().hold_until_cancelled()
+        blocked = False
+        arb = _ARB.get_arbiter()
+        while True:
+            with self._lock:
+                if self.device_bytes + nbytes <= self.device_limit:
+                    if blocked or arb.is_bufn():
+                        break       # cooperation worked: note outside lock
+                    return
+                needed = self.device_bytes + nbytes - self.device_limit
+                freed = self._spill_device_locked(needed)
+                if self.device_bytes + nbytes <= self.device_limit:
+                    if blocked or arb.is_bufn():
+                        break
+                    return
+                used = self.device_bytes
+                # sampled under the catalog lock AFTER the failed
+                # re-check: every byte-freeing release serializes behind
+                # this lock, so a release the park could miss must bump
+                # the seq past this sample and block_on_alloc retries
+                # immediately — while our OWN spill above is already
+                # reflected, so it cannot self-invalidate the park.
+                # (lock order catalog -> arbiter, one-directional.)
+                seq0 = arb.release_seq()
+            outcome = arb.block_on_alloc(nbytes, seen_seq=seq0) \
+                if arb.can_block() else "unregistered"
+            if outcome == "retry":
+                blocked = True
+                continue    # released bytes: re-try admission (re-spill)
+            # unregistered thread / MAX_BLOCK_MS expired: the pre-arbiter
+            # behavior — signal the retry frame (forced OOMs and
+            # cancellation raise out of block_on_alloc directly)
             mt = task_context().metrics
             if mt is not None:
                 mt.oom_count += 1
             from spark_rapids_tpu.aux.events import emit
-            emit("oom", needed=nbytes, used=self.device_bytes,
+            emit("oom", needed=nbytes, used=used,
                  limit=self.device_limit, freed=freed)
             raise RetryOOM(
-                f"device pool exhausted: need {nbytes}, used {self.device_bytes}"
+                f"device pool exhausted: need {nbytes}, used {used}"
                 f"/{self.device_limit}, freed only {freed}")
+        arb.note_alloc_success(task_context().task_id)
 
     # -- registration -------------------------------------------------------
     def add_device_batch(self, batch: ColumnarBatch,
@@ -158,7 +200,10 @@ class BufferCatalog:
             self.device_bytes += nbytes
             self.device_peak_bytes = max(self.device_peak_bytes,
                                          self.device_bytes)
-            return handle
+        # victim-selection input: the owning task's most-evictable buffer
+        _ARB.get_arbiter().note_buffer_priority(task_context().task_id,
+                                                priority)
+        return handle
 
     def add_host_batch(self, batch: HostColumnarBatch,
                        priority: int = SpillPriority.HOST_MEMORY) -> BufferHandle:
@@ -236,6 +281,7 @@ class BufferCatalog:
             self._require(handle).spillable = spillable
 
     def remove(self, handle: BufferHandle) -> None:
+        freed_device = False
         with self._lock:
             buf = self._buffers.pop(handle.id, None)
             handle.closed = True
@@ -243,6 +289,7 @@ class BufferCatalog:
                 return
             if buf.device_batch is not None:
                 self.device_bytes -= buf.device_nbytes
+                freed_device = buf.device_nbytes > 0
                 if buf.owned:
                     _delete_device_batch(buf.device_batch)
             if buf.host_batch is not None:
@@ -253,6 +300,9 @@ class BufferCatalog:
                     os.unlink(buf.disk_path)
                 except OSError:
                     pass
+        if freed_device:
+            # wake BLOCKED_ON_ALLOC parkers: admission may now fit
+            _ARB.get_arbiter().notify_release()
 
     # -- spilling -----------------------------------------------------------
     def synchronous_spill(self, target_free_bytes: Optional[int]) -> int:
@@ -298,6 +348,9 @@ class BufferCatalog:
                  buffer_id=buf.handle.id, priority=buf.handle.priority,
                  duration_s=round(spill_s, 6))
         self._maybe_spill_host_locked()
+        if freed > 0:
+            # device bytes moved down a tier: alloc parkers re-try
+            _ARB.get_arbiter().notify_release()
         return freed
 
     def _maybe_spill_host_locked(self) -> None:

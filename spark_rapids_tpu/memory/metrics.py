@@ -15,6 +15,9 @@ from typing import Dict, Optional
 class TaskMetrics:
     task_id: int = -1
     semaphore_wait_seconds: float = 0.0
+    #: seconds parked in the arbiter's BLOCKED_ON_ALLOC state waiting for
+    #: concurrent tasks to release memory (memory/arbiter.py)
+    alloc_wait_seconds: float = 0.0
     retry_count: int = 0
     split_retry_count: int = 0
     oom_count: int = 0
@@ -38,6 +41,7 @@ class TaskMetrics:
 
     def merge(self, other: "TaskMetrics") -> None:
         self.semaphore_wait_seconds += other.semaphore_wait_seconds
+        self.alloc_wait_seconds += other.alloc_wait_seconds
         self.retry_count += other.retry_count
         self.split_retry_count += other.split_retry_count
         self.oom_count += other.oom_count
@@ -104,6 +108,7 @@ def task_scope(task_id: int, registry: Optional[MetricsRegistry] = None):
              split_retry_count=m.split_retry_count, oom_count=m.oom_count,
              spill_count=m.spill_count, spill_bytes=m.spill_bytes,
              semaphore_wait_s=round(m.semaphore_wait_seconds, 6),
+             alloc_wait_s=round(m.alloc_wait_seconds, 6),
              max_device_bytes=m.max_device_bytes)
         # release the semaphore if the task still holds it (completion listener)
         from spark_rapids_tpu.memory.device_manager import get_runtime

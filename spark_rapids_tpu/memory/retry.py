@@ -47,6 +47,12 @@ class _TaskContext(threading.local):
         self.retry_count = 0
         self.split_retry_count = 0
         self.retry_frame_depth = 0
+        #: count of ACTIVE top-level with_retry frames on this thread —
+        #: the frames that may absorb a SplitAndRetryOOM by splitting
+        #: their input.  The memory arbiter reads this to decide whether
+        #: a forced deadlock-break wake can be a SplitAndRetryOOM or must
+        #: fall back to RetryOOM (memory/arbiter.py victim selection).
+        self.split_frames = 0
         # fault injection counters: fire RetryOOM on the next N tracked allocs
         # after skipping `skip` of them
         self.inject_retry_oom = 0
@@ -216,6 +222,8 @@ def _close_quietly(spillable) -> None:
 
 def _with_retry_gen(queue, fn, split_policy, max_retries, top_level):
     _TL.retry_frame_depth += 1
+    if top_level:
+        _TL.split_frames += 1
     item = None
     done = False
     try:
@@ -261,6 +269,8 @@ def _with_retry_gen(queue, fn, split_policy, max_retries, top_level):
         done = True
     finally:
         _TL.retry_frame_depth -= 1
+        if top_level:
+            _TL.split_frames -= 1
         if not done:
             # early exit — max-retries MemoryError, split exhaustion, or
             # the caller abandoning iteration (GeneratorExit): close the

@@ -64,7 +64,11 @@ class SpillableColumnarBatch:
     def get_batch(self) -> ColumnarBatch:
         """Device batch; unspills if it was pushed down a tier
         (reference: SpillableColumnarBatchImpl.getColumnarBatch); the
-        catalog emits the ``unspill`` event for the call that promotes."""
+        catalog emits the ``unspill`` event for the call that promotes.
+        Materializing counts as task progress for the hung-query
+        watchdog (a long unspill chain is slow, not wedged)."""
+        from spark_rapids_tpu.memory.arbiter import note_progress_current
+        note_progress_current()
         return self._catalog.get_device_batch(self._handle)
 
     def get_host_batch(self) -> HostColumnarBatch:

@@ -285,11 +285,17 @@ def run_task(plan: "Exec", pidx: int):
 def run_task_iter(gen_fn, pidx: int):
     """``run_task`` semantics over an arbitrary per-partition generator —
     exchange map sides run through this so each map partition is a real
-    task (own id, metrics, semaphore release at completion)."""
+    task (own id, metrics, semaphore release at completion).  The task
+    registers with the resource arbiter for its duration (the thread-state
+    registry behind blocking allocation and the hung-query watchdog) and
+    heartbeats once per yielded batch — the watchdog's last-progress
+    signal."""
+    from spark_rapids_tpu.memory.arbiter import get_arbiter
     from spark_rapids_tpu.memory.device_manager import get_runtime
     from spark_rapids_tpu.memory.metrics import task_scope
     task_id = next(_task_ids)
     rt = get_runtime()
+    arb = get_arbiter()
     with task_scope(task_id, rt.metrics if rt is not None else None):
         # conf-driven per-task fault injection
         # (spark.rapids.sql.test.injectRetryOOM; reference
@@ -299,9 +305,17 @@ def run_task_iter(gen_fn, pidx: int):
         # start — before any output — so the retry path stays lossless
         from spark_rapids_tpu.aux.faults import maybe_fire
         maybe_fire("task.run")
+        arb.register_task(task_id)
+        it = gen_fn(pidx)
         try:
-            yield from gen_fn(pidx)
+            for item in it:
+                arb.note_progress(task_id)
+                yield item
         finally:
+            # explicit close replaces the `yield from` delegation so
+            # GeneratorExit/teardown still propagates into the chain
+            close_iter(it)
+            arb.deregister_task(task_id)
             rt = get_runtime()
             if rt is not None:
                 rt.semaphore.release_all(task_id)

@@ -584,6 +584,12 @@ class TpuOverrides:
         _PL.PIPELINE_DEPTH = conf.get(C.PIPELINE_DEPTH.key)
         _PL.PIPELINE_MAX_BYTES = C.parse_bytes(
             conf.get(C.PIPELINE_MAX_IN_FLIGHT_BYTES.key))
+        # cooperative memory arbitration (memory/arbiter.py): blocking
+        # allocation + deadlock-break knobs per action
+        import spark_rapids_tpu.memory.arbiter as _ARB
+        _ARB.ARBITRATION_ENABLED = conf.get(
+            C.MEMORY_ARBITRATION_ENABLED.key)
+        _ARB.MAX_BLOCK_MS = conf.get(C.MEMORY_ARBITRATION_MAX_BLOCK_MS.key)
         # ENABLE-only: benchmark setups interleave an enabled session
         # with a default-conf sanity session, whose every plan compile
         # would otherwise wipe the cache mid-run; releasing the process-

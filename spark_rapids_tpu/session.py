@@ -49,6 +49,10 @@ class TpuSession:
         # process singleton to match this session's conf
         from spark_rapids_tpu.aux.sampler import sync_from_conf
         sync_from_conf(self.conf)
+        # hung-query watchdog (spark.rapids.watchdog.*): same singleton
+        # lifecycle — dumps + escalates tasks that stop making progress
+        from spark_rapids_tpu.memory.arbiter import sync_watchdog_from_conf
+        sync_watchdog_from_conf(self.conf)
         #: temp views for the SQL front-end (name -> DataFrame)
         self._views: Dict[str, "DataFrame"] = {}
         #: row-based Hive UDF passthrough (name -> (fn, return_type));
@@ -69,7 +73,8 @@ class TpuSession:
         if key.startswith("spark.rapids.chaos."):
             from spark_rapids_tpu.aux.faults import arm_from_conf
             arm_from_conf(self.conf)
-        elif key.startswith("spark.rapids.shuffle.fetch."):
+        elif key.startswith(("spark.rapids.shuffle.fetch.",
+                             "spark.rapids.shuffle.transport.")):
             self.shuffle_env.update_fetch_retry(self.conf)
         elif key.startswith(("spark.rapids.sample.",
                              "spark.rapids.sql.eventLog.")):
@@ -77,6 +82,10 @@ class TpuSession:
             # event-log destination it mirrors samples into
             from spark_rapids_tpu.aux.sampler import sync_from_conf
             sync_from_conf(self.conf)
+        elif key.startswith("spark.rapids.watchdog."):
+            from spark_rapids_tpu.memory.arbiter import \
+                sync_watchdog_from_conf
+            sync_watchdog_from_conf(self.conf)
         return self
 
     # -- SQL ----------------------------------------------------------------
@@ -232,6 +241,8 @@ class TpuSession:
     def stop(self):
         from spark_rapids_tpu.aux.sampler import stop_sampler
         stop_sampler()
+        from spark_rapids_tpu.memory.arbiter import stop_watchdog
+        stop_watchdog()
         from spark_rapids_tpu.memory.device_manager import shutdown
         shutdown()
         if self.shuffle_env is not None:

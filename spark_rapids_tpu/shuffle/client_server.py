@@ -318,7 +318,10 @@ class ShuffleClient:
                     maybe_fire("shuffle.fetch")
                     return self._do_fetch_once(cand, shuffle_id,
                                                partition_id)
-                except ConnectionError as e:
+                except (ConnectionError, TimeoutError) as e:
+                    # TimeoutError: a transport wait expired (dead peer /
+                    # exhausted bounce buffers) — retryable exactly like
+                    # a dropped connection
                     last_error = f"{type(e).__name__}: {e}"
                     if attempt >= policy.max_retries:
                         break

@@ -47,6 +47,7 @@ class ShuffleEnv:
         # policy per session, handed to every client this env creates
         from spark_rapids_tpu.shuffle.client_server import FetchRetryPolicy
         self.fetch_retry = FetchRetryPolicy.from_conf(conf)
+        self._apply_transport_timeout(conf)
         self._dir = None
         self._atexit_registered = False
         self._lock = threading.Lock()
@@ -87,12 +88,25 @@ class ShuffleEnv:
                 self._hb_manager = mgr
             return self._hb_manager
 
+    @staticmethod
+    def _apply_transport_timeout(conf) -> None:
+        """Bounds the otherwise-unbounded transport waits
+        (``Transaction.wait(None)`` / bounce-buffer ``acquire(None)``)
+        from ``spark.rapids.shuffle.transport.timeoutMs``: a dead peer
+        surfaces as a retryable TimeoutError through the fetch-retry
+        policy instead of pinning a sender thread forever."""
+        from spark_rapids_tpu.shuffle import transport as _T
+        _T.DEFAULT_WAIT_TIMEOUT_S = \
+            conf.get(C.SHUFFLE_TRANSPORT_TIMEOUT_MS.key) / 1000.0
+
     def update_fetch_retry(self, conf) -> None:
-        """Re-reads the spark.rapids.shuffle.fetch.* keys (set_conf after
-        session init must take effect, not just validate) and pushes the
-        new policy into the already-created client, if any."""
+        """Re-reads the spark.rapids.shuffle.fetch.* / transport.* keys
+        (set_conf after session init must take effect, not just validate)
+        and pushes the new policy into the already-created client, if
+        any."""
         from spark_rapids_tpu.shuffle.client_server import FetchRetryPolicy
         policy = FetchRetryPolicy.from_conf(conf)
+        self._apply_transport_timeout(conf)
         with self._lock:
             self.fetch_retry = policy
             if self._client is not None:

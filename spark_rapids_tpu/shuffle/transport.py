@@ -20,6 +20,14 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from spark_rapids_tpu.shuffle.catalog import ShuffleBlockId
 
+#: conf-driven default for otherwise-unbounded transport waits
+#: (``spark.rapids.shuffle.transport.timeoutMs``, set by ShuffleEnv):
+#: ``Transaction.wait(None)`` and ``BounceBufferManager.acquire(None)``
+#: resolve ``None`` to this, so a dead peer surfaces as a retryable
+#: ``TimeoutError`` through the fetch-retry policy instead of pinning a
+#: sender thread forever.
+DEFAULT_WAIT_TIMEOUT_S = 120.0
+
 
 class TransactionStatus(enum.Enum):
     NOT_STARTED = "not_started"
@@ -58,8 +66,13 @@ class Transaction:
             cb(self)
 
     def wait(self, timeout: Optional[float] = None) -> "Transaction":
+        """``timeout=None`` means the conf-backed transport default, NOT
+        forever — an unbounded wait on a dead peer pins the thread."""
+        if timeout is None:
+            timeout = DEFAULT_WAIT_TIMEOUT_S
         if not self._done.wait(timeout):
-            raise TimeoutError(f"transaction {self.txn_id} timed out")
+            raise TimeoutError(f"transaction {self.txn_id} timed out "
+                               f"after {timeout}s")
         return self
 
 
@@ -130,8 +143,13 @@ class BounceBufferManager:
         self.total = count
 
     def acquire(self, timeout: Optional[float] = None) -> BounceBuffer:
+        """``timeout=None`` resolves to the transport default: a peer
+        that never drains its windows must not park senders forever."""
+        if timeout is None:
+            timeout = DEFAULT_WAIT_TIMEOUT_S
         if not self._sem.acquire(timeout=timeout):
-            raise TimeoutError("no bounce buffer available")
+            raise TimeoutError(
+                f"no bounce buffer available after {timeout}s")
         with self._lock:
             return self._free.pop()
 

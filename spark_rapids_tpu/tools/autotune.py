@@ -27,6 +27,11 @@ Rules (see docs/tools.md for the full semantics):
 5. **ring-buffer drops** → grow
    ``spark.rapids.sql.eventLog.ringBufferSize`` so the next profile is
    not a lower bound.
+6. **repeated deadlock breaks / BUFN splits** → the concurrent working
+   sets genuinely do not fit together: lower
+   ``spark.rapids.sql.concurrentGpuTasks`` (or, already at 1, raise
+   ``spark.rapids.memory.gpu.allocFraction``) so tasks stop winning
+   memory only through forced-split arbitration.
 
 Thresholds are fractions of query wall time; rules stay silent without
 their evidence, and rules 2 and 4 are mutually exclusive by
@@ -186,6 +191,42 @@ def autotune_query(profile: QueryProfile,
                   f"semaphoreAcquired task={e.payload.get('task_id')} "
                   f"wait_s={e.payload.get('wait_s')}"),
             qid))
+
+    # rule 6: repeated deadlock breaks -> shrink concurrency (or grow
+    # the pool when already serial).  One break is the mechanism doing
+    # its job; repeats mean the concurrent working sets never fit.
+    dl_evs = profile.events_of("deadlockBreak")
+    if len(dl_evs) >= 2:
+        cur = int(_conf_value(
+            profile, "spark.rapids.sql.concurrentGpuTasks") or 2)
+        splits = [e for e in dl_evs
+                  if e.payload.get("exc") == "SplitAndRetryOOM"]
+        ev = _cite(dl_evs, lambda e:
+                   f"deadlockBreak task={e.payload.get('task_id')} "
+                   f"exc={e.payload.get('exc')} "
+                   f"wake_count={e.payload.get('wake_count')}")
+        if cur > 1 and not any(r.key ==
+                               "spark.rapids.sql.concurrentGpuTasks"
+                               for r in recs):
+            recs.append(Recommendation(
+                "spark.rapids.sql.concurrentGpuTasks", cur, cur - 1,
+                f"{len(dl_evs)} deadlock break(s) ({len(splits)} forced "
+                "BUFN split(s)): every device-holding task blocked on "
+                "allocation — the concurrent working sets do not fit "
+                "together; fewer admitted tasks avoids the forced-split "
+                "round trips",
+                ev, qid))
+        elif cur <= 1:
+            cur_f = float(_conf_value(
+                profile, "spark.rapids.memory.gpu.allocFraction") or 0.8)
+            if cur_f < 0.95:
+                recs.append(Recommendation(
+                    "spark.rapids.memory.gpu.allocFraction", cur_f,
+                    round(min(0.95, cur_f + 0.1), 2),
+                    f"{len(dl_evs)} deadlock break(s) at "
+                    "concurrentGpuTasks=1: a single task cannot fit its "
+                    "working set — give the pool more of HBM",
+                    ev, qid))
 
     # rule 5: observability truncation -> bigger ring
     dropped = int((profile.summary or {}).get("events_dropped", 0) or 0)

@@ -37,7 +37,7 @@ from spark_rapids_tpu.tools.reader import (QueryProfile, ReadDiagnostics,
 #: decomposition buckets, render order
 BUCKETS = ("decode", "h2d", "compute", "d2h", "shuffle",
            "producer_stall", "consumer_stall", "spill", "recovery",
-           "semaphore", "other")
+           "semaphore", "arbitration", "other")
 
 _DECODE_MARKERS = ("Scan", "Range", "InMemory", "Csv", "Parquet", "Json",
                    "Orc", "Avro", "Hive", "Text", "Cached")
@@ -146,6 +146,15 @@ def attribute(profile: QueryProfile) -> Attribution:
             / 1000.0
     summary = profile.summary or {}
     raw["semaphore"] += float(summary.get("semaphore_wait_s", 0.0) or 0.0)
+    # cooperative-arbitration parks: threadBlocked events carry each
+    # park's measured wait; the queryEnd alloc_wait_s aggregate is the
+    # fallback when the ring dropped them (never both — double count)
+    blocked_evs = profile.events_of("threadBlocked")
+    for ev in blocked_evs:
+        raw["arbitration"] += float(ev.payload.get("wait_s", 0.0) or 0.0)
+    if not blocked_evs:
+        raw["arbitration"] += float(
+            summary.get("alloc_wait_s", 0.0) or 0.0)
     # recovery transition counts (no duration carried for task retries —
     # reported as counts, their re-run time shows in the operator buckets)
     recovery_counts: Dict[str, int] = {}

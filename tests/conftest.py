@@ -82,6 +82,26 @@ def _no_prefetch_thread_leaks():
 
 
 @pytest.fixture(autouse=True)
+def _no_arbiter_registry_leaks():
+    """Arbitration leak guard (memory/arbiter.py): every task registered
+    with the resource arbiter must deregister by task end — early-exit
+    paths (limits, retries, cancellations) included.  A short grace
+    covers tasks finishing at teardown; anything registered after it is
+    a leaked registry entry and fails the test."""
+    yield
+    import time
+    from spark_rapids_tpu.memory.arbiter import get_arbiter
+    arb = get_arbiter()
+    deadline = time.monotonic() + 5.0
+    while arb.stats()["tasks"] and time.monotonic() < deadline:
+        time.sleep(0.02)
+    leaked = arb.stats()["tasks"]
+    if leaked:
+        arb._reset_for_tests()      # don't poison every later test
+    assert not leaked, f"leaked arbiter task registrations: {leaked}"
+
+
+@pytest.fixture(autouse=True)
 def _bound_process_memory(request):
     """The TPC-DS differential tier runs 44 queries x 2 engines in one
     process; per-shape jitted programs and process-wide scan caches

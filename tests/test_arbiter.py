@@ -1,0 +1,757 @@
+"""Cooperative memory arbitration + hung-query watchdog tests.
+
+Reference methodology: the RmmSpark/SparkResourceAdaptor suites drive
+multiple registered task threads into contention and assert the state
+machine blocks, detects the deadlock, and wakes exactly one victim with a
+forced OOM the retry frames absorb — bit-identically.  Same bar here:
+every contention test asserts results identical to the serial run plus
+the arbitration events/counters that prove blocking actually happened.
+
+No test sleeps longer than the watchdog poll interval — coordination is
+via barriers/events, and the deadlock detector runs INLINE on blocking
+transitions (broken within the blocking call itself, well inside one
+watchdog poll).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.aux import events as EV
+from spark_rapids_tpu.aux import faults as F
+from spark_rapids_tpu.columnar import batch_from_pydict
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.memory import arbiter as A
+from spark_rapids_tpu.memory.catalog import BufferCatalog, SpillPriority
+from spark_rapids_tpu.memory.metrics import task_scope
+from spark_rapids_tpu.memory.retry import (RetryOOM, SplitAndRetryOOM,
+                                           with_retry)
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.memory.spillable import SpillableColumnarBatch
+from spark_rapids_tpu.session import TpuSession
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    F.disarm_all()
+    F.reset_recovery_stats()
+    yield
+    F.disarm_all()
+    A.stop_watchdog()
+
+
+@pytest.fixture
+def ring():
+    sink = EV.RingBufferSink(8192)
+    EV.add_global_sink(sink)
+    yield sink
+    EV.remove_global_sink(sink)
+
+
+def host_batch(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return batch_from_pydict({
+        "a": rng.integers(0, 1000, n).astype(np.int64),
+        "b": rng.standard_normal(n),
+    })
+
+
+def est(host):
+    """The catalog's unspill admission estimate (catalog.get_device_batch)."""
+    return 2 * host.nbytes() + 16 * max(host.row_count, 1024)
+
+
+ARB = A.get_arbiter()
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_register_deregister_and_stats(self):
+        with task_scope(9001, None):
+            ARB.register_task(9001)
+            st = ARB.stats()
+            assert st["tasks"] == 1 and st["threads"] == 1
+            ARB.deregister_task(9001)
+        assert ARB.stats()["tasks"] == 0
+
+    def test_adopt_thread_requires_registered_task(self):
+        assert not ARB.adopt_thread(424242)
+        with task_scope(9002, None):
+            ARB.register_task(9002)
+            try:
+                got = []
+                def side():
+                    got.append(ARB.adopt_thread(9002))
+                    ARB.drop_thread(9002)
+                t = threading.Thread(target=side)
+                t.start()
+                t.join(5)
+                assert got == [True]
+            finally:
+                ARB.deregister_task(9002)
+
+    def test_wait_cancellable_marks_and_restores(self):
+        """The shared blocking-primitive wait discipline (semaphore and
+        spool ends): tracked as blocked while waiting, restored after,
+        first-wait hook runs exactly once, stall time returned."""
+        with task_scope(9003, None):
+            ARB.register_task(9003)
+            cond = threading.Condition()
+            seen = []
+            hooks = []
+
+            def should_wait():
+                seen.append(ARB.stats()["blocked_threads"])
+                return len(seen) < 3
+            try:
+                with cond:
+                    t0 = ARB.wait_cancellable(
+                        cond, should_wait, A.TaskState.BLOCKED_ON_SPOOL,
+                        slice_s=0.01,
+                        on_first_wait=lambda: hooks.append(1))
+                assert t0 is not None
+                # unblocked at first probe, marked blocked thereafter
+                assert seen == [0, 1, 1]
+                assert hooks == [1]
+                assert ARB.stats()["blocked_threads"] == 0
+            finally:
+                ARB.deregister_task(9003)
+
+    def test_dump_lists_thread_states(self):
+        with task_scope(9004, None):
+            ARB.register_task(9004)
+            try:
+                text = ARB.dump()
+                assert "task 9004" in text and "state=running" in text
+            finally:
+                ARB.deregister_task(9004)
+
+
+# ---------------------------------------------------------------------------
+# blocking allocation (N tasks through a pool sized for N-1)
+# ---------------------------------------------------------------------------
+
+class TestBlockingAllocation:
+    def test_unregistered_thread_raises_retryoom_immediately(self):
+        cat = BufferCatalog(device_limit_bytes=1 << 16,
+                            host_limit_bytes=1 << 20)
+        t0 = time.monotonic()
+        with pytest.raises(RetryOOM):
+            cat.reserve(1 << 20)
+        assert time.monotonic() - t0 < 1.0, "must not park an unregistered " \
+                                            "thread"
+
+    def test_three_tasks_pool_for_two_blocks_then_completes(self, ring):
+        """N threads through a pool sized for N-1: the loser BLOCKS (no
+        RetryOOM anywhere) and completes once a holder releases, with
+        results identical to the serial run."""
+        hold = host_batch(16384, 7).to_device()
+        H = hold.nbytes()
+        cat = BufferCatalog(device_limit_bytes=2 * H + H // 2,
+                            host_limit_bytes=1 << 30)
+        expected = {s: float(np.sum(np.asarray(
+            host_batch(16384, s).to_pydict()["a"]))) for s in (1, 2, 3)}
+        results, errors = {}, []
+
+        def task(tid, seed):
+            try:
+                with task_scope(tid, None):
+                    ARB.register_task(tid)
+                    try:
+                        b = host_batch(16384, seed).to_device()
+                        h = cat.add_device_batch(b, spillable=False)
+                        # hold until a peer is observed blocked on the
+                        # full pool (bounded, event-driven — no fixed
+                        # sleep)
+                        deadline = time.monotonic() + 5
+                        while time.monotonic() < deadline:
+                            if ARB.stats()["blocked_threads"] >= 1:
+                                break
+                            time.sleep(0.002)
+                        results[seed] = float(np.sum(np.asarray(
+                            cat.get_host_batch(h).to_pydict()["a"])))
+                        cat.remove(h)
+                    finally:
+                        ARB.deregister_task(tid)
+            except BaseException as e:   # noqa: BLE001 - asserted below
+                errors.append((tid, repr(e)))
+
+        b0 = ARB.blocked_on_alloc_total
+        ts = [threading.Thread(target=task, args=(9100 + i, i + 1))
+              for i in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(20)
+        assert errors == []
+        assert results == expected
+        assert ARB.blocked_on_alloc_total - b0 >= 1, \
+            "the third task must have parked, not errored"
+        kinds = [e.kind for e in ring.events()]
+        assert "threadBlocked" in kinds
+        assert ARB.stats()["tasks"] == 0
+
+    def test_max_block_timeout_falls_back_to_retryoom(self, monkeypatch):
+        """A park nothing can break cooperatively (a RUNNING memory
+        holder that never releases) falls back to plain RetryOOM at
+        MAX_BLOCK_MS — the liveness backstop."""
+        monkeypatch.setattr(A, "MAX_BLOCK_MS", 200)
+        hold = host_batch(16384, 7).to_device()
+        H = hold.nbytes()
+        cat = BufferCatalog(device_limit_bytes=H + H // 4,
+                            host_limit_bytes=1 << 30)
+        release = threading.Event()
+        holder_ready = threading.Event()
+
+        def holder():
+            with task_scope(9201, None):
+                ARB.register_task(9201)
+                try:
+                    h = cat.add_device_batch(
+                        host_batch(16384, 7).to_device(), spillable=False)
+                    holder_ready.set()
+                    release.wait(10)        # RUNNING, never blocked
+                    cat.remove(h)
+                finally:
+                    ARB.deregister_task(9201)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        assert holder_ready.wait(10)
+        try:
+            with task_scope(9202, None):
+                ARB.register_task(9202)
+                try:
+                    t0 = time.monotonic()
+                    with pytest.raises(RetryOOM):
+                        cat.reserve(H)
+                    waited = time.monotonic() - t0
+                    assert 0.15 <= waited < 2.0, waited
+                finally:
+                    ARB.deregister_task(9202)
+        finally:
+            release.set()
+            t.join(10)
+
+
+# ---------------------------------------------------------------------------
+# deadlock detection + victim selection
+# ---------------------------------------------------------------------------
+
+class TestDeadlockBreak:
+    def test_victim_order_priority_then_recency(self):
+        """Victim key is (spill priority, wake count, most recently
+        started): the -100-priority task loses first; among equals the
+        most recently registered loses next."""
+        order = []
+        lock = threading.Lock()
+        registered = {tid: threading.Event()
+                      for tid in (9301, 9302, 9303)}
+        go = threading.Event()
+
+        def task(tid, prio):
+            with task_scope(tid, None):
+                ARB.register_task(tid)
+                ARB.note_buffer_priority(tid, prio)
+                registered[tid].set()
+                try:
+                    # gate parks until ALL tasks are registered: an early
+                    # parker would self-deadlock alone instead of testing
+                    # the three-way selection
+                    go.wait(10)
+                    while True:
+                        try:
+                            out = ARB.block_on_alloc(1 << 20)
+                        except RetryOOM:
+                            with lock:
+                                order.append(tid)
+                            return
+                        if out == "timeout":
+                            return
+                finally:
+                    ARB.deregister_task(tid)
+
+        # A: most evictable (loses first); B then C registered in that
+        # order with equal priority (C more recent -> loses before B)
+        threads = []
+        for tid, prio in ((9301, SpillPriority.INPUT_FROM_SHUFFLE),
+                          (9302, SpillPriority.ACTIVE_BATCHING),
+                          (9303, SpillPriority.ACTIVE_BATCHING)):
+            t = threading.Thread(target=task, args=(tid, prio))
+            t.start()
+            assert registered[tid].wait(10)     # pins seq order
+            threads.append(t)
+        go.set()
+        for t in threads:
+            t.join(20)
+        assert order == [9301, 9303, 9302]
+
+    def test_single_task_self_deadlock_escalates_to_split(self, ring):
+        """A lone task that cannot allocate is itself the blocked set:
+        first wake is RetryOOM; blocking again without an allocation in
+        between (BUFN) escalates to a forced SplitAndRetryOOM absorbed
+        by the top-level with_retry frame."""
+        hold = host_batch(16384, 5).to_device()
+        H = hold.nbytes()
+        full = host_batch(8192, 1)
+        margin = (est(full.slice(0, 4096)) + est(full.slice(0, 2048))) // 2
+        cat = BufferCatalog(device_limit_bytes=H + margin,
+                            host_limit_bytes=1 << 30)
+        expected = float(np.sum(np.asarray(full.to_pydict()["a"])))
+        s0 = dict(ARB.stats())
+        with task_scope(9401, None):
+            ARB.register_task(9401)
+            try:
+                h = cat.add_device_batch(host_batch(16384, 5).to_device(),
+                                         spillable=False)
+                inp = SpillableColumnarBatch.from_host(host_batch(8192, 1),
+                                                       catalog=cat)
+
+                def fn(sp):
+                    host = sp.get_host_batch()
+                    s = float(np.sum(np.asarray(host.to_pydict()["a"])))
+                    sp.get_batch()      # the contended materialization
+                    sp.close()
+                    return s
+
+                total = sum(with_retry(inp, fn))
+                cat.remove(h)
+            finally:
+                ARB.deregister_task(9401)
+        assert total == expected
+        s1 = ARB.stats()
+        assert s1["forced_retries"] > s0["forced_retries"]
+        assert s1["forced_splits"] > s0["forced_splits"]
+        assert any(e.kind == "deadlockBreak"
+                   and e.payload["exc"] == "SplitAndRetryOOM"
+                   for e in ring.events())
+
+    def test_two_task_mutual_block_forced_split_bit_identical(self, ring):
+        """THE acceptance scenario: two tasks each hold half the pool
+        (unspillable) and each need more — a true deadlock.  The break
+        is inline (within the blocking call), a BUFN victim is forced to
+        split, and both tasks produce results bit-identical to the
+        serial computation."""
+        H = host_batch(16384, 9).to_device().nbytes()
+        full = host_batch(8192, 1)
+        margin = (est(full.slice(0, 4096)) + est(full.slice(0, 2048))) // 2
+        cat = BufferCatalog(device_limit_bytes=2 * H + margin,
+                            host_limit_bytes=1 << 30)
+        expected = {s: float(np.sum(np.asarray(
+            host_batch(8192, s).to_pydict()["a"]))) for s in (1, 2)}
+        results, errors = {}, []
+        barrier = threading.Barrier(2)
+
+        def task(tid, seed):
+            try:
+                with task_scope(tid, None):
+                    ARB.register_task(tid)
+                    try:
+                        h = cat.add_device_batch(
+                            host_batch(16384, 9).to_device(),
+                            spillable=False)
+                        inp = SpillableColumnarBatch.from_host(
+                            host_batch(8192, seed), catalog=cat)
+                        barrier.wait(timeout=10)
+
+                        def fn(sp):
+                            host = sp.get_host_batch()
+                            s = float(np.sum(np.asarray(
+                                host.to_pydict()["a"])))
+                            sp.get_batch()
+                            sp.close()
+                            return s
+
+                        results[seed] = sum(with_retry(inp, fn))
+                        cat.remove(h)
+                    finally:
+                        ARB.deregister_task(tid)
+            except BaseException as e:   # noqa: BLE001 - asserted below
+                errors.append((tid, repr(e)))
+
+        s0 = dict(ARB.stats())
+        ts = [threading.Thread(target=task, args=(9500 + i, i + 1))
+              for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        assert errors == []
+        assert results == expected, "must be bit-identical to serial"
+        s1 = ARB.stats()
+        assert s1["deadlock_breaks"] > s0["deadlock_breaks"]
+        assert s1["forced_splits"] > s0["forced_splits"]
+        breaks = [e.payload for e in ring.events()
+                  if e.kind == "deadlockBreak"]
+        assert any(p["exc"] == "SplitAndRetryOOM" for p in breaks)
+        assert F.recovery_stats().get("deadlock_breaks", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# interruptible semaphore waits
+# ---------------------------------------------------------------------------
+
+class TestSemaphoreIntegration:
+    def test_waiter_marked_blocked_and_cancellable(self):
+        sem = TpuSemaphore(1)
+        sem.acquire_if_necessary(task_id=9601)
+        cancelled = []
+
+        def waiter():
+            with task_scope(9602, None):
+                ARB.register_task(9602)
+                try:
+                    sem.acquire_if_necessary(task_id=9602)
+                except A.TaskCancelled as e:
+                    cancelled.append(e)
+                finally:
+                    ARB.deregister_task(9602)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if ARB.stats()["blocked_threads"] >= 1:
+                break
+            time.sleep(0.002)
+        assert ARB.stats()["blocked_threads"] >= 1
+        assert ARB.cancel_task(9602, "test cancel")
+        t.join(10)
+        assert len(cancelled) == 1
+        sem.release_all(task_id=9601)
+        assert sem.stats() == {"max_concurrent": 1, "holders": 0,
+                               "waiting": 0}
+
+    def test_holder_dump_carries_live_stack(self):
+        sem = TpuSemaphore(2)
+        sem.acquire_if_necessary(task_id=9603)
+        try:
+            text = sem.dump_active_holders()
+            assert "task 9603" in text and "held=" in text
+            # the dumped stack is the HOLDER's live frame set
+            assert "test_arbiter" in text or "threading" in text
+        finally:
+            sem.release_all(task_id=9603)
+
+    def test_semaphore_feeds_device_holder_view(self):
+        sem = TpuSemaphore(2)
+        with task_scope(9604, None):
+            ARB.register_task(9604)
+            try:
+                sem.acquire_if_necessary(task_id=9604)
+                with ARB._cond:
+                    assert ARB._tasks[9604].holds_device
+                sem.release_all(task_id=9604)
+                with ARB._cond:
+                    assert not ARB._tasks[9604].holds_device
+            finally:
+                ARB.deregister_task(9604)
+
+
+# ---------------------------------------------------------------------------
+# hung-query watchdog
+# ---------------------------------------------------------------------------
+
+class TestWatchdog:
+    def test_sync_from_conf_lifecycle(self):
+        conf = TpuConf({"spark.rapids.watchdog.enabled": "true",
+                        "spark.rapids.watchdog.timeoutMs": "500",
+                        "spark.rapids.watchdog.pollMs": "50"})
+        wd = A.sync_watchdog_from_conf(conf)
+        assert wd is not None and wd.running
+        assert wd.timeout_ms == 500 and wd.poll_ms == 50
+        # idempotent: same knobs keep the same daemon
+        assert A.sync_watchdog_from_conf(conf) is wd
+        A.sync_watchdog_from_conf(TpuConf({}))
+        assert A.active_watchdog() is None
+        assert not wd.running
+
+    def test_conf_validation(self):
+        s = TpuSession(TpuConf({"spark.rapids.sql.enabled": "false"}),
+                       init_device=False)
+        for key in ("spark.rapids.watchdog.timeoutMs",
+                    "spark.rapids.watchdog.pollMs",
+                    "spark.rapids.memory.arbitration.maxBlockMs",
+                    "spark.rapids.shuffle.transport.timeoutMs"):
+            with pytest.raises(ValueError):
+                s.set_conf(key, "0")
+        with pytest.raises(ValueError):
+            s.set_conf("spark.rapids.chaos.memory.block", "nope")
+        s.stop()
+
+    def test_expired_task_dumped_then_cancelled(self, ring):
+        """A wedged task (no heartbeat) gets exactly one watchdogDump,
+        then cancellation; the dump carries the thread states."""
+        wd = A.HungQueryWatchdog(timeout_ms=50, poll_ms=10)
+        stuck = threading.Event()
+        outcome = []
+
+        def wedged():
+            with task_scope(9701, None):
+                ARB.register_task(9701)
+                try:
+                    stuck.set()
+                    while True:
+                        try:
+                            ARB.check_cancelled(9701)
+                        except A.TaskCancelled as e:
+                            outcome.append(e)
+                            return
+                        time.sleep(0.005)
+                finally:
+                    ARB.deregister_task(9701)
+
+        t = threading.Thread(target=wedged)
+        t.start()
+        assert stuck.wait(5)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not outcome:
+            time.sleep(0.02)
+            wd.sweep()
+        t.join(10)
+        assert len(outcome) == 1
+        dumps = [e for e in ring.events() if e.kind == "watchdogDump"]
+        assert len(dumps) == 1
+        assert "task 9701" in dumps[0].payload["dump"]
+        assert any(e.kind == "taskCancelled" for e in ring.events())
+
+    def test_progress_outruns_cancellation(self):
+        """A task that heartbeats after being cancelled proved it is not
+        wedged: the stale cancellation must not kill its next wait."""
+        with task_scope(9702, None):
+            ARB.register_task(9702)
+            try:
+                assert ARB.cancel_task(9702, "stale")
+                ARB.note_progress(9702)
+                ARB.check_cancelled(9702)   # must not raise
+            finally:
+                ARB.deregister_task(9702)
+
+    def test_queued_task_behind_live_holder_is_not_cancelled(self, ring):
+        """A task idle on the device-admission queue while another task
+        holds the device and is still RUNNING is waiting its turn, not
+        wedged: the watchdog must skip it (no dump, no cancel)."""
+        wd = A.HungQueryWatchdog(timeout_ms=50, poll_ms=10)
+        queued = threading.Event()
+        release = threading.Event()
+
+        def waiter():
+            with task_scope(9801, None):
+                ARB.register_task(9801)
+                try:
+                    slot = ARB.enter_blocked(
+                        A.TaskState.BLOCKED_ON_SEMAPHORE)
+                    queued.set()
+                    release.wait(10)
+                    ARB.exit_blocked(
+                        slot, A.TaskState.BLOCKED_ON_SEMAPHORE)
+                finally:
+                    ARB.deregister_task(9801)
+
+        with task_scope(9800, None):
+            ARB.register_task(9800)        # the live holder (RUNNING)
+            ARB.note_device_held(9800, True)
+            t = threading.Thread(target=waiter)
+            t.start()
+            try:
+                assert queued.wait(5)
+                assert ARB.waiting_on_live_holder(9801)
+                with ARB._cond:            # backdate: both look expired
+                    for tid in (9800, 9801):
+                        ARB._tasks[tid].last_progress -= 999.0
+                for _ in range(5):
+                    wd.sweep()
+                assert not any(
+                    e.kind == "taskCancelled"
+                    and e.payload.get("task_id") == 9801
+                    for e in ring.events())
+                assert not any(
+                    e.kind == "watchdogDump"
+                    and e.payload.get("task_id") == 9801
+                    for e in ring.events())
+            finally:
+                release.set()
+                t.join(10)
+                ARB.deregister_task(9800)
+
+    def test_cancelled_task_keeps_episode_alive_with_redumps(self, ring):
+        """A cancelled task that never reaches a cancellation checkpoint
+        must not silence the watchdog: it stays in expired_tasks and is
+        re-dumped every 10 timeouts."""
+        wd = A.HungQueryWatchdog(timeout_ms=50, poll_ms=10)
+        with task_scope(9802, None):
+            ARB.register_task(9802)
+            try:
+                with ARB._cond:
+                    ARB._tasks[9802].last_progress -= 999.0
+                wd.sweep()                 # rung 1: dump
+                # rung 2 (a timeout after the dump, global stall): cancel
+                wd._dumped[9802] -= 0.06
+                wd.sweep()
+                with ARB._cond:
+                    assert ARB._tasks[9802].cancelled
+                # 10 timeouts after the dump: the episode re-dumps
+                wd._dumped[9802] -= 0.5
+                wd.sweep()
+                dumps = [e for e in ring.events()
+                         if e.kind == "watchdogDump"
+                         and e.payload.get("task_id") == 9802]
+                assert len(dumps) == 2
+                assert ARB.expired_tasks(0.05), \
+                    "cancelled task must stay visible to the sweep"
+            finally:
+                ARB.deregister_task(9802)
+
+    def test_sweep_fault_injection_daemon_survives(self):
+        """Chaos point watchdog.sweep: a faulted sweep is skipped, never
+        fatal to the daemon."""
+        F.arm_fault("watchdog.sweep", n=2)
+        wd = A.HungQueryWatchdog(timeout_ms=1000, poll_ms=10)
+        for _ in range(3):
+            wd.sweep()
+        assert wd.sweep_faults == 2
+        assert not F.is_armed("watchdog.sweep")
+
+    def test_memory_block_hang_recovered_through_task_reexecution(self):
+        """THE acceptance scenario: with the watchdog armed, an injected
+        memory.block hang is detected, dumped, and recovered through
+        task re-execution — the query completes with results identical
+        to the fault-free run."""
+        data = {"k": list(range(100)) * 4,
+                "v": [float(i) for i in range(400)]}
+        s0 = TpuSession(TpuConf({}))
+        expected = s0.create_dataframe(data, num_partitions=2) \
+            .group_by("k").sum("v").order_by("k").collect()
+        s0.stop()
+        F.reset_recovery_stats()
+        s = TpuSession(TpuConf({
+            "spark.rapids.watchdog.enabled": "true",
+            "spark.rapids.watchdog.timeoutMs": "300",
+            "spark.rapids.watchdog.pollMs": "50",
+            "spark.rapids.chaos.memory.block": "1",
+        }))
+        try:
+            got = s.create_dataframe(data, num_partitions=2) \
+                .group_by("k").sum("v").order_by("k").collect()
+            assert got == expected
+            rec = F.recovery_stats()
+            assert rec.get("watchdog_dumps", 0) >= 1
+            assert rec.get("tasks_cancelled", 0) >= 1
+            assert rec.get("task_retries", 0) >= 1, \
+                "recovery must ride the task re-execution machinery"
+        finally:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# bounded transport waits (satellite)
+# ---------------------------------------------------------------------------
+
+class TestTransportTimeouts:
+    def test_transaction_wait_none_uses_default(self, monkeypatch):
+        from spark_rapids_tpu.shuffle import transport as T
+        monkeypatch.setattr(T, "DEFAULT_WAIT_TIMEOUT_S", 0.05)
+        txn = T.Transaction(1).start(None)
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            txn.wait()       # no explicit timeout: conf default applies
+        assert time.monotonic() - t0 < 2.0
+
+    def test_bounce_buffer_acquire_none_uses_default(self, monkeypatch):
+        from spark_rapids_tpu.shuffle import transport as T
+        monkeypatch.setattr(T, "DEFAULT_WAIT_TIMEOUT_S", 0.05)
+        mgr = T.BounceBufferManager(buffer_size=16, count=1)
+        buf = mgr.acquire()
+        with pytest.raises(TimeoutError):
+            mgr.acquire()
+        buf.close()
+        assert mgr.available == 1
+
+    def test_conf_flows_to_transport_default(self):
+        from spark_rapids_tpu.shuffle import transport as T
+        s = TpuSession(TpuConf({"spark.rapids.sql.enabled": "false"}),
+                       init_device=False)
+        s.set_conf("spark.rapids.shuffle.transport.timeoutMs", "250")
+        assert T.DEFAULT_WAIT_TIMEOUT_S == 0.25
+        s.stop()
+        # restore the registry default for later tests
+        TpuSession(TpuConf({"spark.rapids.sql.enabled": "false"}),
+                   init_device=False).stop()
+        assert T.DEFAULT_WAIT_TIMEOUT_S == 120.0
+
+    def test_fetch_timeout_is_retryable(self):
+        """A TimeoutError inside a fetch attempt rides the existing
+        retry/backoff policy exactly like a dropped connection."""
+        from spark_rapids_tpu.shuffle.catalog import ShuffleBufferCatalog
+        from spark_rapids_tpu.shuffle.client_server import (ShuffleClient,
+                                                            ShuffleServer)
+        from spark_rapids_tpu.shuffle.client_server import FetchRetryPolicy
+        from spark_rapids_tpu.shuffle.transport import InProcessTransport
+        transport = InProcessTransport()
+        cat = ShuffleBufferCatalog("none")
+        server = ShuffleServer("x-0", cat, transport)
+        client = ShuffleClient("x-0-client", transport,
+                               retry=FetchRetryPolicy(base_wait_s=0.001,
+                                                      max_wait_s=0.002))
+        transport.register_handler("x-0", server)
+        transport.register_handler("x-0-client", client)
+        F.arm_fault("shuffle.fetch", n=1,
+                    exc=lambda p: TimeoutError(f"injected timeout at {p}"))
+        got = client.do_fetch(server, shuffle_id=1, partition_id=0)
+        assert got == []        # empty partition fetched on the retry
+        assert F.recovery_stats().get("fetch_retries", 0) == 1
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces
+# ---------------------------------------------------------------------------
+
+class TestObservability:
+    def test_prometheus_renders_arbiter_counters(self):
+        text = EV.render_prometheus()
+        for name in ("arbiter_blocked_threads",
+                     "arbiter_blocked_on_alloc_total",
+                     "deadlock_breaks_total", "forced_splits_total",
+                     "tasks_cancelled_total", "watchdog_dumps_total"):
+            assert f"spark_rapids_tpu_{name}" in text
+
+    def test_query_summary_carries_alloc_wait(self):
+        s = TpuSession(TpuConf({}))
+        try:
+            df = s.create_dataframe({"a": list(range(64))})
+            df.select("a").collect()
+            from spark_rapids_tpu.aux.tracing import last_query_summary
+            summ = last_query_summary()
+            assert "alloc_wait_s" in summ
+        finally:
+            s.stop()
+
+    def test_profiler_arbitration_bucket(self, tmp_path):
+        """threadBlocked wait time lands in the profiler's arbitration
+        stall bucket."""
+        import json
+        log = tmp_path / "arb.jsonl"
+
+        def jline(kind, qid, sid, ts, **payload):
+            return json.dumps({"event": kind, "query_id": qid,
+                               "span_id": sid, "ts": ts, **payload})
+
+        lines = [
+            jline("queryStart", 5, 1, 1.0, description="blocked"),
+            jline("threadBlocked", 5, 1, 1.2, task_id=1, nbytes=1024,
+                  wait_s=0.8, outcome="retry"),
+            jline("queryEnd", 5, 1, 3.0, duration_s=2.0,
+                  alloc_wait_s=0.8),
+        ]
+        log.write_text("\n".join(lines) + "\n")
+        from spark_rapids_tpu.tools.profile import attribute
+        from spark_rapids_tpu.tools.reader import load_profiles
+        profiles, _ = load_profiles(str(log))
+        att = attribute(profiles[0])
+        # events counted once — the summary fallback must not double it
+        assert att.raw["arbitration"] == pytest.approx(0.8)

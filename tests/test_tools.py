@@ -379,6 +379,61 @@ def test_autotune_spill_pressure_rule(tmp_path):
                by_key["spark.rapids.sql.concurrentGpuTasks"].evidence)
 
 
+def test_autotune_deadlock_break_rule(tmp_path):
+    """Rule 6: repeated deadlock breaks / BUFN splits -> shed device
+    concurrency, with the break events as evidence."""
+    log = tmp_path / "deadlock.jsonl"
+    lines = [
+        _jline("queryStart", 11, 1, 1.0, description="contended",
+               conf={"spark.rapids.sql.concurrentGpuTasks": 4}),
+        _jline("deadlockBreak", 11, 1, 1.2, task_id=7, exc="RetryOOM",
+               blocked_tasks=4, forced=False, wake_count=1),
+        _jline("deadlockBreak", 11, 1, 1.4, task_id=7,
+               exc="SplitAndRetryOOM", blocked_tasks=4, forced=False,
+               wake_count=2),
+        _jline("queryEnd", 11, 1, 3.0, duration_s=2.0),
+    ]
+    log.write_text("\n".join(lines) + "\n")
+    recs = autotune_query(load_profiles(str(log))[0][0])
+    by_key = {r.key: r for r in recs}
+    rec = by_key["spark.rapids.sql.concurrentGpuTasks"]
+    assert rec.current == 4 and rec.recommended == 3
+    assert any("deadlockBreak" in e for e in rec.evidence)
+    assert "BUFN split" in rec.reason
+    # a single break stays silent: the mechanism doing its job once is
+    # not evidence of chronic contention
+    single = tmp_path / "one.jsonl"
+    single.write_text("\n".join([
+        _jline("queryStart", 12, 1, 1.0, description="once"),
+        _jline("deadlockBreak", 12, 1, 1.2, task_id=3, exc="RetryOOM",
+               blocked_tasks=2, forced=False, wake_count=1),
+        _jline("queryEnd", 12, 1, 2.0, duration_s=1.0),
+    ]) + "\n")
+    assert "spark.rapids.sql.concurrentGpuTasks" not in {
+        r.key for r in autotune_query(load_profiles(str(single))[0][0])}
+
+
+def test_autotune_deadlock_breaks_at_serial_raise_pool_fraction(tmp_path):
+    """Rule 6 at concurrentGpuTasks=1: nothing left to shed — recommend
+    a bigger pool fraction instead."""
+    log = tmp_path / "serial.jsonl"
+    lines = [
+        _jline("queryStart", 13, 1, 1.0, description="serial",
+               conf={"spark.rapids.sql.concurrentGpuTasks": 1}),
+        *[_jline("deadlockBreak", 13, 1, 1.0 + 0.1 * i, task_id=5,
+                 exc="SplitAndRetryOOM", blocked_tasks=1, forced=False,
+                 wake_count=i + 1) for i in range(3)],
+        _jline("queryEnd", 13, 1, 3.0, duration_s=2.0),
+    ]
+    log.write_text("\n".join(lines) + "\n")
+    recs = autotune_query(load_profiles(str(log))[0][0])
+    by_key = {r.key: r for r in recs}
+    rec = by_key["spark.rapids.memory.gpu.allocFraction"]
+    assert rec.recommended == pytest.approx(0.9)
+    conf = to_conf_dict([rec])
+    C.TpuConf(dict(conf))       # genuinely ready-to-apply
+
+
 def test_autotune_quiet_on_healthy_log(tmp_path):
     log = tmp_path / "ok.jsonl"
     lines = [
