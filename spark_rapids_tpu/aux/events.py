@@ -68,6 +68,8 @@ EVENT_KINDS = frozenset({
     "taskRetry", "taskDegraded",
     # pipelined execution (exec/pipeline.py)
     "pipelineSpool",
+    # stage compiler (exec/stage_compiler.py)
+    "stageCompile",
     # shuffle layer (shuffle/*.py, exec/exchange.py)
     "shuffleSend", "shuffleFetch", "fetchRetry", "fetchFailover",
     "shuffleBlockLoaded", "shuffleWorkerFetch", "shuffleBlocksInvalidated",
@@ -489,6 +491,26 @@ def render_prometheus() -> str:
         "Hung-query watchdog thread-state dumps")
     add("events_ring_dropped_total", "counter", ring_dropped_total(),
         "Events dropped by bounded ring-buffer sinks (truncation marker)")
+    from spark_rapids_tpu.exec import stage_compiler as _sc
+    scs = _sc.stats()
+    add("stage_programs", "gauge", scs["programs"],
+        "Live compiled stage programs in the executable cache")
+    add("stage_cache_hits_total", "counter", scs["hits"],
+        "Executable-cache hits (program reused without rebuild)")
+    add("stage_cache_misses_total", "counter", scs["misses"],
+        "Executable-cache misses (program built)")
+    add("stage_cache_evictions_total", "counter", scs["evictions"],
+        "Programs dropped by the executable-cache LRU bound")
+    add("stage_traces_total", "counter", scs["traces"],
+        "JAX traces of stage programs (retrace marker: should stop "
+        "growing once a workload's shapes are warm)")
+    add("stage_compiles_total", "counter", scs["compiles"],
+        "Stage programs compiled (first dispatches)")
+    add("stage_async_compiles_total", "counter", scs["async_compiles"],
+        "Stage programs compiled on the background pool")
+    add("stage_compile_seconds_total", "counter",
+        round(scs["compile_s"], 6),
+        "Seconds spent tracing+compiling stage programs")
     from spark_rapids_tpu.aux import profiler as _prof
     for op, s in sorted(_prof.range_stats().items()):
         full = "spark_rapids_tpu_op_range_seconds_total"

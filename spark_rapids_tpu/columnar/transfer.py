@@ -23,7 +23,7 @@ RapidsShuffleInternalManagerBase.scala's serialized-table path.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -36,9 +36,6 @@ from spark_rapids_tpu.columnar.column import (DeviceColumn, HostColumn,
 # canonical transport dtype per element width
 _CANON = {8: np.dtype(np.int64), 4: np.dtype(np.int32),
           2: np.dtype(np.int16), 1: np.dtype(np.uint8)}
-
-# jitted unpack programs keyed by batch layout signature
-_UNPACK_CACHE: Dict[Tuple, object] = {}
 
 
 class _Plane:
@@ -151,8 +148,7 @@ def upload_host_batch(hb, bucket: Optional[int] = None):
     # counts must not trigger recompiles
     key = (tuple(layout), widths,
            tuple(host_bufs[w].size for w in widths), b, n_allvalid > 0)
-    fn = _UNPACK_CACHE.get(key)
-    if fn is None:
+    def build():
         def unpack(bufs, rows):
             byw = dict(zip(widths, bufs))
             outs = []
@@ -171,8 +167,9 @@ def upload_host_batch(hb, bucket: Optional[int] = None):
             ones = (jnp.arange(b) < rows) if n_allvalid else None
             return outs, ones
 
-        fn = jax.jit(unpack)
-        _UNPACK_CACHE[key] = fn
+        return unpack
+    from spark_rapids_tpu.exec.stage_compiler import get_or_build
+    fn = get_or_build("transfer.unpack", key, build)
 
     dev_bufs = jax.device_put([host_bufs[w] for w in widths])
     planes_dev, ones = fn(dev_bufs, n)
@@ -200,9 +197,6 @@ def upload_host_batch(hb, bucket: Optional[int] = None):
 # ---------------------------------------------------------------------------
 # device -> host (packed download)
 # ---------------------------------------------------------------------------
-
-#: jitted pack programs keyed by (plane signature, shrink)
-_PACK_CACHE: Dict[Tuple, object] = {}
 
 #: speculative row cap for single-round-trip downloads when the row count
 #: is still deferred: planes are sliced to this many rows and the count is
@@ -276,12 +270,10 @@ def _pack_planes(planes, shrink: int, rc_traced):
     array on a tunnel-attached chip (~58ms each), which dominated
     small-result collects; a single packed buffer makes the whole download
     one sync."""
-    import jax
     jnp = _jnp()
     sig = tuple((str(p.dtype), tuple(p.shape)) for p in planes)
     key = (sig, shrink)
-    fn = _PACK_CACHE.get(key)
-    if fn is None:
+    def build():
         def run(ps, rc):
             chunks = [_plane_words(p[:shrink], jnp) for p in ps]
             u = jnp.asarray(rc, dtype=np.int64).astype(np.uint64).reshape(1)
@@ -290,8 +282,9 @@ def _pack_planes(planes, shrink: int, rc_traced):
                 (u >> np.uint64(32)).astype(np.uint32)]))
             return jnp.concatenate(chunks)
 
-        fn = jax.jit(run)
-        _PACK_CACHE[key] = fn
+        return run
+    from spark_rapids_tpu.exec.stage_compiler import get_or_build
+    fn = get_or_build("transfer.pack", key, build)
     return fn(planes, rc_traced)
 
 
@@ -343,7 +336,6 @@ def download_host_batch(cb) -> "object":
     rows; the packed count reveals whether that was enough, and only an
     oversized result pays a second (exactly-sized) round trip.
     """
-    import jax
     from spark_rapids_tpu.columnar.batch import HostColumnarBatch
     from spark_rapids_tpu.columnar.column import DeferredCount, rc_traceable
     if not cb.columns:

@@ -774,6 +774,56 @@ PROFILE_PATH = conf_str(
     "If set, write per-stage trace files under this path (reference profiler.scala).",
     "", ConfLevel.INTERNAL)
 
+COMPILE_CACHE_DIR = conf_str(
+    "spark.rapids.sql.compile.cacheDir",
+    "Directory for the persistent (on-disk) XLA compilation cache: "
+    "compiled stage executables survive across queries AND sessions, so "
+    "a restarted process re-traces (cheap) but never re-compiles a "
+    "known program (expensive — tens of seconds per program on a "
+    "tunnel-attached TPU).  Empty (the default) never enables the disk "
+    "tier; the in-process executable cache is always on.  The setting is "
+    "enable-only per process: an already-enabled tier stays on even if a "
+    "later session leaves this empty (interleaved default-conf sessions "
+    "must not drop it) — disable explicitly via "
+    "exec.stage_compiler.set_persistent_cache_dir('').",
+    "")
+
+COMPILE_ASYNC = conf_bool(
+    "spark.rapids.sql.compile.async",
+    "Background stage compilation: a cache-missing stage program lowers "
+    "and compiles on a daemon pool thread while the consumer overlaps "
+    "the previous batch's compute (the fused stage exec runs a "
+    "one-batch look-ahead), so first-batch compile latency stops "
+    "stalling the pipeline.",
+    False)
+
+COMPILE_MAX_PROGRAMS = conf_int(
+    "spark.rapids.sql.compile.maxPrograms",
+    "Bound on the process-wide executable cache (exec/stage_compiler): "
+    "least-recently-used programs beyond it are dropped (and recompile "
+    "on next use — or reload from compile.cacheDir when set).  "
+    "Validated >= 1 at set_conf.",
+    4096,
+    checker=lambda v: int(v) >= 1)
+
+COMPILE_LITERAL_PROMOTION = conf_bool(
+    "spark.rapids.sql.compile.literalPromotion",
+    "Promote scalar literals in fused-stage filters/projections to "
+    "runtime arguments of the compiled program, so plans differing only "
+    "in literal values (dates, thresholds, year filters) share ONE "
+    "executable instead of compiling per value — bounds compile-cache "
+    "key cardinality for templated/parameterized query workloads.",
+    True)
+
+STAGE_FUSION_ENABLED = conf_bool(
+    "spark.rapids.sql.compile.stageFusion.enabled",
+    "Whole-stage fusion planner pass (plan/stages.py): collapse maximal "
+    "device operator pipelines (filter/project chains, hash-agg update "
+    "and merge/final passes) into single compiled XLA programs.  "
+    "Disabling falls back to per-operator dispatch (differential-test "
+    "hook; large end-to-end slowdown).",
+    True)
+
 CBO_ENABLED = conf_bool(
     "spark.rapids.sql.optimizer.enabled",
     "Enable the transition cost-based optimizer (reference CostBasedOptimizer.scala).",

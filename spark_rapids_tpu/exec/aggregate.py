@@ -620,8 +620,11 @@ class TpuHashAggregateExec(CpuHashAggregateExec):
                 yield self._empty_reduction().to_device()
             return
         if n_partials == 1 and self.mode != FINAL:
-            merged_batches = [partials[0].get_batch()]
-            partials[0].close()
+            # unwrap, don't close: get_batch()+close() deleted the very
+            # arrays being yielded (latent while the fusion pass replaced
+            # every non-FINAL aggregate; exposed by stageFusion.enabled=
+            # false)
+            merged_batches = [partials[0].release()]
         else:
             merged_batches = merge_partials_out_of_core(lay, partials)
         names = [lay.key_name(i) for i in range(lay.num_keys)] + \

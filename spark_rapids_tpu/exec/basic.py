@@ -243,7 +243,6 @@ class TpuFilterExec(UnaryExec):
         from spark_rapids_tpu.expressions.evaluator import device_batch_tcols
         from spark_rapids_tpu.ops import compact_batch
         from spark_rapids_tpu.columnar.column import _jnp
-        import jax
         jnp = _jnp()
         for b in self.child.execute_partition(pidx):
             cols = device_batch_tcols(b)
@@ -666,10 +665,7 @@ class TpuFilterProjectExec(UnaryExec):
     def schema(self):
         return _project_schema(self.exprs)
 
-    _CACHE: dict = {}
-
     def execute_partition(self, pidx):
-        import jax
         from spark_rapids_tpu.columnar.batch import ColumnarBatch
         from spark_rapids_tpu.columnar.column import (DeferredCount,
                                                       DeviceColumn, _jnp)
@@ -680,10 +676,12 @@ class TpuFilterProjectExec(UnaryExec):
         jnp = _jnp()
         for b in self.child.execute_partition(pidx):
             key = (_signature([self.condition] + self.exprs, b), b.bucket)
-            fn = TpuFilterProjectExec._CACHE.get(key)
-            dtypes = [c.data_type for c in b.columns]
-            bucket = b.bucket
-            if fn is None:
+
+            def build(dtypes=tuple(c.data_type for c in b.columns),
+                      bucket=b.bucket):
+                # captures frozen at build time (NOT loop cells): a later
+                # jax retrace of this cached program must see the bucket/
+                # dtypes it was keyed under, not the loop's current batch
                 cond, exprs = self.condition, self.exprs
 
                 def run(arrs, row_count):
@@ -718,8 +716,9 @@ class TpuFilterProjectExec(UnaryExec):
                         outs.append((nd, nv, nl, ne))
                     return outs, cnt
 
-                fn = jax.jit(run)
-                TpuFilterProjectExec._CACHE[key] = fn
+                return run
+            from spark_rapids_tpu.exec.stage_compiler import get_or_build
+            fn = get_or_build("basic.filter_project", key, build)
             arrs = [(c.data, c.validity, c.lengths, c.elem_valid)
                     for c in b.columns]
             from spark_rapids_tpu.columnar.column import rc_traceable

@@ -482,15 +482,25 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
 
         total = int(cols[0][0].shape[0])
 
-        def pid_fn(arrs):
-            tcols = [TCol(d, v, f.data_type, lengths=ln)
-                     for (d, v, ln), f in zip(arrs, schema.fields)]
-            ectx = EvalContext(tcols, "tpu", total)
-            h = part._hash_expr().eval_tpu(ectx)
-            n = np.int32(part.num_partitions)
-            return (((h.data % n) + n) % n).astype(np.int32)
+        def build():
+            def pid_fn(arrs):
+                tcols = [TCol(d, v, f.data_type, lengths=ln)
+                         for (d, v, ln), f in zip(arrs, schema.fields)]
+                ectx = EvalContext(tcols, "tpu", total)
+                h = part._hash_expr().eval_tpu(ectx)
+                n = np.int32(part.num_partitions)
+                return (((h.data % n) + n) % n).astype(np.int32)
+            return pid_fn
 
-        pids = jax.jit(pid_fn)([tuple(c) for c in cols])
+        # memoized by (partitioning, schema, shapes): a fresh jax.jit here
+        # re-traced the hash program on EVERY collective shuffle
+        from spark_rapids_tpu.exec.stage_compiler import get_or_build
+        key = (part.desc(),
+               tuple((f.name, str(f.data_type)) for f in schema.fields),
+               tuple((str(d.dtype), tuple(d.shape), ln is not None)
+                     for d, v, ln in cols))
+        pids = get_or_build("exchange.collective_pid", key, build)(
+            [tuple(c) for c in cols])
         out_cols, out_counts = C.collective_hash_shuffle(ctx, cols, counts,
                                                          pids)
         self._collective = (ctx, out_cols, out_counts, schema)

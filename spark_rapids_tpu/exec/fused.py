@@ -18,7 +18,7 @@ reference explicitly replaces with columnar execution).
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Sequence, Tuple
 
 import numpy as np
 
@@ -51,17 +51,30 @@ def _ops_signature(ops: Sequence[StageOp]) -> Tuple:
     return tuple(sig)
 
 
+def _lits_desc(promoted) -> str:
+    """Explain-only rendering of promoted-literal slot VALUES: the ops'
+    sql() shows value-independent ``$litN`` placeholders (they key the
+    program cache), so the concrete bindings surface here."""
+    if not promoted:
+        return ""
+    return " lits[" + \
+        ", ".join(f"$lit{p.slot}={p.value!r}" for p in promoted) + "]"
+
+
 def _batch_signature(batch: ColumnarBatch) -> Tuple:
     return tuple((str(c.data_type), tuple(c.data.shape),
                   c.lengths is not None, c.elem_valid is not None)
                  for c in batch.columns)
 
 
-def _trace_chain(ops, cols: List[TCol], sel, bucket, jnp):
-    """Applies the filter/project chain to (cols, sel) in-trace."""
+def _trace_chain(ops, cols: List[TCol], sel, bucket, jnp, lit_args=None):
+    """Applies the filter/project chain to (cols, sel) in-trace.
+    ``lit_args`` carries the runtime values of PromotedLiteral slots
+    (plan/stages.py) so one compiled program serves every literal."""
     from spark_rapids_tpu.expressions.evaluator import tcol_to_device_column
     for kind, payload in ops:
         ctx = EvalContext(cols, "tpu", bucket)
+        ctx.literal_args = lit_args
         if kind == "filter":
             pred = payload.eval_tpu(ctx)
             keep = valid_array(pred, ctx)
@@ -92,15 +105,38 @@ def _arrs_to_tcols(arrs, dtypes):
             for (d, v, ln, ev), dt in zip(arrs, dtypes)]
 
 
-class TpuFusedStageExec(UnaryExec):
+class _PromotedLiteralsMixin:
+    """Promoted-literal plumbing shared by the fused execs: slot values
+    bind as runtime args of the compiled program (``_lit_args``) while
+    plan-identity keys still carry the VALUES (``lit_key`` — two stages
+    sharing one program are still different pipelines)."""
+
+    def _init_promoted(self, promoted) -> None:
+        #: PromotedLiteral slots in order (plan/stages.py); their values
+        #: are runtime args of the compiled program, not part of its key
+        self.promoted = list(promoted)
+        self._lits = None
+
+    def _lit_args(self) -> Tuple:
+        if self._lits is None:
+            from spark_rapids_tpu.plan.stages import physical_literal
+            self._lits = tuple(physical_literal(p.value, p.data_type)
+                               for p in self.promoted)
+        return self._lits
+
+    def lit_key(self) -> Tuple:
+        return tuple((p.slot, repr(p.value)) for p in self.promoted)
+
+
+class TpuFusedStageExec(UnaryExec, _PromotedLiteralsMixin):
     """Fused [Filter|Project]+ chain with a compact terminal."""
 
     is_device = True
-    _CACHE: Dict[Tuple, object] = {}
 
-    def __init__(self, ops: Sequence[StageOp], child: Exec):
+    def __init__(self, ops: Sequence[StageOp], child: Exec, promoted=()):
         super().__init__(child)
         self.ops = list(ops)
+        self._init_promoted(promoted)
 
     @property
     def schema(self) -> T.StructType:
@@ -120,78 +156,105 @@ class TpuFusedStageExec(UnaryExec):
         return names
 
     def execute_partition(self, pidx):
+        from spark_rapids_tpu.exec import stage_compiler as SC
+        pending = None
+        for b in self.child.execute_partition(pidx):
+            prog, args = self._program(b)
+            if SC.ASYNC_COMPILE and prog.needs_compile():
+                # background lower+compile; the one-batch look-ahead below
+                # overlaps it with the previous batch's downstream compute
+                prog.warm_async(*args)
+            if pending is not None:
+                yield self._finish(*pending)
+                pending = None
+            # defer only while a background compile is actually in flight:
+            # in the steady state (program warm) an unconditional hold
+            # would add a batch of latency and pin an extra batch's device
+            # arrays per fused stage for zero overlap benefit
+            if prog.compiling():
+                pending = (prog, args)
+            else:
+                yield self._finish(prog, args)
+        if pending is not None:
+            yield self._finish(*pending)
+
+    def _program(self, b):
         import jax
         jnp = _jx()
         ops = self.ops
-        for b in self.child.execute_partition(pidx):
-            key = (_ops_signature(ops), _batch_signature(b), b.bucket)
-            fn = TpuFusedStageExec._CACHE.get(key)
-            if fn is None:
-                bucket = b.bucket
-                dtypes = [c.data_type for c in b.columns]
+        key = (_ops_signature(ops), _batch_signature(b), b.bucket)
 
-                def run(arrs, rc):
-                    cols = _arrs_to_tcols(arrs, dtypes)
-                    sel = jnp.arange(bucket, dtype=np.int32) < rc
-                    cols, sel = _trace_chain(ops, cols, sel, bucket, jnp)
-                    # compact terminal: one multi-operand stable sort
-                    cnt = jnp.sum(sel)
-                    live = jnp.arange(bucket) < cnt
-                    flat, twod = [], []
-                    metas = []
-                    for c in cols:
-                        is2d = getattr(c.data, "ndim", 1) > 1
-                        (twod if is2d else flat).append(c.data)
-                        flat.append(c.valid)
-                        has_ln = c.lengths is not None
-                        if has_ln:
-                            flat.append(c.lengths)
-                        has_ev = getattr(c, "elem_valid", None) is not None
-                        if has_ev:
-                            twod.append(c.elem_valid)
-                        metas.append((is2d, has_ln, has_ev))
-                    rowpos = jnp.arange(bucket, dtype=np.int32)
-                    operands = ((~sel).astype(np.int8), rowpos) + tuple(flat)
-                    sorted_ops = jax.lax.sort(operands, num_keys=1,
-                                              is_stable=True)
-                    perm = sorted_ops[1]
-                    fs = list(sorted_ops[2:])
-                    ts = [jnp.take(p, perm, axis=0) for p in twod]
-                    outs = []
-                    fi = ti = 0
-                    for (is2d, has_ln, has_ev) in metas:
-                        if is2d:
-                            d = ts[ti]
-                            ti += 1
-                        else:
-                            d = fs[fi]
-                            fi += 1
-                        v = fs[fi] & live
+        def build():
+            bucket = b.bucket
+            dtypes = [c.data_type for c in b.columns]
+
+            def run(arrs, rc, lits):
+                cols = _arrs_to_tcols(arrs, dtypes)
+                sel = jnp.arange(bucket, dtype=np.int32) < rc
+                cols, sel = _trace_chain(ops, cols, sel, bucket, jnp,
+                                         lits)
+                # compact terminal: one multi-operand stable sort
+                cnt = jnp.sum(sel)
+                live = jnp.arange(bucket) < cnt
+                flat, twod = [], []
+                metas = []
+                for c in cols:
+                    is2d = getattr(c.data, "ndim", 1) > 1
+                    (twod if is2d else flat).append(c.data)
+                    flat.append(c.valid)
+                    has_ln = c.lengths is not None
+                    if has_ln:
+                        flat.append(c.lengths)
+                    has_ev = getattr(c, "elem_valid", None) is not None
+                    if has_ev:
+                        twod.append(c.elem_valid)
+                    metas.append((is2d, has_ln, has_ev))
+                rowpos = jnp.arange(bucket, dtype=np.int32)
+                operands = ((~sel).astype(np.int8), rowpos) + tuple(flat)
+                sorted_ops = jax.lax.sort(operands, num_keys=1,
+                                          is_stable=True)
+                perm = sorted_ops[1]
+                fs = list(sorted_ops[2:])
+                ts = [jnp.take(p, perm, axis=0) for p in twod]
+                outs = []
+                fi = ti = 0
+                for (is2d, has_ln, has_ev) in metas:
+                    if is2d:
+                        d = ts[ti]
+                        ti += 1
+                    else:
+                        d = fs[fi]
                         fi += 1
-                        ln = None
-                        if has_ln:
-                            ln = fs[fi]
-                            fi += 1
-                        ev = None
-                        if has_ev:
-                            ev = ts[ti]
-                            ti += 1
-                        outs.append((d, v, ln, ev))
-                    return outs, cnt
+                    v = fs[fi] & live
+                    fi += 1
+                    ln = None
+                    if has_ln:
+                        ln = fs[fi]
+                        fi += 1
+                    ev = None
+                    if has_ev:
+                        ev = ts[ti]
+                        ti += 1
+                    outs.append((d, v, ln, ev))
+                return outs, cnt
 
-                fn = jax.jit(run)
-                TpuFusedStageExec._CACHE[key] = fn
+            return run
+        from spark_rapids_tpu.exec.stage_compiler import get_or_build
+        prog = get_or_build("fused.stage", key, build)
+        # validity inside the trace comes from TCol.valid; bind real
+        # planes (and the promoted literal values) here
+        args = (_cols_to_arrs(b), rc_traceable(b.row_count),
+                self._lit_args())
+        return prog, args
 
-            # validity inside the trace comes from TCol.valid; bind real
-            # planes here
-            arrs = _cols_to_arrs(b)
-            outs, cnt = fn(arrs, rc_traceable(b.row_count))
-            rc = DeferredCount(cnt)
-            fields = self.schema.fields
-            cols = [DeviceColumn(d, v, rc, f.data_type, ln, ev)
-                    for (d, v, ln, ev), f in zip(outs, fields)]
-            yield ColumnarBatch(cols, rc, self._out_names() or
-                                [f.name for f in fields])
+    def _finish(self, prog, args):
+        outs, cnt = prog(*args)
+        rc = DeferredCount(cnt)
+        fields = self.schema.fields
+        cols = [DeviceColumn(d, v, rc, f.data_type, ln, ev)
+                for (d, v, ln, ev), f in zip(outs, fields)]
+        return ColumnarBatch(cols, rc, self._out_names() or
+                             [f.name for f in fields])
 
     def node_desc(self):
         parts = []
@@ -200,10 +263,11 @@ class TpuFusedStageExec(UnaryExec):
                 parts.append(f"F[{payload.sql()}]")
             else:
                 parts.append(f"P[{', '.join(e.sql() for e in payload)}]")
-        return "TpuFusedStage(" + " -> ".join(parts) + ")"
+        return "TpuFusedStage(" + " -> ".join(parts) + ")" \
+            + _lits_desc(self.promoted)
 
 
-class TpuFusedAggExec(UnaryExec):
+class TpuFusedAggExec(UnaryExec, _PromotedLiteralsMixin):
     """Fused [Filter|Project]* chain + hash-aggregate update pass.
 
     The chain and the aggregate's first (update) pass over each input batch
@@ -213,13 +277,14 @@ class TpuFusedAggExec(UnaryExec):
     """
 
     is_device = True
-    _CACHE: Dict[Tuple, object] = {}
 
-    def __init__(self, ops: Sequence[StageOp], layout, mode, child: Exec):
+    def __init__(self, ops: Sequence[StageOp], layout, mode, child: Exec,
+                 promoted=()):
         super().__init__(child)
         self.ops = list(ops)
         self.layout = layout
         self.mode = mode
+        self._init_promoted(promoted)
 
     @property
     def schema(self):
@@ -228,7 +293,6 @@ class TpuFusedAggExec(UnaryExec):
             self.layout.result_schema
 
     def _fused_update(self, b: ColumnarBatch) -> ColumnarBatch:
-        import jax
         jnp = _jx()
         lay = self.layout
         ops = self.ops
@@ -238,8 +302,7 @@ class TpuFusedAggExec(UnaryExec):
                tuple((o, k, cv, str(dt))
                      for o, k, cv, dt in lay.update_specs()),
                lay.num_keys)
-        fn = TpuFusedAggExec._CACHE.get(key)
-        if fn is None:
+        def build():
             from spark_rapids_tpu.expressions.evaluator import \
                 tcol_to_device_column
             from spark_rapids_tpu.ops.agg_ops import (_GLOBAL_OUT_BUCKET,
@@ -251,10 +314,10 @@ class TpuFusedAggExec(UnaryExec):
             upd_specs = list(lay.update_specs())
             nk = lay.num_keys
 
-            def run(arrs, rc):
+            def run(arrs, rc, lits):
                 cols = _arrs_to_tcols(arrs, dtypes)
                 sel = jnp.arange(bucket, dtype=np.int32) < rc
-                cols, sel = _trace_chain(ops, cols, sel, bucket, jnp)
+                cols, sel = _trace_chain(ops, cols, sel, bucket, jnp, lits)
                 ctx = EvalContext(cols, "tpu", bucket)
                 upd_cols = []
                 for e in upd_exprs:
@@ -269,11 +332,12 @@ class TpuFusedAggExec(UnaryExec):
                 return keyed_agg_trace(upd_cols, sel, nk, upd_specs,
                                        bucket, jnp)
 
-            fn = jax.jit(run)
-            TpuFusedAggExec._CACHE[key] = fn
+            return run
+        from spark_rapids_tpu.exec.stage_compiler import get_or_build
+        fn = get_or_build("fused.agg_update", key, build)
 
         arrs = _cols_to_arrs(b)
-        outs, ng = fn(arrs, rc_traceable(b.row_count))
+        outs, ng = fn(arrs, rc_traceable(b.row_count), self._lit_args())
         lay = self.layout
         nk = lay.num_keys
         n = 1 if nk == 0 else DeferredCount(ng)
@@ -311,7 +375,6 @@ class TpuFusedAggExec(UnaryExec):
         final project) into one — on a tunnel-attached TPU each dispatch
         costs ~20ms of round-trip latency, so this halves the critical
         path of every aggregate query's last mile."""
-        import jax
         jnp = _jx()
         lay = self.layout
         nk = lay.num_keys
@@ -321,8 +384,7 @@ class TpuFusedAggExec(UnaryExec):
                tuple(b.bucket for b in partials), nk,
                tuple((o, k, cv, str(dt)) for o, k, cv, dt in merge_specs),
                tuple((e.sql(), str(e.data_type)) for e in final_exprs))
-        fn = TpuFusedAggExec._CACHE.get(key)
-        if fn is None:
+        def build():
             from spark_rapids_tpu.columnar.column import DeviceColumn
             from spark_rapids_tpu.expressions.evaluator import \
                 tcol_to_device_column
@@ -371,8 +433,9 @@ class TpuFusedAggExec(UnaryExec):
                                   dc.elem_valid))
                 return fouts, ng
 
-            fn = jax.jit(run)
-            TpuFusedAggExec._CACHE[key] = fn
+            return run
+        from spark_rapids_tpu.exec.stage_compiler import get_or_build
+        fn = get_or_build("fused.agg_merge_final", key, build)
 
         arrs_list = [[(c.data, c.validity, c.lengths) for c in b.columns]
                      for b in partials]
@@ -463,4 +526,4 @@ class TpuFusedAggExec(UnaryExec):
         chain = "+".join("F" if k == "filter" else "P"
                          for k, _ in self.ops) or "-"
         return f"TpuFusedAgg[{chain}, keys={self.layout.num_keys}, " \
-               f"mode={self.mode}]"
+               f"mode={self.mode}]" + _lits_desc(self.promoted)

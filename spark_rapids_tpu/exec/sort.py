@@ -109,26 +109,16 @@ def host_sort_batch(b, specs: Sequence[SortSpec]):
 
 def device_sort_batch(b: ColumnarBatch, specs: Sequence[SortSpec]
                       ) -> ColumnarBatch:
-    """Device sort of one batch, projecting non-reference keys as needed
-    (reference: SortUtils computeSortedTable)."""
-    from spark_rapids_tpu.expressions.evaluator import eval_exprs_tpu
-    from spark_rapids_tpu.expressions.base import Alias, BoundReference as BR
-    from spark_rapids_tpu.ops.sort_ops import sort_batch
+    """Device sort of one batch (reference: SortUtils computeSortedTable).
+    Sort-key prep is fused: non-reference keys evaluate IN-TRACE inside
+    the single sort+gather program (ops/sort_ops.sort_gather_batch) — no
+    key projection dispatch, no key materialization, no separate
+    per-column gather."""
+    from spark_rapids_tpu.ops.sort_ops import sort_gather_batch
     from spark_rapids_tpu.memory.retry import with_retry_no_split
-    n_cols = b.num_columns
-    orders, extra = _split_keys(specs, n_cols)
-    if extra:
-        names = b.names or [f"c{i}" for i in range(n_cols)]
-        proj = [Alias(BR(i, c.data_type, True), names[i])
-                for i, c in enumerate(b.columns)]
-        keys = [Alias(e, f"__sortkey{i}") for i, e in enumerate(extra)]
-        aug = eval_exprs_tpu(proj + keys, b)
-    else:
-        aug = b
-    out = with_retry_no_split(None, lambda: sort_batch(aug, orders))
-    if extra:
-        out = out.select(list(range(n_cols)))
-    return out
+    orders, extra = _split_keys(specs, b.num_columns)
+    return with_retry_no_split(
+        None, lambda: sort_gather_batch(b, orders, extra))
 
 
 class CpuSortExec(UnaryExec):

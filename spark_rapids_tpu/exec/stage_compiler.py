@@ -1,0 +1,340 @@
+"""StageCompiler: the process-wide executable cache behind every jitted
+stage program.
+
+The engine's end-to-end deficit lives in the query path around the
+kernels, not in the kernels (ROADMAP item 1): per-operator dispatch and —
+worse — re-tracing/re-compiling programs the process has already built.
+Every jitted stage program (fused filter/project/agg chains, join
+build/probe/pair phases, sort permutations, window frames, transfer
+pack/unpack...) is obtained through ONE helper here, keyed by its
+(op-signature, batch schema, row bucket) and backed by a two-tier cache:
+
+- **tier 1 — process executable cache**: a bounded LRU of jitted
+  callables with hit/miss/evict/trace counters.  The python trace
+  function of every program is wrapped with a trace counter, so "the
+  second run of an identical query performs zero new traces" is an
+  assertable fact, not a hope.
+- **tier 2 — JAX persistent compilation cache** (conf
+  ``spark.rapids.sql.compile.cacheDir``): compiled XLA executables
+  survive process restarts; a cold process re-traces (cheap) but loads
+  machine code from disk instead of re-compiling (expensive — tens of
+  seconds per program on a tunnel-attached TPU).
+
+Optional background compilation (conf ``spark.rapids.sql.compile.async``):
+``warm_async`` lowers + compiles a program on a daemon pool thread while
+the caller overlaps other work (the fused stage exec runs a one-batch
+look-ahead so a new program's compile overlaps the previous batch's
+compute), mirroring the PR-4 pipeline's producer/consumer overlap at the
+compiler layer.
+
+Reference analog: the reference pays JIT cost in cuDF kernel launches and
+avoids it via pre-built kernels; a tracing-compiler engine must instead
+manage program identity explicitly — this module is that manager.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+__all__ = ["get_or_build", "stats", "reset_stats", "clear",
+           "set_max_programs", "set_persistent_cache_dir", "StageProgram"]
+
+#: synced from ``spark.rapids.sql.compile.async`` by the planner
+ASYNC_COMPILE = False
+
+_LOCK = threading.RLock()
+_PROGRAMS: "collections.OrderedDict[Tuple, StageProgram]" = \
+    collections.OrderedDict()
+_MAX_PROGRAMS = 4096
+
+_STATS = {
+    "hits": 0,          # tier-1 lookups that found a live program
+    "misses": 0,        # lookups that had to build a new program
+    "evictions": 0,     # programs dropped by the LRU bound
+    "traces": 0,        # python trace-function executions (per jax trace)
+    "compiles": 0,      # first dispatches that built a new executable
+    "async_compiles": 0,  # programs compiled on the background pool
+    "async_failures": 0,  # background compiles that raised (jit fallback)
+    "compile_s": 0.0,   # seconds spent in first-dispatch trace+compile
+}
+#: last background-compile error (stats(); None = healthy)
+_ASYNC_ERROR = [None]
+_TRACES_BY_KIND: Dict[str, int] = {}
+
+#: persistent (tier-2) cache state; dir None = disabled
+_DISK = {"dir": None, "error": None}
+
+_POOL = None
+_POOL_LOCK = threading.Lock()
+
+
+class _DaemonPool:
+    """Two daemon worker threads + a queue.  NOT a ThreadPoolExecutor:
+    since 3.9 its (non-daemon) workers are joined at interpreter exit, so
+    an in-flight XLA compile — tens of seconds, or forever on a dead TPU
+    tunnel — would block shutdown.  A background compile is disposable;
+    daemon threads let the process exit mid-compile."""
+
+    def __init__(self, workers: int = 2):
+        import queue
+        self._q = queue.Queue()
+        for i in range(workers):
+            threading.Thread(target=self._loop, daemon=True,
+                             name=f"tpu-compile-{i}").start()
+
+    def _loop(self):
+        while True:
+            fut, fn = self._q.get()
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001 — delivered to the
+                fut.set_exception(e)     # joining __call__, never lost
+
+    def submit(self, fn):
+        from concurrent.futures import Future
+        fut = Future()
+        self._q.put((fut, fn))
+        return fut
+
+
+def _compile_pool():
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            _POOL = _DaemonPool()
+        return _POOL
+
+
+def _key_hash(key) -> str:
+    return hashlib.sha1(repr(key).encode()).hexdigest()[:12]
+
+
+class StageProgram:
+    """One cached jitted program.  Callable; measures its first dispatch
+    (trace + compile + first execution) and emits a ``stageCompile``
+    event so the profiler can attribute compilation separately from
+    steady-state compute."""
+
+    __slots__ = ("kind", "key_hash", "_fn", "_lock", "_dispatched",
+                 "_warm_future", "_compiled")
+
+    def __init__(self, kind: str, key, fn):
+        self.kind = kind
+        self.key_hash = _key_hash(key)
+        self._fn = fn
+        self._lock = threading.Lock()
+        self._dispatched = False
+        self._warm_future = None
+        self._compiled = None
+
+    # -- async (AOT) path ----------------------------------------------------
+    def needs_compile(self) -> bool:
+        return not (self._dispatched or self._compiled is not None
+                    or self._warm_future is not None)
+
+    def compiling(self) -> bool:
+        """True while a background compile is in flight (cleared when a
+        ``__call__`` joins it)."""
+        return self._warm_future is not None
+
+    def warm_async(self, *args) -> bool:
+        """Lower + compile off the critical path on the daemon pool.  The
+        next ``__call__`` joins the in-flight future, so foreground work
+        never duplicates the compile.  Returns True if a warm was
+        scheduled."""
+        with self._lock:
+            if not self.needs_compile():
+                return False
+
+            def work():
+                t0 = time.perf_counter()
+                compiled = self._fn.lower(*args).compile()
+                dt = time.perf_counter() - t0
+                self._note_compiled(dt, tier="aot")
+                with _LOCK:
+                    _STATS["async_compiles"] += 1
+                return compiled
+
+            self._warm_future = _compile_pool().submit(work)
+            return True
+
+    def _note_compiled(self, dt: float, tier: str) -> None:
+        with _LOCK:
+            _STATS["compiles"] += 1
+            _STATS["compile_s"] += dt
+        from spark_rapids_tpu.aux.events import emit
+        emit("stageCompile", stage_kind=self.kind, key=self.key_hash,
+             duration_s=round(dt, 6), tier=tier,
+             disk_cache=_DISK["dir"] is not None)
+
+    # -- dispatch ------------------------------------------------------------
+    def __call__(self, *args):
+        fut = self._warm_future
+        if fut is not None:
+            try:
+                compiled = fut.result()
+            except Exception as e:  # noqa: BLE001 — AOT is an optimization;
+                compiled = None      # the jit path below is always correct,
+                # but a silently-failing async tier must be visible in
+                # stats(), or async=true degrades to sync with no evidence
+                with _LOCK:
+                    _STATS["async_failures"] += 1
+                    _ASYNC_ERROR[0] = f"{type(e).__name__}: {e}"[:160]
+            with self._lock:
+                self._warm_future = None
+                if compiled is not None:
+                    self._compiled = compiled
+                    self._dispatched = True
+                # on a failed background compile, first-dispatch stays
+                # unclaimed: the fallback jit compile below must be timed
+                # and counted like any cold compile, not happen invisibly
+        if self._compiled is not None:
+            try:
+                return self._compiled(*args)
+            except (TypeError, ValueError):
+                # arg-signature drift only (an int row count where the
+                # lowering saw a device scalar): fall back to the jit
+                # dispatcher, which traces a variant — timed and counted
+                # like any cold compile so it can't leak into steady-
+                # state metrics.  Genuine runtime errors (device OOM...)
+                # must propagate to retry/arbitration, not silently
+                # re-execute the program.
+                self._compiled = None
+                t0 = time.perf_counter()
+                out = self._fn(*args)
+                self._note_compiled(time.perf_counter() - t0, tier="jit")
+                return out
+        first = False
+        if not self._dispatched:
+            # claim first-dispatch under the lock: concurrent partitions
+            # hitting a fresh program must produce ONE compile record
+            with self._lock:
+                if not self._dispatched:
+                    self._dispatched = True
+                    first = True
+        if first:
+            t0 = time.perf_counter()
+            out = self._fn(*args)
+            self._note_compiled(time.perf_counter() - t0, tier="jit")
+            return out
+        return self._fn(*args)
+
+
+def _counting(kind: str, fn: Callable) -> Callable:
+    """Wraps a trace function so every ACTUAL jax trace (including
+    signature-variant retraces inside one jit wrapper) counts."""
+    def traced(*args, **kwargs):
+        with _LOCK:
+            _STATS["traces"] += 1
+            _TRACES_BY_KIND[kind] = _TRACES_BY_KIND.get(kind, 0) + 1
+        return fn(*args, **kwargs)
+    traced.__name__ = getattr(fn, "__name__", "run") + f"[{kind}]"
+    return traced
+
+
+def get_or_build(kind: str, key: Tuple,
+                 build: Callable[[], Callable]) -> StageProgram:
+    """THE lookup every jit site uses.  ``build()`` runs only on a miss
+    and returns the raw python trace function; this helper owns jitting,
+    trace counting, LRU bounding and the program wrapper."""
+    full_key = (kind, key)
+    with _LOCK:
+        prog = _PROGRAMS.get(full_key)
+        if prog is not None:
+            _STATS["hits"] += 1
+            _PROGRAMS.move_to_end(full_key)
+            return prog
+        _STATS["misses"] += 1
+    # build outside the lock: expression tree walks can be slow and must
+    # not serialize unrelated task threads; a racing double-build is
+    # harmless (the FIRST insert wins, the loser's wrapper is discarded,
+    # both programs are correct)
+    import jax
+    prog = StageProgram(kind, full_key, jax.jit(_counting(kind, build())))
+    with _LOCK:
+        existing = _PROGRAMS.get(full_key)
+        if existing is not None:
+            # the race loser's lookup was really a hit: reclassify its
+            # recorded miss so hits+misses stays equal to lookups
+            _STATS["misses"] -= 1
+            _STATS["hits"] += 1
+            return existing
+        _PROGRAMS[full_key] = prog
+        while len(_PROGRAMS) > _MAX_PROGRAMS:
+            _PROGRAMS.popitem(last=False)
+            _STATS["evictions"] += 1
+    return prog
+
+
+# ---------------------------------------------------------------------------
+# stats / maintenance
+# ---------------------------------------------------------------------------
+
+def stats() -> Dict:
+    with _LOCK:
+        out = dict(_STATS)
+        out["programs"] = len(_PROGRAMS)
+        out["max_programs"] = _MAX_PROGRAMS
+        out["traces_by_kind"] = dict(_TRACES_BY_KIND)
+        out["disk_cache_dir"] = _DISK["dir"]
+        out["disk_cache_error"] = _DISK["error"]
+        out["async_error"] = _ASYNC_ERROR[0]
+        return out
+
+
+def reset_stats() -> None:
+    """Zeroes the counters (tests / bench phase boundaries); live
+    programs stay cached."""
+    with _LOCK:
+        for k in _STATS:
+            _STATS[k] = 0.0 if k == "compile_s" else 0
+        _TRACES_BY_KIND.clear()
+        _ASYNC_ERROR[0] = None
+
+
+def clear() -> None:
+    """Drops every cached program (tests; also releases the compiled
+    executables' device handles)."""
+    with _LOCK:
+        _PROGRAMS.clear()
+
+
+def set_max_programs(n: int) -> None:
+    global _MAX_PROGRAMS
+    with _LOCK:
+        _MAX_PROGRAMS = max(1, int(n))
+        while len(_PROGRAMS) > _MAX_PROGRAMS:
+            _PROGRAMS.popitem(last=False)
+            _STATS["evictions"] += 1
+
+
+def set_persistent_cache_dir(path: Optional[str]) -> None:
+    """Tier 2: point JAX's persistent compilation cache at ``path`` so
+    compiled executables survive across queries AND sessions (conf
+    ``spark.rapids.sql.compile.cacheDir``).  Thresholds drop to zero so
+    every stage program persists — on a tunnel-attached TPU even small
+    programs cost a round trip to rebuild.  Empty/None disables."""
+    path = (path or "").strip() or None
+    if path == _DISK["dir"]:
+        return
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        if path is not None:
+            for k, v in (("jax_persistent_cache_min_compile_time_secs", 0),
+                         ("jax_persistent_cache_min_entry_size_bytes", -1)):
+                try:
+                    jax.config.update(k, v)
+                except (AttributeError, ValueError):
+                    pass    # older jax: keep its defaults
+        _DISK["dir"] = path
+        _DISK["error"] = None
+    except Exception as e:  # noqa: BLE001 — the disk tier is optional;
+        # a bad dir must not fail the query path
+        _DISK["error"] = f"{type(e).__name__}: {e}"[:160]

@@ -602,14 +602,14 @@ class TpuWindowExec(CpuWindowExec):
                      for c in win_cols),
                tuple(low.func[:2] for low in self.lowered),
                len(pkeys), out.bucket, carry is None)
-        fn = _FIXUP_CACHE.get(sig)
-        if fn is None:
-            fn = jax.jit(_make_running_fixup(
+        def build():
+            return _make_running_fixup(
                 [c.data_type for c in key_cols], len(pkeys),
                 [low.func for low in self.lowered],
                 [c.data_type for c in win_cols], out.bucket,
-                first=carry is None))
-            _FIXUP_CACHE[sig] = fn
+                first=carry is None)
+        from spark_rapids_tpu.exec.stage_compiler import get_or_build
+        fn = get_or_build("window.running_fixup", sig, build)
         key_arrs = [(c.data, c.validity, c.lengths) for c in key_cols]
         win_arrs = [(c.data, c.validity) for c in win_cols]
         fixed, new_carry = fn(key_arrs, win_arrs,
@@ -620,9 +620,6 @@ class TpuWindowExec(CpuWindowExec):
         for (d, v), c in zip(fixed, win_cols):
             cols.append(DeviceColumn(d, v, n, c.data_type, c.lengths))
         return ColumnarBatch(cols, out.row_count, out.names), new_carry
-
-
-_FIXUP_CACHE: dict = {}
 
 
 def _spark_minmax(agg: str, a, b, jnp, dt):

@@ -46,7 +46,12 @@ def _coerce(c: TCol, dtype: T.DataType, ctx: EvalContext, xp):
             return TCol.scalar(None, dtype)
         v = c.data
         if nd is not None:
-            v = nd.type(v)
+            if hasattr(v, "aval"):
+                # promoted-literal scalar: a traced 0-d array, cast
+                # in-trace (np.type() would force a host conversion)
+                v = v.astype(nd)
+            else:
+                v = nd.type(v)
         return TCol.scalar(v, dtype)
     data = c.data
     if nd is not None and data.dtype != nd:

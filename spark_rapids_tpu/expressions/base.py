@@ -63,7 +63,7 @@ class EvalContext:
     """
 
     __slots__ = ("cols", "backend", "row_count", "lambda_bindings",
-                 "elem_plane")
+                 "elem_plane", "literal_args")
 
     def __init__(self, cols: Sequence[TCol], backend: str, row_count: int):
         self.cols = list(cols)
@@ -73,6 +73,9 @@ class EvalContext:
         #: True while evaluating a lambda body over an [n, w] element plane
         #: (scalars then densify to [n, 1] so they broadcast either way)
         self.elem_plane = False
+        #: runtime values for PromotedLiteral slots (plan/stages.py) when
+        #: evaluating inside a parameterized fused-stage trace
+        self.literal_args = None
 
 
 class Expression:
@@ -499,6 +502,22 @@ def all_valid(cols: Sequence[TCol], ctx: EvalContext):
     return acc
 
 
+def to_physical_scalar(v):
+    """Date/timestamp python objects -> the physical int representation
+    kernels compute on (micros since epoch / days since epoch); any other
+    value passes through.  Shared by ``materialize`` (baked constants) and
+    plan/stages.physical_literal (promoted runtime args) — the two MUST
+    produce identical values or promoted-vs-baked programs diverge."""
+    import datetime as _dt
+    if isinstance(v, _dt.datetime):
+        import calendar
+        return int(calendar.timegm(v.utctimetuple())) * 1_000_000 \
+            + v.microsecond
+    if isinstance(v, _dt.date):
+        return (v - _dt.date(1970, 1, 1)).days
+    return v
+
+
 def materialize(c: TCol, ctx: EvalContext, np_dtype=None) -> Any:
     """Densifies a scalar TCol to a full column when a kernel needs arrays."""
     xp = jnp() if ctx.backend == "tpu" else np
@@ -512,17 +531,9 @@ def materialize(c: TCol, ctx: EvalContext, np_dtype=None) -> Any:
         return xp.zeros(shape, dtype=dt)
     if dt == np.dtype(object):
         return np.full(shape, c.data, dtype=object)
-    v = c.data
-    if dt != np.dtype(object):
-        # date/timestamp literals carry python objects; kernels want the
-        # physical int representation
-        import datetime as _dt
-        if isinstance(v, _dt.datetime):
-            import calendar
-            v = int(calendar.timegm(v.utctimetuple())) * 1_000_000 \
-                + v.microsecond
-        elif isinstance(v, _dt.date):
-            v = (v - _dt.date(1970, 1, 1)).days
+    # date/timestamp literals carry python objects; kernels want the
+    # physical int representation
+    v = to_physical_scalar(c.data)
     return xp.full(shape, v, dtype=dt)
 
 

@@ -594,6 +594,11 @@ def _lambda_ctx(ctx: EvalContext, bindings) -> EvalContext:
     out.lambda_bindings = dict(getattr(ctx, "lambda_bindings", {}))
     out.lambda_bindings.update(bindings)
     out.elem_plane = True
+    # promoted-literal slots (plan/stages.py) must survive into the lambda
+    # body: the compiled program is cached under a value-independent key,
+    # so a dropped binding would bake the FIRST query's constant into a
+    # program later queries share
+    out.literal_args = getattr(ctx, "literal_args", None)
     return out
 
 
@@ -825,6 +830,7 @@ class ArrayAggregate(Expression):
             # no [n, 1] lifting (that is only for the [n, w] element HOFs)
             bctx = EvalContext(ctx.cols, ctx.backend, ctx.row_count)
             bctx.lambda_bindings = {"acc": acc, "x": x}
+            bctx.literal_args = getattr(ctx, "literal_args", None)
             nxt = self.merge.eval(bctx)
             from spark_rapids_tpu.expressions.base import materialize as mat
             nd = mat(nxt, bctx, _elem_np(self.zero.data_type)) \
@@ -838,6 +844,7 @@ class ArrayAggregate(Expression):
             # acc is an ordinary 1-D column: plain bindings, no lifting
             bctx = EvalContext(ctx.cols, ctx.backend, ctx.row_count)
             bctx.lambda_bindings = {"acc": out}
+            bctx.literal_args = getattr(ctx, "literal_args", None)
             out = self.finish.eval(bctx)
         return out
 

@@ -135,9 +135,8 @@ def eval_exprs_cpu(exprs: Sequence[Expression],
 
 # ---------------------------------------------------------------------------
 # TPU evaluation: one jitted XLA program per (plan signature, schema, bucket)
+# (programs live in the process-wide StageCompiler cache, exec/stage_compiler)
 # ---------------------------------------------------------------------------
-
-_JIT_CACHE: Dict[Tuple, object] = {}
 
 
 def _signature(exprs, batch: ColumnarBatch) -> Tuple:
@@ -153,15 +152,14 @@ def _signature(exprs, batch: ColumnarBatch) -> Tuple:
 
 def eval_exprs_tpu(exprs: Sequence[Expression], batch: ColumnarBatch,
                    names: Optional[List[str]] = None) -> ColumnarBatch:
-    import jax
     from spark_rapids_tpu.columnar.column import _jnp
+    from spark_rapids_tpu.exec.stage_compiler import get_or_build
     xp = _jnp()
     key = _signature(exprs, batch)
-    fn = _JIT_CACHE.get(key)
     dtypes = [c.data_type for c in batch.columns]
     bucket = batch.bucket
 
-    if fn is None:
+    def build():
         def run(arrs):
             cols = [TCol(d, v, dt, lengths=ln, elem_valid=ev)
                     for (d, v, ln, ev), dt in zip(arrs, dtypes)]
@@ -173,9 +171,9 @@ def eval_exprs_tpu(exprs: Sequence[Expression], batch: ColumnarBatch,
                 outs.append((dc.data, dc.validity, dc.lengths,
                              dc.elem_valid))
             return outs
+        return run
 
-        fn = jax.jit(run)
-        _JIT_CACHE[key] = fn
+    fn = get_or_build("expr.project", key, build)
 
     arrs = [(c.data, c.validity, c.lengths, c.elem_valid)
             for c in batch.columns]

@@ -280,6 +280,15 @@ class BufferCatalog:
         with self._lock:
             self._require(handle).spillable = spillable
 
+    def disown(self, handle: BufferHandle) -> None:
+        """Transfers device-array ownership back to the caller: any later
+        spill/remove of this buffer drops the catalog's reference instead
+        of deleting the arrays (SpillableColumnarBatch.release unwrap)."""
+        with self._lock:
+            buf = self._buffers.get(handle.id)
+            if buf is not None:
+                buf.owned = False
+
     def remove(self, handle: BufferHandle) -> None:
         freed_device = False
         with self._lock:

@@ -74,6 +74,16 @@ class SpillableColumnarBatch:
     def get_host_batch(self) -> HostColumnarBatch:
         return self._catalog.get_host_batch(self._handle)
 
+    def release(self) -> ColumnarBatch:
+        """Unwraps: returns the live device batch and unregisters WITHOUT
+        deleting its arrays — ownership transfers to the caller.  The
+        disown happens BEFORE materializing so a racing spill can no
+        longer delete the arrays out from under the returned batch."""
+        self._catalog.disown(self._handle)
+        batch = self.get_batch()
+        self.close()
+        return batch
+
     def make_unspillable(self) -> None:
         """Pin while actively computing (reference setSpillable(false))."""
         self._catalog.set_spillable(self._handle, False)

@@ -15,7 +15,7 @@ Reduction kinds (update & merge lower to the same set):
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Sequence, Tuple
 
 import numpy as np
 
@@ -29,7 +29,6 @@ def _jx():
     return _jnp()
 
 
-_AGG_CACHE: Dict[Tuple, object] = {}
 
 
 def _col_sig(c: DeviceColumn) -> Tuple:
@@ -227,13 +226,11 @@ def _global_aggregate(batch: ColumnarBatch,
     """num_keys == 0: no sort, no segments; output planes are tiny
     (bucket 8) so downstream merge/final passes and the result download
     never touch input-sized buffers."""
-    import jax
     jnp = _jx()
     bucket = batch.bucket
     spec_key = tuple((o, k, cv, str(dt)) for o, k, cv, dt in specs)
     key = ("globalagg", tuple(_col_sig(c) for c in batch.columns), spec_key)
-    fn = _AGG_CACHE.get(key)
-    if fn is None:
+    def build():
         dtypes = [c.data_type for c in batch.columns]
 
         def run(arrs, row_count):
@@ -242,8 +239,9 @@ def _global_aggregate(batch: ColumnarBatch,
             sel = jnp.arange(bucket, dtype=np.int32) < row_count
             return global_agg_trace(cols, sel, specs, jnp)
 
-        fn = jax.jit(run)
-        _AGG_CACHE[key] = fn
+        return run
+    from spark_rapids_tpu.exec.stage_compiler import get_or_build
+    fn = get_or_build("agg.global", key, build)
     from spark_rapids_tpu.columnar.column import rc_traceable
     arrs = [(c.data, c.validity, c.lengths) for c in batch.columns]
     outs = fn(arrs, rc_traceable(batch.row_count))
@@ -349,7 +347,6 @@ def segmented_aggregate(batch: ColumnarBatch, num_keys: int,
     The full pipeline (sort, boundaries, reductions) is one jit per
     signature; only the group count syncs to host.
     """
-    import jax
     jnp = _jx()
     from spark_rapids_tpu.ops.sort_ops import SortOrder, sortable_words
     if num_keys == 0:
@@ -358,8 +355,7 @@ def segmented_aggregate(batch: ColumnarBatch, num_keys: int,
     spec_key = tuple((o, k, cv, str(dt)) for o, k, cv, dt in specs)
     key = ("segagg", tuple(_col_sig(c) for c in batch.columns), num_keys,
            spec_key)
-    fn = _AGG_CACHE.get(key)
-    if fn is None:
+    def build():
         # capture only scalars/types, never the batch (module-cache pinning)
         dtypes = [c.data_type for c in batch.columns]
 
@@ -369,8 +365,9 @@ def segmented_aggregate(batch: ColumnarBatch, num_keys: int,
             sel = jnp.arange(bucket, dtype=np.int32) < row_count
             return keyed_agg_trace(cols, sel, num_keys, specs, bucket, jnp)
 
-        fn = jax.jit(run)
-        _AGG_CACHE[key] = fn
+        return run
+    from spark_rapids_tpu.exec.stage_compiler import get_or_build
+    fn = get_or_build("agg.segmented", key, build)
     from spark_rapids_tpu.columnar.column import DeferredCount, rc_traceable
     arrs = [(c.data, c.validity, c.lengths) for c in batch.columns]
     outs, ng = fn(arrs, rc_traceable(batch.row_count))
@@ -528,7 +525,6 @@ def keyed_agg_trace(cols, sel, num_keys, specs, bucket, jnp):
 # [group, max_len] plane)
 # ---------------------------------------------------------------------------
 
-_COLLECT_CACHE: Dict[Tuple, object] = {}
 
 
 def segmented_collect_many(batch: ColumnarBatch, num_keys: int,
@@ -560,8 +556,7 @@ def _collect_phase1(batch: ColumnarBatch, num_keys: int, value_ord: int,
     bucket = batch.bucket
     sig = ("collect1", tuple(_col_sig(c) for c in batch.columns), num_keys,
            value_ord, distinct)
-    fn = _COLLECT_CACHE.get(sig)
-    if fn is None:
+    def build():
         dtypes = [c.data_type for c in batch.columns]
 
         def phase1(arrs, row_count):
@@ -641,15 +636,15 @@ def _collect_phase1(batch: ColumnarBatch, num_keys: int, value_ord: int,
             return (sval.data, kept, seg, pos, lengths, num_groups, maxw,
                     key_outs)
 
-        fn = jax.jit(phase1)
-        _COLLECT_CACHE[sig] = fn
+        return phase1
+    from spark_rapids_tpu.exec.stage_compiler import get_or_build
+    fn = get_or_build("agg.collect_phase1", sig, build)
     arrs = [(c.data, c.validity, c.lengths) for c in batch.columns]
     return fn(arrs, rc_traceable(batch.row_count))
 
 
 def _collect_phase2(batch: ColumnarBatch, num_keys: int, value_ord: int,
                     p1, maxw: int):
-    import jax
     from spark_rapids_tpu.columnar.column import (DeferredCount,
                                                   bucket_strlen)
     jnp = _jx()
@@ -658,8 +653,7 @@ def _collect_phase2(batch: ColumnarBatch, num_keys: int, value_ord: int,
     (svals, kept, seg, pos, lengths, ng, _maxw_d, key_outs) = p1
     W = bucket_strlen(max(maxw, 1))
     sig2 = ("collect2", bucket, W, str(svals.dtype))
-    fn2 = _COLLECT_CACHE.get(sig2)
-    if fn2 is None:
+    def build():
         def phase2(svals, kept, seg, pos, lengths, ng):
             plane = jnp.zeros((bucket, W), dtype=svals.dtype)
             dest_g = jnp.where(kept, seg.astype(np.int64), bucket)
@@ -669,8 +663,9 @@ def _collect_phase2(batch: ColumnarBatch, num_keys: int, value_ord: int,
             gvalid = jnp.arange(bucket) < ng
             return plane, ev, gvalid
 
-        fn2 = jax.jit(phase2)
-        _COLLECT_CACHE[sig2] = fn2
+        return phase2
+    from spark_rapids_tpu.exec.stage_compiler import get_or_build
+    fn2 = get_or_build("agg.collect_phase2", sig2, build)
     plane, ev, gvalid = fn2(svals, kept, seg, pos, lengths, ng)
     n = DeferredCount(ng)
     arr_col = DeviceColumn(plane, gvalid, n,

@@ -12,7 +12,7 @@ Key TPU-first decisions:
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Tuple
+from typing import List, Sequence, Tuple
 
 import numpy as np
 
@@ -28,8 +28,6 @@ def _jx():
     return _jnp()
 
 
-_COMPACT_CACHE: Dict[Tuple, object] = {}
-_CONCAT_CACHE: Dict[Tuple, object] = {}
 
 
 def _col_sig(c: DeviceColumn) -> Tuple:
@@ -73,8 +71,7 @@ def compact_batch(batch: ColumnarBatch, keep) -> ColumnarBatch:
     import jax
     jnp = _jx()
     key = ("compact", tuple(_col_sig(c) for c in batch.columns))
-    fn = _COMPACT_CACHE.get(key)
-    if fn is None:
+    def build():
         def run(arrs, keep):
             n = keep.shape[0]
             cnt = jnp.sum(keep)
@@ -118,8 +115,9 @@ def compact_batch(batch: ColumnarBatch, keep) -> ColumnarBatch:
                 outs.append((nd, nv, nl, ne))
             return outs, cnt
 
-        fn = jax.jit(run)
-        _COMPACT_CACHE[key] = fn
+        return run
+    from spark_rapids_tpu.exec.stage_compiler import get_or_build
+    fn = get_or_build("batch.compact", key, build)
     arrs = [(c.data, c.validity, c.lengths, c.elem_valid)
             for c in batch.columns]
     outs, cnt = fn(arrs, keep)
@@ -193,7 +191,6 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
         batches = kept or batches[:1]
     if len(batches) == 1:
         return batches[0]
-    import jax
     jnp = _jx()
     if any(isinstance(b.row_count, DeferredCount) and not b.row_count.is_forced
            for b in batches):
@@ -221,8 +218,7 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
         widths.append(w)
     key = ("concat", out_bucket,
            tuple(tuple(_col_sig(c) for c in b.columns) for b in batches))
-    fn = _CONCAT_CACHE.get(key)
-    if fn is None:
+    def build():
         def run(all_arrs, counts_arr):
             offsets = jnp.cumsum(counts_arr) - counts_arr
             outs = []
@@ -259,8 +255,9 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
                 outs.append((acc_d, acc_v, acc_l, acc_e))
             return outs
 
-        fn = jax.jit(run)
-        _CONCAT_CACHE[key] = fn
+        return run
+    from spark_rapids_tpu.exec.stage_compiler import get_or_build
+    fn = get_or_build("batch.concat", key, build)
     counts_arr = jnp.stack([jnp.asarray(rc_traceable(b.row_count),
                                         dtype=np.int64) for b in batches])
     all_arrs = [[(c.data, c.validity, c.lengths, c.elem_valid)

@@ -29,7 +29,7 @@ sortable-word normalization gives this for free, sort_ops.py).
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -120,11 +120,6 @@ class BuiltSide:
     widths: List[int]             # string word widths agreed with probe side
 
 
-_BUILD_CACHE: Dict[Tuple, object] = {}
-_PROBE_CACHE: Dict[Tuple, object] = {}
-_PAIR_CACHE: Dict[Tuple, object] = {}
-_FINAL_CACHE: Dict[Tuple, object] = {}
-_GATHER_CACHE: Dict[Tuple, object] = {}
 
 
 def build_side(batch: ColumnarBatch, key_ordinals: Sequence[int],
@@ -137,8 +132,7 @@ def build_side(batch: ColumnarBatch, key_ordinals: Sequence[int],
     widths = [max(_n_value_words(b), _n_value_words(p))
               for b, p in zip(kcols, probe_key_cols)]
     key = ("build", tuple(_col_sig(c) for c in kcols), tuple(widths))
-    fn = _BUILD_CACHE.get(key)
-    if fn is None:
+    def build():
         bucket = kcols[0].bucket if kcols else batch.bucket
         dtypes = [c.data_type for c in kcols]
 
@@ -151,8 +145,9 @@ def build_side(batch: ColumnarBatch, key_ordinals: Sequence[int],
             hs, perm = jax.lax.sort((h, rowpos), num_keys=1, is_stable=True)
             return hs, perm
 
-        fn = jax.jit(run)
-        _BUILD_CACHE[key] = fn
+        return run
+    from spark_rapids_tpu.exec.stage_compiler import get_or_build
+    fn = get_or_build("join.build", key, build)
     from spark_rapids_tpu.columnar.column import rc_traceable
     arrs = [(c.data, c.validity, c.lengths) for c in kcols]
     hs, perm = fn(arrs, rc_traceable(batch.row_count))
@@ -162,12 +157,10 @@ def build_side(batch: ColumnarBatch, key_ordinals: Sequence[int],
 def _probe_ranges(probe_keys: List[DeviceColumn], built: BuiltSide):
     """Per-probe-row candidate range in the sorted build hashes.
     Returns (lo, counts, offsets, total) — total is the one host sync."""
-    import jax
     jnp = _jx()
     key = ("probe", tuple(_col_sig(c) for c in probe_keys),
            built.hashes_sorted.shape, tuple(built.widths))
-    fn = _PROBE_CACHE.get(key)
-    if fn is None:
+    def build():
         bucket = probe_keys[0].bucket
         dtypes = [c.data_type for c in probe_keys]
         widths = built.widths
@@ -185,8 +178,9 @@ def _probe_ranges(probe_keys: List[DeviceColumn], built: BuiltSide):
             offsets = jnp.cumsum(counts) - counts
             return lo, counts, offsets, jnp.sum(counts)
 
-        fn = jax.jit(run)
-        _PROBE_CACHE[key] = fn
+        return run
+    from spark_rapids_tpu.exec.stage_compiler import get_or_build
+    fn = get_or_build("join.probe", key, build)
     arrs = [(c.data, c.validity, c.lengths) for c in probe_keys]
     from spark_rapids_tpu.columnar.column import rc_traceable
     lo, counts, offsets, total = fn(arrs, rc_traceable(probe_keys[0].row_count),
@@ -201,14 +195,12 @@ def _expand_verify(probe: ColumnarBatch, probe_ordinals, built: BuiltSide,
     equality.  Returns (l_idx, r_idx, keep, pair_bucket).  ``total`` may be
     a 0-d device scalar (speculative sizing: caller picked ``out_bucket``
     and tracks overflow via ops/speculation.py) or a host int (exact)."""
-    import jax
     jnp = _jx()
     pkeys = [probe.columns[i] for i in probe_ordinals]
     bkeys = [built.batch.columns[i] for i in built.key_ordinals]
     key = ("pairs", out_bucket, tuple(_col_sig(c) for c in pkeys),
            tuple(_col_sig(c) for c in bkeys), null_safe, tuple(built.widths))
-    fn = _PAIR_CACHE.get(key)
-    if fn is None:
+    def build():
         p_bucket = probe.bucket
         b_bucket = built.batch.bucket
         pdt = [c.data_type for c in pkeys]
@@ -245,8 +237,9 @@ def _expand_verify(probe: ColumnarBatch, probe_ordinals, built: BuiltSide,
                 keep = keep & eq
             return p, b, keep
 
-        fn = jax.jit(run)
-        _PAIR_CACHE[key] = fn
+        return run
+    from spark_rapids_tpu.exec.stage_compiler import get_or_build
+    fn = get_or_build("join.pair", key, build)
     parrs = [(c.data, c.validity, c.lengths) for c in pkeys]
     barrs = [(c.data, c.validity, c.lengths) for c in bkeys]
     from spark_rapids_tpu.columnar.column import rc_traceable as _rt
@@ -258,14 +251,12 @@ def _expand_verify(probe: ColumnarBatch, probe_ordinals, built: BuiltSide,
 def cross_pairs(probe: ColumnarBatch, build: ColumnarBatch):
     """Candidate set for nested-loop joins: full cartesian product.
     Returns (l_idx, r_idx, keep, pair_bucket)."""
-    import jax
     jnp = _jx()
     from spark_rapids_tpu.columnar.column import rc_traceable
     total = int(probe.row_count) * int(build.row_count)
     out_bucket = bucket_rows(max(total, 1))
     key = ("cross", out_bucket)
-    fn = _PAIR_CACHE.get(key)
-    if fn is None:
+    def build_fn():
         def run(total, b_count):
             r = jnp.arange(out_bucket, dtype=np.int64)
             bc = jnp.maximum(b_count, 1)
@@ -274,25 +265,25 @@ def cross_pairs(probe: ColumnarBatch, build: ColumnarBatch):
             keep = r < total
             return p, b, keep
 
-        fn = jax.jit(run)
-        _PAIR_CACHE[key] = fn
+        return run
+    from spark_rapids_tpu.exec.stage_compiler import get_or_build
+    fn = get_or_build("join.cross_pairs", key, build_fn)
     l_idx, r_idx, keep = fn(total, rc_traceable(build.row_count))
     return l_idx, r_idx, keep, out_bucket
 
 
 def matched_flags(idx, keep, side_bucket: int):
     """Per-row "has >= 1 kept pair" flags (semi/anti/outer bookkeeping)."""
-    import jax
     jnp = _jx()
     key = ("flags", int(idx.shape[0]), side_bucket)
-    fn = _FINAL_CACHE.get(key)
-    if fn is None:
+    def build():
         def run(idx, keep):
             safe = jnp.clip(idx, 0, side_bucket - 1)
             return jnp.zeros(side_bucket, dtype=bool).at[safe].max(keep)
 
-        fn = jax.jit(run)
-        _FINAL_CACHE[key] = fn
+        return run
+    from spark_rapids_tpu.exec.stage_compiler import get_or_build
+    fn = get_or_build("join.matched_flags", key, build)
     return fn(idx, keep)
 
 
@@ -303,19 +294,18 @@ def compact_pairs(l_idx, r_idx, keep):
     host round trip per probe batch (the dominant latency on a
     tunnel-attached chip); consumers size their output by the pair bucket
     (static) and mask by the deferred count instead."""
-    import jax
     from spark_rapids_tpu.columnar.column import DeferredCount
     jnp = _jx()
     key = ("cpairs", int(l_idx.shape[0]))
-    fn = _FINAL_CACHE.get(key)
-    if fn is None:
+    def build():
         def run(l_idx, r_idx, keep):
             order = jnp.argsort(~keep, stable=True)
             return (jnp.take(l_idx, order), jnp.take(r_idx, order),
                     jnp.sum(keep))
 
-        fn = jax.jit(run)
-        _FINAL_CACHE[key] = fn
+        return run
+    from spark_rapids_tpu.exec.stage_compiler import get_or_build
+    fn = get_or_build("join.compact_pairs", key, build)
     l, r, n = fn(l_idx, r_idx, keep)
     return l, r, DeferredCount(n)
 
@@ -323,21 +313,20 @@ def compact_pairs(l_idx, r_idx, keep):
 def unmatched_positions(flags, row_count: int):
     """Row positions with no kept match, compacted; returns
     (idx, DeferredCount) — no host sync (see compact_pairs)."""
-    import jax
     from spark_rapids_tpu.columnar.column import DeferredCount
     jnp = _jx()
     bucket = int(flags.shape[0])
     key = ("unmatched", bucket)
-    fn = _FINAL_CACHE.get(key)
-    if fn is None:
+    def build():
         def run(flags, row_count):
             rowpos = jnp.arange(bucket, dtype=np.int64)
             want = (~flags) & (rowpos < row_count)
             order = jnp.argsort(~want, stable=True)
             return jnp.take(rowpos, order), jnp.sum(want)
 
-        fn = jax.jit(run)
-        _FINAL_CACHE[key] = fn
+        return run
+    from spark_rapids_tpu.exec.stage_compiler import get_or_build
+    fn = get_or_build("join.unmatched", key, build)
     from spark_rapids_tpu.columnar.column import rc_traceable as _rt2
     idx, n = fn(flags, _rt2(row_count))
     return idx, DeferredCount(n)
@@ -354,7 +343,6 @@ def gather_join_output(probe: ColumnarBatch, build: ColumnarBatch,
     either map may be ``None``, meaning "all null rows for that side"
     (the constant -1 map is generated inside the program — shipping a
     bucket-sized host constant would cost a real transfer)."""
-    import jax
     from spark_rapids_tpu.columnar.column import (DeferredCount,
                                                   rc_traceable)
     jnp = _jx()
@@ -375,8 +363,7 @@ def gather_join_output(probe: ColumnarBatch, build: ColumnarBatch,
            l_map is None, r_map is None,
            tuple(_col_sig(c) for c in probe.columns),
            tuple(_col_sig(c) for c in build.columns))
-    fn = _GATHER_CACHE.get(key)
-    if fn is None:
+    def build_fn():
         p_bucket, b_bucket = probe.bucket, build.bucket
         no_l, no_r = l_map is None, r_map is None
 
@@ -404,8 +391,9 @@ def gather_join_output(probe: ColumnarBatch, build: ColumnarBatch,
                 outs.append((nd, nv, nl, ne))
             return outs
 
-        fn = jax.jit(run, static_argnames=())
-        _GATHER_CACHE[key] = fn
+        return run
+    from spark_rapids_tpu.exec.stage_compiler import get_or_build
+    fn = get_or_build("join.gather", key, build_fn)
     parrs = [(c.data, c.validity, c.lengths, c.elem_valid)
              for c in probe.columns]
     barrs = [(c.data, c.validity, c.lengths, c.elem_valid)
@@ -438,8 +426,7 @@ def concat_matched_unmatched(l, r, n, ul, un):
     b1, b2 = int(l.shape[0]), int(ul.shape[0])
     out_bucket = bucket_rows(max(b1 + b2, 1))
     key = ("concat_mu", b1, b2)
-    fn = _FINAL_CACHE.get(key)
-    if fn is None:
+    def build():
         def run(l, r, n, ul, un):
             lmap = jnp.full(out_bucket, -1, dtype=np.int64)
             rmap = jnp.full(out_bucket, -1, dtype=np.int64)
@@ -453,8 +440,9 @@ def concat_matched_unmatched(l, r, n, ul, un):
                 rmap, jnp.full(b2, -1, dtype=np.int64),
                 (n.astype(np.int64),))
             return lmap, rmap, n + un
-        fn = jax.jit(run)
-        _FINAL_CACHE[key] = fn
+        return run
+    from spark_rapids_tpu.exec.stage_compiler import get_or_build
+    fn = get_or_build("join.concat_maps", key, build)
     jnp_n = jnp.asarray(rc_traceable(n), dtype=np.int64)
     jnp_un = jnp.asarray(rc_traceable(un), dtype=np.int64)
     lmap, rmap, total = fn(l, r, jnp_n, ul, jnp_un)

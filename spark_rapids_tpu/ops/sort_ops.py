@@ -24,7 +24,7 @@ Normalization rules:
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -196,7 +196,6 @@ def host_order_words(col, order: SortOrder,
     return words
 
 
-_SORT_CACHE: Dict[Tuple, object] = {}
 
 
 def _col_sig(c: DeviceColumn) -> Tuple:
@@ -210,8 +209,7 @@ def sort_permutation(batch: ColumnarBatch, orders: Sequence[SortOrder]):
     jnp = _jx()
     orders = tuple(orders)
     key = ("perm", tuple(_col_sig(c) for c in batch.columns), orders)
-    fn = _SORT_CACHE.get(key)
-    if fn is None:
+    def build():
         bucket = batch.bucket
         # capture only scalars/types, never the batch itself: the jitted
         # closure lives in the module cache and would pin device buffers
@@ -228,14 +226,84 @@ def sort_permutation(batch: ColumnarBatch, orders: Sequence[SortOrder]):
                                num_keys=len(words), is_stable=True)
             return out[-1]
 
-        fn = jax.jit(run)
-        _SORT_CACHE[key] = fn
+        return run
+    from spark_rapids_tpu.exec.stage_compiler import get_or_build
+    fn = get_or_build("sort.perm", key, build)
     from spark_rapids_tpu.columnar.column import rc_traceable
     arrs = [(c.data, c.validity, c.lengths) for c in batch.columns]
     return fn(arrs, rc_traceable(batch.row_count))
 
 
+def sort_gather_batch(batch: ColumnarBatch, orders: Sequence[SortOrder],
+                      key_exprs: Sequence = ()) -> ColumnarBatch:
+    """Fused sort-key prep + permutation + payload gather: ONE compiled
+    program.  ``key_exprs`` are non-reference sort keys evaluated
+    IN-TRACE (ordinals past the payload width address them), so an
+    expression sort pays zero extra dispatches — previously key
+    projection, permutation and gather were three programs (the gather
+    even dispatched per column).  The payload keeps the input layout;
+    key columns never materialize in HBM."""
+    import jax
+    jnp = _jx()
+    orders = tuple(orders)
+    key_exprs = list(key_exprs or ())
+    key = ("sortgather", tuple(_col_sig(c) for c in batch.columns),
+           tuple((c.elem_valid is not None) for c in batch.columns),
+           orders, tuple((e.sql(), str(e.data_type)) for e in key_exprs),
+           batch.bucket)
+
+    def build():
+        bucket = batch.bucket
+        dtypes = [c.data_type for c in batch.columns]
+        exprs = list(key_exprs)
+
+        def run(arrs, row_count):
+            from spark_rapids_tpu.expressions.base import EvalContext, TCol
+            from spark_rapids_tpu.expressions.evaluator import \
+                tcol_to_device_column
+            cols = [DeviceColumn(d, v, bucket, dtypes[i], ln, ev)
+                    for i, (d, v, ln, ev) in enumerate(arrs)]
+            keycols = list(cols)
+            if exprs:
+                tcols = [TCol(c.data, c.validity, c.data_type,
+                              lengths=c.lengths, elem_valid=c.elem_valid)
+                         for c in cols]
+                ctx = EvalContext(tcols, "tpu", bucket)
+                for e in exprs:
+                    dc = tcol_to_device_column(e.eval_tpu(ctx), 0, bucket,
+                                               jnp)
+                    keycols.append(DeviceColumn(dc.data, dc.validity,
+                                                bucket, e.data_type,
+                                                dc.lengths))
+            rowpos = jnp.arange(bucket, dtype=np.int32)
+            words = [(rowpos >= row_count).astype(np.int8)]  # padding last
+            for o in orders:
+                words.extend(_order_words(keycols[o.ordinal], o, jnp))
+            perm = jax.lax.sort(tuple(words) + (rowpos,),
+                                num_keys=len(words), is_stable=True)[-1]
+            outs = []
+            for c in cols:
+                d = jnp.take(c.data, perm, axis=0)
+                v = jnp.take(c.validity, perm, axis=0)
+                ln = None if c.lengths is None else \
+                    jnp.take(c.lengths, perm, axis=0)
+                ev = None if c.elem_valid is None else \
+                    jnp.take(c.elem_valid, perm, axis=0)
+                outs.append((d, v, ln, ev))
+            return outs
+
+        return run
+
+    from spark_rapids_tpu.exec.stage_compiler import get_or_build
+    fn = get_or_build("sort.fused", key, build)
+    from spark_rapids_tpu.columnar.column import rc_traceable
+    arrs = [(c.data, c.validity, c.lengths, c.elem_valid)
+            for c in batch.columns]
+    outs = fn(arrs, rc_traceable(batch.row_count))
+    cols = [DeviceColumn(d, v, batch.row_count, c.data_type, ln, ev)
+            for (d, v, ln, ev), c in zip(outs, batch.columns)]
+    return ColumnarBatch(cols, batch.row_count, batch.names)
+
+
 def sort_batch(batch: ColumnarBatch, orders: Sequence[SortOrder]) -> ColumnarBatch:
-    from spark_rapids_tpu.ops.batch_ops import gather_batch
-    perm = sort_permutation(batch, orders)
-    return gather_batch(batch, perm, batch.row_count)
+    return sort_gather_batch(batch, orders)

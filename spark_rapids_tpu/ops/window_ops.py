@@ -30,7 +30,7 @@ Window column specs (``funcs``) are tuples:
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -47,7 +47,6 @@ def _jx():
     return _jnp()
 
 
-_WINDOW_CACHE: Dict[Tuple, object] = {}
 
 
 def _col_sig(c: DeviceColumn) -> Tuple:
@@ -93,9 +92,9 @@ def compute_windows(batch: ColumnarBatch, num_payload: int, num_pkeys: int,
     funcs = tuple(tuple(f) for f in funcs)
     key = ("window", tuple(_col_sig(c) for c in batch.columns), num_payload,
            num_pkeys, tuple(order_specs), funcs)
-    fn = _WINDOW_CACHE.get(key)
     pk_range = range(num_payload, num_payload + num_pkeys)
-    if fn is None:
+
+    def build():
         dtypes = [c.data_type for c in batch.columns]
         orders = [SortOrder(i, True, True) for i in pk_range] + \
             [SortOrder(o, a, nf) for o, a, nf in order_specs]
@@ -153,8 +152,9 @@ def compute_windows(batch: ColumnarBatch, num_payload: int, num_pkeys: int,
                        for c in scols[:num_payload]]
             return payload, outs
 
-        fn = jax.jit(run)
-        _WINDOW_CACHE[key] = fn
+        return run
+    from spark_rapids_tpu.exec.stage_compiler import get_or_build
+    fn = get_or_build("window.frame", key, build)
     from spark_rapids_tpu.columnar.column import rc_traceable
     arrs = [(c.data, c.validity, c.lengths) for c in batch.columns]
     payload, outs = fn(arrs, rc_traceable(batch.row_count))
@@ -173,7 +173,6 @@ def compute_windows(batch: ColumnarBatch, num_payload: int, num_pkeys: int,
 def _one_func(f, scols, jnp, rowpos, inrow, seg, sfp, slp, pfp, plp,
               bucket, row_count):
     """One window output column -> (data, valid, lengths)."""
-    import jax
     kind = f[0]
     if kind == "row_number":
         return ((rowpos - sfp + 1).astype(np.int32), inrow, None)

@@ -27,7 +27,7 @@ one entry per device).
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Sequence
 
 import numpy as np
 
@@ -39,9 +39,6 @@ from spark_rapids_tpu.parallel.mesh import MeshContext
 def _jx():
     from spark_rapids_tpu.columnar.column import _jnp
     return _jnp()
-
-
-_SHUFFLE_CACHE: Dict[Tuple, object] = {}
 
 
 def shard_batch(ctx: MeshContext, host_batches: Sequence[HostColumnarBatch]):
@@ -187,9 +184,9 @@ def collective_hash_shuffle(ctx: MeshContext, cols, counts, pids):
     sig = tuple((str(d.dtype), tuple(d.shape), ln is not None)
                 for d, v, ln in cols)
     mesh_key = tuple(d.id for d in ctx.mesh.devices.flat)
-    key = ("cshuffle", mesh_key, n, B, sig)
-    fn = _SHUFFLE_CACHE.get(key)
-    if fn is None:
+    key = (mesh_key, n, B, sig)
+
+    def build():
         axis = ctx.data_axis
 
         def per_device(arrs, count, pids):
@@ -246,15 +243,19 @@ def collective_hash_shuffle(ctx: MeshContext, cols, counts, pids):
         def build_specs(template, spec):
             return jax.tree_util.tree_map(lambda _: spec, template)
 
-        sm = shard_map(per_device, mesh=ctx.mesh,
-                       in_specs=(build_specs([tuple(c) for c in cols],
-                                             P(axis)),
-                                 P(axis), P(axis)),
-                       out_specs=(build_specs([tuple(c) for c in cols],
-                                              P(axis)), P(axis)),
-                       check_rep=False)
-        fn = jax.jit(sm)
-        _SHUFFLE_CACHE[key] = fn
+        return shard_map(per_device, mesh=ctx.mesh,
+                         in_specs=(build_specs([tuple(c) for c in cols],
+                                               P(axis)),
+                                   P(axis), P(axis)),
+                         out_specs=(build_specs([tuple(c) for c in cols],
+                                                P(axis)), P(axis)),
+                         check_rep=False)
+
+    # memoized by (mesh, devices, bucket, schema shapes) in the shared
+    # executable cache: a fresh jax.jit here re-traced the whole SPMD
+    # shuffle program on EVERY collective exchange
+    from spark_rapids_tpu.exec.stage_compiler import get_or_build
+    prog = get_or_build("parallel.collective_shuffle", key, build)
     arrs = [tuple(c) for c in cols]
-    out, new_counts = fn(arrs, counts, pids)
+    out, new_counts = prog(arrs, counts, pids)
     return [tuple(o) for o in out], new_counts

@@ -328,59 +328,9 @@ def insert_transitions(plan: Exec, conf: TpuConf) -> Exec:
     return out
 
 
-def fuse_device_stages(plan: Exec) -> Exec:
-    """Whole-stage fusion pass: collapse maximal chains of device narrow
-    ops (Filter/Project) — and, when they feed a hash aggregate, the
-    aggregate's update pass — into ONE jitted XLA program (exec/fused.py).
-    The reference cannot do this — cuDF dispatches one kernel per operator;
-    XLA's tracing model makes cross-operator fusion a plan rewrite."""
-    from spark_rapids_tpu.exec.aggregate import (FINAL, TpuHashAggregateExec)
-    from spark_rapids_tpu.exec.basic import (TpuFilterExec,
-                                             TpuFilterProjectExec,
-                                             TpuProjectExec)
-    from spark_rapids_tpu.exec.fused import (TpuFusedAggExec,
-                                             TpuFusedStageExec)
-
-    def chain_of(node: Exec):
-        """Descends through fusable narrow ops; returns (ops top-down ->
-        bottom-up reversed, base child)."""
-        ops = []
-        cur = node
-        while True:
-            if isinstance(cur, TpuFilterExec):
-                ops.append(("filter", cur.condition))
-                cur = cur.children[0]
-            elif isinstance(cur, TpuProjectExec):
-                ops.append(("project", cur.exprs))
-                cur = cur.children[0]
-            elif isinstance(cur, TpuFilterProjectExec):
-                ops.append(("project", cur.exprs))
-                ops.append(("filter", cur.condition))
-                cur = cur.children[0]
-            elif isinstance(cur, TpuFusedStageExec):
-                ops.extend(reversed(cur.ops))
-                cur = cur.children[0]
-            else:
-                return list(reversed(ops)), cur
-
-    def fix(node: Exec) -> Exec:
-        if isinstance(node, TpuHashAggregateExec) and node.mode != FINAL \
-                and not node._has_collect():
-            # variable-length (collect) buffers run the dedicated
-            # segmented_collect path in the exec, not the fused kernel
-            ops, base = chain_of(node.children[0])
-            lay = node.layout
-            return TpuFusedAggExec(ops, lay, node.mode, base)
-        if isinstance(node, (TpuFilterExec, TpuProjectExec,
-                             TpuFilterProjectExec)):
-            ops, base = chain_of(node)
-            # fuse whenever it saves a dispatch: any filter (eager predicate
-            # + separate compact otherwise) or a multi-op chain
-            if len(ops) >= 2 or any(k == "filter" for k, _ in ops):
-                return TpuFusedStageExec(ops, base)
-        return node
-
-    return plan.transform_up(fix)
+# whole-stage fusion moved to its own planner module (plan/stages.py);
+# re-exported here for existing callers
+from spark_rapids_tpu.plan.stages import fuse_device_stages  # noqa: E402,F401
 
 
 def push_scan_predicates(plan: Exec) -> Exec:
@@ -442,10 +392,14 @@ def reuse_exchanges(plan: Exec) -> Exec:
             return ("file", type(node).__name__,
                     node._scan_cache_key(-1, "reuse"))
         if isinstance(node, TpuFusedStageExec):
-            return ("fstage", _ops_signature(node.ops))
+            # literal promotion makes _ops_signature value-independent;
+            # plan identity must still include the VALUES or an exchange
+            # over "d_year = 1998" would merge with one over 1999
+            return ("fstage", _ops_signature(node.ops), node.lit_key())
         if isinstance(node, TpuFusedAggExec):
             lay = node.layout
-            return ("fagg", _ops_signature(node.ops), node.mode,
+            return ("fagg", _ops_signature(node.ops), node.lit_key(),
+                    node.mode,
                     tuple((e.sql(), str(e.data_type))
                           for e in lay.update_input_exprs()),
                     tuple((o, k, cv, str(dt))
@@ -590,6 +544,24 @@ class TpuOverrides:
         _ARB.ARBITRATION_ENABLED = conf.get(
             C.MEMORY_ARBITRATION_ENABLED.key)
         _ARB.MAX_BLOCK_MS = conf.get(C.MEMORY_ARBITRATION_MAX_BLOCK_MS.key)
+        # stage compiler (exec/stage_compiler.py + plan/stages.py):
+        # executable-cache bound, persistent disk tier, background
+        # compile, and the fusion/promotion planner knobs
+        import spark_rapids_tpu.exec.stage_compiler as _SC
+        import spark_rapids_tpu.plan.stages as _ST
+        # async/maxPrograms are session-scoped (last apply wins — tested
+        # in test_async_compile_bit_identical_and_warms): an interleaved
+        # default-conf session reverting them costs at most latency or a
+        # recompile.  cacheDir below is the exception (enable-only):
+        # dropping the disk tier mid-process is expensive + irreversible.
+        _SC.ASYNC_COMPILE = conf.get(C.COMPILE_ASYNC.key)
+        _SC.set_max_programs(conf.get(C.COMPILE_MAX_PROGRAMS.key))
+        # ENABLE-only (scan-cache discipline): an interleaved default-conf
+        # session must not drop another session's disk tier; explicit
+        # disable is stage_compiler.set_persistent_cache_dir("")
+        if conf.get(C.COMPILE_CACHE_DIR.key):
+            _SC.set_persistent_cache_dir(conf.get(C.COMPILE_CACHE_DIR.key))
+        _ST.LITERAL_PROMOTION = conf.get(C.COMPILE_LITERAL_PROMOTION.key)
         # ENABLE-only: benchmark setups interleave an enabled session
         # with a default-conf sanity session, whose every plan compile
         # would otherwise wipe the cache mid-run; releasing the process-
@@ -626,7 +598,8 @@ class TpuOverrides:
             return plan
         out = insert_transitions(converted, conf)
         out = self._coalesce_after_device_sources(out)
-        out = fuse_device_stages(out)
+        if conf.get(C.STAGE_FUSION_ENABLED.key):
+            out = fuse_device_stages(out)
         if conf.get(C.EXCHANGE_REUSE_ENABLED.key):
             out = reuse_exchanges(out)
         if conf.get(C.ADAPTIVE_COALESCE_ENABLED.key):

@@ -32,6 +32,11 @@ Rules (see docs/tools.md for the full semantics):
    ``spark.rapids.sql.concurrentGpuTasks`` (or, already at 1, raise
    ``spark.rapids.memory.gpu.allocFraction``) so tasks stop winning
    memory only through forced-split arbitration.
+7. **cold-compile dominated** → set
+   ``spark.rapids.sql.compile.cacheDir``: repeated ``stageCompile``
+   events without the persistent disk tier mean every session (and
+   every evicted program) pays full XLA compilation again; the on-disk
+   cache turns those into loads.
 
 Thresholds are fractions of query wall time; rules stay silent without
 their evidence, and rules 2 and 4 are mutually exclusive by
@@ -51,6 +56,9 @@ STALL_FRACTION = 0.15
 SPILL_FRACTION = 0.05
 RECOVERY_FRACTION = 0.05
 SEMAPHORE_FRACTION = 0.25
+COMPILE_FRACTION = 0.20
+#: default suggestion for rule 7 (any writable path works)
+COMPILE_CACHE_DIR_SUGGESTION = "/tmp/spark-rapids-tpu-xla-cache"
 
 
 @dataclasses.dataclass
@@ -227,6 +235,33 @@ def autotune_query(profile: QueryProfile,
                     "concurrentGpuTasks=1: a single task cannot fit its "
                     "working set — give the pool more of HBM",
                     ev, qid))
+
+    # rule 7: cold compiles dominate and no persistent cache -> cacheDir.
+    # "Cold" = events without a disk tier behind them; with cacheDir set
+    # the same events are disk loads and the rule stays silent.
+    compile_evs = [e for e in profile.events_of("stageCompile")
+                   if not e.payload.get("disk_cache")]
+    compile_s = sum(float(e.payload.get("duration_s", 0.0) or 0.0)
+                    for e in compile_evs)
+    cache_dir = str(_conf_value(
+        profile, "spark.rapids.sql.compile.cacheDir") or "")
+    if compile_evs and not cache_dir and \
+            compile_s / wall >= COMPILE_FRACTION:
+        recs.append(Recommendation(
+            "spark.rapids.sql.compile.cacheDir", "",
+            COMPILE_CACHE_DIR_SUGGESTION,
+            f"{len(compile_evs)} stage compile(s) burned {compile_s:.3f}s "
+            f"({compile_s / wall * 100:.0f}% of wall) with no persistent "
+            "compilation cache — a cacheDir turns repeat compiles across "
+            "sessions into disk loads",
+            _cite(sorted(compile_evs,
+                         key=lambda e: -float(
+                             e.payload.get("duration_s", 0) or 0)),
+                  lambda e:
+                  f"stageCompile kind={e.payload.get('stage_kind')} "
+                  f"duration_s={e.payload.get('duration_s')} "
+                  f"tier={e.payload.get('tier')}"),
+            qid))
 
     # rule 5: observability truncation -> bigger ring
     dropped = int((profile.summary or {}).get("events_dropped", 0) or 0)

@@ -37,7 +37,7 @@ from spark_rapids_tpu.tools.reader import (QueryProfile, ReadDiagnostics,
 #: decomposition buckets, render order
 BUCKETS = ("decode", "h2d", "compute", "d2h", "shuffle",
            "producer_stall", "consumer_stall", "spill", "recovery",
-           "semaphore", "arbitration", "other")
+           "semaphore", "arbitration", "compile", "other")
 
 _DECODE_MARKERS = ("Scan", "Range", "InMemory", "Csv", "Parquet", "Json",
                    "Orc", "Avro", "Hive", "Text", "Cached")
@@ -139,6 +139,11 @@ def attribute(profile: QueryProfile) -> Attribution:
                 ev.payload.get("producer_stall_s", 0.0) or 0.0)
             raw["consumer_stall"] += float(
                 ev.payload.get("consumer_stall_s", 0.0) or 0.0)
+    # stage compilation (stageCompile events carry measured trace+compile
+    # durations; they overlap the owning operator's opTime like every
+    # other resource — the proportional scaling below reconciles them)
+    for ev in profile.events_of("stageCompile"):
+        raw["compile"] += float(ev.payload.get("duration_s", 0.0) or 0.0)
     for ev in profile.events_of("spill", "unspill"):
         raw["spill"] += float(ev.payload.get("duration_s", 0.0) or 0.0)
     for ev in profile.events_of("fetchRetry"):
