@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Pre-merge / CI gate: static engine-invariant lint, then the smoke test
+# tier.  Mirrors what tier-1 enforces (tests/test_lint.py runs the same
+# linter as its gate test) but fails in seconds instead of minutes.
+#
+#   scripts/check.sh            # lint + smoke tests
+#   scripts/check.sh --lint-only
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== 1/2 engine invariant lint =="
+python -m spark_rapids_tpu.tools lint
+
+if [[ "${1:-}" == "--lint-only" ]]; then
+    exit 0
+fi
+
+echo "== 2/2 smoke test tier =="
+python -m pytest tests/ -q -m smoke -p no:cacheprovider

@@ -77,6 +77,8 @@ EVENT_KINDS = frozenset({
     "collectiveFallback",
     # chaos / resilience (aux/faults.py)
     "faultInjected", "breakerTrip",
+    # runtime lock-order validator (aux/lockorder.py)
+    "lockOrderViolation",
     # live resource sampler (aux/sampler.py)
     "resourceSample",
 })
@@ -491,6 +493,10 @@ def render_prometheus() -> str:
         "Hung-query watchdog thread-state dumps")
     add("events_ring_dropped_total", "counter", ring_dropped_total(),
         "Events dropped by bounded ring-buffer sinks (truncation marker)")
+    from spark_rapids_tpu.aux import lockorder as _lo
+    add("lock_order_violations_total", "counter", _lo.violations_total(),
+        "Lock acquisitions that went backward against the canonical "
+        "order (spark.rapids.debug.lockOrder validator; 0 when disarmed)")
     from spark_rapids_tpu.exec import stage_compiler as _sc
     scs = _sc.stats()
     add("stage_programs", "gauge", scs["programs"],

@@ -1,0 +1,274 @@
+"""Runtime lock-order validator for the engine's concurrency core.
+
+The engine's four process-wide synchronization primitives form a strict
+acquisition hierarchy (``CANONICAL_LOCK_ORDER``): a thread holding a lock
+may only acquire locks *later* in the order.  The discipline is what
+keeps the arbiter/semaphore/catalog/spool interplay deadlock-free (the
+arbiter is the innermost rendezvous everything signals into; it never
+calls back out — memory/arbiter.py "the arbiter never calls back into
+the caller", memory/catalog.py "lock order catalog -> arbiter,
+one-directional").
+
+Two enforcement layers share the declaration in this module:
+
+- **static**: ``tools/lint``'s ``lock-order`` rule builds the
+  lock-acquisition graph over the package source (every ``with`` block
+  on a lock created by the factories below, and every call reachable
+  under it) and rejects edges that go backward in the canonical order;
+- **runtime**: conf ``spark.rapids.debug.lockOrder`` arms the
+  instrumented wrappers below.  Each tracked acquire records the
+  (held -> acquiring) edge for the calling thread; an edge that goes
+  backward counts as a violation and emits a ``lockOrderViolation``
+  event (surfaced in ``render_prometheus()`` and the tools profiler).
+
+The factories return plain ``threading`` primitives semantically — when
+the validator is disarmed the per-acquire overhead is one global flag
+read — so the four call sites construct through here unconditionally.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Set, Tuple
+
+__all__ = [
+    "CANONICAL_LOCK_ORDER", "tracked_condition", "tracked_rlock",
+    "set_enabled", "force_enabled", "is_enabled", "observed_edges",
+    "violations_total", "violation_pairs", "reset_observations",
+    "sync_from_conf",
+]
+
+#: THE declared acquisition order, outermost first: a thread holding a
+#: lock may only acquire locks strictly later in this tuple.  The static
+#: lint rule parses this literal; the runtime wrappers index into it —
+#: one source of truth for both directions of the cross-check.
+CANONICAL_LOCK_ORDER: Tuple[str, ...] = (
+    "spool",        # exec/pipeline.py   PrefetchSpool._cond
+    "catalog",      # memory/catalog.py  BufferCatalog._lock
+    "semaphore",    # memory/semaphore.py TpuSemaphore._cond
+    "arbiter",      # memory/arbiter.py  ResourceArbiter._cond
+)
+
+_RANK: Dict[str, int] = {n: i for i, n in enumerate(CANONICAL_LOCK_ORDER)}
+
+#: effective hot-path flag: conf-synced base, overridable by tests
+_ENABLED = False
+_CONF_ENABLED = False
+_FORCED = None
+
+
+def _refresh() -> None:
+    global _ENABLED
+    _ENABLED = _CONF_ENABLED if _FORCED is None else _FORCED
+
+_STATE_LOCK = threading.Lock()
+#: every (held, acquired) pair seen since the last reset — the runtime
+#: half of the static/runtime cross-check (tests assert each observed
+#: edge is forward in CANONICAL_LOCK_ORDER)
+_EDGES: Set[Tuple[str, str]] = set()
+#: back-edges, kept separately so a violation survives edge inspection
+_VIOLATIONS: Set[Tuple[str, str]] = set()
+_VIOLATIONS_TOTAL = 0
+
+
+class _HeldStack(threading.local):
+    def __init__(self):
+        self.stack: List[str] = []
+
+
+_HELD = _HeldStack()
+
+
+def set_enabled(on: bool) -> None:
+    """Conf-synced arming (session init / set_conf).  A session built
+    with default conf DISARMS — the sampler/watchdog singleton
+    lifecycle; tests that must stay armed across incidental session
+    construction use ``force_enabled``."""
+    global _CONF_ENABLED
+    _CONF_ENABLED = bool(on)
+    _refresh()
+
+
+def force_enabled(on) -> None:
+    """Test override that wins over conf syncs: ``True``/``False`` pin
+    the validator regardless of session construction; ``None`` returns
+    control to the conf."""
+    global _FORCED
+    _FORCED = on if on is None else bool(on)
+    _refresh()
+
+
+def is_enabled() -> bool:
+    return _ENABLED
+
+
+def sync_from_conf(conf) -> None:
+    """Arms/disarms the validator from ``spark.rapids.debug.lockOrder``
+    (session init / set_conf, the sampler/watchdog sync pattern)."""
+    from spark_rapids_tpu import config as C
+    set_enabled(conf.get(C.DEBUG_LOCK_ORDER.key, False))
+
+
+def observed_edges() -> Set[Tuple[str, str]]:
+    with _STATE_LOCK:
+        return set(_EDGES)
+
+
+def violation_pairs() -> Set[Tuple[str, str]]:
+    with _STATE_LOCK:
+        return set(_VIOLATIONS)
+
+
+def violations_total() -> int:
+    with _STATE_LOCK:
+        return _VIOLATIONS_TOTAL
+
+
+def reset_observations() -> None:
+    global _VIOLATIONS_TOTAL
+    with _STATE_LOCK:
+        _EDGES.clear()
+        _VIOLATIONS.clear()
+        _VIOLATIONS_TOTAL = 0
+
+
+def _note_acquire(name: str) -> None:
+    """Runs BEFORE the actual acquire (the violation must be recorded
+    even if the bad acquisition then deadlocks)."""
+    stack = _HELD.stack
+    if stack and name not in stack:
+        global _VIOLATIONS_TOTAL
+        rank = _RANK.get(name)
+        fresh_violations = []
+        with _STATE_LOCK:
+            for held in stack:
+                if held == name:
+                    continue
+                edge = (held, name)
+                _EDGES.add(edge)
+                held_rank = _RANK.get(held)
+                backward = (rank is None or held_rank is None
+                            or rank <= held_rank)
+                if backward:
+                    _VIOLATIONS_TOTAL += 1
+                    if edge not in _VIOLATIONS:
+                        _VIOLATIONS.add(edge)
+                        fresh_violations.append(edge)
+        for held, acq in fresh_violations:
+            # emitted outside _STATE_LOCK, before the offending acquire
+            # (the event sinks use their own leaf locks)
+            from spark_rapids_tpu.aux.events import emit
+            emit("lockOrderViolation", held=held, acquiring=acq,
+                 order="<".join(CANONICAL_LOCK_ORDER),
+                 thread=threading.current_thread().name)
+    stack.append(name)
+
+
+def _note_release(name: str) -> None:
+    stack = _HELD.stack
+    # pop the most recent matching entry (reentrant holds pop one level)
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i] == name:
+            del stack[i]
+            return
+
+
+class TrackedCondition(threading.Condition):
+    """``threading.Condition`` that reports lock acquisition order.
+
+    ``wait()`` internally releases/re-acquires through the inner RLock's
+    ``_release_save``/``_acquire_restore`` (bound by Condition at
+    construction, bypassing the overrides) — correct for tracking: the
+    thread logically holds the lock across a wait, and no new ordering
+    edge is created by the re-acquire."""
+
+    def __init__(self, name: str):
+        super().__init__()
+        self._lo_name = name
+        inner_acquire = self.acquire
+        inner_release = self.release
+
+        # Condition binds acquire/release as INSTANCE attributes from its
+        # lock, so class-level overrides would be shadowed: rebind here
+        def acquire(*a, **k):
+            if _ENABLED:
+                _note_acquire(name)
+            got = inner_acquire(*a, **k)
+            if not got:
+                _note_release(name)
+            return got
+
+        def release():
+            inner_release()
+            # release-side tracking is UNCONDITIONAL: disarming while a
+            # thread holds the lock must still pop its stack entry, or a
+            # later re-arm sees phantom held locks (a no-op on the empty
+            # stack when never armed)
+            _note_release(name)
+
+        self.acquire = acquire
+        self.release = release
+
+    def __enter__(self):
+        if _ENABLED:
+            _note_acquire(self._lo_name)
+        return super().__enter__()
+
+    def __exit__(self, *exc):
+        out = super().__exit__(*exc)
+        _note_release(self._lo_name)
+        return out
+
+
+class TrackedRLock:
+    """Re-entrant lock that reports acquisition order.  Exposes the
+    ``_release_save``/``_acquire_restore``/``_is_owned`` protocol so it
+    can also back a ``threading.Condition`` if ever needed."""
+
+    def __init__(self, name: str):
+        self._lo_name = name
+        self._inner = threading.RLock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if _ENABLED:
+            _note_acquire(self._lo_name)
+        got = self._inner.acquire(blocking, timeout)
+        if not got:
+            _note_release(self._lo_name)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        # unconditional: see TrackedCondition.release
+        _note_release(self._lo_name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # Condition compatibility passthroughs
+    def _release_save(self):
+        return self._inner._release_save()
+
+    def _acquire_restore(self, state):
+        return self._inner._acquire_restore(state)
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def __repr__(self):
+        return f"TrackedRLock({self._lo_name!r})"
+
+
+def tracked_condition(name: str) -> TrackedCondition:
+    """Factory the four concurrency-core sites construct through; the
+    static lint rule keys lock identity off these literal names."""
+    return TrackedCondition(name)
+
+
+def tracked_rlock(name: str) -> TrackedRLock:
+    return TrackedRLock(name)

@@ -145,6 +145,7 @@ TEST_ALLOWED_NONGPU = conf_str(
     "Comma-separated exec class names allowed to stay on CPU in test mode.",
     "", ConfLevel.INTERNAL)
 
+# lint: ok=conf-registry -- reference-compat key, reserved (not yet wired)
 INCOMPATIBLE_OPS = conf_bool(
     "spark.rapids.sql.incompatibleOps.enabled",
     "Enable operators whose TPU results can differ from CPU in documented "
@@ -152,16 +153,19 @@ INCOMPATIBLE_OPS = conf_bool(
     "'spark.rapids.sql.incompatibleOps.enabled'.",
     True)
 
+# lint: ok=conf-registry -- reference-compat key, reserved (not yet wired)
 HAS_NANS = conf_bool(
     "spark.rapids.sql.hasNans",
     "Assume floating point data may contain NaN (affects agg/join tagging).",
     True)
 
+# lint: ok=conf-registry -- reference-compat key, reserved (not yet wired)
 VARIABLE_FLOAT_AGG = conf_bool(
     "spark.rapids.sql.variableFloatAgg.enabled",
     "Allow float aggregations whose result can vary with evaluation order.",
     True)
 
+# lint: ok=conf-registry -- reference-compat key, reserved (not yet wired)
 IMPROVED_FLOAT_OPS = conf_bool(
     "spark.rapids.sql.improvedFloatOps.enabled",
     "Use float paths faster than, but not bit-identical to, CPU.",
@@ -179,6 +183,7 @@ MAX_READER_BATCH_SIZE_ROWS = conf_int(
     "Max rows a file reader produces per batch.",
     1 << 20)
 
+# lint: ok=conf-registry -- reference-compat key, reserved (not yet wired)
 MAX_READER_BATCH_SIZE_BYTES = conf_bytes(
     "spark.rapids.sql.reader.batchSizeBytes",
     "Soft max bytes a file reader produces per batch.",
@@ -198,6 +203,7 @@ TASK_PARALLELISM = conf_int(
     "(min(4, cpu_count)); 1 = serial.",
     0)
 
+# lint: ok=conf-registry -- reference-compat key, reserved (not yet wired)
 ROW_BUCKET_MIN = conf_int(
     "spark.rapids.tpu.batch.rowBucketMin",
     "Minimum padded row-count bucket for device batches. Device batches are "
@@ -223,6 +229,7 @@ HOST_SPILL_STORAGE_SIZE = conf_bytes(
     "(reference 'spark.rapids.memory.host.spillStorageSize').",
     1 << 30)
 
+# lint: ok=conf-registry -- reference-compat key, reserved (not yet wired)
 PAGEABLE_POOL_SIZE = conf_bytes(
     "spark.rapids.memory.host.pageablePool.size",
     "Host allocation pool size.",
@@ -270,12 +277,6 @@ WATCHDOG_POLL_MS = conf_int(
     "Watchdog sweep interval.  Validated > 0 at set_conf.",
     100,
     checker=lambda v: int(v) > 0)
-
-OOM_RETRY_COUNT = conf_int(
-    "spark.rapids.memory.gpu.oomDumpRetryCount",
-    "How many synchronous spill-and-retry attempts on device alloc failure "
-    "before declaring OOM (reference DeviceMemoryEventHandler retry loop).",
-    10, ConfLevel.INTERNAL)
 
 OOM_INJECTION_MODE = conf_str(
     "spark.rapids.sql.test.injectRetryOOM",
@@ -580,12 +581,6 @@ RANGES_ENABLED = conf_bool(
     "(reference: NVTX ranges, NvtxWithMetrics.scala).",
     False)
 
-DUMP_PATH = conf_str(
-    "spark.rapids.sql.debug.dumpPathPrefix",
-    "When set, operators dump their last good input batch to parquet "
-    "under this prefix when a kernel fails (reference: DumpUtils.scala).",
-    "")
-
 JOIN_SUBPARTITION_THRESHOLD = conf_bytes(
     "spark.rapids.sql.join.subPartitionThresholdBytes",
     "Build sides larger than this re-partition into hash buckets joined "
@@ -626,11 +621,6 @@ FILECACHE_MAX_BYTES = conf_bytes(
     "spark.rapids.filecache.maxBytes",
     "Local disk budget for the file cache.",
     "1g", ConfLevel.STARTUP)
-
-SHUFFLE_PARTITIONS = conf_int(
-    "spark.sql.shuffle.partitions",
-    "Default partition count for shuffles (Spark core conf, honored here).",
-    16)
 
 METRICS_LEVEL = conf_str(
     "spark.rapids.sql.metrics.level",
@@ -694,23 +684,13 @@ EVENT_LOG_RING_SIZE = conf_int(
     "count is reported in the query summary.",
     2048)
 
+# lint: ok=conf-registry -- reference-compat key, reserved (not yet wired)
 STABLE_SORT = conf_bool(
     "spark.rapids.sql.stableSort.enabled",
     "Force stable full sorts (disables some out-of-core optimizations).",
     False)
 
-AGG_FALLBACK_PARTITIONS = conf_int(
-    "spark.rapids.sql.agg.fallbackPartitions",
-    "Bucket count when merge-aggregation falls back to hash re-partitioning "
-    "(reference GpuAggregateExec repartition fallback).",
-    16, ConfLevel.INTERNAL)
-
-JOIN_SUBPARTITIONS = conf_int(
-    "spark.rapids.sql.join.subPartitions",
-    "Sub-partition count for oversized hash join inputs "
-    "(reference GpuSubPartitionHashJoin).",
-    16, ConfLevel.INTERNAL)
-
+# lint: ok=conf-registry -- reference-compat key, reserved (not yet wired)
 ENABLE_FLOAT_CAST_STRING = conf_bool(
     "spark.rapids.sql.castFloatToString.enabled",
     "Enable float->string casts (formatting can differ from CPU in last ulp).",
@@ -721,11 +701,6 @@ ENABLE_REGEX = conf_bool(
     "Enable regular expression acceleration via the transpiler "
     "(reference 'spark.rapids.sql.regexp.enabled').",
     True)
-
-CPU_ORACLE_X64 = conf_bool(
-    "spark.rapids.tpu.test.cpuOracleX64",
-    "Run the CPU differential-test oracle in 64-bit float mode.",
-    True, ConfLevel.INTERNAL)
 
 MULTITHREADED_READ_NUM_THREADS = conf_int(
     "spark.rapids.sql.multiThreadedRead.numThreads",
@@ -753,26 +728,34 @@ ORC_READER_TYPE = conf_str(
     "ORC reader strategy (same values as the parquet key).",
     "AUTO")
 
+# lint: ok=conf-registry -- reference-compat key, reserved (not yet wired)
 AVRO_READER_TYPE = conf_str(
     "spark.rapids.sql.format.avro.reader.type",
     "Avro reader strategy (same values as the parquet key).",
     "AUTO")
 
+# lint: ok=conf-registry -- reference-compat key, reserved (not yet wired)
 DEVICE_STRING_MAX_LEN = conf_int(
     "spark.rapids.tpu.string.maxDeviceLen",
     "Strings longer than this stay on the host tier (device strings are "
     "padded [rows, max_len] uint8; padding cost grows with max length).",
     256)
 
+DEBUG_LOCK_ORDER = conf_bool(
+    "spark.rapids.debug.lockOrder",
+    "Arm the runtime lock-order validator (aux/lockorder.py): the "
+    "catalog/arbiter/semaphore/spool locks record every (held -> "
+    "acquiring) edge per thread and check it against the canonical "
+    "acquisition order the static lint rule enforces "
+    "(spool < catalog < semaphore < arbiter); a backward edge counts in "
+    "lock_order_violations_total and emits a lockOrderViolation event.  "
+    "Debug/test knob: adds one flag read per lock acquire when off.",
+    False, ConfLevel.INTERNAL)
+
 RMM_DEBUG = conf_bool(
     "spark.rapids.memory.gpu.debug",
     "Log every pool allocation/free (reference RapidsConf.scala:375).",
     False, ConfLevel.INTERNAL)
-
-PROFILE_PATH = conf_str(
-    "spark.rapids.profile.pathPrefix",
-    "If set, write per-stage trace files under this path (reference profiler.scala).",
-    "", ConfLevel.INTERNAL)
 
 COMPILE_CACHE_DIR = conf_str(
     "spark.rapids.sql.compile.cacheDir",

@@ -27,7 +27,7 @@ from spark_rapids_tpu.expressions.aggregates import (AggregateExpression,
                                                      BufferSpec)
 from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
                                                Expression)
-from spark_rapids_tpu.plan.base import Exec, UnaryExec
+from spark_rapids_tpu.plan.base import Exec, UnaryExec, closing_source
 
 PARTIAL, FINAL, COMPLETE = "partial", "final", "complete"
 
@@ -600,20 +600,22 @@ class TpuHashAggregateExec(CpuHashAggregateExec):
         # aggregated-batch queue semantics)
         partials: List[SpillableColumnarBatch] = []
         n_partials = 0
-        for b in self.child.execute_partition(pidx):
-            if self.mode in (PARTIAL, COMPLETE):
-                exprs = []
-                for i, e in enumerate(lay.update_input_exprs()):
-                    nm = lay.key_name(i) if i < lay.num_keys else \
-                        f"v{i - lay.num_keys}"
-                    exprs.append(Alias(e, nm))
-                proj = eval_exprs_tpu(exprs, b)
-                p = with_retry_no_split(None, lambda: segmented_aggregate(
-                    proj, lay.num_keys, lay.update_specs()))
-            else:
-                p = b  # already in buffer layout (post-shuffle)
-            partials.append(SpillableColumnarBatch.from_device(p))
-            n_partials += 1
+        with closing_source(self.child.execute_partition(pidx)) as it:
+            for b in it:
+                if self.mode in (PARTIAL, COMPLETE):
+                    exprs = []
+                    for i, e in enumerate(lay.update_input_exprs()):
+                        nm = lay.key_name(i) if i < lay.num_keys else \
+                            f"v{i - lay.num_keys}"
+                        exprs.append(Alias(e, nm))
+                    proj = eval_exprs_tpu(exprs, b)
+                    p = with_retry_no_split(
+                        None, lambda: segmented_aggregate(
+                            proj, lay.num_keys, lay.update_specs()))
+                else:
+                    p = b  # already in buffer layout (post-shuffle)
+                partials.append(SpillableColumnarBatch.from_device(p))
+                n_partials += 1
         if not partials:
             if lay.num_keys == 0 and self.mode in (COMPLETE, FINAL) and \
                     self.child.num_partitions == 1:

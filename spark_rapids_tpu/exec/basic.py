@@ -19,7 +19,8 @@ from spark_rapids_tpu.expressions.base import (Alias, BoundReference,
                                                Expression, TCol)
 from spark_rapids_tpu.expressions.evaluator import (eval_exprs_cpu,
                                                     eval_exprs_tpu, _out_names)
-from spark_rapids_tpu.plan.base import Exec, LeafExec, UnaryExec
+from spark_rapids_tpu.plan.base import (Exec, LeafExec, UnaryExec,
+                                        closing_source)
 
 
 def _project_schema(exprs: Sequence[Expression]) -> T.StructType:
@@ -175,8 +176,9 @@ class CpuProjectExec(UnaryExec):
         return _project_schema(self.exprs)
 
     def execute_partition(self, pidx):
-        for b in self.child.execute_partition(pidx):
-            yield eval_exprs_cpu(self.exprs, b)
+        with closing_source(self.child.execute_partition(pidx)) as it:
+            for b in it:
+                yield eval_exprs_cpu(self.exprs, b)
 
     def node_desc(self):
         return f"Project[{', '.join(e.sql() for e in self.exprs)}]"
@@ -197,8 +199,9 @@ class TpuProjectExec(UnaryExec):
         return _project_schema(self.exprs)
 
     def execute_partition(self, pidx):
-        for b in self.child.execute_partition(pidx):
-            yield eval_exprs_tpu(self.exprs, b)
+        with closing_source(self.child.execute_partition(pidx)) as it:
+            for b in it:
+                yield eval_exprs_tpu(self.exprs, b)
 
     def node_desc(self):
         return f"TpuProject[{', '.join(e.sql() for e in self.exprs)}]"
@@ -215,14 +218,15 @@ class CpuFilterExec(UnaryExec):
         from spark_rapids_tpu.expressions.evaluator import (host_batch_tcols,
                                                             tcol_to_host_column)
         from spark_rapids_tpu.expressions.base import EvalContext
-        for b in self.child.execute_partition(pidx):
-            cols = host_batch_tcols(b)
-            ctx = EvalContext(cols, "cpu", b.row_count)
-            pred = self.condition.eval_cpu(ctx)
-            keep_col = tcol_to_host_column(pred, b.row_count)
-            mask = pc.fill_null(keep_col.arrow.cast(pa.bool_()), False)
-            rb = b.to_arrow().filter(mask)
-            yield batch_from_arrow(pa.Table.from_batches([rb]))
+        with closing_source(self.child.execute_partition(pidx)) as it:
+            for b in it:
+                cols = host_batch_tcols(b)
+                ctx = EvalContext(cols, "cpu", b.row_count)
+                pred = self.condition.eval_cpu(ctx)
+                keep_col = tcol_to_host_column(pred, b.row_count)
+                mask = pc.fill_null(keep_col.arrow.cast(pa.bool_()), False)
+                rb = b.to_arrow().filter(mask)
+                yield batch_from_arrow(pa.Table.from_batches([rb]))
 
     def node_desc(self):
         return f"Filter[{self.condition.sql()}]"
@@ -244,19 +248,20 @@ class TpuFilterExec(UnaryExec):
         from spark_rapids_tpu.ops import compact_batch
         from spark_rapids_tpu.columnar.column import _jnp
         jnp = _jnp()
-        for b in self.child.execute_partition(pidx):
-            cols = device_batch_tcols(b)
-            ctx = EvalContext(cols, "tpu", b.bucket)
-            pred = self.condition.eval_tpu(ctx)
-            keep = valid_array(pred, ctx)
-            if not pred.is_scalar:
-                keep = keep & pred.data
-            else:
-                keep = keep & bool(pred.data)
-            # padding rows must never be kept
-            rowpos = jnp.arange(b.bucket)
-            keep = keep & (rowpos < b.row_count)
-            yield compact_batch(b, keep)
+        with closing_source(self.child.execute_partition(pidx)) as it:
+            for b in it:
+                cols = device_batch_tcols(b)
+                ctx = EvalContext(cols, "tpu", b.bucket)
+                pred = self.condition.eval_tpu(ctx)
+                keep = valid_array(pred, ctx)
+                if not pred.is_scalar:
+                    keep = keep & pred.data
+                else:
+                    keep = keep & bool(pred.data)
+                # padding rows must never be kept
+                rowpos = jnp.arange(b.bucket)
+                keep = keep & (rowpos < b.row_count)
+                yield compact_batch(b, keep)
 
     def node_desc(self):
         return f"TpuFilter[{self.condition.sql()}]"
@@ -608,10 +613,11 @@ class CpuSampleExec(UnaryExec):
     def execute_partition(self, pidx):
         import pyarrow as pa
         rng = np.random.default_rng(self.seed + pidx)
-        for b in self.child.execute_partition(pidx):
-            mask = rng.random(b.row_count) < self.fraction
-            rb = b.to_arrow().filter(pa.array(mask))
-            yield batch_from_arrow(pa.Table.from_batches([rb]))
+        with closing_source(self.child.execute_partition(pidx)) as it:
+            for b in it:
+                mask = rng.random(b.row_count) < self.fraction
+                rb = b.to_arrow().filter(pa.array(mask))
+                yield batch_from_arrow(pa.Table.from_batches([rb]))
 
     def node_desc(self):
         return f"Sample[{self.fraction}]"
@@ -631,11 +637,13 @@ class TpuSampleExec(UnaryExec):
         from spark_rapids_tpu.columnar.column import _jnp
         jnp = _jnp()
         key = jax.random.PRNGKey(self.seed + pidx)
-        for i, b in enumerate(self.child.execute_partition(pidx)):
-            key, sub = jax.random.split(key)
-            u = jax.random.uniform(sub, (b.bucket,))
-            keep = (u < self.fraction) & (jnp.arange(b.bucket) < b.row_count)
-            yield compact_batch(b, keep)
+        with closing_source(self.child.execute_partition(pidx)) as it:
+            for i, b in enumerate(it):
+                key, sub = jax.random.split(key)
+                u = jax.random.uniform(sub, (b.bucket,))
+                keep = (u < self.fraction) & \
+                    (jnp.arange(b.bucket) < b.row_count)
+                yield compact_batch(b, keep)
 
     def node_desc(self):
         return f"TpuSample[{self.fraction}]"
@@ -674,60 +682,61 @@ class TpuFilterProjectExec(UnaryExec):
         from spark_rapids_tpu.expressions.evaluator import (
             _signature, device_batch_tcols, tcol_to_device_column)
         jnp = _jnp()
-        for b in self.child.execute_partition(pidx):
-            key = (_signature([self.condition] + self.exprs, b), b.bucket)
+        with closing_source(self.child.execute_partition(pidx)) as it:
+            for b in it:
+                key = (_signature([self.condition] + self.exprs, b), b.bucket)
 
-            def build(dtypes=tuple(c.data_type for c in b.columns),
-                      bucket=b.bucket):
-                # captures frozen at build time (NOT loop cells): a later
-                # jax retrace of this cached program must see the bucket/
-                # dtypes it was keyed under, not the loop's current batch
-                cond, exprs = self.condition, self.exprs
+                def build(dtypes=tuple(c.data_type for c in b.columns),
+                          bucket=b.bucket):
+                    # captures frozen at build time (NOT loop cells): a later
+                    # jax retrace of this cached program must see the bucket/
+                    # dtypes it was keyed under, not the loop's current batch
+                    cond, exprs = self.condition, self.exprs
 
-                def run(arrs, row_count):
-                    cols = [TCol(d, v, dt, lengths=ln, elem_valid=ev)
-                            for (d, v, ln, ev), dt in zip(arrs, dtypes)]
-                    ctx = EvalContext(cols, "tpu", bucket)
-                    pred = cond.eval_tpu(ctx)
-                    keep = valid_array(pred, ctx)
-                    if not pred.is_scalar:
-                        keep = keep & pred.data
-                    else:
-                        keep = keep & bool(pred.data)
-                    keep = keep & (jnp.arange(bucket) < row_count)
-                    dest = jnp.cumsum(keep) - 1
-                    dest = jnp.where(keep, dest, bucket)
-                    cnt = jnp.sum(keep)
-                    live = jnp.arange(bucket) < cnt
-                    outs = []
-                    for e in exprs:
-                        dc = tcol_to_device_column(e.eval_tpu(ctx), 0,
-                                                   bucket, jnp)
-                        nd = jnp.zeros_like(dc.data).at[dest].set(
-                            dc.data, mode="drop")
-                        nv = jnp.zeros_like(dc.validity).at[dest].set(
-                            dc.validity & keep, mode="drop") & live
-                        nl = None if dc.lengths is None else \
-                            jnp.zeros_like(dc.lengths).at[dest].set(
-                                dc.lengths, mode="drop")
-                        ne = None if dc.elem_valid is None else \
-                            jnp.zeros_like(dc.elem_valid).at[dest].set(
-                                dc.elem_valid, mode="drop")
-                        outs.append((nd, nv, nl, ne))
-                    return outs, cnt
+                    def run(arrs, row_count):
+                        cols = [TCol(d, v, dt, lengths=ln, elem_valid=ev)
+                                for (d, v, ln, ev), dt in zip(arrs, dtypes)]
+                        ctx = EvalContext(cols, "tpu", bucket)
+                        pred = cond.eval_tpu(ctx)
+                        keep = valid_array(pred, ctx)
+                        if not pred.is_scalar:
+                            keep = keep & pred.data
+                        else:
+                            keep = keep & bool(pred.data)
+                        keep = keep & (jnp.arange(bucket) < row_count)
+                        dest = jnp.cumsum(keep) - 1
+                        dest = jnp.where(keep, dest, bucket)
+                        cnt = jnp.sum(keep)
+                        live = jnp.arange(bucket) < cnt
+                        outs = []
+                        for e in exprs:
+                            dc = tcol_to_device_column(e.eval_tpu(ctx), 0,
+                                                       bucket, jnp)
+                            nd = jnp.zeros_like(dc.data).at[dest].set(
+                                dc.data, mode="drop")
+                            nv = jnp.zeros_like(dc.validity).at[dest].set(
+                                dc.validity & keep, mode="drop") & live
+                            nl = None if dc.lengths is None else \
+                                jnp.zeros_like(dc.lengths).at[dest].set(
+                                    dc.lengths, mode="drop")
+                            ne = None if dc.elem_valid is None else \
+                                jnp.zeros_like(dc.elem_valid).at[dest].set(
+                                    dc.elem_valid, mode="drop")
+                            outs.append((nd, nv, nl, ne))
+                        return outs, cnt
 
-                return run
-            from spark_rapids_tpu.exec.stage_compiler import get_or_build
-            fn = get_or_build("basic.filter_project", key, build)
-            arrs = [(c.data, c.validity, c.lengths, c.elem_valid)
-                    for c in b.columns]
-            from spark_rapids_tpu.columnar.column import rc_traceable
-            outs, cnt = fn(arrs, rc_traceable(b.row_count))
-            rc = DeferredCount(cnt)
-            cols = [DeviceColumn(d, v, rc, e.data_type, ln, ev)
-                    for (d, v, ln, ev), e in zip(outs, self.exprs)]
-            from spark_rapids_tpu.expressions.evaluator import _out_names
-            yield ColumnarBatch(cols, rc, _out_names(self.exprs))
+                    return run
+                from spark_rapids_tpu.exec.stage_compiler import get_or_build
+                fn = get_or_build("basic.filter_project", key, build)
+                arrs = [(c.data, c.validity, c.lengths, c.elem_valid)
+                        for c in b.columns]
+                from spark_rapids_tpu.columnar.column import rc_traceable
+                outs, cnt = fn(arrs, rc_traceable(b.row_count))
+                rc = DeferredCount(cnt)
+                cols = [DeviceColumn(d, v, rc, e.data_type, ln, ev)
+                        for (d, v, ln, ev), e in zip(outs, self.exprs)]
+                from spark_rapids_tpu.expressions.evaluator import _out_names
+                yield ColumnarBatch(cols, rc, _out_names(self.exprs))
 
     def node_desc(self):
         return (f"TpuFilterProject[{self.condition.sql()}; "
@@ -751,8 +760,9 @@ class DeviceToHostExec(UnaryExec):
     is_device = False
 
     def execute_partition(self, pidx):
-        for b in self.child.execute_partition(pidx):
-            yield b.to_host()
+        with closing_source(self.child.execute_partition(pidx)) as it:
+            for b in it:
+                yield b.to_host()
 
     def node_desc(self):
         return "DeviceToHost"
@@ -774,13 +784,14 @@ class TpuCoalesceBatchesExec(UnaryExec):
         from spark_rapids_tpu.ops import concat_batches
         pending: List[ColumnarBatch] = []
         pending_bytes = 0
-        for b in self.child.execute_partition(pidx):
-            pending.append(b)
-            pending_bytes += b.sized_nbytes()
-            if not self.require_single_batch and \
-                    pending_bytes >= self.target_bytes:
-                yield concat_batches(pending)
-                pending, pending_bytes = [], 0
+        with closing_source(self.child.execute_partition(pidx)) as it:
+            for b in it:
+                pending.append(b)
+                pending_bytes += b.sized_nbytes()
+                if not self.require_single_batch and \
+                        pending_bytes >= self.target_bytes:
+                    yield concat_batches(pending)
+                    pending, pending_bytes = [], 0
         if pending:
             yield concat_batches(pending)
 

@@ -17,7 +17,7 @@ from spark_rapids_tpu.exec.sort import (SortSpec, device_sort_batch,
 from spark_rapids_tpu.expressions.base import Expression
 from spark_rapids_tpu.expressions.evaluator import (eval_exprs_cpu,
                                                     eval_exprs_tpu)
-from spark_rapids_tpu.plan.base import Exec, UnaryExec
+from spark_rapids_tpu.plan.base import Exec, UnaryExec, closing_source
 
 
 class CpuExpandExec(UnaryExec):
@@ -66,9 +66,10 @@ class CpuExpandExec(UnaryExec):
 
     def execute_partition(self, pidx):
         coerced = [self._coerced(p) for p in self.projections]
-        for b in self.child.execute_partition(pidx):
-            for proj in coerced:
-                yield eval_exprs_cpu(proj, b, self.names)
+        with closing_source(self.child.execute_partition(pidx)) as it:
+            for b in it:
+                for proj in coerced:
+                    yield eval_exprs_cpu(proj, b, self.names)
 
     def node_desc(self):
         return f"Expand[{len(self.projections)} projections]"
@@ -85,9 +86,10 @@ class TpuExpandExec(CpuExpandExec):
 
     def execute_partition(self, pidx):
         coerced = [self._coerced(p) for p in self.projections]
-        for b in self.child.execute_partition(pidx):
-            for proj in coerced:
-                yield eval_exprs_tpu(proj, b, self.names)
+        with closing_source(self.child.execute_partition(pidx)) as it:
+            for b in it:
+                for proj in coerced:
+                    yield eval_exprs_tpu(proj, b, self.names)
 
     def node_desc(self):
         return f"TpuExpand[{len(self.projections)} projections]"

@@ -28,7 +28,7 @@ from spark_rapids_tpu.columnar.column import (DeferredCount, DeviceColumn,
                                               rc_traceable)
 from spark_rapids_tpu.expressions.base import EvalContext, Expression, TCol, \
     valid_array
-from spark_rapids_tpu.plan.base import Exec, UnaryExec
+from spark_rapids_tpu.plan.base import Exec, UnaryExec, closing_source
 
 
 def _jx():
@@ -158,23 +158,26 @@ class TpuFusedStageExec(UnaryExec, _PromotedLiteralsMixin):
     def execute_partition(self, pidx):
         from spark_rapids_tpu.exec import stage_compiler as SC
         pending = None
-        for b in self.child.execute_partition(pidx):
-            prog, args = self._program(b)
-            if SC.ASYNC_COMPILE and prog.needs_compile():
-                # background lower+compile; the one-batch look-ahead below
-                # overlaps it with the previous batch's downstream compute
-                prog.warm_async(*args)
-            if pending is not None:
-                yield self._finish(*pending)
-                pending = None
-            # defer only while a background compile is actually in flight:
-            # in the steady state (program warm) an unconditional hold
-            # would add a batch of latency and pin an extra batch's device
-            # arrays per fused stage for zero overlap benefit
-            if prog.compiling():
-                pending = (prog, args)
-            else:
-                yield self._finish(prog, args)
+        with closing_source(self.child.execute_partition(pidx)) as it:
+            for b in it:
+                prog, args = self._program(b)
+                if SC.ASYNC_COMPILE and prog.needs_compile():
+                    # background lower+compile; the one-batch look-ahead
+                    # below overlaps it with the previous batch's
+                    # downstream compute
+                    prog.warm_async(*args)
+                if pending is not None:
+                    yield self._finish(*pending)
+                    pending = None
+                # defer only while a background compile is actually in
+                # flight: in the steady state (program warm) an
+                # unconditional hold would add a batch of latency and pin
+                # an extra batch's device arrays per fused stage for zero
+                # overlap benefit
+                if prog.compiling():
+                    pending = (prog, args)
+                else:
+                    yield self._finish(prog, args)
         if pending is not None:
             yield self._finish(*pending)
 
@@ -455,9 +458,10 @@ class TpuFusedAggExec(UnaryExec, _PromotedLiteralsMixin):
         from spark_rapids_tpu.memory.retry import with_retry_no_split
         lay = self.layout
         partials: List[ColumnarBatch] = []
-        for b in self.child.execute_partition(pidx):
-            partials.append(with_retry_no_split(
-                None, lambda: self._fused_update(b)))
+        with closing_source(self.child.execute_partition(pidx)) as it:
+            for b in it:
+                partials.append(with_retry_no_split(
+                    None, lambda: self._fused_update(b)))
         if not partials:
             if lay.num_keys == 0 and self.mode in (COMPLETE, FINAL) and \
                     self.child.num_partitions == 1:

@@ -18,7 +18,7 @@ import numpy as np
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.expressions.base import BoundReference, Expression
-from spark_rapids_tpu.plan.base import Exec, UnaryExec
+from spark_rapids_tpu.plan.base import Exec, UnaryExec, closing_source
 
 
 class CpuGenerateExec(UnaryExec):
@@ -54,40 +54,41 @@ class CpuGenerateExec(UnaryExec):
         from spark_rapids_tpu.columnar.batch import batch_from_arrow
         from spark_rapids_tpu.expressions.base import EvalContext, valid_array
         from spark_rapids_tpu.expressions.evaluator import host_batch_tcols
-        for b in self.child.execute_partition(pidx):
-            cols = host_batch_tcols(b)
-            ctx = EvalContext(cols, "cpu", b.row_count)
-            arr = self.generator.eval_cpu(ctx)
-            valid = valid_array(arr, ctx)
-            src_rows: List[int] = []
-            poss: List[Optional[int]] = []
-            elems: List = []
-            for i in range(b.row_count):
-                lst = arr.data[i] if valid[i] else None
-                if lst:
-                    for j, e in enumerate(lst):
+        with closing_source(self.child.execute_partition(pidx)) as it:
+            for b in it:
+                cols = host_batch_tcols(b)
+                ctx = EvalContext(cols, "cpu", b.row_count)
+                arr = self.generator.eval_cpu(ctx)
+                valid = valid_array(arr, ctx)
+                src_rows: List[int] = []
+                poss: List[Optional[int]] = []
+                elems: List = []
+                for i in range(b.row_count):
+                    lst = arr.data[i] if valid[i] else None
+                    if lst:
+                        for j, e in enumerate(lst):
+                            src_rows.append(i)
+                            poss.append(j)
+                            elems.append(e)
+                    elif self.outer:
                         src_rows.append(i)
-                        poss.append(j)
-                        elems.append(e)
-                elif self.outer:
-                    src_rows.append(i)
-                    poss.append(None)
-                    elems.append(None)
-            tab = pa.Table.from_batches([b.to_arrow()])
-            taken = tab.take(pa.array(src_rows, type=pa.int64()))
-            out_cols = [c.combine_chunks() if isinstance(c, pa.ChunkedArray)
-                        else c for c in taken.columns]
-            names = list(tab.schema.names)
-            if self.position:
-                out_cols.append(pa.array(poss, type=pa.int32()))
-                names.append(self.pos_name)
-            out_cols.append(pa.array(
-                elems, type=T.to_arrow(self.generator.data_type.element_type)))
-            names.append(self.element_name)
-            # from_arrays keeps duplicate names (the explode alias may
-            # collide with a child column; a dict would silently drop one)
-            yield batch_from_arrow(pa.Table.from_arrays(out_cols,
-                                                        names=names))
+                        poss.append(None)
+                        elems.append(None)
+                tab = pa.Table.from_batches([b.to_arrow()])
+                taken = tab.take(pa.array(src_rows, type=pa.int64()))
+                out_cols = [c.combine_chunks() if isinstance(c, pa.ChunkedArray)
+                            else c for c in taken.columns]
+                names = list(tab.schema.names)
+                if self.position:
+                    out_cols.append(pa.array(poss, type=pa.int32()))
+                    names.append(self.pos_name)
+                out_cols.append(pa.array(
+                    elems, type=T.to_arrow(self.generator.data_type.element_type)))
+                names.append(self.element_name)
+                # from_arrays keeps duplicate names (the explode alias may
+                # collide with a child column; a dict would silently drop one)
+                yield batch_from_arrow(pa.Table.from_arrays(out_cols,
+                                                            names=names))
 
     def node_desc(self):
         kind = "PosExplode" if self.position else "Explode"
@@ -111,48 +112,49 @@ class TpuGenerateExec(CpuGenerateExec):
         from spark_rapids_tpu.ops.batch_ops import gather_batch
         jnp = _jnp()
         elem_dt = self.generator.data_type.element_type
-        for b in self.child.execute_partition(pidx):
-            cols = device_batch_tcols(b)
-            ctx = EvalContext(cols, "tpu", b.bucket)
-            arr = self.generator.eval_tpu(ctx)
-            valid = valid_array(arr, ctx)
-            rowpos = jnp.arange(b.bucket)
-            live_row = valid & (rowpos < b.row_count)
-            lens = jnp.where(live_row, arr.lengths, 0).astype(np.int64)
-            if self.outer:
-                in_row = rowpos < b.row_count
-                fan = jnp.where(in_row & (lens == 0), 1, lens)
-            else:
-                fan = lens
-            cum = jnp.cumsum(fan)
-            total = int(cum[-1])           # ONE sync: output size
-            if total == 0:
-                continue
-            out_bucket = bucket_rows(total)
-            outpos = jnp.arange(out_bucket, dtype=np.int64)
-            src = jnp.searchsorted(cum, outpos, side="right")
-            src = jnp.clip(src, 0, b.bucket - 1)
-            start = cum[src] - fan[src]
-            within = outpos - start
-            out_live = outpos < total
-            # element plane gather
-            w = arr.data.shape[1]
-            safe_within = jnp.clip(within, 0, w - 1).astype(np.int64)
-            elem = arr.data[src, safe_within]
-            elem_ok = arr.elem_valid[src, safe_within] & \
-                (within < lens[src]) & out_live
-            repeated = gather_batch(b, src, total, idx_valid=out_live)
-            out_cols = list(repeated.columns)
-            names = list(repeated.names)
-            if self.position:
-                # outer-null fan rows have within==0 >= lens==0 -> null pos
-                pos_ok = out_live & (within < lens[src])
-                out_cols.append(DeviceColumn(
-                    within.astype(np.int32), pos_ok, total, T.INT))
-                names.append(self.pos_name)
-            out_cols.append(DeviceColumn(elem, elem_ok, total, elem_dt))
-            names.append(self.element_name)
-            yield ColumnarBatch(out_cols, total, names)
+        with closing_source(self.child.execute_partition(pidx)) as it:
+            for b in it:
+                cols = device_batch_tcols(b)
+                ctx = EvalContext(cols, "tpu", b.bucket)
+                arr = self.generator.eval_tpu(ctx)
+                valid = valid_array(arr, ctx)
+                rowpos = jnp.arange(b.bucket)
+                live_row = valid & (rowpos < b.row_count)
+                lens = jnp.where(live_row, arr.lengths, 0).astype(np.int64)
+                if self.outer:
+                    in_row = rowpos < b.row_count
+                    fan = jnp.where(in_row & (lens == 0), 1, lens)
+                else:
+                    fan = lens
+                cum = jnp.cumsum(fan)
+                total = int(cum[-1])           # ONE sync: output size
+                if total == 0:
+                    continue
+                out_bucket = bucket_rows(total)
+                outpos = jnp.arange(out_bucket, dtype=np.int64)
+                src = jnp.searchsorted(cum, outpos, side="right")
+                src = jnp.clip(src, 0, b.bucket - 1)
+                start = cum[src] - fan[src]
+                within = outpos - start
+                out_live = outpos < total
+                # element plane gather
+                w = arr.data.shape[1]
+                safe_within = jnp.clip(within, 0, w - 1).astype(np.int64)
+                elem = arr.data[src, safe_within]
+                elem_ok = arr.elem_valid[src, safe_within] & \
+                    (within < lens[src]) & out_live
+                repeated = gather_batch(b, src, total, idx_valid=out_live)
+                out_cols = list(repeated.columns)
+                names = list(repeated.names)
+                if self.position:
+                    # outer-null fan rows have within==0 >= lens==0 -> null pos
+                    pos_ok = out_live & (within < lens[src])
+                    out_cols.append(DeviceColumn(
+                        within.astype(np.int32), pos_ok, total, T.INT))
+                    names.append(self.pos_name)
+                out_cols.append(DeviceColumn(elem, elem_ok, total, elem_dt))
+                names.append(self.element_name)
+                yield ColumnarBatch(out_cols, total, names)
 
     def node_desc(self):
         return "Tpu" + super().node_desc()

@@ -169,7 +169,8 @@ class PrefetchSpool:
         self.max_bytes = max(1, int(max_bytes))
         self.boundary = boundary
         self._q: collections.deque = collections.deque()
-        self._cond = threading.Condition()
+        from spark_rapids_tpu.aux.lockorder import tracked_condition
+        self._cond = tracked_condition("spool")
         self._depth = 0
         self._bytes = 0
         self._stop = False

@@ -13,7 +13,7 @@ from typing import Callable, Sequence
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import batch_from_arrow
-from spark_rapids_tpu.plan.base import Exec, UnaryExec
+from spark_rapids_tpu.plan.base import Exec, UnaryExec, closing_source
 
 
 def _to_pandas(b):
@@ -49,9 +49,10 @@ class CpuMapInPandasExec(UnaryExec):
         return self._schema
 
     def execute_partition(self, pidx):
-        for b in self.child.execute_partition(pidx):
-            pdf = self.fn(_to_pandas(b))
-            yield _from_pandas(pdf, self._schema)
+        with closing_source(self.child.execute_partition(pidx)) as it:
+            for b in it:
+                pdf = self.fn(_to_pandas(b))
+                yield _from_pandas(pdf, self._schema)
 
     def node_desc(self):
         return f"MapInPandas[{getattr(self.fn, '__name__', 'fn')}]"
@@ -124,21 +125,22 @@ class CpuArrowEvalPythonExec(UnaryExec):
 
     def execute_partition(self, pidx):
         import pyarrow as pa
-        for b in self.child.execute_partition(pidx):
-            hb = b.to_host() if hasattr(b, "bucket") else b
-            tab = pa.Table.from_batches([hb.to_arrow()])
-            # ONE host eval pass for every UDF's inputs (k separate
-            # passes would re-materialize the batch per UDF)
-            all_ins = [e for _n, _f, ins, _d in self.udfs for e in ins]
-            series = _eval_inputs_pandas(all_ins, hb) if all_ins else []
-            off = 0
-            for name, fn, ins, dtype in self.udfs:
-                args = series[off:off + len(ins)]
-                off += len(ins)
-                res = fn(*args)
-                tab = tab.append_column(
-                    name, pa.array(res, type=T.to_arrow(dtype)))
-            yield batch_from_arrow(tab)
+        with closing_source(self.child.execute_partition(pidx)) as it:
+            for b in it:
+                hb = b.to_host() if hasattr(b, "bucket") else b
+                tab = pa.Table.from_batches([hb.to_arrow()])
+                # ONE host eval pass for every UDF's inputs (k separate
+                # passes would re-materialize the batch per UDF)
+                all_ins = [e for _n, _f, ins, _d in self.udfs for e in ins]
+                series = _eval_inputs_pandas(all_ins, hb) if all_ins else []
+                off = 0
+                for name, fn, ins, dtype in self.udfs:
+                    args = series[off:off + len(ins)]
+                    off += len(ins)
+                    res = fn(*args)
+                    tab = tab.append_column(
+                        name, pa.array(res, type=T.to_arrow(dtype)))
+                yield batch_from_arrow(tab)
 
     def node_desc(self):
         return "ArrowEvalPython[%s]" % ", ".join(n for n, *_ in self.udfs)

@@ -29,7 +29,7 @@ from spark_rapids_tpu.expressions.window_exprs import (Lag, Lead, NTile,
                                                        RowNumber,
                                                        WindowExpression)
 from spark_rapids_tpu.ops.window_ops import MAX_UNROLLED_FRAME
-from spark_rapids_tpu.plan.base import Exec, UnaryExec
+from spark_rapids_tpu.plan.base import Exec, UnaryExec, closing_source
 
 
 class LoweredWindow:
@@ -511,10 +511,11 @@ class TpuWindowExec(CpuWindowExec):
                   for e, a, nf in self.spec.order_specs]
         sorter = TpuSortExec(specs, scan)
         carry = None
-        for sorted_batch in sorter.execute_partition(0):
-            out = self._window_one(sorted_batch)
-            out, carry = self._apply_carry(out, carry)
-            yield out
+        with closing_source(sorter.execute_partition(0)) as it:
+            for sorted_batch in it:
+                out = self._window_one(sorted_batch)
+                out, carry = self._apply_carry(out, carry)
+                yield out
 
     def _bounded_windows(self, batches: List[ColumnarBatch], P: int,
                          F: int):
@@ -550,28 +551,29 @@ class TpuWindowExec(CpuWindowExec):
         carry = None          # (P+F)-row tail batch of the prev combined
         skip_t = None         # device scalar: rows of carry already emitted
         last = None           # (windowed combined, rc_t, skip_t) to flush
-        for sb in sorter.execute_partition(0):
-            combined = sb if carry is None else concat_batches([carry, sb])
-            out = self._window_one(combined)
-            rc_t = jnp.asarray(rc_traceable(out.row_count), dtype=np.int64)
-            skip = jnp.zeros((), np.int64) if skip_t is None else skip_t
-            pos = jnp.arange(out.bucket, dtype=np.int64)
-            emit_hi = jnp.maximum(rc_t - F, skip)
-            emitted = compact_batch(out, (pos >= skip) & (pos < emit_hi))
-            emitted.names = out.names
-            yield emitted
-            # tail for the next chunk: last min(rc, span) rows of combined
-            carried_t = jnp.minimum(rc_t, span)
-            idx = jnp.maximum(rc_t - span, 0) + \
-                jnp.arange(bucket_rows(span), dtype=np.int64)
-            carry = gather_batch(
-                combined, jnp.minimum(idx, jnp.maximum(rc_t - 1, 0)),
-                DeferredCount(carried_t))
-            carry.names = combined.names
-            # of the carried rows, the last min(F, rc) were NOT emitted
-            skip_t = carried_t - jnp.minimum(jnp.asarray(F, np.int64),
-                                             rc_t - skip)
-            last = (out, rc_t, emit_hi)
+        with closing_source(sorter.execute_partition(0)) as it:
+            for sb in it:
+                combined = sb if carry is None else concat_batches([carry, sb])
+                out = self._window_one(combined)
+                rc_t = jnp.asarray(rc_traceable(out.row_count), dtype=np.int64)
+                skip = jnp.zeros((), np.int64) if skip_t is None else skip_t
+                pos = jnp.arange(out.bucket, dtype=np.int64)
+                emit_hi = jnp.maximum(rc_t - F, skip)
+                emitted = compact_batch(out, (pos >= skip) & (pos < emit_hi))
+                emitted.names = out.names
+                yield emitted
+                # tail for the next chunk: last min(rc, span) rows of combined
+                carried_t = jnp.minimum(rc_t, span)
+                idx = jnp.maximum(rc_t - span, 0) + \
+                    jnp.arange(bucket_rows(span), dtype=np.int64)
+                carry = gather_batch(
+                    combined, jnp.minimum(idx, jnp.maximum(rc_t - 1, 0)),
+                    DeferredCount(carried_t))
+                carry.names = combined.names
+                # of the carried rows, the last min(F, rc) were NOT emitted
+                skip_t = carried_t - jnp.minimum(jnp.asarray(F, np.int64),
+                                                 rc_t - skip)
+                last = (out, rc_t, emit_hi)
         if last is not None:
             out, rc_t, emit_hi = last
             # flush: the final chunk's trailing rows' frames are complete

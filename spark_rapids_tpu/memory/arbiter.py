@@ -153,7 +153,8 @@ class ResourceArbiter:
     rendezvous (reference: SparkResourceAdaptor's thread registry)."""
 
     def __init__(self):
-        self._cond = threading.Condition()
+        from spark_rapids_tpu.aux.lockorder import tracked_condition
+        self._cond = tracked_condition("arbiter")
         self._tasks: Dict[int, _TaskEntry] = {}
         #: task ids currently BUFN, mirrored from the entries so the
         #: catalog's fast path can test membership WITHOUT the arbiter

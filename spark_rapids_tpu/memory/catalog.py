@@ -26,7 +26,6 @@ import enum
 import itertools
 import os
 import tempfile
-import threading
 import time
 from typing import Dict, Optional
 
@@ -111,7 +110,8 @@ class BufferCatalog:
         self.host_limit = host_limit_bytes
         self._disk_dir = disk_dir
         self._buffers: Dict[int, _Buffer] = {}
-        self._lock = threading.RLock()
+        from spark_rapids_tpu.aux.lockorder import tracked_rlock
+        self._lock = tracked_rlock("catalog")
         self.device_bytes = 0
         #: high-watermark of device_bytes (resource sampler / Prometheus)
         self.device_peak_bytes = 0

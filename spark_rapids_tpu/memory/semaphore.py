@@ -31,7 +31,8 @@ class TpuSemaphore:
     def __init__(self, max_concurrent: int):
         self.max_concurrent = max_concurrent
         self._permits = max_concurrent
-        self._cond = threading.Condition()
+        from spark_rapids_tpu.aux.lockorder import tracked_condition
+        self._cond = tracked_condition("semaphore")
         self._holders: Dict[int, dict] = {}
         self._waiting = 0
 

@@ -53,6 +53,10 @@ class TpuSession:
         # lifecycle — dumps + escalates tasks that stop making progress
         from spark_rapids_tpu.memory.arbiter import sync_watchdog_from_conf
         sync_watchdog_from_conf(self.conf)
+        # runtime lock-order validator (spark.rapids.debug.lockOrder)
+        from spark_rapids_tpu.aux.lockorder import sync_from_conf \
+            as sync_lockorder
+        sync_lockorder(self.conf)
         #: temp views for the SQL front-end (name -> DataFrame)
         self._views: Dict[str, "DataFrame"] = {}
         #: row-based Hive UDF passthrough (name -> (fn, return_type));
@@ -86,6 +90,10 @@ class TpuSession:
             from spark_rapids_tpu.memory.arbiter import \
                 sync_watchdog_from_conf
             sync_watchdog_from_conf(self.conf)
+        elif key.startswith("spark.rapids.debug."):
+            from spark_rapids_tpu.aux.lockorder import sync_from_conf \
+                as sync_lockorder
+            sync_lockorder(self.conf)
         return self
 
     # -- SQL ----------------------------------------------------------------

@@ -12,9 +12,11 @@ logs PR 1's sink writes:
                  stalls / spill / recovery) plus operator ranking;
 - ``autotune`` — rule-based conf recommendations, each citing the
                  evidence events that triggered it;
-- ``compare``  — BENCH_r*.json diffing across PRs.
+- ``compare``  — BENCH_r*.json diffing across PRs;
+- ``lint``     — static AST analysis of the engine's own source against
+                 its declared invariants (docs/lint.md).
 
-CLI: ``python -m spark_rapids_tpu.tools <profile|autotune|compare> ...``
+CLI: ``python -m spark_rapids_tpu.tools <profile|autotune|compare|lint>``
 (stdlib-only; runs without jax or a device).
 """
 

@@ -7,6 +7,8 @@ Commands:
 - ``autotune <event-log>``: rule-based conf recommendations with cited
   evidence; ``--json`` prints the ready-to-apply conf dict.
 - ``compare <bench.json ...>``: diff BENCH payloads across runs/PRs.
+- ``lint [path]``: static engine-invariant analysis (docs/lint.md);
+  exits non-zero on any unsuppressed finding.
 """
 
 from __future__ import annotations
@@ -44,6 +46,23 @@ def _build_parser() -> argparse.ArgumentParser:
     cmp_p = sub.add_parser("compare", help="diff BENCH_r*.json payloads")
     cmp_p.add_argument("files", nargs="+")
     cmp_p.add_argument("--json", action="store_true")
+
+    lint = sub.add_parser("lint",
+                          help="static engine-invariant analysis")
+    lint.add_argument("path", nargs="?", default=None,
+                      help="tree to lint (default: the installed "
+                           "spark_rapids_tpu package)")
+    lint.add_argument("--format", choices=("text", "json"),
+                      default="text", help="output format")
+    lint.add_argument("--rule", default=None,
+                      help="comma-separated rule ids to run "
+                           "(default: all)")
+    lint.add_argument("--baseline", default=None,
+                      help="baseline JSON path (default: "
+                           "<root>/../.lint-baseline.json when present)")
+    lint.add_argument("--write-baseline", action="store_true",
+                      help="grandfather every active finding into the "
+                           "baseline file and exit 0")
     return p
 
 
@@ -81,6 +100,33 @@ def main(argv=None) -> int:
         else:
             sys.stdout.write(render_compare(args.files))
         return 0
+    if args.cmd == "lint":
+        from spark_rapids_tpu.tools.lint import (default_baseline_path,
+                                                 default_rules,
+                                                 render_text, run_lint,
+                                                 write_baseline)
+        rules = None
+        if args.rule:
+            wanted = {r.strip() for r in args.rule.split(",")}
+            rules = [r for r in default_rules() if r.id in wanted]
+            unknown = wanted - {r.id for r in rules}
+            if unknown:
+                print(f"unknown rule id(s): {', '.join(sorted(unknown))}",
+                      file=sys.stderr)
+                return 2
+        report = run_lint(root=args.path, rules=rules,
+                          baseline_path=args.baseline)
+        if args.write_baseline:
+            path = args.baseline or default_baseline_path(report.root)
+            n = write_baseline(path, report)
+            print(f"wrote {n} baseline entr{'y' if n == 1 else 'ies'} "
+                  f"to {path}")
+            return 0
+        if args.format == "json":
+            print(json.dumps(report.to_json(), indent=2))
+        else:
+            sys.stdout.write(render_text(report))
+        return report.exit_code
     return 2
 
 

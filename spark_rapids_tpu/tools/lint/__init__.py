@@ -1,0 +1,23 @@
+"""Engine invariant linter (``python -m spark_rapids_tpu.tools lint``).
+
+Static AST analysis of the engine's own source against the invariants
+the engine already declares at runtime — the conf registry, the event
+vocabulary, the chaos-point catalog, the single jit entry point, the
+close-propagation discipline, the retry-frame discipline and the lock
+hierarchy.  See docs/lint.md for the rule table and core.py for runner
+mechanics (suppression, baseline, JSON output).  Stdlib-only: runs
+without jax or a device, and never imports the code it checks.
+"""
+
+from spark_rapids_tpu.tools.lint.core import (Finding, LintReport, Rule,
+                                              default_baseline_path,
+                                              load_baseline, render_text,
+                                              run_lint, write_baseline)
+from spark_rapids_tpu.tools.lint.facts import Facts, load_facts
+from spark_rapids_tpu.tools.lint.rules import default_rules
+
+__all__ = [
+    "Facts", "Finding", "LintReport", "Rule", "default_baseline_path",
+    "default_rules", "load_baseline", "load_facts", "render_text",
+    "run_lint", "write_baseline",
+]

@@ -1,0 +1,331 @@
+"""Rule runner for the engine invariant linter.
+
+Reference analog: the reference plugin's largest component is static
+plan validation (GpuOverrides/RapidsMeta tag every operator against
+machine-checkable support rules; ``api_validation`` audits API drift).
+This package applies the same discipline to the ENGINE'S OWN SOURCE:
+invariants the previous PRs established by convention (one jit entry
+point, a closed conf registry, a closed event vocabulary, close_iter
+propagation, the lock hierarchy...) become executable rules.
+
+Mechanics:
+
+- every ``.py`` file under the linted root is parsed ONCE; a single AST
+  walk dispatches each node to every registered rule (full-repo runs
+  stay well under the 10s budget);
+- a ``Finding`` carries rule id, severity, ``file:line`` and a fix hint;
+- suppression is explicit and visible: an inline
+  ``# lint: ok=<rule-id>[,<rule-id>...] [-- reason]`` annotation on the
+  flagged line (or the line above) waives that line, and a baseline
+  JSON file grandfathers pre-existing findings by (rule, file, exact
+  source line text) so moved-but-unfixed code stays suppressed while NEW
+  violations surface;
+- ``--format json`` emits the machine schema CI consumes; the process
+  exits non-zero iff any unsuppressed error-severity finding remains.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from spark_rapids_tpu.tools.lint.facts import Facts, load_facts
+
+#: machine-output schema version (bump on breaking shape changes)
+LINT_SCHEMA_VERSION = 1
+
+#: default baseline file, resolved relative to the linted root's parent
+BASELINE_BASENAME = ".lint-baseline.json"
+
+_ANNOTATION = re.compile(r"#\s*lint:\s*ok=([A-Za-z0-9_,\-*]+)")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    severity: str               # "error" | "warning"
+    file: str                   # posix path relative to the linted root
+    line: int
+    message: str
+    hint: str = ""
+    #: None = active; "inline" / "baseline" = suppressed (still listed)
+    suppressed: Optional[str] = None
+
+    @property
+    def location(self) -> str:
+        return f"{self.file}:{self.line}"
+
+    def to_json(self) -> Dict:
+        return {"rule": self.rule, "severity": self.severity,
+                "file": self.file, "line": self.line,
+                "message": self.message, "hint": self.hint,
+                "suppressed": self.suppressed}
+
+
+class Rule:
+    """One invariant.  Subclasses implement any of ``visit`` (called for
+    every AST node of every file, the shared one-pass walk),
+    ``check_file`` (once per parsed file) and ``finalize`` (once, after
+    every file was walked — cross-file rules)."""
+
+    id: str = ""
+    severity: str = "error"
+    invariant: str = ""         # one line: what must hold (docs table)
+    rationale: str = ""         # why it must hold (docs table)
+    hint: str = ""              # how to fix / how to suppress
+
+    def visit(self, ctx: "LintContext", pf: "ParsedFile",
+              node: ast.AST) -> None:
+        pass
+
+    def check_file(self, ctx: "LintContext", pf: "ParsedFile") -> None:
+        pass
+
+    def finalize(self, ctx: "LintContext") -> None:
+        pass
+
+    def report(self, ctx: "LintContext", file: str, line: int,
+               message: str) -> None:
+        ctx.add_finding(Finding(self.id, self.severity, file, line,
+                                message, self.hint))
+
+
+@dataclasses.dataclass
+class ParsedFile:
+    path: str                   # absolute
+    rel: str                    # posix-relative to the linted root
+    tree: ast.Module
+    lines: List[str]
+    #: the tree flattened ONCE (ast.walk order): rules iterate this
+    #: instead of re-walking — the difference between a ~2s and a ~15s
+    #: full-repo run
+    nodes: List[ast.AST] = dataclasses.field(default_factory=list)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+class LintContext:
+    def __init__(self, root: str, files: List[ParsedFile], facts: Facts):
+        self.root = root
+        self.files = files
+        self.facts = facts
+        self.findings: List[Finding] = []
+        #: rule scratch space surfaced into the JSON output (the lock
+        #: graph publishes its edges here)
+        self.extras: Dict[str, object] = {}
+        self._by_path: Dict[str, ParsedFile] = {f.rel: f for f in files}
+
+    def file(self, rel: str) -> Optional[ParsedFile]:
+        return self._by_path.get(rel)
+
+    def add_finding(self, finding: Finding) -> None:
+        self.findings.append(finding)
+
+
+@dataclasses.dataclass
+class LintReport:
+    root: str
+    files_scanned: int
+    findings: List[Finding]
+    rules: List[Rule]
+    elapsed_s: float
+    fact_errors: List[str]
+    extras: Dict[str, object]
+
+    @property
+    def active(self) -> List[Finding]:
+        return [f for f in self.findings if f.suppressed is None]
+
+    @property
+    def active_errors(self) -> List[Finding]:
+        return [f for f in self.active if f.severity == "error"]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.active_errors or self.fact_errors else 0
+
+    def to_json(self) -> Dict:
+        by_sup: Dict[str, int] = {"inline": 0, "baseline": 0}
+        for f in self.findings:
+            if f.suppressed:
+                by_sup[f.suppressed] = by_sup.get(f.suppressed, 0) + 1
+        return {
+            "version": LINT_SCHEMA_VERSION,
+            "root": self.root,
+            "files_scanned": self.files_scanned,
+            "elapsed_s": round(self.elapsed_s, 3),
+            "rules": [{"id": r.id, "severity": r.severity,
+                       "invariant": r.invariant} for r in self.rules],
+            "findings": [f.to_json() for f in self.findings],
+            "summary": {
+                "active_errors": len(self.active_errors),
+                "active_warnings": len(self.active)
+                - len(self.active_errors),
+                "suppressed_inline": by_sup.get("inline", 0),
+                "suppressed_baseline": by_sup.get("baseline", 0),
+            },
+            "fact_errors": list(self.fact_errors),
+            "extras": {k: sorted(map(list, v))
+                       if isinstance(v, (set, frozenset)) else v
+                       for k, v in self.extras.items()},
+        }
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def default_baseline_path(root: str) -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(root)),
+                        BASELINE_BASENAME)
+
+
+def load_baseline(path: Optional[str]) -> Set[Tuple[str, str, str]]:
+    """Entries are (rule, file, stripped source line text): robust to
+    line-number drift, invalidated the moment the flagged line changes."""
+    if not path or not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    out = set()
+    for e in data.get("entries", []):
+        out.add((e["rule"], e["file"], e["line_text"]))
+    return out
+
+
+def write_baseline(path: str, report: "LintReport") -> int:
+    """Grandfathers every ACTIVE finding of ``report`` into ``path``;
+    returns the entry count.  Re-reads the flagged files so it needs
+    only the report."""
+    cache: Dict[str, List[str]] = {}
+
+    def line_text(rel: str, lineno: int) -> str:
+        if rel not in cache:
+            try:
+                with open(os.path.join(report.root, rel),
+                          encoding="utf-8") as f:
+                    cache[rel] = f.read().splitlines()
+            except OSError:
+                cache[rel] = []
+        lines = cache[rel]
+        return lines[lineno - 1] if 1 <= lineno <= len(lines) else ""
+
+    entries = [{"rule": f.rule, "file": f.file,
+                "line_text": line_text(f.file, f.line).strip()}
+               for f in report.findings if f.suppressed is None]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": LINT_SCHEMA_VERSION, "entries": entries},
+                  fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return len(entries)
+
+
+def _apply_suppressions(ctx: LintContext,
+                        baseline: Set[Tuple[str, str, str]]) -> None:
+    for f in ctx.findings:
+        pf = ctx.file(f.file)
+        if pf is None:
+            continue
+        for lineno in (f.line, f.line - 1):
+            m = _ANNOTATION.search(pf.line_text(lineno))
+            if m:
+                ids = {s.strip() for s in m.group(1).split(",")}
+                if f.rule in ids or "*" in ids:
+                    f.suppressed = "inline"
+                    break
+        if f.suppressed is None and baseline:
+            key = (f.rule, f.file, pf.line_text(f.line).strip())
+            if key in baseline:
+                f.suppressed = "baseline"
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+def _collect_files(root: str) -> List[ParsedFile]:
+    out: List[ParsedFile] = []
+    root = os.path.abspath(root)
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__", ".git"))
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    src = f.read()
+                tree = ast.parse(src, filename=path)
+            except (OSError, SyntaxError) as e:
+                # a file the linter cannot parse is itself a finding;
+                # surfaced via a pseudo-file with no tree would
+                # complicate every rule — raise instead (CI wants a
+                # loud failure for a syntax error anyway)
+                raise RuntimeError(f"lint: cannot parse {path}: {e}")
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            out.append(ParsedFile(path, rel, tree, src.splitlines(),
+                                  list(ast.walk(tree))))
+    return out
+
+
+def run_lint(root: Optional[str] = None,
+             rules: Optional[Sequence[Rule]] = None,
+             baseline_path: Optional[str] = None,
+             facts: Optional[Facts] = None) -> LintReport:
+    """Lints every ``.py`` under ``root`` (default: the installed
+    spark_rapids_tpu package) and returns the report.  ``baseline_path``
+    defaults to ``<root>/../.lint-baseline.json`` when present."""
+    from spark_rapids_tpu.tools.lint.rules import default_rules
+    t0 = time.monotonic()
+    facts = facts or load_facts()
+    root = os.path.abspath(root or facts.package_root)
+    rules = list(rules) if rules is not None else default_rules()
+    files = _collect_files(root)
+    ctx = LintContext(root, files, facts)
+    visitors = [r for r in rules
+                if type(r).visit is not Rule.visit]
+    per_file = [r for r in rules
+                if type(r).check_file is not Rule.check_file]
+    for pf in files:
+        for node in pf.nodes:
+            for rule in visitors:
+                rule.visit(ctx, pf, node)
+        for rule in per_file:
+            rule.check_file(ctx, pf)
+    for rule in rules:
+        rule.finalize(ctx)
+    if baseline_path is None:
+        candidate = default_baseline_path(root)
+        baseline_path = candidate if os.path.exists(candidate) else None
+    _apply_suppressions(ctx, load_baseline(baseline_path))
+    ctx.findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return LintReport(root, len(files), ctx.findings, rules,
+                      time.monotonic() - t0, list(facts.errors),
+                      ctx.extras)
+
+
+def render_text(report: LintReport) -> str:
+    lines = [f"== lint: {report.files_scanned} file(s) under "
+             f"{report.root} ({report.elapsed_s:.2f}s) =="]
+    for err in report.fact_errors:
+        lines.append(f"!! fact extraction failed: {err}")
+    for f in report.findings:
+        mark = "" if f.suppressed is None else f"  [{f.suppressed}]"
+        lines.append(f"{f.location}: {f.severity}: {f.rule}: "
+                     f"{f.message}{mark}")
+        if f.hint and f.suppressed is None:
+            lines.append(f"    hint: {f.hint}")
+    active = report.active
+    lines.append(f"{len(active)} finding(s) "
+                 f"({len(report.findings) - len(active)} suppressed); "
+                 + ("FAIL" if report.exit_code else "OK"))
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,219 @@
+"""Static extraction of the engine facts the lint rules check against.
+
+Every catalog the engine already maintains at runtime — the ConfEntry
+registry (config.py), the event-kind vocabulary (aux/events.py
+EVENT_KINDS), the chaos fault-point table (aux/faults.py CHAOS_POINTS),
+the canonical lock order (aux/lockorder.py CANONICAL_LOCK_ORDER) and the
+generated conf reference (docs/configs.md) — is re-derived here by
+PARSING, never importing: the linter must run stdlib-only (no jax, no
+device) and must see the source as committed, not as imported (an
+import-time registration failure is exactly the kind of drift it
+exists to catch).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+#: conf keys registered DYNAMICALLY (plan/overrides.py registers one
+#: enable conf per operator rule): literals under these prefixes resolve
+#: against docs/configs.md rows instead of the static config.py registry
+DYNAMIC_CONF_PREFIXES = (
+    "spark.rapids.sql.exec.",
+    "spark.rapids.sql.expression.",
+)
+
+_CONF_FACTORIES = frozenset({
+    "conf_bool", "conf_int", "conf_float", "conf_str", "conf_bytes",
+    "ConfEntry",
+})
+
+
+@dataclasses.dataclass
+class ConfKeyInfo:
+    key: str
+    const_name: Optional[str]   # module-level constant holding the entry
+    line: int                   # registration call line in config.py
+    #: the key STRING LITERAL's own line (differs from ``line`` on
+    #: multi-line registrations) — the dead-key check must skip exactly
+    #: this occurrence, not the call line
+    key_line: int = 0
+
+
+@dataclasses.dataclass
+class Facts:
+    """Parsed engine catalogs (empty collections when a source file is
+    missing; ``errors`` records what could not be derived)."""
+    package_root: str
+    repo_root: str
+    event_kinds: Set[str] = dataclasses.field(default_factory=set)
+    event_kinds_line: int = 0
+    fault_points: Set[str] = dataclasses.field(default_factory=set)
+    conf_registered: Dict[str, ConfKeyInfo] = \
+        dataclasses.field(default_factory=dict)
+    conf_doc_keys: Set[str] = dataclasses.field(default_factory=set)
+    canonical_lock_order: Tuple[str, ...] = ()
+    errors: List[str] = dataclasses.field(default_factory=list)
+
+
+def _parse(path: str) -> Optional[ast.Module]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+
+
+def _string_set_from_assign(tree: ast.Module, name: str):
+    """(values, lineno) of a module-level ``NAME = frozenset({...})`` /
+    set / tuple / list of string literals."""
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == name
+                   for t in node.targets):
+            continue
+        values: Set[str] = set()
+        for sub in ast.walk(node.value):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                values.add(sub.value)
+        return values, node.lineno
+    return None, 0
+
+
+def _load_event_kinds(facts: Facts) -> None:
+    tree = _parse(os.path.join(facts.package_root, "aux", "events.py"))
+    if tree is None:
+        facts.errors.append("cannot parse aux/events.py")
+        return
+    kinds, line = _string_set_from_assign(tree, "EVENT_KINDS")
+    if kinds is None:
+        facts.errors.append("EVENT_KINDS not found in aux/events.py")
+        return
+    facts.event_kinds = kinds
+    facts.event_kinds_line = line
+
+
+def _load_fault_points(facts: Facts) -> None:
+    tree = _parse(os.path.join(facts.package_root, "aux", "faults.py"))
+    if tree is None:
+        facts.errors.append("cannot parse aux/faults.py")
+        return
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "CHAOS_POINTS"
+                   for t in targets):
+            continue
+        if isinstance(value, ast.Dict):
+            for v in value.values:
+                # value is (point, exc_factory): the first tuple element
+                if isinstance(v, ast.Tuple) and v.elts and \
+                        isinstance(v.elts[0], ast.Constant) and \
+                        isinstance(v.elts[0].value, str):
+                    facts.fault_points.add(v.elts[0].value)
+        return
+    facts.errors.append("CHAOS_POINTS not found in aux/faults.py")
+
+
+def _load_conf_registry(facts: Facts) -> None:
+    tree = _parse(os.path.join(facts.package_root, "config.py"))
+    if tree is None:
+        facts.errors.append("cannot parse config.py")
+        return
+    for node in ast.walk(tree):
+        value = None
+        const = None
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call):
+            value = node.value
+            if len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                const = node.targets[0].id
+        elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            value = node.value
+        if value is None:
+            continue
+        fn = value.func
+        fname = fn.id if isinstance(fn, ast.Name) else \
+            (fn.attr if isinstance(fn, ast.Attribute) else None)
+        if fname not in _CONF_FACTORIES:
+            continue
+        if value.args and isinstance(value.args[0], ast.Constant) and \
+                isinstance(value.args[0].value, str):
+            key = value.args[0].value
+            facts.conf_registered[key] = ConfKeyInfo(key, const,
+                                                     value.lineno,
+                                                     value.args[0].lineno)
+    if not facts.conf_registered:
+        facts.errors.append("no ConfEntry registrations found in config.py")
+
+
+_DOC_ROW = re.compile(r"^\| (spark\.[^ |]+) \|", re.M)
+
+
+def _load_conf_docs(facts: Facts) -> None:
+    path = os.path.join(facts.repo_root, "docs", "configs.md")
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        facts.errors.append("docs/configs.md not found")
+        return
+    facts.conf_doc_keys = set(_DOC_ROW.findall(text))
+
+
+def _load_lock_order(facts: Facts) -> None:
+    tree = _parse(os.path.join(facts.package_root, "aux", "lockorder.py"))
+    if tree is None:
+        facts.errors.append("cannot parse aux/lockorder.py")
+        return
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        if not any(isinstance(t, ast.Name) and
+                   t.id == "CANONICAL_LOCK_ORDER" for t in targets):
+            continue
+        names = [sub.value for sub in ast.walk(value)
+                 if isinstance(sub, ast.Constant)
+                 and isinstance(sub.value, str)]
+        facts.canonical_lock_order = tuple(names)
+        return
+    facts.errors.append("CANONICAL_LOCK_ORDER not found in aux/lockorder.py")
+
+
+def default_package_root() -> str:
+    """The spark_rapids_tpu package directory this module ships inside —
+    the engine source the facts describe, regardless of which tree is
+    being linted (fixture tests lint tmp dirs against the real facts)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def load_facts(package_root: Optional[str] = None) -> Facts:
+    pkg = os.path.abspath(package_root or default_package_root())
+    facts = Facts(package_root=pkg, repo_root=os.path.dirname(pkg))
+    _load_event_kinds(facts)
+    _load_fault_points(facts)
+    _load_conf_registry(facts)
+    _load_conf_docs(facts)
+    _load_lock_order(facts)
+    return facts

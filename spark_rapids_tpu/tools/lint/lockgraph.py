@@ -1,0 +1,239 @@
+"""Static lock-acquisition graph over the engine's concurrency core.
+
+Lock identity is keyed off the ``aux.lockorder`` factories: any class
+whose ``__init__`` (or any method) assigns
+``self.<attr> = tracked_condition("<name>")`` / ``tracked_rlock(...)``
+is a *lock class* owning lock ``<name>``.  The analyzer then computes,
+for every method of a lock class (and every module-level function in a
+file that contains one), the set of locks a call to it may acquire —
+its own ``with self.<lock-attr>:`` blocks plus, transitively, the locks
+of every resolvable call it makes — and finally walks each ``with``
+block to record (held -> acquired) edges with call-site locations.
+
+Call resolution is deliberately heuristic (this is a lint, not a
+verifier): a method name defined by exactly ONE lock class resolves to
+it; an ambiguous name resolves only when the receiver expression's
+tokens name the lock ("rt.semaphore.release_all()", "arb.…",
+"get_arbiter().…"); anything unresolvable is skipped — conservative
+toward silence, with every REAL cross-lock call in the engine resolving
+through one of those two paths today (pinned by tests/test_lint.py).
+Nested ``def``/``lambda`` bodies inside a ``with`` block count as
+running under the lock: the spool passes closures into
+``wait_cancellable`` exactly that way.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+_FACTORY_NAMES = frozenset({"tracked_condition", "tracked_rlock"})
+
+
+def _is_factory(fn: ast.AST) -> bool:
+    if isinstance(fn, ast.Name):
+        return fn.id in _FACTORY_NAMES
+    if isinstance(fn, ast.Attribute):
+        return fn.attr in _FACTORY_NAMES
+    return False
+
+
+@dataclasses.dataclass(frozen=True)
+class LockEdge:
+    held: str
+    acquired: str
+    file: str
+    line: int
+
+
+@dataclasses.dataclass
+class _Callable:
+    """One analyzable function: a lock-class method or a module-level
+    function in a file containing a lock class."""
+    node: ast.AST               # FunctionDef
+    file: str
+    own_lock: Optional[str]     # lock of the defining class (methods)
+    lock_attr: Optional[str]    # the class's lock attribute name
+
+
+class LockGraph:
+    def __init__(self):
+        #: lock name -> (file, class name) it was declared in
+        self.locks: Dict[str, Tuple[str, str]] = {}
+        self.edges: Set[LockEdge] = set()
+        #: method name -> {lock name of defining class}
+        self._method_locks: Dict[str, Set[str]] = {}
+        #: (lock, method name) -> _Callable
+        self._methods: Dict[Tuple[str, str], _Callable] = {}
+        #: (file, func name) -> _Callable (module level)
+        self._module_funcs: Dict[Tuple[str, str], _Callable] = {}
+        #: bare name -> _Callable for GLOBALLY-UNIQUE module functions:
+        #: helpers like plan/base.release_semaphore_for_wait are imported
+        #: into the lock files and invoked under their locks
+        self._global_funcs: Dict[str, Optional[_Callable]] = {}
+        self._acquire_memo: Dict[int, Set[str]] = {}
+
+    # -- discovery -----------------------------------------------------------
+
+    def discover(self, files) -> None:
+        for pf in files:
+            for node in pf.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    self._discover_class(pf, node)
+        lock_files = {f for f, _cls in self.locks.values()}
+        for pf in files:
+            for node in pf.tree.body:
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                fn = _Callable(node, pf.rel, None, None)
+                if pf.rel in lock_files:
+                    self._module_funcs[(pf.rel, node.name)] = fn
+                # None marks a name defined more than once: ambiguous
+                self._global_funcs[node.name] = (
+                    fn if node.name not in self._global_funcs else None)
+
+    def _discover_class(self, pf, cls: ast.ClassDef) -> None:
+        lock_attr = lock_name = None
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            call = node.value
+            if not (isinstance(call, ast.Call) and _is_factory(call.func)):
+                continue
+            if not (call.args and isinstance(call.args[0], ast.Constant)):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    lock_attr = t.attr
+                    lock_name = call.args[0].value
+        if lock_name is None:
+            return
+        self.locks[lock_name] = (pf.rel, cls.name)
+        for node in cls.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._methods[(lock_name, node.name)] = _Callable(
+                    node, pf.rel, lock_name, lock_attr)
+                self._method_locks.setdefault(node.name,
+                                              set()).add(lock_name)
+
+    # -- call resolution -----------------------------------------------------
+
+    @staticmethod
+    def _receiver_tokens(expr: ast.AST) -> List[str]:
+        out = []
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name):
+                out.append(sub.id)
+            elif isinstance(sub, ast.Attribute):
+                out.append(sub.attr)
+        return out
+
+    def _hint_lock(self, tokens: List[str]) -> Optional[str]:
+        for tok in tokens:
+            low = tok.lower().lstrip("_")
+            for lock in self.locks:
+                if lock in low or (len(low) >= 3 and
+                                   lock.startswith(low)):
+                    return lock
+        return None
+
+    def _resolve_call(self, call: ast.Call,
+                      caller: _Callable) -> Optional[_Callable]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            local = self._module_funcs.get((caller.file, fn.id))
+            return local or self._global_funcs.get(fn.id)
+        if not isinstance(fn, ast.Attribute):
+            return None
+        name = fn.attr
+        if isinstance(fn.value, ast.Name) and fn.value.id == "self" and \
+                caller.own_lock is not None:
+            return self._methods.get((caller.own_lock, name))
+        owners = self._method_locks.get(name)
+        if not owners:
+            return None
+        if len(owners) == 1:
+            return self._methods[(next(iter(owners)), name)]
+        hinted = self._hint_lock(self._receiver_tokens(fn.value))
+        if hinted in owners:
+            return self._methods[(hinted, name)]
+        return None
+
+    # -- acquire sets --------------------------------------------------------
+
+    def _own_with_locks(self, fn: _Callable, node: ast.With) -> Set[str]:
+        """Locks taken by this ``with`` statement's context items."""
+        out: Set[str] = set()
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Attribute) and \
+                    isinstance(expr.value, ast.Name) and \
+                    expr.value.id == "self" and \
+                    fn.lock_attr is not None and \
+                    expr.attr == fn.lock_attr and fn.own_lock:
+                out.add(fn.own_lock)
+        return out
+
+    def acquire_set(self, fn: _Callable,
+                    _stack: Optional[Set[int]] = None) -> Set[str]:
+        """Which locks may a call to ``fn`` acquire (transitively within
+        the analyzed universe)."""
+        key = id(fn.node)
+        memo = self._acquire_memo.get(key)
+        if memo is not None:
+            return memo
+        stack = _stack or set()
+        if key in stack:
+            return set()
+        stack.add(key)
+        out: Set[str] = set()
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.With):
+                out |= self._own_with_locks(fn, node)
+            elif isinstance(node, ast.Call):
+                target = self._resolve_call(node, fn)
+                if target is not None:
+                    out |= self.acquire_set(target, stack)
+        stack.discard(key)
+        self._acquire_memo[key] = out
+        return out
+
+    # -- edges ---------------------------------------------------------------
+
+    def build_edges(self) -> Set[LockEdge]:
+        callables = list(self._methods.values()) + \
+            list(self._module_funcs.values())
+        for fn in callables:
+            self._edges_in(fn)
+        return self.edges
+
+    def _edges_in(self, fn: _Callable) -> None:
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.With):
+                continue
+            held = self._own_with_locks(fn, node)
+            if not held:
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                target = self._resolve_call(sub, fn)
+                if target is None:
+                    continue
+                acquired = self.acquire_set(target)
+                for h in held:
+                    for a in acquired:
+                        if a != h:
+                            self.edges.add(LockEdge(
+                                h, a, fn.file, sub.lineno))
+
+
+def analyze(files) -> LockGraph:
+    g = LockGraph()
+    g.discover(files)
+    g.build_edges()
+    return g

@@ -311,6 +311,15 @@ def render_report(profiles: List[QueryProfile], diag: ReadDiagnostics,
         if att.recovery_counts:
             lines.append("  Recovery ledger: " + " ".join(
                 f"{k}={v}" for k, v in sorted(att.recovery_counts.items())))
+        lock_violations = qp.events_of("lockOrderViolation")
+        if lock_violations:
+            pairs = sorted({f"{ev.payload.get('held')}->"
+                            f"{ev.payload.get('acquiring')}"
+                            for ev in lock_violations})
+            lines.append(f"  !! {len(lock_violations)} lock-order "
+                         f"violation(s) recorded by the runtime validator "
+                         f"({', '.join(pairs)}) — acquisition went "
+                         "backward against the canonical order")
         if show_timeline:
             _render_timeline(qp, lines)
         if qp.samples:

@@ -33,6 +33,28 @@ from spark_rapids_tpu.memory.spillable import SpillableColumnarBatch
 from spark_rapids_tpu.session import TpuSession
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _lock_order_validator():
+    """The whole arbiter/semaphore contention suite runs with the runtime
+    lock-order validator armed (spark.rapids.debug.lockOrder semantics)
+    and must record ZERO violations — the runtime half of the lint
+    rule's static/runtime cross-check (tools/lint `lock-order`)."""
+    from spark_rapids_tpu.aux import lockorder
+    lockorder.reset_observations()
+    # force, not set: tests in this module construct TpuSessions whose
+    # default conf would otherwise sync the validator back OFF
+    lockorder.force_enabled(True)
+    yield
+    violations = lockorder.violation_pairs()
+    edges = lockorder.observed_edges()
+    lockorder.force_enabled(None)
+    lockorder.set_enabled(False)
+    lockorder.reset_observations()
+    assert not violations, \
+        f"lock-order violations under contention: {violations} " \
+        f"(observed edges: {edges})"
+
+
 @pytest.fixture(autouse=True)
 def _clean_chaos():
     F.disarm_all()

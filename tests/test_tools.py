@@ -5,17 +5,13 @@ JSONL sink, the event-kind catalog, and the Prometheus exposition
 format (reference: spark-rapids-tools Qualification/Profiling +
 AutoTuner over Spark event logs)."""
 
-import ast
 import gzip
 import json
 import os
-import pathlib
-import time
 
 import numpy as np
 import pytest
 
-import spark_rapids_tpu
 from spark_rapids_tpu import config as C
 from spark_rapids_tpu import functions as F
 from spark_rapids_tpu.aux import events as EV
@@ -287,6 +283,31 @@ def test_profile_report_flags_ring_drops(tmp_path):
     assert diag.dropped_events == 12
     report = render_report(profiles, diag)
     assert "dropped" in report and "lower bound" in report
+
+
+def test_profile_report_flags_lock_order_violations(tmp_path):
+    """A query whose log carries lockOrderViolation events (the runtime
+    spark.rapids.debug.lockOrder validator) gets a !! line naming the
+    backward edges; a clean query gets none."""
+    log = tmp_path / "lock.jsonl"
+    lines = [
+        _jline("queryStart", 4, 1, 1.0, description="q"),
+        _jline("lockOrderViolation", 4, 1, 1.5, held="arbiter",
+               acquiring="catalog",
+               order="spool<catalog<semaphore<arbiter"),
+        _jline("queryEnd", 4, 1, 2.0, duration_s=1.0),
+    ]
+    log.write_text("\n".join(lines) + "\n")
+    profiles, diag = load_profiles(str(log))
+    report = render_report(profiles, diag)
+    assert "1 lock-order violation(s)" in report
+    assert "arbiter->catalog" in report
+    clean = tmp_path / "clean.jsonl"
+    clean.write_text("\n".join([
+        _jline("queryStart", 5, 1, 1.0, description="q"),
+        _jline("queryEnd", 5, 1, 2.0, duration_s=1.0)]) + "\n")
+    profiles, diag = load_profiles(str(clean))
+    assert "lock-order" not in render_report(profiles, diag)
 
 
 # ---------------------------------------------------------------------------
@@ -584,52 +605,33 @@ def test_sample_payload_reflects_pool_state(tmp_path):
 
 
 # ---------------------------------------------------------------------------
-# event-kind catalog (ast over every call site)
+# event-kind catalog (migrated into the lint rule `event-catalog`; these
+# thin tier-1 wrappers keep the invariant in this suite)
 # ---------------------------------------------------------------------------
 
+def _run_event_catalog_rule():
+    from spark_rapids_tpu.tools.lint import run_lint
+    from spark_rapids_tpu.tools.lint.rules import EventCatalogRule
+    return run_lint(rules=[EventCatalogRule()], baseline_path="")
+
+
 def test_every_emit_call_site_uses_cataloged_kind():
-    pkg = pathlib.Path(spark_rapids_tpu.__file__).parent
-    offenders = []
-    sites = 0
-    for py in sorted(pkg.rglob("*.py")):
-        tree = ast.parse(py.read_text(), filename=str(py))
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            fn = node.func
-            name = fn.id if isinstance(fn, ast.Name) else (
-                fn.attr if isinstance(fn, ast.Attribute) else None)
-            if name not in ("emit", "record_event"):
-                continue
-            if not node.args:
-                continue
-            first = node.args[0]
-            if isinstance(first, ast.Constant) and \
-                    isinstance(first.value, str):
-                sites += 1
-                if first.value not in EV.EVENT_KINDS:
-                    offenders.append((str(py.relative_to(pkg)),
-                                      first.value))
-    assert sites >= 25, f"expected the full emit surface, found {sites}"
-    assert not offenders, \
-        f"emit sites using uncataloged kinds: {offenders}"
+    """Every emit()/record_event kind literal is cataloged — now a lint
+    rule (tools/lint rules.py `event-catalog`); this wrapper runs the
+    rule and asserts zero findings."""
+    report = _run_event_catalog_rule()
+    offenders = [f.location + ": " + f.message
+                 for f in report.active
+                 if "not in EVENT_KINDS" in f.message]
+    assert not offenders, f"emit sites using uncataloged kinds: {offenders}"
 
 
 def test_catalog_covers_no_dead_kinds():
-    """Every cataloged kind is either emitted somewhere in the package or
-    is an explicitly file-level kind (header)."""
-    pkg = pathlib.Path(spark_rapids_tpu.__file__).parent
-    emitted = set()
-    for py in sorted(pkg.rglob("*.py")):
-        if py.name == "events.py" and py.parent.name == "aux":
-            continue    # the catalog definition itself doesn't count
-        tree = ast.parse(py.read_text())
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Constant) and \
-                    isinstance(node.value, str) and \
-                    node.value in EV.EVENT_KINDS:
-                emitted.add(node.value)
-    dead = EV.EVENT_KINDS - emitted
+    """Every cataloged kind is referenced outside the catalog — the dead
+    direction of the same lint rule."""
+    report = _run_event_catalog_rule()
+    dead = [f.location + ": " + f.message
+            for f in report.active if "never referenced" in f.message]
     assert not dead, f"cataloged kinds never referenced: {dead}"
 
 
