@@ -43,6 +43,15 @@ _PAYLOAD = {
     "value": 0, "unit": "rows/s", "vs_baseline": 0.0,
     "error": "primary phase exceeded BENCH_BUDGET_S",
 }
+#: live progress the alarm handler reads (BENCH_r05 regression: a blown
+#: budget printed value 0 with no metric and no culprit).  Phases update
+#: it as they start; the primary phase adds rows as passes finish, so a
+#: mid-phase alarm still reports a partial rows/s and WHAT was running.
+_PROGRESS = {"phase": "init", "rows_done": 0}
+
+
+def _set_phase(name: str):
+    _PROGRESS["phase"] = name
 
 
 def _remaining() -> float:
@@ -59,6 +68,24 @@ def _swap_payload(out: dict):
 
 def _on_alarm(signum, frame):
     _PAYLOAD.setdefault("budget_exceeded", True)
+    if _PAYLOAD.get("error"):
+        # the primary metric never landed: report the partial throughput
+        # of whatever DID finish plus the phase that blew the budget,
+        # never a bare value:0
+        elapsed = max(time.perf_counter() - _T0, 1e-9)
+        done = int(_PROGRESS["rows_done"])
+        _PAYLOAD["phase"] = _PROGRESS["phase"]
+        if done > 0:
+            _PAYLOAD["value"] = round(done / elapsed)
+            _PAYLOAD["partial"] = True
+            _PAYLOAD["rows_processed"] = done
+    else:
+        # primary metric exists; still record where the budget died
+        _PAYLOAD.setdefault("budget_phase", _PROGRESS["phase"])
+    try:
+        _PAYLOAD.setdefault("encoding", _encoding_payload())
+    except Exception:  # noqa: BLE001 — the failsafe line must print
+        pass
     sys.stdout.write(json.dumps(_PAYLOAD) + "\n")
     sys.stdout.flush()
     os._exit(0)
@@ -134,6 +161,7 @@ def main():
         # payload's "compile" field)
         for _ in range(warmups):
             _query(table).collect()
+            _PROGRESS["rows_done"] += n_rows
         warm = cstats()
         best = float("inf")
         result = None
@@ -141,6 +169,7 @@ def main():
             t0 = time.perf_counter()
             result = _query(table).collect()
             best = min(best, time.perf_counter() - t0)
+            _PROGRESS["rows_done"] += n_rows
         steady = cstats()
         compile_info = {
             "warmup_compile_s": round(warm["compile_s"]
@@ -187,6 +216,7 @@ def main():
             f"device backend unavailable: {type(e).__name__}: {e}"[:300]
         print(json.dumps(_PAYLOAD))
         return 1
+    _set_phase("tpu_primary")
     best_tpu, r_tpu, tpu_compile = measure(tpu, warmups=2, runs=reps)
     # per-query attribution of the LAST timed device run (query-scoped
     # tracing): node-level rows/batches/opTime plus spill/retry/semaphore
@@ -200,6 +230,7 @@ def main():
     # numpy has no warmup effect worth paying for twice — one timed pass
     # leaves budget for the TPC-DS phase
     big = n_rows >= 32_000_000
+    _set_phase("cpu_primary")
     best_cpu, r_cpu, _ = measure(cpu, warmups=0 if big else 1,
                                  runs=1 if big else reps)
 
@@ -258,6 +289,9 @@ def main():
     # so BENCH_*.json tracks whether decode/transfer/compute actually
     # overlapped (overlap_ratio 0 = fully serial boundaries)
     out["pipeline"] = _pipeline_payload()
+    # encoded-execution ledger (columnar/encoding.py): bytes the
+    # encoding kept off the tunnel, bytes decoded late, fallback count
+    out["encoding"] = _encoding_payload()
     # primary number exists: from here on the failsafe prints it verbatim
     signal.alarm(0)          # quiesce while the payload is swapped
     _PAYLOAD.clear()
@@ -271,7 +305,20 @@ def main():
     sys.stderr.flush()
     _arm(_remaining())
 
+    if os.environ.get("BENCH_SKIP_ENCODING", "") != "1" and _remaining() > 30:
+        # encoded-vs-eager microbenchmark: filter+agg over a
+        # dictionary-encoded parquet column, H2D/decode deltas from the
+        # encoding ledger (ISSUE 11 acceptance evidence)
+        _set_phase("encoding_microbench")
+        try:
+            out["encoding"]["microbench"] = _encoding_microbench(tpu)
+        except Exception as e:  # keep the primary metric reportable
+            out["encoding"]["microbench_error"] = \
+                f"{type(e).__name__}: {e}"
+        _swap_payload(out)
+
     if os.environ.get("BENCH_SKIP_PIPELINE", "") != "1" and _remaining() > 30:
+        _set_phase("pipeline_microbench")
         # transfer-overlap microbenchmark: the primary pipeline with
         # prefetch spools on vs off, plus the overlap ratio measured over
         # the pipelined runs (stall time below the serial sum = win)
@@ -286,6 +333,7 @@ def main():
     if os.environ.get("BENCH_SKIP_TPCDS", "") != "1" and _remaining() > 45:
         # TPC-DS before the scaling curve: per-query speedups are the
         # scarcer signal when the budget runs short
+        _set_phase("tpcds")
         tpcds: dict = {"partial": True}
         out["tpcds"] = tpcds
         _swap_payload(out)
@@ -301,6 +349,7 @@ def main():
         # own table at the SAME partition count as the primary phase (a
         # limit() slice would run single-partition and skew the diagnostic);
         # tables are dropped between points so device residency stays ~1x.
+        _set_phase("scaling")
         try:
             curve = {str(n_rows): round(rows_per_sec)}
             ctable = None
@@ -334,6 +383,11 @@ def main():
     if ev_log:
         # re-parse so the payload covers the follow-on phases' queries too
         out["event_log"] = _event_log_payload(ev_log)
+    prev_enc = out.get("encoding", {})
+    out["encoding"] = _encoding_payload()
+    for k in ("microbench", "microbench_error"):
+        if k in prev_enc:
+            out["encoding"][k] = prev_enc[k]
     signal.alarm(0)
     print(json.dumps(out))
     return 0
@@ -370,6 +424,76 @@ def _chaos_payload() -> dict:
     payload.update(recovery_stats())
     payload["faults_injected"] = sum(fault_stats().values())
     return payload
+
+
+def _encoding_payload() -> dict:
+    """Encoded-execution counters observed so far this process
+    (columnar/encoding.py ledger): encoded bytes in/out, decode-avoided
+    bytes, late-decoded bytes and the dictionary fallback count."""
+    from spark_rapids_tpu.columnar.encoding import encoding_stats
+    return encoding_stats()
+
+
+def _encoding_microbench(tpu) -> dict:
+    """Filter+agg over a dictionary-encoded parquet string column with
+    encoding ON vs OFF (eager decode): same query, same file — the
+    ledger deltas show the avoided H2D bytes and the wall-clock the
+    decode bucket gives back."""
+    import tempfile
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.expressions.base import col, lit
+    from spark_rapids_tpu.columnar.encoding import encoding_stats
+    rng = np.random.default_rng(11)
+    n = int(os.environ.get("BENCH_ENCODING_ROWS", 2_000_000))
+    cats = np.array([f"cat{i:03d}" for i in range(64)])
+    tbl = pa.table({"s": pa.array(cats[rng.integers(0, 64, n)]),
+                    "v": rng.integers(0, 1000, n)})
+    d = tempfile.mkdtemp(prefix="bench-enc-")
+    path = os.path.join(d, "enc.parquet")
+    pq.write_table(tbl, path)
+
+    def q(session):
+        return (session.read.parquet(path)
+                .filter(col("s") == lit("cat007"))
+                .groupBy("s")
+                .agg(F.sum("v").alias("sv"), F.count("v").alias("c"))
+                .collect())
+
+    res = {"rows": n}
+    try:
+        for key, flag in (("eager_s", "false"), ("encoded_s", "true")):
+            tpu.set_conf("spark.rapids.sql.encoding.enabled", flag)
+            q(tpu)                    # warm (compile + any scan cache)
+            s0 = encoding_stats()
+            best = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                rows = q(tpu)
+                best = min(best, time.perf_counter() - t0)
+            s1 = encoding_stats()
+            res[key] = round(best, 4)
+            if flag == "true":
+                res["encoded_bytes_in"] = \
+                    s1["encoded_bytes_in"] - s0["encoded_bytes_in"]
+                res["decode_avoided_bytes"] = \
+                    s1["decode_avoided_bytes"] - s0["decode_avoided_bytes"]
+                res["dict_fallbacks"] = \
+                    s1["dict_fallbacks"] - s0["dict_fallbacks"]
+                res["groups"] = len(rows)
+    finally:
+        tpu.set_conf("spark.rapids.sql.encoding.enabled", "true")
+        for f in (path,):
+            try:
+                os.remove(f)
+            except OSError:
+                pass
+    if res.get("encoded_s"):
+        res["speedup_vs_eager"] = round(res["eager_s"] / res["encoded_s"],
+                                        3)
+    return res
 
 
 def _pipeline_payload() -> dict:

@@ -70,6 +70,8 @@ EVENT_KINDS = frozenset({
     "pipelineSpool",
     # stage compiler (exec/stage_compiler.py)
     "stageCompile",
+    # encoded columnar execution (columnar/encoding.py, transfer.py)
+    "encodedBatch", "encodingFallback",
     # shuffle layer (shuffle/*.py, exec/exchange.py)
     "shuffleSend", "shuffleFetch", "fetchRetry", "fetchFailover",
     "shuffleBlockLoaded", "shuffleWorkerFetch", "shuffleBlocksInvalidated",

@@ -191,5 +191,27 @@ def concat_host_batches(batches: Iterable[HostColumnarBatch]) -> HostColumnarBat
     import pyarrow as pa
     batches = list(batches)
     assert batches, "cannot concat zero batches"
+    # a column may arrive dictionary-encoded from one source and plain
+    # from another (encoded scan vs adapted/evolved file): arrow refuses
+    # mixed concat, so decode the minority form per column (all-encoded
+    # columns concat encoded — arrow unifies the dictionaries)
+    if len(batches) > 1 and any(c.is_dict_encoded
+                                for b in batches for c in b.columns):
+        mixed = [ci for ci in range(min(b.num_columns for b in batches))
+                 if len({b.columns[ci].is_dict_encoded
+                         for b in batches}) > 1]
+        if mixed:
+            from spark_rapids_tpu.columnar.encoding import host_decoded
+            fixed = []
+            for b in batches:
+                cols = list(b.columns)
+                for ci in mixed:
+                    c = cols[ci]
+                    if c.is_dict_encoded:
+                        cols[ci] = HostColumn(host_decoded(c.arrow),
+                                              c.data_type)
+                fixed.append(HostColumnarBatch(cols, b.row_count,
+                                               b.names))
+            batches = fixed
     tables = [pa.Table.from_batches([b.to_arrow()]) for b in batches]
     return batch_from_arrow(pa.concat_tables(tables).combine_chunks())

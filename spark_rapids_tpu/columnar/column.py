@@ -324,7 +324,7 @@ class HostColumn:
     host columnar layout + RapidsHostColumnVector).
     """
 
-    __slots__ = ("arrow", "data_type")
+    __slots__ = ("arrow", "data_type", "_plain_cache")
 
     def __init__(self, arrow_array, data_type: Optional[T.DataType] = None):
         import pyarrow as pa
@@ -335,6 +335,10 @@ class HostColumn:
             arrow_array = arrow_array.cast(pa.date32())
         self.arrow = arrow_array
         self.data_type = data_type or T.from_arrow(arrow_array.type)
+        #: memoized decoded form of a dictionary-encoded array (columns
+        #: are immutable; every value-plane accessor below would
+        #: otherwise re-decode the full column)
+        self._plain_cache = None
 
     # -- constructors -------------------------------------------------------
     @staticmethod
@@ -384,15 +388,37 @@ class HostColumn:
         return len(self.arrow)
 
     @property
+    def is_dict_encoded(self) -> bool:
+        import pyarrow as pa
+        return pa.types.is_dictionary(self.arrow.type)
+
+    def _plain(self):
+        """The non-dictionary arrow form (value-plane accessors below
+        need real buffers; dictionary indices would masquerade as data).
+        Decode routes through the one sanctioned host decode helper and
+        is memoized (immutable column, many accessors)."""
+        if not self.is_dict_encoded:
+            return self.arrow
+        if self._plain_cache is None:
+            from spark_rapids_tpu.columnar.encoding import host_decoded
+            self._plain_cache = host_decoded(self.arrow)
+        return self._plain_cache
+
+    @property
     def null_count(self) -> int:
+        if self.is_dict_encoded:
+            # a valid index pointing at a null dictionary VALUE is a
+            # null row; only the decoded form counts those
+            return self._plain().null_count
         return self.arrow.null_count
 
     def validity_np(self) -> np.ndarray:
         """Returns bool[rows], True where valid."""
         import pyarrow.compute as pc
-        if self.arrow.null_count == 0:
-            return np.ones(len(self.arrow), dtype=bool)
-        return pc.is_valid(self.arrow).to_numpy(zero_copy_only=False)
+        arr = self._plain()
+        if arr.null_count == 0:
+            return np.ones(len(arr), dtype=bool)
+        return pc.is_valid(arr).to_numpy(zero_copy_only=False)
 
     def data_np(self) -> np.ndarray:
         """Dense data as numpy, nulls filled with zeros (use validity_np)."""
@@ -407,7 +433,7 @@ class HostColumn:
             # vectorized unscaled-limb extraction straight from the arrow
             # 16-byte little-endian buffer (reference: cuDF DECIMAL64/128
             # columns expose unscaled values the same way)
-            arr = self.arrow
+            arr = self._plain()
             if not pa.types.is_decimal128(arr.type):
                 arr = arr.cast(pa.decimal128(dt.precision, dt.scale))
             n = len(arr)
@@ -421,7 +447,7 @@ class HostColumn:
             if dt.is_decimal128:
                 return np.stack([hi, lo], axis=1)  # device layout is [hi, lo]
             return lo
-        arr = self.arrow
+        arr = self._plain()
         if isinstance(dt, T.TimestampType):
             arr = arr.cast("int64")
         elif isinstance(dt, T.DateType):
@@ -439,7 +465,7 @@ class HostColumn:
         """Rectangularizes to (uint8[rows, max_len], int32 lengths)."""
         import pyarrow as pa
         import pyarrow.compute as pc
-        arr = self.arrow
+        arr = self._plain()
         if pa.types.is_string(arr.type):
             arr = arr.cast(pa.binary())
         elif pa.types.is_large_string(arr.type) or pa.types.is_large_binary(arr.type):
@@ -473,7 +499,7 @@ class HostColumn:
         dt = self.data_type
         if not isinstance(dt, T.ArrayType):
             raise TypeError("list_np on a non-array column")
-        arr = self.arrow
+        arr = self._plain()
         if isinstance(arr, pa.ChunkedArray):
             arr = arr.combine_chunks()
         if pa.types.is_large_list(arr.type):
@@ -508,7 +534,14 @@ class HostColumn:
         return HostColumn(self.arrow.slice(offset, length), self.data_type)
 
     def nbytes(self) -> int:
-        return sum(b.size for b in self.arrow.buffers() if b is not None)
+        n = sum(b.size for b in self.arrow.buffers() if b is not None)
+        if self.is_dict_encoded:
+            # .buffers() on a DictionaryArray covers only the indices;
+            # the dictionary's value buffers are real host bytes too
+            n += sum(b.size
+                     for b in self.arrow.dictionary.buffers()
+                     if b is not None)
+        return n
 
     def __repr__(self):
         return f"HostColumn({self.data_type}, rows={len(self)}, nulls={self.null_count})"

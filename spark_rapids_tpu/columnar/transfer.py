@@ -50,13 +50,51 @@ class _Plane:
 
 
 def _host_planes(col: HostColumn, bucket: int):
-    """Decomposes one host column into (planes, descriptor).
+    """Decomposes one host column into (planes, descriptor, extra).
 
     descriptor: (kind, has_validity) where kind identifies how to
-    reassemble: 'scalar' | 'dec128' | 'string' | 'array'.
+    reassemble: 'scalar' | 'dec128' | 'string' | 'array' | 'dict' |
+    'rle'.  ``extra`` carries the Dictionary (dict) or the runs bucket
+    (rle); None otherwise.
     """
+    from spark_rapids_tpu.columnar import encoding as ENC
     n = len(col)
     dt = col.data_type
+    enc = ENC.classify_host_column(col)
+    if enc is not None and enc[0] == "dict":
+        # encoded upload: ship ONLY the narrow code plane (+ validity);
+        # the dictionary's value planes upload once per fingerprint
+        _k, dic, codes, valid_np = enc
+        planes = []
+        all_valid = bool(valid_np.all())
+        if not all_valid:
+            v = np.zeros(bucket, dtype=np.uint8)
+            v[:n] = valid_np
+            planes.append(_Plane(v, to_bool=True))
+        cbuf = np.zeros(bucket, dtype=codes.dtype)
+        cbuf[:n] = codes
+        planes.append(_Plane(cbuf, target_dtype=np.dtype(np.int32)))
+        return planes, ("dict", not all_valid), dic
+    if enc is not None and enc[0] == "rle":
+        _k, rvals, rvalid, rends = enc
+        n_runs = len(rvals)
+        rbucket = ENC.bucket_rows(max(n_runs, 1), minimum=8)
+        planes = []
+        rv = np.zeros(rbucket, dtype=np.uint8)
+        rv[:n_runs] = rvalid
+        planes.append(_Plane(rv, to_bool=True))
+        data = np.zeros(rbucket, dtype=rvals.dtype)
+        data[:n_runs] = rvals
+        planes.append(_Plane(data))
+        ends = np.full(rbucket, np.iinfo(np.int32).max, dtype=np.int32)
+        ends[:n_runs] = rends
+        planes.append(_Plane(ends))
+        return planes, ("rle", True), bucket
+    if col.is_dict_encoded:
+        # rejected dictionary (oversized / null values / unsupported
+        # value type) or encoding disabled: decode ONCE here so the
+        # plane accessors below don't each re-decode
+        col = HostColumn(ENC.host_decoded(col.arrow), dt)
     valid_np = col.validity_np()
     all_valid = bool(valid_np.all())
     planes: List[Optional[_Plane]] = []
@@ -82,23 +120,23 @@ def _host_planes(col: HostColumn, bucket: int):
         elem_valid[:n] = ev
         planes += [_Plane(data), _Plane(lengths),
                    _Plane(elem_valid, to_bool=True)]
-        return planes, ("array", not all_valid)
+        return planes, ("array", not all_valid), None
     if isinstance(dt, (T.StringType, T.BinaryType)):
         chars, lens = col.string_np()
         data = np.zeros((bucket, chars.shape[1]), dtype=np.uint8)
         data[:n] = chars
         planes += [_Plane(data), _Plane(pad1(lens, np.int32))]
-        return planes, ("string", not all_valid)
+        return planes, ("string", not all_valid), None
     raw = col.data_np()
     if isinstance(dt, T.DecimalType) and dt.is_decimal128:
         data = np.zeros((bucket, 2), dtype=np.int64)
         data[:n] = raw
         planes.append(_Plane(data))
-        return planes, ("dec128", not all_valid)
+        return planes, ("dec128", not all_valid), None
     data = np.zeros((bucket,) + raw.shape[1:], dtype=raw.dtype)
     data[:n] = raw
     planes.append(_Plane(data))
-    return planes, ("scalar", not all_valid)
+    return planes, ("scalar", not all_valid), None
 
 
 def upload_host_batch(hb, bucket: Optional[int] = None):
@@ -113,9 +151,11 @@ def upload_host_batch(hb, bucket: Optional[int] = None):
 
     all_planes: List[_Plane] = []
     descs = []
+    extras = []
     for col in hb.columns:
-        planes, desc = _host_planes(col, b)
+        planes, desc, extra = _host_planes(col, b)
         descs.append((desc, len(planes)))
+        extras.append(extra)
         all_planes += planes
 
     # group plane payloads by element width
@@ -159,7 +199,12 @@ def upload_host_batch(hb, bucket: Optional[int] = None):
                 if to_bool or tdt == np.bool_:
                     seg = seg.astype(jnp.bool_)
                 elif tdt != seg.dtype:
-                    seg = jax.lax.bitcast_convert_type(seg, tdt)
+                    if tdt.itemsize == seg.dtype.itemsize:
+                        seg = jax.lax.bitcast_convert_type(seg, tdt)
+                    else:
+                        # width change (narrow dictionary codes -> the
+                        # device's int32): a real convert, fused in-jit
+                        seg = seg.astype(tdt)
                 outs.append(seg)
             # shared all-valid row mask, created on device (no transfer);
             # one per batch so buffer lifetimes stay independent (spill may
@@ -176,7 +221,9 @@ def upload_host_batch(hb, bucket: Optional[int] = None):
 
     cols = []
     i = 0
-    for col, ((kind, has_valid), np_count) in zip(hb.columns, descs):
+    n_dict = n_rle = enc_bytes = avoided = 0
+    for col, ((kind, has_valid), np_count), extra in zip(hb.columns, descs,
+                                                         extras):
         dt = col.data_type
         take = planes_dev[i:i + np_count]
         i += np_count
@@ -189,8 +236,34 @@ def upload_host_batch(hb, bucket: Optional[int] = None):
         elif kind == "string":
             data, lengths = rest
             cols.append(DeviceColumn(data, validity, n, dt, lengths=lengths))
+        elif kind == "dict":
+            from spark_rapids_tpu.columnar.encoding import DictionaryColumn
+            dic = extra
+            cols.append(DictionaryColumn(rest[0], validity, n, dt,
+                                         None, None, dictionary=dic))
+            n_dict += 1
+            codes_bytes = 4 * b
+            enc_bytes += codes_bytes
+            vals_bytes = sum(buf.size for buf in dic.values.buffers()
+                             if buf is not None)
+            per_row = vals_bytes / max(dic.size, 1)
+            avoided += int(max(0, n * per_row + 4 * b - codes_bytes))
+        elif kind == "rle":
+            from spark_rapids_tpu.columnar.encoding import RleColumn
+            data, ends = rest
+            cols.append(RleColumn(data, validity, n, dt, None, None,
+                                  run_ends=ends, logical_bucket=b))
+            n_rle += 1
+            run_bytes = int(data.size * data.dtype.itemsize +
+                            ends.size * 4 + validity.size)
+            enc_bytes += run_bytes
+            avoided += max(0, b * int(np.dtype(dt.np_dtype).itemsize)
+                           - run_bytes)
         else:
             cols.append(DeviceColumn(rest[0], validity, n, dt))
+    if n_dict or n_rle:
+        from spark_rapids_tpu.columnar import encoding as ENC
+        ENC.note_encoded_upload(n_dict, n_rle, enc_bytes, avoided)
     return ColumnarBatch(cols, n, hb.names)
 
 
@@ -336,15 +409,22 @@ def download_host_batch(cb) -> "object":
     rows; the packed count reveals whether that was enough, and only an
     oversized result pays a second (exactly-sized) round trip.
     """
+    from spark_rapids_tpu.columnar import encoding as ENC
     from spark_rapids_tpu.columnar.batch import HostColumnarBatch
     from spark_rapids_tpu.columnar.column import DeferredCount, rc_traceable
     if not cb.columns:
         return HostColumnarBatch([], int(cb.row_count), cb.names)
+    # RLE planes are runs-shaped (per-column buckets would break the
+    # shared slice-to-shrink program); dictionary columns download their
+    # CODE planes — a D2H reduction — and reassemble against the
+    # host-resident dictionary values below
+    cb = ENC.materialize_rle_batch(cb, site="download")
 
     planes = []   # device arrays, in fixed role order per column
-    descs = []    # (data_type, [role names present])
+    descs = []    # (data_type, [role names present], Dictionary|None)
     for c in cb.columns:
         dt = c.data_type
+        dic = c.dictionary if isinstance(c, ENC.DictionaryColumn) else None
         col_planes = []
         if not isinstance(dt, T.NullType):
             col_planes.append(("data", c.data))
@@ -353,7 +433,7 @@ def download_host_batch(cb) -> "object":
             col_planes.append(("lens", c.lengths))
         if c.elem_valid is not None:
             col_planes.append(("ev", c.elem_valid))
-        descs.append((dt, [r for r, _ in col_planes]))
+        descs.append((dt, [r for r, _ in col_planes], dic))
         planes.extend(p for _, p in col_planes)
 
     rc = cb.row_count
@@ -377,12 +457,16 @@ def download_host_batch(cb) -> "object":
 
     cols = []
     i = 0
-    for (dt, roles) in descs:
+    for (dt, roles, dic) in descs:
         byrole = {}
         for r in roles:
             byrole[r] = fetched[i]
             i += 1
         raw = byrole.get("data")
+        if dic is not None:
+            cols.append(ENC.reassemble_host_dictionary(
+                raw[:n], byrole["valid"][:n], dic, dt))
+            continue
         cols.append(assemble_host_column(
             dt, n,
             None if raw is None else raw[:n],

@@ -812,6 +812,57 @@ CBO_ENABLED = conf_bool(
     "Enable the transition cost-based optimizer (reference CostBasedOptimizer.scala).",
     False)
 
+ENCODING_ENABLED = conf_bool(
+    "spark.rapids.sql.encoding.enabled",
+    "Encoded columnar execution (columnar/encoding.py): parquet scans "
+    "keep dictionary pages encoded, batches ship int codes + a "
+    "once-per-fingerprint dictionary to the device, fused filters "
+    "evaluate code-space lookup tables, hash-agg group keys and join "
+    "keys hash the codes when dictionaries match, and sorts ride the "
+    "codes of value-sorted dictionaries.  Every unsupported shape "
+    "falls back per column to eager decode; disabling reproduces the "
+    "plain (decode-at-scan) path exactly.",
+    True)
+
+ENCODING_LATE_MAT = conf_bool(
+    "spark.rapids.sql.encoding.lateMaterialization",
+    "Defer dictionary decode past filters: encoded columns survive the "
+    "fused filter/project chain as compacted code planes and only "
+    "SURVIVING rows gather through the dictionary where an operator "
+    "needs values.  Disabling inserts an explicit materialize node "
+    "above encoded scans (plan/encoding.py), keeping the H2D savings "
+    "but decoding before any operator runs.",
+    True)
+
+ENCODING_MAX_DICT_SIZE = conf_int(
+    "spark.rapids.sql.encoding.maxDictionarySize",
+    "Dictionaries larger than this many values fall back to eager "
+    "decode at upload (high-cardinality columns gain little from "
+    "code-space execution and their lookup tables stop fitting the "
+    "compile-friendly pow2 buckets).  Validated >= 1 at set_conf.",
+    1 << 16,
+    checker=lambda v: int(v) >= 1)
+
+ENCODING_RLE_ENABLED = conf_bool(
+    "spark.rapids.sql.encoding.rle.enabled",
+    "Opportunistic run-length encoding at upload: fixed-width host "
+    "columns whose run count is at most rows/8 ship run values + run "
+    "ends instead of row planes and expand in-trace inside fused "
+    "stages.  Off by default (run detection costs a host pass per "
+    "uploaded column).",
+    False)
+
+SPILL_CODEC = conf_str(
+    "spark.rapids.memory.spill.codec",
+    "Codec for host->disk spill files: none | lz4 | zlib (the shuffle "
+    "serializer's frame format; reference nvcomp-compressed spill).  "
+    "Compressed spill multiplies effective spill capacity under the "
+    "same disk budget; spill events and pool stats report the actual "
+    "on-disk (compressed) bytes plus the logical bytes.",
+    "lz4",
+    checker=lambda v: str(v).strip().lower() in ("none", "", "lz4",
+                                                 "zlib"))
+
 COLUMN_PRUNING_ENABLED = conf_bool(
     "spark.rapids.sql.columnPruning.enabled",
     "Prune unused columns at scans before plan rewrite (Spark performs this "

@@ -174,7 +174,11 @@ def compute_file_stats(hb, schema: T.StructType) -> dict:
             col = cols.get(f.name)
         if col is None:
             continue
-        arr = col.arrow
+        # encoded scans hand back dictionary arrays; arrow's min_max has
+        # no dictionary kernel, and null VALUES in a dictionary only
+        # count as row nulls on the decoded form
+        from spark_rapids_tpu.columnar.encoding import host_decoded
+        arr = host_decoded(col.arrow)
         stats["nullCount"][f.name] = arr.null_count
         if f.data_type.is_numeric or isinstance(
                 f.data_type, (T.DateType, T.TimestampType, T.StringType)):

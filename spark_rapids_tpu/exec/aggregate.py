@@ -194,7 +194,9 @@ def merge_partials_out_of_core(lay: "_AggLayout", spill_partials,
 
     def attempt():
         maybe_inject_oom()
-        batches = [sb.get_batch() for sb in spill_partials]
+        from spark_rapids_tpu.columnar.encoding import materialize_batch
+        batches = [materialize_batch(sb.get_batch(), site="agg-merge")
+                   for sb in spill_partials]
         big = concat_batches(batches) if len(batches) > 1 else batches[0]
         return segmented_aggregate(big, nk, lay.merge_specs())
 

@@ -682,8 +682,12 @@ class TpuFilterProjectExec(UnaryExec):
         from spark_rapids_tpu.expressions.evaluator import (
             _signature, device_batch_tcols, tcol_to_device_column)
         jnp = _jnp()
+        from spark_rapids_tpu.columnar.encoding import materialize_batch
         with closing_source(self.child.execute_partition(pidx)) as it:
             for b in it:
+                # this pre-fusion node reads raw column planes; the fused
+                # stage exec (plan/stages.py) is the encoding-aware path
+                b = materialize_batch(b, site="operator")
                 key = (_signature([self.condition] + self.exprs, b), b.bucket)
 
                 def build(dtypes=tuple(c.data_type for c in b.columns),
@@ -741,6 +745,26 @@ class TpuFilterProjectExec(UnaryExec):
     def node_desc(self):
         return (f"TpuFilterProject[{self.condition.sql()}; "
                 f"{', '.join(e.sql() for e in self.exprs)}]")
+
+
+class TpuMaterializeEncodedExec(UnaryExec):
+    """Explicit eager-decode boundary: every encoded column of every
+    child batch materializes here.  The plan/encoding.py planner pass
+    inserts this directly above encoded-capable device scans when
+    ``spark.rapids.sql.encoding.lateMaterialization`` is off — the scan
+    still ships codes over the tunnel (the H2D win), but operators only
+    ever see plain columns."""
+
+    is_device = True
+
+    def execute_partition(self, pidx):
+        from spark_rapids_tpu.columnar.encoding import materialize_batch
+        with closing_source(self.child.execute_partition(pidx)) as it:
+            for b in it:
+                yield materialize_batch(b, site="eager")
+
+    def node_desc(self):
+        return "TpuMaterializeEncoded"
 
 
 class HostToDeviceExec(UnaryExec):

@@ -62,19 +62,35 @@ def _lits_desc(promoted) -> str:
 
 
 def _batch_signature(batch: ColumnarBatch) -> Tuple:
-    return tuple((str(c.data_type), tuple(c.data.shape),
-                  c.lengths is not None, c.elem_valid is not None)
-                 for c in batch.columns)
+    from spark_rapids_tpu.columnar.encoding import (DictionaryColumn,
+                                                    RleColumn)
+    sig = []
+    for c in batch.columns:
+        enc = None
+        if isinstance(c, DictionaryColumn):
+            # codes plane, value-plane shapes ride the dictionary args;
+            # the FINGERPRINT stays out — one executable per table/plane
+            # SHAPE serves every dictionary and literal value
+            enc = "dict"
+        elif isinstance(c, RleColumn):
+            enc = ("rle", c.logical_bucket)
+        sig.append((str(c.data_type), tuple(c.data.shape),
+                    c.lengths is not None, c.elem_valid is not None, enc))
+    return tuple(sig)
 
 
-def _trace_chain(ops, cols: List[TCol], sel, bucket, jnp, lit_args=None):
+def _trace_chain(ops, cols: List[TCol], sel, bucket, jnp, lit_args=None,
+                 enc_tables=None):
     """Applies the filter/project chain to (cols, sel) in-trace.
     ``lit_args`` carries the runtime values of PromotedLiteral slots
-    (plan/stages.py) so one compiled program serves every literal."""
+    (plan/stages.py) so one compiled program serves every literal;
+    ``enc_tables`` the dictionary lookup tables of code-space
+    predicates (columnar/encoding.py DictContains)."""
     from spark_rapids_tpu.expressions.evaluator import tcol_to_device_column
     for kind, payload in ops:
         ctx = EvalContext(cols, "tpu", bucket)
         ctx.literal_args = lit_args
+        ctx.enc_tables = enc_tables
         if kind == "filter":
             pred = payload.eval_tpu(ctx)
             keep = valid_array(pred, ctx)
@@ -137,6 +153,8 @@ class TpuFusedStageExec(UnaryExec, _PromotedLiteralsMixin):
         super().__init__(child)
         self.ops = list(ops)
         self._init_promoted(promoted)
+        #: per-(batch encodings) translated op chains (encoding.py)
+        self._enc_cache: dict = {}
 
     @property
     def schema(self) -> T.StructType:
@@ -160,7 +178,7 @@ class TpuFusedStageExec(UnaryExec, _PromotedLiteralsMixin):
         pending = None
         with closing_source(self.child.execute_partition(pidx)) as it:
             for b in it:
-                prog, args = self._program(b)
+                prog, args, enc = self._program(b)
                 if SC.ASYNC_COMPILE and prog.needs_compile():
                     # background lower+compile; the one-batch look-ahead
                     # below overlaps it with the previous batch's
@@ -175,27 +193,35 @@ class TpuFusedStageExec(UnaryExec, _PromotedLiteralsMixin):
                 # an extra batch's device arrays per fused stage for zero
                 # overlap benefit
                 if prog.compiling():
-                    pending = (prog, args)
+                    pending = (prog, args, enc)
                 else:
-                    yield self._finish(prog, args)
+                    yield self._finish(prog, args, enc)
         if pending is not None:
             yield self._finish(*pending)
 
     def _program(self, b):
         import jax
+        from spark_rapids_tpu.columnar import encoding as ENC
         jnp = _jx()
-        ops = self.ops
-        key = (_ops_signature(ops), _batch_signature(b), b.bucket)
+        enc = ENC.plan_fused_stage(self.ops, b, cache=self._enc_cache)
+        ops = self.ops if enc is None else enc.ops
+        key = (_ops_signature(self.ops), _batch_signature(b), b.bucket,
+               None if enc is None else enc.sig)
 
         def build():
             bucket = b.bucket
             dtypes = [c.data_type for c in b.columns]
+            plan = enc
 
-            def run(arrs, rc, lits):
+            def run(arrs, rc, lits, enc_args):
                 cols = _arrs_to_tcols(arrs, dtypes)
+                if plan is not None:
+                    cols = plan.prepare_cols(cols, enc_args, jnp)
                 sel = jnp.arange(bucket, dtype=np.int32) < rc
                 cols, sel = _trace_chain(ops, cols, sel, bucket, jnp,
-                                         lits)
+                                         lits,
+                                         None if plan is None
+                                         else enc_args[0])
                 # compact terminal: one multi-operand stable sort
                 cnt = jnp.sum(sel)
                 live = jnp.arange(bucket) < cnt
@@ -247,15 +273,26 @@ class TpuFusedStageExec(UnaryExec, _PromotedLiteralsMixin):
         # validity inside the trace comes from TCol.valid; bind real
         # planes (and the promoted literal values) here
         args = (_cols_to_arrs(b), rc_traceable(b.row_count),
-                self._lit_args())
-        return prog, args
+                self._lit_args(),
+                () if enc is None else enc.runtime_args(b))
+        return prog, args, enc
 
-    def _finish(self, prog, args):
+    def _finish(self, prog, args, enc=None):
         outs, cnt = prog(*args)
         rc = DeferredCount(cnt)
         fields = self.schema.fields
-        cols = [DeviceColumn(d, v, rc, f.data_type, ln, ev)
-                for (d, v, ln, ev), f in zip(outs, fields)]
+        cols = []
+        for i, ((d, v, ln, ev), f) in enumerate(zip(outs, fields)):
+            dic = None if enc is None else enc.final_dicts[i]
+            if dic is not None:
+                # kept codes survived the compacting filter: decode is
+                # deferred until (and unless) something needs values
+                from spark_rapids_tpu.columnar.encoding import \
+                    DictionaryColumn
+                cols.append(DictionaryColumn(d, v, rc, f.data_type,
+                                             None, None, dictionary=dic))
+            else:
+                cols.append(DeviceColumn(d, v, rc, f.data_type, ln, ev))
         return ColumnarBatch(cols, rc, self._out_names() or
                              [f.name for f in fields])
 
@@ -288,6 +325,8 @@ class TpuFusedAggExec(UnaryExec, _PromotedLiteralsMixin):
         self.layout = layout
         self.mode = mode
         self._init_promoted(promoted)
+        #: per-(batch encodings) translated op chains (encoding.py)
+        self._enc_cache: dict = {}
 
     @property
     def schema(self):
@@ -296,15 +335,28 @@ class TpuFusedAggExec(UnaryExec, _PromotedLiteralsMixin):
             self.layout.result_schema
 
     def _fused_update(self, b: ColumnarBatch) -> ColumnarBatch:
+        from spark_rapids_tpu.columnar import encoding as ENC
         jnp = _jx()
         lay = self.layout
-        ops = self.ops
-        key = (_ops_signature(ops), _batch_signature(b), b.bucket,
+        nk0 = lay.num_keys
+        all_upd = list(lay.update_input_exprs())
+        enc = ENC.plan_fused_stage(self.ops, b, key_exprs=all_upd[:nk0],
+                                   other_exprs=all_upd[nk0:],
+                                   cache=self._enc_cache)
+        ops = self.ops if enc is None else enc.ops
+        # per-key Dictionary when the group key is a kept (code-space)
+        # column; dictionary IDENTITY joins the program key — grouped
+        # code outputs are only meaningful against their dictionary
+        key_dicts = self._key_dicts(enc, all_upd[:nk0])
+        key = (_ops_signature(self.ops), _batch_signature(b), b.bucket,
                tuple((e.sql(), str(e.data_type))
                      for e in lay.update_input_exprs()),
                tuple((o, k, cv, str(dt))
                      for o, k, cv, dt in lay.update_specs()),
-               lay.num_keys)
+               lay.num_keys,
+               None if enc is None else enc.sig,
+               tuple(None if d is None else d.fingerprint
+                     for d in key_dicts))
         def build():
             from spark_rapids_tpu.expressions.evaluator import \
                 tcol_to_device_column
@@ -316,14 +368,32 @@ class TpuFusedAggExec(UnaryExec, _PromotedLiteralsMixin):
             upd_exprs = list(lay.update_input_exprs())
             upd_specs = list(lay.update_specs())
             nk = lay.num_keys
+            plan = enc
+            kdicts = key_dicts
 
-            def run(arrs, rc, lits):
+            def run(arrs, rc, lits, enc_args):
                 cols = _arrs_to_tcols(arrs, dtypes)
+                if plan is not None:
+                    cols = plan.prepare_cols(cols, enc_args, jnp)
                 sel = jnp.arange(bucket, dtype=np.int32) < rc
-                cols, sel = _trace_chain(ops, cols, sel, bucket, jnp, lits)
+                cols, sel = _trace_chain(ops, cols, sel, bucket, jnp,
+                                         lits,
+                                         None if plan is None
+                                         else enc_args[0])
                 ctx = EvalContext(cols, "tpu", bucket)
                 upd_cols = []
-                for e in upd_exprs:
+                for ki, e in enumerate(upd_exprs):
+                    if ki < nk and kdicts[ki] is not None:
+                        # kept dictionary key: GROUP BY THE CODES — an
+                        # int32 plane instead of string word planes
+                        from spark_rapids_tpu.columnar.encoding import \
+                            _strip_alias
+                        base = _strip_alias(e)
+                        tc = cols[base.ordinal]
+                        upd_cols.append(DeviceColumn(
+                            tc.data.astype(np.int32), tc.valid, bucket,
+                            T.INT))
+                        continue
                     tc = e.eval_tpu(ctx)
                     dc = tcol_to_device_column(tc, 0, bucket, jnp)
                     upd_cols.append(DeviceColumn(dc.data, dc.validity,
@@ -340,7 +410,8 @@ class TpuFusedAggExec(UnaryExec, _PromotedLiteralsMixin):
         fn = get_or_build("fused.agg_update", key, build)
 
         arrs = _cols_to_arrs(b)
-        outs, ng = fn(arrs, rc_traceable(b.row_count), self._lit_args())
+        outs, ng = fn(arrs, rc_traceable(b.row_count), self._lit_args(),
+                      () if enc is None else enc.runtime_args(b))
         lay = self.layout
         nk = lay.num_keys
         n = 1 if nk == 0 else DeferredCount(ng)
@@ -352,6 +423,13 @@ class TpuFusedAggExec(UnaryExec, _PromotedLiteralsMixin):
         for j, (d, v, ln) in enumerate(outs):
             if j < nk:
                 dt = upd_exprs[j].data_type
+                if key_dicts[j] is not None:
+                    from spark_rapids_tpu.columnar.encoding import \
+                        DictionaryColumn
+                    cols.append(DictionaryColumn(
+                        d, v, n, dt, None, None,
+                        dictionary=key_dicts[j]))
+                    continue
             else:
                 dt = upd_specs[j - nk][3]
                 if ln is None and dt.np_dtype is not None and \
@@ -359,6 +437,22 @@ class TpuFusedAggExec(UnaryExec, _PromotedLiteralsMixin):
                     d = d.astype(dt.np_dtype)
             cols.append(DeviceColumn(d, v, n, dt, ln))
         return ColumnarBatch(cols, n, names)
+
+    @staticmethod
+    def _key_dicts(enc, key_exprs):
+        """Per grouping key: the Dictionary when the key rides codes."""
+        from spark_rapids_tpu.columnar.encoding import _strip_alias
+        from spark_rapids_tpu.expressions.base import BoundReference
+        out = []
+        for e in key_exprs:
+            dic = None
+            if enc is not None:
+                base = _strip_alias(e)
+                if isinstance(base, BoundReference) and \
+                        base.ordinal < len(enc.final_dicts):
+                    dic = enc.final_dicts[base.ordinal]
+            out.append(dic)
+        return out
 
     def _merge_final_eligible(self, partials: List[ColumnarBatch]) -> bool:
         """The single-jit merge+final path needs in-trace concat: every
@@ -378,15 +472,24 @@ class TpuFusedAggExec(UnaryExec, _PromotedLiteralsMixin):
         final project) into one — on a tunnel-attached TPU each dispatch
         costs ~20ms of round-trip latency, so this halves the critical
         path of every aggregate query's last mile."""
+        from spark_rapids_tpu.columnar.encoding import DictionaryColumn
         jnp = _jx()
         lay = self.layout
         nk = lay.num_keys
         merge_specs = list(lay.merge_specs())
         final_exprs = list(lay.final_exprs())
+        # encoded key columns merge as code planes; align_batches already
+        # guaranteed one fingerprint per position, and that IDENTITY is
+        # part of the program key (grouped codes mean nothing without
+        # their dictionary)
+        enc_dicts = [c.dictionary if isinstance(c, DictionaryColumn)
+                     else None for c in partials[0].columns]
         key = ("mergefinal", tuple(_batch_signature(b) for b in partials),
                tuple(b.bucket for b in partials), nk,
                tuple((o, k, cv, str(dt)) for o, k, cv, dt in merge_specs),
-               tuple((e.sql(), str(e.data_type)) for e in final_exprs))
+               tuple((e.sql(), str(e.data_type)) for e in final_exprs),
+               tuple(None if d is None else d.fingerprint
+                     for d in enc_dicts))
         def build():
             from spark_rapids_tpu.columnar.column import DeviceColumn
             from spark_rapids_tpu.expressions.evaluator import \
@@ -396,7 +499,12 @@ class TpuFusedAggExec(UnaryExec, _PromotedLiteralsMixin):
                                                       keyed_agg_trace)
             buckets = [b.bucket for b in partials]
             total = sum(buckets)
-            in_dtypes = [c.data_type for c in partials[0].columns]
+            # inside the trace encoded key columns are their int32 code
+            # planes (the group/hash machinery must not see the logical
+            # string type)
+            in_dtypes = [T.INT if enc_dicts[ci] is not None
+                         else c.data_type
+                         for ci, c in enumerate(partials[0].columns)]
 
             def run(arrs_list, rcs):
                 sel = jnp.concatenate(
@@ -447,8 +555,14 @@ class TpuFusedAggExec(UnaryExec, _PromotedLiteralsMixin):
         n = 1 if nk == 0 else DeferredCount(ng)
         from spark_rapids_tpu.expressions.evaluator import _out_names
         fields = self.layout.result_schema.fields
-        cols = [DeviceColumn(d, v, n, f.data_type, ln, ev)
-                for (d, v, ln, ev), f in zip(fouts, fields)]
+        cols = []
+        for i, ((d, v, ln, ev), f) in enumerate(zip(fouts, fields)):
+            if i < nk and enc_dicts[i] is not None:
+                cols.append(DictionaryColumn(d, v, n, f.data_type,
+                                             None, None,
+                                             dictionary=enc_dicts[i]))
+            else:
+                cols.append(DeviceColumn(d, v, n, f.data_type, ln, ev))
         return ColumnarBatch(cols, n, _out_names(final_exprs) or
                              [f.name for f in fields])
 
@@ -456,12 +570,18 @@ class TpuFusedAggExec(UnaryExec, _PromotedLiteralsMixin):
         from spark_rapids_tpu.exec.aggregate import COMPLETE, FINAL, PARTIAL
         from spark_rapids_tpu.expressions.evaluator import eval_exprs_tpu
         from spark_rapids_tpu.memory.retry import with_retry_no_split
+        from spark_rapids_tpu.columnar import encoding as ENC
         lay = self.layout
         partials: List[ColumnarBatch] = []
         with closing_source(self.child.execute_partition(pidx)) as it:
             for b in it:
                 partials.append(with_retry_no_split(
                     None, lambda: self._fused_update(b)))
+        if len(partials) > 1 and any(ENC.batch_has_encoded(p)
+                                     for p in partials):
+            # grouped codes only combine against ONE dictionary per key
+            # column; mismatched fingerprints decode before merging
+            partials = ENC.align_batches(partials, site="agg-merge")
         if not partials:
             if lay.num_keys == 0 and self.mode in (COMPLETE, FINAL) and \
                     self.child.num_partitions == 1:
@@ -487,15 +607,21 @@ class TpuFusedAggExec(UnaryExec, _PromotedLiteralsMixin):
             eligible = self.mode != PARTIAL and \
                 A.FORCE_REPARTITION_BELOW_DEPTH == 0 and \
                 self._merge_final_eligible(partials)
-            spills = [SpillableColumnarBatch.from_device(p)
-                      for p in partials]
-            partials = None  # only the spillable handles keep them alive
             too_big = False
             if lay.num_keys > 0:
                 budget = free_device_headroom(2)
                 if budget is not None:
-                    est = sum(sb.sized_nbytes for sb in spills)
+                    est = sum(p.sized_nbytes() for p in partials)
                     too_big = est > budget
+            if (not eligible or too_big) and \
+                    any(ENC.batch_has_encoded(p) for p in partials):
+                # the out-of-core merge walks host tiers and the CPU
+                # repartitioner: it needs values, not codes
+                partials = [ENC.materialize_batch(p, site="agg-merge")
+                            for p in partials]
+            spills = [SpillableColumnarBatch.from_device(p)
+                      for p in partials]
+            partials = None  # only the spillable handles keep them alive
             if eligible and not too_big:
                 def attempt():
                     maybe_inject_oom()
@@ -524,7 +650,9 @@ class TpuFusedAggExec(UnaryExec, _PromotedLiteralsMixin):
                     lay.grouping, lay.aggs, self.mode,
                     self.child)._empty_reduction().to_device()
             else:
-                yield eval_exprs_tpu(lay.final_exprs(), merged)
+                # grouped dictionary keys pass through STILL ENCODED
+                yield ENC.eval_exprs_keep_encoded(lay.final_exprs(),
+                                                  merged)
 
     def node_desc(self):
         chain = "+".join("F" if k == "filter" else "P"

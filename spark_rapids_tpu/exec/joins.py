@@ -314,13 +314,26 @@ class _TpuJoinCore(_JoinBase):
 
     is_device = True
 
-    def _augment_keys(self, batch: ColumnarBatch, keys) -> ColumnarBatch:
-        """Appends evaluated key columns; returns (augmented, ordinals)."""
+    def _augment_keys(self, batch: ColumnarBatch, keys,
+                      enc_keys=None) -> ColumnarBatch:
+        """Appends evaluated key columns; returns (augmented, ordinals).
+
+        ``enc_keys`` (per key: Dictionary | None) marks keys that join
+        in CODE SPACE: both sides carry the SAME dictionary, so equality
+        on int32 codes is equality on values — the hash/probe machinery
+        sees one int word instead of string word planes."""
+        from spark_rapids_tpu.columnar import encoding as ENC
         from spark_rapids_tpu.expressions.evaluator import eval_exprs_tpu
         if not keys:
             return batch, ()
-        kb = eval_exprs_tpu(keys, batch)
-        aug = ColumnarBatch(list(batch.columns) + list(kb.columns),
+        enc_keys = enc_keys or [None] * len(keys)
+        plain = [k for k, d in zip(keys, enc_keys) if d is None]
+        kb_cols = iter(eval_exprs_tpu(plain, batch).columns) if plain \
+            else iter(())
+        key_cols = [ENC.codes_key_column(batch, k) if d is not None
+                    else next(kb_cols)
+                    for k, d in zip(keys, enc_keys)]
+        aug = ColumnarBatch(list(batch.columns) + key_cols,
                             batch.row_count)
         ords = tuple(range(batch.num_columns,
                            batch.num_columns + len(keys)))
@@ -359,6 +372,7 @@ class _TpuJoinCore(_JoinBase):
         side to build; our planner joins in SQL order, which puts fact
         tables on the build side in star queries).  Output column order
         stays left-then-right via argument swap at gather time."""
+        from spark_rapids_tpu.columnar import encoding as ENC
         from spark_rapids_tpu.ops.batch_ops import concat_batches
         jt = self.join_type
         names = self._out_names
@@ -368,9 +382,10 @@ class _TpuJoinCore(_JoinBase):
         cache = build_cache if build_cache is not None else {}
         use_hash = bool(self.left_keys) and jt != J.CROSS
         if "build" in cache:
-            build, build_aug, build_ords = cache["build"]
+            build = cache["build"]
         else:
-            build_batches = [b for b in build_batches
+            build_batches = [ENC.materialize_rle_batch(b, site="join")
+                             for b in build_batches
                              if not _known_empty(b.row_count)]
             build = concat_batches(build_batches) if build_batches else \
                 _empty_device(ls if swapped else rs)
@@ -378,22 +393,41 @@ class _TpuJoinCore(_JoinBase):
             # never mutate it (it may be a shared/cached batch); rewrap
             # to drop names instead
             build = ColumnarBatch(build.columns, build.row_count)
-            build_aug, build_ords = (build, ())
-            if use_hash:
-                build_aug, build_ords = self._augment_keys(build,
-                                                           build_keys)
-            cache["build"] = (build, build_aug, build_ords)
-        # string-key word widths depend on the probe batch -> keyed sub-cache
-        built_by_widths = cache.setdefault("built_by_widths", {})
+            cache["build"] = build
+        build_key_dicts = ENC.join_key_dicts(build, build_keys) \
+            if use_hash else []
+        # augmented build sides keyed by the code-space signature (one
+        # per dictionary combination a probe stream presents), each with
+        # its own string-width sub-cache
+        aug_cache = cache.setdefault("aug", {})
         build_matched = None
         semi_anti = jt in (J.LEFT_SEMI, J.LEFT_ANTI)
         empty_right = ColumnarBatch([], 0) if semi_anti else None
         for probe in probe_batches:
             if _known_empty(probe.row_count):
                 continue
+            probe = ENC.materialize_rle_batch(probe, site="join")
             if use_hash:
+                # a key joins in code space only when BOTH sides carry
+                # the same dictionary; otherwise it falls back to value
+                # comparison (the probe-side key eval materializes)
+                probe_dicts = ENC.join_key_dicts(probe, probe_keys)
+                enc_keys = [bd if (bd is not None and pd is not None and
+                                   bd.fingerprint == pd.fingerprint)
+                            else None
+                            for bd, pd in zip(build_key_dicts,
+                                              probe_dicts)]
+                enc_sig = tuple(None if d is None else d.fingerprint
+                                for d in enc_keys)
+                entry = aug_cache.get(enc_sig)
+                if entry is None:
+                    entry = (self._augment_keys(build, build_keys,
+                                                enc_keys), {})
+                    aug_cache[enc_sig] = entry
+                (build_aug, build_ords), built_by_widths = entry
                 probe_aug, probe_ords = self._augment_keys(probe,
-                                                           probe_keys)
+                                                           probe_keys,
+                                                           enc_keys)
                 pk = [probe_aug.columns[i] for i in probe_ords]
                 wkey = tuple(J._n_value_words(c) for c in pk)
                 built = built_by_widths.get(wkey)

@@ -114,11 +114,17 @@ def device_sort_batch(b: ColumnarBatch, specs: Sequence[SortSpec]
     the single sort+gather program (ops/sort_ops.sort_gather_batch) — no
     key projection dispatch, no key materialization, no separate
     per-column gather."""
+    from spark_rapids_tpu.columnar.encoding import shadow_sort_batch
     from spark_rapids_tpu.ops.sort_ops import sort_gather_batch
     from spark_rapids_tpu.memory.retry import with_retry_no_split
+    # encoded prep: a dictionary SORT KEY rides its codes only when the
+    # dictionary is value-sorted (codes are order-isomorphic), else it
+    # materializes; payload dictionary columns gather as int planes and
+    # re-wrap, staying encoded through the sort
+    b, rewrap = shadow_sort_batch(b, specs)
     orders, extra = _split_keys(specs, b.num_columns)
-    return with_retry_no_split(
-        None, lambda: sort_gather_batch(b, orders, extra))
+    return rewrap(with_retry_no_split(
+        None, lambda: sort_gather_batch(b, orders, extra)))
 
 
 class CpuSortExec(UnaryExec):

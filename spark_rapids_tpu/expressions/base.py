@@ -63,7 +63,7 @@ class EvalContext:
     """
 
     __slots__ = ("cols", "backend", "row_count", "lambda_bindings",
-                 "elem_plane", "literal_args")
+                 "elem_plane", "literal_args", "enc_tables")
 
     def __init__(self, cols: Sequence[TCol], backend: str, row_count: int):
         self.cols = list(cols)
@@ -76,6 +76,9 @@ class EvalContext:
         #: runtime values for PromotedLiteral slots (plan/stages.py) when
         #: evaluating inside a parameterized fused-stage trace
         self.literal_args = None
+        #: device bool lookup tables for code-space dictionary predicates
+        #: (columnar/encoding.py DictContains slots)
+        self.enc_tables = None
 
 
 class Expression:

@@ -28,6 +28,13 @@ from spark_rapids_tpu.expressions.base import (EvalContext, Expression, TCol,
 # ---------------------------------------------------------------------------
 
 def device_batch_tcols(batch: ColumnarBatch) -> List[TCol]:
+    """Bridges a device batch into evaluation TCols.  Encoded columns
+    (dictionary codes / RLE runs) materialize here — the transparent
+    per-column fallback for every operator that is not encoding-aware
+    (the fused-stage path consumes codes directly and never calls
+    this on encoded columns it keeps)."""
+    from spark_rapids_tpu.columnar.encoding import materialize_batch
+    batch = materialize_batch(batch, site="operator")
     return [TCol(c.data, c.validity, c.data_type, lengths=c.lengths,
                  elem_valid=c.elem_valid)
             for c in batch.columns]
@@ -153,7 +160,21 @@ def _signature(exprs, batch: ColumnarBatch) -> Tuple:
 def eval_exprs_tpu(exprs: Sequence[Expression], batch: ColumnarBatch,
                    names: Optional[List[str]] = None) -> ColumnarBatch:
     from spark_rapids_tpu.columnar.column import _jnp
+    from spark_rapids_tpu.columnar.encoding import (batch_has_encoded,
+                                                    materialize_batch)
     from spark_rapids_tpu.exec.stage_compiler import get_or_build
+    if batch_has_encoded(batch):
+        # decode only the ordinals these expressions actually read; an
+        # unreferenced encoded column would still flow into the program
+        # below as raw codes, so it must decode too unless every
+        # expression ignores it (projections list their inputs)
+        from spark_rapids_tpu.expressions.base import BoundReference
+        refs = set()
+        for e in exprs:
+            refs.update(b.ordinal for b in
+                        e.collect(lambda n: isinstance(n, BoundReference)))
+        batch = materialize_batch(batch, ordinals=sorted(refs),
+                                  site="operator")
     xp = _jnp()
     key = _signature(exprs, batch)
     dtypes = [c.data_type for c in batch.columns]

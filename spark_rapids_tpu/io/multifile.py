@@ -214,11 +214,16 @@ class MultiFileScanBase(LeafExec):
         # (reader_type, coalesce target) map pidx to different groups and
         # must not alias each other's cache entries (ADVICE r4)
         group = tuple(self._plan_partitions()[pidx])
+        # encoded vs plain batches must not alias: a cached dictionary
+        # batch served to an encoding-disabled session would change plans
+        from spark_rapids_tpu.columnar import encoding as _ENC
         return (self.format_name, files,
                 tuple(self.columns or ()) if hasattr(self, "columns")
                 else (),
                 None if pred is None else pred.sql(),
-                self._scan_cache_extra(), group, tier)
+                self._scan_cache_extra(), group, tier,
+                ("enc", _ENC.ENCODING_ENABLED, _ENC.RLE_ENABLED,
+                 _ENC.MAX_DICTIONARY_SIZE))
 
     def execute_partition(self, pidx: int):
         if SCAN_CACHE_ENABLED:

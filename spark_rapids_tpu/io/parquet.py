@@ -117,11 +117,18 @@ class CpuParquetScanExec(MultiFileScanBase):
         for p in self.paths:
             sch = pq.read_schema(p)
             for f in sch:
+                # a writer that received encoded batches embeds a
+                # dictionary arrow type in the footer metadata; the
+                # LOGICAL read schema is the value type (the scan
+                # re-encodes via read_dictionary regardless)
+                ftype = f.type
+                if pa.types.is_dictionary(ftype):
+                    ftype = ftype.value_type
                 if f.name not in fields:
-                    fields[f.name] = f.type
+                    fields[f.name] = ftype
                     order.append(f.name)
                 else:
-                    w = _widen(fields[f.name], f.type)
+                    w = _widen(fields[f.name], ftype)
                     if w is None:
                         raise TypeError(
                             f"parquet schema evolution cannot reconcile "
@@ -199,6 +206,25 @@ class CpuParquetScanExec(MultiFileScanBase):
                 cols[f.name] = pa.nulls(n, type=want)
         return pa.table(cols)
 
+    def _dictionary_columns(self, f, file_cols):
+        """Columns whose parquet dictionary pages stay ENCODED through
+        the scan (reference: the plugin executes over cuDF's encoded
+        columns; here pyarrow hands back DictionaryArrays and the upload
+        ships codes + a once-per-fingerprint dictionary).  String/binary
+        columns only — the types whose decode the engine defers."""
+        from spark_rapids_tpu.columnar import encoding as ENC
+        import pyarrow as pa
+        if not ENC.ENCODING_ENABLED:
+            return None
+        want = file_cols if file_cols is not None else \
+            list(f.schema_arrow.names)
+        out = [fld.name for fld in f.schema_arrow
+               if fld.name in want and
+               (pa.types.is_string(fld.type) or
+                pa.types.is_large_string(fld.type) or
+                pa.types.is_binary(fld.type))]
+        return out or None
+
     def read_file(self, path: str):
         import pyarrow as pa
         import pyarrow.parquet as pq
@@ -220,15 +246,36 @@ class CpuParquetScanExec(MultiFileScanBase):
         if self.columns is not None:
             present = set(f.schema_arrow.names)
             file_cols = [c for c in self.columns if c in present]
+        # the rebase/evolution adapter casts through plain arrays, so
+        # only adapt-free files keep their dictionary pages encoded
+        dict_cols = None if needs_adapt else \
+            self._dictionary_columns(f, file_cols)
         if flt is not None and not needs_adapt:
             import pyarrow.dataset as ds
-            dataset = ds.dataset(path, format="parquet")
+            fmt = "parquet"
+            if dict_cols:
+                try:
+                    fmt = ds.ParquetFileFormat(
+                        read_options=ds.ParquetReadOptions(
+                            dictionary_columns=set(dict_cols)))
+                except Exception:  # noqa: BLE001 — dataset API drift:
+                    fmt = "parquet"  # plain decode, never a scan failure
+            dataset = ds.dataset(path, format=fmt)
             scanner = dataset.scanner(columns=file_cols, filter=flt,
                                       batch_size=self.batch_rows)
             for rb in scanner.to_batches():
                 if rb.num_rows:
                     yield batch_from_arrow(pa.Table.from_batches([rb]))
             return
+        if dict_cols:
+            # the read_dictionary option only exists at open time; close
+            # the metadata handle before reopening (fd pressure on wide
+            # multi-file scans otherwise waits on GC)
+            try:
+                f.close()
+            except AttributeError:  # older pyarrow: no explicit close
+                pass
+            f = pq.ParquetFile(path, read_dictionary=dict_cols)
         for rb in f.iter_batches(batch_size=self.batch_rows,
                                  columns=file_cols):
             if not rb.num_rows:

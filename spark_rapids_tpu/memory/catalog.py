@@ -34,6 +34,13 @@ from spark_rapids_tpu.memory import arbiter as _ARB
 from spark_rapids_tpu.memory.retry import RetryOOM, maybe_inject_oom, task_context
 
 
+#: codec for host->disk spill files (spark.rapids.memory.spill.codec,
+#: synced by TpuOverrides.apply): the shuffle serializer's frame format,
+#: so the spill tier rides the same lz4/zlib path shuffle payloads do
+#: (reference: nvcomp-compressed spill in RapidsDiskStore)
+SPILL_CODEC = "lz4"
+
+
 class StorageTier(enum.IntEnum):
     """reference: RapidsBuffer.scala:59-64 StorageTier"""
     DEVICE = 0
@@ -68,7 +75,8 @@ class BufferHandle:
 
 class _Buffer:
     __slots__ = ("handle", "tier", "device_batch", "host_batch", "disk_path",
-                 "device_nbytes", "host_nbytes", "spillable", "owned")
+                 "device_nbytes", "host_nbytes", "disk_nbytes",
+                 "disk_logical_nbytes", "spillable", "owned")
 
     def __init__(self, handle: BufferHandle):
         self.handle = handle
@@ -78,6 +86,11 @@ class _Buffer:
         self.disk_path: Optional[str] = None
         self.device_nbytes = 0
         self.host_nbytes = 0
+        #: actual on-disk (post-codec) size — the accounting the pool
+        #: watermarks and spill events report; re-statting the file
+        #: raced with unlink and silently leaked disk_bytes on loss
+        self.disk_nbytes = 0
+        self.disk_logical_nbytes = 0
         self.spillable = True
         #: True = the catalog exclusively owns the device arrays and may
         #: .delete() them on spill/remove.  False = the arrays may be
@@ -93,7 +106,10 @@ def _delete_device_batch(batch: ColumnarBatch) -> None:
     """Releases device buffers eagerly (reference: RapidsBuffer.free /
     cudf close; jax arrays support explicit .delete())."""
     for col in batch.columns:
-        for arr in (col.data, col.validity, col.lengths):
+        # run_ends: RleColumn's extra plane; DICTIONARY value planes are
+        # shared process-wide and must never be deleted with a batch
+        for arr in (col.data, col.validity, col.lengths,
+                    getattr(col, "run_ends", None)):
             if arr is not None and hasattr(arr, "delete"):
                 try:
                     arr.delete()
@@ -117,6 +133,9 @@ class BufferCatalog:
         self.device_peak_bytes = 0
         self.host_bytes = 0
         self.disk_bytes = 0
+        #: pre-codec bytes behind disk_bytes (compression ratio =
+        #: disk_logical_bytes / disk_bytes)
+        self.disk_logical_bytes = 0
         self.spill_count = 0
         self.debug = debug
 
@@ -304,8 +323,11 @@ class BufferCatalog:
             if buf.host_batch is not None:
                 self.host_bytes -= buf.host_nbytes
             if buf.disk_path is not None:
+                # recorded size, not a re-stat: the decrement must happen
+                # even when the file is already gone
+                self.disk_bytes -= buf.disk_nbytes
+                self.disk_logical_bytes -= buf.disk_logical_nbytes
                 try:
-                    self.disk_bytes -= os.path.getsize(buf.disk_path)
                     os.unlink(buf.disk_path)
                 except OSError:
                     pass
@@ -375,25 +397,33 @@ class BufferCatalog:
             self._spill_host_to_disk_locked(buf)
 
     def _spill_host_to_disk_locked(self, buf: _Buffer) -> None:
-        import pyarrow as pa
-        import pyarrow.ipc as ipc
+        from spark_rapids_tpu.shuffle.serializer import serialize_batch
         d = self._disk_dir or tempfile.gettempdir()
         os.makedirs(d, exist_ok=True)
-        path = os.path.join(d, f"spill-{buf.handle.id}.arrow")
+        path = os.path.join(d, f"spill-{buf.handle.id}.spill")
         t0 = time.monotonic()
-        rb = buf.host_batch.to_arrow()
-        with ipc.RecordBatchFileWriter(path, rb.schema) as w:
-            w.write_batch(rb)
+        logical = buf.host_nbytes
+        # the shuffle wire format (arrow IPC stream + codec frame): the
+        # spill tier compresses through the same lz4/zlib path shuffle
+        # payloads use, multiplying effective disk spill capacity
+        frame = serialize_batch(buf.host_batch, SPILL_CODEC)
+        with open(path, "wb") as fh:
+            fh.write(frame)
         self.host_bytes -= buf.host_nbytes
         buf.host_batch = None
         buf.host_nbytes = 0
         buf.disk_path = path
-        disk_nbytes = os.path.getsize(path)
-        self.disk_bytes += disk_nbytes
+        buf.disk_nbytes = len(frame)
+        buf.disk_logical_nbytes = logical
+        self.disk_bytes += buf.disk_nbytes
+        self.disk_logical_bytes += logical
         buf.tier = StorageTier.DISK
         self.spill_count += 1
         from spark_rapids_tpu.aux.events import emit
-        emit("spill", tier="host->disk", bytes=disk_nbytes,
+        # bytes = ACTUAL on-disk (compressed) size, so profile spill
+        # durations and the AutoTuner pressure rule see real I/O volume
+        emit("spill", tier="host->disk", bytes=buf.disk_nbytes,
+             logical_bytes=logical, codec=SPILL_CODEC,
              buffer_id=buf.handle.id, priority=buf.handle.priority,
              duration_s=round(time.monotonic() - t0, 6))
 
@@ -401,16 +431,17 @@ class BufferCatalog:
         if buf.host_batch is not None:
             return buf.host_batch
         assert buf.disk_path is not None, "buffer has no backing storage"
-        import pyarrow.ipc as ipc
-        from spark_rapids_tpu.columnar.batch import batch_from_arrow
-        with ipc.open_file(buf.disk_path) as r:
-            table = r.read_all()
-        host = batch_from_arrow(table)
+        from spark_rapids_tpu.shuffle.serializer import deserialize_batch
+        with open(buf.disk_path, "rb") as fh:
+            host = deserialize_batch(fh.read())
         # promote back to host tier
         buf.host_batch = host
         buf.host_nbytes = host.nbytes()
         self.host_bytes += buf.host_nbytes
-        self.disk_bytes -= os.path.getsize(buf.disk_path)
+        self.disk_bytes -= buf.disk_nbytes
+        self.disk_logical_bytes -= buf.disk_logical_nbytes
+        buf.disk_nbytes = 0
+        buf.disk_logical_nbytes = 0
         try:
             os.unlink(buf.disk_path)
         except OSError:
@@ -438,6 +469,7 @@ class BufferCatalog:
                 "host_bytes": self.host_bytes,
                 "host_limit": self.host_limit,
                 "disk_bytes": self.disk_bytes,
+                "disk_logical_bytes": self.disk_logical_bytes,
                 "buffers": len(self._buffers),
                 "spill_count": self.spill_count,
             }

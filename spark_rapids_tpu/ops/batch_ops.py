@@ -39,7 +39,12 @@ def gather_batch(batch: ColumnarBatch, idx, row_count: int,
                  idx_valid=None) -> ColumnarBatch:
     """Gathers rows by index (device gather-map application; reference:
     cuDF Table.gather via JoinGatherer).  ``idx`` may exceed row bounds for
-    padding positions; callers pass ``idx_valid`` to invalidate those rows."""
+    padding positions; callers pass ``idx_valid`` to invalidate those rows.
+    Dictionary columns gather their code planes (encoding survives);
+    RLE columns are run-shaped and materialize first."""
+    from spark_rapids_tpu.columnar.encoding import (materialize_rle_batch,
+                                                    rewrap_like)
+    batch = materialize_rle_batch(batch)
     jnp = _jx()
     out = []
     n = idx.shape[0]
@@ -52,13 +57,14 @@ def gather_batch(batch: ColumnarBatch, idx, row_count: int,
         lengths = None if c.lengths is None else jnp.take(c.lengths, safe, axis=0)
         ev = None if c.elem_valid is None else jnp.take(c.elem_valid, safe,
                                                         axis=0)
-        out.append(DeviceColumn(data, valid, row_count, c.data_type, lengths,
-                                ev))
+        out.append(rewrap_like(c, data, valid, row_count, lengths, ev))
     return ColumnarBatch(out, row_count, batch.names)
 
 
 def compact_batch(batch: ColumnarBatch, keep) -> ColumnarBatch:
     """Moves kept rows to the front (stable), returns batch with new count.
+    Dictionary code planes compact like any int plane (the encoding
+    survives — late materialization); RLE materializes first.
 
     No host sync: the count stays deferred on device.  Implementation is a
     single multi-operand ``lax.sort`` keyed on the drop flag: TPU sorts are
@@ -69,6 +75,8 @@ def compact_batch(batch: ColumnarBatch, keep) -> ColumnarBatch:
     permutation.
     """
     import jax
+    from spark_rapids_tpu.columnar.encoding import materialize_rle_batch
+    batch = materialize_rle_batch(batch)
     jnp = _jx()
     key = ("compact", tuple(_col_sig(c) for c in batch.columns))
     def build():
@@ -123,7 +131,8 @@ def compact_batch(batch: ColumnarBatch, keep) -> ColumnarBatch:
     outs, cnt = fn(arrs, keep)
     # count stays on device: chained kernels consume it sync-free
     row_count = DeferredCount(cnt)
-    cols = [DeviceColumn(d, v, row_count, c.data_type, ln, ne)
+    from spark_rapids_tpu.columnar.encoding import rewrap_like
+    cols = [rewrap_like(c, d, v, row_count, ln, ne)
             for (d, v, ln, ne), c in zip(outs, batch.columns)]
     return ColumnarBatch(cols, row_count, batch.names)
 
@@ -138,10 +147,13 @@ def shrink_batch(batch: ColumnarBatch, minimum: int = 1024) -> ColumnarBatch:
     target = bucket_rows(max(n, 1), minimum=minimum)
     if not batch.columns or target >= batch.bucket:
         return batch
+    from spark_rapids_tpu.columnar.encoding import (materialize_rle_batch,
+                                                    rewrap_like)
+    batch = materialize_rle_batch(batch)
     cols = []
     for c in batch.columns:
-        cols.append(DeviceColumn(
-            c.data[:target], c.validity[:target], n, c.data_type,
+        cols.append(rewrap_like(
+            c, c.data[:target], c.validity[:target], n,
             None if c.lengths is None else c.lengths[:target],
             None if c.elem_valid is None else c.elem_valid[:target]))
     return ColumnarBatch(cols, n, batch.names)
@@ -160,6 +172,9 @@ def take_front(batch: ColumnarBatch, n) -> ColumnarBatch:
     ``n`` may itself be deferred/a device scalar (limit budget carried on
     device across batches — no per-batch sync)."""
     jnp = _jx()
+    from spark_rapids_tpu.columnar.encoding import (materialize_rle_batch,
+                                                    rewrap_like)
+    batch = materialize_rle_batch(batch)
     rc = batch.row_count
     n_deferred = isinstance(n, DeferredCount) or not isinstance(n, int)
     if n_deferred or (isinstance(rc, DeferredCount) and not rc.is_forced):
@@ -171,8 +186,8 @@ def take_front(batch: ColumnarBatch, n) -> ColumnarBatch:
         n = min(int(n), int(rc))
         n_t = n
     keep = jnp.arange(batch.bucket) < n_t
-    cols = [DeviceColumn(c.data, c.validity & keep, n, c.data_type, c.lengths,
-                         c.elem_valid)
+    cols = [rewrap_like(c, c.data, c.validity & keep, n, c.lengths,
+                        c.elem_valid)
             for c in batch.columns]
     return ColumnarBatch(cols, n, batch.names)
 
@@ -191,6 +206,11 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
         batches = kept or batches[:1]
     if len(batches) == 1:
         return batches[0]
+    # dictionary code planes concat like int planes when every input
+    # shares the fingerprint; mismatched positions decode first
+    from spark_rapids_tpu.columnar.encoding import (align_batches,
+                                                    rewrap_like)
+    batches = align_batches(batches, site="concat")
     jnp = _jx()
     if any(isinstance(b.row_count, DeferredCount) and not b.row_count.is_forced
            for b in batches):
@@ -265,5 +285,5 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
     outs = fn(all_arrs, counts_arr)
     cols = []
     for (d, v, ln, ev), proto in zip(outs, batches[0].columns):
-        cols.append(DeviceColumn(d, v, total, proto.data_type, ln, ev))
+        cols.append(rewrap_like(proto, d, v, total, ln, ev))
     return ColumnarBatch(cols, total, batches[0].names)

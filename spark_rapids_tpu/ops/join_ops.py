@@ -406,10 +406,12 @@ def gather_join_output(probe: ColumnarBatch, build: ColumnarBatch,
     if isinstance(count, DeferredCount) and count.is_forced:
         count = int(count)
     cols = []
-    all_dt = [c.data_type for c in probe.columns] + \
-        [c.data_type for c in build.columns]
-    for (d, v, ln, ev), dt in zip(outs, all_dt):
-        cols.append(DeviceColumn(d, v, count, dt, ln, ev))
+    from spark_rapids_tpu.columnar.encoding import rewrap_like
+    protos = list(probe.columns) + list(build.columns)
+    for (d, v, ln, ev), proto in zip(outs, protos):
+        # dictionary payload columns gather their code planes and stay
+        # encoded through the join (late materialization)
+        cols.append(rewrap_like(proto, d, v, count, ln, ev))
     return ColumnarBatch(cols, count, names)
 
 

@@ -1,0 +1,48 @@
+"""Encoded-execution planner pass (late-materialization placement).
+
+With ``spark.rapids.sql.encoding.enabled`` on, device scans keep parquet
+dictionary pages (and opted-in RLE runs) encoded and the operator layer
+defers decode per column (columnar/encoding.py).  This pass controls
+WHERE the decode boundary sits:
+
+- ``lateMaterialization=true`` (default): no node is inserted — encoded
+  columns flow through fused filter chains as compacted code planes and
+  materialize only where values are genuinely needed.
+- ``lateMaterialization=false``: an explicit ``TpuMaterializeEncoded``
+  node lands directly above every encoded-capable device scan, so the
+  H2D transfer still ships codes but every operator sees plain columns
+  (the conservative mode the AutoTuner recommends when dictionary
+  fallbacks dominate).
+
+With encoding disabled the pass is an exact no-op, reproducing the
+pre-encoding plans.
+"""
+
+from __future__ import annotations
+
+from spark_rapids_tpu.plan.base import Exec
+
+
+def insert_materialize_boundaries(plan: Exec, conf) -> Exec:
+    from spark_rapids_tpu import config as C
+    if not conf.get(C.ENCODING_ENABLED.key) or \
+            conf.get(C.ENCODING_LATE_MAT.key):
+        return plan
+    from spark_rapids_tpu.exec.basic import TpuMaterializeEncodedExec
+    from spark_rapids_tpu.io.multifile import MultiFileScanBase
+
+    def fix(node: Exec) -> Exec:
+        new_children = []
+        for c in node.children:
+            if isinstance(c, MultiFileScanBase) and \
+                    getattr(c, "is_device", False) and \
+                    not isinstance(node, TpuMaterializeEncodedExec):
+                c = TpuMaterializeEncodedExec(c)
+            new_children.append(c)
+        return node.with_children(new_children)
+
+    out = plan.transform_up(fix)
+    if isinstance(out, MultiFileScanBase) and \
+            getattr(out, "is_device", False):
+        out = TpuMaterializeEncodedExec(out)
+    return out

@@ -562,6 +562,15 @@ class TpuOverrides:
         if conf.get(C.COMPILE_CACHE_DIR.key):
             _SC.set_persistent_cache_dir(conf.get(C.COMPILE_CACHE_DIR.key))
         _ST.LITERAL_PROMOTION = conf.get(C.COMPILE_LITERAL_PROMOTION.key)
+        # encoded columnar execution (columnar/encoding.py) + the
+        # compressed spill tier (memory/catalog.py)
+        import spark_rapids_tpu.columnar.encoding as _ENC
+        import spark_rapids_tpu.memory.catalog as _CAT
+        _ENC.ENCODING_ENABLED = conf.get(C.ENCODING_ENABLED.key)
+        _ENC.LATE_MATERIALIZATION = conf.get(C.ENCODING_LATE_MAT.key)
+        _ENC.MAX_DICTIONARY_SIZE = conf.get(C.ENCODING_MAX_DICT_SIZE.key)
+        _ENC.RLE_ENABLED = conf.get(C.ENCODING_RLE_ENABLED.key)
+        _CAT.SPILL_CODEC = conf.get(C.SPILL_CODEC.key)
         # ENABLE-only: benchmark setups interleave an enabled session
         # with a default-conf sanity session, whose every plan compile
         # would otherwise wipe the cache mid-run; releasing the process-
@@ -598,6 +607,11 @@ class TpuOverrides:
             return plan
         out = insert_transitions(converted, conf)
         out = self._coalesce_after_device_sources(out)
+        # eager-decode boundary above encoded scans when late
+        # materialization is off (exact no-op otherwise / when disabled)
+        from spark_rapids_tpu.plan.encoding import \
+            insert_materialize_boundaries
+        out = insert_materialize_boundaries(out, conf)
         if conf.get(C.STAGE_FUSION_ENABLED.key):
             out = fuse_device_stages(out)
         if conf.get(C.EXCHANGE_REUSE_ENABLED.key):

@@ -37,6 +37,13 @@ Rules (see docs/tools.md for the full semantics):
    events without the persistent disk tier mean every session (and
    every evicted program) pays full XLA compilation again; the on-disk
    cache turns those into loads.
+8. **dictionary fallbacks dominate encoded scans** → operators keep
+   forcing decodes of columns the scan kept encoded: disable
+   ``spark.rapids.sql.encoding.lateMaterialization`` (decode once above
+   the scan instead of repeatedly at operators); when the fallbacks are
+   oversized-dictionary rejections at upload, shrink
+   ``spark.rapids.sql.encoding.maxDictionarySize`` so those columns
+   skip the encode attempt entirely.
 
 Thresholds are fractions of query wall time; rules stay silent without
 their evidence, and rules 2 and 4 are mutually exclusive by
@@ -262,6 +269,51 @@ def autotune_query(profile: QueryProfile,
                   f"duration_s={e.payload.get('duration_s')} "
                   f"tier={e.payload.get('tier')}"),
             qid))
+
+    # rule 8: dictionary fallbacks dominate encoded scans.  One decode
+    # per query is late materialization working; fallbacks rivaling the
+    # encoded-batch count mean operators repeatedly undo what the scan
+    # kept encoded.
+    enc_evs = profile.events_of("encodedBatch")
+    fb_evs = profile.events_of("encodingFallback")
+    op_fbs = [e for e in fb_evs
+              if e.payload.get("site") not in ("upload", "eager")]
+    up_fbs = [e for e in fb_evs
+              if e.payload.get("site") == "upload" and
+              e.payload.get("detail") == "maxDictionarySize"]
+    if enc_evs and len(op_fbs) >= max(3, len(enc_evs)):
+        late = _conf_value(
+            profile, "spark.rapids.sql.encoding.lateMaterialization")
+        if late in (True, "true", None):
+            recs.append(Recommendation(
+                "spark.rapids.sql.encoding.lateMaterialization",
+                True, False,
+                f"{len(op_fbs)} operator-forced dictionary decode(s) "
+                f"against {len(enc_evs)} encoded batch(es): the plan "
+                "keeps undoing the encoding downstream — decoding once "
+                "above the scan keeps the H2D savings without the "
+                "repeated per-operator gathers",
+                _cite(op_fbs, lambda e:
+                      f"encodingFallback site={e.payload.get('site')} "
+                      f"detail={e.payload.get('detail')} "
+                      f"bytes={e.payload.get('bytes')}"),
+                qid))
+    elif len(up_fbs) >= 3 and len(up_fbs) >= len(enc_evs):
+        cur_sz = int(_conf_value(
+            profile, "spark.rapids.sql.encoding.maxDictionarySize")
+            or (1 << 16))
+        if cur_sz > 1024:
+            recs.append(Recommendation(
+                "spark.rapids.sql.encoding.maxDictionarySize", cur_sz,
+                max(1024, cur_sz // 4),
+                f"{len(up_fbs)} oversized-dictionary rejection(s) at "
+                "upload: these high-cardinality columns pay the "
+                "fingerprint/encode attempt only to fall back — a "
+                "lower cap skips the attempt",
+                _cite(up_fbs, lambda e:
+                      f"encodingFallback site=upload "
+                      f"dict_size={e.payload.get('dict_size')}"),
+                qid))
 
     # rule 5: observability truncation -> bigger ring
     dropped = int((profile.summary or {}).get("events_dropped", 0) or 0)

@@ -581,6 +581,48 @@ class RetryFrameRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# encoded-materialize
+# ---------------------------------------------------------------------------
+
+class EncodedMaterializeRule(Rule):
+    """The encoded-execution PR keeps dictionary/RLE columns alive past
+    the scan; decode is only correct (and only counted — decoded bytes,
+    fallback events, AutoTuner evidence) through the sanctioned
+    ``materialize*`` helpers.  A stray decode primitive silently
+    re-materializes what the scan kept encoded AND dodges the ledger."""
+
+    id = "encoded-materialize"
+    invariant = ("the decode primitives (decode_dictionary / decode_rle "
+                 "/ arrow .dictionary_decode) are called only inside "
+                 "columnar/encoding.py; operators decode via the "
+                 "materialize*/host_decoded helpers")
+    rationale = ("every decode must flow through the one module that "
+                 "counts decoded bytes and emits encodingFallback "
+                 "events — an uncounted decode both wastes the encoding "
+                 "and blinds the AutoTuner's fallback rule")
+    hint = ("call encoding.materialize()/materialize_batch()/"
+            "materialize_rle_batch() (device) or encoding.host_decoded() "
+            "(arrow), or annotate '# lint: ok=encoded-materialize' with "
+            "a reason")
+
+    ALLOWED_FILES = ("columnar/encoding.py",)
+    _DECODE_NAMES = frozenset({"decode_dictionary", "decode_rle",
+                               "dictionary_decode"})
+
+    def visit(self, ctx: LintContext, pf: ParsedFile,
+              node: ast.AST) -> None:
+        if pf.rel in self.ALLOWED_FILES:
+            return
+        if not isinstance(node, ast.Call):
+            return
+        name = _call_name(node)
+        if name in self._DECODE_NAMES:
+            self.report(ctx, pf.rel, node.lineno,
+                        f"raw decode primitive {name}() outside "
+                        "columnar/encoding.py")
+
+
+# ---------------------------------------------------------------------------
 # lock-order
 # ---------------------------------------------------------------------------
 
@@ -632,5 +674,6 @@ def default_rules() -> List[Rule]:
         SpillableCloseRule(),
         FaultPointRule(),
         RetryFrameRule(),
+        EncodedMaterializeRule(),
         LockOrderRule(),
     ]

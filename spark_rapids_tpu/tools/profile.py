@@ -311,6 +311,21 @@ def render_report(profiles: List[QueryProfile], diag: ReadDiagnostics,
         if att.recovery_counts:
             lines.append("  Recovery ledger: " + " ".join(
                 f"{k}={v}" for k, v in sorted(att.recovery_counts.items())))
+        enc_evs = qp.events_of("encodedBatch")
+        fb_evs = qp.events_of("encodingFallback")
+        if enc_evs or fb_evs:
+            avoided = sum(int(e.payload.get("decode_avoided_bytes", 0) or 0)
+                          for e in enc_evs)
+            enc_bytes = sum(int(e.payload.get("encoded_bytes", 0) or 0)
+                            for e in enc_evs)
+            fb_bytes = sum(int(e.payload.get("bytes", 0) or 0)
+                           for e in fb_evs)
+            lines.append(
+                f"  Encoding: decodeAvoided={_fmt_bytes(avoided)} "
+                f"encodedBatches={len(enc_evs)} "
+                f"({_fmt_bytes(enc_bytes)} shipped) "
+                f"fallbacks={len(fb_evs)} "
+                f"({_fmt_bytes(fb_bytes)} decoded)")
         lock_violations = qp.events_of("lockOrderViolation")
         if lock_violations:
             pairs = sorted({f"{ev.payload.get('held')}->"

@@ -299,6 +299,30 @@ def test_fault_point_rule(tmp_path):
     assert not _findings(report, "fault-point")
 
 
+def test_encoded_materialize_rule(tmp_path):
+    from spark_rapids_tpu.tools.lint.rules import EncodedMaterializeRule
+    bad = """
+        from spark_rapids_tpu.columnar.encoding import decode_dictionary
+
+        def sneak(col, jnp):
+            data, v, ln = decode_dictionary(col.data, col.validity,
+                                            planes, jnp)
+            return col.arrow.dictionary_decode()
+    """
+    report = _lint_snippet(tmp_path, bad, [EncodedMaterializeRule()])
+    assert len(_findings(report, "encoded-materialize")) == 2
+    clean = """
+        from spark_rapids_tpu.columnar.encoding import (host_decoded,
+                                                        materialize_batch)
+
+        def sanctioned(batch, arr):
+            return materialize_batch(batch), host_decoded(arr)
+    """
+    report = _lint_snippet(tmp_path, clean, [EncodedMaterializeRule()],
+                           name="clean.py")
+    assert not _findings(report, "encoded-materialize")
+
+
 def test_retry_frame_rule(tmp_path):
     bad = """
         from spark_rapids_tpu.memory.retry import maybe_inject_oom
@@ -444,7 +468,8 @@ def test_json_schema(tmp_path):
     assert d["files_scanned"] == 1
     assert {r["id"] for r in d["rules"]} == {
         "jit-site", "conf-registry", "event-catalog", "traced-purity",
-        "spillable-close", "fault-point", "retry-frame", "lock-order"}
+        "spillable-close", "fault-point", "retry-frame",
+        "encoded-materialize", "lock-order"}
     (f,) = [f for f in d["findings"] if f["rule"] == "jit-site"]
     assert set(f) == {"rule", "severity", "file", "line", "message",
                       "hint", "suppressed"}
