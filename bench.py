@@ -400,17 +400,42 @@ def _event_log_payload(path: str) -> dict:
     instead of months later on a real incident log."""
     try:
         from spark_rapids_tpu.tools.profile import attribute
-        from spark_rapids_tpu.tools.reader import load_profiles
-        profiles, diag = load_profiles(path)
+        from spark_rapids_tpu.tools.reader import (profiles_from_events,
+                                                   read_events)
+        # ONE parse of the (possibly rotated/gzip'd) log serves the
+        # profile smoke AND the audit below
+        events, diag = read_events(path)
+        profiles, _ = profiles_from_events(events, diag)
         for qp in profiles:
             attribute(qp)     # attribution must never raise on own logs
-        return {"path": path, "profile_ok": True,
-                "queries": len(profiles),
-                "events": diag.parsed,
-                "truncated_lines": diag.truncated_lines}
+        out = {"path": path, "profile_ok": True,
+               "queries": len(profiles),
+               "events": diag.parsed,
+               "truncated_lines": diag.truncated_lines}
     except Exception as e:  # noqa: BLE001 - keep the primary metric alive
         return {"path": path, "profile_ok": False,
                 "error": f"{type(e).__name__}: {e}"[:200]}
+    # compiled-program audit over the run's own stageProgram ledger
+    # (schema v3): the bench payload carries the verdict so a forbidden
+    # primitive / baked constant / recompile storm regression fails the
+    # very next bench run, not a later incident review
+    try:
+        from spark_rapids_tpu.tools.audit import LedgerRow, run_audit
+        rep = run_audit(
+            rows=[LedgerRow.from_event(e) for e in events
+                  if e.kind == "stageProgram"],
+            profiles=profiles)
+        out["audit"] = {
+            "ok": rep.exit_code == 0,
+            "programs": len(rep.rows),
+            "structures": len({(r.kind, r.norm_sig) for r in rep.rows}),
+            "errors": len(rep.active_errors),
+            "warnings": len(rep.active) - len(rep.active_errors),
+        }
+    except Exception as e:  # noqa: BLE001 - keep the primary metric alive
+        out["audit"] = {"ok": False,
+                        "error": f"{type(e).__name__}: {e}"[:200]}
+    return out
 
 
 def _chaos_payload() -> dict:

@@ -1,21 +1,49 @@
 #!/usr/bin/env bash
-# Pre-merge / CI gate: static engine-invariant lint, then the smoke test
-# tier.  Mirrors what tier-1 enforces (tests/test_lint.py runs the same
-# linter as its gate test) but fails in seconds instead of minutes.
+# Pre-merge / CI gate: static engine-invariant lint, a compiled-program
+# audit smoke (run a small query, audit its stageProgram ledger), then
+# the smoke test tier.  Mirrors what tier-1 enforces (tests/test_lint.py
+# and tests/test_audit.py run the same linter/auditor as their gate
+# tests) but fails in seconds instead of minutes.
 #
-#   scripts/check.sh            # lint + smoke tests
+#   scripts/check.sh            # lint + audit smoke + smoke tests
 #   scripts/check.sh --lint-only
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== 1/2 engine invariant lint =="
+echo "== 1/3 engine invariant lint =="
 python -m spark_rapids_tpu.tools lint
 
 if [[ "${1:-}" == "--lint-only" ]]; then
     exit 0
 fi
 
-echo "== 2/2 smoke test tier =="
+echo "== 2/3 compiled-program audit smoke =="
+AUDIT_LOG="$(mktemp -d)/audit_smoke.jsonl"
+python - "$AUDIT_LOG" <<'PY'
+import sys
+import numpy as np
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.expressions.base import Alias, col, lit
+from spark_rapids_tpu.session import TpuSession
+
+log = sys.argv[1]
+s = TpuSession({"spark.rapids.sql.test.enabled": "false",
+                "spark.rapids.sql.eventLog.path": log,
+                "spark.rapids.debug.planCheck": "true"})
+rng = np.random.default_rng(3)
+df = s.create_dataframe(
+    {"k": rng.integers(0, 20, 50_000).astype(np.int64),
+     "v": rng.standard_normal(50_000)}, num_partitions=2)
+out = (df.filter(col("k") > lit(2))
+         .group_by("k").agg(Alias(F.sum(col("v")), "sv"))).collect()
+assert out, "audit smoke query returned nothing"
+PY
+# error-severity ledger findings fail the gate; the roofline table is
+# report-only here (no peak floor configured)
+python -m spark_rapids_tpu.tools audit "$AUDIT_LOG" --no-roofline
+rm -rf "$(dirname "$AUDIT_LOG")"
+
+echo "== 3/3 smoke test tier =="
 python -m pytest tests/ -q -m smoke -p no:cacheprovider

@@ -42,9 +42,13 @@ from typing import Dict, List, Optional
 #: v1 = PR 1 envelope (event/query_id/span_id/ts).  v2 adds the offline
 #: reader's structural fields: spanMetrics rows carry parent_id / depth /
 #: start_s / end_s / partitions, queryStart carries the non-default conf
-#: snapshot, and files open with an ``eventLogHeader`` line.  The reader
-#: (tools/reader.py) accepts both.
-EVENT_SCHEMA_VERSION = 2
+#: snapshot, and files open with an ``eventLogHeader`` line.  v3 adds the
+#: compiled-program audit ledger: ``stageProgram`` rows (one per built
+#: executable — jaxpr signatures, const shapes/fingerprints, arg
+#: signature, flops/bytes, key provenance) and ``planInvariantViolation``
+#: rows from the runtime plan verifier.  The reader (tools/reader.py)
+#: accepts all three.
+EVENT_SCHEMA_VERSION = 3
 
 #: stamped on events emitted outside any query / span scope
 NO_QUERY = -1
@@ -68,8 +72,11 @@ EVENT_KINDS = frozenset({
     "taskRetry", "taskDegraded",
     # pipelined execution (exec/pipeline.py)
     "pipelineSpool",
-    # stage compiler (exec/stage_compiler.py)
-    "stageCompile",
+    # stage compiler (exec/stage_compiler.py); stageProgram is the
+    # per-executable audit ledger row (schema v3, tools/audit)
+    "stageCompile", "stageProgram",
+    # runtime plan-invariant verifier (plan/verify.py)
+    "planInvariantViolation",
     # encoded columnar execution (columnar/encoding.py, transfer.py)
     "encodedBatch", "encodingFallback",
     # shuffle layer (shuffle/*.py, exec/exchange.py)
@@ -499,6 +506,11 @@ def render_prometheus() -> str:
     add("lock_order_violations_total", "counter", _lo.violations_total(),
         "Lock acquisitions that went backward against the canonical "
         "order (spark.rapids.debug.lockOrder validator; 0 when disarmed)")
+    from spark_rapids_tpu.plan import verify as _pv
+    add("plan_invariant_violations_total", "counter",
+        _pv.violations_total(),
+        "Structural plan-contract violations found by the runtime plan "
+        "verifier (spark.rapids.debug.planCheck; 0 when disarmed)")
     from spark_rapids_tpu.exec import stage_compiler as _sc
     scs = _sc.stats()
     add("stage_programs", "gauge", scs["programs"],

@@ -752,6 +752,33 @@ DEBUG_LOCK_ORDER = conf_bool(
     "Debug/test knob: adds one flag read per lock acquire when off.",
     False, ConfLevel.INTERNAL)
 
+DEBUG_PLAN_CHECK = conf_bool(
+    "spark.rapids.debug.planCheck",
+    "Arm the runtime plan-invariant verifier (plan/verify.py): every "
+    "post-optimization physical plan is walked against the structural "
+    "contracts the planner passes establish — encoding materialize "
+    "boundaries, prefetch-node placement, spillable registration of "
+    "queued batches, exchange-reuse key consistency.  A violation "
+    "counts in plan_invariant_violations_total and emits a "
+    "planInvariantViolation event (mirroring spark.rapids.debug."
+    "lockOrder).  Debug/test knob: adds one plan walk per action when "
+    "on.",
+    False, ConfLevel.INTERNAL)
+
+AUDIT_LEDGER = conf_bool(
+    "spark.rapids.audit.ledger",
+    "Record a per-program audit ledger row (stageProgram event) every "
+    "time the stage compiler builds an executable: the closed jaxpr's "
+    "structural signatures, primitive set, const shapes/fingerprints "
+    "(never buffers), arg signature, cost-analysis flops/bytes and "
+    "cache-key provenance — the input of the offline compiled-program "
+    "auditor (python -m spark_rapids_tpu.tools audit, docs/audit.md).  "
+    "Rows are recorded only while a sink that will store them is live "
+    "(an eventLog.path file sink or a global sink): the analysis costs "
+    "a few ms per BUILD, and a row that would die in the per-query "
+    "ring buffer is not worth it.  Steady-state dispatch is untouched.",
+    True)
+
 RMM_DEBUG = conf_bool(
     "spark.rapids.memory.gpu.debug",
     "Log every pool allocation/free (reference RapidsConf.scala:375).",

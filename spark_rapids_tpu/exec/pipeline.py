@@ -162,6 +162,14 @@ class PrefetchSpool:
     to — and are released with — the owning task.
     """
 
+    #: contract flag the runtime plan verifier (plan/verify.py,
+    #: ``spark.rapids.debug.planCheck``) asserts: ``_wrap`` registers
+    #: every queued DEVICE batch with the spill framework (owned=False,
+    #: lowest priority).  A refactor that drops the registration must
+    #: flip this — and thereby fail every armed run — instead of
+    #: silently pinning unevictable device memory in spool queues.
+    QUEUED_DEVICE_BATCHES_SPILLABLE = True
+
     def __init__(self, source_fn, depth: int, max_bytes: int,
                  boundary: str):
         self._source_fn = source_fn

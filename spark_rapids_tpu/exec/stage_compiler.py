@@ -36,15 +36,38 @@ from __future__ import annotations
 
 import collections
 import hashlib
+import re
 import threading
 import time
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 __all__ = ["get_or_build", "stats", "reset_stats", "clear",
-           "set_max_programs", "set_persistent_cache_dir", "StageProgram"]
+           "set_max_programs", "set_persistent_cache_dir", "StageProgram",
+           "jaxpr_signatures"]
 
 #: synced from ``spark.rapids.sql.compile.async`` by the planner
 ASYNC_COMPILE = False
+
+#: synced from ``spark.rapids.audit.ledger`` by the planner: record a
+#: per-program audit ledger row (``stageProgram`` event, schema v3) at
+#: every build, so the offline auditor (tools/audit) sees every cached
+#: executable.  The row carries signatures/shapes/fingerprints ONLY —
+#: never jaxpr objects or buffers, so audit state pins no device memory.
+#: Recording only happens when a sink that will STORE the row is live
+#: (the query's event-log file sink, or a process-global sink) — the
+#: audit is an offline tool over event logs, and paying the per-build
+#: analysis for a row that dies in the per-query ring buffer would tax
+#: every sink-less session (~10% on compile-heavy suites) for nothing.
+AUDIT_LEDGER = True
+
+#: consts at or under this many bytes get a content fingerprint (one
+#: host read at build time); larger consts record shape/dtype only —
+#: the auditor treats any large const as promotion-suspect on its own
+CONST_FP_MAX_BYTES = 1 << 20
+
+#: cache keys recorded into the ledger are capped at this many repr
+#: chars (key provenance is for storm diagnosis, not reconstruction)
+KEY_REPR_MAX = 600
 
 _LOCK = threading.RLock()
 _PROGRAMS: "collections.OrderedDict[Tuple, StageProgram]" = \
@@ -60,6 +83,9 @@ _STATS = {
     "async_compiles": 0,  # programs compiled on the background pool
     "async_failures": 0,  # background compiles that raised (jit fallback)
     "compile_s": 0.0,   # seconds spent in first-dispatch trace+compile
+    "ledger_rows": 0,   # stageProgram audit rows emitted
+    "ledger_errors": 0,  # ledger recordings that raised (audit never
+                         # fails the query; nonzero = blind audit spots)
 }
 #: last background-compile error (stats(); None = healthy)
 _ASYNC_ERROR = [None]
@@ -121,17 +147,22 @@ class StageProgram:
     event so the profiler can attribute compilation separately from
     steady-state compute."""
 
-    __slots__ = ("kind", "key_hash", "_fn", "_lock", "_dispatched",
-                 "_warm_future", "_compiled")
+    __slots__ = ("kind", "key_hash", "key_repr", "_fn", "_lock",
+                 "_dispatched", "_warm_future", "_compiled", "_drifted")
 
     def __init__(self, kind: str, key, fn):
         self.kind = kind
         self.key_hash = _key_hash(key)
+        #: key provenance for the audit ledger (bounded repr: enough to
+        #: diagnose which component over-discriminates in a recompile
+        #: storm, never the whole structure)
+        self.key_repr = repr(key)[:KEY_REPR_MAX]
         self._fn = fn
         self._lock = threading.Lock()
         self._dispatched = False
         self._warm_future = None
         self._compiled = None
+        self._drifted = False
 
     # -- async (AOT) path ----------------------------------------------------
     def needs_compile(self) -> bool:
@@ -154,14 +185,25 @@ class StageProgram:
 
             def work():
                 t0 = time.perf_counter()
-                compiled = self._fn.lower(*args).compile()
+                traced = self._fn.trace(*args)
+                lowered = traced.lower()
+                compiled = lowered.compile()
                 dt = time.perf_counter() - t0
                 self._note_compiled(dt, tier="aot")
                 with _LOCK:
                     _STATS["async_compiles"] += 1
+                _record_ledger(self, traced, lowered)
                 return compiled
 
-            self._warm_future = _compile_pool().submit(work)
+            # the pool's daemon threads carry no contextvars: run the
+            # work inside a COPY of the caller's context (the spool
+            # pattern) so the stageCompile/stageProgram events route to
+            # the caller's query sinks — without it every async-built
+            # program would silently vanish from the audit ledger
+            import contextvars
+            ctx = contextvars.copy_context()
+            self._warm_future = _compile_pool().submit(
+                lambda: ctx.run(work))
             return True
 
     def _note_compiled(self, dt: float, tier: str) -> None:
@@ -199,16 +241,25 @@ class StageProgram:
                 return self._compiled(*args)
             except (TypeError, ValueError):
                 # arg-signature drift only (an int row count where the
-                # lowering saw a device scalar): fall back to the jit
-                # dispatcher, which traces a variant — timed and counted
-                # like any cold compile so it can't leak into steady-
-                # state metrics.  Genuine runtime errors (device OOM...)
-                # must propagate to retry/arbitration, not silently
-                # re-execute the program.
-                self._compiled = None
+                # lowering saw a device scalar): route THIS call through
+                # the jit dispatcher, which traces and caches the
+                # variant.  The compiled executable is KEPT — exact-
+                # signature calls stay on it, and dropping it would make
+                # jit re-compile the original signature from scratch the
+                # next time it recurs (one full wasted compile per
+                # drifting program).  The first drift is timed and
+                # counted like any cold compile so it can't leak into
+                # steady-state metrics.  Genuine runtime errors (device
+                # OOM...) must propagate to retry/arbitration, not
+                # silently re-execute the program.
                 t0 = time.perf_counter()
                 out = self._fn(*args)
-                self._note_compiled(time.perf_counter() - t0, tier="jit")
+                with self._lock:
+                    first_drift = not self._drifted
+                    self._drifted = True
+                if first_drift:
+                    self._note_compiled(time.perf_counter() - t0,
+                                        tier="jit")
                 return out
         first = False
         if not self._dispatched:
@@ -220,6 +271,28 @@ class StageProgram:
                     first = True
         if first:
             t0 = time.perf_counter()
+            traced = lowered = compiled = None
+            if _ledger_active():
+                # first dispatch goes through the AOT pipeline so the
+                # audit ledger sees the jaxpr + cost analysis of the
+                # exact program being cached, with ONE trace (the same
+                # count the jit dispatch would pay) and no duplicate
+                # compile.  Any AOT-surface failure falls back to the
+                # plain jit dispatch, which is always correct.
+                try:
+                    traced = self._fn.trace(*args)
+                    lowered = traced.lower()
+                    compiled = lowered.compile()
+                except Exception:  # noqa: BLE001 — audit is best-effort
+                    traced = lowered = compiled = None
+                    with _LOCK:
+                        _STATS["ledger_errors"] += 1
+            if compiled is not None:
+                self._compiled = compiled
+                out = compiled(*args)
+                self._note_compiled(time.perf_counter() - t0, tier="jit")
+                _record_ledger(self, traced, lowered)
+                return out
             out = self._fn(*args)
             self._note_compiled(time.perf_counter() - t0, tier="jit")
             return out
@@ -236,6 +309,181 @@ def _counting(kind: str, fn: Callable) -> Callable:
         return fn(*args, **kwargs)
     traced.__name__ = getattr(fn, "__name__", "run") + f"[{kind}]"
     return traced
+
+
+# ---------------------------------------------------------------------------
+# audit ledger (schema v3 ``stageProgram`` rows; consumed by tools/audit)
+# ---------------------------------------------------------------------------
+
+#: memory addresses inside param reprs (callables, array views) would
+#: make structural signatures unstable across processes
+_ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
+
+
+def _ledger_active() -> bool:
+    """True when a recorded row would actually be STORED: the active
+    query carries a durable (file) sink, or process-global sinks exist
+    (out-of-query builds route there).  A query's ring buffer alone
+    does not count — it is discarded at query end."""
+    if not AUDIT_LEDGER:
+        return False
+    from spark_rapids_tpu.aux import events as EV
+    q = EV.active_query()
+    if q is not None:
+        return bool(getattr(q, "_sinks", None))
+    return bool(EV._GLOBAL_SINKS)
+
+
+def _literal_cls():
+    from jax.core import Literal
+    return Literal
+
+
+def _sub_jaxprs(val) -> List:
+    """Open jaxprs nested inside an eqn param (pjit's ``jaxpr``, scan's
+    branches...), whatever container they arrive in."""
+    import jax
+    if isinstance(val, jax.core.Jaxpr):
+        return [val]
+    if isinstance(val, jax.core.ClosedJaxpr):
+        return [val.jaxpr]
+    if isinstance(val, (tuple, list)):
+        out = []
+        for v in val:
+            out.extend(_sub_jaxprs(v))
+        return out
+    return []
+
+
+def _walk_eqns(jaxpr, exact: List, norm: List, prims: set) -> None:
+    lit_cls = _literal_cls()
+    for eqn in jaxpr.eqns:
+        prims.add(eqn.primitive.name)
+        ins_exact, ins_norm = [], []
+        for v in eqn.invars:
+            short = v.aval.str_short()
+            if isinstance(v, lit_cls):
+                # the exact signature keeps the baked value, the
+                # normalized one keeps only its type: N keys collapsing
+                # onto one normalized signature while their exact
+                # signatures differ IS the missed-literal-promotion
+                # storm the auditor hunts
+                ins_exact.append(f"lit({v.val!r}):{short}")
+                ins_norm.append(f"lit:{short}")
+            else:
+                ins_exact.append(short)
+                ins_norm.append(short)
+        params = []
+        for k in sorted(eqn.params):
+            val = eqn.params[k]
+            subs = _sub_jaxprs(val)
+            if subs:
+                for sj in subs:
+                    _walk_eqns(sj, exact, norm, prims)
+                params.append((k, "<jaxpr>"))
+            else:
+                params.append((k, _ADDR_RE.sub("0x", repr(val))))
+        rec = (eqn.primitive.name, tuple(params),
+               tuple(o.aval.str_short() for o in eqn.outvars))
+        exact.append((rec, tuple(ins_exact)))
+        norm.append((rec, tuple(ins_norm)))
+
+
+def jaxpr_signatures(jaxpr) -> Tuple[str, str, List[str], int]:
+    """(struct_sig, norm_sig, primitives, eqn count) of an OPEN jaxpr.
+
+    ``struct_sig`` hashes the full structure including inline literal
+    VALUES; ``norm_sig`` replaces every literal value with its type, so
+    programs differing only in baked scalars collapse onto one
+    signature — the clustering key of the auditor's recompile-storm and
+    baked-constant passes.  Const buffers never participate: constvars
+    contribute only their avals."""
+    exact: List = []
+    norm: List = []
+    prims: set = set()
+    _walk_eqns(jaxpr, exact, norm, prims)
+    frame = (tuple(v.aval.str_short() for v in jaxpr.invars),
+             tuple(v.aval.str_short() for v in jaxpr.constvars),
+             tuple(v.aval.str_short() for v in jaxpr.outvars))
+
+    def h(parts) -> str:
+        return hashlib.sha1(repr((frame, parts)).encode()).hexdigest()[:16]
+
+    return h(exact), h(norm), sorted(prims), len(exact)
+
+
+def _const_records(consts) -> List[Dict]:
+    """Shape/dtype/nbytes + content fingerprint per jaxpr const.  The
+    fingerprint is a hash of the VALUE (one bounded host read at build
+    time) so the auditor can tell 'same table baked everywhere' from
+    'a different table baked per key'; the buffer itself is read and
+    immediately dropped — ledger rows hold primitives only."""
+    import numpy as np
+    out = []
+    for c in consts:
+        shape = tuple(getattr(c, "shape", ()))
+        dtype = str(getattr(c, "dtype", type(c).__name__))
+        nbytes = int(getattr(c, "nbytes", 0) or 0)
+        if 0 < nbytes <= CONST_FP_MAX_BYTES:
+            try:
+                fp = hashlib.sha1(
+                    np.asarray(c).tobytes()).hexdigest()[:16]
+            except Exception:  # noqa: BLE001 — unreadable const: shape-only
+                fp = "unreadable"
+        else:
+            fp = "large"
+        out.append({"shape": list(shape), "dtype": dtype,
+                    "nbytes": nbytes, "fp": fp})
+    return out
+
+
+def _record_ledger(prog: StageProgram, traced, lowered) -> None:
+    """Emits the program's ``stageProgram`` audit row.  Never raises —
+    a failed recording counts in ``ledger_errors`` (a blind audit spot
+    must be visible in stats, not silent)."""
+    if traced is None or not _ledger_active():
+        return
+    try:
+        closed = traced.jaxpr
+        struct_sig, norm_sig, prims, n_eqns = jaxpr_signatures(closed.jaxpr)
+        in_avals = [v.aval for v in closed.jaxpr.invars]
+        out_avals = [v.aval for v in closed.jaxpr.outvars]
+        flops = bytes_accessed = None
+        try:
+            ca = lowered.cost_analysis()
+            if isinstance(ca, dict):
+                if ca.get("flops") is not None:
+                    flops = float(ca["flops"])
+                if ca.get("bytes accessed") is not None:
+                    bytes_accessed = float(ca["bytes accessed"])
+        except Exception:  # noqa: BLE001 — cost analysis is best-effort
+            pass
+        args_sig = [a.str_short() for a in in_avals]
+        payload = {
+            "stage_kind": prog.kind,
+            "key": prog.key_hash,
+            "key_repr": prog.key_repr,
+            "struct_sig": struct_sig,
+            "norm_sig": norm_sig,
+            "primitives": prims,
+            "eqns": n_eqns,
+            "consts": _const_records(closed.consts),
+            "n_args": len(args_sig),
+            "args": args_sig[:64],
+            "in_dtypes": sorted({str(getattr(a, "dtype", "?"))
+                                 for a in in_avals}),
+            "out_dtypes": sorted({str(getattr(a, "dtype", "?"))
+                                  for a in out_avals}),
+            "flops": flops,
+            "bytes_accessed": bytes_accessed,
+        }
+        from spark_rapids_tpu.aux.events import emit
+        emit("stageProgram", **payload)
+        with _LOCK:
+            _STATS["ledger_rows"] += 1
+    except Exception:  # noqa: BLE001 — audit must never fail the query
+        with _LOCK:
+            _STATS["ledger_errors"] += 1
 
 
 def get_or_build(kind: str, key: Tuple,

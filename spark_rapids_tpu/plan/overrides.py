@@ -358,6 +358,79 @@ def push_scan_predicates(plan: Exec) -> Exec:
     return plan.transform_up(fix)
 
 
+def _reuse_node_key(node: Exec):
+    """DEFAULT-DENY signature: a node type participates only when its
+    key provably captures ALL result-affecting state — anything else
+    keys by object identity and blocks reuse of its subtree (a lossy
+    node_desc would otherwise merge differing pipelines: the fused
+    execs compress their op chain to 'F'/'P' letters).
+
+    Module-level (not nested in ``reuse_exchanges``) because the runtime
+    plan verifier (plan/verify.py, ``spark.rapids.debug.planCheck``)
+    re-derives the same signatures over the FINAL tree to assert the
+    pass left no two distinct exchange instances with equal keys — the
+    pass and its verifier must share one definition or the cross-check
+    checks nothing."""
+    from spark_rapids_tpu.exec import basic as XB
+    from spark_rapids_tpu.exec.basic import CpuInMemoryScanExec
+    from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
+    from spark_rapids_tpu.exec.fused import (TpuFusedAggExec,
+                                             TpuFusedStageExec,
+                                             _ops_signature)
+    from spark_rapids_tpu.io.multifile import MultiFileScanBase
+    if isinstance(node, CpuInMemoryScanExec):
+        # the device-column cache is shared by every copy of one
+        # source DataFrame and distinct across sources
+        return ("mem", id(node._dev_cache),
+                tuple(node.col_indices or ()))
+    if isinstance(node, MultiFileScanBase):
+        # the scan-cache key already solves this exact problem:
+        # format + files+mtimes + columns + predicate + per-format
+        # decode options (schema/serde/parse flags)
+        return ("file", type(node).__name__,
+                node._scan_cache_key(-1, "reuse"))
+    if isinstance(node, TpuFusedStageExec):
+        # literal promotion makes _ops_signature value-independent;
+        # plan identity must still include the VALUES or an exchange
+        # over "d_year = 1998" would merge with one over 1999
+        return ("fstage", _ops_signature(node.ops), node.lit_key())
+    if isinstance(node, TpuFusedAggExec):
+        lay = node.layout
+        return ("fagg", _ops_signature(node.ops), node.lit_key(),
+                node.mode,
+                tuple((e.sql(), str(e.data_type))
+                      for e in lay.update_input_exprs()),
+                tuple((o, k, cv, str(dt))
+                      for o, k, cv, dt in lay.update_specs()),
+                tuple(e.sql() for e in lay.final_exprs()))
+    if isinstance(node, CpuShuffleExchangeExec):
+        # RangePartitioning.desc() omits sort direction/null order —
+        # spell the full specs out (an asc and a desc range exchange
+        # must never merge)
+        from spark_rapids_tpu.plan.partitioning import RangePartitioning
+        part = node.partitioning
+        pkey = part.desc()
+        if isinstance(part, RangePartitioning):
+            pkey = ("range", part.num_partitions,
+                    tuple((s.expr.sql(), s.ascending,
+                           s.effective_nulls_first)
+                          for s in part.specs))
+        return ("x", type(node).__name__, pkey)
+    if isinstance(node, (XB.CpuProjectExec, XB.CpuFilterExec,
+                         XB.TpuCoalesceBatchesExec,
+                         XB.HostToDeviceExec, XB.DeviceToHostExec)):
+        # descs of these spell out their expressions
+        return ("d", type(node).__name__, node.node_desc())
+    return ("opaque", id(node))    # unvetted: never reuse through it
+
+
+def exchange_reuse_signature(node: Exec):
+    """Structural subtree signature the reuse pass merges by (and the
+    plan verifier re-checks)."""
+    return _reuse_node_key(node) + tuple(exchange_reuse_signature(c)
+                                         for c in node.children)
+
+
 def reuse_exchanges(plan: Exec) -> Exec:
     """Spark's ReuseExchange rule (reference: the reference keeps it
     active and re-tags reused exchanges in updateForAdaptivePlan,
@@ -366,68 +439,9 @@ def reuse_exchanges(plan: Exec) -> Exec:
     once and every reader hits its store — TPC-DS repeats whole subquery
     pipelines (q2's year-split, q1's customer_total_return) that
     otherwise shuffle twice."""
-    from spark_rapids_tpu.exec import basic as XB
-    from spark_rapids_tpu.exec.basic import CpuInMemoryScanExec
     from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
-    from spark_rapids_tpu.exec.fused import (TpuFusedAggExec,
-                                             TpuFusedStageExec,
-                                             _ops_signature)
-    from spark_rapids_tpu.io.multifile import MultiFileScanBase
 
-    def node_key(node: Exec):
-        """DEFAULT-DENY signature: a node type participates only when its
-        key provably captures ALL result-affecting state — anything else
-        keys by object identity and blocks reuse of its subtree (a lossy
-        node_desc would otherwise merge differing pipelines: the fused
-        execs compress their op chain to 'F'/'P' letters)."""
-        if isinstance(node, CpuInMemoryScanExec):
-            # the device-column cache is shared by every copy of one
-            # source DataFrame and distinct across sources
-            return ("mem", id(node._dev_cache),
-                    tuple(node.col_indices or ()))
-        if isinstance(node, MultiFileScanBase):
-            # the scan-cache key already solves this exact problem:
-            # format + files+mtimes + columns + predicate + per-format
-            # decode options (schema/serde/parse flags)
-            return ("file", type(node).__name__,
-                    node._scan_cache_key(-1, "reuse"))
-        if isinstance(node, TpuFusedStageExec):
-            # literal promotion makes _ops_signature value-independent;
-            # plan identity must still include the VALUES or an exchange
-            # over "d_year = 1998" would merge with one over 1999
-            return ("fstage", _ops_signature(node.ops), node.lit_key())
-        if isinstance(node, TpuFusedAggExec):
-            lay = node.layout
-            return ("fagg", _ops_signature(node.ops), node.lit_key(),
-                    node.mode,
-                    tuple((e.sql(), str(e.data_type))
-                          for e in lay.update_input_exprs()),
-                    tuple((o, k, cv, str(dt))
-                          for o, k, cv, dt in lay.update_specs()),
-                    tuple(e.sql() for e in lay.final_exprs()))
-        if isinstance(node, CpuShuffleExchangeExec):
-            # RangePartitioning.desc() omits sort direction/null order —
-            # spell the full specs out (an asc and a desc range exchange
-            # must never merge)
-            from spark_rapids_tpu.plan.partitioning import RangePartitioning
-            part = node.partitioning
-            pkey = part.desc()
-            if isinstance(part, RangePartitioning):
-                pkey = ("range", part.num_partitions,
-                        tuple((s.expr.sql(), s.ascending,
-                               s.effective_nulls_first)
-                              for s in part.specs))
-            return ("x", type(node).__name__, pkey)
-        if isinstance(node, (XB.CpuProjectExec, XB.CpuFilterExec,
-                             XB.TpuCoalesceBatchesExec,
-                             XB.HostToDeviceExec, XB.DeviceToHostExec)):
-            # descs of these spell out their expressions
-            return ("d", type(node).__name__, node.node_desc())
-        return ("opaque", id(node))    # unvetted: never reuse through it
-
-    def sig(node: Exec):
-        return node_key(node) + tuple(sig(c) for c in node.children)
-
+    sig = exchange_reuse_signature
     seen = {}
 
     def fix(node: Exec) -> Exec:
@@ -555,6 +569,7 @@ class TpuOverrides:
         # recompile.  cacheDir below is the exception (enable-only):
         # dropping the disk tier mid-process is expensive + irreversible.
         _SC.ASYNC_COMPILE = conf.get(C.COMPILE_ASYNC.key)
+        _SC.AUDIT_LEDGER = conf.get(C.AUDIT_LEDGER.key)
         _SC.set_max_programs(conf.get(C.COMPILE_MAX_PROGRAMS.key))
         # ENABLE-only (scan-cache discipline): an interleaved default-conf
         # session must not drop another session's disk tier; explicit
@@ -636,6 +651,12 @@ class TpuOverrides:
             from spark_rapids_tpu.exec.pipeline import \
                 insert_pipeline_prefetch
             out = insert_pipeline_prefetch(out)
+        if not for_explain and conf.get(C.DEBUG_PLAN_CHECK.key):
+            # runtime plan-invariant verifier: walks the FINAL tree
+            # (after every in-place pass) against the contracts the
+            # passes establish; observes + emits, never raises
+            from spark_rapids_tpu.plan.verify import verify_plan
+            verify_plan(out, conf)
         if not for_explain:
             # never on the explain path: instrument_plan resets the shared
             # per-node counters, and introspection must not zero the

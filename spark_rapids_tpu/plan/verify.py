@@ -1,0 +1,216 @@
+"""Runtime plan-invariant verifier (conf ``spark.rapids.debug.planCheck``).
+
+The planner passes in ``TpuOverrides.apply`` establish structural
+contracts the execution layer silently depends on — and nothing used to
+re-check the FINAL tree after every pass (several of which mutate in
+place) had run.  This module is the runtime companion of the static
+linter, in the exact mold of ``aux/lockorder``: armed by a debug conf,
+it walks every post-optimization physical plan, emits a
+``planInvariantViolation`` event per breach and counts them in a
+process-wide counter surfaced by ``render_prometheus()``.
+
+Checks (ids are the ``check`` field of the event):
+
+- ``materialize-boundary``: with encoding on and late materialization
+  OFF, every encoded-capable device scan sits directly under a
+  ``TpuMaterializeEncodedExec``; with late materialization on (or
+  encoding off) no materialize node exists at all
+  (plan/encoding.insert_materialize_boundaries's contract).
+- ``prefetch-placement``: no stacked spools (PrefetchExec directly
+  wrapping PrefetchExec), the boundary label is one the planner pass
+  knows, the node mirrors its child's device tier, the batch
+  coalescer / adaptive reader never has a spool INSIDE it, and a
+  pipeline-disabled plan carries no prefetch nodes
+  (exec/pipeline.insert_pipeline_prefetch's contract).
+- ``spillable-registration``: the spool implementation declares that
+  queued device batches register with the spill framework
+  (``PrefetchSpool.QUEUED_DEVICE_BATCHES_SPILLABLE``), and every
+  device-side spool has a positive depth and in-flight-byte budget —
+  an unbounded or unregistered queue holds device memory the catalog
+  cannot evict.
+- ``exchange-reuse``: no two DISTINCT shuffle-exchange instances in the
+  final tree share an ``exchange_reuse_signature`` (plan/overrides.py —
+  the verifier and the reuse pass share the one definition).  A pass
+  that shallow-copies a shared exchange apart re-materializes the
+  shuffle per parent; this is the bug class the in-place passes exist
+  to avoid.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import List
+
+__all__ = ["PlanViolation", "verify_plan", "violations_total",
+           "reset_observations"]
+
+#: boundary labels exec/pipeline.insert_pipeline_prefetch may assign
+KNOWN_PREFETCH_BOUNDARIES = frozenset(
+    {"decode", "transfer", "shuffle", "upload", "d2h"})
+
+_LOCK = threading.Lock()
+_VIOLATIONS_TOTAL = 0
+
+
+@dataclasses.dataclass
+class PlanViolation:
+    check: str
+    node: str       # node name (class-level, stable across runs)
+    detail: str
+
+
+def violations_total() -> int:
+    with _LOCK:
+        return _VIOLATIONS_TOTAL
+
+
+def reset_observations() -> None:
+    global _VIOLATIONS_TOTAL
+    with _LOCK:
+        _VIOLATIONS_TOTAL = 0
+
+
+def _walk_with_parent(plan):
+    """(parent, node) pairs by IDENTITY, each shared instance once —
+    reuse/CTE collapse makes the plan a DAG, and re-walking a shared
+    exchange per parent would double-count (or double-report) it."""
+    seen = set()
+    out = []
+
+    def visit(node, parent):
+        out.append((parent, node))
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for c in node.children:
+            visit(c, node)
+
+    visit(plan, None)
+    return out
+
+
+def verify_plan(plan, conf, emit_events: bool = True
+                ) -> List[PlanViolation]:
+    """Walks one post-optimization physical plan against the structural
+    contracts above.  Observes and reports — it never raises, so an
+    armed verifier cannot turn a benign drift into a query failure."""
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.exec.adaptive import AdaptiveShuffleReaderExec
+    from spark_rapids_tpu.exec.basic import (TpuCoalesceBatchesExec,
+                                             TpuMaterializeEncodedExec)
+    from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
+    from spark_rapids_tpu.exec.pipeline import (PIPELINE_DEPTH,
+                                                PIPELINE_MAX_BYTES,
+                                                PrefetchExec, PrefetchSpool)
+    from spark_rapids_tpu.io.multifile import MultiFileScanBase
+    from spark_rapids_tpu.plan.overrides import exchange_reuse_signature
+
+    violations: List[PlanViolation] = []
+
+    def report(check: str, node, detail: str) -> None:
+        violations.append(PlanViolation(check, node.name, detail))
+
+    pairs = _walk_with_parent(plan)
+    enc_on = bool(conf.get(C.ENCODING_ENABLED.key))
+    late_mat = bool(conf.get(C.ENCODING_LATE_MAT.key))
+    pipeline_on = bool(conf.get(C.PIPELINE_ENABLED.key))
+    reuse_on = bool(conf.get(C.EXCHANGE_REUSE_ENABLED.key))
+
+    for parent, node in pairs:
+        # -- materialize boundaries ------------------------------------
+        if isinstance(node, MultiFileScanBase) and \
+                getattr(node, "is_device", False) and \
+                enc_on and not late_mat and \
+                not isinstance(parent, TpuMaterializeEncodedExec):
+            report("materialize-boundary", node,
+                   "encoded-capable device scan without a "
+                   "TpuMaterializeEncoded parent while "
+                   "lateMaterialization=false — operators would see "
+                   "encoded columns the plan promised to decode eagerly")
+        if isinstance(node, TpuMaterializeEncodedExec) and \
+                (not enc_on or late_mat):
+            report("materialize-boundary", node,
+                   "eager materialize node present although the conf "
+                   "asks for " +
+                   ("late materialization" if enc_on else
+                    "encoding disabled") +
+                   " — the planner pass must be an exact no-op here")
+        # -- prefetch placement ----------------------------------------
+        if isinstance(node, PrefetchExec):
+            if not pipeline_on:
+                report("prefetch-placement", node,
+                       "prefetch node in a pipeline-disabled plan")
+            if node.children and isinstance(node.children[0],
+                                            PrefetchExec):
+                report("prefetch-placement", node,
+                       "stacked prefetch spools (spool directly wraps "
+                       "a spool): double buffering, double threads, "
+                       "zero extra overlap")
+            if node.boundary not in KNOWN_PREFETCH_BOUNDARIES:
+                report("prefetch-placement", node,
+                       f"unknown boundary {node.boundary!r} (planner "
+                       "inserts only "
+                       f"{sorted(KNOWN_PREFETCH_BOUNDARIES)})")
+            if node.children and \
+                    node.is_device != node.children[0].is_device:
+                report("prefetch-placement", node,
+                       "prefetch node's device tier does not mirror "
+                       "its child — transitions/markers above it see "
+                       "the wrong tier")
+            # -- spillable registration of queued batches --------------
+            if not getattr(PrefetchSpool,
+                           "QUEUED_DEVICE_BATCHES_SPILLABLE", False):
+                report("spillable-registration", node,
+                       "PrefetchSpool no longer declares queued device "
+                       "batches spillable — in-flight prefetch would "
+                       "pin device memory the catalog cannot evict")
+            depth = node.depth if node.depth is not None else \
+                PIPELINE_DEPTH
+            max_bytes = node.max_bytes if node.max_bytes is not None \
+                else PIPELINE_MAX_BYTES
+            if getattr(node, "is_device", False) and \
+                    (depth < 1 or max_bytes <= 0):
+                report("spillable-registration", node,
+                       f"device-side spool with depth={depth} "
+                       f"max_bytes={max_bytes}: queued device batches "
+                       "must be bounded (and thereby catalog-budgeted)")
+        if isinstance(node, (TpuCoalesceBatchesExec,
+                             AdaptiveShuffleReaderExec)) and \
+                node.children and \
+                isinstance(node.children[0], PrefetchExec):
+            report("prefetch-placement", node,
+                   f"{node.name} introspects its direct child; the "
+                   "spool belongs ABOVE it, never inside")
+
+    # -- exchange-reuse key consistency --------------------------------
+    if reuse_on:
+        # dedupe by IDENTITY first: a correctly-reused exchange appears
+        # once per parent edge in the walk, and counting those edges
+        # would flag reuse WORKING as reuse broken
+        by_sig: dict = {}
+        seen_ids: set = set()
+        for _parent, node in pairs:
+            if isinstance(node, CpuShuffleExchangeExec) and \
+                    id(node) not in seen_ids:
+                seen_ids.add(id(node))
+                by_sig.setdefault(exchange_reuse_signature(node),
+                                  []).append(node)
+        for sig, nodes in by_sig.items():
+            if len(nodes) > 1:
+                report("exchange-reuse", nodes[0],
+                       f"{len(nodes)} distinct exchange instances share "
+                       "one reuse signature — a pass split a shared "
+                       "exchange apart (or reuse never merged them); "
+                       "the shuffle materializes once per copy")
+
+    if violations:
+        global _VIOLATIONS_TOTAL
+        with _LOCK:
+            _VIOLATIONS_TOTAL += len(violations)
+        if emit_events:
+            from spark_rapids_tpu.aux.events import emit
+            for v in violations:
+                emit("planInvariantViolation", check=v.check,
+                     node=v.node, detail=v.detail)
+    return violations

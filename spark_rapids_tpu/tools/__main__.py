@@ -9,6 +9,10 @@ Commands:
 - ``compare <bench.json ...>``: diff BENCH payloads across runs/PRs.
 - ``lint [path]``: static engine-invariant analysis (docs/lint.md);
   exits non-zero on any unsuppressed finding.
+- ``audit <event-log>``: compiled-program audit over the stageProgram
+  ledger (docs/audit.md) — forbidden primitives, baked constants,
+  recompile storms, dtype widening, roofline cross-check; exits
+  non-zero on any unsuppressed error finding.
 """
 
 from __future__ import annotations
@@ -46,6 +50,32 @@ def _build_parser() -> argparse.ArgumentParser:
     cmp_p = sub.add_parser("compare", help="diff BENCH_r*.json payloads")
     cmp_p.add_argument("files", nargs="+")
     cmp_p.add_argument("--json", action="store_true")
+
+    aud = sub.add_parser("audit",
+                         help="compiled-program audit over the "
+                              "stageProgram ledger")
+    aud.add_argument("log", help="JSONL event log path (rotated .N "
+                                 "siblings read automatically)")
+    aud.add_argument("--json", action="store_true",
+                     help="machine-readable output")
+    aud.add_argument("--no-roofline", action="store_true",
+                     help="skip the per-program roofline table")
+    aud.add_argument("--storm-threshold", type=int, default=None,
+                     help="distinct cache keys over one program "
+                          "structure that count as a recompile storm")
+    aud.add_argument("--min-peak-fraction", type=float, default=0.0,
+                     help="flag programs achieving less than this "
+                          "fraction of peak (0 = report-only)")
+    aud.add_argument("--peak-flops", type=float, default=None,
+                     help="accelerator peak FLOP/s for the roofline")
+    aud.add_argument("--peak-bw", type=float, default=None,
+                     help="accelerator peak bytes/s for the roofline")
+    aud.add_argument("--baseline", default=None,
+                     help="baseline JSON path (default: "
+                          "<log dir>/.audit-baseline.json when present)")
+    aud.add_argument("--write-baseline", action="store_true",
+                     help="grandfather every active finding into the "
+                          "baseline file and exit 0")
 
     lint = sub.add_parser("lint",
                           help="static engine-invariant analysis")
@@ -100,6 +130,35 @@ def main(argv=None) -> int:
         else:
             sys.stdout.write(render_compare(args.files))
         return 0
+    if args.cmd == "audit":
+        from spark_rapids_tpu.tools.audit import (render_audit, run_audit,
+                                                  write_audit_baseline)
+        from spark_rapids_tpu.tools.audit.passes import (
+            DEFAULT_PEAK_BYTES_PER_S, DEFAULT_PEAK_FLOPS,
+            DEFAULT_STORM_THRESHOLD, default_audit_baseline_path)
+        report = run_audit(
+            args.log,
+            storm_threshold=(args.storm_threshold
+                             if args.storm_threshold is not None
+                             else DEFAULT_STORM_THRESHOLD),
+            min_peak_fraction=args.min_peak_fraction,
+            peak_flops=(args.peak_flops if args.peak_flops is not None
+                        else DEFAULT_PEAK_FLOPS),
+            peak_bw=(args.peak_bw if args.peak_bw is not None
+                     else DEFAULT_PEAK_BYTES_PER_S),
+            baseline_path=args.baseline)
+        if args.write_baseline:
+            path = args.baseline or default_audit_baseline_path(args.log)
+            n = write_audit_baseline(path, report)
+            print(f"wrote {n} baseline entr{'y' if n == 1 else 'ies'} "
+                  f"to {path}")
+            return 0
+        if args.json:
+            print(json.dumps(report.to_json(), indent=2))
+        else:
+            sys.stdout.write(render_audit(
+                report, show_roofline=not args.no_roofline))
+        return report.exit_code
     if args.cmd == "lint":
         from spark_rapids_tpu.tools.lint import (default_baseline_path,
                                                  default_rules,

@@ -1,0 +1,32 @@
+"""Compiled-program auditor: static analysis over every cached executable.
+
+PR 9's linter audits the engine's *Python source*; the artifacts that
+actually determine end-to-end speed — the jitted programs in the
+StageCompiler cache — were unaudited.  This package closes that gap by
+analyzing the **audit ledger**: the ``stageProgram`` rows
+(event-log schema v3) that ``exec/stage_compiler.py`` records for every
+program it builds — jaxpr structural signatures, primitive sets, const
+shapes/fingerprints, arg signatures, cost-analysis flops/bytes and
+cache-key provenance.  Audits therefore run fully offline, from an
+event log alone, with no jax and no device (reference analog: the
+plugin's ``api_validation`` module, applied to compiled IR instead of
+APIs; Flare's observation that whole-query compilation lives or dies on
+what gets baked into the generated program).
+
+Entry point::
+
+    python -m spark_rapids_tpu.tools audit <event-log> [--json] ...
+
+See ``docs/audit.md`` for the pass table, severity levels and the
+baseline suppression story (shared shape with ``tools lint``).
+"""
+
+from spark_rapids_tpu.tools.audit.passes import (AuditFinding,  # noqa: F401
+                                                 AuditReport, LedgerRow,
+                                                 cluster_rows, load_ledger,
+                                                 render_audit, run_audit,
+                                                 write_audit_baseline)
+
+__all__ = ["AuditFinding", "AuditReport", "LedgerRow", "cluster_rows",
+           "load_ledger", "render_audit", "run_audit",
+           "write_audit_baseline"]

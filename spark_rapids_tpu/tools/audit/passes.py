@@ -1,0 +1,547 @@
+"""The audit pass suite over the compiled-program ledger.
+
+Input: ``stageProgram`` rows (schema v3) from an event log — one per
+executable the StageCompiler built, carrying jaxpr signatures, the
+primitive set, const shapes/fingerprints, arg signature, cost-analysis
+flops/bytes and cache-key provenance.  No jax objects, no buffers: the
+whole suite runs stdlib-only, offline.
+
+Passes (ids are the ``pass`` field of a finding):
+
+- ``forbidden-primitive`` (error): a compiled program contains a
+  primitive that round-trips to the host (callbacks, infeed/outfeed,
+  debug taps).  A cached executable replays forever; a host round-trip
+  inside it serializes every dispatch and can observe ambient state.
+- ``baked-constant`` (error): within a cluster of programs sharing one
+  *normalized* structure (literal values scrubbed), a const's content
+  fingerprint varies across cache keys — the exact
+  missed-literal/table-promotion bug class PR 8/11 review hardening hit
+  twice.  Large consts (no fingerprint) repeated across keys of one
+  cluster are flagged as warnings: each executable bakes its own copy.
+- ``recompile-storm`` (error): N distinct cache keys collapse onto ONE
+  normalized structure — the key over-discriminates, and components
+  that do not change the program should be runtime arguments
+  (threshold configurable; promoted literals make healthy plans share
+  one key per structure).
+- ``dtype-audit`` (warning): a program's outputs carry float64/int64
+  although none of its inputs do — silent in-trace widening against
+  the batch schema.
+- ``roofline`` (warning): each program's flops/bytes joined against the
+  measured exclusive ``opTime`` of the exec spans its stage kind runs
+  under, yielding an achieved fraction of peak and a
+  compute-vs-memory-bound verdict; programs below
+  ``min_peak_fraction`` are flagged (default 0 = report-only table).
+
+Suppression mirrors ``tools lint``: a baseline JSON keyed by
+(pass, stage kind, signature) grandfathers known findings;
+``--write-baseline`` records the current active set.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+#: primitives a cached executable must never contain: host round-trips
+#: serialize every dispatch and can bake ambient observations
+FORBIDDEN_PRIMITIVES = {
+    "pure_callback": "host callback (jax.pure_callback)",
+    "io_callback": "host I/O callback",
+    "callback": "host callback",
+    "debug_callback": "host debug callback",
+    "debug_print": "host debug print",
+    "infeed": "host infeed",
+    "outfeed": "host outfeed",
+}
+
+#: cluster size at which distinct keys over one normalized structure
+#: count as a recompile storm
+DEFAULT_STORM_THRESHOLD = 4
+
+#: placeholder peaks for the roofline (override per accelerator via the
+#: CLI; deliberately modest so fractions read as upper bounds on CPU)
+DEFAULT_PEAK_FLOPS = 1.0e12
+DEFAULT_PEAK_BYTES_PER_S = 1.0e11
+
+#: stage-kind prefix -> exec span-name markers, for joining ledger rows
+#: to measured opTime (tools/profile exclusive times)
+KIND_SPAN_MARKERS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    ("fused.stage", ("TpuFusedStage",)),
+    ("fused.agg", ("TpuFusedAgg",)),
+    ("basic.filter_project", ("TpuFilter", "TpuProject")),
+    ("expr.project", ("TpuProject", "TpuFusedStage")),
+    ("agg.", ("TpuHashAggregate", "TpuFusedAgg")),
+    ("join.", ("Join",)),
+    ("sort.", ("Sort",)),
+    ("window.", ("Window",)),
+    ("transfer.pack", ("HostToDevice",)),
+    ("transfer.unpack", ("DeviceToHost",)),
+    ("batch.", ("Coalesce",)),
+    ("exchange.", ("Shuffle", "Exchange")),
+    ("encoding.", ("Materialize",)),
+)
+
+AUDIT_SCHEMA_VERSION = 1
+BASELINE_BASENAME = ".audit-baseline.json"
+
+
+@dataclasses.dataclass
+class LedgerRow:
+    """One ``stageProgram`` event, typed."""
+    kind: str
+    key: str
+    key_repr: str
+    struct_sig: str
+    norm_sig: str
+    primitives: List[str]
+    eqns: int
+    consts: List[Dict]
+    n_args: int
+    args: List[str]
+    in_dtypes: List[str]
+    out_dtypes: List[str]
+    flops: Optional[float]
+    bytes_accessed: Optional[float]
+    query_id: int = -1
+
+    @classmethod
+    def from_event(cls, ev) -> "LedgerRow":
+        p = ev.payload
+        return cls(
+            kind=str(p.get("stage_kind", "?")),
+            key=str(p.get("key", "?")),
+            key_repr=str(p.get("key_repr", "")),
+            struct_sig=str(p.get("struct_sig", "?")),
+            norm_sig=str(p.get("norm_sig", "?")),
+            primitives=list(p.get("primitives", []) or []),
+            eqns=int(p.get("eqns", 0) or 0),
+            consts=list(p.get("consts", []) or []),
+            n_args=int(p.get("n_args", 0) or 0),
+            args=list(p.get("args", []) or []),
+            in_dtypes=list(p.get("in_dtypes", []) or []),
+            out_dtypes=list(p.get("out_dtypes", []) or []),
+            flops=(None if p.get("flops") is None
+                   else float(p["flops"])),
+            bytes_accessed=(None if p.get("bytes_accessed") is None
+                            else float(p["bytes_accessed"])),
+            query_id=ev.query_id,
+        )
+
+
+@dataclasses.dataclass
+class AuditFinding:
+    pass_id: str
+    severity: str               # "error" | "warning"
+    kind: str                   # stage kind
+    sig: str                    # clustering signature (baseline key)
+    message: str
+    evidence: List[str] = dataclasses.field(default_factory=list)
+    #: None = active; "baseline" = suppressed (still listed)
+    suppressed: Optional[str] = None
+
+    def to_json(self) -> Dict:
+        return {"pass": self.pass_id, "severity": self.severity,
+                "kind": self.kind, "sig": self.sig,
+                "message": self.message, "evidence": self.evidence,
+                "suppressed": self.suppressed}
+
+
+@dataclasses.dataclass
+class RooflineEntry:
+    kind: str
+    key: str
+    flops: Optional[float]
+    bytes_accessed: Optional[float]
+    intensity: Optional[float]          # flops / byte
+    bound: str                          # "compute" | "memory" | "?"
+    sec_per_call: Optional[float]       # measured, None when unjoined
+    peak_fraction: Optional[float]
+
+    def to_json(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class AuditReport:
+    files: List[str]
+    rows: List[LedgerRow]
+    findings: List[AuditFinding]
+    roofline: List[RooflineEntry]
+    plan_violations: int                # planInvariantViolation rows seen
+
+    @property
+    def active(self) -> List[AuditFinding]:
+        return [f for f in self.findings if f.suppressed is None]
+
+    @property
+    def active_errors(self) -> List[AuditFinding]:
+        return [f for f in self.active if f.severity == "error"]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.active_errors else 0
+
+    def to_json(self) -> Dict:
+        by_sup = sum(1 for f in self.findings if f.suppressed)
+        return {
+            "version": AUDIT_SCHEMA_VERSION,
+            "files": self.files,
+            "programs": len(self.rows),
+            "kinds": sorted({r.kind for r in self.rows}),
+            "structures": len({(r.kind, r.norm_sig) for r in self.rows}),
+            "plan_violations": self.plan_violations,
+            "findings": [f.to_json() for f in self.findings],
+            "roofline": [e.to_json() for e in self.roofline],
+            "summary": {
+                "active_errors": len(self.active_errors),
+                "active_warnings": len(self.active)
+                - len(self.active_errors),
+                "suppressed_baseline": by_sup,
+            },
+        }
+
+
+# ---------------------------------------------------------------------------
+# ledger ingestion
+# ---------------------------------------------------------------------------
+
+def load_ledger(path: str):
+    """(ledger rows, profiles, diagnostics, plan-violation count) from an
+    event log — one reader pass serves the passes AND the roofline
+    join."""
+    from spark_rapids_tpu.tools.reader import (profiles_from_events,
+                                               read_events)
+    events, diag = read_events(path)
+    rows = [LedgerRow.from_event(ev) for ev in events
+            if ev.kind == "stageProgram"]
+    plan_violations = sum(1 for ev in events
+                          if ev.kind == "planInvariantViolation")
+    profiles, _ = profiles_from_events(events, diag)
+    return rows, profiles, diag, plan_violations
+
+
+def cluster_rows(rows: Sequence[LedgerRow]
+                 ) -> Dict[Tuple[str, str], Dict[str, List[LedgerRow]]]:
+    """(kind, normalized structure) -> {cache key -> rows}.  Distinct
+    keys per cluster is THE over-discrimination measure: a healthy
+    promoted plan has one key per structure (per shape variant)."""
+    out: Dict[Tuple[str, str], Dict[str, List[LedgerRow]]] = {}
+    for r in rows:
+        out.setdefault((r.kind, r.norm_sig), {}) \
+            .setdefault(r.key, []).append(r)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# passes
+# ---------------------------------------------------------------------------
+
+def _pass_forbidden(rows) -> List[AuditFinding]:
+    out = []
+    for r in rows:
+        bad = sorted(set(r.primitives) & set(FORBIDDEN_PRIMITIVES))
+        if bad:
+            out.append(AuditFinding(
+                "forbidden-primitive", "error", r.kind, r.struct_sig,
+                f"program {r.key} contains "
+                + ", ".join(f"{b} ({FORBIDDEN_PRIMITIVES[b]})"
+                            for b in bad)
+                + " — a cached executable must never round-trip to the "
+                  "host",
+                [f"key_repr={r.key_repr[:160]}"]))
+    return out
+
+
+def _pass_baked_constants(clusters) -> List[AuditFinding]:
+    out = []
+    for (kind, norm_sig), by_key in sorted(clusters.items()):
+        if len(by_key) < 2:
+            continue
+        # one representative row per key, consts aligned by position
+        reps = [rs[0] for rs in by_key.values()]
+        n_consts = min(len(r.consts) for r in reps)
+        for i in range(n_consts):
+            fps = {r.consts[i].get("fp") for r in reps}
+            shapes = {tuple(r.consts[i].get("shape", [])) for r in reps}
+            c0 = reps[0].consts[i]
+            where = (f"const #{i} shape={c0.get('shape')} "
+                     f"dtype={c0.get('dtype')}")
+            if "large" in fps:
+                out.append(AuditFinding(
+                    "baked-constant", "warning", kind, norm_sig,
+                    f"{where} exceeds the fingerprint cap and is baked "
+                    f"into {len(by_key)} executables of one structure — "
+                    "each holds its own copy; promote the table to a "
+                    "runtime argument",
+                    [f"keys={sorted(by_key)[:4]}"]))
+            elif len(fps - {"unreadable"}) > 1:
+                out.append(AuditFinding(
+                    "baked-constant", "error", kind, norm_sig,
+                    f"{where} varies across {len(by_key)} cache keys of "
+                    "one program structure (fingerprints "
+                    f"{sorted(fps)[:4]}) — a missed literal/table "
+                    "promotion: the value belongs in the runtime "
+                    "argument list, not the executable",
+                    [f"shapes={sorted(shapes)[:4]}",
+                     f"keys={sorted(by_key)[:4]}"]))
+    return out
+
+
+def _pass_storms(clusters, threshold: int) -> List[AuditFinding]:
+    out = []
+    for (kind, norm_sig), by_key in sorted(clusters.items()):
+        if len(by_key) < threshold:
+            continue
+        reps = [rs[0] for rs in by_key.values()]
+        exact = {r.struct_sig for r in reps}
+        literal_hint = (len(exact) > 1)
+        out.append(AuditFinding(
+            "recompile-storm", "error", kind, norm_sig,
+            f"{len(by_key)} distinct cache keys compiled ONE program "
+            f"structure ({kind}): the key over-discriminates — the "
+            "varying component should be a runtime argument"
+            + (" (inline literal values differ across the cluster: "
+               "literal promotion is off or missed this site)"
+               if literal_hint else ""),
+            [f"keys={sorted(by_key)[:6]}",
+             f"example key_repr={reps[0].key_repr[:200]}"]))
+    return out
+
+
+_WIDE = {"float64": ("float32", "float16", "bfloat16"),
+         "int64": ("int32", "int16", "int8")}
+
+
+def _pass_dtypes(rows) -> List[AuditFinding]:
+    out = []
+    seen: Set[Tuple[str, str, str]] = set()
+    for r in rows:
+        for wide, narrows in _WIDE.items():
+            if wide in r.out_dtypes and wide not in r.in_dtypes and \
+                    any(n in r.in_dtypes for n in narrows):
+                dedup = (r.kind, r.struct_sig, wide)
+                if dedup in seen:
+                    continue
+                seen.add(dedup)
+                out.append(AuditFinding(
+                    "dtype-audit", "warning", r.kind, r.struct_sig,
+                    f"program {r.key} widens to {wide} in-trace "
+                    f"(inputs are {sorted(r.in_dtypes)}) — silent "
+                    "widening doubles HBM traffic vs the batch schema",
+                    [f"out_dtypes={sorted(r.out_dtypes)}"]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# roofline cross-check
+# ---------------------------------------------------------------------------
+
+def _kind_markers(kind: str) -> Tuple[str, ...]:
+    for prefix, markers in KIND_SPAN_MARKERS:
+        if kind.startswith(prefix):
+            return markers
+    return ()
+
+
+def _measured_by_kind(profiles) -> Dict[str, Tuple[float, int]]:
+    """stage kind -> (exclusive seconds, batches) summed over every
+    profiled query's spans whose node name carries the kind's marker."""
+    if not profiles:
+        return {}
+    from spark_rapids_tpu.tools.profile import attribute
+    per_marker: Dict[str, Tuple[float, int]] = {}
+    for qp in profiles:
+        att = attribute(qp)
+        for op in att.operators:
+            s, n = per_marker.get(op.name, (0.0, 0))
+            per_marker[op.name] = (s + op.exclusive_s,
+                                   n + max(op.batches, 0))
+    out: Dict[str, Tuple[float, int]] = {}
+    for prefix, markers in KIND_SPAN_MARKERS:
+        tot_s, tot_n = 0.0, 0
+        for name, (s, n) in per_marker.items():
+            if any(m in name for m in markers):
+                tot_s += s
+                tot_n += n
+        if tot_s > 0:
+            out[prefix] = (tot_s, tot_n)
+    return out
+
+
+def _pass_roofline(rows, profiles, peak_flops: float, peak_bw: float,
+                   min_fraction: float
+                   ) -> Tuple[List[RooflineEntry], List[AuditFinding]]:
+    measured = _measured_by_kind(profiles)
+    balance = peak_flops / max(peak_bw, 1.0)
+    entries: List[RooflineEntry] = []
+    findings: List[AuditFinding] = []
+    #: programs per kind-prefix, to split the kind's measured seconds
+    calls_by_prefix: Dict[str, int] = {}
+    for r in rows:
+        for prefix, _m in KIND_SPAN_MARKERS:
+            if r.kind.startswith(prefix):
+                calls_by_prefix[prefix] = \
+                    calls_by_prefix.get(prefix, 0) + 1
+                break
+    for r in rows:
+        flops, nbytes = r.flops, r.bytes_accessed
+        intensity = None
+        bound = "?"
+        if flops is not None and nbytes:
+            intensity = flops / nbytes
+            bound = "compute" if intensity >= balance else "memory"
+        sec = frac = None
+        prefix = next((p for p, _m in KIND_SPAN_MARKERS
+                       if r.kind.startswith(p)), None)
+        if prefix in measured and flops is not None and nbytes:
+            tot_s, tot_n = measured[prefix]
+            # dispatch count proxy: the kind's batch count, split across
+            # the kind's programs (the ledger has builds, not dispatches)
+            n_calls = max(tot_n, calls_by_prefix.get(prefix, 1))
+            sec = tot_s / max(n_calls, 1)
+            if sec > 0:
+                # time the peak machine would need for the same work,
+                # whichever resource binds
+                ideal = max(flops / peak_flops, nbytes / peak_bw)
+                frac = min(1.0, ideal / sec)
+        entries.append(RooflineEntry(
+            r.kind, r.key, flops, nbytes,
+            None if intensity is None else round(intensity, 4),
+            bound,
+            None if sec is None else round(sec, 6),
+            None if frac is None else round(frac, 6)))
+        if frac is not None and min_fraction > 0 and frac < min_fraction:
+            findings.append(AuditFinding(
+                "roofline", "warning", r.kind, r.struct_sig,
+                f"program {r.key} achieves {frac * 100:.2f}% of the "
+                f"{bound}-bound peak (est {sec * 1e3:.3f}ms/call for "
+                f"{flops:.3g} flops / {nbytes:.3g} bytes) — below the "
+                f"{min_fraction * 100:.0f}% floor",
+                [f"intensity={intensity:.4g} flops/byte, machine "
+                 f"balance={balance:.4g}"]))
+    entries.sort(key=lambda e: (e.kind, e.key))
+    return entries, findings
+
+
+# ---------------------------------------------------------------------------
+# baseline (same shape as tools lint)
+# ---------------------------------------------------------------------------
+
+def default_audit_baseline_path(log_path: str) -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(log_path)),
+                        BASELINE_BASENAME)
+
+
+def _load_baseline(path: Optional[str]) -> Set[Tuple[str, str, str]]:
+    if not path or not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {(e["pass"], e["kind"], e["sig"])
+            for e in data.get("entries", [])}
+
+
+def write_audit_baseline(path: str, report: AuditReport) -> int:
+    """Grandfathers every ACTIVE finding; entries key by (pass, stage
+    kind, structural signature) — they survive re-runs of the same
+    workload and invalidate when the program structure changes.
+    Already-baselined findings are RE-written (not dropped): a second
+    ``--write-baseline`` over the same log must be idempotent, never an
+    accidental wipe of everything the first run grandfathered."""
+    entries = [{"pass": f.pass_id, "kind": f.kind, "sig": f.sig}
+               for f in report.findings
+               if f.suppressed in (None, "baseline")]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": AUDIT_SCHEMA_VERSION, "entries": entries},
+                  fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return len(entries)
+
+
+# ---------------------------------------------------------------------------
+# runner + rendering
+# ---------------------------------------------------------------------------
+
+def run_audit(path: Optional[str] = None,
+              rows: Optional[Sequence[LedgerRow]] = None,
+              profiles=None,
+              storm_threshold: int = DEFAULT_STORM_THRESHOLD,
+              min_peak_fraction: float = 0.0,
+              peak_flops: float = DEFAULT_PEAK_FLOPS,
+              peak_bw: float = DEFAULT_PEAK_BYTES_PER_S,
+              baseline_path: Optional[str] = None) -> AuditReport:
+    """The full pass suite.  Pass an event-log ``path`` (the CLI), or
+    pre-loaded ``rows``/``profiles`` (tests, bench)."""
+    files: List[str] = []
+    plan_violations = 0
+    if rows is None:
+        if path is None:
+            raise ValueError("run_audit needs an event-log path or rows")
+        rows, profiles, diag, plan_violations = load_ledger(path)
+        files = diag.files
+    rows = list(rows)
+    clusters = cluster_rows(rows)
+    findings: List[AuditFinding] = []
+    findings += _pass_forbidden(rows)
+    findings += _pass_baked_constants(clusters)
+    findings += _pass_storms(clusters, storm_threshold)
+    findings += _pass_dtypes(rows)
+    roofline, rf = _pass_roofline(rows, profiles, peak_flops, peak_bw,
+                                  min_peak_fraction)
+    findings += rf
+    if baseline_path is None and path is not None:
+        candidate = default_audit_baseline_path(path)
+        baseline_path = candidate if os.path.exists(candidate) else None
+    baseline = _load_baseline(baseline_path)
+    for f in findings:
+        if (f.pass_id, f.kind, f.sig) in baseline:
+            f.suppressed = "baseline"
+    findings.sort(key=lambda f: (f.severity != "error", f.pass_id,
+                                 f.kind, f.sig))
+    return AuditReport(files, rows, findings, roofline, plan_violations)
+
+
+def render_audit(report: AuditReport, show_roofline: bool = True) -> str:
+    rows = report.rows
+    lines = [f"== audit: {len(rows)} program(s), "
+             f"{len({(r.kind, r.norm_sig) for r in rows})} structure(s), "
+             f"{len({r.kind for r in rows})} kind(s)"
+             + (f" across {len(report.files)} file(s)" if report.files
+                else "") + " =="]
+    if not rows:
+        lines.append("!! no stageProgram rows: the log predates schema "
+                     "v3 or spark.rapids.audit.ledger was off")
+    if report.plan_violations:
+        lines.append(f"!! {report.plan_violations} planInvariantViolation "
+                     "event(s) in this log (spark.rapids.debug.planCheck)")
+    for f in report.findings:
+        mark = "" if f.suppressed is None else f"  [{f.suppressed}]"
+        lines.append(f"{f.severity}: {f.pass_id}: [{f.kind}] "
+                     f"{f.message}{mark}")
+        for e in f.evidence:
+            lines.append(f"    evidence: {e}")
+    if show_roofline and report.roofline:
+        lines.append("")
+        lines.append("  Roofline (per program; fractions are estimates "
+                     "from kind-level measured opTime):")
+        lines.append(f"    {'kind':<24}{'key':<14}{'flops':>12}"
+                     f"{'bytes':>12}{'F/B':>8}{'bound':>9}"
+                     f"{'s/call':>11}{'%peak':>8}")
+        for e in report.roofline:
+            def fmt(v, spec):
+                return "-" if v is None else format(v, spec)
+            lines.append(
+                f"    {e.kind:<24}{e.key:<14}"
+                f"{fmt(e.flops, '12.4g'):>12}"
+                f"{fmt(e.bytes_accessed, '12.4g'):>12}"
+                f"{fmt(e.intensity, '8.3g'):>8}{e.bound:>9}"
+                f"{fmt(e.sec_per_call, '11.6f'):>11}"
+                + ("       -" if e.peak_fraction is None
+                   else f"{e.peak_fraction * 100:7.2f}%"))
+    active = report.active
+    lines.append(f"{len(active)} finding(s) "
+                 f"({len(report.findings) - len(active)} suppressed); "
+                 + ("FAIL" if report.exit_code else "OK"))
+    return "\n".join(lines) + "\n"

@@ -44,6 +44,11 @@ Rules (see docs/tools.md for the full semantics):
    oversized-dictionary rejections at upload, shrink
    ``spark.rapids.sql.encoding.maxDictionarySize`` so those columns
    skip the encode attempt entirely.
+9. **audit-surfaced recompile storm** → the ``stageProgram`` ledger
+   (schema v3) shows many cache keys compiling ONE program structure
+   while ``spark.rapids.sql.compile.literalPromotion`` is off: enable
+   it so plans differing only in literal values share executables
+   (the same clustering ``tools audit`` uses for its storm pass).
 
 Thresholds are fractions of query wall time; rules stay silent without
 their evidence, and rules 2 and 4 are mutually exclusive by
@@ -329,10 +334,55 @@ def autotune_query(profile: QueryProfile,
     return recs
 
 
+def _rule9_recompile_storm(profiles: List[QueryProfile]
+                           ) -> Optional[Recommendation]:
+    """Rule 9 is CROSS-query by nature: a parameterized workload builds
+    one cache key per query (d_year=1998 today, 1999 tomorrow), so no
+    single query's ledger shows the cluster — the storm only appears
+    when the stageProgram rows of the whole log are clustered together
+    (the same (kind, normalized structure) grouping ``tools audit``
+    uses).  With literal promotion already on the rule stays silent:
+    the storm is then a key-design problem for the auditor, not a conf
+    fix."""
+    rows, row_qid = [], {}
+    promo_off_qid = None
+    for qp in profiles:
+        promo = _conf_value(
+            qp, "spark.rapids.sql.compile.literalPromotion")
+        if promo not in (False, "false"):
+            continue
+        promo_off_qid = qp.query_id
+        for ev in qp.events_of("stageProgram"):
+            rows.append(ev)
+    if not rows or promo_off_qid is None:
+        return None
+    from spark_rapids_tpu.tools.audit import LedgerRow, cluster_rows
+    ledger = [LedgerRow.from_event(e) for e in rows]
+    clusters = cluster_rows(ledger)
+    storms = {ck: by_key for ck, by_key in clusters.items()
+              if len(by_key) >= 3}
+    if not storms:
+        return None
+    n_keys = sum(len(v) for v in storms.values())
+    worst = max(storms.items(), key=lambda kv: len(kv[1]))
+    return Recommendation(
+        "spark.rapids.sql.compile.literalPromotion", False, True,
+        f"recompile storm: {n_keys} cache keys across {len(storms)} "
+        "program structure(s) with literal promotion OFF — plans "
+        "differing only in literal values compile per value; promotion "
+        "makes them share one executable",
+        [f"kind={worst[0][0]} structure={worst[0][1]} "
+         f"keys={len(worst[1])}"] + _cite(
+            [rs[0] for rs in worst[1].values()], lambda r:
+            f"stageProgram key={r.key} key_repr={r.key_repr[:80]}"),
+        promo_off_qid)
+
+
 def autotune(profiles: List[QueryProfile]) -> List[Recommendation]:
     """All rules over all queries, deduplicated to the strongest form of
     each key (recommendations from different queries for the same key
-    keep the one backed by the slowest query)."""
+    keep the one backed by the slowest query); plus the cross-query
+    rule 9 over the combined stageProgram ledger."""
     by_key: Dict[str, Recommendation] = {}
     by_key_wall: Dict[str, float] = {}
     for qp in profiles:
@@ -341,6 +391,9 @@ def autotune(profiles: List[QueryProfile]) -> List[Recommendation]:
             if rec.key not in by_key or att.wall_s > by_key_wall[rec.key]:
                 by_key[rec.key] = rec
                 by_key_wall[rec.key] = att.wall_s
+    storm = _rule9_recompile_storm(profiles)
+    if storm is not None and storm.key not in by_key:
+        by_key[storm.key] = storm
     return list(by_key.values())
 
 

@@ -31,6 +31,8 @@ METRICS: List[Tuple[str, str, Optional[bool]]] = [
     ("fetch retries", "chaos.fetch_retries", False),
     ("query tasks", "query_metrics.tasks", None),
     ("query spill bytes", "query_metrics.spill_bytes", False),
+    ("programs built", "event_log.audit.programs", None),
+    ("audit errors", "event_log.audit.errors", False),
 ]
 
 
@@ -83,20 +85,50 @@ def load_bench(path: str) -> Dict:
     return last
 
 
+def run_failure(payload: Dict) -> Optional[str]:
+    """A payload from a run that FAILED rather than measured: its
+    numbers are placeholders (value 0, vs_baseline 0.0 from the bench
+    failsafe), and comparing against them would report a −100%/÷0
+    'regression' where the honest verdict is 'run failed'
+    (BENCH_r05: ``budget_exceeded`` with value 0)."""
+    if not isinstance(payload, dict):
+        return None
+    # a run that produced a real primary value is a (possibly partial)
+    # measurement even if a later phase tripped the budget alarm
+    # (BENCH_r04 carries budget_exceeded WITH a real value); only a
+    # placeholder-zero payload is a failed run
+    if payload.get("value"):
+        return None
+    if payload.get("budget_exceeded"):
+        return str(payload.get("error") or "budget exceeded")
+    if payload.get("error"):
+        return str(payload["error"])
+    return None
+
+
 def compare(paths: List[str]) -> Dict:
     """Structured diff: every known metric across every payload, with a
     relative delta of last vs first where both are numeric.  A payload
     that doesn't load (a crashed run's capture) shows as an empty column
-    and is listed under ``errors`` instead of aborting the comparison."""
+    and is listed under ``errors``; a payload from a FAILED run (bench
+    failsafe output) is skipped-and-flagged under ``failed`` — its
+    placeholder zeros never enter a delta."""
     payloads = []
     errors: Dict[str, str] = {}
+    failed: Dict[str, str] = {}
     for p in paths:
         name = os.path.basename(p)
         try:
-            payloads.append((name, load_bench(p)))
+            pl = load_bench(p)
         except (OSError, ValueError) as e:
             errors[name] = str(e)
             payloads.append((name, {}))
+            continue
+        why = run_failure(pl)
+        if why is not None:
+            failed[name] = why
+            pl = {}     # placeholder numbers must not enter any row
+        payloads.append((name, pl))
     rows = []
     for label, dotted, higher_better in METRICS:
         values = [_dig(pl, dotted) for _, pl in payloads]
@@ -115,7 +147,7 @@ def compare(paths: List[str]) -> Dict:
                                      else delta > 0.05)
         rows.append(row)
     return {"files": [name for name, _ in payloads], "rows": rows,
-            "errors": errors}
+            "errors": errors, "failed": failed}
 
 
 def render_compare(paths: List[str]) -> str:
@@ -143,6 +175,9 @@ def render_compare(paths: List[str]) -> str:
         lines.append("")
         lines.append("!! regressions (>5% the wrong way): "
                      + ", ".join(regressions))
+    for name, msg in out.get("failed", {}).items():
+        lines.append(f"!! {name}: run failed ({msg}) — excluded from "
+                     "deltas")
     for name, msg in out.get("errors", {}).items():
         lines.append(f"!! {name}: no payload loaded ({msg})")
     return "\n".join(lines) + "\n"

@@ -202,9 +202,12 @@ def load_baseline(path: Optional[str]) -> Set[Tuple[str, str, str]]:
 
 
 def write_baseline(path: str, report: "LintReport") -> int:
-    """Grandfathers every ACTIVE finding of ``report`` into ``path``;
-    returns the entry count.  Re-reads the flagged files so it needs
-    only the report."""
+    """Grandfathers every ACTIVE finding of ``report`` into ``path``
+    (and RE-writes findings already suppressed by a baseline, so a
+    second ``--write-baseline`` run is idempotent instead of wiping the
+    first run's entries); returns the entry count.  Re-reads the
+    flagged files so it needs only the report.  Inline-suppressed
+    findings stay out — their waiver lives in the source."""
     cache: Dict[str, List[str]] = {}
 
     def line_text(rel: str, lineno: int) -> str:
@@ -220,7 +223,8 @@ def write_baseline(path: str, report: "LintReport") -> int:
 
     entries = [{"rule": f.rule, "file": f.file,
                 "line_text": line_text(f.file, f.line).strip()}
-               for f in report.findings if f.suppressed is None]
+               for f in report.findings
+               if f.suppressed in (None, "baseline")]
     with open(path, "w", encoding="utf-8") as fh:
         json.dump({"version": LINT_SCHEMA_VERSION, "entries": entries},
                   fh, indent=2, sort_keys=True)

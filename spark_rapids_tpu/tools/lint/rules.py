@@ -87,6 +87,101 @@ class JitSiteRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# aot-site
+# ---------------------------------------------------------------------------
+
+class AotSiteRule(Rule):
+    """The compiled-program audit ledger (stageProgram rows) is recorded
+    where programs are built — exec/stage_compiler.py.  An AOT
+    ``.lower(...)/.compile()`` pipeline anywhere else produces an
+    executable the ledger never sees, so the auditor's 'every cached
+    program is audited' guarantee silently stops holding."""
+
+    id = "aot-site"
+    invariant = (".lower(args)/.compile() AOT compilation on jit "
+                 "objects only inside exec/stage_compiler.py; every "
+                 "program reaches the audit ledger")
+    rationale = ("the auditor (tools audit) can only vouch for "
+                 "programs whose build ran through the stage "
+                 "compiler's ledger recorder; an out-of-band AOT "
+                 "compile is an unaudited executable")
+    hint = ("obtain the program via exec.stage_compiler.get_or_build "
+            "(it owns AOT lowering AND ledger recording), or annotate "
+            "'# lint: ok=aot-site' with a reason")
+
+    ALLOWED_FILES = ("exec/stage_compiler.py",)
+
+    #: the jax AOT entry points: ``jitted.lower(args)`` and
+    #: ``jitted.trace(args)``.  Both take the program's example
+    #: arguments, which is what separates them statically from
+    #: ``str.lower()`` / attribute look-alikes (argless)
+    _ENTRY_ATTRS = frozenset({"lower", "trace"})
+
+    def check_file(self, ctx: LintContext, pf: ParsedFile) -> None:
+        if pf.rel in self.ALLOWED_FILES:
+            return
+        # names bound from an AOT pipeline stage: entry calls
+        # ('traced = f.trace(x)', 'lowered = f.lower(x)') and argless
+        # '.lower()' on an already-tracked name ('lowered =
+        # traced.lower()') — fixpoint over assignment order
+        tracked: Set[str] = set()
+        grew = True
+        while grew:
+            grew = False
+            for node in pf.nodes:
+                if not isinstance(node, ast.Assign):
+                    continue
+                if self._is_aot_stage(node.value, tracked):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and \
+                                t.id not in tracked:
+                            tracked.add(t.id)
+                            grew = True
+        for node in pf.nodes:
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute):
+                continue
+            if self._is_entry_call(node):
+                self.report(ctx, pf.rel, node.lineno,
+                            f".{node.func.attr}(...) AOT "
+                            "trace/lowering outside the stage compiler")
+            elif node.func.attr == "compile":
+                recv = node.func.value
+                chained = isinstance(recv, ast.Call) and \
+                    self._is_aot_stage(recv, tracked)
+                from_tracked = isinstance(recv, ast.Name) and \
+                    recv.id in tracked
+                if chained or from_tracked:
+                    self.report(ctx, pf.rel, node.lineno,
+                                ".compile() of a traced/lowered "
+                                "program outside the stage compiler")
+
+    @classmethod
+    def _is_entry_call(cls, node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in cls._ENTRY_ATTRS
+                and bool(node.args or node.keywords))
+
+    @classmethod
+    def _is_aot_stage(cls, node: ast.AST, tracked: Set[str]) -> bool:
+        """An expression yielding a Traced/Lowered: an entry call, or
+        an argless ``.lower()`` whose receiver is itself a stage or a
+        tracked name (``jitted.trace(x).lower()``)."""
+        if cls._is_entry_call(node):
+            return True
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "lower" and \
+                not node.args and not node.keywords:
+            recv = node.func.value
+            if isinstance(recv, ast.Name) and recv.id in tracked:
+                return True
+            return cls._is_aot_stage(recv, tracked)
+        return False
+
+
+# ---------------------------------------------------------------------------
 # conf-registry
 # ---------------------------------------------------------------------------
 
@@ -668,6 +763,7 @@ def default_rules() -> List[Rule]:
     """Fresh rule instances (rules keep per-run state)."""
     return [
         JitSiteRule(),
+        AotSiteRule(),
         ConfRegistryRule(),
         EventCatalogRule(),
         TracedPurityRule(),

@@ -335,6 +335,23 @@ def render_report(profiles: List[QueryProfile], diag: ReadDiagnostics,
                          f"violation(s) recorded by the runtime validator "
                          f"({', '.join(pairs)}) — acquisition went "
                          "backward against the canonical order")
+        plan_violations = qp.events_of("planInvariantViolation")
+        if plan_violations:
+            checks = sorted({str(ev.payload.get("check"))
+                             for ev in plan_violations})
+            lines.append(f"  !! {len(plan_violations)} plan-invariant "
+                         f"violation(s) ({', '.join(checks)}) — the "
+                         "post-optimization plan broke a structural "
+                         "contract (spark.rapids.debug.planCheck)")
+        prog_evs = qp.events_of("stageProgram")
+        if prog_evs:
+            kinds = {str(e.payload.get("stage_kind")) for e in prog_evs}
+            structs = {(e.payload.get("stage_kind"),
+                        e.payload.get("norm_sig")) for e in prog_evs}
+            lines.append(f"  Programs: {len(prog_evs)} built "
+                         f"({len(structs)} structure(s), {len(kinds)} "
+                         "kind(s)) — audit with: python -m "
+                         "spark_rapids_tpu.tools audit <log>")
         if show_timeline:
             _render_timeline(qp, lines)
         if qp.samples:

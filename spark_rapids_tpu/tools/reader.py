@@ -18,8 +18,11 @@ processes are the EXPECTED input, not a corner case:
 - **versions**: v1 logs (PR 1, no structural span fields) load with a
   flat span list under a synthetic root; v2 logs rebuild the exec span
   tree from ``parent_id``/``depth`` and per-partition timelines from the
-  ``partitions`` payload.  A version newer than ``SUPPORTED_VERSIONS``
-  raises — guessing at future schemas would corrupt attribution.
+  ``partitions`` payload; v3 adds the compiled-program audit rows
+  (``stageProgram``, ``planInvariantViolation``) which ride through as
+  ordinary events (tools/audit consumes them).  A version newer than
+  ``SUPPORTED_VERSIONS`` raises — guessing at future schemas would
+  corrupt attribution.
 
 This module imports only the standard library plus ``aux.events`` (also
 stdlib-only), so the CLI runs without jax or a device runtime.
@@ -38,7 +41,7 @@ from typing import Dict, List, Optional, Tuple
 from spark_rapids_tpu.aux.events import NO_QUERY, Event
 
 #: schema versions this reader understands (events carry "v" per line)
-SUPPORTED_VERSIONS = (1, 2)
+SUPPORTED_VERSIONS = (1, 2, 3)
 
 
 @dataclasses.dataclass
@@ -251,6 +254,14 @@ def load_profiles(path: str) -> Tuple[List[QueryProfile], ReadDiagnostics]:
     """Reconstructs per-query profiles (span trees, timelines, events)
     plus the out-of-query sample stream, aligned by timestamp."""
     events, diag = read_events(path)
+    return profiles_from_events(events, diag)
+
+
+def profiles_from_events(events: List[Event], diag: ReadDiagnostics
+                         ) -> Tuple[List[QueryProfile], ReadDiagnostics]:
+    """Profile reconstruction over an already-ingested event list, so a
+    caller that needs BOTH the raw events and the profiles (tools/audit)
+    pays one file parse, not two."""
     #: latest open profile per query id; query ids restart per PROCESS
     #: (itertools.count in tracing.py), so an append-mode log spanning
     #: restarts re-uses ids — a second queryStart for an id that already

@@ -106,6 +106,39 @@ def test_jit_site_rule(tmp_path):
     assert not _findings(report, "jit-site")
 
 
+def test_aot_site_rule(tmp_path):
+    from spark_rapids_tpu.tools.lint.rules import AotSiteRule
+    bad = """
+        def warm(jitted, x):
+            lowered = jitted.lower(x)
+            return lowered.compile()
+
+        def chained(jitted, x):
+            return jitted.lower(x).compile()
+
+        def trace_style(jitted, x):
+            traced = jitted.trace(x)
+            lowered2 = traced.lower()
+            return lowered2.compile()
+    """
+    report = _lint_snippet(tmp_path, bad, [AotSiteRule()])
+    finds = _findings(report, "aot-site")
+    # two .lower( + one .trace( entries, three .compile() sinks (bound,
+    # chained, and via the argless traced.lower() hop)
+    assert len(finds) == 6, [f.message for f in finds]
+    assert any(".trace(" in f.message for f in finds)
+    clean = """
+        import re
+
+        def fine(s, params, compiler_cls):
+            pat = re.compile(s.lower())          # str.lower(): no args
+            return compiler_cls(pat, params).compile()   # not a Lowered
+    """
+    report = _lint_snippet(tmp_path, clean, [AotSiteRule()],
+                           name="clean.py")
+    assert not _findings(report, "aot-site")
+
+
 def test_conf_registry_rule(tmp_path):
     bad = """
         def read(conf):
@@ -441,6 +474,12 @@ def test_baseline_suppresses_and_invalidates_on_change(tmp_path):
     assert not report2.active
     assert [f.suppressed for f in report2.findings] == ["baseline"]
     assert report2.exit_code == 0
+    # idempotent re-write: --write-baseline twice must not wipe the
+    # entries the first run grandfathered
+    assert write_baseline(str(base), report2) == 1
+    report2b = run_lint(root=str(tmp_path), rules=[JitSiteRule()],
+                        baseline_path=str(base))
+    assert not report2b.active and report2b.exit_code == 0
     # the flagged LINE changing invalidates the entry
     (tmp_path / "mod.py").write_text(textwrap.dedent(src).replace(
         "jax.jit(fn)", "jax.jit(fn )"))
@@ -467,8 +506,8 @@ def test_json_schema(tmp_path):
     assert d["version"] == 1
     assert d["files_scanned"] == 1
     assert {r["id"] for r in d["rules"]} == {
-        "jit-site", "conf-registry", "event-catalog", "traced-purity",
-        "spillable-close", "fault-point", "retry-frame",
+        "jit-site", "aot-site", "conf-registry", "event-catalog",
+        "traced-purity", "spillable-close", "fault-point", "retry-frame",
         "encoded-materialize", "lock-order"}
     (f,) = [f for f in d["findings"] if f["rule"] == "jit-site"]
     assert set(f) == {"rule", "severity", "file", "line", "message",

@@ -523,6 +523,44 @@ def test_compare_takes_last_json_line(tmp_path):
     assert rows["rows/s"]["values"] == [2]
 
 
+def test_compare_skips_and_flags_failed_payload(tmp_path):
+    """The BENCH_r05 shape: a budget-exceeded run records value 0 — a
+    healthy-vs-failed comparison must say 'run failed', never a
+    −100%/÷0 regression (in either direction)."""
+    good = tmp_path / "BENCH_r04.json"
+    bad = tmp_path / "BENCH_r05.json"
+    good.write_text(json.dumps(_bench_payload(1000, 0.8, 3.0)) + "\n")
+    bad.write_text(json.dumps({
+        "metric": "filter_project_hash_agg_rows_per_sec", "value": 0,
+        "unit": "rows/s", "vs_baseline": 0.0,
+        "error": "primary phase exceeded BENCH_BUDGET_S",
+        "budget_exceeded": True}) + "\n")
+    out = compare([str(good), str(bad)])
+    assert "BENCH_r05.json" in out["failed"]
+    assert "BENCH_BUDGET_S" in out["failed"]["BENCH_r05.json"]
+    rows = {r["metric"]: r for r in out["rows"]}
+    # the failed run's placeholder zeros never enter a row or a delta
+    assert rows["rows/s"]["values"] == [1000, None]
+    assert rows["rows/s"]["delta_pct"] == 0.0
+    assert not any(r.get("regression") for r in out["rows"])
+    text = render_compare([str(good), str(bad)])
+    assert "run failed" in text and "regressions" not in text
+    # reversed order: the failed run must not become the delta base
+    out2 = compare([str(bad), str(good)])
+    assert "BENCH_r05.json" in out2["failed"]
+    assert not any(r.get("regression") for r in out2["rows"])
+    # a budget-exceeded payload that still carries a REAL primary value
+    # (the committed BENCH_r04 shape) is a measurement, not a failure
+    partial = tmp_path / "partial.json"
+    pl = _bench_payload(900, 0.7, 3.1)
+    pl["budget_exceeded"] = True
+    partial.write_text(json.dumps(pl) + "\n")
+    out3 = compare([str(good), str(partial)])
+    assert not out3["failed"]
+    rows3 = {r["metric"]: r for r in out3["rows"]}
+    assert rows3["rows/s"]["values"] == [1000, 900]
+
+
 # ---------------------------------------------------------------------------
 # live resource sampler
 # ---------------------------------------------------------------------------
