@@ -84,6 +84,9 @@ EVENT_KINDS = frozenset({
     "shuffleBlockLoaded", "shuffleWorkerFetch", "shuffleBlocksInvalidated",
     "executorRegistered", "executorLost", "workerExpired", "mapRerun",
     "collectiveFallback",
+    # SPMD partitioned execution (parallel/mesh.py, parallel/spmd.py,
+    # plan/distribution.py, exec/adaptive.py)
+    "meshTopology", "iciExchange", "exchangeElided", "aqeCoalesce",
     # chaos / resilience (aux/faults.py)
     "faultInjected", "breakerTrip",
     # runtime lock-order validator (aux/lockorder.py)

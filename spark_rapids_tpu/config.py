@@ -382,6 +382,62 @@ COLLECTIVE_EXCHANGE_ENABLED = conf_bool(
     "count matches the device count (multi-chip path).",
     True)
 
+DISTRIBUTION_ENABLED = conf_bool(
+    "spark.rapids.sql.distribution.enabled",
+    "Partition-aware planning: propagate delivered distributions "
+    "(hash/range/single, with a mesh-axis binding) through the plan and "
+    "ELIDE every shuffle exchange whose child is already partitioned as "
+    "required — co-partitioned joins and aggregates above joins skip "
+    "their re-shuffle entirely (plan/distribution.py; the "
+    "EnsureRequirements dual).  Disabled reproduces the eager-exchange "
+    "plans exactly.",
+    True)
+
+
+def _mesh_shape_ok(v: str) -> bool:
+    # THE parser (parallel/mesh.py) is the one validity definition; the
+    # checker just runs it so set_conf and session init cannot diverge
+    from spark_rapids_tpu.parallel.mesh import parse_mesh_shape
+    try:
+        parse_mesh_shape(v)
+        return True
+    except ValueError:
+        return False
+
+
+def _mesh_axes_ok(v: str) -> bool:
+    from spark_rapids_tpu.parallel.mesh import parse_mesh_axes
+    try:
+        parse_mesh_axes(v)
+        return True
+    except ValueError:
+        return False
+
+
+MESH_ENABLED = conf_bool(
+    "spark.rapids.mesh.enabled",
+    "Build and activate the device mesh from spark.rapids.mesh.* at "
+    "session init (parallel/mesh.py); shuffle exchanges then lower to "
+    "the in-mesh ICI path where eligible.  Off leaves mesh activation "
+    "to explicit set_active_mesh() calls.",
+    False)
+
+MESH_SHAPE = conf_str(
+    "spark.rapids.mesh.shape",
+    "Mesh shape as comma-separated positive extents (e.g. '8' or '2,4'); "
+    "empty uses all visible devices in one data-parallel dimension.  The "
+    "product must divide the visible device count — validated at "
+    "set_conf/session init, not at the first collective.",
+    "", checker=_mesh_shape_ok)
+
+MESH_AXES = conf_str(
+    "spark.rapids.mesh.axes",
+    "Comma-separated mesh axis names, one per shape dimension, "
+    "non-empty and unique; the FIRST axis is the data axis partition "
+    "parallelism shards over (the NamedSharding binding the planner's "
+    "distribution pass records).",
+    "data", checker=_mesh_axes_ok)
+
 SCAN_CACHE_ENABLED = conf_bool(
     "spark.rapids.sql.scanCache.enabled",
     "Keep decoded (host) and uploaded (device) scan batches resident for "
@@ -610,6 +666,14 @@ ADVISORY_PARTITION_BYTES = conf_bytes(
     "spark.sql.adaptive.advisoryPartitionSizeInBytes",
     "Target size for adaptive partition coalescing.",
     "64m")
+
+ADAPTIVE_MESH_ALIGN = conf_bool(
+    "spark.rapids.sql.adaptive.meshAlign",
+    "With an active device mesh, adaptive coalescing picks partition "
+    "counts that are MULTIPLES of the mesh size (balanced contiguous "
+    "merge), so post-AQE stages keep an even device mapping and later "
+    "exchanges stay eligible for the in-mesh ICI path.",
+    True)
 
 FILECACHE_ENABLED = conf_bool(
     "spark.rapids.filecache.enabled",

@@ -89,10 +89,41 @@ def _partition_sizes(exchange, target_bytes: Optional[int] = None
     return sizes_now()
 
 
-def coalesce_specs(sizes: Sequence[int],
-                   target_bytes: int) -> List[CoalescedPartitionSpec]:
+def _balanced_contiguous(sizes: Sequence[int],
+                         k: int) -> List[CoalescedPartitionSpec]:
+    """Exactly ``k`` contiguous, size-balanced groups covering every
+    input partition (each group non-empty)."""
+    n = len(sizes)
+    k = max(1, min(k, n))
+    cum: List[int] = []
+    total = 0
+    for s in sizes:
+        total += s
+        cum.append(total)
+    specs: List[CoalescedPartitionSpec] = []
+    start = 0
+    for g in range(k):
+        if g == k - 1:
+            end = n
+        else:
+            target = total * (g + 1) / k
+            end = start + 1
+            # advance while under quota, leaving >= 1 input per
+            # remaining group
+            while end < n - (k - g - 1) and cum[end - 1] < target:
+                end += 1
+        specs.append(CoalescedPartitionSpec(start, end))
+        start = end
+    return specs
+
+
+def coalesce_specs(sizes: Sequence[int], target_bytes: int,
+                   align: int = 1) -> List[CoalescedPartitionSpec]:
     """Greedy adjacent merge up to the advisory size (Spark's
-    coalescePartitions algorithm)."""
+    coalescePartitions algorithm).  With ``align`` > 1 (the mesh size,
+    mesh-aware AQE) the output count snaps to the nearest achievable
+    MULTIPLE of ``align`` via a balanced contiguous re-split, so
+    post-AQE stages keep an even device mapping."""
     specs: List[CoalescedPartitionSpec] = []
     start = 0
     acc = 0
@@ -103,7 +134,30 @@ def coalesce_specs(sizes: Sequence[int],
         acc += sz
     if start < len(sizes) or not specs:
         specs.append(CoalescedPartitionSpec(start, max(len(sizes), 1)))
+    if align > 1 and len(sizes) >= align and len(specs) % align:
+        # nearest multiple of align, clamped to what the input count can
+        # actually supply: rounding UP past len(sizes) must floor to the
+        # largest achievable multiple, never give up (12 inputs on an
+        # 8-mesh round to 16 but snap to 8, not stay at 12)
+        k = max(align, int(round(len(specs) / align)) * align)
+        k = min(k, (len(sizes) // align) * align)
+        specs = _balanced_contiguous(sizes, k)
     return specs
+
+
+def _emit_coalesce_event(before: int, after: int, align: int,
+                         ici_active: bool) -> None:
+    """One ``aqeCoalesce`` record per AQE decision: the mesh-alignment
+    evidence AutoTuner rule 10 cites (``aligned`` is judged against the
+    ACTIVE mesh size, not the requested align, so a misaligned count
+    with meshAlign disabled still shows up as misaligned)."""
+    from spark_rapids_tpu.aux.events import emit
+    from spark_rapids_tpu.parallel.mesh import active_mesh
+    ctx = active_mesh()
+    mesh = ctx.num_devices if ctx is not None else 0
+    emit("aqeCoalesce", before=before, after=after, align=align,
+         mesh=mesh, ici_active=bool(ici_active),
+         aligned=(mesh <= 1 or after % mesh == 0))
 
 
 def skew_split_specs(exchange, pidx: int,
@@ -145,10 +199,12 @@ class SharedCoalesceSpecs:
     way).  Sizes are summed across sides so the target bound applies to
     the pair."""
 
-    def __init__(self, left_ex, right_ex, target_bytes: int):
+    def __init__(self, left_ex, right_ex, target_bytes: int,
+                 align: int = 1):
         import threading
         self._exs = (left_ex, right_ex)
         self._target = target_bytes
+        self._align = align
         self._specs: Optional[List[PartitionSpec]] = None
         self._lock = threading.Lock()
 
@@ -165,7 +221,12 @@ class SharedCoalesceSpecs:
                     sizes = [a + b for a, b in zip(lsz, rsz)]
                     # whole-partition coalescing only — a partial split
                     # on one side without the other would break pairing
-                    self._specs = coalesce_specs(sizes, self._target)
+                    self._specs = coalesce_specs(sizes, self._target,
+                                                 self._align)
+                    _emit_coalesce_event(
+                        len(sizes), len(self._specs), self._align,
+                        any(getattr(ex, "_collective", None) is not None
+                            for ex in self._exs))
         return self._specs
 
 
@@ -174,12 +235,15 @@ class AdaptiveShuffleReaderExec(UnaryExec):
 
     def __init__(self, exchange, target_bytes: int = 64 << 20,
                  specs: Optional[List[PartitionSpec]] = None,
-                 shared: Optional[SharedCoalesceSpecs] = None):
+                 shared: Optional[SharedCoalesceSpecs] = None,
+                 align: int = 1):
         super().__init__(exchange)
         self.target_bytes = target_bytes
         self._specs = specs
         #: coordinated specs shared with the sibling join side
         self._shared = shared
+        #: snap coalesced counts to multiples of this (the mesh size)
+        self._align = align
 
     @property
     def is_device(self):  # type: ignore[override]
@@ -199,7 +263,12 @@ class AdaptiveShuffleReaderExec(UnaryExec):
                 if self._specs is None:
                     sizes = _partition_sizes(self.children[0],
                                              self.target_bytes)
-                    self._specs = coalesce_specs(sizes, self.target_bytes)
+                    self._specs = coalesce_specs(sizes, self.target_bytes,
+                                                 self._align)
+                    _emit_coalesce_event(
+                        len(sizes), len(self._specs), self._align,
+                        getattr(self.children[0], "_collective", None)
+                        is not None)
         return self._specs
 
     @property
@@ -232,7 +301,20 @@ class AdaptiveShuffleReaderExec(UnaryExec):
                 f"({nc} coalesced, {np_} partial)]")
 
 
-def insert_adaptive_readers(plan: Exec, target_bytes: int) -> Exec:
+def _potential_collective(ex) -> bool:
+    """True when ``ex`` would take the in-mesh ICI path on materialize
+    (hash partitioning at the mesh size, mesh-shardable schema): these
+    exchanges map reduce partitions 1:1 onto device shards, and the
+    reader must preserve that mapping — coalescing across shards would
+    concatenate batches living on different devices into one downstream
+    kernel, destroying the locality the collective bought."""
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    return isinstance(ex, TpuShuffleExchangeExec) and \
+        ex._collective_eligible(ex.partitioning) is not None
+
+
+def insert_adaptive_readers(plan: Exec, target_bytes: int,
+                            align: int = 1) -> Exec:
     """Planner pass (TOP-down): wrap every shuffle exchange whose parent
     will iterate its reduce partitions (coalescing whole partitions is
     safe: hash groups and range order are preserved).
@@ -241,13 +323,16 @@ def insert_adaptive_readers(plan: Exec, target_bytes: int) -> Exec:
     shuffled join read through ONE coordinated spec (Spark coordinates
     AQE shuffle reads across join children identically); a join side
     that CANNOT be coordinated gets no reader at all — an independently
-    coalesced side would silently mis-pair the join keys."""
+    coalesced side would silently mis-pair the join keys.
+
+    Mesh-aware: exchanges riding the in-mesh ICI path keep their 1:1
+    shard mapping (no reader); host-staged exchanges under an active
+    mesh coalesce to counts that are MULTIPLES of the mesh size
+    (``align``, conf spark.rapids.sql.adaptive.meshAlign) so later
+    stages stay evenly device-mapped and ICI-eligible."""
     from spark_rapids_tpu.exec.basic import TpuCoalesceBatchesExec
     from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
     from spark_rapids_tpu.plan.base import BinaryExec
-
-    from spark_rapids_tpu.parallel.mesh import active_mesh
-    mesh_on = active_mesh() is not None
 
     def unwrap(c):
         """(exchange, rewrap) looking through the post-shuffle batch
@@ -280,25 +365,40 @@ def insert_adaptive_readers(plan: Exec, target_bytes: int) -> Exec:
         return out
 
     def _visit(node: Exec, no_wrap: bool = False) -> Exec:
-        if isinstance(node, BinaryExec) and not mesh_on:
+        if isinstance(node, BinaryExec):
+            if no_wrap:
+                # a downstream shuffled join relies on THIS subtree's
+                # delivered partition count (its own exchange was elided
+                # by the distribution pass): nothing below may coalesce,
+                # including nested joins' exchange pairs — a 2->1 merge
+                # here would leave the downstream join reading partition
+                # i against an unrelated (or never-read) partition i
+                return node.with_children([visit(c, no_wrap=True)
+                                           for c in node.children])
             l, r = node.children
             lex, lwrap = unwrap(l)
             rex, rwrap = unwrap(r)
             if (lex is not None and rex is not None and
                     lex.num_partitions == rex.num_partitions and
-                    lex.num_partitions > 1):
+                    lex.num_partitions > 1 and
+                    not _potential_collective(lex) and
+                    not _potential_collective(rex)):
                 # rebuild through the memoized visit so an exchange shared
                 # with other consumers (ReuseExchange) stays ONE instance
                 lex = visit(lex, no_wrap=True)
                 rex = visit(rex, no_wrap=True)
-                shared = SharedCoalesceSpecs(lex, rex, target_bytes)
+                shared = SharedCoalesceSpecs(lex, rex, target_bytes,
+                                             align)
                 return node.with_children([
                     lwrap(AdaptiveShuffleReaderExec(lex, target_bytes,
-                                                    shared=shared)),
+                                                    shared=shared,
+                                                    align=align)),
                     rwrap(AdaptiveShuffleReaderExec(rex, target_bytes,
-                                                    shared=shared))])
-            # un-coordinatable: children recurse with their top-level
-            # exchange left unwrapped (partition pairing must hold)
+                                                    shared=shared,
+                                                    align=align))])
+            # un-coordinatable (or an ICI pair whose 1:1 shard pairing
+            # must survive untouched): children recurse with their
+            # top-level exchange left unwrapped
             return node.with_children([visit(c, no_wrap=True)
                                        for c in node.children])
         new_children = []
@@ -318,13 +418,14 @@ def insert_adaptive_readers(plan: Exec, target_bytes: int) -> Exec:
             if isinstance(c2, CpuShuffleExchangeExec) and \
                     not isinstance(node, AdaptiveShuffleReaderExec) and \
                     not child_no_wrap:
-                if mesh_on:
-                    # mesh shuffles map reduce partitions 1:1 onto device
+                if _potential_collective(c2):
+                    # ICI shuffles map reduce partitions 1:1 onto device
                     # shards; coalescing would concatenate batches living
                     # on different devices into one downstream kernel
                     new_children.append(c2)
                     continue
-                c2 = AdaptiveShuffleReaderExec(c2, target_bytes)
+                c2 = AdaptiveShuffleReaderExec(c2, target_bytes,
+                                               align=align)
             new_children.append(c2)
         return node.with_children(new_children)
 

@@ -463,46 +463,37 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
         return ctx
 
     def _materialize_collective(self, ctx):
-        """Mesh execution: shard the map output over the devices (input
-        pipeline step of the single-controller SPMD model), then ONE fused
-        all_to_all program is the entire shuffle (reference: the UCX
-        RDMA transport + catalogs + heartbeats collapse into the
-        collective; parallel/collective.py docstring)."""
-        import jax
-        from spark_rapids_tpu.columnar.column import _jnp
-        from spark_rapids_tpu.expressions.base import EvalContext, TCol
-        from spark_rapids_tpu.parallel import collective as C
-        jnp = _jnp()
+        """Mesh execution: the whole shuffle is parallel/spmd.py's fused
+        in-mesh exchange (shard -> compiled pid program -> one all_to_all
+        collective; the UCX RDMA transport + catalogs + heartbeats of the
+        reference collapse into the collective).  May raise
+        ``SpmdHbmExceeded`` — handled by ``_materialize`` as a fallback
+        to the host-staged spill-safe path."""
+        from spark_rapids_tpu.parallel import spmd as _SPMD
+        from spark_rapids_tpu.parallel.spmd import (check_hbm_budget,
+                                                    spmd_hash_exchange)
         schema = self.child.schema
+        # incremental HBM check while draining: an input that cannot
+        # possibly fit stops pulling as soon as the running total proves
+        # it, instead of materializing the rest first.  The host-staged
+        # fallback then re-executes the child — the second pull rides
+        # the scan cache / already-materialized upstream stores, but is
+        # still a real cost, which is why this bails as EARLY as the
+        # evidence allows.  The admission model itself lives in ONE
+        # place: spmd.check_hbm_budget.
+        budget = _SPMD._hbm_budget()
+        total = 0
         batches = []
         for mp in range(self.child.num_partitions):
-            batches.extend(self.child.execute_partition(mp))
-        cols, counts = C.shard_engine_batches(ctx, batches, schema)
-        part = self.partitioning
-
-        total = int(cols[0][0].shape[0])
-
-        def build():
-            def pid_fn(arrs):
-                tcols = [TCol(d, v, f.data_type, lengths=ln)
-                         for (d, v, ln), f in zip(arrs, schema.fields)]
-                ectx = EvalContext(tcols, "tpu", total)
-                h = part._hash_expr().eval_tpu(ectx)
-                n = np.int32(part.num_partitions)
-                return (((h.data % n) + n) % n).astype(np.int32)
-            return pid_fn
-
-        # memoized by (partitioning, schema, shapes): a fresh jax.jit here
-        # re-traced the hash program on EVERY collective shuffle
-        from spark_rapids_tpu.exec.stage_compiler import get_or_build
-        key = (part.desc(),
-               tuple((f.name, str(f.data_type)) for f in schema.fields),
-               tuple((str(d.dtype), tuple(d.shape), ln is not None)
-                     for d, v, ln in cols))
-        pids = get_or_build("exchange.collective_pid", key, build)(
-            [tuple(c) for c in cols])
-        out_cols, out_counts = C.collective_hash_shuffle(ctx, cols, counts,
-                                                         pids)
+            for b in self.child.execute_partition(mp):
+                batches.append(b)
+                if budget is not None:
+                    total += (b.nbytes() or 0) if hasattr(b, "nbytes") \
+                        else 0
+                    check_hbm_budget(total // max(1, ctx.num_devices),
+                                     budget)
+        out_cols, out_counts = spmd_hash_exchange(ctx, batches, schema,
+                                                  self.partitioning)
         self._collective = (ctx, out_cols, out_counts, schema)
 
     def _materialize(self):
@@ -515,21 +506,27 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
         if mode == "DEFAULT":
             ctx = self._collective_eligible(part)
             if ctx is not None:
+                from spark_rapids_tpu.parallel.spmd import SpmdHbmExceeded
                 from spark_rapids_tpu.plan.base import _is_retryable
                 try:
                     self._materialize_collective(ctx)
                     return
                 except Exception as e:   # noqa: BLE001 - classified below
-                    if not _is_retryable(e):
+                    if not (_is_retryable(e) or
+                            isinstance(e, SpmdHbmExceeded)):
                         raise
-                    # a lost chip fails the whole collective step:
-                    # degrade to the single-device store below instead
-                    # of failing the query (Theseus-style: finish the
-                    # plan when a participant dies mid-shuffle)
+                    # per-stage ICI-vs-host choice: a working set that
+                    # cannot fit per-device HBM (SpmdHbmExceeded) takes
+                    # the host-staged spillable path; a lost chip fails
+                    # the whole collective step and degrades the same
+                    # way (Theseus-style: finish the plan when a
+                    # participant dies mid-shuffle)
                     from spark_rapids_tpu.aux.events import emit
                     from spark_rapids_tpu.aux.faults import note_recovery
                     note_recovery("collective_fallbacks")
                     emit("collectiveFallback",
+                         reason=("hbm" if isinstance(e, SpmdHbmExceeded)
+                                 else "fault"),
                          error=f"{type(e).__name__}: {e}"[:160])
                     self._collective = None
         if mode != "DEFAULT":
@@ -726,7 +723,20 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
         if not samples:
             part.bounds = _sample_bounds(part, [], None)
             return
-        hb = concat_batches(samples).to_host()
+        from spark_rapids_tpu.ops.batch_ops import _committed_device
+        sample_devs = {id(d) for d in
+                       (_committed_device(b) for b in samples)
+                       if d is not None}
+        if len(sample_devs) > 1:
+            # mesh shards: sample batches committed to DIFFERENT devices
+            # cannot concat in one program — gather per shard and merge
+            # on host (bounded: <= RANGE_BOUNDS_SAMPLE_ROWS per shard)
+            from spark_rapids_tpu.columnar.batch import concat_host_batches
+            hbs = [b.to_host() for b in samples]
+            live = [h for h in hbs if h.row_count]
+            hb = concat_host_batches(live) if live else hbs[0]
+        else:
+            hb = concat_batches(samples).to_host()
         part.bounds = _sample_bounds(part, [hb] if hb.row_count else [],
                                      None)
 

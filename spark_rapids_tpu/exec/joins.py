@@ -502,6 +502,24 @@ class _TpuJoinCore(_JoinBase):
 # Concrete execs
 # ---------------------------------------------------------------------------
 
+def _check_copartitioned(join) -> None:
+    """Partition i of the left side pairs with partition i of the right:
+    the contract every producer of a shuffled join upholds — the eager
+    exchanges, AQE's COORDINATED readers, and the distribution pass's
+    elision (which only removes an exchange whose child provably
+    delivers the same placement).  A count mismatch here means a pass
+    broke that contract; failing loudly beats joining partition i
+    against an unrelated partition i and returning silently wrong
+    rows (plan/verify.py's distribution-consistency check is the
+    observe-only twin of this guard)."""
+    ln, rn = join.left.num_partitions, join.right.num_partitions
+    if ln != rn:
+        raise ValueError(
+            f"{join.name} sides are not co-partitioned: left has {ln} "
+            f"partition(s), right has {rn} — partition pairing would "
+            "silently drop or mis-match rows")
+
+
 class CpuShuffledHashJoinExec(_CpuJoinCore):
     """Both children hash-partitioned by the join keys; joins partition-wise
     (reference: GpuShuffledHashJoinExec)."""
@@ -511,6 +529,7 @@ class CpuShuffledHashJoinExec(_CpuJoinCore):
         return self.left.num_partitions
 
     def execute_partition(self, pidx):
+        _check_copartitioned(self)
         left = _concat_or_empty(list(self.left.execute_partition(pidx)),
                                 self.left.schema)
         right = _concat_or_empty(list(self.right.execute_partition(pidx)),
@@ -550,6 +569,7 @@ class TpuShuffledHashJoinExec(_TpuJoinCore):
         return self.left.execute_partition(pidx), build, False
 
     def execute_partition(self, pidx):
+        _check_copartitioned(self)
         probe, build, swapped = self._maybe_swapped(pidx)
         yield from self._join_device(probe, build, swapped=swapped)
 
@@ -753,6 +773,7 @@ class CpuSubPartitionHashJoinExec(_SubPartitionMixin, CpuShuffledHashJoinExec):
     """Host variant (oracle): always joins through the bucket machinery."""
 
     def execute_partition(self, pidx):
+        _check_copartitioned(self)
         left = list(self.left.execute_partition(pidx))
         right = list(self.right.execute_partition(pidx))
         if not self._build_oversized(right):
@@ -779,6 +800,7 @@ class CpuSubPartitionHashJoinExec(_SubPartitionMixin, CpuShuffledHashJoinExec):
 
 class TpuSubPartitionHashJoinExec(_SubPartitionMixin, TpuShuffledHashJoinExec):
     def execute_partition(self, pidx):
+        _check_copartitioned(self)
         build = list(self.right.execute_partition(pidx))
         if not self._build_oversized(build):
             probe, build, swapped = self._maybe_swapped_with(build, pidx)

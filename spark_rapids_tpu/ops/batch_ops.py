@@ -192,6 +192,78 @@ def take_front(batch: ColumnarBatch, n) -> ColumnarBatch:
     return ColumnarBatch(cols, n, batch.names)
 
 
+def _committed_device(b: ColumnarBatch):
+    """The single device a batch's planes are committed to, or None for
+    uncommitted/empty batches."""
+    for c in b.columns:
+        devices = getattr(c.data, "devices", None)
+        if callable(devices):
+            try:
+                ds = list(devices())
+            except Exception:  # noqa: BLE001 - best-effort placement probe
+                return None
+            if len(ds) == 1:
+                return ds[0]
+    return None
+
+
+def _align_batch_devices(batches: Sequence[ColumnarBatch]
+                         ) -> Sequence[ColumnarBatch]:
+    """Moves batches committed to DIFFERENT devices onto one device
+    before they meet in a single program (jax refuses cross-device
+    inputs).  Mesh execution makes this real: a shard-local pipeline
+    keeps each partition's batches on its own device, but partition
+    merges (coalesced AQE reads above a host-staged exchange fed by
+    mesh shards, out-of-core agg merges) legitimately combine shards —
+    that transfer rides ICI on real hardware."""
+    devs = {id(d): d for d in (_committed_device(b) for b in batches)
+            if d is not None}
+    if len(devs) <= 1:
+        return batches
+    import jax
+    from spark_rapids_tpu.columnar.encoding import materialize_batch
+    target = next(iter(devs.values()))
+
+    moved_counts: dict = {}
+
+    def move_count(rc):
+        # unforced deferred counts are 0-d arrays committed to the
+        # batch's device — they meet in the concat's size math too.
+        # Memoized by identity: a batch and its columns SHARE one count
+        # object (ColumnarBatch invariant) and must keep sharing it.
+        if isinstance(rc, DeferredCount) and not rc.is_forced:
+            if id(rc) not in moved_counts:
+                moved_counts[id(rc)] = DeferredCount(
+                    jax.device_put(rc.traceable(), target))
+            return moved_counts[id(rc)]
+        return rc
+
+    def put(x):
+        return None if x is None else jax.device_put(x, target)
+
+    out = []
+    for b in batches:
+        dev = _committed_device(b)
+        if dev is None or dev is target:
+            out.append(b)
+            continue
+        # decode encoded columns BEFORE moving: an RLE column's planes
+        # are run-space (rebuilding them as row planes corrupts rows),
+        # and a dictionary column's value planes are shared + committed
+        # to the SOURCE device — moving only the codes would hand the
+        # next program cross-device inputs, the exact failure this
+        # helper exists to prevent
+        b = materialize_batch(b, site="device-align")
+        cols = []
+        for c in b.columns:
+            cols.append(DeviceColumn(
+                put(c.data), put(c.validity),
+                move_count(c.row_count), c.data_type,
+                put(c.lengths), put(getattr(c, "elem_valid", None))))
+        out.append(ColumnarBatch(cols, move_count(b.row_count), b.names))
+    return out
+
+
 def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
     """Concatenates device batches into one padded batch (coalesce).
 
@@ -206,6 +278,7 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
         batches = kept or batches[:1]
     if len(batches) == 1:
         return batches[0]
+    batches = _align_batch_devices(batches)
     # dictionary code planes concat like int planes when every input
     # shares the fingerprint; mismatched positions decode first
     from spark_rapids_tpu.columnar.encoding import (align_batches,

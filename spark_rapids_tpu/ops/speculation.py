@@ -56,7 +56,22 @@ class SpeculationContext:
         import numpy as np
         from spark_rapids_tpu.columnar.column import _jnp
         jnp = _jnp()
-        if bool(np.asarray(jnp.any(jnp.stack(flags)))):
+        # flags produced by shard-local pipelines are committed to
+        # DIFFERENT devices under a mesh — they cannot meet in one
+        # stack; group per device so the sync count stays one per
+        # device, not one per flag
+        by_dev: dict = {}
+        for f in flags:
+            devices = getattr(f, "devices", None)
+            key = None
+            if callable(devices):
+                try:
+                    key = tuple(sorted(d.id for d in devices()))
+                except Exception:  # noqa: BLE001 - placement probe only
+                    key = None
+            by_dev.setdefault(key, []).append(f)
+        if any(bool(np.asarray(jnp.any(jnp.stack(group))))
+               for group in by_dev.values()):
             raise SpeculationOverflow()
 
 

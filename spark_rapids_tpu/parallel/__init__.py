@@ -10,6 +10,9 @@ control-plane protocol (the collective is the protocol).
 """
 
 from spark_rapids_tpu.parallel.mesh import (MeshContext,  # noqa: F401
-                                            data_mesh)
+                                            active_mesh, data_mesh,
+                                            set_active_mesh)
 from spark_rapids_tpu.parallel.collective import (  # noqa: F401
     collective_hash_shuffle, shard_batch, unshard_batch)
+from spark_rapids_tpu.parallel.spmd import (SpmdHbmExceeded,  # noqa: F401
+                                            spmd_hash_exchange)

@@ -143,15 +143,25 @@ def shard_engine_batches(ctx: MeshContext, batches, schema):
 def shard_to_batch(ctx: MeshContext, cols, counts, schema, shard: int):
     """Reduce-side read: materializes mesh shard ``shard`` as a regular
     engine ColumnarBatch (the reduce task's fetch; all data already sits on
-    that device)."""
+    that device).
+
+    The shard planes are COPIED (a device-local copy, no transfer):
+    ``addressable_shards[i].data`` shares buffers with the exchange's
+    global arrays, and downstream consumers legitimately register their
+    input batches spillable and ``.delete()`` them (the out-of-core agg
+    merge does) — deleting a shared buffer would poison the exchange
+    store for every re-read of the same shard (task retry, plan
+    reuse)."""
+    jnp = _jx()
     from spark_rapids_tpu.columnar.batch import ColumnarBatch
     n = ctx.num_devices
     cnt = int(np.asarray(counts)[shard])
     out_cols = []
     for (d, v, ln), f in zip(cols, schema.fields):
-        ds = d.addressable_shards[shard].data
-        vs = v.addressable_shards[shard].data
-        ls = None if ln is None else ln.addressable_shards[shard].data
+        ds = jnp.copy(d.addressable_shards[shard].data)
+        vs = jnp.copy(v.addressable_shards[shard].data)
+        ls = None if ln is None else \
+            jnp.copy(ln.addressable_shards[shard].data)
         out_cols.append(DeviceColumn(ds, vs, cnt, f.data_type, ls))
     return ColumnarBatch(out_cols, cnt,
                          [f.name for f in schema.fields])

@@ -35,6 +35,10 @@ class MeshContext:
 
 
 _ACTIVE: Optional[MeshContext] = None
+#: True when the ACTIVE mesh was built by sync_from_conf — conf-driven
+#: disable tears down only what conf activated; a mesh installed
+#: manually via set_active_mesh stays under manual control
+_CONF_ACTIVATED = False
 
 
 def data_mesh(n_devices: Optional[int] = None,
@@ -51,9 +55,99 @@ def data_mesh(n_devices: Optional[int] = None,
 
 
 def set_active_mesh(ctx: Optional[MeshContext]) -> None:
-    global _ACTIVE
+    global _ACTIVE, _CONF_ACTIVATED
     _ACTIVE = ctx
+    _CONF_ACTIVATED = False
 
 
 def active_mesh() -> Optional[MeshContext]:
     return _ACTIVE
+
+
+# ---------------------------------------------------------------------------
+# conf-driven lifecycle (spark.rapids.mesh.*)
+# ---------------------------------------------------------------------------
+
+def parse_mesh_shape(s: str) -> tuple:
+    """'' -> () (all devices, 1-D); '2,4' -> (2, 4).  Raises ValueError
+    on malformed input (the conf checker runs the same parse, so a bad
+    shape fails at set_conf, never at the first collective)."""
+    s = str(s).strip()
+    if not s:
+        return ()
+    try:
+        dims = tuple(int(p) for p in s.split(","))
+    except ValueError:
+        raise ValueError(f"spark.rapids.mesh.shape must be "
+                         f"comma-separated ints, got {s!r}")
+    if not dims or any(d <= 0 for d in dims):
+        raise ValueError(f"spark.rapids.mesh.shape extents must be "
+                         f"positive, got {s!r}")
+    return dims
+
+
+def parse_mesh_axes(s: str) -> tuple:
+    names = tuple(p.strip() for p in str(s).split(","))
+    if not all(names):
+        raise ValueError(f"spark.rapids.mesh.axes names must be "
+                         f"non-empty, got {s!r}")
+    if len(set(names)) != len(names):
+        raise ValueError(f"spark.rapids.mesh.axes names must be "
+                         f"unique, got {s!r}")
+    return names
+
+
+def sync_from_conf(conf, allow_disable: bool = False
+                   ) -> Optional[MeshContext]:
+    """Validates ``spark.rapids.mesh.*`` and, when enabled, builds and
+    activates the mesh (emitting a ``meshTopology`` event).  Validation
+    always runs — a session carrying a malformed shape fails at
+    set_conf/init even with the mesh disabled; the divides-device-count
+    check needs the device list so it lives here rather than in the
+    conf checker.
+
+    Disable semantics: with ``enabled=false`` AND ``allow_disable``
+    (the explicit ``set_conf`` path), a mesh THIS function activated is
+    torn down — disabling the feature must not be a silent no-op.
+    Session INIT passes ``allow_disable=False``: an interleaved
+    default-conf session must not clobber another session's
+    conf-activated mesh (the scan-cache/lockorder discipline).  A mesh
+    installed manually via set_active_mesh is never touched."""
+    global _CONF_ACTIVATED
+    from spark_rapids_tpu import config as C
+    shape = parse_mesh_shape(conf.get(C.MESH_SHAPE.key))
+    axes = parse_mesh_axes(conf.get(C.MESH_AXES.key))
+    if len(axes) != (len(shape) if shape else 1):
+        raise ValueError(
+            f"spark.rapids.mesh.axes has {len(axes)} name(s) for a "
+            f"{len(shape) if shape else 1}-D spark.rapids.mesh.shape"
+            + ("" if shape else " (empty shape means 1-D)"))
+    if not conf.get(C.MESH_ENABLED.key):
+        if allow_disable and _CONF_ACTIVATED:
+            set_active_mesh(None)
+        return active_mesh()
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    devs = list(jax.devices())
+    if shape:
+        want = 1
+        for d in shape:
+            want *= d
+        if want > len(devs) or len(devs) % want:
+            raise ValueError(
+                f"spark.rapids.mesh.shape {shape} needs {want} "
+                f"device(s) dividing the visible count "
+                f"({len(devs)} available)")
+        mesh = Mesh(np.asarray(devs[:want]).reshape(shape), axes)
+    else:
+        mesh = Mesh(np.asarray(devs), (axes[0],))
+    ctx = MeshContext(mesh, data_axis=axes[0])
+    set_active_mesh(ctx)
+    _CONF_ACTIVATED = True
+    from spark_rapids_tpu.aux.events import emit
+    emit("meshTopology", devices=ctx.num_devices,
+         shape=list(mesh.devices.shape), axes=list(mesh.axis_names),
+         data_axis=ctx.data_axis,
+         platform=str(getattr(mesh.devices.flat[0], "platform", "?")))
+    return ctx

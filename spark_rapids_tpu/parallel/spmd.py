@@ -1,0 +1,156 @@
+"""In-mesh SPMD hash exchange: the device-resident shuffle path.
+
+Replaces the host-staged writer/reader whenever producer and consumer
+both live on the mesh: map output is placed onto the devices ONCE (the
+single-controller input-pipeline step), the per-row destination ids are
+computed by a compiled program, and ONE fused ``shard_map`` all-to-all
+(parallel/collective.py) is the entire shuffle — no serialization, no
+host copies, partition p of the result IS device p's shard (the
+mesh-axis binding the planner's distribution pass records).
+
+Every program here is compiled through ``exec/stage_compiler.py`` like
+the rest of the engine, so collective shuffles are cached, trace-counted
+and audit-ledgered programs, not ad-hoc jits.
+
+Spill safety: the collective needs the whole sharded working set
+resident per device (send buffer + receive buffer + compaction copies,
+all at the padded bucket).  ``SpmdHbmExceeded`` is raised when that
+estimate does not fit the per-device HBM headroom — a host-side
+pre-check runs BEFORE any device allocation, and an exact padded-shape
+check runs after sharding but before the collective; the exchange
+catches it and degrades to the existing host-staged ShuffleClient
+path, which spills — the per-stage ICI-vs-host decision the mesh-aware
+AQE relies on.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.parallel.mesh import MeshContext
+
+__all__ = ["SpmdHbmExceeded", "spmd_hash_exchange",
+           "estimate_shard_bytes", "check_hbm_budget"]
+
+#: the collective's per-device working set as a multiple of the local
+#: shard's padded bytes: input shard + packed [n, B] send buffer +
+#: received [n, B] buffer + the compacted output copy
+WORKING_SET_FACTOR = 4
+
+
+class SpmdHbmExceeded(Exception):
+    """The sharded working set does not fit per-device HBM; caller must
+    fall back to the host-staged (spillable) shuffle path."""
+
+    def __init__(self, need: int, budget: int):
+        super().__init__(f"collective working set ~{need} bytes exceeds "
+                         f"per-device budget {budget} bytes")
+        self.need = need
+        self.budget = budget
+
+
+def estimate_shard_bytes(cols, n_devices: int) -> int:
+    """Per-device padded bytes of a sharded batch (one local bucket of
+    every plane), computable from shapes without a device sync."""
+    total = 0
+    for d, v, ln in cols:
+        total += d.size * d.dtype.itemsize
+        total += v.size * v.dtype.itemsize
+        if ln is not None:
+            total += ln.size * ln.dtype.itemsize
+    return total // max(1, n_devices)
+
+
+def _hbm_budget() -> Optional[int]:
+    """Per-device headroom for the collective working set: half the free
+    pool (the same policy point every out-of-core trigger uses), or
+    None when no runtime is initialized (primitive-level tests)."""
+    from spark_rapids_tpu.memory.device_manager import free_device_headroom
+    return free_device_headroom(2)
+
+
+def check_hbm_budget(per_device_bytes: int,
+                     budget: Optional[int]) -> None:
+    """THE working-set admission policy: raises ``SpmdHbmExceeded`` when
+    ``per_device_bytes`` at WORKING_SET_FACTOR exceeds ``budget``.  Every
+    check site (the exchange's incremental drain, the host pre-check,
+    the exact post-shard check) routes here so the model cannot
+    diverge between callers."""
+    if budget is not None and \
+            per_device_bytes * WORKING_SET_FACTOR > budget:
+        raise SpmdHbmExceeded(per_device_bytes * WORKING_SET_FACTOR,
+                              budget)
+
+
+def _pid_program(ctx: MeshContext, partitioning, schema, cols):
+    """The compiled per-row destination-id program, memoized by
+    (partitioning, schema, plane shapes) — the hash evaluates over the
+    GLOBAL sharded arrays so one dispatch covers every device."""
+    from spark_rapids_tpu.exec.stage_compiler import get_or_build
+    from spark_rapids_tpu.expressions.base import EvalContext, TCol
+
+    total = int(cols[0][0].shape[0])
+
+    def build():
+        def pid_fn(arrs):
+            tcols = [TCol(d, v, f.data_type, lengths=ln)
+                     for (d, v, ln), f in zip(arrs, schema.fields)]
+            ectx = EvalContext(tcols, "tpu", total)
+            h = partitioning._hash_expr().eval_tpu(ectx)
+            n = np.int32(partitioning.num_partitions)
+            return (((h.data % n) + n) % n).astype(np.int32)
+        return pid_fn
+
+    key = (partitioning.desc(),
+           tuple((f.name, str(f.data_type)) for f in schema.fields),
+           tuple((str(d.dtype), tuple(d.shape), ln is not None)
+                 for d, v, ln in cols))
+    return get_or_build("spmd.pid", key, build)
+
+
+def spmd_hash_exchange(ctx: MeshContext, batches, schema, partitioning
+                       ) -> Tuple[List, object]:
+    """The whole in-mesh shuffle: shard ``batches`` over the mesh,
+    compute destinations, run the fused all-to-all, and report the
+    result's per-shard row statistics (mesh-aware AQE's runtime input)
+    in an ``iciExchange`` event.  Returns (cols, counts) in the sharded
+    layout of parallel/collective.py.
+
+    Raises ``SpmdHbmExceeded`` (before touching the collective) when
+    the padded working set cannot fit per-device HBM — the caller's cue
+    to take the host-staged spill-safe path instead."""
+    from spark_rapids_tpu.aux.events import emit
+    from spark_rapids_tpu.parallel import collective as C
+
+    t0 = time.monotonic()
+    budget = _hbm_budget()
+    if budget is not None:
+        # host-side pre-check BEFORE any device allocation: the logical
+        # input bytes per device lower-bound the padded shard, so an
+        # input that cannot possibly fit never pays the transfer (and
+        # never risks dying in device_put with an unclassifiable
+        # allocator error instead of the clean fallback)
+        host_bytes = sum(getattr(b, "nbytes", lambda: 0)() or 0
+                         for b in batches)
+        check_hbm_budget(host_bytes // max(1, ctx.num_devices), budget)
+    cols, counts = C.shard_engine_batches(ctx, batches, schema)
+    # exact post-shard check: padding (pow2 buckets, string rectangles)
+    # can inflate the working set well past the logical estimate
+    shard_bytes = estimate_shard_bytes(cols, ctx.num_devices)
+    check_hbm_budget(shard_bytes, budget)
+    pids = _pid_program(ctx, partitioning, schema, cols)(
+        [tuple(c) for c in cols])
+    out_cols, out_counts = C.collective_hash_shuffle(ctx, cols, counts,
+                                                     pids)
+    # the per-shard totals are the only host sync of the whole exchange;
+    # forcing them here makes the measured duration honest AND gives the
+    # adaptive layer its runtime row statistics for free
+    shard_rows = [int(c) for c in np.asarray(out_counts)]
+    emit("iciExchange", devices=ctx.num_devices,
+         rows=int(sum(shard_rows)), shard_rows=shard_rows,
+         shard_bytes=shard_bytes,
+         duration_s=round(time.monotonic() - t0, 6))
+    return out_cols, out_counts

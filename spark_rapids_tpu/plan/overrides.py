@@ -497,6 +497,9 @@ class TpuOverrides:
     def __init__(self, conf: TpuConf):
         self.conf = conf
         self.last_meta: Optional[PlanMeta] = None
+        #: exchanges removed by the distribution pass on the last apply
+        #: (plan/distribution.py Elision records; EXPLAIN renders them)
+        self.last_elided: List = []
 
     def apply(self, plan: Exec, for_explain: bool = False,
               skip_pruning: bool = False) -> Exec:
@@ -602,6 +605,20 @@ class TpuOverrides:
                                  strict=conf.get(C.TEST_ENABLED.key, False))
         if not conf.is_sql_enabled:
             return plan
+        # partition-aware planning: delete exchanges whose child already
+        # delivers the required distribution (co-partitioned joins /
+        # aggs-above-joins shuffle zero times).  Runs on the Cpu tree so
+        # every later pass (fusion, reuse, AQE) sees the final exchange
+        # set; disabled reproduces the eager-exchange plans exactly.
+        self.last_elided = []
+        if conf.get(C.DISTRIBUTION_ENABLED.key):
+            from spark_rapids_tpu.plan.distribution import \
+                eliminate_redundant_exchanges
+            plan, self.last_elided = eliminate_redundant_exchanges(plan)
+            if self.last_elided and not for_explain:
+                from spark_rapids_tpu.aux.events import emit
+                emit("exchangeElided", count=len(self.last_elided),
+                     exchanges=[e.desc() for e in self.last_elided])
         meta = PlanMeta(plan, conf)
         meta.tag()
         if conf.get(C.CBO_ENABLED.key):
@@ -638,8 +655,14 @@ class TpuOverrides:
             # specs capture the exact in-tree exchanges
             from spark_rapids_tpu.exec.adaptive import \
                 insert_adaptive_readers
+            from spark_rapids_tpu.parallel.mesh import active_mesh
+            mesh_ctx = active_mesh()
+            align = mesh_ctx.num_devices \
+                if mesh_ctx is not None and \
+                conf.get(C.ADAPTIVE_MESH_ALIGN.key) else 1
             out = insert_adaptive_readers(
-                out, C.parse_bytes(conf.get(C.ADVISORY_PARTITION_BYTES.key)))
+                out, C.parse_bytes(conf.get(C.ADVISORY_PARTITION_BYTES.key)),
+                align=align)
         if conf.is_test_enabled and not for_explain:
             validate_all_on_device(out, conf)
         from spark_rapids_tpu.aux.capture import ExecutionPlanCaptureCallback

@@ -34,6 +34,14 @@ Checks (ids are the ``check`` field of the event):
   that shallow-copies a shared exchange apart re-materializes the
   shuffle per parent; this is the bug class the in-place passes exist
   to avoid.
+- ``distribution-consistency``: every shuffled hash join's sides agree
+  on partition count, and a side with NO exchange/adaptive-reader
+  boundary between the join and its sources (the distribution pass
+  elided it) provably DELIVERS a hash distribution over that side's
+  join keys at the join's partition count — re-derived on the final
+  tree with the same plan/distribution.py analysis the elision pass
+  used, so a pass that broke co-partitioning after elision is caught
+  at plan time, not as silently wrong rows.
 """
 
 from __future__ import annotations
@@ -183,6 +191,10 @@ def verify_plan(plan, conf, emit_events: bool = True
                    f"{node.name} introspects its direct child; the "
                    "spool belongs ABOVE it, never inside")
 
+    # -- distribution consistency (post-elision co-partitioning) -------
+    if conf.get(C.DISTRIBUTION_ENABLED.key):
+        _check_distribution(pairs, report)
+
     # -- exchange-reuse key consistency --------------------------------
     if reuse_on:
         # dedupe by IDENTITY first: a correctly-reused exchange appears
@@ -214,3 +226,82 @@ def verify_plan(plan, conf, emit_events: bool = True
                 emit("planInvariantViolation", check=v.check,
                      node=v.node, detail=v.detail)
     return violations
+
+
+def _has_partition_boundary(node) -> bool:
+    """True when the subtree rooted at ``node`` establishes its own
+    partitioning before any source: an exchange or adaptive reader
+    reached through partition-count-preserving unary nodes."""
+    from spark_rapids_tpu.exec.adaptive import AdaptiveShuffleReaderExec
+    from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
+    from spark_rapids_tpu.plan.base import UnaryExec
+    while True:
+        if isinstance(node, (CpuShuffleExchangeExec,
+                             AdaptiveShuffleReaderExec)):
+            return True
+        if isinstance(node, UnaryExec) and node.children and \
+                node.num_partitions == node.children[0].num_partitions:
+            node = node.children[0]
+            continue
+        return False
+
+
+def _count_is_static(node) -> bool:
+    """False when the subtree's partition count depends on an adaptive
+    reader whose specs have not been computed yet — touching
+    ``num_partitions`` there would MATERIALIZE the exchange during plan
+    verification (the verifier must observe, never execute)."""
+    from spark_rapids_tpu.exec.adaptive import AdaptiveShuffleReaderExec
+    for n in node.collect_nodes():
+        if isinstance(n, AdaptiveShuffleReaderExec) and \
+                n._specs is None and \
+                (n._shared is None or n._shared._specs is None):
+            return False
+    return True
+
+
+def _check_distribution(pairs, report) -> None:
+    """The ``distribution-consistency`` invariant over the final tree."""
+    from spark_rapids_tpu.exec.joins import (CpuShuffledHashJoinExec,
+                                             TpuShuffledHashJoinExec)
+    from spark_rapids_tpu.plan.distribution import (HashDist, canon,
+                                                    delivered_dists)
+    dist_memo: dict = {}
+    seen = set()
+    for _parent, node in pairs:
+        if not isinstance(node, (CpuShuffledHashJoinExec,
+                                 TpuShuffledHashJoinExec)):
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        left, right = node.children
+        if not (_count_is_static(left) and _count_is_static(right)):
+            # pending adaptive specs: the runtime co-partitioning guard
+            # (exec/joins._check_copartitioned) covers this join once
+            # the specs exist
+            continue
+        if left.num_partitions != right.num_partitions:
+            report("distribution-consistency", node,
+                   f"shuffled join sides have {left.num_partitions} vs "
+                   f"{right.num_partitions} partitions — partition i "
+                   "no longer pairs with partition i")
+            continue
+        n = node.num_partitions
+        if n <= 1:
+            continue
+        for side, keys, label in ((left, node.left_keys, "left"),
+                                  (right, node.right_keys, "right")):
+            if _has_partition_boundary(side):
+                continue
+            want = tuple(canon(k) for k in keys)
+            ok = any(isinstance(d, HashDist) and d.keys == want
+                     and d.n == n
+                     for d in delivered_dists(side, dist_memo))
+            if not ok:
+                report("distribution-consistency", node,
+                       f"{label} side has no exchange boundary and does "
+                       "not provably deliver "
+                       f"hash(join keys, {n}) — an elided (or never "
+                       "inserted) exchange left the join "
+                       "mis-partitioned")

@@ -57,6 +57,12 @@ class TpuSession:
         from spark_rapids_tpu.aux.lockorder import sync_from_conf \
             as sync_lockorder
         sync_lockorder(self.conf)
+        # device mesh (spark.rapids.mesh.*): validate + activate from the
+        # conf, emitting a meshTopology event; a bad shape fails HERE,
+        # not at the first collective
+        from spark_rapids_tpu.parallel.mesh import sync_from_conf \
+            as sync_mesh
+        sync_mesh(self.conf)
         #: temp views for the SQL front-end (name -> DataFrame)
         self._views: Dict[str, "DataFrame"] = {}
         #: row-based Hive UDF passthrough (name -> (fn, return_type));
@@ -94,6 +100,10 @@ class TpuSession:
             from spark_rapids_tpu.aux.lockorder import sync_from_conf \
                 as sync_lockorder
             sync_lockorder(self.conf)
+        elif key.startswith("spark.rapids.mesh."):
+            from spark_rapids_tpu.parallel.mesh import sync_from_conf \
+                as sync_mesh
+            sync_mesh(self.conf, allow_disable=True)
         return self
 
     # -- SQL ----------------------------------------------------------------
@@ -843,6 +853,9 @@ class DataFrame:
         out = (f"== Physical Plan (input) ==\n{self._plan.tree_string()}\n"
                f"== TPU Plan ==\n{final.tree_string()}\n"
                f"== Placement ==\n{reasons}")
+        elided = overrides.last_elided
+        out += (f"\n== Distribution ==\nexchangeElided={len(elided)}"
+                + "".join(f"\n  - {e.desc()}" for e in elided))
         return out
 
     def __repr__(self):

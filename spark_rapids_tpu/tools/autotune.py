@@ -49,6 +49,12 @@ Rules (see docs/tools.md for the full semantics):
    while ``spark.rapids.sql.compile.literalPromotion`` is off: enable
    it so plans differing only in literal values share executables
    (the same clustering ``tools audit`` uses for its storm pass).
+10. **mesh-misaligned AQE coalescing** → ``aqeCoalesce`` events show
+   adaptive coalescing picked partition counts that are NOT multiples
+   of the active mesh size while the ICI exchange path is live, with
+   ``spark.rapids.sql.adaptive.meshAlign`` disabled: enable it so the
+   coalesced count snaps to the aligned multiple and post-AQE stages
+   keep an even device mapping (and stay ICI-eligible).
 
 Thresholds are fractions of query wall time; rules stay silent without
 their evidence, and rules 2 and 4 are mutually exclusive by
@@ -318,6 +324,38 @@ def autotune_query(profile: QueryProfile,
                 _cite(up_fbs, lambda e:
                       f"encodingFallback site=upload "
                       f"dict_size={e.payload.get('dict_size')}"),
+                qid))
+
+    # rule 10: AQE coalesced to a mesh-misaligned partition count while
+    # the ICI path is active.  Only actionable when meshAlign is OFF —
+    # with it on, a misaligned count means alignment was unachievable
+    # (fewer inputs than devices) and there is no conf to apply.
+    aqe_evs = profile.events_of("aqeCoalesce")
+    misaligned = [e for e in aqe_evs
+                  if int(e.payload.get("mesh", 0) or 0) > 1
+                  and not e.payload.get("aligned", True)]
+    if misaligned:
+        cur = _conf_value(profile, "spark.rapids.sql.adaptive.meshAlign")
+        if cur in (False, "false"):
+            mesh = int(misaligned[0].payload.get("mesh", 0) or 0)
+            worst = misaligned[0]
+            after = int(worst.payload.get("after", 0) or 0)
+            aligned_count = min(
+                int(worst.payload.get("before", after) or after),
+                max(mesh, int(round(after / mesh)) * mesh))
+            recs.append(Recommendation(
+                "spark.rapids.sql.adaptive.meshAlign", False, True,
+                f"{len(misaligned)} adaptive coalesce decision(s) "
+                f"picked partition counts misaligned with the "
+                f"{mesh}-device mesh (e.g. {after}, aligned would be "
+                f"{aligned_count}) while the ICI exchange path was "
+                "active — misaligned stages map unevenly onto devices "
+                "and lose in-mesh shuffle eligibility downstream",
+                _cite(misaligned, lambda e:
+                      f"aqeCoalesce before={e.payload.get('before')} "
+                      f"after={e.payload.get('after')} "
+                      f"mesh={e.payload.get('mesh')} "
+                      f"ici_active={e.payload.get('ici_active')}"),
                 qid))
 
     # rule 5: observability truncation -> bigger ring

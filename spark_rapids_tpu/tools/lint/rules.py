@@ -718,6 +718,70 @@ class EncodedMaterializeRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# collective-site
+# ---------------------------------------------------------------------------
+
+class CollectiveSiteRule(Rule):
+    """The SPMD PR concentrates every mesh collective in ``parallel/``:
+    the in-mesh exchange (spmd.py) and the fused all-to-all shuffle
+    (collective.py) own the shard_map programs, their HBM guards, their
+    chaos point, and their host-staged fallback.  A collective primitive
+    anywhere else is an unguarded whole-mesh synchronization point — no
+    fallback, no iciExchange accounting, and a lost chip fails the query
+    instead of degrading."""
+
+    id = "collective-site"
+    invariant = ("JAX collective primitives (shard_map, psum, "
+                 "all_to_all, ppermute, axis_index) only inside "
+                 "parallel/")
+    rationale = ("collectives synchronize the whole mesh: the parallel/ "
+                 "modules wrap them in the chaos point, the HBM guard "
+                 "and the host-staged fallback; a stray collective has "
+                 "none of those and turns one lost chip into a failed "
+                 "query")
+    hint = ("route mesh data movement through parallel/spmd.py / "
+            "parallel/collective.py, or annotate "
+            "'# lint: ok=collective-site' with a reason")
+
+    ALLOWED_DIRS = ("parallel/",)
+    _BANNED = frozenset({"shard_map", "psum", "all_to_all", "ppermute",
+                         "axis_index"})
+
+    def check_file(self, ctx: LintContext, pf: ParsedFile) -> None:
+        if pf.rel.startswith(self.ALLOWED_DIRS):
+            return
+        # names imported straight from jax modules
+        # ('from jax.experimental.shard_map import shard_map',
+        #  'from jax.lax import all_to_all')
+        imported: Set[str] = set()
+        for node in pf.nodes:
+            if isinstance(node, ast.ImportFrom) and node.module and \
+                    node.module.split(".")[0] == "jax":
+                for alias in node.names:
+                    if alias.name in self._BANNED:
+                        imported.add(alias.asname or alias.name)
+        for node in pf.nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            bad = None
+            if isinstance(fn, ast.Attribute) and fn.attr in self._BANNED:
+                root = _dotted(fn)
+                # attribute calls count only when rooted in a jax
+                # module path (jax.lax.psum, lax.all_to_all) — a
+                # method named .psum on an engine object is not a
+                # collective
+                if root.split(".")[0] in ("jax", "lax"):
+                    bad = root
+            elif isinstance(fn, ast.Name) and fn.id in imported:
+                bad = fn.id
+            if bad:
+                self.report(ctx, pf.rel, node.lineno,
+                            f"mesh collective {bad}(...) outside "
+                            "parallel/")
+
+
+# ---------------------------------------------------------------------------
 # lock-order
 # ---------------------------------------------------------------------------
 
@@ -771,5 +835,6 @@ def default_rules() -> List[Rule]:
         FaultPointRule(),
         RetryFrameRule(),
         EncodedMaterializeRule(),
+        CollectiveSiteRule(),
         LockOrderRule(),
     ]

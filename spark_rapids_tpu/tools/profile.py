@@ -35,7 +35,7 @@ from spark_rapids_tpu.tools.reader import (QueryProfile, ReadDiagnostics,
                                            SpanNode)
 
 #: decomposition buckets, render order
-BUCKETS = ("decode", "h2d", "compute", "d2h", "shuffle",
+BUCKETS = ("decode", "h2d", "compute", "d2h", "shuffle", "ici",
            "producer_stall", "consumer_stall", "spill", "recovery",
            "semaphore", "arbitration", "compile", "other")
 
@@ -144,6 +144,13 @@ def attribute(profile: QueryProfile) -> Attribution:
     # other resource — the proportional scaling below reconciles them)
     for ev in profile.events_of("stageCompile"):
         raw["compile"] += float(ev.payload.get("duration_s", 0.0) or 0.0)
+    # in-mesh collective exchanges (parallel/spmd.py): measured shard +
+    # pid + all_to_all time, split out of the generic shuffle bucket so
+    # ICI vs host-staged movement is visible per query.  The owning
+    # exchange span's exclusive time still lands in 'shuffle'; the
+    # proportional scaling reconciles the overlap like every resource.
+    for ev in profile.events_of("iciExchange"):
+        raw["ici"] += float(ev.payload.get("duration_s", 0.0) or 0.0)
     for ev in profile.events_of("spill", "unspill"):
         raw["spill"] += float(ev.payload.get("duration_s", 0.0) or 0.0)
     for ev in profile.events_of("fetchRetry"):
@@ -326,6 +333,17 @@ def render_report(profiles: List[QueryProfile], diag: ReadDiagnostics,
                 f"({_fmt_bytes(enc_bytes)} shipped) "
                 f"fallbacks={len(fb_evs)} "
                 f"({_fmt_bytes(fb_bytes)} decoded)")
+        elided_evs = qp.events_of("exchangeElided")
+        ici_evs = qp.events_of("iciExchange")
+        if elided_evs or ici_evs:
+            n_elided = sum(int(e.payload.get("count", 0) or 0)
+                           for e in elided_evs)
+            ici_rows = sum(int(e.payload.get("rows", 0) or 0)
+                           for e in ici_evs)
+            lines.append(
+                f"  Distribution: exchangeElided={n_elided} "
+                f"iciExchanges={len(ici_evs)} "
+                f"({ici_rows} rows moved in-mesh)")
         lock_violations = qp.events_of("lockOrderViolation")
         if lock_violations:
             pairs = sorted({f"{ev.payload.get('held')}->"

@@ -332,6 +332,40 @@ def test_fault_point_rule(tmp_path):
     assert not _findings(report, "fault-point")
 
 
+def test_collective_site_rule(tmp_path):
+    from spark_rapids_tpu.tools.lint.rules import CollectiveSiteRule
+    bad = """
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax import lax
+
+        def my_exchange(fn, mesh, x):
+            prog = shard_map(fn, mesh=mesh)          # imported name
+            total = jax.lax.psum(x, "data")          # jax.lax attr
+            moved = lax.all_to_all(x, "data", 0, 0)  # lax attr
+            return prog, total, moved
+    """
+    report = _lint_snippet(tmp_path, bad, [CollectiveSiteRule()])
+    finds = _findings(report, "collective-site")
+    assert len(finds) == 3, [f.message for f in finds]
+    # a file under parallel/ is the sanctioned home
+    root = tmp_path / "pkg"
+    (root / "parallel").mkdir(parents=True)
+    (root / "parallel" / "spmd2.py").write_text(textwrap.dedent(bad))
+    from spark_rapids_tpu.tools.lint import run_lint
+    report = run_lint(root=str(root), rules=[CollectiveSiteRule()],
+                      baseline_path="")
+    assert not _findings(report, "collective-site")
+    # method look-alikes on engine objects are not collectives
+    clean = """
+        def fine(store, x):
+            return store.psum(x) + x.all_to_all()
+    """
+    report = _lint_snippet(tmp_path, clean, [CollectiveSiteRule()],
+                           name="clean.py")
+    assert not _findings(report, "collective-site")
+
+
 def test_encoded_materialize_rule(tmp_path):
     from spark_rapids_tpu.tools.lint.rules import EncodedMaterializeRule
     bad = """
@@ -508,7 +542,7 @@ def test_json_schema(tmp_path):
     assert {r["id"] for r in d["rules"]} == {
         "jit-site", "aot-site", "conf-registry", "event-catalog",
         "traced-purity", "spillable-close", "fault-point", "retry-frame",
-        "encoded-materialize", "lock-order"}
+        "encoded-materialize", "collective-site", "lock-order"}
     (f,) = [f for f in d["findings"] if f["rule"] == "jit-site"]
     assert set(f) == {"rule", "severity", "file", "line", "message",
                       "hint", "suppressed"}

@@ -753,3 +753,74 @@ def test_bench_event_log_payload_smoke(tmp_path):
     assert payload["events"] > 0
     bad = bench._event_log_payload(str(tmp_path / "missing.jsonl"))
     assert bad["profile_ok"] is False and "error" in bad
+
+
+# ---------------------------------------------------------------------------
+# SPMD distribution: ici bucket, Distribution line, AutoTuner rule 10
+# ---------------------------------------------------------------------------
+
+def _ici_log(tmp_path, mesh_align_conf=None, aligned=True):
+    log = tmp_path / "ici.jsonl"
+    conf = {}
+    if mesh_align_conf is not None:
+        conf["spark.rapids.sql.adaptive.meshAlign"] = mesh_align_conf
+    lines = [
+        _jline("queryStart", 21, 1, 1.0, description="mesh q",
+               conf=conf),
+        _jline("exchangeElided", 21, 1, 1.1, count=2,
+               exchanges=["HashPartitioning(k, 8) <= hash[1k,8]",
+                          "HashPartitioning(k, 8) <= hash[1k,8]"]),
+        _jline("iciExchange", 21, 1, 1.3, devices=8, rows=4000,
+               shard_rows=[500] * 8, shard_bytes=1 << 16,
+               duration_s=0.4),
+        _jline("aqeCoalesce", 21, 1, 1.5, before=16,
+               after=8 if aligned else 5, align=8 if aligned else 1,
+               mesh=8, ici_active=True, aligned=aligned),
+        _jline("spanMetrics", 21, 4, 1.9, parent_id=1, depth=1,
+               node="TpuShuffleExchangeExec", desc="x", opTime=0.6,
+               start_s=1.0, end_s=2.0),
+        _jline("queryEnd", 21, 1, 2.0, duration_s=1.0),
+    ]
+    log.write_text("\n".join(lines) + "\n")
+    return log
+
+
+def test_profile_ici_bucket_and_distribution_line(tmp_path):
+    log = _ici_log(tmp_path)
+    profiles, diag = load_profiles(str(log))
+    att = attribute(profiles[0])
+    assert att.raw["ici"] == pytest.approx(0.4)
+    report = render_report(profiles, diag)
+    assert "ici" in report
+    assert "Distribution: exchangeElided=2 iciExchanges=1" in report
+    assert "4000 rows moved in-mesh" in report
+
+
+def test_autotune_rule10_mesh_misaligned_coalesce(tmp_path):
+    """Rule 10: misaligned AQE counts while the ICI path is active and
+    meshAlign is OFF -> recommend enabling it, with the aqeCoalesce
+    events as evidence."""
+    log = _ici_log(tmp_path, mesh_align_conf=False, aligned=False)
+    recs = autotune_query(load_profiles(str(log))[0][0])
+    by_key = {r.key: r for r in recs}
+    rec = by_key["spark.rapids.sql.adaptive.meshAlign"]
+    assert rec.current is False and rec.recommended is True
+    assert any("aqeCoalesce" in e for e in rec.evidence)
+    assert "8-device mesh" in rec.reason
+    conf = to_conf_dict([rec])
+    C.TpuConf(dict(conf))    # genuinely ready-to-apply
+
+
+def test_autotune_rule10_quiet_when_aligned_or_enabled(tmp_path):
+    # aligned decisions: healthy, no recommendation
+    log = _ici_log(tmp_path, mesh_align_conf=False, aligned=True)
+    keys = {r.key for r in autotune_query(load_profiles(str(log))[0][0])}
+    assert "spark.rapids.sql.adaptive.meshAlign" not in keys
+    # misaligned but meshAlign already ON (alignment unachievable):
+    # there is no conf to apply — stay silent
+    log2 = tmp_path / "on.jsonl"
+    log2.write_text(_ici_log(tmp_path, mesh_align_conf=True,
+                             aligned=False).read_text())
+    keys2 = {r.key
+             for r in autotune_query(load_profiles(str(log2))[0][0])}
+    assert "spark.rapids.sql.adaptive.meshAlign" not in keys2
