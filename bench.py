@@ -105,14 +105,17 @@ def _build_data(n_rows: int):
     }
 
 
-def _query(df):
+def _query(df, threshold=0):
+    # ``threshold`` rides a promoted literal slot: every threshold
+    # variant shares ONE compiled program (the serving phase leans on
+    # this — its mixed synthetic workload adds zero compiles)
     from spark_rapids_tpu import functions as F
     from spark_rapids_tpu.expressions import arithmetic as A
     from spark_rapids_tpu.expressions import hashing as H
     from spark_rapids_tpu.expressions import predicates as P
     from spark_rapids_tpu.expressions.base import Alias, col, lit
     return (df
-            .filter(P.GreaterThan(col("w"), lit(0)))
+            .filter(P.GreaterThan(col("w"), lit(threshold)))
             .select(Alias(A.Add(col("k"), lit(1)), "k1"),
                     Alias(A.Multiply(col("v"), lit(2.0)), "v2"),
                     Alias(H.Murmur3Hash(col("k"), col("w")), "h"))
@@ -148,20 +151,21 @@ def main():
     data = _build_data(n_rows)
     row_bytes = 8 + 8 + 4
 
-    def measure(session, warmups, runs):
+    def measure(session, tbl_data, warmups, runs):
         # the table stays local: holding it past this function would pin
         # the full device-resident working set through the follow-on
         # phases (which compute out-of-core budgets from free HBM)
         from spark_rapids_tpu.exec.stage_compiler import stats as cstats
+        tbl_rows = len(next(iter(tbl_data.values())))
         base = cstats()
-        table = session.create_dataframe(data, num_partitions=parts)
+        table = session.create_dataframe(tbl_data, num_partitions=parts)
         # uncounted compile warm-up pass: every stage program of the
         # query compiles here, so the timed runs below measure the
         # engine, never the compiler (warm/steady split reported in the
         # payload's "compile" field)
         for _ in range(warmups):
             _query(table).collect()
-            _PROGRESS["rows_done"] += n_rows
+            _PROGRESS["rows_done"] += tbl_rows
         warm = cstats()
         best = float("inf")
         result = None
@@ -169,7 +173,7 @@ def main():
             t0 = time.perf_counter()
             result = _query(table).collect()
             best = min(best, time.perf_counter() - t0)
-            _PROGRESS["rows_done"] += n_rows
+            _PROGRESS["rows_done"] += tbl_rows
         steady = cstats()
         compile_info = {
             "warmup_compile_s": round(warm["compile_s"]
@@ -216,28 +220,59 @@ def main():
             f"device backend unavailable: {type(e).__name__}: {e}"[:300]
         print(json.dumps(_PAYLOAD))
         return 1
-    _set_phase("tpu_primary")
-    best_tpu, r_tpu, tpu_compile = measure(tpu, warmups=2, runs=reps)
-    # per-query attribution of the LAST timed device run (query-scoped
-    # tracing): node-level rows/batches/opTime plus spill/retry/semaphore
-    # totals, so this payload is attributable, not just a wall-clock
-    from spark_rapids_tpu.aux.tracing import last_query_summary
-    tpu_query_metrics = _compact_summary(last_query_summary())
-
     cpu = TpuSession(TpuConf({"spark.rapids.sql.enabled": "false"}),
                      init_device=False)
-    # at large working sets a CPU-engine pass costs tens of seconds and
-    # numpy has no warmup effect worth paying for twice — one timed pass
-    # leaves budget for the TPC-DS phase
-    big = n_rows >= 32_000_000
-    _set_phase("cpu_primary")
-    best_cpu, r_cpu, _ = measure(cpu, warmups=0 if big else 1,
-                                 runs=1 if big else reps)
 
-    # differential sanity: the two engines must agree or the number is void
-    ok = (abs(r_tpu[0]["sk"] - r_cpu[0]["sk"]) == 0 and
-          abs(r_tpu[0]["sv"] - r_cpu[0]["sv"]) < 1e-6 * abs(r_cpu[0]["sv"]))
-    if not ok:
+    def _match(r_tpu, r_cpu) -> bool:
+        # differential sanity: the engines must agree or a number is void
+        return (abs(r_tpu[0]["sk"] - r_cpu[0]["sk"]) == 0 and
+                abs(r_tpu[0]["sv"] - r_cpu[0]["sv"])
+                < 1e-6 * abs(r_cpu[0]["sv"]))
+
+    # honest device efficiency: effective bytes/s vs HBM bandwidth (v5e
+    # ~819 GB/s; override for other chips).  The pipeline reads each row
+    # once, so bytes/s ~ input traffic; hbm_frac near 0 = dispatch-bound.
+    hbm_bw = float(os.environ.get("BENCH_HBM_GBPS", 819)) * 1e9
+
+    def _primary_out(n, best_tpu, best_cpu, tier):
+        bps = n * row_bytes / best_tpu
+        return {
+            "metric": "filter_project_hash_agg_rows_per_sec",
+            "value": round(n / best_tpu),
+            "unit": "rows/s",
+            "vs_baseline": round(best_cpu / best_tpu, 3),
+            "rows": n,
+            "tier": tier,
+            "bytes_per_sec": round(bps),
+            "hbm_frac": round(bps / hbm_bw, 5),
+            "tpu_s": round(best_tpu, 4),
+            "cpu_s": round(best_cpu, 4),
+            "results_match": True,
+        }
+
+    # QUICK tier first (BENCH_r05 ended with value 0 after the full-size
+    # primary blew the whole budget): a small slice lands a real metric
+    # within minutes even when device compiles are slow, and the
+    # full-size tier then only runs — and overwrites it — if the budget
+    # provably still fits a linear projection of the measured pass times
+    quick_rows = min(n_rows,
+                     int(os.environ.get("BENCH_QUICK_ROWS", 8_000_000)))
+    qdata = data if quick_rows == n_rows \
+        else {k: v[:quick_rows] for k, v in data.items()}
+    _set_phase("tpu_quick")
+    # when the quick slice IS the full size, this pass is the full tier:
+    # run the full protocol (2 warm-ups, best of reps), not the 1+1
+    # quick probe — a 'full'-labeled number must mean the same thing
+    # regardless of BENCH_ROWS
+    full_now = quick_rows == n_rows
+    best_tpu, r_tpu, tpu_compile = measure(
+        tpu, qdata, warmups=2 if full_now else 1,
+        runs=reps if full_now else 1)
+    from spark_rapids_tpu.aux.tracing import last_query_summary
+    tpu_query_metrics = _compact_summary(last_query_summary())
+    _set_phase("cpu_quick")
+    best_cpu, r_cpu, _ = measure(cpu, qdata, warmups=0, runs=1)
+    if not _match(r_tpu, r_cpu):
         signal.alarm(0)
         print(json.dumps({
             "metric": "filter_project_hash_agg_rows_per_sec",
@@ -246,24 +281,38 @@ def main():
             "tpu": r_tpu[0], "cpu": r_cpu[0],
         }))
         return 1
+    out = _primary_out(quick_rows, best_tpu, best_cpu,
+                       "full" if quick_rows == n_rows else "quick")
+    # a real metric exists NOW: the failsafe prints it from here on
+    signal.alarm(0)
+    _PAYLOAD.clear()
+    _PAYLOAD.update(out)
+    _PAYLOAD.pop("error", None)
+    _arm(max(1.0, _remaining()))
+    sys.stderr.write(json.dumps(out) + "\n")
+    sys.stderr.flush()
 
-    rows_per_sec = n_rows / best_tpu
-    # honest device efficiency: effective bytes/s vs HBM bandwidth (v5e
-    # ~819 GB/s; override for other chips).  The pipeline reads each row
-    # once, so bytes/s ~ input traffic; hbm_frac near 0 = dispatch-bound.
-    hbm_bw = float(os.environ.get("BENCH_HBM_GBPS", 819)) * 1e9
-    bytes_per_sec = n_rows * row_bytes / best_tpu
-    out = {
-        "metric": "filter_project_hash_agg_rows_per_sec",
-        "value": round(rows_per_sec),
-        "unit": "rows/s",
-        "vs_baseline": round(best_cpu / best_tpu, 3),
-        "bytes_per_sec": round(bytes_per_sec),
-        "hbm_frac": round(bytes_per_sec / hbm_bw, 5),
-        "tpu_s": round(best_tpu, 4),
-        "cpu_s": round(best_cpu, 4),
-        "results_match": True,
-    }
+    if quick_rows < n_rows:
+        # full-size tier: 2 warm-up + reps timed TPU passes (device time
+        # is near-flat in rows, so linear is conservative) + one
+        # linear-scaling CPU pass
+        scale = n_rows / quick_rows
+        est = best_cpu * scale + (2 + reps) * best_tpu * scale
+        if _remaining() > est + 45:
+            _set_phase("tpu_primary")
+            best_tpu, r_tpu, tpu_compile = measure(tpu, data, warmups=2,
+                                                   runs=reps)
+            tpu_query_metrics = _compact_summary(last_query_summary())
+            _set_phase("cpu_primary")
+            best_cpu, r_cpu, _ = measure(cpu, data, warmups=0, runs=1)
+            if _match(r_tpu, r_cpu):
+                out = _primary_out(n_rows, best_tpu, best_cpu, "full")
+            else:   # keep the (matching) quick number, flag the full run
+                out["full_tier_error"] = "TPU/CPU results diverge"
+        else:
+            out["full_tier_skipped"] = \
+                f"projected {round(est)}s exceeds remaining budget"
+    rows_per_sec = out["value"]
     # compile ledger (stage_compiler): warm-up compile seconds are
     # EXCLUDED from the primary metric and reported here; steady_traces
     # must be 0 or compilation leaked into the steady-state number
@@ -330,6 +379,24 @@ def main():
                 f"{type(e).__name__}: {e}"
         _swap_payload(out)
 
+    if os.environ.get("BENCH_SKIP_SERVING", "") != "1" and _remaining() > 30:
+        # sustained-throughput serving payload (ISSUE 15 acceptance),
+        # BEFORE the TPC-DS phase so a budget blowout there can never
+        # leave it missing: 8 literal variants of the primary pipeline
+        # at the quick tier's shape — shares its compiled programs, so
+        # this round costs execution time only
+        _set_phase("serving")
+        serving: dict = {"partial": True}
+        out["serving"] = serving
+        _swap_payload(out)
+        try:
+            _serving_phase(tpu, serving, "synthetic",
+                           data_slice=qdata, parts=parts)
+            serving.pop("partial", None)
+        except Exception as e:  # keep the primary metric reportable
+            serving["error"] = f"{type(e).__name__}: {e}"
+        _swap_payload(out)
+
     if os.environ.get("BENCH_SKIP_TPCDS", "") != "1" and _remaining() > 45:
         # TPC-DS before the scaling curve: per-query speedups are the
         # scarcer signal when the budget runs short
@@ -343,6 +410,22 @@ def main():
         except Exception as e:  # keep the primary metric reportable
             tpcds["error"] = f"{type(e).__name__}: {e}"
 
+    if os.environ.get("BENCH_SKIP_SERVING", "") != "1" and \
+            _remaining() > 70 and "tpcds" in out:
+        # opportunistic second serving round over the REAL mixed TPC-DS
+        # workload the TPC-DS phase just warmed (the guaranteed
+        # synthetic round above already landed the payload)
+        _set_phase("serving_tpcds")
+        serving2: dict = {"partial": True}
+        out["serving_tpcds"] = serving2
+        _swap_payload(out)
+        try:
+            _serving_phase(tpu, serving2, "tpcds")
+            serving2.pop("partial", None)
+        except Exception as e:  # keep the primary metric reportable
+            serving2["error"] = f"{type(e).__name__}: {e}"
+        _swap_payload(out)
+
     if os.environ.get("BENCH_SKIP_SCALING", "") != "1" and _remaining() > 30:
         # row-count scaling curve: dispatch-bound shows flat time (rising
         # rows/s); bandwidth-bound shows flat rows/s.  Each point gets its
@@ -351,7 +434,9 @@ def main():
         # tables are dropped between points so device residency stays ~1x.
         _set_phase("scaling")
         try:
-            curve = {str(n_rows): round(rows_per_sec)}
+            # anchor at the rows the surviving metric actually measured
+            # (the quick tier's count when the full tier was skipped)
+            curve = {str(out["rows"]): round(rows_per_sec)}
             ctable = None
             for cn in (1_000_000, 2_000_000, 4_000_000):
                 if cn > n_rows or _remaining() < 20:
@@ -568,6 +653,201 @@ def _pipeline_microbench(tpu, data, parts) -> dict:
     return res
 
 
+def _serving_phase(tpu, res: dict, kind: str, data_slice=None, parts=2):
+    """Sustained-throughput serving measurement (serving/server.py): the
+    same mixed 8-query workload executed (a) serially through the plain
+    session path and (b) concurrently through the QueryServer (admission
+    + cross-query plan/result caches + the online AutoTuner), reporting
+    queries/sec, p50/p99 submit-to-result latency, the plan-cache hit
+    rate, and bit-identity of every served result against the serial
+    reference.
+
+    ``kind="synthetic"`` (runs BEFORE the TPC-DS phase, so a budget
+    blowout there can never leave the payload missing): 8 threshold
+    variants of the primary pipeline over ``data_slice`` at the primary
+    phase's shape — literal promotion makes every variant share the
+    already-compiled programs, so this round adds ZERO compiles.
+    ``kind="tpcds"``: the 8 cheapest TPC-DS queries the TPC-DS phase
+    just registered and compile-warmed."""
+    from spark_rapids_tpu.serving import QueryServer
+    reps = int(os.environ.get("BENCH_SERVING_REPS", 3))
+    res["workload"] = kind
+    if kind == "tpcds":
+        from spark_rapids_tpu.testing.tpcds_queries import QUERIES
+        # (q8 excluded: pathological native compile on some backends —
+        # see the TPC-DS phase's slow tail)
+        names = [q for q in ("q3", "q7", "q19", "q1", "q15", "q12",
+                             "q13", "q20") if q in QUERIES]
+        if len(names) < 4 or tpu.catalog_lookup("store_sales") is None:
+            res["error"] = "tpcds tables/queries unavailable"
+            return res
+        workload = [(n, QUERIES[n]) for n in names]
+    else:
+        table = tpu.create_dataframe(data_slice, num_partitions=parts)
+
+        def variant(threshold):
+            def build(session):
+                return _query(table, threshold)
+            return build
+
+        workload = [(f"w>{t}", variant(t))
+                    for t in (-750, -500, -250, 0, 250, 500, 750, 900)]
+
+    def run_serial(item):
+        tag, q = item
+        df = tpu.sql(q) if isinstance(q, str) else q(tpu)
+        return df.collect()
+
+    # every serving.* conf this phase touches on the SHARED session is
+    # restored on exit (the first validation run leaked resultCache=0
+    # into the follow-on round and silently disabled it)
+    saved_conf = {}
+
+    def set_conf(key, value):
+        saved_conf.setdefault(key, tpu.conf.get(key))
+        tpu.set_conf(key, value)
+
+    # serial reference pass: one uncounted warm execution per distinct
+    # query (compiles must not skew either side), TIMED so the sweep
+    # cost is known before committing the budget to it
+    reference = {}
+    warm_s = 0.0
+    for item in workload:
+        if _remaining() < 25:
+            res["error"] = "budget exhausted during serving warm-up"
+            return res
+        t0 = time.perf_counter()
+        reference[item[0]] = run_serial(item)
+        warm_s += time.perf_counter() - t0
+    if warm_s * (reps + 1.5) > _remaining() - 20:
+        # the warm sweep proved this workload too slow for a serial
+        # baseline + concurrent pass within the remaining budget
+        res["error"] = f"workload too slow for budget (warm {warm_s:.1f}s)"
+        return res
+    executions = workload * reps
+    res.update({"queries": len(workload), "reps": reps,
+                "executions": len(executions)})
+    serial_s = 0.0
+    for item in executions:
+        if _remaining() < 20:
+            res["error"] = "budget exhausted during serial baseline"
+            return res
+        t0 = time.perf_counter()
+        run_serial(item)
+        serial_s += time.perf_counter() - t0
+    res["serial_s"] = round(serial_s, 4)
+
+    try:
+        # throughput pass: autotune stays OFF — an accepted delta
+        # mid-measurement legitimately re-keys both caches (the conf
+        # digest changed), which measures the tuner's transient, not
+        # steady-state serving; the loop gets its own round below
+        srv = QueryServer(session=tpu)
+        try:
+            t0 = time.perf_counter()
+            subs = [(tag, srv.submit(q, tag=tag))
+                    for tag, q in executions]
+            lat = []
+            identical = True
+            for tag, sub in subs:
+                rows = sub.result(timeout=max(30.0, _remaining()))
+                lat.append(sub.info.get("latency_s", 0.0))
+                identical = identical and rows == reference[tag]
+            wall = time.perf_counter() - t0
+            lat.sort()
+            st = srv.stats()
+            pc = st["plan_cache"]
+            looked = pc["hits"] + pc["misses"]
+            res.update({
+                "concurrent_s": round(wall, 4),
+                "queries_per_sec": round(len(executions) / wall, 3),
+                "serial_queries_per_sec":
+                    round(len(executions) / serial_s, 3)
+                    if serial_s else 0.0,
+                "speedup_vs_serial": round(serial_s / wall, 3),
+                "p50_latency_s": round(lat[len(lat) // 2], 4),
+                "p99_latency_s":
+                    round(lat[min(len(lat) - 1,
+                                  math.ceil(0.99 * len(lat)) - 1)], 4),
+                "bit_identical": identical,
+                "plan_cache_hit_rate":
+                    round(pc["hits"] / looked, 3) if looked else 0.0,
+                "plan_cache": pc,
+                "result_cache": st["result_cache"],
+                "admission": st["admission"],
+                "max_concurrent": srv.admission.max_concurrent,
+            })
+        finally:
+            srv.stop()
+
+        if _remaining() > 20:
+            # plan-cache round, result cache OFF: the mixed pass above
+            # serves repeats from the RESULT cache, so the plan cache
+            # never shows its exact-hit path there.  This round isolates
+            # it — serial repeats of each query must hit the cached
+            # physical plan and trace NOTHING (the ISSUE 15 acceptance
+            # assertion, measured on the live bench workload, not only
+            # in tier-1)
+            from spark_rapids_tpu.exec.stage_compiler import \
+                stats as cstats
+            set_conf("spark.rapids.serving.resultCache.maxBytes", "0")
+            srv2 = QueryServer(session=tpu)
+            try:
+                for tag, q in workload:          # insert sweep
+                    srv2.execute(q, tag=tag,
+                                 timeout=max(30.0, _remaining()))
+                tr0 = cstats()["traces"]
+                t0 = time.perf_counter()
+                n_rep = 0
+                for _ in range(max(1, reps - 1)):
+                    if _remaining() < 15:
+                        break
+                    for tag, q in workload:      # repeat sweeps: hits
+                        srv2.execute(q, tag=tag,
+                                     timeout=max(30.0, _remaining()))
+                        n_rep += 1
+                pc2 = srv2.stats()["plan_cache"]
+                looked2 = pc2["hits"] + pc2["misses"]
+                res["plan_cache_round"] = {
+                    "repeats": n_rep,
+                    "repeat_s": round(time.perf_counter() - t0, 4),
+                    "hits": pc2["hits"],
+                    "misses": pc2["misses"],
+                    "hit_rate": round(pc2["hits"] / looked2, 3)
+                    if looked2 else 0.0,
+                    # MUST be 0: a repeat that re-traces re-compiled
+                    "new_traces_on_repeat": cstats()["traces"] - tr0,
+                }
+            finally:
+                srv2.stop()
+
+        if _remaining() > 15:
+            # online-tuning round: the loop live on real executions
+            # (result cache off so rules see executions, not cache
+            # hits); the trail proves deltas apply between queries
+            set_conf("spark.rapids.serving.autotune.enabled", "true")
+            srv3 = QueryServer(session=tpu)
+            try:
+                for tag, q in workload:
+                    if _remaining() < 10:
+                        break
+                    srv3.execute(q, tag=tag,
+                                 timeout=max(30.0, _remaining()))
+                res["autotune"] = {
+                    "applied": len(srv3.autotune_applied),
+                    "deltas": [
+                        {"key": k, "old": str(o), "new": str(n)}
+                        for k, o, n, _r, _q
+                        in srv3.autotune_applied[:8]],
+                }
+            finally:
+                srv3.stop()
+    finally:
+        for key, old in saved_conf.items():
+            tpu.set_conf(key, str(old))
+    return res
+
+
 def _compact_summary(qm, max_nodes: int = 8):
     """Trims a tracing query summary for the one-line payload: the
     query-level counters plus the top-opTime nodes."""
@@ -631,10 +911,13 @@ def _tpcds_phase(tpu, cpu, res: dict):
     # budget runs short the expensive tail is skipped instead of eating
     # the cheap majority's slots; unmeasured queries run before the
     # known-slow tail
-    order = ["q3", "q1", "q7", "q8", "q15", "q12", "q13", "q20", "q19",
+    order = ["q3", "q1", "q7", "q15", "q12", "q13", "q20", "q19",
              "q16", "q17", "q10", "q18", "q6", "q9", "q2", "q11", "q5",
              "q4"]
-    slow_tail = ["q48", "q9", "q2", "q11", "q5", "q4"]
+    # q8 rides the slow tail: its fused agg hits a pathological XLA
+    # compile on some backends (minutes of native compile the SIGALRM
+    # failsafe cannot preempt) — it must never starve the cheap majority
+    slow_tail = ["q48", "q8", "q9", "q2", "q11", "q5", "q4"]
     fast_new = [q for q in sorted(QUERIES, key=lambda s: int(s[1:]))
                 if q not in order and q not in slow_tail]
     names = [q for q in order if q in QUERIES and q not in slow_tail] + \

@@ -5,21 +5,21 @@
 # and tests/test_audit.py run the same linter/auditor as their gate
 # tests) but fails in seconds instead of minutes.
 #
-#   scripts/check.sh            # lint + audit smoke + smoke tests
+#   scripts/check.sh            # lint + audit smoke + serving smoke + smoke tests
 #   scripts/check.sh --lint-only
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== 1/3 engine invariant lint =="
+echo "== 1/4 engine invariant lint =="
 python -m spark_rapids_tpu.tools lint
 
 if [[ "${1:-}" == "--lint-only" ]]; then
     exit 0
 fi
 
-echo "== 2/3 compiled-program audit smoke =="
+echo "== 2/4 compiled-program audit smoke =="
 AUDIT_LOG="$(mktemp -d)/audit_smoke.jsonl"
 python - "$AUDIT_LOG" <<'PY'
 import sys
@@ -45,5 +45,37 @@ PY
 python -m spark_rapids_tpu.tools audit "$AUDIT_LOG" --no-roofline
 rm -rf "$(dirname "$AUDIT_LOG")"
 
-echo "== 3/3 smoke test tier =="
+echo "== 3/4 concurrent-serving smoke =="
+# two queries racing through the QueryServer: both admitted, results
+# bit-identical to a serial run, and the exact repeat skips planning
+python - <<'PY'
+import numpy as np
+from spark_rapids_tpu.serving import QueryServer
+from spark_rapids_tpu.session import TpuSession
+
+s = TpuSession({"spark.rapids.sql.test.enabled": "false",
+                "spark.rapids.serving.maxConcurrentQueries": "2",
+                "spark.rapids.serving.resultCache.maxBytes": "0"})
+rng = np.random.default_rng(9)
+df = s.create_dataframe(
+    {"k": rng.integers(0, 10, 20_000).astype(np.int64),
+     "v": rng.standard_normal(20_000)}, num_partitions=2)
+s.create_or_replace_temp_view("t", df)
+q = "SELECT k, SUM(v) AS sv, COUNT(*) AS c FROM t GROUP BY k ORDER BY k"
+srv = QueryServer(session=s)
+try:
+    serial = srv.execute(q)
+    a, b = srv.submit(q), srv.submit(q)
+    assert a.result(120) == serial and b.result(120) == serial, \
+        "concurrent serving results diverge from serial"
+    st = srv.stats()
+    assert st["admission"]["admitted"] == 3, st
+    assert st["plan_cache"]["hits"] >= 1, \
+        f"repeat query did not hit the plan cache: {st}"
+finally:
+    srv.stop()
+print("serving smoke ok:", st["admission"], st["plan_cache"])
+PY
+
+echo "== 4/4 smoke test tier =="
 python -m pytest tests/ -q -m smoke -p no:cacheprovider

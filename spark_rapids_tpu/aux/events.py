@@ -93,6 +93,10 @@ EVENT_KINDS = frozenset({
     "lockOrderViolation",
     # live resource sampler (aux/sampler.py)
     "resourceSample",
+    # concurrent query serving (serving/server.py, serving/caches.py):
+    # admission lifecycle, the two cross-query caches, and the online
+    # AutoTuner's applied conf deltas
+    "servingAdmission", "planCache", "resultCache", "autotuneApplied",
 })
 
 
@@ -503,6 +507,11 @@ def render_prometheus() -> str:
         "Wedged tasks cancelled by the hung-query watchdog")
     add("watchdog_dumps_total", "counter", ast["watchdog_dumps"],
         "Hung-query watchdog thread-state dumps")
+    add("serving_queries", "gauge", ast["serving_queries"],
+        "Queries currently admitted to or queued in the serving layer")
+    add("serving_admission_queued", "gauge", ast["serving_queued"],
+        "Submissions currently blocked on serving admission "
+        "(BLOCKED_ON_ADMISSION)")
     add("events_ring_dropped_total", "counter", ring_dropped_total(),
         "Events dropped by bounded ring-buffer sinks (truncation marker)")
     from spark_rapids_tpu.aux import lockorder as _lo

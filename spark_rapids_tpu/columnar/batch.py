@@ -87,9 +87,9 @@ class ColumnarBatch:
             return self.nbytes()
         return int(self.nbytes() * (int(rc) / max(self.bucket, 1)))
 
-    def to_host(self) -> "HostColumnarBatch":
+    def to_host(self, spec_rows=None) -> "HostColumnarBatch":
         from spark_rapids_tpu.columnar.transfer import download_host_batch
-        return download_host_batch(self)
+        return download_host_batch(self, spec_rows=spec_rows)
 
     def select(self, indices: Sequence[int]) -> "ColumnarBatch":
         names = None if self.names is None else [self.names[i] for i in indices]

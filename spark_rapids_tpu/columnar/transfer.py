@@ -275,7 +275,9 @@ def upload_host_batch(hb, bucket: Optional[int] = None):
 #: is still deferred: planes are sliced to this many rows and the count is
 #: packed INTO the buffer, so the fetch itself resolves whether it was
 #: enough (results above the cap pay one extra round trip — rare: results
-#: a user collects are small)
+#: a user collects are small).  Default only — the D2H boundary exec
+#: carries its conf value per instance (per-query conf travels with the
+#: plan, not this module)
 _DL_SPEC_ROWS = 8192
 
 
@@ -399,15 +401,17 @@ def _unpack_buffer(buf: np.ndarray, planes, shrink: int):
     return out, rc
 
 
-def download_host_batch(cb) -> "object":
+def download_host_batch(cb, spec_rows=None) -> "object":
     """ColumnarBatch -> HostColumnarBatch in ONE device round trip.
 
     All planes are packed into a single uint8 buffer on device (cheap — a
     fused slice+bitcast+concat program) together with the row count, then
     fetched with one blocking call.  When the row count is deferred and the
-    bucket is large, planes are speculatively sliced to ``_DL_SPEC_ROWS``
-    rows; the packed count reveals whether that was enough, and only an
-    oversized result pays a second (exactly-sized) round trip.
+    bucket is large, planes are speculatively sliced to ``spec_rows``
+    (default ``_DL_SPEC_ROWS``; the D2H boundary exec passes its
+    convert-time conf value) rows; the packed count reveals whether that
+    was enough, and only an oversized result pays a second (exactly-sized)
+    round trip.
     """
     from spark_rapids_tpu.columnar import encoding as ENC
     from spark_rapids_tpu.columnar.batch import HostColumnarBatch
@@ -440,7 +444,9 @@ def download_host_batch(cb) -> "object":
     bucket = int(cb.columns[0].data.shape[0])
     deferred = isinstance(rc, DeferredCount) and not rc.is_forced
     if deferred:
-        shrink = min(bucket, bucket_rows(_DL_SPEC_ROWS, minimum=8))
+        if spec_rows is None:   # explicit sentinel: small conf values
+            spec_rows = _DL_SPEC_ROWS             # must stick
+        shrink = min(bucket, bucket_rows(spec_rows, minimum=8))
     else:
         # known count: slice exactly (never ship padding rows; d2h
         # bandwidth is the scarcest resource on a tunnel-attached device)

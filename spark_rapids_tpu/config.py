@@ -348,8 +348,13 @@ SHUFFLE_DEVICE_SHRINK_THRESHOLD = conf_bytes(
 DOWNLOAD_SPECULATIVE_ROWS = conf_int(
     "spark.rapids.sql.collect.speculativeRows",
     "Row cap for single-round-trip result downloads while the row count "
-    "is still deferred; larger results pay one extra round trip.",
-    8192)
+    "is still deferred; larger results pay one extra round trip.  "
+    "Applies to the result-download path (the device->host plan "
+    "boundary and host-staged shuffle downloads); internal spill/"
+    "sampling downloads keep the built-in default.  Validated >= 1 at "
+    "set_conf.",
+    8192,
+    checker=lambda v: int(v) >= 1)
 
 CTE_REUSE_ENABLED = conf_bool(
     "spark.rapids.sql.cteReuse.enabled",
@@ -373,8 +378,10 @@ COLLECT_AGG_ENABLED = conf_bool(
 LIMIT_DEFERRED_FORCE_INTERVAL = conf_int(
     "spark.rapids.sql.limit.deferredForceInterval",
     "Deferred-count limit budget is forced to host every N batches so a "
-    "satisfied limit stops pulling its child (amortized early exit).",
-    8)
+    "satisfied limit stops pulling its child (amortized early exit).  "
+    "Validated >= 1 at set_conf.",
+    8,
+    checker=lambda v: int(v) >= 1)
 
 COLLECTIVE_EXCHANGE_ENABLED = conf_bool(
     "spark.rapids.shuffle.collective.enabled",
@@ -960,6 +967,79 @@ COLUMN_PRUNING_ENABLED = conf_bool(
     "in its logical optimizer; this engine plans physical trees directly). "
     "On TPU every pruned column is a host->device transfer avoided.",
     True)
+
+# ---------------------------------------------------------------------------
+# concurrent query serving (spark_rapids_tpu/serving)
+# ---------------------------------------------------------------------------
+
+SERVING_MAX_CONCURRENT = conf_int(
+    "spark.rapids.serving.maxConcurrentQueries",
+    "Queries the QueryServer executes concurrently; submissions past "
+    "this wait in the admission queue.  The per-query device working "
+    "sets still arbitrate through the shared pool + TpuSemaphore "
+    "budgets — this bounds QUERY-level concurrency, the semaphore "
+    "bounds TASK-level device concurrency.  Validated >= 1 at set_conf.",
+    4,
+    checker=lambda v: int(v) >= 1)
+
+SERVING_MEMORY_RESERVATION = conf_bytes(
+    "spark.rapids.serving.queryMemoryReservation",
+    "Device-pool bytes the admission controller reserves per admitted "
+    "query (Sparkle-style static memory partitioning of the shared "
+    "pool): a query is only admitted while the sum of reservations "
+    "fits the pool limit.  0 = pool limit / maxConcurrentQueries.  "
+    "Reservations are admission-time accounting, not allocations — the "
+    "arbiter still resolves real contention inside the pool.",
+    "0")
+
+SERVING_QUEUE_TIMEOUT_MS = conf_int(
+    "spark.rapids.serving.queueTimeoutMs",
+    "How long a submission may wait in the admission queue before "
+    "failing with AdmissionTimeout (a bounded queue sheds load instead "
+    "of stacking it).  Validated >= 1 at set_conf.",
+    60_000,
+    checker=lambda v: int(v) >= 1)
+
+SERVING_QUEUE_BACKOFF_MS = conf_int(
+    "spark.rapids.serving.queueBackoffMs",
+    "Initial re-check backoff for a queued submission; doubles up to "
+    "32x between admission re-checks (release notifications short-cut "
+    "the wait).  Validated >= 1 at set_conf.",
+    20,
+    checker=lambda v: int(v) >= 1)
+
+SERVING_PLAN_CACHE_MAX = conf_int(
+    "spark.rapids.serving.planCache.maxPlans",
+    "Physical plans the cross-query plan cache keeps (LRU).  Keyed by "
+    "the normalized plan structure — literal-promoted queries share an "
+    "entry and its compiled-executable set — plus the literal values; "
+    "an exact repeat skips planning AND compilation.  0 disables.",
+    64,
+    checker=lambda v: int(v) >= 0)
+
+SERVING_RESULT_CACHE_MAX_BYTES = conf_bytes(
+    "spark.rapids.serving.resultCache.maxBytes",
+    "In-memory budget for the deterministic query/CTE result cache "
+    "(keyed by exact plan signature + input-file fingerprints; any "
+    "file change invalidates).  Under pressure entries spill to an "
+    "on-disk arrow tier (resultCache.spill) bounded at 4x this.  "
+    "0 disables.",
+    "256m")
+
+SERVING_RESULT_CACHE_SPILL = conf_bool(
+    "spark.rapids.serving.resultCache.spill",
+    "Spill result-cache entries to an on-disk arrow tier instead of "
+    "dropping them when the in-memory budget is exceeded.",
+    True)
+
+SERVING_AUTOTUNE_ENABLED = conf_bool(
+    "spark.rapids.serving.autotune.enabled",
+    "Close the AutoTuner into an online loop: after each query the "
+    "server evaluates the rule set (tools/autotune.py) over the live "
+    "event stream + resourceSample feed and applies accepted conf "
+    "deltas (pipeline depth, concurrentGpuTasks, batch size) to the "
+    "NEXT admitted query, emitting an autotuneApplied event per delta.",
+    False)
 
 
 class TpuConf:

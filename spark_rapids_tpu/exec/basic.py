@@ -372,17 +372,22 @@ class CpuLimitExec(UnaryExec):
         return f"Limit[{self.n}]"
 
 
-#: conf-driven (spark.rapids.sql.limit.deferredForceInterval)
+#: default for spark.rapids.sql.limit.deferredForceInterval — the limit
+#: execs carry their convert-time conf value per instance
 LIMIT_DEFERRED_FORCE_INTERVAL = 8
 
 
-def _deferred_limited(batches, n: int):
+def _deferred_limited(batches, n: int, force_interval=None):
     """Limit over a batch stream with the remaining budget kept ON DEVICE
     while counts are deferred (forcing each batch's count would cost a
     tunnel sync per batch).  Amortized early exit: every
-    LIMIT_DEFERRED_FORCE_INTERVAL-th deferred batch forces the budget
-    once so a satisfied limit stops pulling the source."""
+    ``force_interval``-th (default LIMIT_DEFERRED_FORCE_INTERVAL)
+    deferred batch forces the budget once so a satisfied limit stops
+    pulling the source."""
     import numpy as _np
+
+    if force_interval is None:      # explicit sentinel: a conf value of
+        force_interval = LIMIT_DEFERRED_FORCE_INTERVAL   # 1 must stick
 
     from spark_rapids_tpu.columnar.column import (DeferredCount, _jnp,
                                                   rc_traceable)
@@ -421,12 +426,15 @@ def _deferred_limited(batches, n: int):
                 jnp.asarray(rc_traceable(out.row_count)), 0)
             yield out
             deferred_batches += 1
-            if deferred_batches % LIMIT_DEFERRED_FORCE_INTERVAL == 0:
+            if deferred_batches % force_interval == 0:
                 left = int(_np.asarray(left))
 
 
 class TpuLimitExec(UnaryExec):
     is_device = True
+
+    #: conf-at-convert-time (spark.rapids.sql.limit.deferredForceInterval)
+    deferred_force_interval = None
 
     def __init__(self, n: int, child: Exec):
         super().__init__(child)
@@ -434,7 +442,8 @@ class TpuLimitExec(UnaryExec):
 
     def execute_partition(self, pidx):
         yield from _deferred_limited(self.child.execute_partition(pidx),
-                                     self.n)
+                                     self.n,
+                                     self.deferred_force_interval)
 
     def node_desc(self):
         return f"TpuLimit[{self.n}]"
@@ -449,9 +458,19 @@ class CpuCteCacheExec(UnaryExec):
     copies are re-merged by the exchange-reuse pass keyed on ``origin``
     (plan/overrides.py reuse_exchanges)."""
 
+    #: execution epoch the next execution must rebuild for (stamped by
+    #: ``refresh_cte_epochs`` per prepared action); class-level 0 keeps
+    #: directly-driven test execs caching across calls
+    _expected_epoch = 0
+
     def __init__(self, child: Exec):
         super().__init__(child)
         self._cache = None
+        #: epoch the cached batches were materialized under — a cache
+        #: from a previous action / speculation replay / changed input
+        #: file set must never replay (it is only valid within the ONE
+        #: action whose epoch stamped it)
+        self._cache_epoch = None
         #: identity of the logical (analyzer-built) node — survives the
         #: shallow copies the rewrite passes make, letting reuse collapse
         #: converted copies back into one caching instance
@@ -459,12 +478,14 @@ class CpuCteCacheExec(UnaryExec):
 
     def execute_partition(self, pidx):
         from spark_rapids_tpu.plan.base import release_semaphore_for_wait
-        if self._cache is None:
+        if self._cache is None or self._cache_epoch != self._expected_epoch:
             release_semaphore_for_wait()
             with self._exec_lock:
-                if self._cache is None:
+                if self._cache is None or \
+                        self._cache_epoch != self._expected_epoch:
                     self._cache = [list(self.child.execute_partition(p))
                                    for p in range(self.child.num_partitions)]
+                    self._cache_epoch = self._expected_epoch
         yield from self._cache[pidx]
 
     def node_desc(self):
@@ -480,6 +501,23 @@ class TpuCteCacheExec(CpuCteCacheExec):
 
     def node_desc(self):
         return "TpuCteCache"
+
+
+def refresh_cte_epochs(plan: Exec) -> None:
+    """Arms every CTE cache in ``plan`` for ONE upcoming execution: a
+    fresh process-wide epoch is stamped on each node, so every reference
+    within the action shares the single materialization while batches
+    cached by a PREVIOUS action (a speculation replay in exact mode, a
+    re-executed plan-cache entry, inputs whose files changed) always
+    rebuild instead of replaying stale."""
+    from spark_rapids_tpu.plan.base import next_execution_epoch
+    nodes = [n for n in plan.collect_nodes()
+             if isinstance(n, CpuCteCacheExec)]
+    if not nodes:
+        return
+    epoch = next_execution_epoch()
+    for n in nodes:
+        n._expected_epoch = epoch
 
 
 class CpuGlobalLimitExec(UnaryExec):
@@ -527,11 +565,15 @@ class CpuGlobalLimitExec(UnaryExec):
 class TpuGlobalLimitExec(CpuGlobalLimitExec):
     is_device = True
 
+    #: conf-at-convert-time (spark.rapids.sql.limit.deferredForceInterval)
+    deferred_force_interval = None
+
     def execute_partition(self, pidx):
         def stream():
             for cp in range(self.child.num_partitions):
                 yield from self.child.execute_partition(cp)
-        yield from _deferred_limited(stream(), self.n)
+        yield from _deferred_limited(stream(), self.n,
+                                     self.deferred_force_interval)
 
     def node_desc(self):
         return f"TpuGlobalLimit[{self.n}]"
@@ -783,10 +825,16 @@ class DeviceToHostExec(UnaryExec):
 
     is_device = False
 
+    #: conf-at-plan-time speculative download row cap
+    #: (spark.rapids.sql.collect.speculativeRows); ``None`` falls back
+    #: to the transfer-module default.  Set by ``insert_transitions``
+    #: so per-query conf rides the plan instance
+    dl_spec_rows = None
+
     def execute_partition(self, pidx):
         with closing_source(self.child.execute_partition(pidx)) as it:
             for b in it:
-                yield b.to_host()
+                yield b.to_host(spec_rows=self.dl_spec_rows)
 
     def node_desc(self):
         return "DeviceToHost"

@@ -54,7 +54,9 @@ def _sample_bounds(part: RangePartitioning, sample_rows, to_host_batch):
     return cc(rows) if rows else HostColumnarBatch([], 0, [])
 
 
-#: conf-driven (plan/overrides.apply)
+#: defaults for the round-5 shuffle knobs; the convert-time conf values
+#: travel on each exchange INSTANCE (per-query conf must ride the plan,
+#: not the process — concurrent sessions share this module)
 SHRINK_THRESHOLD_BYTES = 64 << 20
 RANGE_BOUNDS_SAMPLE_ROWS = 1024
 COLLECTIVE_ENABLED = True
@@ -442,6 +444,16 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
     #: (MeshContext, sharded cols, per-device counts, schema)
     _collective = None
 
+    #: conf-at-convert-time knobs (spark.rapids.shuffle.device.
+    #: shrinkThresholdBytes / sql.rangeBounds.sampleRows /
+    #: shuffle.collective.enabled / sql.collect.speculativeRows);
+    #: ``None`` falls back to the module/transfer defaults so
+    #: directly-driven test execs keep working
+    shrink_threshold_bytes = None
+    range_bounds_sample_rows = None
+    collective_enabled = None
+    dl_spec_rows = None
+
     def _collective_eligible(self, part):
         """The mesh path covers hash shuffles whose reduce count equals the
         mesh size and whose columns ride the sharded layout (no nested
@@ -449,7 +461,8 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
         from spark_rapids_tpu import types as T
         from spark_rapids_tpu.parallel.mesh import active_mesh
         from spark_rapids_tpu.plan.partitioning import HashPartitioning
-        if not COLLECTIVE_ENABLED:
+        ce = self.collective_enabled
+        if not (COLLECTIVE_ENABLED if ce is None else ce):
             return None
         ctx = active_mesh()
         if ctx is None or not isinstance(part, HashPartitioning):
@@ -562,7 +575,9 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
         #: padding-shrink (shrink needs the exact count -> a ~185ms tunnel
         #: sync); below the threshold the compacts just keep the input
         #: bucket and counts stay deferred (sync-free map side)
-        shrink_threshold = SHRINK_THRESHOLD_BYTES
+        shrink_threshold = self.shrink_threshold_bytes \
+            if self.shrink_threshold_bytes is not None \
+            else SHRINK_THRESHOLD_BYTES
 
         def map_gen(mp):
             from spark_rapids_tpu.plan.base import closing_source
@@ -641,7 +656,7 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
         shuffled = gather_batch(b, perm, b.row_count)
         counts = np.asarray(jnp.bincount(
             jnp.clip(pids, 0, n), length=n + 1))[:n]
-        hb = shuffled.to_host()
+        hb = shuffled.to_host(spec_rows=self.dl_spec_rows)
         hb.names = b.names
         off = 0
         for p in range(n):
@@ -711,7 +726,9 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
                 # evenly spaced over the LIVE rows (a stride over the
                 # bucket would collapse to ~1 sample for a filtered batch
                 # whose count is far below its padding)
-                k = RANGE_BOUNDS_SAMPLE_ROWS
+                k = self.range_bounds_sample_rows \
+                    if self.range_bounds_sample_rows is not None \
+                    else RANGE_BOUNDS_SAMPLE_ROWS
                 rc_t = jnp.asarray(rc_traceable(b.row_count),
                                    dtype=np.int64)
                 j = jnp.arange(k, dtype=np.int64)
@@ -750,10 +767,24 @@ from spark_rapids_tpu.plan.overrides import register_exec  # noqa: E402
 
 from spark_rapids_tpu.plan import typechecks as _TS  # noqa: E402
 
+def _convert_exchange(p, m):
+    from spark_rapids_tpu import config as C
+    out = TpuShuffleExchangeExec(p.partitioning, p.children[0],
+                                 shuffle_env=p.shuffle_env)
+    # round-5 behavior knobs ride the INSTANCE (set from meta.conf at
+    # convert time) — concurrent sessions must not race module globals
+    out.shrink_threshold_bytes = C.parse_bytes(
+        m.conf.get(C.SHUFFLE_DEVICE_SHRINK_THRESHOLD.key))
+    out.range_bounds_sample_rows = int(
+        m.conf.get(C.RANGE_BOUNDS_SAMPLE_ROWS.key))
+    out.collective_enabled = bool(
+        m.conf.get(C.COLLECTIVE_EXCHANGE_ENABLED.key))
+    out.dl_spec_rows = int(m.conf.get(C.DOWNLOAD_SPECULATIVE_ROWS.key))
+    return out
+
+
 register_exec(CpuShuffleExchangeExec,
-              convert=lambda p, m: TpuShuffleExchangeExec(
-                  p.partitioning, p.children[0],
-                  shuffle_env=p.shuffle_env),
+              convert=_convert_exchange,
               sig=_TS.BASIC_WITH_ARRAYS,
               exprs_of=lambda p: list(p.partitioning.exprs),
               extra_tag=lambda m: _TS.no_array_keys(

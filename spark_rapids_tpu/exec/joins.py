@@ -38,10 +38,18 @@ from spark_rapids_tpu.plan.base import BinaryExec, Exec
 
 _PAIR_TYPES = (J.INNER, J.LEFT_OUTER, J.RIGHT_OUTER, J.FULL_OUTER, J.CROSS)
 
-#: conf-driven (spark.rapids.sql.join.buildSideSwap.*; set per plan
-#: compile by plan/overrides.apply)
+#: defaults for the build-side-swap knobs (spark.rapids.sql.join.
+#: buildSideSwap.*); the convert-time values travel on each join
+#: INSTANCE (conf must ride the plan, not the process — concurrent
+#: sessions with different confs share these modules)
 BUILD_SWAP_ENABLED = True
 BUILD_SWAP_MAX_BYTES = 256 << 20
+
+#: speculative-join verification headroom: candidate pairs are expanded
+#: and verified over ``probe_bucket * HEADROOM`` so collision/null
+#: candidates that verification rejects never flag overflow; the output
+#: table stays at the probe bucket (post-verify pairs truncate back)
+SPECULATIVE_PAIR_HEADROOM = 2
 
 
 from spark_rapids_tpu.columnar.column import known_empty as _known_empty
@@ -309,10 +317,31 @@ def _empty_device(schema: T.StructType) -> ColumnarBatch:
     return _empty_host(schema).to_device()
 
 
+def _chain_then_close(consumed, it):
+    """Replays already-sampled probe batches then continues the live
+    stream; closing this generator early closes the underlying stream
+    (the swap-sampling path must not strand a half-drained child)."""
+    from spark_rapids_tpu.plan.base import close_iter
+    try:
+        yield from consumed
+        yield from it
+    finally:
+        close_iter(it)
+
+
 class _TpuJoinCore(_JoinBase):
     """Streamed probe vs built side on device (see module docstring)."""
 
     is_device = True
+
+    #: conf-at-convert-time build-side-swap knobs
+    #: (spark.rapids.sql.join.buildSideSwap.*); ``None`` falls back to
+    #: the module defaults so directly-driven test execs keep working.
+    #: Instance-threaded on purpose: per-query conf travels with the
+    #: plan, never through process-global module state (concurrent
+    #: sessions with different confs share this module)
+    build_swap_enabled: Optional[bool] = None
+    build_swap_max_bytes: Optional[int] = None
 
     def _augment_keys(self, batch: ColumnarBatch, keys,
                       enc_keys=None) -> ColumnarBatch:
@@ -438,18 +467,28 @@ class _TpuJoinCore(_JoinBase):
                     [probe_aug.columns[i] for i in probe_ords], built)
                 spec = speculation.active()
                 if spec is not None:
-                    # optimistic pair table = probe bucket (exact for the
-                    # FK->PK joins that dominate star schemas: <=1 build
-                    # match per probe row); overflow checked at collect,
-                    # action replays in exact mode if it ever fired
+                    # optimistic OUTPUT table = probe bucket (exact for
+                    # the FK->PK joins that dominate star schemas: <=1
+                    # build match per probe row), but candidates are
+                    # expanded + verified over a HEADROOM window first:
+                    # hash-collision / null-key candidates that
+                    # verification rejects must not flag overflow (they
+                    # used to trigger a silent full-query exact replay).
+                    # Overflow is decided on the POST-VERIFY pair count
+                    # against the probe bucket (below, after compact);
+                    # only a candidate total beyond even the headroom
+                    # window — unverifiable without a sizing sync —
+                    # forces the replay directly
                     out_bucket = probe_aug.bucket
-                    spec.add(total > out_bucket)
+                    verify_bucket = out_bucket * SPECULATIVE_PAIR_HEADROOM
+                    spec.add(total > verify_bucket)
                 else:
                     total = int(total)       # the per-join sizing sync
                     out_bucket = J.bucket_rows(max(total, 1))
+                    verify_bucket = out_bucket
                 l_idx, r_idx, keep, pair_bucket = J._expand_verify(
                     probe_aug, probe_ords, built, self.null_safe, lo,
-                    offsets, total, out_bucket)
+                    offsets, total, verify_bucket)
             else:
                 l_idx, r_idx, keep, pair_bucket = J.cross_pairs(probe, build)
             probe_pay = probe
@@ -472,6 +511,21 @@ class _TpuJoinCore(_JoinBase):
                                            out_bucket=probe.bucket)
                 continue
             l, r, n = J.compact_pairs(l_idx, r_idx, keep)
+            if use_hash and spec is not None and pair_bucket > out_bucket:
+                # the post-verify overflow check: only REAL pairs (after
+                # key verification AND the non-equi condition) must fit
+                # the optimistic output bucket; the verified headroom
+                # window then truncates back so output batches keep the
+                # probe-bucket footprint
+                from spark_rapids_tpu.columnar.column import (
+                    DeferredCount as _DC, rc_traceable as _rt)
+                from spark_rapids_tpu.columnar.column import _jnp as _j
+                jnp = _j()
+                nt = jnp.asarray(_rt(n))
+                spec.add(nt > out_bucket)
+                l, r = l[:out_bucket], r[:out_bucket]
+                n = _DC(jnp.minimum(nt, out_bucket))
+                pair_bucket = out_bucket
             if jt in (J.LEFT_OUTER, J.FULL_OUTER):
                 flags = J.matched_flags(l_idx, keep, probe.bucket)
                 ul, un = J.unmatched_positions(flags, probe.row_count)
@@ -555,18 +609,35 @@ class TpuShuffledHashJoinExec(_TpuJoinCore):
         which would build on the FACT side in star queries — wrong both
         for memory and for the speculative pair sizing)."""
         bb = sum(b.nbytes() for b in build)
-        if BUILD_SWAP_ENABLED and self.join_type == J.INNER and \
+        if self.swap_enabled() and self.join_type == J.INNER and \
                 self.condition is None and \
-                self.left_keys and bb <= BUILD_SWAP_MAX_BYTES:
-            # comparing sides requires materializing the probe partition;
-            # bound that by only considering a swap when the build side is
-            # modest (an oversized build falls to sub-partitioning anyway)
-            probe = list(self.left.execute_partition(pidx))
-            pb = sum(b.nbytes() for b in probe)
-            if bb > pb:
-                return iter(build), probe, True
-            return iter(probe), build, False
+                self.left_keys and bb <= self.swap_max_bytes():
+            # first-batch sampling: probe batches are pulled only until
+            # their running bytes EXCEED the build side (probe provably
+            # bigger -> no swap) or the stream ends first (whole probe is
+            # smaller -> build on it).  Weighing the swap materializes at
+            # most ~build-side bytes (itself <= buildSideSwap.maxBytes),
+            # never the whole probe partition
+            it = self.left.execute_partition(pidx)
+            sampled = []
+            pb = 0
+            for b in it:
+                sampled.append(b)
+                pb += b.nbytes()
+                if pb > bb:
+                    break
+            if pb <= bb:      # stream drained: full probe is the smaller side
+                return iter(build), sampled, True
+            return _chain_then_close(sampled, it), build, False
         return self.left.execute_partition(pidx), build, False
+
+    def swap_enabled(self) -> bool:
+        bs = self.build_swap_enabled
+        return BUILD_SWAP_ENABLED if bs is None else bs
+
+    def swap_max_bytes(self) -> int:
+        mb = self.build_swap_max_bytes
+        return BUILD_SWAP_MAX_BYTES if mb is None else mb
 
     def execute_partition(self, pidx):
         _check_copartitioned(self)
@@ -687,6 +758,12 @@ def _convert_shuffled(p, m):
     out.subpartition_threshold = C.parse_bytes(
         m.conf.get(C.JOIN_SUBPARTITION_THRESHOLD.key))
     out.num_subpartitions = int(m.conf.get(C.JOIN_NUM_SUBPARTITIONS.key))
+    # round-5 behavior knobs ride the INSTANCE (set from meta.conf at
+    # convert time) — concurrent sessions must not race module globals
+    out.build_swap_enabled = bool(
+        m.conf.get(C.JOIN_BUILD_SWAP_ENABLED.key))
+    out.build_swap_max_bytes = C.parse_bytes(
+        m.conf.get(C.JOIN_BUILD_SWAP_MAX_BYTES.key))
     return out
 
 

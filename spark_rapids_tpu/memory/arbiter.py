@@ -76,6 +76,10 @@ class TaskState(enum.Enum):
     BLOCKED_ON_ALLOC = "blocked_on_alloc"
     BLOCKED_ON_SEMAPHORE = "blocked_on_semaphore"
     BLOCKED_ON_SPOOL = "blocked_on_spool"
+    #: serving-layer admission queue (QueryServer) — deliberately NOT a
+    #: deadlock-relevant blocked state: a query waiting for admission is
+    #: waiting on OTHER queries finishing, which needs no victim
+    BLOCKED_ON_ADMISSION = "blocked_on_admission"
     BUFN = "bufn"
 
 
@@ -172,6 +176,12 @@ class ResourceArbiter:
         self.forced_retries = 0
         self.tasks_cancelled = 0
         self.watchdog_dumps = 0
+        #: serving-layer view: query_id -> (state, reserved_bytes,
+        #: since).  Rides the registry so ``stats()``/``dump()`` show
+        #: admission-queued queries next to the task threads, but never
+        #: participates in deadlock victim selection (its own dict, not
+        #: ``_tasks``)
+        self._serving: Dict[int, tuple] = {}
 
     # -- registration --------------------------------------------------------
     def register_task(self, task_id: Optional[int]) -> None:
@@ -675,12 +685,37 @@ class ResourceArbiter:
                     out.append((e.task_id, idle))
         return out
 
+    # -- serving-layer view (QueryServer admission) --------------------------
+    def note_serving(self, query_id: int, state: TaskState,
+                     reserved_bytes: int = 0) -> None:
+        """Registers/updates one served query's admission state (the
+        QueryServer calls this around its admission waits)."""
+        with self._cond:
+            self._serving[query_id] = (state, int(reserved_bytes),
+                                       time.monotonic())
+
+    def drop_serving(self, query_id: int) -> None:
+        with self._cond:
+            self._serving.pop(query_id, None)
+
+    def serving_view(self) -> Dict[int, dict]:
+        with self._cond:
+            now = time.monotonic()
+            return {qid: {"state": st.value, "reserved_bytes": rb,
+                          "age_s": now - since}
+                    for qid, (st, rb, since) in self._serving.items()}
+
     def stats(self) -> dict:
         with self._cond:
             blocked = sum(
                 1 for e in self._tasks.values()
                 for s in e.threads.values() if s.state in _BLOCKED_STATES)
+            serving_queued = sum(
+                1 for st, _, _ in self._serving.values()
+                if st is TaskState.BLOCKED_ON_ADMISSION)
             return {
+                "serving_queries": len(self._serving),
+                "serving_queued": serving_queued,
                 "tasks": len(self._tasks),
                 "threads": sum(len(e.threads)
                                for e in self._tasks.values()),
@@ -707,6 +742,10 @@ class ResourceArbiter:
                         now - e.last_progress, list(e.threads.values()))
                        for e in self._tasks.values()]
         lines.append(f"== arbiter: {len(entries)} task(s) ==")
+        for qid, info in sorted(self.serving_view().items()):
+            lines.append(f"serving query {qid} state={info['state']} "
+                         f"reserved={info['reserved_bytes']}B "
+                         f"for {info['age_s']:.1f}s")
         for tid, held, bufn, cancelled, idle, slots in entries:
             flags = "".join(f for f, on in
                             (("D", held), ("B", bufn), ("C", cancelled))
@@ -733,6 +772,7 @@ class ResourceArbiter:
         with self._cond:
             self._tasks.clear()
             self._bufn_tasks.clear()
+            self._serving.clear()
             self._cond.notify_all()
 
 

@@ -123,6 +123,24 @@ class TpuSemaphore:
         with self._cond:
             return task_id in self._holders
 
+    def resize(self, new_max: int) -> int:
+        """Online permit-budget adjustment (the serving AutoTuner loop
+        applies ``spark.rapids.sql.concurrentGpuTasks`` deltas between
+        queries).  Growing wakes waiters immediately; shrinking lets
+        permits go transiently negative and takes effect as holders
+        release — a held permit is never revoked.  Returns the old
+        budget."""
+        new_max = max(1, int(new_max))
+        with self._cond:
+            old = self.max_concurrent
+            if new_max == old:
+                return old
+            self._permits += new_max - old
+            self.max_concurrent = new_max
+            if new_max > old:
+                self._cond.notify_all()
+        return old
+
     def stats(self) -> dict:
         """Read-only snapshot for the resource sampler: permit budget,
         current holders and threads queued on admission."""

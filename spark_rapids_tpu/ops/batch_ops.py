@@ -28,6 +28,13 @@ def _jx():
     return _jnp()
 
 
+#: deferred-concat padding guard (ADVICE r5): above this summed padded
+#: input footprint, ``concat_batches`` forces the counts (one batched
+#: sync) and sizes the output from live rows instead of next-pow2 of the
+#: summed padded buckets
+CONCAT_FORCE_SYNC_BYTES = 64 << 20
+
+
 
 
 def _col_sig(c: DeviceColumn) -> Tuple:
@@ -285,8 +292,22 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
                                                     rewrap_like)
     batches = align_batches(batches, site="concat")
     jnp = _jx()
-    if any(isinstance(b.row_count, DeferredCount) and not b.row_count.is_forced
-           for b in batches):
+    deferred_in = any(
+        isinstance(b.row_count, DeferredCount) and not b.row_count.is_forced
+        for b in batches)
+    if deferred_in and \
+            sum(b.nbytes() for b in batches) > CONCAT_FORCE_SYNC_BYTES:
+        # padding guard: sizing by next-pow2 of SUMMED padded buckets can
+        # allocate far past the live rows (every input carries its own
+        # pow2 padding; mostly-filtered batches are nearly all padding).
+        # Past this footprint one batched count sync is cheaper than the
+        # OOM risk — force the counts, size from the REAL total below,
+        # and drop each oversized input's padding first
+        from spark_rapids_tpu.columnar.column import force_counts
+        force_counts([b.row_count for b in batches])
+        batches = [shrink_batch(b) for b in batches]
+        deferred_in = False
+    if deferred_in:
         # deferred inputs: size by the (static) bucket sum — a host sync
         # per concat costs a ~185ms tunnel round trip; the scatter kernel
         # masks by traced counts either way, so a roomier bucket only pads

@@ -30,6 +30,16 @@ _task_parallelism = 0
 #: independent plans execute concurrently (semaphore/metrics key on this)
 _task_ids = itertools.count(1)
 
+#: monotone execution-epoch source: every prepared action (and every
+#: speculation replay / plan-cache re-execution) draws a fresh epoch and
+#: stamps it onto the plan's per-execution caches (CTE materialization),
+#: so batches cached by a previous action are never replayed stale
+_execution_epochs = itertools.count(1)
+
+
+def next_execution_epoch() -> int:
+    return next(_execution_epochs)
+
 
 def set_task_parallelism(n: int) -> None:
     global _task_parallelism
@@ -226,13 +236,18 @@ class Exec:
             lambda p: run_task(self, p), self.num_partitions)
 
     def collect_host(self) -> HostColumnarBatch:
-        """Gathers every partition to one host batch (driver collect)."""
+        """Gathers every partition to one host batch (driver collect).
+        ``dl_spec_rows`` is stamped on the executed root by
+        ``TpuOverrides.apply`` (spark.rapids.sql.collect.speculativeRows)
+        so a fully-device plan — no DeviceToHost boundary above it —
+        still honors the conf on this final download."""
         from spark_rapids_tpu.columnar.batch import (batch_from_pydict,
                                                      concat_host_batches)
+        spec_rows = getattr(self, "dl_spec_rows", None)
         out = []
         for b in self.execute_all():
             if isinstance(b, ColumnarBatch):
-                b = b.to_host()
+                b = b.to_host(spec_rows=spec_rows)
             out.append(b)
         if not out:
             import pyarrow as pa

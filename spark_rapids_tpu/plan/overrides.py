@@ -270,8 +270,16 @@ def _register_basic_execs():
                   convert=lambda p, m: X.TpuInMemoryScanExec(p),
                   sig=TS.BASIC_WITH_ARRAYS,
                   desc="in-memory scan")
+    def _limit_conf(out, m):
+        # round-5 knob rides the instance (set from meta.conf at convert
+        # time): per-query conf travels with the plan, not the process
+        out.deferred_force_interval = int(
+            m.conf.get(C.LIMIT_DEFERRED_FORCE_INTERVAL.key))
+        return out
+
     register_exec(X.CpuLimitExec,
-                  convert=lambda p, m: X.TpuLimitExec(p.n, p.children[0]),
+                  convert=lambda p, m: _limit_conf(
+                      X.TpuLimitExec(p.n, p.children[0]), m),
                   sig=TS.BASIC_WITH_ARRAYS,
                   desc="limit")
     register_exec(X.CpuCteCacheExec,
@@ -285,8 +293,8 @@ def _register_basic_execs():
                   sig=TS.BASIC_WITH_ARRAYS,
                   desc="shuffle-free partition merge")
     register_exec(X.CpuGlobalLimitExec,
-                  convert=lambda p, m: X.TpuGlobalLimitExec(p.n,
-                                                            p.children[0]),
+                  convert=lambda p, m: _limit_conf(
+                      X.TpuGlobalLimitExec(p.n, p.children[0]), m),
                   sig=TS.BASIC_WITH_ARRAYS,
                   desc="global limit")
     register_exec(X.CpuUnionExec,
@@ -313,6 +321,7 @@ def insert_transitions(plan: Exec, conf: TpuConf) -> Exec:
     from spark_rapids_tpu.exec.basic import (DeviceToHostExec,
                                              HostToDeviceExec,
                                              TpuCoalesceBatchesExec)
+    dl_spec_rows = int(conf.get(C.DOWNLOAD_SPECULATIVE_ROWS.key))
 
     def fix(node: Exec) -> Exec:
         new_children = []
@@ -321,6 +330,8 @@ def insert_transitions(plan: Exec, conf: TpuConf) -> Exec:
                 c = HostToDeviceExec(c)
             elif not node.is_device and c.is_device:
                 c = DeviceToHostExec(c)
+                # per-query conf rides the boundary instance
+                c.dl_spec_rows = dl_spec_rows
             new_children.append(c)
         return node.with_children(new_children)
 
@@ -532,22 +543,13 @@ class TpuOverrides:
         _WI.FORCE_BOUNDED_WINDOW = conf.get(C.FORCE_BOUNDED_WINDOW.key)
         _WI.BOUNDED_WINDOW_MAX_SPAN = conf.get(
             C.BOUNDED_WINDOW_MAX_SPAN.key)
-        # round-5 behavior knobs ride the same module-global pattern
-        import spark_rapids_tpu.columnar.transfer as _TR
-        import spark_rapids_tpu.exec.basic as _XB2
-        import spark_rapids_tpu.exec.exchange as _XC
-        import spark_rapids_tpu.exec.joins as _XJ
-        _XJ.BUILD_SWAP_ENABLED = conf.get(C.JOIN_BUILD_SWAP_ENABLED.key)
-        _XJ.BUILD_SWAP_MAX_BYTES = C.parse_bytes(
-            conf.get(C.JOIN_BUILD_SWAP_MAX_BYTES.key))
-        _XC.SHRINK_THRESHOLD_BYTES = C.parse_bytes(
-            conf.get(C.SHUFFLE_DEVICE_SHRINK_THRESHOLD.key))
-        _XC.RANGE_BOUNDS_SAMPLE_ROWS = conf.get(
-            C.RANGE_BOUNDS_SAMPLE_ROWS.key)
-        _XC.COLLECTIVE_ENABLED = conf.get(C.COLLECTIVE_EXCHANGE_ENABLED.key)
-        _TR._DL_SPEC_ROWS = conf.get(C.DOWNLOAD_SPECULATIVE_ROWS.key)
-        _XB2.LIMIT_DEFERRED_FORCE_INTERVAL = conf.get(
-            C.LIMIT_DEFERRED_FORCE_INTERVAL.key)
+        # (the round-5 behavior knobs — build-side swap, shuffle shrink
+        # threshold, range-bounds sample rows, collective enable, D2H
+        # speculative rows, limit force interval — ride plan/exec
+        # INSTANCES set from meta.conf at convert/transition time, never
+        # module globals: per-query conf must travel with the plan so
+        # concurrent sessions with different confs don't race.  The
+        # conf-module-global lint rule pins the remaining legacy set.)
         # pipelined-execution knobs (exec/pipeline.py spools + the
         # shuffle-read next-partition warm in exec/exchange.py)
         import spark_rapids_tpu.exec.pipeline as _PL
@@ -604,6 +606,9 @@ class TpuOverrides:
             plan = prune_columns(plan,
                                  strict=conf.get(C.TEST_ENABLED.key, False))
         if not conf.is_sql_enabled:
+            if not for_explain:
+                from spark_rapids_tpu.exec.basic import refresh_cte_epochs
+                refresh_cte_epochs(plan)
             return plan
         # partition-aware planning: delete exchanges whose child already
         # delivers the required distribution (co-partitioned joins /
@@ -636,6 +641,9 @@ class TpuOverrides:
                 log.info("TPU plan overview:\n%s", text)
         if conf.is_explain_only:
             # plan and log only; execute entirely on CPU
+            if not for_explain:
+                from spark_rapids_tpu.exec.basic import refresh_cte_epochs
+                refresh_cte_epochs(plan)
             return plan
         out = insert_transitions(converted, conf)
         out = self._coalesce_after_device_sources(out)
@@ -680,6 +688,19 @@ class TpuOverrides:
             # passes establish; observes + emits, never raises
             from spark_rapids_tpu.plan.verify import verify_plan
             verify_plan(out, conf)
+        if not for_explain:
+            # arm every CTE materialization cache for ONE execution: a
+            # fresh epoch per prepared action means batches cached by a
+            # previous action / speculation replay never replay stale
+            # (the serving plan cache re-arms its cached plans the same
+            # way before each re-execution)
+            from spark_rapids_tpu.exec.basic import refresh_cte_epochs
+            refresh_cte_epochs(out)
+        # a fully-device plan has no DeviceToHost boundary: the final
+        # download happens in collect_host on the ROOT, which reads this
+        # instance knob (same conf insert_transitions threads onto D2H
+        # boundaries)
+        out.dl_spec_rows = int(conf.get(C.DOWNLOAD_SPECULATIVE_ROWS.key))
         if not for_explain:
             # never on the explain path: instrument_plan resets the shared
             # per-node counters, and introspection must not zero the

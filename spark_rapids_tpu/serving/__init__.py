@@ -1,0 +1,27 @@
+"""Multi-tenant query serving (ROADMAP item 4).
+
+"Millions of users" means many small concurrent queries, not one big
+one.  This package is the session-server layer over the engine:
+
+- :mod:`spark_rapids_tpu.serving.server` — ``QueryServer``: admits N
+  concurrent queries against the shared device pool + ``TpuSemaphore``
+  budgets (admission controller with per-query memory reservations and
+  a bounded queue with timeout/backoff, surfaced through the PR 7
+  arbiter registry), executes them on a worker pool, and closes the
+  PR 5 AutoTuner into an ONLINE loop (accepted conf deltas apply to the
+  next admitted query).
+- :mod:`spark_rapids_tpu.serving.signature` — normalized structural
+  plan signatures + input-file fingerprints, the cache vocabulary.
+- :mod:`spark_rapids_tpu.serving.caches` — the two cross-query caches:
+  optimized-plan -> physical plan (+ its compiled-executable set, shared
+  through the PR 8 stage compiler), and deterministic query/CTE ->
+  result batches, both invalidated on input-file change and bounded /
+  spillable under pressure.
+
+Reference analogs: Spark's ThriftServer session layer + Sparkle's
+memory-partitioning analysis for the admission split (PAPERS.md), and
+Flare's compiled-query reuse extended from executables to whole plans.
+"""
+
+from spark_rapids_tpu.serving.server import (AdmissionTimeout,  # noqa: F401
+                                             QueryServer)

@@ -1,0 +1,232 @@
+"""Normalized structural plan signatures — the serving caches' vocabulary.
+
+A signature captures ALL result-affecting state of a CPU (pre-rewrite)
+plan tree, split into two parts:
+
+- the **normalized structure**: node classes, their result-affecting
+  attributes, and every expression rendered with literal VALUES scrubbed
+  to typed slots (the same normalization the PR 8 literal promotion and
+  the PR 12 audit ``norm_sig`` apply) — so ``d_year = 1998`` and
+  ``= 1999`` share one structure;
+- the **literal values**, in scrub order — the exact-identity remainder.
+
+Two queries with equal structures share one plan-cache ENTRY; equal
+structures AND equal literal values are the same query (full hit: the
+cached physical plan — and its compiled-executable set — re-executes
+with zero planning and zero traces).
+
+DEFAULT-DENY: a node whose ``__dict__`` carries state the canonicalizer
+does not understand (callables — python UDFs, pandas fns — or foreign
+objects) makes the whole plan unsigned (``None``), which simply disables
+caching for it; being uncacheable is always correct, being wrongly
+merged never is.  This mirrors ``plan/overrides._reuse_node_key``'s
+posture, widened from exchanges to whole plans.
+
+File inputs are NOT part of the structure: :func:`plan_fingerprints`
+collects ``(path, mtime, size)`` per scanned file, and the caches compare
+fingerprints at lookup — a changed file invalidates instead of silently
+serving stale results.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import List, Optional, Tuple
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions.base import Expression, Literal
+from spark_rapids_tpu.plan.base import Exec
+
+
+class _Unsigned(Exception):
+    """Raised when a plan carries state the signature cannot capture."""
+
+
+class _SlotLiteral(Literal):
+    """Scrubbed literal: renders a typed slot so the structure is
+    value-independent; the value itself moves to the signature's
+    ``lit_values``."""
+
+    def __init__(self, slot: int, dtype):
+        super().__init__(None, dtype)
+        self.slot = slot
+
+    def sql(self):
+        return f"$sig{self.slot}:{self._dtype}"
+
+
+def _scrub_expr(e: Expression, lits: List[str]) -> Expression:
+    """Copy of ``e`` with every literal swapped for a slot; values append
+    to ``lits`` in walk order."""
+    if isinstance(e, Literal) and not isinstance(e, _SlotLiteral):
+        lits.append(f"{e.value!r}:{e.data_type}")
+        return _SlotLiteral(len(lits) - 1, e.data_type)
+    if not e.children:
+        return e
+    return e.with_children([_scrub_expr(c, lits) for c in e.children])
+
+
+#: node attributes that never affect results (or are captured through
+#: the child structure / identity keys instead)
+_IGNORED_ATTRS = frozenset({
+    "children", "shuffle_env", "origin", "metrics", "predicate_pushed",
+})
+
+
+def _canon(v, lits: List[str]):
+    """Canonical hashable form of one node attribute; raises
+    :class:`_Unsigned` for anything it cannot prove result-neutral."""
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        return v
+    if isinstance(v, Expression):
+        return ("E", _scrub_expr(v, lits).sql())
+    if isinstance(v, T.StructType):
+        return ("T", str(v))
+    if isinstance(v, T.DataType):
+        return ("t", str(v))
+    if isinstance(v, (list, tuple)):
+        return tuple(_canon(x, lits) for x in v)
+    if isinstance(v, frozenset):
+        return ("fs",) + tuple(sorted(repr(_canon(x, lits)) for x in v))
+    if isinstance(v, dict):
+        return ("D",) + tuple(sorted(
+            (str(k), _canon(x, lits)) for k, x in v.items()))
+    # sort specs / window specs / partitionings: structured holders whose
+    # result-affecting state is (class name + their public attributes)
+    d = getattr(v, "__dict__", None)
+    if d is not None and not callable(v):
+        items = []
+        for k in sorted(d):
+            if k.startswith("_") or k in _IGNORED_ATTRS:
+                continue
+            items.append((k, _canon(d[k], lits)))
+        # specs hide state behind properties too (SortSpec.ascending is
+        # a plain attr; effective_nulls_first is derived) — the public
+        # attrs above cover the constructor inputs
+        return ("O", type(v).__name__, tuple(items))
+    raise _Unsigned(f"{type(v).__name__} attribute is not signable")
+
+
+def _node_signature(node: Exec, lits: List[str]) -> Tuple:
+    from spark_rapids_tpu.exec.basic import CpuInMemoryScanExec
+    from spark_rapids_tpu.io.multifile import MultiFileScanBase
+    if isinstance(node, CpuInMemoryScanExec):
+        # the device-column cache is shared by every plan over one source
+        # DataFrame and distinct across sources: identity IS the data
+        return ("mem", id(node._dev_cache), tuple(node.col_indices or ()),
+                str(node._schema))
+    if isinstance(node, MultiFileScanBase):
+        pred = getattr(node, "predicate", None)
+        return ("file", type(node).__name__,
+                tuple(str(p) for p in node.paths),
+                tuple(node.columns or ()) if hasattr(node, "columns")
+                else (),
+                None if pred is None else _scrub_expr(pred, lits).sql(),
+                node._scan_cache_extra())
+    items = []
+    for k in sorted(node.__dict__):
+        if k.startswith("_") or k in _IGNORED_ATTRS:
+            continue
+        items.append((k, _canon(node.__dict__[k], lits)))
+    return (type(node).__name__, tuple(items))
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanSignature:
+    """(normalized structure digest, literal values).  ``norm`` is the
+    sha1 of the full structural tuple; ``lit_values`` the scrubbed
+    literal reprs in walk order."""
+    norm: str
+    lit_values: Tuple[str, ...]
+
+    @property
+    def exact(self) -> str:
+        h = hashlib.sha1(self.norm.encode())
+        for v in self.lit_values:
+            h.update(b"\x00")
+            h.update(v.encode())
+        return h.hexdigest()
+
+
+def plan_signature(plan: Exec) -> Optional[PlanSignature]:
+    """Signature of a CPU plan tree, or ``None`` when any node carries
+    unsignable state (python UDFs, foreign objects) — such plans simply
+    bypass the caches."""
+    lits: List[str] = []
+
+    def walk(node: Exec) -> Tuple:
+        return (_node_signature(node, lits),
+                tuple(walk(c) for c in node.children))
+
+    try:
+        struct = walk(plan)
+    except _Unsigned:
+        return None
+    norm = hashlib.sha1(repr(struct).encode()).hexdigest()
+    return PlanSignature(norm, tuple(lits))
+
+
+def plan_pins(plan: Exec) -> Tuple:
+    """The objects whose IDENTITY the signature keys on (in-memory scan
+    device caches): a result-cache entry must hold strong references to
+    them, or a freed dict's recycled address could collide with a new
+    table's and serve stale rows.  (The plan cache self-pins: its
+    entries retain the physical plan, which references the scans.)"""
+    from spark_rapids_tpu.exec.basic import CpuInMemoryScanExec
+    return tuple(n._dev_cache for n in plan.collect_nodes()
+                 if isinstance(n, CpuInMemoryScanExec))
+
+
+def plan_fingerprints(plan: Exec) -> Tuple[Tuple[str, float, int], ...]:
+    """(path, mtime, size) for every file any scan in ``plan`` reads —
+    the caches' invalidation evidence.  Missing files fingerprint as
+    (path, 0, -1) so a deleted input invalidates too."""
+    import os
+    from spark_rapids_tpu.io.multifile import MultiFileScanBase
+    out = []
+    for node in plan.collect_nodes():
+        if isinstance(node, MultiFileScanBase):
+            for p in node.paths:
+                try:
+                    st = os.stat(p)
+                    out.append((str(p), st.st_mtime, st.st_size))
+                except OSError:
+                    out.append((str(p), 0.0, -1))
+    return tuple(sorted(set(out)))
+
+
+def conf_digest(conf) -> str:
+    """Digest of the plan-affecting conf: the non-default entries minus
+    the serving layer's own knobs and the event-log destination (neither
+    changes what a plan computes).  Part of every plan-cache key — an
+    online autotune delta (pipeline depth, batch size) legitimately
+    changes the plans the overrides produce, so it must re-plan, never
+    serve a stale shape.
+
+    Values canonicalize through each entry's registered converter:
+    ``TpuConf.set`` stores PARSED values while untouched defaults stay
+    raw strings ('1g' vs 1073741824), and a digest that saw those as
+    different would spuriously re-plan after every unrelated set_conf."""
+    from spark_rapids_tpu import config as C
+
+    def canon(entry, v):
+        try:
+            return repr(entry.converter(v) if isinstance(v, str) else v)
+        except Exception:   # noqa: BLE001 - unparseable -> raw identity
+            return repr(v)
+
+    items = []
+    for key, entry in C.registry().items():
+        if key.startswith(("spark.rapids.serving.",
+                           "spark.rapids.sql.eventLog.")):
+            continue
+        try:
+            v = conf.get(key)
+        except Exception:   # noqa: BLE001 - a digest must never fail
+            continue
+        cv = canon(entry, v)
+        if cv != canon(entry, entry.default):
+            items.append((key, cv))
+    items.sort()
+    return hashlib.sha1(repr(items).encode()).hexdigest()

@@ -277,6 +277,44 @@ def _to_expr(e) -> Expression:
     return lit(e)
 
 
+def rows_from_host_batch(batch) -> List[dict]:
+    """List-of-dict rows from a HostColumnarBatch — THE collect row
+    shape, shared by ``DataFrame.collect`` and the serving layer's
+    ``Submission.result`` so served rows can never drift from
+    DataFrame rows."""
+    d = batch.to_pydict()
+    names = list(d.keys())
+    return [dict(zip(names, row)) for row in zip(*d.values())] \
+        if names else []
+
+
+def collect_with_speculation(conf, plan_factory) -> HostColumnarBatch:
+    """THE speculative-sizing collect discipline, shared by DataFrame
+    actions and the serving layer: run under a speculation scope, check
+    every overflow flag with one sync, and replay the whole action in
+    exact mode if any fired.  ``plan_factory()`` returns the prepared
+    physical plan — called again for the replay so the factory can
+    re-arm per-execution state (CTE epochs) or re-plan."""
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.ops.speculation import (SpeculationOverflow,
+                                                  no_speculation,
+                                                  speculation_scope)
+    if not conf.get(C.SPECULATIVE_SIZING_ENABLED.key):
+        with no_speculation():
+            return plan_factory().collect_host()
+    try:
+        with speculation_scope() as ctx:
+            out = plan_factory().collect_host()
+            if ctx is not None:
+                ctx.check()   # one sync over every overflow flag
+            return out
+    except SpeculationOverflow:
+        # a speculative output bucket was too small somewhere: replay
+        # the whole action with exact (sync-per-decision) sizing
+        with no_speculation():
+            return plan_factory().collect_host()
+
+
 class DataFrame:
     """Lazy plan builder over CPU physical execs; actions run the rewrite."""
 
@@ -750,24 +788,8 @@ class DataFrame:
             return self._collect_batch_traced()
 
     def _collect_batch_traced(self) -> HostColumnarBatch:
-        from spark_rapids_tpu import config as C
-        from spark_rapids_tpu.ops.speculation import (SpeculationOverflow,
-                                                      no_speculation,
-                                                      speculation_scope)
-        if not self._session.conf.get(C.SPECULATIVE_SIZING_ENABLED.key):
-            with no_speculation():
-                return self._executed_plan().collect_host()
-        try:
-            with speculation_scope() as ctx:
-                out = self._executed_plan().collect_host()
-                if ctx is not None:
-                    ctx.check()   # one sync over every overflow flag
-                return out
-        except SpeculationOverflow:
-            # a speculative output bucket was too small somewhere: replay
-            # the whole action with exact (sync-per-decision) sizing
-            with no_speculation():
-                return self._executed_plan().collect_host()
+        return collect_with_speculation(self._session.conf,
+                                        self._executed_plan)
 
     def to_pydict(self) -> Dict[str, list]:
         return self.collect_batch().to_pydict()
@@ -780,10 +802,7 @@ class DataFrame:
         return self.to_arrow().to_pandas()
 
     def collect(self) -> List[dict]:
-        d = self.to_pydict()
-        names = list(d.keys())
-        return [dict(zip(names, row)) for row in zip(*d.values())] \
-            if names else []
+        return rows_from_host_batch(self.collect_batch())
 
     def count(self) -> int:
         from spark_rapids_tpu.aux import events as EV

@@ -1003,11 +1003,16 @@ class Analyzer:
         bound = self._expr_generic(c, lower, scope)
         return state["plan"], bound
 
-    _marker_n = 0
-
     def _next_marker(self) -> str:
-        Analyzer._marker_n += 1
-        return f"_exists{Analyzer._marker_n}"
+        # Per-instance (one Analyzer per sql() call): re-parsing the same
+        # SQL must yield the same marker names, or the serving layer's
+        # normalized plan signatures differ across parses and identical
+        # queries miss the plan cache.  Markers only disambiguate
+        # subqueries WITHIN one query — they bind positionally and the
+        # final projection drops them, so cross-parse uniqueness is not
+        # needed.
+        self._marker_n = getattr(self, "_marker_n", 0) + 1
+        return f"_exists{self._marker_n}"
 
     def _correlation_split(self, sub: A.Select, inner_scope: Scope,
                            outer_scope: Scope):

@@ -823,6 +823,98 @@ class LockOrderRule(Rule):
                             f"canonical order {'<'.join(order)}")
 
 
+class ConfModuleGlobalRule(Rule):
+    """PR 15 prerequisite: per-query conf must travel WITH the plan.  A
+    conf value copied into a module global at plan time is process-wide
+    state — two concurrent sessions planning with different confs race
+    each other's values, which the serving layer (N admitted queries at
+    once) turns from a theoretical hazard into a daily one."""
+
+    id = "conf-module-global"
+    invariant = ("no NEW conf-driven module-global assignments: a conf "
+                 "value read at plan time rides the converted plan/exec "
+                 "instance (or a call argument), never a module "
+                 "attribute")
+    rationale = ("module globals are shared by every session in the "
+                 "process; concurrent queries with different confs "
+                 "(admission-time autotune deltas, per-tenant settings) "
+                 "would race each other's behavior knobs")
+    hint = ("set the value on the converted exec instance at convert "
+            "time (see exec/joins.py build_swap_* or exec/exchange.py "
+            "shrink_threshold_bytes) or thread it as an argument; "
+            "'# lint: ok=conf-module-global' is reserved for the frozen "
+            "legacy set below")
+
+    #: the pre-PR-15 legacy assignments in plan/overrides.apply — this
+    #: set may only SHRINK (migrate a knob onto its instances, then
+    #: delete its name here); adding a name defeats the rule
+    LEGACY = frozenset({
+        "FORCE_REPARTITION_BELOW_DEPTH", "FORCE_OUT_OF_CORE_SORT",
+        "FORCE_RUNNING_WINDOW", "FORCE_BOUNDED_WINDOW",
+        "BOUNDED_WINDOW_MAX_SPAN", "PIPELINE_ENABLED", "PIPELINE_DEPTH",
+        "PIPELINE_MAX_BYTES", "ARBITRATION_ENABLED", "MAX_BLOCK_MS",
+        "ASYNC_COMPILE", "AUDIT_LEDGER", "LITERAL_PROMOTION",
+        "ENCODING_ENABLED", "LATE_MATERIALIZATION",
+        "MAX_DICTIONARY_SIZE", "RLE_ENABLED", "SPILL_CODEC",
+    })
+
+    @staticmethod
+    def _module_aliases(pf: ParsedFile) -> Set[str]:
+        """Names bound to modules in this file (``import m``,
+        ``import a.b as m`` — and ``from pkg import mod`` heuristically:
+        lowercase names from a package import)."""
+        out: Set[str] = set()
+        for node in pf.nodes:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    out.add(a.asname or a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    name = a.asname or a.name
+                    if name.islower():
+                        out.add(name)
+        return out
+
+    @staticmethod
+    def _conf_derived(value: ast.AST) -> bool:
+        """The assigned expression reads a conf (conf.get / m.conf.get /
+        a bare ``conf`` name feeding a converter)."""
+        for n in ast.walk(value):
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr == "get":
+                d = _dotted(n.func.value)
+                if d == "conf" or d.endswith(".conf"):
+                    return True
+            if isinstance(n, ast.Name) and n.id == "conf":
+                return True
+        return False
+
+    def check_file(self, ctx: LintContext, pf: ParsedFile) -> None:
+        aliases = None
+        for node in pf.nodes:
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            t = node.targets[0]
+            if not (isinstance(t, ast.Attribute) and
+                    isinstance(t.value, ast.Name)):
+                continue
+            if not t.attr.isupper():
+                continue        # instance/field assignment, not a knob
+            if not self._conf_derived(node.value):
+                continue
+            if aliases is None:
+                aliases = self._module_aliases(pf)
+            if t.value.id not in aliases:
+                continue        # attribute on an object, not a module
+            if t.attr in self.LEGACY and pf.rel == "plan/overrides.py":
+                continue
+            self.report(ctx, pf.rel, node.lineno,
+                        f"conf-driven module global "
+                        f"{t.value.id}.{t.attr}: per-query conf must "
+                        "ride the plan instance, not process state")
+
+
 def default_rules() -> List[Rule]:
     """Fresh rule instances (rules keep per-run state)."""
     return [
@@ -837,4 +929,5 @@ def default_rules() -> List[Rule]:
         EncodedMaterializeRule(),
         CollectiveSiteRule(),
         LockOrderRule(),
+        ConfModuleGlobalRule(),
     ]

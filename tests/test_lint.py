@@ -542,7 +542,8 @@ def test_json_schema(tmp_path):
     assert {r["id"] for r in d["rules"]} == {
         "jit-site", "aot-site", "conf-registry", "event-catalog",
         "traced-purity", "spillable-close", "fault-point", "retry-frame",
-        "encoded-materialize", "collective-site", "lock-order"}
+        "encoded-materialize", "collective-site", "lock-order",
+        "conf-module-global"}
     (f,) = [f for f in d["findings"] if f["rule"] == "jit-site"]
     assert set(f) == {"rule", "severity", "file", "line", "message",
                       "hint", "suppressed"}

@@ -1,0 +1,719 @@
+"""Concurrent query serving tier-1 tests (spark_rapids_tpu/serving):
+
+- plan signatures: normalized-structure sharing across literal values,
+  exact identity, default-deny on unsignable state, file fingerprints;
+- the two cross-query caches: exact-repeat plan-cache hits with ZERO new
+  traces (the ISSUE 15 acceptance assertion, via the stage compiler's
+  counters), literal-promoted structure sharing, busy-bypass leasing,
+  result-cache spill round trip and invalidation on input-file change;
+- admission control: a starved pool BLOCKS submissions (never OOMs),
+  sheds them with AdmissionTimeout past the queue timeout, and surfaces
+  waits through the arbiter's serving view;
+- concurrent bit-identity: N queries racing == serial results;
+- the online AutoTuner loop: accepted conf deltas apply to the NEXT
+  admitted query (conf-digest re-plan), resize the live semaphore, and
+  leave an autotuneApplied trail;
+- the PR 15 satellites: CTE-cache execution epochs, the deferred-concat
+  padding guard, and first-batch-sampled build-side swap.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.aux import events as EV
+from spark_rapids_tpu.exec import stage_compiler as SC
+from spark_rapids_tpu.serving import AdmissionTimeout, QueryServer
+from spark_rapids_tpu.serving.caches import PlanCache, ResultCache
+from spark_rapids_tpu.serving.server import AdmissionController
+from spark_rapids_tpu.serving.signature import (conf_digest,
+                                                plan_fingerprints,
+                                                plan_signature)
+
+from tests.asserts import tpu_session
+
+
+def _write_store(tmp_path, n=2000, seed=7):
+    rng = np.random.default_rng(seed)
+    t = pa.table({
+        "k": rng.integers(0, 9, n).astype(np.int64),
+        "g": rng.integers(0, 4, n).astype(np.int64),
+        "v": rng.standard_normal(n),
+    })
+    path = str(tmp_path / "serve_t.parquet")
+    pq.write_table(t, path)
+    return path
+
+
+def _serving_session(tmp_path, extra=None):
+    s = tpu_session(extra)
+    path = _write_store(tmp_path)
+    s.create_or_replace_temp_view("t", s.read.parquet(path))
+    return s, path
+
+
+class _Server:
+    """Context-managed QueryServer (workers must stop even on failure)."""
+
+    def __init__(self, session, **conf):
+        for k, v in conf.items():
+            session = session.set_conf(k, v)
+        self.srv = QueryServer(session=session)
+
+    def __enter__(self):
+        return self.srv
+
+    def __exit__(self, *exc):
+        self.srv.stop()
+        return False
+
+
+Q_AGG = ("SELECT k, SUM(v) AS s, COUNT(*) AS c FROM t "
+         "WHERE v > 0 GROUP BY k ORDER BY k")
+Q_FILTER = "SELECT k, g, v FROM t WHERE v > 1.5 ORDER BY v DESC, k, g"
+Q_GROUP2 = ("SELECT g, MIN(v) AS lo, MAX(v) AS hi FROM t "
+            "GROUP BY g ORDER BY g")
+MIXED = [Q_AGG, Q_FILTER, Q_GROUP2]
+
+
+# ---------------------------------------------------------------------------
+# signatures
+# ---------------------------------------------------------------------------
+
+def test_signature_structure_shared_across_literals(tmp_path):
+    s, _ = _serving_session(tmp_path)
+    a = plan_signature(s.sql(Q_AGG)._plan)
+    b = plan_signature(s.sql(Q_AGG)._plan)
+    c = plan_signature(s.sql(Q_AGG.replace("v > 0", "v > 2"))._plan)
+    d = plan_signature(s.sql(Q_FILTER)._plan)
+    assert a is not None and a.norm == b.norm
+    assert a.lit_values == b.lit_values and a.exact == b.exact
+    # same structure, different literal vector -> same entry, new variant
+    assert c.norm == a.norm
+    assert c.lit_values != a.lit_values and c.exact != a.exact
+    # different structure
+    assert d.norm != a.norm
+
+
+def test_signature_stable_across_reparse_with_subqueries(tmp_path):
+    # regression: the analyzer's subquery marker counter (_exists<N> /
+    # _sq<N> internal column names) was process-global, so two parses of
+    # the SAME text produced different structures and identical queries
+    # missed the plan cache.  Markers now number per-parse.
+    s, _ = _serving_session(tmp_path)
+    q = ("SELECT k, v FROM t WHERE EXISTS "
+         "(SELECT 1 FROM t t2 WHERE t2.k = t.k AND t2.v > 1) "
+         "AND v < (SELECT MAX(v) FROM t) ORDER BY k, v")
+    a = plan_signature(s.sql(q)._plan)
+    b = plan_signature(s.sql(q)._plan)
+    assert a is not None and a.norm == b.norm and a.exact == b.exact
+
+
+def test_signature_default_denies_unsignable_state(tmp_path):
+    s, _ = _serving_session(tmp_path)
+    plan = s.sql(Q_AGG)._plan
+    assert plan_signature(plan) is not None
+    # a node carrying a callable (python UDFs, pandas fns) makes the
+    # whole plan unsigned: wrongly merging two UDF plans is never ok
+    plan.children[0].mystery_fn = lambda row: row
+    try:
+        assert plan_signature(plan) is None
+    finally:
+        del plan.children[0].mystery_fn
+
+
+def test_fingerprints_track_file_change_and_deletion(tmp_path):
+    s, path = _serving_session(tmp_path)
+    plan = s.sql(Q_AGG)._plan
+    fp0 = plan_fingerprints(plan)
+    assert any(f[0] == path and f[2] > 0 for f in fp0)
+    t = pq.read_table(path)
+    time.sleep(0.02)
+    pq.write_table(t.slice(0, 100), path)
+    fp1 = plan_fingerprints(plan)
+    assert fp1 != fp0
+    import os
+    os.remove(path)
+    fp2 = plan_fingerprints(plan)
+    assert any(f[0] == path and f[2] == -1 for f in fp2)
+
+
+def test_conf_digest_ignores_serving_keys(tmp_path):
+    s, _ = _serving_session(tmp_path)
+    d0 = conf_digest(s.conf)
+    d1 = conf_digest(
+        s.conf.set("spark.rapids.serving.maxConcurrentQueries", "2"))
+    assert d0 == d1
+    d2 = conf_digest(s.conf.set("spark.rapids.sql.batchSizeBytes", "1m"))
+    assert d2 != d0
+
+
+# ---------------------------------------------------------------------------
+# cache units
+# ---------------------------------------------------------------------------
+
+class _FakeSig:
+    def __init__(self, norm, lits=()):
+        self.norm = norm
+        self.lit_values = tuple(lits)
+
+
+def test_plan_cache_lease_busy_bypass_and_eviction():
+    pc = PlanCache(max_plans=2)
+    fp = (("f", 1.0, 10),)
+    s1 = _FakeSig("n1", ("1",))
+    lease = pc.insert("cd", s1, fp, plan="P1")
+    # the inserted variant is LEASED: a concurrent identical query must
+    # bypass instead of racing the same exec instances
+    assert pc.lookup("cd", s1, fp) is None
+    assert pc.stats["busy_bypass"] == 1
+    lease.release()
+    hit = pc.lookup("cd", s1, fp)
+    assert hit is not None and hit.plan == "P1"
+    hit.release()
+    # same structure / new literal vector: norm_hit, caller plans fresh
+    s2 = _FakeSig("n1", ("2",))
+    assert pc.lookup("cd", s2, fp) is None
+    assert pc.stats["norm_hits"] == 1
+    pc.insert("cd", s2, fp, plan="P2").release()
+    # LRU bound counts variants; a third pushes the oldest unleased out
+    pc.insert("cd", _FakeSig("n3"), fp, plan="P3").release()
+    assert pc.stats["evictions"] >= 1
+    # stale fingerprints drop the whole structure entry
+    s_live = next(iter(pc._entries))
+    lv = next(iter(pc._entries[s_live]))
+    pc._entries[s_live][lv].fingerprints = (("f", 2.0, 11),)
+    assert pc.lookup(s_live[0], _FakeSig(s_live[1], lv), fp) is None
+    assert pc.stats["invalidations"] >= 1
+
+
+def test_result_cache_spill_round_trip():
+    from spark_rapids_tpu.columnar.batch import batch_from_pydict
+    b1 = batch_from_pydict({"x": np.arange(512, dtype=np.int64),
+                            "s": [f"r{i}" for i in range(512)]})
+    b2 = batch_from_pydict({"x": np.arange(7, dtype=np.int64)})
+    rc = ResultCache(max_bytes=b1.nbytes() + 16, spill=True)
+    fp = ()
+    assert rc.put("k1", fp, b1)
+    assert rc.put("k2", fp, b2)      # pressure: k1 spills to arrow tier
+    assert rc.stats["spills"] == 1 and rc.disk_bytes > 0
+    back = rc.lookup("k1", fp)
+    assert back is not None and rc.stats["unspills"] == 1
+    assert back.to_pydict() == b1.to_pydict()
+    # fingerprint mismatch invalidates instead of serving stale
+    assert rc.lookup("k2", (("f", 1.0, 1),)) is None
+    assert rc.stats["invalidations"] == 1
+    rc.clear()
+    assert rc.mem_bytes == 0 and rc.disk_bytes == 0
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+def test_admission_starved_pool_blocks_then_admits_on_release():
+    from spark_rapids_tpu.memory.arbiter import get_arbiter
+    ac = AdmissionController(max_concurrent=4, reserve_bytes=600,
+                            timeout_ms=30_000, backoff_ms=5)
+    ac._pool_limit = lambda: 1000
+    assert ac.admit(1) == 600        # first admits even when oversized
+    admitted = threading.Event()
+
+    def second():
+        ac.admit(2)
+        admitted.set()
+
+    t = threading.Thread(target=second, daemon=True)
+    t.start()
+    # the starved pool BLOCKS the second submission (never OOMs)
+    assert not admitted.wait(0.25)
+    view = get_arbiter().serving_view()
+    assert view[2]["state"] == "blocked_on_admission"
+    assert "serving query 2" in get_arbiter().dump()
+    ac.release(1)
+    assert admitted.wait(5.0)
+    t.join(5.0)
+    ac.release(2)
+    assert not get_arbiter().serving_view()
+
+
+def test_admission_timeout_sheds_load():
+    ac = AdmissionController(max_concurrent=4, reserve_bytes=600,
+                            timeout_ms=120, backoff_ms=5)
+    ac._pool_limit = lambda: 1000
+    ac.admit(1)
+    with pytest.raises(AdmissionTimeout):
+        ac.admit(2)
+    assert ac.stats["timeouts"] == 1
+    ac.release(1)
+    # queue stats survived the shed
+    assert ac.stats["admitted"] == 1 and ac.stats["queued"] == 1
+
+
+def test_serving_starved_pool_end_to_end(tmp_path):
+    s, _ = _serving_session(tmp_path)
+    with _Server(s) as srv:
+        # serialize admissions through a tiny synthetic pool: every query
+        # still completes (blocked, not shed, not OOMed)
+        srv.admission._pool_limit = lambda: 1000
+        srv.admission._reserve_bytes = 600
+        expected = srv.execute(Q_AGG)
+        subs = [srv.submit(Q_AGG) for _ in range(4)]
+        assert all(sub.result(120) == expected for sub in subs)
+        st = srv.stats()["admission"]
+        assert st["timeouts"] == 0 and st["admitted"] == 5
+        assert st["queued"] >= 1    # at least one wait was surfaced
+
+
+# ---------------------------------------------------------------------------
+# cross-query caching end to end
+# ---------------------------------------------------------------------------
+
+def test_second_identical_query_skips_planning_and_compile(tmp_path):
+    s, _ = _serving_session(tmp_path)
+    # result cache OFF: force the repeat onto the plan-cache path
+    with _Server(s, **{"spark.rapids.serving.resultCache.maxBytes": "0"}
+                 ) as srv:
+        sub1 = srv.submit(Q_AGG)
+        r1 = sub1.result(120)
+        assert sub1.info["resolved"] == "planned"
+        traces0 = SC.stats()["traces"]
+        sub2 = srv.submit(Q_AGG)
+        r2 = sub2.result(120)
+        # plan-cache hit: NO planning, NO compilation, zero new traces
+        assert sub2.info["resolved"] == "plan_cache"
+        assert SC.stats()["traces"] - traces0 == 0
+        assert r2 == r1
+        assert srv.stats()["plan_cache"]["hits"] == 1
+
+
+def test_literal_promoted_queries_share_structure(tmp_path):
+    s, _ = _serving_session(tmp_path)
+    with _Server(s, **{"spark.rapids.serving.resultCache.maxBytes": "0"}
+                 ) as srv:
+        srv.execute(Q_AGG)
+        r_low = srv.execute(Q_AGG.replace("v > 0", "v > -10"))
+        ps = srv.stats()["plan_cache"]
+        # same normalized structure, new literal vector: shared entry
+        assert ps["norm_hits"] == 1
+        # and the literal actually took effect (more rows pass v > -10)
+        assert sum(r["c"] for r in r_low) == 2000
+
+
+def test_result_cache_hit_and_file_invalidation(tmp_path):
+    s, path = _serving_session(tmp_path)
+    with _Server(s) as srv:
+        r1 = srv.execute(Q_AGG)
+        sub = srv.submit(Q_AGG)
+        assert sub.result(120) == r1
+        assert sub.info["resolved"] == "result_cache"
+        # rewrite an input file: both caches must invalidate, the query
+        # recomputes over the new bytes
+        t = pq.read_table(path)
+        time.sleep(0.02)
+        pq.write_table(t.slice(0, 500), path)
+        r2 = srv.execute(Q_AGG)
+        assert r2 != r1
+        st = srv.stats()
+        assert st["result_cache"]["invalidations"] >= 1
+        assert st["plan_cache"]["invalidations"] >= 1
+        # and the recomputed result is itself served from cache again
+        sub3 = srv.submit(Q_AGG)
+        assert sub3.result(120) == r2
+        assert sub3.info["resolved"] == "result_cache"
+
+
+def test_speculation_replay_never_reuses_poisoned_plan_state(tmp_path):
+    # regression: a served query whose speculative join pair table
+    # overflows (duplicate build keys -> more pairs than the probe
+    # bucket) replays in exact mode.  The replay used to re-execute the
+    # SAME physical-plan instance, whose exchange stores / join build
+    # caches the failed speculative pass had filled with TRUNCATED
+    # batches — silently wrong rows.  The replay must re-plan fresh
+    # instances, and later plan-cache hits must never see the poisoned
+    # ones.
+    s, _ = _serving_session(tmp_path)
+    nk = 9
+    rng = np.random.default_rng(11)
+    t_data = {"k": rng.integers(0, nk, 2000).astype(np.int64),
+              "v": rng.standard_normal(2000)}
+    dup_data = {"bk": np.repeat(np.arange(nk, dtype=np.int64), 5),
+                "m": np.arange(nk * 5, dtype=np.int64)}
+    # in-memory sides: this is the shape whose sub-partition hash join
+    # provably poisons (the parquet-scan plan shape happens not to)
+    s.create_or_replace_temp_view(
+        "t", s.create_dataframe(dict(t_data), num_partitions=2))
+    s.create_or_replace_temp_view(
+        "u", s.create_dataframe(dict(dup_data), num_partitions=1))
+    # GROUP BY u.m alone: hash(m) is NOT delivered by the join's
+    # hash(k) partitioning, so a real exchange sits ABOVE the join and
+    # its map side materializes the (truncated) join output — the
+    # poison vector (the join-INPUT exchanges only ever hold clean
+    # pre-join batches)
+    q = ("SELECT u.m, SUM(t.v) AS sv FROM t JOIN u ON t.k = u.bk "
+         "GROUP BY u.m ORDER BY u.m")
+
+    # the shape must actually overflow (else this test asserts nothing):
+    # 5 build rows per probe key >> the optimistic 1-match-per-row table
+    from spark_rapids_tpu.ops.speculation import (SpeculationOverflow,
+                                                  speculation_scope)
+    df = s.sql(q)
+    with pytest.raises(SpeculationOverflow):
+        with speculation_scope() as ctx:
+            df._executed_plan().collect_host()
+            ctx.check()
+
+    # CPU oracle on its OWN session (session.set_conf mutates in place —
+    # flipping sql.enabled on ``s`` would quietly de-TPU the server too)
+    cpu = tpu_session({"spark.rapids.sql.enabled": "false"})
+    cpu.create_or_replace_temp_view(
+        "t", cpu.create_dataframe(dict(t_data), num_partitions=2))
+    cpu.create_or_replace_temp_view(
+        "u", cpu.create_dataframe(dict(dup_data), num_partitions=1))
+    expect = cpu.sql(q).collect()
+    with _Server(s, **{"spark.rapids.serving.resultCache.maxBytes": "0"}
+                 ) as srv:
+        assert srv.execute(q) == expect          # replayed execution
+        assert srv.execute(q) == expect          # plan-cache hit after
+
+
+def test_failed_execution_discards_cached_plan_variant(tmp_path, monkeypatch):
+    # an execution that fails AFTER planning may leave the cached plan's
+    # exec instances with poisoned memoized state (e.g. a speculative
+    # pass dying before its overflow check, stores built from truncated
+    # joins) — the variant must be discarded, and the retry must plan
+    # fresh, not hit the dirty instance
+    import spark_rapids_tpu.session as SS
+    s, _ = _serving_session(tmp_path)
+    real = SS.collect_with_speculation
+    calls = {"n": 0}
+
+    def flaky(conf, factory):
+        out = real(conf, factory)       # run fully (plan inserted+leased)
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("injected post-execution fault")
+        return out
+
+    monkeypatch.setattr(SS, "collect_with_speculation", flaky)
+    with _Server(s, **{"spark.rapids.serving.resultCache.maxBytes": "0"}
+                 ) as srv:
+        with pytest.raises(RuntimeError, match="injected"):
+            srv.execute(Q_AGG)
+        assert srv.stats()["plan_cache"]["invalidations"] == 1
+        sub = srv.submit(Q_AGG)
+        rows = sub.result(120)
+        assert sub.info["resolved"] == "planned"    # NOT a stale hit
+        assert rows == s.sql(Q_AGG).collect()
+        # and the fresh variant serves hits again
+        sub2 = srv.submit(Q_AGG)
+        assert sub2.result(120) == rows
+        assert sub2.info["resolved"] == "plan_cache"
+
+
+def test_set_conf_applies_serving_knobs_to_live_server(tmp_path):
+    # regression: serving.* knobs set on a RUNNING server must apply to
+    # the live structures — resultCache.maxBytes=0 used to leave the
+    # constructed cache serving entries (only the conf snapshot changed)
+    s, _ = _serving_session(tmp_path)
+    with _Server(s) as srv:
+        r1 = srv.execute(Q_AGG)
+        srv.set_conf("spark.rapids.serving.resultCache.maxBytes", "0")
+        assert srv.result_cache.max_bytes == 0
+        sub = srv.submit(Q_AGG)
+        assert sub.result(120) == r1
+        # served by the PLAN cache now, never the disabled result cache
+        assert sub.info["resolved"] == "plan_cache"
+        assert srv.stats()["result_cache"]["hits"] == 0
+        srv.set_conf("spark.rapids.serving.queueTimeoutMs", "123")
+        assert srv.admission.timeout_ms == 123
+
+
+def test_concurrent_bit_identity_mixed_workload(tmp_path):
+    s, _ = _serving_session(tmp_path)
+    with _Server(s, **{"spark.rapids.serving.maxConcurrentQueries": "4"}
+                 ) as srv:
+        serial = [srv.execute(q) for q in MIXED]
+        # racing repeats (cache hits AND fresh plans: half the load runs
+        # with caches bypassed via distinct literals) == serial rows
+        subs = [(i % len(MIXED), srv.submit(MIXED[i % len(MIXED)]))
+                for i in range(12)]
+        for qi, sub in subs:
+            assert sub.result(180) == serial[qi], MIXED[qi]
+
+
+def test_uncacheable_query_still_serves(tmp_path):
+    s, _ = _serving_session(tmp_path)
+    with _Server(s) as srv:
+        # DataFrame queries over in-memory sources sign (dev-cache
+        # identity), so force unsignability through a callable attr
+        df = s.sql(Q_AGG)
+        df._plan.children[0].mystery_fn = lambda r: r
+        r1 = srv.execute(df)
+        assert r1 == srv.execute(s.sql(Q_AGG))
+        ps = srv.stats()["plan_cache"]
+        assert ps["hits"] == 0 and ps["inserts"] == 1   # only the signed run
+
+
+# ---------------------------------------------------------------------------
+# the online AutoTuner loop
+# ---------------------------------------------------------------------------
+
+def test_online_conf_delta_applies_to_next_admitted_query(tmp_path):
+    s, _ = _serving_session(tmp_path)
+    with _Server(s, **{"spark.rapids.serving.resultCache.maxBytes": "0"}
+                 ) as srv:
+        srv.execute(Q_AGG)
+        # an online delta (batch size is plan-affecting) re-keys the plan
+        # cache: the next admitted query re-plans under the new conf...
+        srv.set_conf("spark.rapids.sql.batchSizeBytes", "32m")
+        srv.execute(Q_AGG)
+        ps = srv.stats()["plan_cache"]
+        assert ps["inserts"] == 2 and ps["hits"] == 0
+        # ...and later repeats under the same conf hit again
+        srv.execute(Q_AGG)
+        assert srv.stats()["plan_cache"]["hits"] == 1
+
+
+def test_autotune_applied_delta_trail_and_semaphore_resize(tmp_path):
+    from spark_rapids_tpu.memory.device_manager import get_runtime
+    from spark_rapids_tpu.tools.autotune import Recommendation
+    s, _ = _serving_session(tmp_path)
+    with _Server(s, **{"spark.rapids.serving.autotune.enabled": "true"}
+                 ) as srv:
+        ring = EV.RingBufferSink(64)
+        EV.add_global_sink(ring)
+        try:
+            old = int(srv.conf.get("spark.rapids.sql.concurrentGpuTasks"))
+            rec = Recommendation(
+                key="spark.rapids.sql.concurrentGpuTasks", current=old,
+                recommended=old + 1, reason="unit", evidence=[],
+                query_id=77)
+            srv._apply_delta(rec, 77)
+            assert int(srv.conf.get(
+                "spark.rapids.sql.concurrentGpuTasks")) == old + 1
+            key, was, now = srv.autotune_applied[-1][:3]
+            assert key == "spark.rapids.sql.concurrentGpuTasks"
+            assert int(was) == old and int(now) == old + 1
+            evs = [e for e in ring.events()
+                   if e.kind == "autotuneApplied"]
+            assert evs and evs[-1].payload["new"] == str(old + 1)
+            rt = get_runtime()
+            if rt is not None:   # live budget follows the delta
+                assert rt.semaphore.max_concurrent == old + 1
+                rt.semaphore.resize(old)
+            # an identical re-recommendation is a no-op (no event spam)
+            n = len(srv.autotune_applied)
+            srv._apply_delta(rec, 78)
+            assert len(srv.autotune_applied) == n
+            # the allowlist is explicit: only perf knobs tune online
+            from spark_rapids_tpu.serving.server import ONLINE_TUNABLE_KEYS
+            assert "spark.rapids.sql.enabled" not in ONLINE_TUNABLE_KEYS
+            assert "spark.rapids.sql.batchSizeBytes" in ONLINE_TUNABLE_KEYS
+        finally:
+            EV.remove_global_sink(ring)
+
+
+def test_autotune_loop_quiet_on_healthy_workload(tmp_path):
+    s, _ = _serving_session(tmp_path)
+    with _Server(s, **{"spark.rapids.serving.autotune.enabled": "true"}
+                 ) as srv:
+        for _ in range(3):
+            srv.execute(Q_GROUP2)
+        # rules run after every query; a healthy small workload yields
+        # no deltas (quiet-on-healthy), and tuning never fails a query
+        assert srv.autotune_applied == []
+        assert srv.stats()["admission"]["admitted"] == 3
+
+
+def test_semaphore_resize_grow_wakes_and_shrink_drains():
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    sem = TpuSemaphore(1)
+    try:
+        sem.acquire_if_necessary(task_id=1)
+        got = threading.Event()
+
+        def waiter():
+            sem.acquire_if_necessary(task_id=2)
+            got.set()
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        assert not got.wait(0.15)    # budget 1: second acquire queues
+        assert sem.resize(2) == 1    # grow applies ONLINE, wakes waiter
+        assert got.wait(5.0)
+        t.join(5.0)
+        # shrink never revokes held permits: drains as holders release
+        assert sem.resize(1) == 2
+        assert sem.max_concurrent == 1
+        assert sem.resize(1) == 1    # no-op resize
+    finally:
+        sem.release_all(task_id=1)
+        sem.release_all(task_id=2)
+    assert not sem.stats()["holders"]
+
+
+# ---------------------------------------------------------------------------
+# PR 15 satellites
+# ---------------------------------------------------------------------------
+
+class _CountingSource:
+    """Minimal host exec: counts how often its stream is (re)built."""
+
+    def __init__(self):
+        from spark_rapids_tpu.plan.base import Exec
+        self.node = Exec()
+        self.builds = 0
+
+        def execute_partition(pidx):
+            self.builds += 1
+            yield ("batch", pidx)
+        self.node.execute_partition = execute_partition
+
+
+def test_cte_cache_rebuilds_per_execution_epoch():
+    from spark_rapids_tpu.exec.basic import (CpuCteCacheExec,
+                                             refresh_cte_epochs)
+    src = _CountingSource()
+    cte = CpuCteCacheExec(src.node)
+    # two pulls within one epoch: ONE materialization, shared
+    assert list(cte.execute_partition(0)) == [("batch", 0)]
+    assert list(cte.execute_partition(0)) == [("batch", 0)]
+    assert src.builds == 1
+    # a new prepared action stamps a fresh epoch: stale batches (changed
+    # files, speculation replay, plan-cache re-execution) never replay
+    refresh_cte_epochs(cte)
+    assert list(cte.execute_partition(0)) == [("batch", 0)]
+    assert src.builds == 2
+    refresh_cte_epochs(cte)
+    assert list(cte.execute_partition(0)) == [("batch", 0)]
+    assert src.builds == 3
+
+
+def test_concat_padding_guard_sizes_from_forced_counts(monkeypatch):
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar.batch import batch_from_pydict
+    from spark_rapids_tpu.columnar.column import DeferredCount
+    from spark_rapids_tpu.ops import batch_ops as BO
+
+    def sparse(lo):
+        db = batch_from_pydict(
+            {"x": np.arange(lo, lo + 2000, dtype=np.int64)}).to_device()
+        keep = np.zeros(db.bucket, dtype=bool)
+        keep[:2000][::667] = True            # 3 live rows in a 2048 bucket
+        return BO.compact_batch(db, jnp.asarray(keep))
+
+    a, b = sparse(0), sparse(5000)
+    assert isinstance(a.row_count, DeferredCount) and \
+        not a.row_count.is_forced
+    # default: deferred sizing = next-pow2 of summed padded buckets
+    out0 = BO.concat_batches([sparse(0), sparse(5000)])
+    assert out0.bucket >= 4096
+    # above the byte threshold: force the counts once, shrink the
+    # padded inputs, size the output from LIVE rows (OOM guard)
+    monkeypatch.setattr(BO, "CONCAT_FORCE_SYNC_BYTES", 0)
+    out1 = BO.concat_batches([a, b])
+    assert out1.bucket < 4096
+    rows = sorted(out1.to_host().to_pydict()["x"])
+    assert rows == [0, 667, 1334, 5000, 5667, 6334]
+    assert rows == sorted(out0.to_host().to_pydict()["x"])
+
+
+class _FakeBatch:
+    def __init__(self, nbytes):
+        self._n = nbytes
+
+    def nbytes(self):
+        return self._n
+
+
+class _FakeProbe:
+    """Streams fake batches, recording pulls and close."""
+
+    def __init__(self, sizes):
+        self.sizes = sizes
+        self.pulled = 0
+        self.closed = False
+
+    def execute_partition(self, pidx):
+        try:
+            for s in self.sizes:
+                self.pulled += 1
+                yield _FakeBatch(s)
+        finally:
+            self.closed = True
+
+
+def _swap_join(probe, max_bytes=1 << 30):
+    from spark_rapids_tpu.exec.joins import TpuShuffledHashJoinExec
+    from spark_rapids_tpu.ops import join_ops as J
+    j = object.__new__(TpuShuffledHashJoinExec)
+    j.join_type = J.INNER
+    j.condition = None
+    j.left_keys = ["k"]
+    j.build_swap_enabled = True
+    j.build_swap_max_bytes = max_bytes
+    j.children = [probe, None]       # .left rides children[0]
+    return j
+
+
+def test_build_swap_samples_first_batches_only():
+    # probe provably bigger after TWO batches: sampling stops there
+    # (the old code materialized the ENTIRE probe partition to weigh a
+    # swap it doesn't take)
+    probe = _FakeProbe([600, 600, 600, 600, 600])
+    j = _swap_join(probe)
+    build = [_FakeBatch(1000)]
+    it, out_build, swapped = j._maybe_swapped_with(build, 0)
+    assert not swapped and out_build is build
+    assert probe.pulled == 2
+    # the sampled prefix replays first, then the live stream continues
+    drained = list(it)
+    assert len(drained) == 5 and probe.closed
+    # abandoning the stream early still closes the child
+    probe2 = _FakeProbe([600, 600, 600, 600])
+    it2, _, _ = _swap_join(probe2)._maybe_swapped_with(
+        [_FakeBatch(1000)], 0)
+    next(it2)
+    it2.close()
+    assert probe2.closed
+
+
+def test_build_swap_takes_smaller_probe_as_build():
+    probe = _FakeProbe([100, 100])
+    j = _swap_join(probe)
+    big_build = [_FakeBatch(5000)]
+    it, out_build, swapped = j._maybe_swapped_with(big_build, 0)
+    assert swapped            # whole probe drained and is the smaller side
+    assert [b._n for b in out_build] == [100, 100]
+    assert [b._n for b in it] == [5000]
+
+
+def test_conf_module_global_lint_rule(tmp_path):
+    import textwrap
+
+    from spark_rapids_tpu.tools.lint.core import run_lint
+    from spark_rapids_tpu.tools.lint.rules import ConfModuleGlobalRule
+    (tmp_path / "bad_mod.py").write_text(textwrap.dedent("""\
+        import spark_rapids_tpu.exec.joins as _XJ
+
+        def apply(conf):
+            _XJ.BUILD_SWAP_ENABLED = conf.get("spark.rapids.x")
+    """))
+    (tmp_path / "clean_mod.py").write_text(textwrap.dedent("""\
+        def convert(p, m):
+            out = make_exec()
+            out.build_swap_enabled = m.conf.get("spark.rapids.x")
+            LOCAL_CONST = 5
+            return out
+    """))
+    report = run_lint(root=str(tmp_path), rules=[ConfModuleGlobalRule()],
+                      baseline_path="")
+    findings = [f for f in report.findings
+                if f.rule == "conf-module-global"]
+    assert len(findings) == 1 and "bad_mod.py" in findings[0].file
+    assert "BUILD_SWAP_ENABLED" in findings[0].message
