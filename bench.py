@@ -271,7 +271,10 @@ def main():
     from spark_rapids_tpu.aux.tracing import last_query_summary
     tpu_query_metrics = _compact_summary(last_query_summary())
     _set_phase("cpu_quick")
-    best_cpu, r_cpu, _ = measure(cpu, qdata, warmups=0, runs=1)
+    # warm reps, not one cold pass: at quick-tier row counts a cold CPU
+    # pass is dominated by first-touch page faults and allocator growth,
+    # which inflated vs_baseline (the TPU side always runs warm)
+    best_cpu, r_cpu, _ = measure(cpu, qdata, warmups=1, runs=reps)
     if not _match(r_tpu, r_cpu):
         signal.alarm(0)
         print(json.dumps({
@@ -300,12 +303,18 @@ def main():
         est = best_cpu * scale + (2 + reps) * best_tpu * scale
         if _remaining() > est + 45:
             _set_phase("tpu_primary")
-            best_tpu, r_tpu, tpu_compile = measure(tpu, data, warmups=2,
-                                                   runs=reps)
-            tpu_query_metrics = _compact_summary(last_query_summary())
+            # full-tier results land in temporaries: a diverged full run
+            # must leave the quick tier's compile/query_metrics payload
+            # intact, not poison it with numbers from a run we rejected
+            f_tpu, fr_tpu, f_compile = measure(tpu, data, warmups=2,
+                                               runs=reps)
+            f_query_metrics = _compact_summary(last_query_summary())
             _set_phase("cpu_primary")
-            best_cpu, r_cpu, _ = measure(cpu, data, warmups=0, runs=1)
-            if _match(r_tpu, r_cpu):
+            f_cpu, fr_cpu, _ = measure(cpu, data, warmups=0, runs=1)
+            if _match(fr_tpu, fr_cpu):
+                best_tpu, best_cpu = f_tpu, f_cpu
+                tpu_compile = f_compile
+                tpu_query_metrics = f_query_metrics
                 out = _primary_out(n_rows, best_tpu, best_cpu, "full")
             else:   # keep the (matching) quick number, flag the full run
                 out["full_tier_error"] = "TPU/CPU results diverge"
@@ -497,6 +506,12 @@ def _event_log_payload(path: str) -> dict:
                "queries": len(profiles),
                "events": diag.parsed,
                "truncated_lines": diag.truncated_lines}
+        # per-query host-transition ledger (schema v4): BENCH_*.json
+        # tracks boundary-crossing counts/bytes/sync seconds across PRs
+        # the same way it tracks chaos/pipeline/encoding ledgers
+        from spark_rapids_tpu.tools.profile import _transition_ledger
+        out["transitions"] = {
+            str(qp.query_id): _transition_ledger(qp) for qp in profiles}
     except Exception as e:  # noqa: BLE001 - keep the primary metric alive
         return {"path": path, "profile_ok": False,
                 "error": f"{type(e).__name__}: {e}"[:200]}

@@ -5,21 +5,21 @@
 # and tests/test_audit.py run the same linter/auditor as their gate
 # tests) but fails in seconds instead of minutes.
 #
-#   scripts/check.sh            # lint + audit smoke + serving smoke + smoke tests
+#   scripts/check.sh            # lint + audit smoke + trace round-trip + serving smoke + smoke tests
 #   scripts/check.sh --lint-only
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== 1/4 engine invariant lint =="
+echo "== 1/5 engine invariant lint =="
 python -m spark_rapids_tpu.tools lint
 
 if [[ "${1:-}" == "--lint-only" ]]; then
     exit 0
 fi
 
-echo "== 2/4 compiled-program audit smoke =="
+echo "== 2/5 compiled-program audit smoke =="
 AUDIT_LOG="$(mktemp -d)/audit_smoke.jsonl"
 python - "$AUDIT_LOG" <<'PY'
 import sys
@@ -43,9 +43,31 @@ PY
 # error-severity ledger findings fail the gate; the roofline table is
 # report-only here (no peak floor configured)
 python -m spark_rapids_tpu.tools audit "$AUDIT_LOG" --no-roofline
+
+echo "== 3/5 transition-ledger trace round-trip =="
+# the audit smoke's own log round-trips through the Perfetto exporter:
+# --check fails on any hostTransition/deviceSync the gateway saw that
+# no query owns (unattributed = invisible latency), and the rendered
+# JSON must be loadable trace-event format with a transitions track
+TRACE_JSON="$(dirname "$AUDIT_LOG")/trace.json"
+python -m spark_rapids_tpu.tools trace "$AUDIT_LOG" -o "$TRACE_JSON" --check
+python - "$TRACE_JSON" <<'PY'
+import json
+import sys
+
+trace = json.load(open(sys.argv[1]))
+evs = trace["traceEvents"]
+assert evs and all(e["ph"] in ("M", "X", "C") for e in evs)
+slices = [e for e in evs if e["ph"] == "X"]
+assert any(e["cat"] == "plan" for e in slices), "plan track missing"
+assert any(e["cat"] == "hostTransition" for e in slices), \
+    "the smoke query crossed the boundary but no transition slice rendered"
+print(f"trace round-trip ok: {len(evs)} events, "
+      f"{sum(1 for e in slices if e['cat'] == 'hostTransition')} transition slice(s)")
+PY
 rm -rf "$(dirname "$AUDIT_LOG")"
 
-echo "== 3/4 concurrent-serving smoke =="
+echo "== 4/5 concurrent-serving smoke =="
 # two queries racing through the QueryServer: both admitted, results
 # bit-identical to a serial run, and the exact repeat skips planning
 python - <<'PY'
@@ -77,5 +99,5 @@ finally:
 print("serving smoke ok:", st["admission"], st["plan_cache"])
 PY
 
-echo "== 4/4 smoke test tier =="
+echo "== 5/5 smoke test tier =="
 python -m pytest tests/ -q -m smoke -p no:cacheprovider

@@ -46,9 +46,13 @@ from typing import Dict, List, Optional
 #: compiled-program audit ledger: ``stageProgram`` rows (one per built
 #: executable — jaxpr signatures, const shapes/fingerprints, arg
 #: signature, flops/bytes, key provenance) and ``planInvariantViolation``
-#: rows from the runtime plan verifier.  The reader (tools/reader.py)
-#: accepts all three.
-EVENT_SCHEMA_VERSION = 3
+#: rows from the runtime plan verifier.  v4 adds the host-transition
+#: ledger: ``hostTransition`` rows (one per packed H2D/D2H batch
+#: transfer — direction, bytes, encoding kinds, duration) and
+#: ``deviceSync`` rows (one per non-transfer blocking sync — site,
+#: duration) from aux/transitions.py.  The reader (tools/reader.py)
+#: accepts all four.
+EVENT_SCHEMA_VERSION = 4
 
 #: stamped on events emitted outside any query / span scope
 NO_QUERY = -1
@@ -79,6 +83,10 @@ EVENT_KINDS = frozenset({
     "planInvariantViolation",
     # encoded columnar execution (columnar/encoding.py, transfer.py)
     "encodedBatch", "encodingFallback",
+    # host-transition & device-sync ledger (aux/transitions.py, schema
+    # v4): one hostTransition per packed H2D/D2H transfer, one
+    # deviceSync per non-transfer blocking sync
+    "hostTransition", "deviceSync",
     # shuffle layer (shuffle/*.py, exec/exchange.py)
     "shuffleSend", "shuffleFetch", "fetchRetry", "fetchFailover",
     "shuffleBlockLoaded", "shuffleWorkerFetch", "shuffleBlocksInvalidated",
@@ -543,6 +551,44 @@ def render_prometheus() -> str:
     add("stage_compile_seconds_total", "counter",
         round(scs["compile_s"], 6),
         "Seconds spent tracing+compiling stage programs")
+    from spark_rapids_tpu.aux import transitions as _tr
+    trt = _tr.totals()
+    add("h2d_transitions_total", "counter", trt["h2d_count"],
+        "Packed host->device batch uploads through the transition gateway")
+    add("h2d_bytes_total", "counter", trt["h2d_bytes"],
+        "Bytes uploaded host->device")
+    add("h2d_seconds_total", "counter", trt["h2d_seconds"],
+        "Seconds in device_put dispatch for H2D uploads")
+    add("d2h_transitions_total", "counter", trt["d2h_count"],
+        "Packed device->host batch downloads through the transition "
+        "gateway")
+    add("d2h_bytes_total", "counter", trt["d2h_bytes"],
+        "Bytes downloaded device->host")
+    add("d2h_seconds_total", "counter", trt["d2h_seconds"],
+        "Seconds blocked fetching D2H downloads")
+    add("device_syncs_total", "counter", trt["sync_count"],
+        "Non-transfer blocking device syncs (count forces, overflow "
+        "checks) through the transition gateway")
+    add("device_sync_seconds_total", "counter", trt["sync_seconds"],
+        "Seconds blocked in non-transfer device syncs")
+    from spark_rapids_tpu.serving import server as _srv
+    hists = _srv.latency_histograms()
+    if hists:
+        full = "spark_rapids_tpu_serving_latency_seconds"
+        lines.append(f"# HELP {full} Serving submission latency by stage "
+                     "(queue wait, admission, cache lookup, plan, "
+                     "compile, execute, collect, e2e)")
+        lines.append(f"# TYPE {full} histogram")
+        for stage in sorted(hists):
+            h = hists[stage]
+            lbl = escape_label_value(stage)
+            for le, n in h["buckets"]:
+                le_s = "+Inf" if le == float("inf") else repr(le)
+                lines.append(f'{full}_bucket{{stage="{lbl}",le="{le_s}"}} '
+                             f'{n}')
+            lines.append(f'{full}_sum{{stage="{lbl}"}} '
+                         f'{round(h["sum"], 6)}')
+            lines.append(f'{full}_count{{stage="{lbl}"}} {h["count"]}')
     from spark_rapids_tpu.aux import profiler as _prof
     for op, s in sorted(_prof.range_stats().items()):
         full = "spark_rapids_tpu_op_range_seconds_total"

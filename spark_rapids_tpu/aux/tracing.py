@@ -124,6 +124,7 @@ class QueryExecution:
         self._plan = None
         self._token = None
         self._start_snapshot = None
+        self._transitions_snapshot = None
         self.summary_dict: Optional[dict] = None
         self.finished = False
         #: non-default conf values captured at from_conf (v2 event-log
@@ -156,6 +157,8 @@ class QueryExecution:
         rt = get_runtime()
         self._start_snapshot = rt.metrics.snapshot() if rt is not None \
             else None
+        from spark_rapids_tpu.aux import transitions as TR
+        self._transitions_snapshot = TR.snapshot()
         start_payload = {"description": self.description}
         if self.conf_snapshot:
             start_payload["conf"] = dict(self.conf_snapshot)
@@ -362,6 +365,13 @@ class QueryExecution:
         }
         if recovery:
             summary["recovery"] = recovery
+        # host-transition ledger: snapshot-delta of the gateway counters
+        # (aux/transitions.py) — robust to ring drops, like TaskMetrics
+        if self._transitions_snapshot is not None:
+            from spark_rapids_tpu.aux import transitions as TR
+            ledger = TR.snapshot().delta(self._transitions_snapshot)
+            if TR.enabled():
+                summary["transitions"] = ledger
         self.summary_dict = summary
         self.record_event("queryEnd",
                           {k: v for k, v in summary.items()
@@ -443,6 +453,16 @@ class QueryExecution:
             lines.append("== Recovery ==")
             lines.append(" ".join(f"{k}={v}" for k, v in sorted(
                 rec.items())))
+        tr = summary.get("transitions")
+        if tr:
+            lines.append("== Transitions ==")
+            lines.append(
+                f"h2d={tr.get('h2d_count', 0)} "
+                f"({tr.get('h2d_bytes', 0)}B {tr.get('h2d_s', 0.0)}s) "
+                f"d2h={tr.get('d2h_count', 0)} "
+                f"({tr.get('d2h_bytes', 0)}B {tr.get('d2h_s', 0.0)}s) "
+                f"syncs={tr.get('sync_count', 0)} "
+                f"({tr.get('sync_s', 0.0)}s)")
         return "\n".join(lines)
 
 

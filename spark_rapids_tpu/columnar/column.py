@@ -72,7 +72,8 @@ class DeferredCount:
 
     def _force(self) -> int:
         if self._val is None:
-            self._val = int(self._dev)
+            from spark_rapids_tpu.aux import transitions as TR
+            self._val = TR.sync_int(self._dev, site="count-force")
         return self._val
 
     # device-side interop (jnp ops accept this without a sync)
@@ -171,8 +172,10 @@ def force_counts(rcs) -> None:
                if isinstance(rc, DeferredCount) and not rc.is_forced]
     if not pending:
         return
-    stacked = np.asarray(jnp.stack([jnp.asarray(rc.traceable())
-                                    for rc in pending]))
+    from spark_rapids_tpu.aux import transitions as TR
+    stacked = TR.fetch(jnp.stack([jnp.asarray(rc.traceable())
+                                  for rc in pending]),
+                       site="count-force-batch")
     for rc, v in zip(pending, stacked):
         rc._val = int(v)
 
@@ -192,7 +195,8 @@ def sum_counts(rcs) -> int:
         total = deferred[0]
         for d in deferred[1:]:
             total = total + d
-        static += int(total)
+        from spark_rapids_tpu.aux import transitions as TR
+        static += TR.sync_int(total, site="count-sum")
     return static
 
 

@@ -708,6 +708,24 @@ TRACING_ENABLED = conf_bool(
     "summary (explain(analyze=True), event log, bench attribution).",
     True)
 
+TRANSITIONS_ENABLED = conf_bool(
+    "spark.rapids.sql.transitions.enabled",
+    "Host-transition & device-sync ledger (aux/transitions.py): time and "
+    "count every H2D upload, D2H download and blocking device sync "
+    "through the instrumented gateway, aggregated per query into the "
+    "summary/explain(analyze=True) ledger and the transitions/sync "
+    "buckets of tools profile.  Off = wrappers degrade to the raw "
+    "operations (results are bit-identical either way).",
+    True)
+
+TRANSITIONS_EVENTS = conf_bool(
+    "spark.rapids.sql.transitions.events",
+    "Emit one hostTransition/deviceSync event per boundary crossing "
+    "(schema v4) into the event bus for timeline tools (tools trace).  "
+    "Requires spark.rapids.sql.transitions.enabled; off keeps the "
+    "aggregate ledger but skips per-crossing events on hot paths.",
+    True)
+
 EVENT_LOG_PATH = conf_str(
     "spark.rapids.sql.eventLog.path",
     "When set, every traced query appends its events to this JSONL file "

@@ -55,7 +55,8 @@ def _partition_sizes(exchange, target_bytes: Optional[int] = None
     if getattr(exchange, "_collective", None) is not None:
         # mesh path: partitions are device shards; size = rows * row width
         _ctx, cols, counts, schema = exchange._collective
-        counts_h = np.asarray(counts)
+        from spark_rapids_tpu.aux import transitions as TR
+        counts_h = TR.fetch(counts, site="aqe-shard-counts")
         row_bytes = sum(
             getattr(f.data_type, "np_dtype", None).itemsize
             if getattr(f.data_type, "np_dtype", None) is not None else 16

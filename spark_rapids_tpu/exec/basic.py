@@ -427,7 +427,8 @@ def _deferred_limited(batches, n: int, force_interval=None):
             yield out
             deferred_batches += 1
             if deferred_batches % force_interval == 0:
-                left = int(_np.asarray(left))
+                from spark_rapids_tpu.aux import transitions as TR
+                left = int(TR.fetch(left, site="limit-force"))
 
 
 class TpuLimitExec(UnaryExec):

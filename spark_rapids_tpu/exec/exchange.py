@@ -654,8 +654,10 @@ class TpuShuffleExchangeExec(CpuShuffleExchangeExec):
         aug = ColumnarBatch([pid_col] + list(b.columns), b.row_count)
         perm = sort_permutation(aug, [SortOrder(0, True, True)])
         shuffled = gather_batch(b, perm, b.row_count)
-        counts = np.asarray(jnp.bincount(
-            jnp.clip(pids, 0, n), length=n + 1))[:n]
+        from spark_rapids_tpu.aux import transitions as TR
+        counts = TR.fetch(jnp.bincount(
+            jnp.clip(pids, 0, n), length=n + 1),
+            site="shuffle-pid-counts")[:n]
         hb = shuffled.to_host(spec_rows=self.dl_spec_rows)
         hb.names = b.names
         off = 0

@@ -70,7 +70,9 @@ class SpeculationContext:
                 except Exception:  # noqa: BLE001 - placement probe only
                     key = None
             by_dev.setdefault(key, []).append(f)
-        if any(bool(np.asarray(jnp.any(jnp.stack(group))))
+        from spark_rapids_tpu.aux import transitions as TR
+        if any(bool(TR.fetch(jnp.any(jnp.stack(group)),
+                             site="speculation-overflow"))
                for group in by_dev.values()):
             raise SpeculationOverflow()
 

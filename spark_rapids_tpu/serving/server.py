@@ -58,6 +58,73 @@ class AdmissionTimeout(TimeoutError):
     bounded queue sheds load instead of stacking it."""
 
 
+#: serving latency histogram bounds (seconds): log-spaced from the
+#: millisecond serving floor (ROADMAP item 2) up past the queue timeout
+LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, float("inf"))
+
+#: submission stage keys, decomposition order (queue wait -> admission
+#: -> cache lookup -> plan -> compile -> execute -> collect)
+STAGE_KEYS = ("queue_wait_s", "admit_wait_s", "lookup_s", "plan_s",
+              "compile_s", "execute_s", "collect_s")
+
+
+class LatencyHistogram:
+    """One fixed-bucket latency histogram (Prometheus semantics: the
+    exposition renders CUMULATIVE ``le`` buckets + ``_sum``/``_count``)."""
+
+    def __init__(self, bounds=LATENCY_BUCKETS):
+        self.bounds = tuple(bounds)
+        self._counts = [0] * len(self.bounds)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        v = max(0.0, float(seconds))
+        with self._lock:
+            for i, b in enumerate(self.bounds):
+                if v <= b:
+                    self._counts[i] += 1
+                    break
+            self._sum += v
+            self._count += 1
+
+    def snapshot(self) -> Dict:
+        """Cumulative (le, count) pairs ending at +Inf, plus sum/count."""
+        with self._lock:
+            counts = list(self._counts)
+            total, n = self._sum, self._count
+        cum = 0
+        buckets = []
+        for b, c in zip(self.bounds, counts):
+            cum += c
+            buckets.append((b, cum))
+        return {"buckets": buckets, "sum": total, "count": n}
+
+
+#: process-wide stage -> histogram registry, rendered by
+#: aux.events.render_prometheus (lazy import there; one registry per
+#: process regardless of how many QueryServers run)
+_HISTOGRAMS: Dict[str, LatencyHistogram] = {}
+_HIST_LOCK = threading.Lock()
+
+
+def observe_latency(stage: str, seconds: float) -> None:
+    with _HIST_LOCK:
+        h = _HISTOGRAMS.get(stage)
+        if h is None:
+            h = _HISTOGRAMS[stage] = LatencyHistogram()
+    h.observe(seconds)
+
+
+def latency_histograms() -> Dict[str, Dict]:
+    """stage -> histogram snapshot for render_prometheus()."""
+    with _HIST_LOCK:
+        items = list(_HISTOGRAMS.items())
+    return {stage: h.snapshot() for stage, h in items}
+
+
 class AdmissionController:
     """Per-query memory reservations against the shared device pool.
 
@@ -122,35 +189,53 @@ class AdmissionController:
                 / 1000.0
         backoff = max(0.001, self.backoff_ms / 1000.0)
         waited = None
+        timed_out = None
+        n_admitted = 0
         with self._cond:
             while not self._fits(reserve, limit):
                 now = time.monotonic()
                 if waited is None:
                     waited = now
                     self.stats["queued"] += 1
-                    arb.note_serving(query_id,
-                                     TaskState.BLOCKED_ON_ADMISSION,
-                                     reserve)
-                    EV.emit("servingAdmission", op="queued",
-                            serve_id=query_id, reserve_bytes=reserve)
+                    # the arbiter registration + event emit pay foreign
+                    # locks and possibly sink file I/O: drop the
+                    # condition around them so queueing one waiter never
+                    # taxes every OTHER waiter's wake/notify, then loop
+                    # back to re-check _fits (state may have moved)
+                    self._cond.release()
+                    try:
+                        arb.note_serving(query_id,
+                                         TaskState.BLOCKED_ON_ADMISSION,
+                                         reserve)
+                        EV.emit("servingAdmission", op="queued",
+                                serve_id=query_id, reserve_bytes=reserve)
+                    finally:
+                        self._cond.acquire()
+                    continue
                 if now >= deadline:
+                    # collect the facts under the lock, raise outside it
                     self.stats["timeouts"] += 1
-                    arb.drop_serving(query_id)
-                    EV.emit("servingAdmission", op="timeout",
-                            serve_id=query_id,
-                            waited_s=round(now - waited, 4))
-                    raise AdmissionTimeout(
-                        f"query {query_id} not admitted within "
-                        f"{self.timeout_ms}ms (pool limit {limit}, "
-                        f"reservation {reserve}B, "
-                        f"{len(self._admitted)} admitted)")
+                    timed_out = now
+                    n_admitted = len(self._admitted)
+                    break
                 self._cond.wait(min(backoff, deadline - now))
                 backoff = min(backoff * 2, 32 * self.backoff_ms / 1000.0)
                 limit = self._pool_limit()
-            self._admitted[query_id] = reserve
-            wait_s = 0.0 if waited is None else time.monotonic() - waited
-            self.stats["admitted"] += 1
-            self.stats["queue_wait_s"] += wait_s
+            if timed_out is None:
+                self._admitted[query_id] = reserve
+                wait_s = 0.0 if waited is None \
+                    else time.monotonic() - waited
+                self.stats["admitted"] += 1
+                self.stats["queue_wait_s"] += wait_s
+        if timed_out is not None:
+            arb.drop_serving(query_id)
+            EV.emit("servingAdmission", op="timeout", serve_id=query_id,
+                    waited_s=round(timed_out - waited, 4))
+            raise AdmissionTimeout(
+                f"query {query_id} not admitted within "
+                f"{self.timeout_ms}ms (pool limit {limit}, "
+                f"reservation {reserve}B, "
+                f"{n_admitted} admitted)")
         arb.note_serving(query_id, TaskState.RUNNING, reserve)
         EV.emit("servingAdmission", op="admitted", serve_id=query_id,
                 reserve_bytes=reserve, queue_wait_s=round(wait_s, 4))
@@ -392,6 +477,8 @@ class QueryServer:
 
     def _serve(self, sub: Submission, query) -> None:
         t0 = time.monotonic()
+        stages = sub.info["stages"] = {k: 0.0 for k in STAGE_KEYS}
+        stages["queue_wait_s"] = round(t0 - sub.submitted, 6)
         reserved = self.admission.admit(
             sub.serve_id,
             deadline=sub.submitted + self.admission.timeout_ms / 1000.0)
@@ -402,17 +489,38 @@ class QueryServer:
             conf = self.conf
             sub.info["reserved_bytes"] = reserved
             sub.info["admit_wait_s"] = round(time.monotonic() - t0, 4)
+            stages["admit_wait_s"] = sub.info["admit_wait_s"]
             batch = self._execute(sub, query, conf)
             sub._finish(batch=batch)
         except BaseException as e:  # noqa: BLE001 - handed to caller
             sub._finish(error=e)
         finally:
             self.admission.release(sub.serve_id)
+            self._observe_stages(sub)
+
+    def _observe_stages(self, sub: Submission) -> None:
+        """End-of-submission latency decomposition: every stage (and the
+        end-to-end latency) observes into the process-wide histograms
+        rendered by render_prometheus(), and the per-stage sums ride a
+        ``servingAdmission`` op="complete" event."""
+        stages = sub.info.get("stages") or {}
+        e2e = float(sub.info.get("latency_s", 0.0) or 0.0)
+        observe_latency("e2e", e2e)
+        for k in STAGE_KEYS:
+            observe_latency(k[:-2], float(stages.get(k, 0.0) or 0.0))
+        EV.emit("servingAdmission", op="complete", serve_id=sub.serve_id,
+                latency_s=round(e2e, 6),
+                resolved=str(sub.info.get("resolved", "")),
+                error=sub.error is not None,
+                **{k: round(float(stages.get(k, 0.0) or 0.0), 6)
+                   for k in STAGE_KEYS})
 
     def _execute(self, sub: Submission, query, conf):
         from spark_rapids_tpu.aux.tracing import query_scope
         from spark_rapids_tpu.serving.signature import plan_pins
         from spark_rapids_tpu.session import collect_with_speculation
+        stages = sub.info.get("stages")
+        t_lk = time.monotonic()
         df = self._build_df(query)
         plan = df._plan
         sig = plan_signature(plan)
@@ -423,6 +531,8 @@ class QueryServer:
             rkey = hashlib.sha1(
                 (cdig + ":" + sig.exact).encode()).hexdigest()
         cached = self.result_cache.lookup(rkey, fps)
+        if stages is not None:
+            stages["lookup_s"] = round(time.monotonic() - t_lk, 6)
         if cached is not None:
             sub.info["resolved"] = "result_cache"
             return cached
@@ -473,10 +583,27 @@ class QueryServer:
                 q.attach_plan(out)
             return out
 
+        def timed_prepared_plan():
+            # plan_s accumulates across speculation replays (the rare
+            # re-plan path invokes this more than once)
+            t = time.monotonic()
+            try:
+                return prepared_plan()
+            finally:
+                if stages is not None:
+                    stages["plan_s"] = round(
+                        stages["plan_s"] + time.monotonic() - t, 6)
+
+        from spark_rapids_tpu.aux import transitions as TR
+        from spark_rapids_tpu.exec import stage_compiler as SC
+        compile_s0 = float(SC.stats()["compile_s"])
+        tr0 = TR.snapshot()
+        t_exec = time.monotonic()
         qe = None
         try:
             with query_scope(conf, f"serve:{sub.tag}") as qe:
-                batch = collect_with_speculation(conf, prepared_plan)
+                batch = collect_with_speculation(conf,
+                                                 timed_prepared_plan)
         except BaseException:
             # a FAILED execution may leave the plan's exec instances
             # with poisoned memoized state (a speculative pass that
@@ -492,6 +619,21 @@ class QueryServer:
             lease = lease_box.get("lease")
             if lease is not None:
                 lease.release()
+        if stages is not None:
+            # decompose the execution wall: compile from the stage
+            # compiler's measured delta (process-wide — concurrent
+            # peers' compiles can bleed in, same caveat as every shared
+            # counter), collect as the transition ledger's D2H fetch
+            # seconds, execute as the clamped remainder
+            exec_wall = max(0.0, time.monotonic() - t_exec)
+            compile_s = max(0.0,
+                            float(SC.stats()["compile_s"]) - compile_s0)
+            collect_s = float(TR.snapshot().delta(tr0).get("d2h_s", 0.0))
+            stages["compile_s"] = round(compile_s, 6)
+            stages["collect_s"] = round(collect_s, 6)
+            stages["execute_s"] = round(
+                max(0.0, exec_wall - stages["plan_s"] - compile_s
+                    - collect_s), 6)
         self.result_cache.put(rkey, fps, batch, pins=plan_pins(plan))
         if self.autotune_enabled and qe is not None:
             self._autotune_step(qe)

@@ -57,6 +57,11 @@ class TpuSession:
         from spark_rapids_tpu.aux.lockorder import sync_from_conf \
             as sync_lockorder
         sync_lockorder(self.conf)
+        # host-transition ledger (spark.rapids.sql.transitions.*): arm
+        # the instrumented sync/transfer gateway
+        from spark_rapids_tpu.aux.transitions import sync_from_conf \
+            as sync_transitions
+        sync_transitions(self.conf)
         # device mesh (spark.rapids.mesh.*): validate + activate from the
         # conf, emitting a meshTopology event; a bad shape fails HERE,
         # not at the first collective
@@ -100,6 +105,10 @@ class TpuSession:
             from spark_rapids_tpu.aux.lockorder import sync_from_conf \
                 as sync_lockorder
             sync_lockorder(self.conf)
+        elif key.startswith("spark.rapids.sql.transitions."):
+            from spark_rapids_tpu.aux.transitions import sync_from_conf \
+                as sync_transitions
+            sync_transitions(self.conf)
         elif key.startswith("spark.rapids.mesh."):
             from spark_rapids_tpu.parallel.mesh import sync_from_conf \
                 as sync_mesh

@@ -7,6 +7,9 @@ Commands:
 - ``autotune <event-log>``: rule-based conf recommendations with cited
   evidence; ``--json`` prints the ready-to-apply conf dict.
 - ``compare <bench.json ...>``: diff BENCH payloads across runs/PRs.
+- ``trace <event-log>``: render the log as Chrome-trace/Perfetto JSON
+  (load in chrome://tracing or ui.perfetto.dev); ``--check`` fails on
+  transitions unattributed to any query.
 - ``lint [path]``: static engine-invariant analysis (docs/lint.md);
   exits non-zero on any unsuppressed finding.
 - ``audit <event-log>``: compiled-program audit over the stageProgram
@@ -46,6 +49,18 @@ def _build_parser() -> argparse.ArgumentParser:
     at.add_argument("log")
     at.add_argument("--json", action="store_true",
                     help="print only the ready-to-apply conf dict")
+
+    tr = sub.add_parser("trace",
+                        help="Chrome-trace/Perfetto JSON timeline export")
+    tr.add_argument("log", help="JSONL event log path (rotated .N "
+                                "siblings read automatically)")
+    tr.add_argument("--query", type=int, default=None,
+                    help="only this query id")
+    tr.add_argument("-o", "--out", default=None,
+                    help="write the trace JSON here (default: stdout)")
+    tr.add_argument("--check", action="store_true",
+                    help="exit non-zero if any hostTransition/deviceSync "
+                         "event is unattributed to a query")
 
     cmp_p = sub.add_parser("compare", help="diff BENCH_r*.json payloads")
     cmp_p.add_argument("files", nargs="+")
@@ -122,6 +137,24 @@ def main(argv=None) -> int:
             print(json.dumps(to_conf_dict(recs), indent=2))
         else:
             sys.stdout.write(render_recommendations(recs))
+        return 0
+    if args.cmd == "trace":
+        from spark_rapids_tpu.tools.trace import render_trace, trace_from_log
+        trace, unattributed, _diag = trace_from_log(args.log,
+                                                    query_id=args.query)
+        text = render_trace(trace)
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                f.write(text)
+            print(f"wrote {len(trace['traceEvents'])} trace event(s) "
+                  f"to {args.out}")
+        else:
+            print(text)
+        if unattributed:
+            print(f"!! {unattributed} hostTransition/deviceSync event(s) "
+                  "unattributed to any query", file=sys.stderr)
+            if args.check:
+                return 1
         return 0
     if args.cmd == "compare":
         from spark_rapids_tpu.tools.compare import compare, render_compare

@@ -182,6 +182,69 @@ class AotSiteRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# sync-site
+# ---------------------------------------------------------------------------
+
+class SyncSiteRule(Rule):
+    """The host-transition ledger (aux/transitions.py) can only claim
+    'every blocking device sync is counted' if no code syncs around it.
+    A raw ``block_until_ready`` / ``jax.device_get`` is a sync the
+    ledger, tools profile and tools trace never see."""
+
+    id = "sync-site"
+    invariant = ("block_until_ready / jax.device_get only inside "
+                 "aux/transitions.py; every blocking device sync "
+                 "routes through the instrumented gateway")
+    rationale = ("the transition ledger's per-query sync counts/seconds "
+                 "(and tools profile's transitions/sync buckets) are "
+                 "only trustworthy if the gateway sees every sync; a "
+                 "raw sync is invisible latency")
+    hint = ("sync through aux.transitions — block_until_ready(x, site), "
+            "device_get(x, site), fetch(arr, site) or sync_int(x, site) "
+            "— so it is timed, counted and attributed; or annotate "
+            "'# lint: ok=sync-site' with a reason")
+
+    ALLOWED_FILES = ("aux/transitions.py",)
+    _BANNED = frozenset({"block_until_ready", "device_get"})
+
+    def check_file(self, ctx: LintContext, pf: ParsedFile) -> None:
+        if pf.rel in self.ALLOWED_FILES:
+            return
+        # names imported straight off jax ('from jax import device_get')
+        jax_imported: Set[str] = set()
+        for node in pf.nodes:
+            if isinstance(node, ast.ImportFrom) and node.module == "jax":
+                for alias in node.names:
+                    if alias.name in self._BANNED:
+                        jax_imported.add(alias.asname or alias.name)
+        for node in pf.nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            bad = None
+            if isinstance(fn, ast.Attribute) and \
+                    fn.attr == "block_until_ready":
+                # method form (arr.block_until_ready()) and module form
+                # (jax.block_until_ready(x)) are both raw syncs; the
+                # gateway's own wrapper is a same-named attribute on the
+                # transitions module alias — not a sync at the call site
+                recv = fn.value
+                if not (isinstance(recv, ast.Name)
+                        and recv.id in ("TR", "transitions")):
+                    bad = "block_until_ready"
+            elif isinstance(fn, ast.Attribute) and \
+                    fn.attr == "device_get" and \
+                    isinstance(fn.value, ast.Name) and fn.value.id == "jax":
+                bad = "jax.device_get"
+            elif isinstance(fn, ast.Name) and fn.id in jax_imported:
+                bad = f"jax {fn.id}"
+            if bad:
+                self.report(ctx, pf.rel, node.lineno,
+                            f"raw {bad}(...) outside the transition "
+                            "gateway")
+
+
+# ---------------------------------------------------------------------------
 # conf-registry
 # ---------------------------------------------------------------------------
 
@@ -920,6 +983,7 @@ def default_rules() -> List[Rule]:
     return [
         JitSiteRule(),
         AotSiteRule(),
+        SyncSiteRule(),
         ConfRegistryRule(),
         EventCatalogRule(),
         TracedPurityRule(),

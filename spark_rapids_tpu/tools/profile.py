@@ -37,7 +37,8 @@ from spark_rapids_tpu.tools.reader import (QueryProfile, ReadDiagnostics,
 #: decomposition buckets, render order
 BUCKETS = ("decode", "h2d", "compute", "d2h", "shuffle", "ici",
            "producer_stall", "consumer_stall", "spill", "recovery",
-           "semaphore", "arbitration", "compile", "other")
+           "semaphore", "arbitration", "compile", "transitions", "sync",
+           "other")
 
 _DECODE_MARKERS = ("Scan", "Range", "InMemory", "Csv", "Parquet", "Json",
                    "Orc", "Avro", "Hive", "Text", "Cached")
@@ -167,6 +168,24 @@ def attribute(profile: QueryProfile) -> Attribution:
     if not blocked_evs:
         raw["arbitration"] += float(
             summary.get("alloc_wait_s", 0.0) or 0.0)
+    # host-transition ledger (schema v4): measured per-boundary transfer
+    # and sync durations; the queryEnd 'transitions' aggregate is the
+    # fallback when the ring dropped the events (never both).  Overlap
+    # with the h2d/d2h span buckets reconciles through the proportional
+    # scaling, like compile and ici.
+    ledger = summary.get("transitions") or {}
+    tr_evs = profile.events_of("hostTransition")
+    for ev in tr_evs:
+        raw["transitions"] += float(
+            ev.payload.get("duration_s", 0.0) or 0.0)
+    if not tr_evs and ledger:
+        raw["transitions"] += float(ledger.get("h2d_s", 0.0) or 0.0) \
+            + float(ledger.get("d2h_s", 0.0) or 0.0)
+    sync_evs = profile.events_of("deviceSync")
+    for ev in sync_evs:
+        raw["sync"] += float(ev.payload.get("duration_s", 0.0) or 0.0)
+    if not sync_evs and ledger:
+        raw["sync"] += float(ledger.get("sync_s", 0.0) or 0.0)
     # recovery transition counts (no duration carried for task retries —
     # reported as counts, their re-run time shows in the operator buckets)
     recovery_counts: Dict[str, int] = {}
@@ -198,6 +217,34 @@ def attribute(profile: QueryProfile) -> Attribution:
     return Attribution(wall, {b: round(v, 6) for b, v in raw.items()},
                        {b: round(v, 6) for b, v in scaled.items()},
                        operators, bottleneck, recovery_counts)
+
+
+def _transition_ledger(profile: QueryProfile) -> Dict:
+    """The per-query transition ledger: the queryEnd aggregate when
+    present (authoritative — snapshot-delta, immune to ring drops), else
+    re-summed from the surviving hostTransition/deviceSync events."""
+    ledger = (profile.summary or {}).get("transitions")
+    if ledger:
+        return {"h2d_count": int(ledger.get("h2d_count", 0) or 0),
+                "h2d_bytes": int(ledger.get("h2d_bytes", 0) or 0),
+                "h2d_s": float(ledger.get("h2d_s", 0.0) or 0.0),
+                "d2h_count": int(ledger.get("d2h_count", 0) or 0),
+                "d2h_bytes": int(ledger.get("d2h_bytes", 0) or 0),
+                "d2h_s": float(ledger.get("d2h_s", 0.0) or 0.0),
+                "sync_count": int(ledger.get("sync_count", 0) or 0),
+                "sync_s": float(ledger.get("sync_s", 0.0) or 0.0)}
+    out = {"h2d_count": 0, "h2d_bytes": 0, "h2d_s": 0.0,
+           "d2h_count": 0, "d2h_bytes": 0, "d2h_s": 0.0,
+           "sync_count": 0, "sync_s": 0.0}
+    for ev in profile.events_of("hostTransition"):
+        d = "h2d" if ev.payload.get("direction") == "h2d" else "d2h"
+        out[f"{d}_count"] += 1
+        out[f"{d}_bytes"] += int(ev.payload.get("bytes", 0) or 0)
+        out[f"{d}_s"] += float(ev.payload.get("duration_s", 0.0) or 0.0)
+    for ev in profile.events_of("deviceSync"):
+        out["sync_count"] += 1
+        out["sync_s"] += float(ev.payload.get("duration_s", 0.0) or 0.0)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -318,6 +365,17 @@ def render_report(profiles: List[QueryProfile], diag: ReadDiagnostics,
         if att.recovery_counts:
             lines.append("  Recovery ledger: " + " ".join(
                 f"{k}={v}" for k, v in sorted(att.recovery_counts.items())))
+        ledger = _transition_ledger(qp)
+        if any(ledger.values()):
+            lines.append(
+                f"  Transitions: h2d={ledger['h2d_count']} "
+                f"({_fmt_bytes(ledger['h2d_bytes'])} "
+                f"{ledger['h2d_s']:.4f}s) "
+                f"d2h={ledger['d2h_count']} "
+                f"({_fmt_bytes(ledger['d2h_bytes'])} "
+                f"{ledger['d2h_s']:.4f}s) "
+                f"syncs={ledger['sync_count']} "
+                f"({ledger['sync_s']:.4f}s)")
         enc_evs = qp.events_of("encodedBatch")
         fb_evs = qp.events_of("encodingFallback")
         if enc_evs or fb_evs:
@@ -411,6 +469,7 @@ def profiles_to_json(profiles: List[QueryProfile],
             "bottleneck": att.bottleneck,
             "buckets_scaled_s": att.scaled,
             "buckets_raw_s": att.raw,
+            "transitions": _transition_ledger(qp),
             "recovery": att.recovery_counts,
             "samples": len(qp.samples),
             "operators": [dataclasses.asdict(o)

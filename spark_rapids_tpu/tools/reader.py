@@ -20,9 +20,11 @@ processes are the EXPECTED input, not a corner case:
   tree from ``parent_id``/``depth`` and per-partition timelines from the
   ``partitions`` payload; v3 adds the compiled-program audit rows
   (``stageProgram``, ``planInvariantViolation``) which ride through as
-  ordinary events (tools/audit consumes them).  A version newer than
-  ``SUPPORTED_VERSIONS`` raises — guessing at future schemas would
-  corrupt attribution.
+  ordinary events (tools/audit consumes them); v4 adds the
+  host-transition ledger rows (``hostTransition``, ``deviceSync``) from
+  aux/transitions.py, consumed by tools/profile and tools/trace.  A
+  version newer than ``SUPPORTED_VERSIONS`` raises — guessing at future
+  schemas would corrupt attribution.
 
 This module imports only the standard library plus ``aux.events`` (also
 stdlib-only), so the CLI runs without jax or a device runtime.
@@ -41,7 +43,7 @@ from typing import Dict, List, Optional, Tuple
 from spark_rapids_tpu.aux.events import NO_QUERY, Event
 
 #: schema versions this reader understands (events carry "v" per line)
-SUPPORTED_VERSIONS = (1, 2, 3)
+SUPPORTED_VERSIONS = (1, 2, 3, 4)
 
 
 @dataclasses.dataclass

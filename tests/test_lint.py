@@ -366,6 +366,42 @@ def test_collective_site_rule(tmp_path):
     assert not _findings(report, "collective-site")
 
 
+def test_sync_site_rule(tmp_path):
+    from spark_rapids_tpu.tools.lint.rules import SyncSiteRule
+    bad = """
+        import jax
+        from jax import device_get as dget
+
+        def raw_syncs(arr, x):
+            arr.block_until_ready()          # method form
+            jax.block_until_ready(x)         # module form
+            y = jax.device_get(x)            # attr form
+            return dget(y)                   # from-import alias
+    """
+    report = _lint_snippet(tmp_path, bad, [SyncSiteRule()])
+    finds = _findings(report, "sync-site")
+    assert len(finds) == 4, [f.message for f in finds]
+    # the gateway itself is the sanctioned home
+    root = tmp_path / "pkg"
+    (root / "aux").mkdir(parents=True)
+    (root / "aux" / "transitions.py").write_text(textwrap.dedent(bad))
+    from spark_rapids_tpu.tools.lint import run_lint
+    report = run_lint(root=str(root), rules=[SyncSiteRule()],
+                      baseline_path="")
+    assert not _findings(report, "sync-site")
+    # gateway wrappers at the call site are not raw syncs
+    clean = """
+        from spark_rapids_tpu.aux import transitions as TR
+
+        def fine(arr, x):
+            TR.block_until_ready(arr, site="dispatch")
+            return TR.device_get(x, site="test")
+    """
+    report = _lint_snippet(tmp_path, clean, [SyncSiteRule()],
+                           name="clean.py")
+    assert not _findings(report, "sync-site")
+
+
 def test_encoded_materialize_rule(tmp_path):
     from spark_rapids_tpu.tools.lint.rules import EncodedMaterializeRule
     bad = """
@@ -540,10 +576,10 @@ def test_json_schema(tmp_path):
     assert d["version"] == 1
     assert d["files_scanned"] == 1
     assert {r["id"] for r in d["rules"]} == {
-        "jit-site", "aot-site", "conf-registry", "event-catalog",
-        "traced-purity", "spillable-close", "fault-point", "retry-frame",
-        "encoded-materialize", "collective-site", "lock-order",
-        "conf-module-global"}
+        "jit-site", "aot-site", "sync-site", "conf-registry",
+        "event-catalog", "traced-purity", "spillable-close",
+        "fault-point", "retry-frame", "encoded-materialize",
+        "collective-site", "lock-order", "conf-module-global"}
     (f,) = [f for f in d["findings"] if f["rule"] == "jit-site"]
     assert set(f) == {"rule", "severity", "file", "line", "message",
                       "hint", "suppressed"}

@@ -708,9 +708,15 @@ def test_prometheus_format_types_escaping_monotonicity():
     s.create_dataframe({"a": np.arange(200, dtype=np.int64)}).count()
     text1 = EV.render_prometheus()
     types1, samples1 = _parse_prometheus(text1)
-    # every sample line's metric family has a TYPE line
+    # every sample line's metric family has a TYPE line (histogram
+    # series sample as <family>_bucket/_sum/_count under one TYPE line)
     for name in samples1:
         family = name.split("{")[0]
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = family[:-len(suffix)] if family.endswith(suffix) else ""
+            if types1.get(base) == "histogram":
+                family = base
+                break
         assert family in types1, f"sample {name} missing # TYPE"
     # new gauges are present
     assert "spark_rapids_tpu_device_pool_peak_bytes" in samples1
@@ -751,6 +757,9 @@ def test_bench_event_log_payload_smoke(tmp_path):
     assert payload["profile_ok"] is True, payload
     assert payload["queries"] == 1
     assert payload["events"] > 0
+    # the per-query transition ledger rides the payload (schema v4)
+    (led,) = payload["transitions"].values()
+    assert led["d2h_count"] >= 1 and led["d2h_bytes"] > 0
     bad = bench._event_log_payload(str(tmp_path / "missing.jsonl"))
     assert bad["profile_ok"] is False and "error" in bad
 
