@@ -5,21 +5,21 @@
 # and tests/test_audit.py run the same linter/auditor as their gate
 # tests) but fails in seconds instead of minutes.
 #
-#   scripts/check.sh            # lint + audit smoke + trace round-trip + serving smoke + smoke tests
+#   scripts/check.sh            # lint + audit smoke + trace round-trip + history round-trip + serving smoke + smoke tests
 #   scripts/check.sh --lint-only
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== 1/5 engine invariant lint =="
+echo "== 1/6 engine invariant lint =="
 python -m spark_rapids_tpu.tools lint
 
 if [[ "${1:-}" == "--lint-only" ]]; then
     exit 0
 fi
 
-echo "== 2/5 compiled-program audit smoke =="
+echo "== 2/6 compiled-program audit smoke =="
 AUDIT_LOG="$(mktemp -d)/audit_smoke.jsonl"
 python - "$AUDIT_LOG" <<'PY'
 import sys
@@ -44,7 +44,7 @@ PY
 # report-only here (no peak floor configured)
 python -m spark_rapids_tpu.tools audit "$AUDIT_LOG" --no-roofline
 
-echo "== 3/5 transition-ledger trace round-trip =="
+echo "== 3/6 transition-ledger trace round-trip =="
 # the audit smoke's own log round-trips through the Perfetto exporter:
 # --check fails on any hostTransition/deviceSync the gateway saw that
 # no query owns (unattributed = invisible latency), and the rendered
@@ -65,9 +65,35 @@ assert any(e["cat"] == "hostTransition" for e in slices), \
 print(f"trace round-trip ok: {len(evs)} events, "
       f"{sum(1 for e in slices if e['cat'] == 'hostTransition')} transition slice(s)")
 PY
+
+echo "== 4/6 history warehouse round-trip =="
+# the audit smoke's log ingests (twice, as two labeled runs) into a
+# fresh warehouse, calibrates a machine profile whose own residual
+# bound must cover >=80% of observations, and the trajectory sentinel
+# must stay quiet on a healthy (identical) repeat
+HIST_DB="$(dirname "$AUDIT_LOG")/history.db"
+MACHINE_JSON="$(dirname "$AUDIT_LOG")/machine.json"
+python -m spark_rapids_tpu.tools history ingest "$AUDIT_LOG" --db "$HIST_DB" --label run1
+python -m spark_rapids_tpu.tools history ingest "$AUDIT_LOG" --db "$HIST_DB" --label run2
+python -m spark_rapids_tpu.tools history calibrate --db "$HIST_DB" -o "$MACHINE_JSON"
+python - "$MACHINE_JSON" <<'PY'
+import json
+import sys
+
+prof = json.load(open(sys.argv[1]))
+assert prof["schema"] == "spark-rapids-tpu-machine-profile", prof["schema"]
+assert prof["stage_kinds"], "calibration produced no stage kinds"
+assert prof["within_bound_frac"] >= 0.8, prof
+print(f"machine profile ok: {len(prof['stage_kinds'])} stage kind(s), "
+      f"{prof['observations']} observation(s), "
+      f"{prof['within_bound_frac'] * 100:.0f}% within "
+      f"+/-{prof['residual_bound'] * 100:.1f}%")
+PY
+python -m spark_rapids_tpu.tools history regress --db "$HIST_DB" --min-runs 1
+python -m spark_rapids_tpu.tools history report --db "$HIST_DB"
 rm -rf "$(dirname "$AUDIT_LOG")"
 
-echo "== 4/5 concurrent-serving smoke =="
+echo "== 5/6 concurrent-serving smoke =="
 # two queries racing through the QueryServer: both admitted, results
 # bit-identical to a serial run, and the exact repeat skips planning
 python - <<'PY'
@@ -99,5 +125,5 @@ finally:
 print("serving smoke ok:", st["admission"], st["plan_cache"])
 PY
 
-echo "== 5/5 smoke test tier =="
+echo "== 6/6 smoke test tier =="
 python -m pytest tests/ -q -m smoke -p no:cacheprovider

@@ -105,6 +105,9 @@ EVENT_KINDS = frozenset({
     # admission lifecycle, the two cross-query caches, and the online
     # AutoTuner's applied conf deltas
     "servingAdmission", "planCache", "resultCache", "autotuneApplied",
+    # calibrated cost-model cross-check (aux/tracing.py): predicted vs
+    # measured wall time from the tools/history machine profile
+    "costModel",
 })
 
 

@@ -372,6 +372,14 @@ class QueryExecution:
             ledger = TR.snapshot().delta(self._transitions_snapshot)
             if TR.enabled():
                 summary["transitions"] = ledger
+        # calibrated cost-model cross-check (report-only; docs/history.md):
+        # predicted wall time from the tools/history machine profile vs
+        # this query's measured duration, emitted before sinks close so
+        # the residual lands in the event log for `tools audit`
+        cost = self._cost_crosscheck(plan, summary["duration_s"])
+        if cost is not None:
+            summary["cost"] = cost
+            self.record_event("costModel", cost)
         self.summary_dict = summary
         self.record_event("queryEnd",
                           {k: v for k, v in summary.items()
@@ -382,6 +390,42 @@ class QueryExecution:
         with _LAST_LOCK:
             _LAST_SUMMARY = summary
         return summary
+
+    def _cost_crosscheck(self, plan, measured_s: float):
+        """Predicted-vs-measured residual against the configured machine
+        profile, or None when no profile is set/loadable.  Defaults are
+        absent from ``conf_snapshot`` (non-default-only), so a missing
+        path key simply means the cost model is off."""
+        if plan is None:
+            return None
+        from spark_rapids_tpu import config as C
+        path = self.conf_snapshot.get(C.HISTORY_MACHINE_PROFILE_PATH.key)
+        enabled = self.conf_snapshot.get(
+            C.HISTORY_COST_MODEL_ENABLED.key,
+            C.HISTORY_COST_MODEL_ENABLED.default)
+        if not path or not enabled:
+            return None
+        try:
+            from spark_rapids_tpu.plan.cost import (load_machine_profile,
+                                                    predict_plan_costs)
+            profile = load_machine_profile(str(path))
+            if profile is None:
+                return None
+            rows = predict_plan_costs(plan, profile)
+            predicted = sum(r["predicted_s"] for r in rows
+                            if r["predicted_s"] is not None)
+            covered = sum(1 for r in rows
+                          if r["predicted_s"] is not None)
+            residual = ((measured_s - predicted) / measured_s
+                        if measured_s > 0 else 0.0)
+            return {"profile_version": profile.version,
+                    "residual_bound": profile.residual_bound,
+                    "predicted_s": round(predicted, 6),
+                    "measured_s": round(measured_s, 6),
+                    "residual": round(residual, 6),
+                    "nodes": len(rows), "covered": covered}
+        except Exception:   # noqa: BLE001 - report-only, never fails a query
+            return None
 
     def _exec_spans(self) -> List[Span]:
         out: List[Span] = []

@@ -1060,6 +1060,58 @@ SERVING_AUTOTUNE_ENABLED = conf_bool(
     False)
 
 
+# ---------------------------------------------------------------------------
+# cross-run metrics warehouse + calibrated cost model (tools/history)
+# ---------------------------------------------------------------------------
+
+HISTORY_PATH = conf_str(
+    "spark.rapids.history.path",
+    "Path of the persistent cross-run history warehouse (SQLite). When "
+    "set, bench.py auto-ingests each run's payload and event log after "
+    "the benchmark completes, so `tools history regress|calibrate` "
+    "accumulate a baseline without manual ingestion. Empty disables. "
+    "Reference: the spark-rapids-tools Qualification/Profiling store "
+    "over Spark event logs.",
+    "")
+
+HISTORY_MACHINE_PROFILE_PATH = conf_str(
+    "spark.rapids.history.machineProfilePath",
+    "Path of a machine-profile JSON artifact written by `tools history "
+    "calibrate`. When set (and costModel.enabled), df.explain() renders "
+    "a report-only `== Cost ==` section with per-operator predicted "
+    "cost from the calibrated profile, and each query's end-of-run "
+    "summary cross-checks prediction vs measured per-stage time "
+    "(queryEnd `cost` block + a costModel event for `tools audit`). "
+    "Never changes plans or results. Empty disables.",
+    "")
+
+HISTORY_COST_MODEL_ENABLED = conf_bool(
+    "spark.rapids.history.costModel.enabled",
+    "Master switch for the report-only predicted-cost annotation layer "
+    "(the `== Cost ==` explain section and the post-run predicted-vs-"
+    "measured cross-check). Only meaningful when machineProfilePath is "
+    "set; leaves query execution and results bit-identical either way.",
+    True)
+
+HISTORY_REGRESS_MIN_RUNS = conf_int(
+    "spark.rapids.history.regress.minRuns",
+    "Baseline runs `tools history regress` requires per query/metric "
+    "before trusting a verdict; with fewer samples the metric is "
+    "skipped (reported, never failed). Guards cold warehouses from "
+    "judging against noise.",
+    3,
+    checker=lambda v: v >= 1)
+
+HISTORY_REGRESS_MAD_BANDS = conf_float(
+    "spark.rapids.history.regress.madBands",
+    "Noise-band multiplier for `tools history regress`: the band "
+    "around the baseline median is max(5% of |median|, madBands x "
+    "1.4826 x MAD), so genuinely noisy metrics widen their own band "
+    "instead of flagging every run (1.4826 scales the median absolute "
+    "deviation to a Gaussian sigma).",
+    3.0)
+
+
 class TpuConf:
     """Immutable snapshot of config values (reference: ``new RapidsConf(conf)``
     re-read per query, GpuOverrides.scala:4564)."""

@@ -44,26 +44,29 @@ _REGION_FIXED = 10_000.0
 
 
 def estimate_rows(plan: Exec) -> int:
-    """Bottom-up row estimate (reference: RowCountPlanVisitor)."""
+    """Bottom-up row estimate (reference: RowCountPlanVisitor).  Suffix
+    matching covers BOTH engines' node names (CpuFilterExec and
+    TpuFilterExec alike) so the machine-profile predictor below can
+    estimate the rewritten TPU plan, not just the CPU input."""
     name = type(plan).__name__
     kids = [estimate_rows(c) for c in plan.children]
-    if name == "CpuInMemoryScanExec":
+    if name.endswith("InMemoryScanExec"):
         try:
             return sum(b.row_count for part in plan.partitions
                        for b in part)
         except Exception:    # noqa: BLE001
             return DEFAULT_ROWS
-    if name == "CpuRangeExec":
+    if name.endswith("RangeExec"):
         try:
             return max(0, (plan.end - plan.start) // plan.step)
         except Exception:    # noqa: BLE001
             return DEFAULT_ROWS
-    if name == "CpuFilterExec":
+    if name.endswith("FilterExec"):
         return max(1, (kids[0] if kids else DEFAULT_ROWS) // 2)
-    if name in ("CpuLimitExec", "CpuGlobalLimitExec"):
+    if name.endswith(("LimitExec", "GlobalLimitExec")):
         return min(getattr(plan, "n", DEFAULT_ROWS),
                    kids[0] if kids else DEFAULT_ROWS)
-    if name == "CpuHashAggregateExec":
+    if name.endswith(("HashAggregateExec", "FusedAggExec")):
         return max(1, (kids[0] if kids else DEFAULT_ROWS) // 10)
     if kids:
         return max(kids)
@@ -128,3 +131,153 @@ class CostBasedOptimizer:
             for m in region:
                 m.will_not_work(reason)
             notes.append(f"{region[0].plan.name}: {reason}")
+
+
+# ---------------------------------------------------------------------------
+# calibrated machine-profile prediction (tools/history calibrate artifact)
+# ---------------------------------------------------------------------------
+#
+# The factors above are static guesses; this layer predicts from what the
+# machine actually measured.  `tools history calibrate` fits, per
+# stage-kind family, t ≈ fixed_s_per_batch·batches + per_row_s·rows over
+# the warehouse's accumulated span observations (plus H2D/D2H bandwidth
+# from the transition ledger), and this module applies that fit to an
+# un-run plan: rows from estimate_rows, batches from the partition
+# count, bytes from the schema row width.  Strictly REPORT-ONLY — the
+# `== Cost ==` explain section and the post-run predicted-vs-measured
+# cross-check (aux/tracing.py) read it; nothing about plan selection or
+# results changes.
+
+MACHINE_PROFILE_SCHEMA = "spark-rapids-tpu-machine-profile"
+
+#: one-slot (path, mtime) -> MachineProfile memo: explain() and every
+#: query-end cross-check reload the same artifact
+_PROFILE_CACHE: Dict = {}
+
+
+class MachineProfile:
+    """A loaded calibration artifact."""
+
+    def __init__(self, doc: Dict):
+        if doc.get("schema") != MACHINE_PROFILE_SCHEMA:
+            raise ValueError(
+                f"not a machine profile (schema={doc.get('schema')!r})")
+        self.doc = doc
+        self.version = int(doc.get("version", 0))
+        self.stage_kinds: Dict[str, Dict] = doc.get("stage_kinds", {})
+        self.transfer: Dict[str, Dict] = doc.get("transfer", {}) or {}
+        self.residual_bound = float(doc.get("residual_bound", 0.0))
+        self.runs = int(doc.get("runs", 0))
+        self.observations = int(doc.get("observations", 0))
+
+    @staticmethod
+    def load(path: str) -> "MachineProfile":
+        import json
+        import os
+        mtime = os.path.getmtime(path)
+        hit = _PROFILE_CACHE.get(path)
+        if hit is not None and hit[0] == mtime:
+            return hit[1]
+        with open(path, encoding="utf-8") as f:
+            prof = MachineProfile(json.load(f))
+        _PROFILE_CACHE[path] = (mtime, prof)
+        return prof
+
+    def predict_stage(self, family: str, rows: int,
+                      batches: int) -> Optional[float]:
+        e = self.stage_kinds.get(family)
+        if e is None:
+            return None
+        return (float(e.get("fixed_s_per_batch", 0.0)) * max(batches, 1)
+                + float(e.get("per_row_s", 0.0)) * max(rows, 0))
+
+    def predict_transfer(self, direction: str, nbytes: int,
+                         batches: int) -> Optional[float]:
+        fit = self.transfer.get(direction)
+        if not fit:
+            return None
+        bps = fit.get("bytes_per_s")
+        t = float(fit.get("fixed_s", 0.0)) * max(batches, 1)
+        if bps:
+            t += nbytes / float(bps)
+        return t
+
+
+def load_machine_profile(path: str) -> Optional[MachineProfile]:
+    """The artifact at ``path``, or None when it is missing/invalid —
+    the annotation layer is report-only and must never fail a query."""
+    try:
+        return MachineProfile.load(path)
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def node_family(node_name: str) -> Optional[str]:
+    """Stage-kind family of an exec node name (the audit vocabulary)."""
+    from spark_rapids_tpu.tools.history.calibrate import family_for_node
+    return family_for_node(node_name)
+
+
+def _row_width(plan: Exec) -> int:
+    try:
+        return max(1, sum(f.data_type.default_size
+                          for f in plan.schema.fields))
+    except Exception:    # noqa: BLE001 - sizing guess, never fatal
+        return 8
+
+
+def _est_batches(plan: Exec) -> int:
+    try:
+        return max(1, int(plan.num_partitions))
+    except Exception:    # noqa: BLE001
+        return 1
+
+
+def predict_plan_costs(plan: Exec, profile: MachineProfile) -> List[Dict]:
+    """Pre-order rows: one per plan node, ``predicted_s`` None when the
+    profile has no calibration for the node's family."""
+    out: List[Dict] = []
+
+    def walk(node: Exec, depth: int) -> None:
+        name = type(node).__name__
+        rows = estimate_rows(node)
+        batches = _est_batches(node)
+        family = node_family(name)
+        pred = None
+        if family in ("transfer.pack", "transfer.unpack"):
+            direction = "h2d" if family == "transfer.pack" else "d2h"
+            pred = profile.predict_transfer(
+                direction, rows * _row_width(node), batches)
+        if pred is None and family is not None:
+            pred = profile.predict_stage(family, rows, batches)
+        out.append({"node": name, "depth": depth, "family": family,
+                    "rows": rows, "batches": batches,
+                    "predicted_s": (None if pred is None
+                                    else round(pred, 6))})
+        for c in node.children:
+            walk(c, depth + 1)
+
+    walk(plan, 0)
+    return out
+
+
+def render_cost_section(rows: List[Dict],
+                        profile: MachineProfile) -> str:
+    """The ``== Cost ==`` explain section (report-only)."""
+    total = sum(r["predicted_s"] for r in rows
+                if r["predicted_s"] is not None)
+    covered = sum(1 for r in rows if r["predicted_s"] is not None)
+    lines = ["== Cost ==",
+             f"machine profile v{profile.version} "
+             f"({profile.runs} run(s), {profile.observations} obs, "
+             f"residual bound ±{profile.residual_bound * 100:.1f}%); "
+             f"predicted total {total:.6f}s over {covered}/{len(rows)} "
+             "node(s)"]
+    for r in rows:
+        pred = ("-" if r["predicted_s"] is None
+                else f"{r['predicted_s']:.6f}s")
+        fam = r["family"] or "-"
+        lines.append("  " * r["depth"]
+                     + f"{r['node']} [{fam}] rows~{r['rows']} "
+                       f"cost~{pred}")
+    return "\n".join(lines)

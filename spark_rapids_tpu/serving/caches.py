@@ -237,13 +237,16 @@ class ResultCache:
     def _write_spill(self, key: str, batch) -> str:
         """Serializes one batch to the arrow tier — called OUTSIDE the
         cache lock (the write is the expensive part; peers keep
-        hitting)."""
-        import pyarrow as pa
+        hitting).  Uses the shuffle serializer's codec frame with the
+        catalog's spill codec (``spark.rapids.memory.spill.codec``), so
+        result-cache spill files compress through the same lz4/zlib
+        path every other host->disk spill does."""
+        from spark_rapids_tpu.memory import catalog as CAT
+        from spark_rapids_tpu.shuffle.serializer import serialize_batch
         path = os.path.join(self._ensure_spill_dir(), f"{key}.arrow")
-        rb = batch.to_arrow()
-        with pa.OSFile(path, "wb") as f, \
-                pa.ipc.new_file(f, rb.schema) as w:
-            w.write_batch(rb)
+        frame = serialize_batch(batch, CAT.SPILL_CODEC)
+        with open(path, "wb") as fh:
+            fh.write(frame)
         return path
 
     def _spill_victims(self, victims) -> None:
@@ -273,11 +276,9 @@ class ResultCache:
                     pass
 
     def _load(self, path: str):
-        import pyarrow as pa
-        from spark_rapids_tpu.columnar.batch import batch_from_arrow
-        with pa.OSFile(path, "rb") as f:
-            table = pa.ipc.open_file(f).read_all()
-        return batch_from_arrow(table)
+        from spark_rapids_tpu.shuffle.serializer import deserialize_batch
+        with open(path, "rb") as f:
+            return deserialize_batch(f.read())
 
     def _drop(self, key: str, e: _ResultEntry) -> None:
         if e.batch is not None:

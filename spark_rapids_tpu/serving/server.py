@@ -479,6 +479,20 @@ class QueryServer:
         t0 = time.monotonic()
         stages = sub.info["stages"] = {k: 0.0 for k in STAGE_KEYS}
         stages["queue_wait_s"] = round(t0 - sub.submitted, 6)
+        # result-cache probe BEFORE admission: a cached result needs no
+        # device memory reservation, so a hit must not queue behind (or
+        # steal a slot from) queries that actually execute
+        try:
+            probe = self._probe_result_cache(sub, query, stages)
+        except BaseException as e:  # noqa: BLE001 - handed to caller
+            sub._finish(error=e)
+            self._observe_stages(sub)
+            return
+        if probe.get("cached") is not None:
+            sub.info["resolved"] = "result_cache"
+            sub._finish(batch=probe["cached"])
+            self._observe_stages(sub)
+            return
         reserved = self.admission.admit(
             sub.serve_id,
             deadline=sub.submitted + self.admission.timeout_ms / 1000.0)
@@ -490,13 +504,35 @@ class QueryServer:
             sub.info["reserved_bytes"] = reserved
             sub.info["admit_wait_s"] = round(time.monotonic() - t0, 4)
             stages["admit_wait_s"] = sub.info["admit_wait_s"]
-            batch = self._execute(sub, query, conf)
+            batch = self._execute(sub, query, conf, probe=probe)
             sub._finish(batch=batch)
         except BaseException as e:  # noqa: BLE001 - handed to caller
             sub._finish(error=e)
         finally:
             self.admission.release(sub.serve_id)
             self._observe_stages(sub)
+
+    def _probe_result_cache(self, sub: Submission, query,
+                            stages: Dict) -> Dict:
+        """Builds the plan, signs it, and probes the result cache under
+        the CURRENT conf.  The probe (plan/signature/digest) is handed
+        to ``_execute`` so an admitted miss does not re-plan unless the
+        online tuner changed the conf while the query waited."""
+        t_lk = time.monotonic()
+        conf = self.conf
+        df = self._build_df(query)
+        plan = df._plan
+        sig = plan_signature(plan)
+        fps = plan_fingerprints(plan)
+        cdig = self._conf_digest(conf)
+        rkey = None
+        if sig is not None:
+            rkey = hashlib.sha1(
+                (cdig + ":" + sig.exact).encode()).hexdigest()
+        cached = self.result_cache.lookup(rkey, fps)
+        stages["lookup_s"] = round(time.monotonic() - t_lk, 6)
+        return {"cached": cached, "plan": plan, "sig": sig, "fps": fps,
+                "cdig": cdig, "rkey": rkey}
 
     def _observe_stages(self, sub: Submission) -> None:
         """End-of-submission latency decomposition: every stage (and the
@@ -515,24 +551,33 @@ class QueryServer:
                 **{k: round(float(stages.get(k, 0.0) or 0.0), 6)
                    for k in STAGE_KEYS})
 
-    def _execute(self, sub: Submission, query, conf):
+    def _execute(self, sub: Submission, query, conf, probe=None):
         from spark_rapids_tpu.aux.tracing import query_scope
         from spark_rapids_tpu.serving.signature import plan_pins
         from spark_rapids_tpu.session import collect_with_speculation
         stages = sub.info.get("stages")
         t_lk = time.monotonic()
-        df = self._build_df(query)
-        plan = df._plan
-        sig = plan_signature(plan)
-        fps = plan_fingerprints(plan)
-        cdig = self._conf_digest(conf)
-        rkey = None
-        if sig is not None:
-            rkey = hashlib.sha1(
-                (cdig + ":" + sig.exact).encode()).hexdigest()
+        if probe is not None and probe["cdig"] == self._conf_digest(conf):
+            # pre-admission probe still valid: reuse its plan/signature
+            # and re-check only the cache (a concurrent peer may have
+            # published this result while we waited for admission)
+            plan, sig, fps = probe["plan"], probe["sig"], probe["fps"]
+            cdig, rkey = probe["cdig"], probe["rkey"]
+        else:
+            df = self._build_df(query)
+            plan = df._plan
+            sig = plan_signature(plan)
+            fps = plan_fingerprints(plan)
+            cdig = self._conf_digest(conf)
+            rkey = None
+            if sig is not None:
+                rkey = hashlib.sha1(
+                    (cdig + ":" + sig.exact).encode()).hexdigest()
         cached = self.result_cache.lookup(rkey, fps)
         if stages is not None:
-            stages["lookup_s"] = round(time.monotonic() - t_lk, 6)
+            stages["lookup_s"] = round(
+                stages.get("lookup_s", 0.0)
+                + (time.monotonic() - t_lk), 6)
         if cached is not None:
             sub.info["resolved"] = "result_cache"
             return cached

@@ -884,7 +884,31 @@ class DataFrame:
         elided = overrides.last_elided
         out += (f"\n== Distribution ==\nexchangeElided={len(elided)}"
                 + "".join(f"\n  - {e.desc()}" for e in elided))
+        cost = self._cost_section(final)
+        if cost:
+            out += f"\n{cost}"
         return out
+
+    def _cost_section(self, final: Exec) -> str:
+        """Report-only ``== Cost ==`` explain section from the calibrated
+        machine profile (``spark.rapids.history.machineProfilePath``,
+        produced by ``tools history calibrate``).  Empty string when no
+        profile is configured/loadable — explain never fails over it."""
+        conf = self._session.conf
+        path = conf.get(C.HISTORY_MACHINE_PROFILE_PATH.key)
+        if not path or not conf.get(C.HISTORY_COST_MODEL_ENABLED.key):
+            return ""
+        from spark_rapids_tpu.plan.cost import (load_machine_profile,
+                                                predict_plan_costs,
+                                                render_cost_section)
+        profile = load_machine_profile(path)
+        if profile is None:
+            return f"== Cost ==\nmachine profile unreadable: {path}"
+        try:
+            rows = predict_plan_costs(final, profile)
+            return render_cost_section(rows, profile)
+        except Exception as exc:    # noqa: BLE001 - report-only section
+            return f"== Cost ==\nprediction failed: {exc}"
 
     def __repr__(self):
         return f"DataFrame[{self.schema.simple_name}]"

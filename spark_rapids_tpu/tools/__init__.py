@@ -12,11 +12,17 @@ logs PR 1's sink writes:
                  stalls / spill / recovery) plus operator ranking;
 - ``autotune`` — rule-based conf recommendations, each citing the
                  evidence events that triggered it;
-- ``compare``  — BENCH_r*.json diffing across PRs;
+- ``compare``  — BENCH_r*.json diffing across PRs (shared regression
+                 core with ``history regress`` in ``regression``);
 - ``lint``     — static AST analysis of the engine's own source against
-                 its declared invariants (docs/lint.md).
+                 its declared invariants (docs/lint.md);
+- ``history``  — persistent SQLite warehouse across runs: ingest event
+                 logs/BENCH payloads, regress the latest run against
+                 the accumulated baseline, and calibrate the machine
+                 profile ``plan/cost.py`` predicts from (docs/history.md).
 
-CLI: ``python -m spark_rapids_tpu.tools <profile|autotune|compare|lint>``
+CLI: ``python -m spark_rapids_tpu.tools
+<profile|autotune|compare|trace|audit|lint|history>``
 (stdlib-only; runs without jax or a device).
 """
 
@@ -24,6 +30,8 @@ from spark_rapids_tpu.tools.autotune import (Recommendation, autotune,
                                              render_recommendations,
                                              to_conf_dict)
 from spark_rapids_tpu.tools.compare import compare, render_compare
+from spark_rapids_tpu.tools.history import (HistoryWarehouse, calibrate,
+                                            regress)
 from spark_rapids_tpu.tools.profile import (Attribution, attribute,
                                             profiles_to_json,
                                             render_report)
@@ -31,8 +39,9 @@ from spark_rapids_tpu.tools.reader import (QueryProfile, ReadDiagnostics,
                                            load_profiles, read_events)
 
 __all__ = [
-    "Attribution", "QueryProfile", "ReadDiagnostics", "Recommendation",
-    "attribute", "autotune", "compare", "load_profiles",
-    "profiles_to_json", "read_events", "render_compare",
-    "render_recommendations", "render_report", "to_conf_dict",
+    "Attribution", "HistoryWarehouse", "QueryProfile", "ReadDiagnostics",
+    "Recommendation", "attribute", "autotune", "calibrate", "compare",
+    "load_profiles", "profiles_to_json", "read_events", "regress",
+    "render_compare", "render_recommendations", "render_report",
+    "to_conf_dict",
 ]

@@ -16,6 +16,11 @@ Commands:
   ledger (docs/audit.md) — forbidden primitives, baked constants,
   recompile storms, dtype widening, roofline cross-check; exits
   non-zero on any unsuppressed error finding.
+- ``history ingest|report|regress|calibrate``: the persistent SQLite
+  warehouse (docs/history.md) — ingest event logs and BENCH payloads,
+  judge the latest run against the accumulated baseline (nonzero exit
+  on regression), and fit the machine profile ``plan/cost.py`` uses to
+  annotate plans with predicted cost.
 """
 
 from __future__ import annotations
@@ -91,6 +96,46 @@ def _build_parser() -> argparse.ArgumentParser:
     aud.add_argument("--write-baseline", action="store_true",
                      help="grandfather every active finding into the "
                           "baseline file and exit 0")
+
+    hist = sub.add_parser("history",
+                          help="persistent cross-run metrics warehouse")
+    hsub = hist.add_subparsers(dest="action", required=True)
+    h_ing = hsub.add_parser("ingest",
+                            help="ingest event logs / BENCH payloads "
+                                 "(files or directories, sniffed)")
+    h_ing.add_argument("paths", nargs="+")
+    h_ing.add_argument("--db", default=None,
+                       help="warehouse path (default: the session "
+                            "conf spark.rapids.history.path)")
+    h_ing.add_argument("--label", default="",
+                       help="free-form tag recorded on each run")
+    h_rep = hsub.add_parser("report", help="warehouse inventory")
+    h_rep.add_argument("--db", default=None)
+    h_rep.add_argument("--json", action="store_true")
+    h_reg = hsub.add_parser("regress",
+                            help="latest run vs history baseline; "
+                                 "exits non-zero on regression")
+    h_reg.add_argument("--db", default=None)
+    h_reg.add_argument("--min-runs", type=int, default=None,
+                       help="baseline runs required for a verdict "
+                            "(conf: spark.rapids.history.regress."
+                            "minRuns)")
+    h_reg.add_argument("--band-k", type=float, default=None,
+                       help="MAD band multiplier (conf: spark.rapids."
+                            "history.regress.madBands)")
+    h_reg.add_argument("--threshold", type=float, default=None,
+                       help="relative wrong-way floor (default 0.05)")
+    h_reg.add_argument("--json", action="store_true")
+    h_cal = hsub.add_parser("calibrate",
+                            help="fit the machine profile from "
+                                 "accumulated history")
+    h_cal.add_argument("--db", default=None)
+    h_cal.add_argument("-o", "--out", default=None,
+                       help="write the profile JSON here "
+                            "(default: stdout)")
+    h_cal.add_argument("--json", action="store_true",
+                       help="print the JSON artifact instead of the "
+                            "rendered table")
 
     lint = sub.add_parser("lint",
                           help="static engine-invariant analysis")
@@ -192,6 +237,8 @@ def main(argv=None) -> int:
             sys.stdout.write(render_audit(
                 report, show_roofline=not args.no_roofline))
         return report.exit_code
+    if args.cmd == "history":
+        return _run_history(args)
     if args.cmd == "lint":
         from spark_rapids_tpu.tools.lint import (default_baseline_path,
                                                  default_rules,
@@ -219,6 +266,83 @@ def main(argv=None) -> int:
         else:
             sys.stdout.write(render_text(report))
         return report.exit_code
+    return 2
+
+
+def _run_history(args) -> int:
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.tools.history import (HistoryWarehouse,
+                                                calibrate, regress,
+                                                render_profile,
+                                                render_regress)
+    # --db falls back to the registered warehouse conf: the same key a
+    # session/bench run sets to auto-ingest its own logs
+    if not args.db:
+        args.db = C.default_conf().get(C.HISTORY_PATH.key)
+    if not args.db:
+        print("history: no warehouse: pass --db or set "
+              f"{C.HISTORY_PATH.key}", file=sys.stderr)
+        return 2
+    if args.action == "ingest":
+        with HistoryWarehouse(args.db) as wh:
+            total = []
+            for p in args.paths:
+                total.extend(wh.ingest(p, label=args.label))
+        for r in total:
+            extra = (f"{r.get('queries', 0)} query(ies), "
+                     f"{r.get('spans', 0)} span(s), "
+                     f"{r.get('programs', 0)} program(s)"
+                     if r["kind"] == "event_log"
+                     else f"{r.get('metrics', 0)} metric(s)"
+                     + (f" [FAILED RUN: {r['failure']}]"
+                        if r.get("failure") else ""))
+            print(f"run {r['run_id']}: {r['kind']} "
+                  f"{r['source']} -> {extra}")
+        return 0
+    if args.action == "report":
+        from spark_rapids_tpu.tools.history.warehouse import render_report
+        with HistoryWarehouse(args.db) as wh:
+            report = wh.report()
+        if args.json:
+            print(json.dumps(report, indent=2))
+        else:
+            sys.stdout.write(render_report(report))
+        return 0
+    if args.action == "regress":
+        min_runs = args.min_runs if args.min_runs is not None \
+            else int(C.HISTORY_REGRESS_MIN_RUNS.default)
+        band_k = args.band_k if args.band_k is not None \
+            else float(C.HISTORY_REGRESS_MAD_BANDS.default)
+        kwargs = {"min_runs": min_runs, "band_k": band_k}
+        if args.threshold is not None:
+            kwargs["rel_threshold"] = args.threshold
+        with HistoryWarehouse(args.db) as wh:
+            result = regress(wh, **kwargs)
+        if args.json:
+            print(json.dumps(result, indent=2))
+        else:
+            sys.stdout.write(render_regress(result))
+        return result["exit_code"]
+    if args.action == "calibrate":
+        with HistoryWarehouse(args.db) as wh:
+            try:
+                profile = calibrate(wh)
+            except ValueError as e:
+                print(f"calibrate: {e}", file=sys.stderr)
+                return 2
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                json.dump(profile, f, indent=2, sort_keys=True)
+                f.write("\n")
+            print(f"wrote machine profile ({len(profile['stage_kinds'])} "
+                  f"stage kind(s), residual bound "
+                  f"±{profile['residual_bound'] * 100:.1f}%) to "
+                  f"{args.out}")
+        if args.json:
+            print(json.dumps(profile, indent=2, sort_keys=True))
+        elif not args.out:
+            sys.stdout.write(render_profile(profile))
+        return 0
     return 2
 
 

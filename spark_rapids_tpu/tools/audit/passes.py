@@ -31,6 +31,12 @@ Passes (ids are the ``pass`` field of a finding):
   under, yielding an achieved fraction of peak and a
   compute-vs-memory-bound verdict; programs below
   ``min_peak_fraction`` are flagged (default 0 = report-only table).
+- ``cost-residual`` (warning): when queries ran with a calibrated
+  machine profile (``costModel`` events, docs/history.md), the
+  predicted-vs-measured residual is cross-checked against the
+  profile's own reported bound; a query whose |residual| exceeds the
+  bound means the machine drifted from its calibration (or the
+  profile is stale) — re-run ``tools history calibrate``.
 
 Suppression mirrors ``tools lint``: a baseline JSON keyed by
 (pass, stage kind, signature) grandfathers known findings;
@@ -170,6 +176,9 @@ class AuditReport:
     findings: List[AuditFinding]
     roofline: List[RooflineEntry]
     plan_violations: int                # planInvariantViolation rows seen
+    #: per-query cost-model cross-checks (costModel events); default so
+    #: pre-existing constructors stay valid
+    cost_checks: List[Dict] = dataclasses.field(default_factory=list)
 
     @property
     def active(self) -> List[AuditFinding]:
@@ -194,6 +203,7 @@ class AuditReport:
             "plan_violations": self.plan_violations,
             "findings": [f.to_json() for f in self.findings],
             "roofline": [e.to_json() for e in self.roofline],
+            "cost_checks": self.cost_checks,
             "summary": {
                 "active_errors": len(self.active_errors),
                 "active_warnings": len(self.active)
@@ -426,6 +436,49 @@ def _pass_roofline(rows, profiles, peak_flops: float, peak_bw: float,
 
 
 # ---------------------------------------------------------------------------
+# cost-model residual cross-check
+# ---------------------------------------------------------------------------
+
+def _pass_cost_residual(profiles
+                        ) -> Tuple[List[Dict], List[AuditFinding]]:
+    """One check row per query that ran with a machine profile
+    (``costModel`` event), flagged when |residual| exceeds the
+    profile's self-reported bound.  Report-only by severity (warning):
+    drift says "recalibrate", not "the engine is broken"."""
+    checks: List[Dict] = []
+    findings: List[AuditFinding] = []
+    for qp in profiles or []:
+        events_of = getattr(qp, "events_of", None)
+        if events_of is None:       # roofline tests stub profiles with
+            continue                # bare sentinels; skip non-QueryProfiles
+        for ev in events_of("costModel"):
+            p = ev.payload
+            residual = float(p.get("residual", 0.0) or 0.0)
+            bound = float(p.get("residual_bound", 0.0) or 0.0)
+            row = {"query_id": qp.query_id,
+                   "description": qp.description,
+                   "predicted_s": p.get("predicted_s"),
+                   "measured_s": p.get("measured_s"),
+                   "residual": residual, "residual_bound": bound,
+                   "profile_version": p.get("profile_version"),
+                   "within_bound": abs(residual) <= bound}
+            checks.append(row)
+            if not row["within_bound"]:
+                findings.append(AuditFinding(
+                    "cost-residual", "warning", "cost",
+                    f"query:{qp.description[:80]}",
+                    f"query {qp.query_id} measured "
+                    f"{p.get('measured_s')}s vs predicted "
+                    f"{p.get('predicted_s')}s "
+                    f"(residual {residual * 100:+.1f}% outside the "
+                    f"profile's ±{bound * 100:.1f}% bound) — the machine "
+                    "drifted from its calibration; re-run "
+                    "`tools history calibrate`",
+                    [f"profile_version={p.get('profile_version')}"]))
+    return checks, findings
+
+
+# ---------------------------------------------------------------------------
 # baseline (same shape as tools lint)
 # ---------------------------------------------------------------------------
 
@@ -491,6 +544,8 @@ def run_audit(path: Optional[str] = None,
     roofline, rf = _pass_roofline(rows, profiles, peak_flops, peak_bw,
                                   min_peak_fraction)
     findings += rf
+    cost_checks, cf = _pass_cost_residual(profiles)
+    findings += cf
     if baseline_path is None and path is not None:
         candidate = default_audit_baseline_path(path)
         baseline_path = candidate if os.path.exists(candidate) else None
@@ -500,7 +555,8 @@ def run_audit(path: Optional[str] = None,
             f.suppressed = "baseline"
     findings.sort(key=lambda f: (f.severity != "error", f.pass_id,
                                  f.kind, f.sig))
-    return AuditReport(files, rows, findings, roofline, plan_violations)
+    return AuditReport(files, rows, findings, roofline, plan_violations,
+                       cost_checks)
 
 
 def render_audit(report: AuditReport, show_roofline: bool = True) -> str:
@@ -540,6 +596,17 @@ def render_audit(report: AuditReport, show_roofline: bool = True) -> str:
                 f"{fmt(e.sec_per_call, '11.6f'):>11}"
                 + ("       -" if e.peak_fraction is None
                    else f"{e.peak_fraction * 100:7.2f}%"))
+    if report.cost_checks:
+        lines.append("")
+        lines.append("  Cost model (predicted vs measured per query):")
+        for c in report.cost_checks:
+            verdict = "ok" if c["within_bound"] else "DRIFT"
+            lines.append(
+                f"    query {c['query_id']} '{c['description'][:40]}': "
+                f"predicted {c['predicted_s']}s measured "
+                f"{c['measured_s']}s residual "
+                f"{c['residual'] * 100:+.1f}% "
+                f"(bound ±{c['residual_bound'] * 100:.1f}%) {verdict}")
     active = report.active
     lines.append(f"{len(active)} finding(s) "
                  f"({len(report.findings) - len(active)} suppressed); "

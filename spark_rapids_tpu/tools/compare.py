@@ -12,6 +12,10 @@ import json
 import os
 from typing import Dict, List, Optional, Tuple
 
+from spark_rapids_tpu.tools.regression import (REL_THRESHOLD,
+                                               delta_regression,
+                                               run_failure)
+
 #: (label, dotted path into the payload, higher-is-better or None)
 METRICS: List[Tuple[str, str, Optional[bool]]] = [
     ("rows/s", "value", True),
@@ -85,27 +89,6 @@ def load_bench(path: str) -> Dict:
     return last
 
 
-def run_failure(payload: Dict) -> Optional[str]:
-    """A payload from a run that FAILED rather than measured: its
-    numbers are placeholders (value 0, vs_baseline 0.0 from the bench
-    failsafe), and comparing against them would report a −100%/÷0
-    'regression' where the honest verdict is 'run failed'
-    (BENCH_r05: ``budget_exceeded`` with value 0)."""
-    if not isinstance(payload, dict):
-        return None
-    # a run that produced a real primary value is a (possibly partial)
-    # measurement even if a later phase tripped the budget alarm
-    # (BENCH_r04 carries budget_exceeded WITH a real value); only a
-    # placeholder-zero payload is a failed run
-    if payload.get("value"):
-        return None
-    if payload.get("budget_exceeded"):
-        return str(payload.get("error") or "budget exceeded")
-    if payload.get("error"):
-        return str(payload["error"])
-    return None
-
-
 def compare(paths: List[str]) -> Dict:
     """Structured diff: every known metric across every payload, with a
     relative delta of last vs first where both are numeric.  A payload
@@ -142,9 +125,9 @@ def compare(paths: List[str]) -> Dict:
         if first not in (None, 0) and last is not None:
             delta = (last - first) / abs(first)
             row["delta_pct"] = round(delta * 100, 2)
-            if higher_better is not None:
-                row["regression"] = (delta < -0.05 if higher_better
-                                     else delta > 0.05)
+            verdict = delta_regression(first, last, higher_better)
+            if verdict is not None:
+                row["regression"] = verdict
         rows.append(row)
     return {"files": [name for name, _ in payloads], "rows": rows,
             "errors": errors, "failed": failed}
@@ -173,8 +156,8 @@ def render_compare(paths: List[str]) -> str:
     regressions = [r["metric"] for r in out["rows"] if r.get("regression")]
     if regressions:
         lines.append("")
-        lines.append("!! regressions (>5% the wrong way): "
-                     + ", ".join(regressions))
+        lines.append(f"!! regressions (>{REL_THRESHOLD * 100:.0f}% the "
+                     "wrong way): " + ", ".join(regressions))
     for name, msg in out.get("failed", {}).items():
         lines.append(f"!! {name}: run failed ({msg}) — excluded from "
                      "deltas")

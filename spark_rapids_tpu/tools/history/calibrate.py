@@ -1,0 +1,278 @@
+"""``history calibrate``: fit a machine profile from accumulated runs.
+
+The join the audit roofline performs per log, done across the whole
+warehouse: ``stage_programs`` carries the audit ledger's cost-analysis
+flops/bytes per compiled stage, ``spans`` carries the measured
+EXCLUSIVE seconds (tools/profile attribution) of the operators those
+stages ran under, and ``KIND_SPAN_MARKERS`` (tools/audit) is the
+kind->node vocabulary linking them.  Per stage-kind family we fit
+
+    t_exclusive ≈ fixed_s_per_batch · batches + per_row_s · rows
+
+by least squares over every (operator, query, run) observation — the
+fixed term is the per-dispatch overhead the reference's AutoTuner
+models as kernel launch + cache lookup, the marginal term absorbs the
+data-proportional work — and report achieved byte/s and FLOP/s for the
+family from the ledger join (per-call flops/bytes over measured
+seconds-per-call, the roofline's denominator).  H2D/D2H bandwidth and
+per-transfer fixed cost come from a straight-line fit over the
+transition ledger's per-event (bytes, seconds) pairs; spill cost the
+same way over spill events; compile cost is the mean measured
+``stageCompile`` duration per kind.
+
+Residual statistics are the artifact's honesty clause: every
+observation's predicted-vs-actual relative error is aggregated, the
+reported ``residual_bound`` is the p90 of |relative error| — so "≥80%
+of stages land within the reported bound" holds by construction and
+the bound itself says how good (or bad) the fit really is.  All
+stdlib: the normal equations are 2×2, solved by hand.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu.tools.audit.passes import KIND_SPAN_MARKERS
+
+MACHINE_PROFILE_SCHEMA = "spark-rapids-tpu-machine-profile"
+MACHINE_PROFILE_VERSION = 1
+
+#: spans shorter than this carry more clock jitter than signal; they
+#: still calibrate (they ARE the fixed-overhead evidence) but guard the
+#: relative-residual denominator
+_EPS_S = 1e-6
+
+
+def family_for_node(node: str) -> Optional[str]:
+    """First stage-kind family whose span markers match this exec node
+    name (first match wins — a span must not calibrate twice)."""
+    for prefix, markers in KIND_SPAN_MARKERS:
+        if any(m in node for m in markers):
+            return prefix
+    return None
+
+
+def _fit_two_term(obs: List[Tuple[float, float, float]]
+                  ) -> Tuple[float, float]:
+    """Least-squares (c0, c1) for t ≈ c0·a + c1·b over (a, b, t) rows,
+    clamped non-negative (a negative throughput is a fit artifact, not
+    physics): a negative coefficient refits the remaining single term."""
+    saa = sab = sbb = sat = sbt = 0.0
+    for a, b, t in obs:
+        saa += a * a
+        sab += a * b
+        sbb += b * b
+        sat += a * t
+        sbt += b * t
+    det = saa * sbb - sab * sab
+    if det > 1e-30:
+        c0 = (sat * sbb - sbt * sab) / det
+        c1 = (sbt * saa - sat * sab) / det
+    else:
+        c0 = c1 = -1.0      # collinear: fall through to single-term
+    if c0 < 0.0 and c1 < 0.0:
+        c0 = sat / saa if saa > 0 else 0.0
+        c1 = sbt / sbb if sbb > 0 else 0.0
+        c0, c1 = max(c0, 0.0), max(c1, 0.0)
+        # two independent single-term fits double-count; keep the better
+        if c0 and c1:
+            err0 = sum((c0 * a - t) ** 2 for a, _b, t in obs)
+            err1 = sum((c1 * b - t) ** 2 for _a, b, t in obs)
+            if err0 <= err1:
+                c1 = 0.0
+            else:
+                c0 = 0.0
+    elif c0 < 0.0:
+        c0 = 0.0
+        c1 = max(0.0, sbt / sbb if sbb > 0 else 0.0)
+    elif c1 < 0.0:
+        c1 = 0.0
+        c0 = max(0.0, sat / saa if saa > 0 else 0.0)
+    return c0, c1
+
+
+def _percentile(sorted_xs: List[float], q: float) -> float:
+    if not sorted_xs:
+        return 0.0
+    idx = min(len(sorted_xs) - 1, max(0, int(q * len(sorted_xs))))
+    return sorted_xs[idx]
+
+
+def _residual_stats(rels: List[float]) -> Dict:
+    if not rels:
+        return {"n": 0, "mean_abs_rel": 0.0, "p50": 0.0, "p90": 0.0}
+    s = sorted(rels)
+    return {"n": len(s),
+            "mean_abs_rel": round(sum(s) / len(s), 6),
+            "p50": round(_percentile(s, 0.50), 6),
+            "p90": round(_percentile(s, 0.90), 6)}
+
+
+def _fit_transfer(pairs: List[Tuple[int, float]]) -> Optional[Dict]:
+    """t ≈ fixed_s + bytes / bytes_per_s over per-event pairs."""
+    pairs = [(b, t) for b, t in pairs if t > 0.0]
+    if not pairs:
+        return None
+    obs = [(1.0, float(b), t) for b, t in pairs]
+    fixed_s, per_byte = _fit_two_term(obs)
+    tot_b = sum(b for b, _ in pairs)
+    tot_t = sum(t for _, t in pairs)
+    return {"count": len(pairs), "bytes": int(tot_b),
+            "seconds": round(tot_t, 6),
+            "fixed_s": round(fixed_s, 9),
+            "bytes_per_s": (round(1.0 / per_byte, 3) if per_byte > 0
+                            else round(tot_b / tot_t, 3) if tot_t > 0
+                            else None)}
+
+
+def calibrate(wh) -> Dict:
+    """The machine-profile artifact from everything the warehouse
+    holds.  Raises ValueError when there is nothing to calibrate from."""
+    run_rows = wh.query(
+        "SELECT COUNT(*) FROM runs WHERE kind = 'event_log'"
+        " AND status = 'ok'")
+    n_runs = run_rows[0][0]
+    if n_runs == 0:
+        raise ValueError("no event-log runs in the warehouse; "
+                         "ingest at least one before calibrating")
+    n_queries = wh.query("SELECT COUNT(*) FROM queries")[0][0]
+
+    # -- per stage-kind family: fit fixed + per-row over span obs ------------
+    span_rows = wh.query(
+        "SELECT node, exclusive_s, rows, batches FROM spans"
+        " WHERE exclusive_s > 0")
+    fam_obs: Dict[str, List[Tuple[float, float, float]]] = {}
+    for node, excl, rows, batches in span_rows:
+        fam = family_for_node(node)
+        if fam is None:
+            continue
+        fam_obs.setdefault(fam, []).append(
+            (float(max(batches, 1)), float(max(rows, 0)), float(excl)))
+    prog_rows = wh.query(
+        "SELECT stage_kind, flops, bytes_accessed FROM stage_programs")
+    fam_ledger: Dict[str, List[Tuple[float, float]]] = {}
+    for kind, flops, nbytes in prog_rows:
+        for prefix, _markers in KIND_SPAN_MARKERS:
+            if str(kind).startswith(prefix):
+                if flops is not None or nbytes is not None:
+                    fam_ledger.setdefault(prefix, []).append(
+                        (float(flops or 0.0), float(nbytes or 0.0)))
+                break
+    stage_kinds: Dict[str, Dict] = {}
+    all_rels: List[float] = []
+    for fam, obs in sorted(fam_obs.items()):
+        c0, c1 = _fit_two_term(obs)
+        rels = []
+        for a, b, t in obs:
+            pred = c0 * a + c1 * b
+            rels.append(abs(pred - t) / max(t, _EPS_S))
+        all_rels.extend(rels)
+        entry = {"fixed_s_per_batch": round(c0, 9),
+                 "per_row_s": round(c1, 12),
+                 "samples": len(obs),
+                 "residual": _residual_stats(rels)}
+        # ledger join: achieved rates from per-call work over measured
+        # seconds-per-call (dispatch proxy: the family's batch count,
+        # floored by its program count — builds, not dispatches)
+        ledger = fam_ledger.get(fam)
+        if ledger:
+            tot_s = sum(t for _a, _b, t in obs)
+            tot_calls = max(sum(a for a, _b, _t in obs), len(ledger))
+            sec_per_call = tot_s / tot_calls if tot_calls else 0.0
+            mean_flops = sum(f for f, _ in ledger) / len(ledger)
+            mean_bytes = sum(b for _, b in ledger) / len(ledger)
+            if sec_per_call > 0:
+                entry["achieved_flops_per_s"] = round(
+                    mean_flops / sec_per_call, 3)
+                entry["achieved_bytes_per_s"] = round(
+                    mean_bytes / sec_per_call, 3)
+            entry["ledger_programs"] = len(ledger)
+        stage_kinds[fam] = entry
+
+    # -- transfer / sync from the transition ledger --------------------------
+    transfer: Dict[str, Dict] = {}
+    for direction in ("h2d", "d2h"):
+        pairs = wh.query(
+            "SELECT bytes, seconds FROM transitions WHERE direction = ?",
+            (direction,))
+        fit = _fit_transfer([(int(b), float(t)) for b, t in pairs])
+        if fit is not None:
+            transfer[direction] = fit
+    syncs = wh.query(
+        "SELECT seconds FROM transitions WHERE direction = 'sync'")
+    if syncs:
+        ts = [float(t) for (t,) in syncs]
+        transfer["sync"] = {"count": len(ts),
+                            "mean_s": round(sum(ts) / len(ts), 9)}
+
+    # -- spill + compile costs ----------------------------------------------
+    spill_pairs = wh.query(
+        "SELECT bytes, seconds FROM spills WHERE op = 'spill'")
+    spill = _fit_transfer([(int(b), float(t)) for b, t in spill_pairs])
+    comp_rows = wh.query("SELECT stage_kind, seconds FROM compiles")
+    compile_cost: Optional[Dict] = None
+    if comp_rows:
+        per_kind: Dict[str, List[float]] = {}
+        for kind, secs in comp_rows:
+            per_kind.setdefault(str(kind), []).append(float(secs))
+        allc = [t for ts in per_kind.values() for t in ts]
+        compile_cost = {
+            "count": len(allc),
+            "mean_s": round(sum(allc) / len(allc), 6),
+            "per_kind": {k: round(sum(v) / len(v), 6)
+                         for k, v in sorted(per_kind.items())}}
+
+    overall = _residual_stats(all_rels)
+    bound = overall["p90"]
+    within = (sum(1 for r in all_rels if r <= bound) / len(all_rels)
+              if all_rels else 0.0)
+    return {"schema": MACHINE_PROFILE_SCHEMA,
+            "version": MACHINE_PROFILE_VERSION,
+            "runs": n_runs, "queries": n_queries,
+            "observations": len(all_rels),
+            "stage_kinds": stage_kinds,
+            "transfer": transfer,
+            "spill": spill,
+            "compile": compile_cost,
+            "residuals": overall,
+            "residual_bound": bound,
+            "within_bound_frac": round(within, 4)}
+
+
+def render_profile(profile: Dict) -> str:
+    lines = [f"== machine profile v{profile['version']} "
+             f"({profile['runs']} run(s), {profile['queries']} "
+             f"query(ies), {profile['observations']} observation(s)) =="]
+    lines.append(f"residual bound ±{profile['residual_bound'] * 100:.1f}% "
+                 f"(p90 |rel|); {profile['within_bound_frac'] * 100:.0f}% "
+                 "of stages within bound")
+    lines.append(f"  {'stage kind':<24}{'fixed s/batch':>14}"
+                 f"{'per-row s':>14}{'B/s':>12}{'FLOP/s':>12}"
+                 f"{'n':>6}{'p90 rel':>9}")
+    for fam, e in sorted(profile["stage_kinds"].items()):
+        def fmt(v, spec="12.4g"):
+            return "-" if v is None else format(v, spec)
+        lines.append(
+            f"  {fam:<24}{e['fixed_s_per_batch']:>14.3g}"
+            f"{e['per_row_s']:>14.3g}"
+            f"{fmt(e.get('achieved_bytes_per_s')):>12}"
+            f"{fmt(e.get('achieved_flops_per_s')):>12}"
+            f"{e['samples']:>6}{e['residual']['p90'] * 100:>8.1f}%")
+    for direction, fit in sorted(profile.get("transfer", {}).items()):
+        if "bytes_per_s" in fit:
+            lines.append(f"  transfer {direction}: "
+                         f"{fit['bytes_per_s'] or 0:.4g} B/s, "
+                         f"fixed {fit['fixed_s']:.3g}s "
+                         f"({fit['count']} event(s))")
+        else:
+            lines.append(f"  {direction}: mean {fit['mean_s']:.3g}s "
+                         f"({fit['count']} event(s))")
+    if profile.get("spill"):
+        sp = profile["spill"]
+        lines.append(f"  spill: {sp['bytes_per_s'] or 0:.4g} B/s over "
+                     f"{sp['count']} event(s)")
+    if profile.get("compile"):
+        cc = profile["compile"]
+        lines.append(f"  compile: mean {cc['mean_s']:.4g}s over "
+                     f"{cc['count']} build(s)")
+    return "\n".join(lines) + "\n"

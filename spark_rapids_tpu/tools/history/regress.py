@@ -1,0 +1,134 @@
+"""``history regress``: the trajectory sentinel.
+
+The latest ingested run is judged against the accumulated baseline —
+per query wall clock for event-log runs, per metric (and per TPC-DS
+query) for bench runs — using the shared noise-aware core in
+tools/regression.py: a verdict needs ``min_runs`` baseline samples, and
+the band around the baseline median is
+``max(rel_threshold·|median|, band_k·1.4826·MAD)`` so a genuinely noisy
+metric widens its own band instead of crying wolf.  Nonzero exit on any
+regression; runs recorded as ``failed`` (bench placeholder zeros) never
+enter a baseline and are never judged.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.tools.regression import (DEFAULT_BAND_K,
+                                               DEFAULT_MIN_RUNS,
+                                               REL_THRESHOLD, detect,
+                                               summarize)
+
+
+def regress(wh, min_runs: int = DEFAULT_MIN_RUNS,
+            rel_threshold: float = REL_THRESHOLD,
+            band_k: float = DEFAULT_BAND_K) -> Dict:
+    """Latest run vs history, for both run kinds present.  Returns the
+    full verdict document; ``exit_code`` is 1 iff any metric regressed."""
+    verdicts: List[Dict] = []
+    domains = []
+    for kind, domain_fn in (("event_log", _event_log_domain),
+                            ("bench", _bench_domain)):
+        doc = domain_fn(wh, min_runs, rel_threshold, band_k)
+        if doc is not None:
+            domains.append(doc)
+            verdicts.extend(doc["verdicts"])
+    out = summarize(verdicts)
+    out["thresholds"] = {"min_runs": min_runs,
+                         "rel_threshold": rel_threshold,
+                         "band_k": band_k}
+    out["domains"] = domains
+    return out
+
+
+def _latest_ok_run(wh, kind: str) -> Optional[int]:
+    rows = wh.query(
+        "SELECT run_id FROM runs WHERE kind = ? AND status = 'ok'"
+        " ORDER BY run_id DESC LIMIT 1", (kind,))
+    return rows[0][0] if rows else None
+
+
+def _event_log_domain(wh, min_runs, rel_threshold, band_k
+                      ) -> Optional[Dict]:
+    """Per-query wall clock, keyed by (description, ordinal): query ids
+    restart per process, but the Nth query of a deterministic workload
+    is comparable across runs."""
+    latest = _latest_ok_run(wh, "event_log")
+    if latest is None:
+        return None
+    rows = wh.query(
+        "SELECT q.run_id, q.description, q.ordinal, q.wall_s"
+        " FROM queries q JOIN runs r ON r.run_id = q.run_id"
+        " WHERE r.kind = 'event_log' AND r.status = 'ok'"
+        " AND q.complete = 1 ORDER BY q.run_id, q.ordinal")
+    by_key: Dict = {}
+    for run_id, desc, ordinal, wall in rows:
+        by_key.setdefault((desc, ordinal), []).append((run_id, wall))
+    verdicts = []
+    for (desc, ordinal), samples in sorted(by_key.items()):
+        latest_vals = [w for rid, w in samples if rid == latest]
+        history = [w for rid, w in samples if rid != latest]
+        if not latest_vals:
+            continue        # query absent from the latest run
+        v = detect(history, latest_vals[0], higher_better=False,
+                   min_runs=min_runs, rel_threshold=rel_threshold,
+                   band_k=band_k)
+        v["key"] = f"query[{ordinal}] {desc!r} wall_s"
+        verdicts.append(v)
+    return {"domain": "event_log", "latest_run": latest,
+            "verdicts": verdicts}
+
+
+def _bench_domain(wh, min_runs, rel_threshold, band_k
+                  ) -> Optional[Dict]:
+    latest = _latest_ok_run(wh, "bench")
+    if latest is None:
+        return None
+    rows = wh.query(
+        "SELECT m.run_id, m.metric, m.path, m.value, m.higher_better"
+        " FROM bench_metrics m JOIN runs r ON r.run_id = m.run_id"
+        " WHERE r.status = 'ok' ORDER BY m.run_id")
+    by_key: Dict = {}
+    for run_id, metric, path, value, higher in rows:
+        if higher is None:
+            continue        # direction-less metrics carry no verdict
+        by_key.setdefault((metric, path, bool(higher)), []) \
+            .append((run_id, value))
+    verdicts = []
+    for (metric, path, higher), samples in sorted(by_key.items()):
+        latest_vals = [v for rid, v in samples if rid == latest]
+        history = [v for rid, v in samples if rid != latest]
+        if not latest_vals:
+            continue
+        v = detect(history, latest_vals[0], higher_better=higher,
+                   min_runs=min_runs, rel_threshold=rel_threshold,
+                   band_k=band_k)
+        v["key"] = f"bench {metric} ({path})"
+        verdicts.append(v)
+    return {"domain": "bench", "latest_run": latest,
+            "verdicts": verdicts}
+
+
+def render_regress(result: Dict) -> str:
+    th = result["thresholds"]
+    lines = [f"== history regress (min_runs={th['min_runs']}, "
+             f"rel={th['rel_threshold'] * 100:.0f}%, "
+             f"band_k={th['band_k']}) =="]
+    for doc in result["domains"]:
+        lines.append(f"-- {doc['domain']} (latest run "
+                     f"{doc['latest_run']}) --")
+        for v in doc["verdicts"]:
+            if v.get("regression"):
+                lines.append(f"!! REGRESSION {v['key']}: {v['reason']}")
+            elif v.get("skipped"):
+                lines.append(f"   skip {v['key']}: {v['reason']}")
+            else:
+                lines.append(
+                    f"   ok   {v['key']}: latest {v['latest']:.6g} vs "
+                    f"median {v['median']:.6g} (band ±{v['band']:.6g})")
+    lines.append(f"{result['checked']} checked, "
+                 f"{result['skipped']} skipped, "
+                 f"{result['regressions']} regression(s); "
+                 + ("FAIL" if result["exit_code"] else "OK"))
+    return "\n".join(lines) + "\n"

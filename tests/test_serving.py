@@ -211,6 +211,35 @@ def test_result_cache_spill_round_trip():
     assert rc.mem_bytes == 0 and rc.disk_bytes == 0
 
 
+def test_result_cache_spill_rides_the_catalog_codec_frame():
+    # spill files are shuffle-serializer codec frames honoring
+    # spark.rapids.memory.spill.codec, not raw arrow IPC
+    from spark_rapids_tpu.columnar.batch import batch_from_pydict
+    from spark_rapids_tpu.memory import catalog as CAT
+    from spark_rapids_tpu.shuffle.serializer import deserialize_batch
+    b1 = batch_from_pydict({"x": np.arange(512, dtype=np.int64),
+                            "s": [f"r{i}" for i in range(512)]})
+    b2 = batch_from_pydict({"x": np.arange(7, dtype=np.int64)})
+    old_codec = CAT.SPILL_CODEC
+    CAT.SPILL_CODEC = "zlib"
+    try:
+        rc = ResultCache(max_bytes=b1.nbytes() + 16, spill=True)
+        assert rc.put("k1", (), b1)
+        assert rc.put("k2", (), b2)     # pressure: k1 spills
+        assert rc.stats["spills"] == 1
+        e = rc._entries["k1"]
+        with open(e.spill_path, "rb") as f:
+            frame = f.read()
+        assert frame[0] == 2            # zlib frame tag
+        assert deserialize_batch(frame).to_pydict() == b1.to_pydict()
+        # and the cache's own unspill path round-trips the frame
+        back = rc.lookup("k1", ())
+        assert back is not None and back.to_pydict() == b1.to_pydict()
+        rc.clear()
+    finally:
+        CAT.SPILL_CODEC = old_codec
+
+
 # ---------------------------------------------------------------------------
 # admission control
 # ---------------------------------------------------------------------------
@@ -256,7 +285,10 @@ def test_admission_timeout_sheds_load():
 
 def test_serving_starved_pool_end_to_end(tmp_path):
     s, _ = _serving_session(tmp_path)
-    with _Server(s) as srv:
+    # result cache OFF: identical repeats resolve pre-admission from the
+    # cache and would never touch the starved pool this test exercises
+    with _Server(s, **{"spark.rapids.serving.resultCache.maxBytes": "0"}
+                 ) as srv:
         # serialize admissions through a tiny synthetic pool: every query
         # still completes (blocked, not shed, not OOMed)
         srv.admission._pool_limit = lambda: 1000
@@ -325,6 +357,23 @@ def test_result_cache_hit_and_file_invalidation(tmp_path):
         sub3 = srv.submit(Q_AGG)
         assert sub3.result(120) == r2
         assert sub3.info["resolved"] == "result_cache"
+
+
+def test_result_cache_hit_resolves_before_admission(tmp_path):
+    # PR 15 deferral closed: a cached result consumes NO admission slot
+    # — the probe runs before admit(), so hits neither wait for nor
+    # hold device-memory reservations
+    s, _ = _serving_session(tmp_path)
+    with _Server(s) as srv:
+        r1 = srv.execute(Q_AGG)
+        admitted0 = srv.stats()["admission"]["admitted"]
+        for _ in range(3):
+            sub = srv.submit(Q_AGG)
+            assert sub.result(120) == r1
+            assert sub.info["resolved"] == "result_cache"
+            # the hit still reports its latency decomposition
+            assert sub.info["stages"]["lookup_s"] >= 0.0
+        assert srv.stats()["admission"]["admitted"] == admitted0
 
 
 def test_speculation_replay_never_reuses_poisoned_plan_state(tmp_path):
@@ -527,7 +576,9 @@ def test_autotune_loop_quiet_on_healthy_workload(tmp_path):
         # rules run after every query; a healthy small workload yields
         # no deltas (quiet-on-healthy), and tuning never fails a query
         assert srv.autotune_applied == []
-        assert srv.stats()["admission"]["admitted"] == 3
+        # repeats resolve from the result cache BEFORE admission: only
+        # the first execution consumed an admission slot
+        assert srv.stats()["admission"]["admitted"] == 1
 
 
 def test_semaphore_resize_grow_wakes_and_shrink_drains():
