@@ -693,6 +693,38 @@ def _pipeline_microbench(tpu, data, parts) -> dict:
     return res
 
 
+def _console_snapshot():
+    """Mid-run live-console capture for the serving payload: when the
+    engine console (aux/console.py) is up, fetch /queries and /server
+    over its HTTP socket — the same path an external scraper takes — and
+    keep the operational scalars (queue depth, cache hit rates).  None
+    when the console is disabled or unreachable; never fails the bench."""
+    try:
+        from urllib.request import urlopen
+
+        from spark_rapids_tpu.aux.console import active_console
+        con = active_console()
+        if con is None:
+            return None
+        with urlopen(con.url("/queries"), timeout=5) as r:
+            queries = json.loads(r.read().decode("utf-8"))
+        with urlopen(con.url("/server"), timeout=5) as r:
+            server = json.loads(r.read().decode("utf-8"))
+        srv_rows = server.get("servers", [])
+        row = srv_rows[0] if srv_rows else {}
+        return {
+            "url": con.url(""),
+            "live_queries": len(queries.get("live", [])),
+            "recent_queries": len(queries.get("recent", [])),
+            "queue_depth": row.get("queue_depth"),
+            "admitted_now": row.get("admitted_now"),
+            "plan_cache_hit_rate": row.get("plan_cache_hit_rate"),
+            "result_cache_hit_rate": row.get("result_cache_hit_rate"),
+        }
+    except Exception:
+        return None
+
+
 def _serving_phase(tpu, res: dict, kind: str, data_slice=None, parts=2):
     """Sustained-throughput serving measurement (serving/server.py): the
     same mixed 8-query workload executed (a) serially through the plain
@@ -781,12 +813,19 @@ def _serving_phase(tpu, res: dict, kind: str, data_slice=None, parts=2):
         # throughput pass: autotune stays OFF — an accepted delta
         # mid-measurement legitimately re-keys both caches (the conf
         # digest changed), which measures the tuner's transient, not
-        # steady-state serving; the loop gets its own round below
+        # steady-state serving; the loop gets its own round below.
+        # The live console rides this pass (results-neutral, pinned by
+        # the trimodal console test) so the payload records a scrape of
+        # the serving state taken over the console's own HTTP socket.
+        tpu.set_conf("spark.rapids.console.enabled", "true")
         srv = QueryServer(session=tpu)
         try:
             t0 = time.perf_counter()
             subs = [(tag, srv.submit(q, tag=tag))
                     for tag, q in executions]
+            # mid-run: the submissions are in flight while the console
+            # scrape happens — queue depth / admitted counts are live
+            snap = _console_snapshot()
             lat = []
             identical = True
             for tag, sub in subs:
@@ -817,8 +856,11 @@ def _serving_phase(tpu, res: dict, kind: str, data_slice=None, parts=2):
                 "admission": st["admission"],
                 "max_concurrent": srv.admission.max_concurrent,
             })
+            if snap is not None:
+                res["console_snapshot"] = snap
         finally:
             srv.stop()
+            tpu.set_conf("spark.rapids.console.enabled", "false")
 
         if _remaining() > 20:
             # plan-cache round, result cache OFF: the mixed pass above

@@ -12,14 +12,14 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== 1/6 engine invariant lint =="
+echo "== 1/7 engine invariant lint =="
 python -m spark_rapids_tpu.tools lint
 
 if [[ "${1:-}" == "--lint-only" ]]; then
     exit 0
 fi
 
-echo "== 2/6 compiled-program audit smoke =="
+echo "== 2/7 compiled-program audit smoke =="
 AUDIT_LOG="$(mktemp -d)/audit_smoke.jsonl"
 python - "$AUDIT_LOG" <<'PY'
 import sys
@@ -44,7 +44,7 @@ PY
 # report-only here (no peak floor configured)
 python -m spark_rapids_tpu.tools audit "$AUDIT_LOG" --no-roofline
 
-echo "== 3/6 transition-ledger trace round-trip =="
+echo "== 3/7 transition-ledger trace round-trip =="
 # the audit smoke's own log round-trips through the Perfetto exporter:
 # --check fails on any hostTransition/deviceSync the gateway saw that
 # no query owns (unattributed = invisible latency), and the rendered
@@ -66,7 +66,7 @@ print(f"trace round-trip ok: {len(evs)} events, "
       f"{sum(1 for e in slices if e['cat'] == 'hostTransition')} transition slice(s)")
 PY
 
-echo "== 4/6 history warehouse round-trip =="
+echo "== 4/7 history warehouse round-trip =="
 # the audit smoke's log ingests (twice, as two labeled runs) into a
 # fresh warehouse, calibrates a machine profile whose own residual
 # bound must cover >=80% of observations, and the trajectory sentinel
@@ -74,7 +74,10 @@ echo "== 4/6 history warehouse round-trip =="
 HIST_DB="$(dirname "$AUDIT_LOG")/history.db"
 MACHINE_JSON="$(dirname "$AUDIT_LOG")/machine.json"
 python -m spark_rapids_tpu.tools history ingest "$AUDIT_LOG" --db "$HIST_DB" --label run1
-python -m spark_rapids_tpu.tools history ingest "$AUDIT_LOG" --db "$HIST_DB" --label run2
+# same path + same content: ingest is idempotent by content digest and
+# would UPDATE run1 in place — --force inserts the second labeled run
+# the calibrate/regress steps below need
+python -m spark_rapids_tpu.tools history ingest "$AUDIT_LOG" --db "$HIST_DB" --label run2 --force
 python -m spark_rapids_tpu.tools history calibrate --db "$HIST_DB" -o "$MACHINE_JSON"
 python - "$MACHINE_JSON" <<'PY'
 import json
@@ -93,7 +96,7 @@ python -m spark_rapids_tpu.tools history regress --db "$HIST_DB" --min-runs 1
 python -m spark_rapids_tpu.tools history report --db "$HIST_DB"
 rm -rf "$(dirname "$AUDIT_LOG")"
 
-echo "== 5/6 concurrent-serving smoke =="
+echo "== 5/7 concurrent-serving smoke =="
 # two queries racing through the QueryServer: both admitted, results
 # bit-identical to a serial run, and the exact repeat skips planning
 python - <<'PY'
@@ -125,5 +128,75 @@ finally:
 print("serving smoke ok:", st["admission"], st["plan_cache"])
 PY
 
-echo "== 6/6 smoke test tier =="
+echo "== 6/7 live console smoke =="
+# the embedded console serves the engine live: start a session with the
+# console enabled, race queries through the QueryServer, and scrape
+# /metrics, /queries, and /server over its HTTP socket MID-RUN —
+# Prometheus exposition shape, progress fields, and admission/cache
+# state must all validate while work is in flight
+python - <<'PY'
+import json
+import urllib.request
+
+import numpy as np
+from spark_rapids_tpu.aux.console import active_console
+from spark_rapids_tpu.serving import QueryServer
+from spark_rapids_tpu.session import TpuSession
+
+s = TpuSession({"spark.rapids.sql.test.enabled": "false",
+                "spark.rapids.console.enabled": "true",
+                "spark.rapids.console.port": "0"})
+con = active_console()
+assert con is not None and con.running, "console did not start from conf"
+rng = np.random.default_rng(11)
+df = s.create_dataframe(
+    {"k": rng.integers(0, 10, 20_000).astype(np.int64),
+     "v": rng.standard_normal(20_000)}, num_partitions=2)
+s.create_or_replace_temp_view("t", df)
+q = "SELECT k, SUM(v) AS sv FROM t GROUP BY k ORDER BY k"
+
+
+def get(path):
+    with urllib.request.urlopen(con.url(path), timeout=10) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read()
+
+
+srv = QueryServer(session=s)
+try:
+    subs = [srv.submit(q) for _ in range(3)]
+    # mid-run: the submissions are in flight while we scrape
+    code, ctype, body = get("/metrics")
+    assert code == 200 and ctype.startswith("text/plain; version=0.0.4"), \
+        (code, ctype)
+    text = body.decode("utf-8")
+    assert "# TYPE" in text and "# HELP" in text, "not an exposition"
+    queries = json.loads(get("/queries")[2])
+    assert set(queries) == {"live", "recent"}, queries.keys()
+    server = json.loads(get("/server")[2])
+    assert server["servers"], "live QueryServer missing from /server"
+    row = server["servers"][0]
+    for key in ("queue_depth", "admitted_now", "plan_cache",
+                "result_cache", "plan_cache_hit_rate"):
+        assert key in row, f"/server row missing {key}"
+    results = [sub.result(120) for sub in subs]
+    assert all(r == results[0] for r in results), "results diverge"
+    # completed serves populate the per-stage latency histograms
+    server = json.loads(get("/server")[2])
+    assert server["latency_histograms"], "latency histograms missing"
+    for snap in server["latency_histograms"].values():
+        assert snap["buckets"][-1][0] == "+Inf", snap
+finally:
+    srv.stop()
+# the finished queries surface in the recent tail with progress 1.0
+queries = json.loads(get("/queries")[2])
+assert queries["recent"] and all(r["progress"] == 1.0
+                                 for r in queries["recent"]), queries
+s.stop()
+assert active_console() is None, "session stop left the console running"
+print(f"console smoke ok: {len(queries['recent'])} recent quer(ies), "
+      f"queue_depth={row['queue_depth']}, "
+      f"plan_cache_hit_rate={row['plan_cache_hit_rate']}")
+PY
+
+echo "== 7/7 smoke test tier =="
 python -m pytest tests/ -q -m smoke -p no:cacheprovider

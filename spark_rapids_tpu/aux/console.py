@@ -1,0 +1,286 @@
+"""Live engine console: the runtime's state served over HTTP.
+
+Reference: the Spark UI + the ``PrometheusServlet`` metrics sink — the
+reference engine exposes operators, tasks, memory and shuffle state
+live, while everything this engine had before was post-hoc
+(``render_prometheus()`` was a function nobody served; running queries
+were invisible until ``queryEnd``).  A stdlib-only
+``ThreadingHTTPServer`` (no dependencies) serves:
+
+- ``/metrics``  — ``aux.events.render_prometheus()`` verbatim, with the
+  Prometheus exposition content-type;
+- ``/queries``  — live QueryExecution span trees (aux/tracing.py) with
+  per-operator rows/batches so far plus a progress fraction and ETA
+  joined against the PR 17 machine-profile cost predictions (the cost
+  model's first LIVE consumer);
+- ``/memory``   — catalog pool gauges + the per-query/per-operator byte
+  attribution threaded through BufferCatalog registration tags;
+- ``/server``   — QueryServer admission/cache/latency state
+  (serving/console_routes.py);
+- ``/debug/dump`` — the PR 7 watchdog ladder on demand: arbiter
+  registry, semaphore holders, live stacks — without waiting for a
+  hang;
+- ``/events``   — process-wide ring-buffer tail with kind filtering.
+
+Every handler reads lock-protected SNAPSHOTS only (catalog stats,
+arbiter stats/dump, histogram snapshots, per-query span locks) — a
+scrape never takes an engine lock an executing query holds, which the
+lock-order validator armed in the console tests proves.
+
+Lifecycle mirrors the resource sampler singleton: ``TpuSession`` calls
+``sync_from_conf`` at construction and on ``set_conf`` of any
+``spark.rapids.console.*`` key; one console per process regardless of
+session count; ``session.stop()`` stops it.  Off by default
+(``spark.rapids.console.enabled``) with zero overhead when disabled —
+no socket, no tap, one module-global read on the emit hot path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from spark_rapids_tpu.aux import events as EV
+
+#: Prometheus text exposition format version 0.0.4
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+#: /events default tail length
+DEFAULT_EVENT_TAIL = 256
+
+
+# ---------------------------------------------------------------------------
+# endpoint payload builders (everything reads snapshots only)
+# ---------------------------------------------------------------------------
+
+def queries_payload(params: Optional[Dict] = None) -> dict:
+    """Live span trees + a bounded tail of finished summaries."""
+    from spark_rapids_tpu.aux import tracing as TR
+    live = [q.live_snapshot() for q in TR.live_queries()]
+    recent = [{"query_id": s.get("query_id"),
+               "description": s.get("description"),
+               "status": s.get("status"),
+               "duration_s": s.get("duration_s"),
+               "progress": 1.0}
+              for s in TR.recent_summaries()]
+    return {"live": live, "recent": recent}
+
+
+def memory_payload(params: Optional[Dict] = None) -> dict:
+    """Catalog pool gauges + per-(query, operator) byte attribution.
+    Attribution rows resolve their span id to the operator name through
+    the live-query registry; buffers registered outside any query
+    (caches, exchange stores) aggregate under query_id -1."""
+    from spark_rapids_tpu.memory.device_manager import get_runtime
+    rt = get_runtime()
+    if rt is None:
+        return {"pool": None, "attribution": []}
+    from spark_rapids_tpu.aux import tracing as TR
+    names: Dict[int, str] = {}
+    for q in TR.live_queries():
+        names.update(q.span_names())
+    rows = []
+    for row in rt.catalog.attribution():
+        row = dict(row)
+        node = names.get(row["span_id"])
+        if node is not None:
+            row["node"] = node
+        rows.append(row)
+    return {"pool": rt.catalog.stats(), "attribution": rows}
+
+
+def debug_dump_payload(params: Optional[Dict] = None) -> dict:
+    """The watchdog's thread-state ladder, on demand: arbiter registry
+    stats + serving view + live stacks, semaphore holders."""
+    from spark_rapids_tpu.memory.arbiter import get_arbiter
+    from spark_rapids_tpu.memory.device_manager import get_runtime
+    arb = get_arbiter()
+    payload = {
+        "arbiter": arb.stats(),
+        "serving": arb.serving_view(),
+        "dump": arb.dump().splitlines(),
+    }
+    rt = get_runtime()
+    if rt is not None:
+        payload["semaphore"] = rt.semaphore.stats()
+        payload["catalog"] = rt.catalog.stats()
+    EV.emit("consoleLifecycle", op="dump")
+    return payload
+
+
+def events_payload(params: Optional[Dict] = None) -> dict:
+    """Tail of the console's process-wide event tap, optionally
+    filtered by ``?kind=`` and bounded by ``?n=``."""
+    params = params or {}
+    tap = EV.console_tap()
+    if tap is None:
+        return {"events": [], "dropped": 0}
+    kind = params.get("kind") or None
+    try:
+        n = max(1, int(params.get("n", DEFAULT_EVENT_TAIL)))
+    except ValueError:
+        n = DEFAULT_EVENT_TAIL
+    rows = [{"event": e.kind, "query_id": e.query_id,
+             "span_id": e.span_id, "ts": e.ts, "payload": e.payload}
+            for e in tap.events() if kind is None or e.kind == kind]
+    return {"events": rows[-n:], "dropped": tap.dropped}
+
+
+def _server_payload(params: Optional[Dict] = None) -> dict:
+    from spark_rapids_tpu.serving.console_routes import server_payload
+    return server_payload()
+
+
+def _index_payload(params: Optional[Dict] = None) -> dict:
+    return {"service": "spark-rapids-tpu console",
+            "endpoints": sorted(list(_JSON_ROUTES) + ["/metrics"])}
+
+
+_JSON_ROUTES = {
+    "/": _index_payload,
+    "/queries": queries_payload,
+    "/memory": memory_payload,
+    "/server": _server_payload,
+    "/debug/dump": debug_dump_payload,
+    "/events": events_payload,
+}
+
+
+class _ConsoleHandler(BaseHTTPRequestHandler):
+    server_version = "SparkRapidsTpuConsole/1.0"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):     # noqa: A003 - BaseHTTPRequest API
+        pass    # diagnostics endpoint; stderr chatter helps nobody
+
+    def _send(self, code: int, ctype: str, body: bytes) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:              # noqa: N802 - BaseHTTPRequest API
+        try:
+            parsed = urllib.parse.urlsplit(self.path)
+            path = parsed.path
+            if len(path) > 1:
+                path = path.rstrip("/")
+            if path == "/metrics":
+                self._send(200, PROMETHEUS_CONTENT_TYPE,
+                           EV.render_prometheus().encode("utf-8"))
+                return
+            params = dict(urllib.parse.parse_qsl(parsed.query))
+            fn = _JSON_ROUTES.get(path)
+            if fn is None:
+                self._send(404, "application/json",
+                           json.dumps({"error": f"unknown path {path}",
+                                       **_index_payload()}).encode("utf-8"))
+                return
+            body = json.dumps(fn(params), default=str).encode("utf-8")
+            self._send(200, "application/json", body)
+        except Exception as e:  # noqa: BLE001 - a scrape must never crash
+            try:                # the server thread
+                self._send(500, "application/json",
+                           json.dumps({"error": repr(e)}).encode("utf-8"))
+            except Exception:   # noqa: BLE001 - client went away
+                pass
+
+
+class EngineConsole:
+    """One bound HTTP server + its serve thread + the event tap."""
+
+    def __init__(self, port: int = 0, bind_address: str = "127.0.0.1",
+                 ring_size: int = 2048):
+        self.conf_port = int(port)          # as configured (0 = ephemeral)
+        self.bind_address = bind_address
+        self.tap = EV.RingBufferSink(ring_size)
+        self._httpd = ThreadingHTTPServer((bind_address, self.conf_port),
+                                          _ConsoleHandler)
+        self._httpd.daemon_threads = True
+        self.port = int(self._httpd.server_address[1])  # as bound
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def url(self, path: str = "/") -> str:
+        host = self.bind_address if self.bind_address not in (
+            "", "0.0.0.0", "::") else "127.0.0.1"
+        return f"http://{host}:{self.port}{path}"
+
+    def start(self) -> None:
+        if self.running:
+            return
+        EV.set_console_tap(self.tap)
+        t = threading.Thread(target=self._httpd.serve_forever,
+                             name="tpu-console", daemon=True)
+        self._thread = t
+        t.start()
+        EV.emit("consoleLifecycle", op="start", port=self.port,
+                bind=self.bind_address)
+
+    def stop(self) -> None:
+        if EV.console_tap() is self.tap:
+            EV.set_console_tap(None)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
+        self._thread = None
+        EV.emit("consoleLifecycle", op="stop", port=self.port)
+
+
+# ---------------------------------------------------------------------------
+# process-wide singleton, synced from conf (the sampler pattern)
+# ---------------------------------------------------------------------------
+
+_LOCK = threading.Lock()
+_CONSOLE: Optional[EngineConsole] = None
+
+
+def active_console() -> Optional[EngineConsole]:
+    with _LOCK:
+        return _CONSOLE
+
+
+def stop_console() -> None:
+    global _CONSOLE
+    with _LOCK:
+        cur, _CONSOLE = _CONSOLE, None
+    if cur is not None:
+        cur.stop()
+
+
+def sync_from_conf(conf) -> Optional[EngineConsole]:
+    """Reconciles the singleton with ``spark.rapids.console.*``:
+    enabling binds + starts it, disabling stops it, a changed
+    port/bind address rebinds.  Idempotent — safe on every session
+    init / set_conf."""
+    global _CONSOLE
+    from spark_rapids_tpu import config as C
+    enabled = conf.get(C.CONSOLE_ENABLED.key, False)
+    port = int(conf.get(C.CONSOLE_PORT.key, 0))
+    bind = conf.get(C.CONSOLE_BIND_ADDRESS.key, "127.0.0.1")
+    stale = None
+    with _LOCK:
+        cur = _CONSOLE
+        if not enabled:
+            _CONSOLE, stale = None, cur
+        elif cur is not None and cur.running and \
+                cur.conf_port == port and cur.bind_address == bind:
+            return cur
+        else:
+            stale = cur
+            _CONSOLE = EngineConsole(port, bind)
+            _CONSOLE.start()
+        out = _CONSOLE
+    if stale is not None:
+        stale.stop()
+    return out

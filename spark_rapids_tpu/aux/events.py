@@ -101,6 +101,8 @@ EVENT_KINDS = frozenset({
     "lockOrderViolation",
     # live resource sampler (aux/sampler.py)
     "resourceSample",
+    # live engine console (aux/console.py): start/stop/dump lifecycle
+    "consoleLifecycle",
     # concurrent query serving (serving/server.py, serving/caches.py):
     # admission lifecycle, the two cross-query caches, and the online
     # AutoTuner's applied conf deltas
@@ -424,20 +426,40 @@ def remove_global_sink(sink: EventSink) -> None:
             _GLOBAL_SINKS.remove(sink)
 
 
+#: the console's process-wide event tail (aux/console.py /events): a
+#: RingBufferSink mirror of BOTH routing paths — query-scoped events
+#: (mirrored by QueryExecution.record_event) and global-scope events
+#: (mirrored here).  None when the console is off: the emit hot path
+#: pays one module-global read, nothing else.
+_CONSOLE_TAP: Optional[RingBufferSink] = None
+
+
+def set_console_tap(sink: Optional[RingBufferSink]) -> None:
+    global _CONSOLE_TAP
+    _CONSOLE_TAP = sink
+
+
+def console_tap() -> Optional[RingBufferSink]:
+    return _CONSOLE_TAP
+
+
 def emit(kind: str, **payload) -> None:
-    """The one hook every layer calls.  No active query and no global
-    sink = no allocation, no lock."""
+    """The one hook every layer calls.  No active query, no global
+    sink and no console tap = no allocation, no lock."""
     q = _ACTIVE.get()
     if q is not None:
         q.record_event(kind, payload)
         return
-    if _GLOBAL_SINKS:
+    tap = _CONSOLE_TAP
+    if _GLOBAL_SINKS or tap is not None:
         ev = Event(kind, NO_QUERY, current_span_id() or NO_SPAN,
                    time.monotonic(), payload)
         with _GLOBAL_LOCK:
             sinks = list(_GLOBAL_SINKS)
         for s in sinks:
             s.emit(ev)
+        if tap is not None:
+            tap.emit(ev)
 
 
 # ---------------------------------------------------------------------------

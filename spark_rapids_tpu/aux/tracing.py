@@ -20,6 +20,7 @@ queryStart / spanMetrics / queryEnd plus every layer event.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import itertools
 import threading
@@ -33,6 +34,26 @@ _span_ids = itertools.count(1)
 
 _LAST_LOCK = threading.Lock()
 _LAST_SUMMARY: Optional[dict] = None
+
+#: process-wide registry of in-flight QueryExecutions (registered on
+#: __enter__, removed at finish) + a bounded tail of finished summaries.
+#: The console's /queries endpoint reads both; the registry is a plain
+#: dict under its own leaf lock so a scrape never touches engine locks.
+_LIVE_LOCK = threading.Lock()
+_LIVE: Dict[int, "QueryExecution"] = {}
+_RECENT: collections.deque = collections.deque(maxlen=32)
+
+
+def live_queries() -> List["QueryExecution"]:
+    """The QueryExecutions currently in flight in this process."""
+    with _LIVE_LOCK:
+        return list(_LIVE.values())
+
+
+def recent_summaries() -> List[dict]:
+    """Bounded tail (newest last) of finished-query summary dicts."""
+    with _LIVE_LOCK:
+        return list(_RECENT)
 
 
 def last_query_summary() -> Optional[dict]:
@@ -127,6 +148,13 @@ class QueryExecution:
         self._transitions_snapshot = None
         self.summary_dict: Optional[dict] = None
         self.finished = False
+        #: cached predict_plan_costs rows for the attached plan (fixed
+        #: weights keep the live progress fraction monotone) + a
+        #: high-water mark so reported progress never regresses across
+        #: console scrapes even when a new partition wave opens
+        self._live_cost: Optional[List[Dict]] = None
+        self._live_cost_key: Optional[int] = None
+        self._progress_hwm = 0.0
         #: non-default conf values captured at from_conf (v2 event-log
         #: schema: rides the queryStart payload so the offline AutoTuner
         #: knows what it is tuning FROM)
@@ -163,6 +191,8 @@ class QueryExecution:
         if self.conf_snapshot:
             start_payload["conf"] = dict(self.conf_snapshot)
         self.record_event("queryStart", start_payload)
+        with _LIVE_LOCK:
+            _LIVE[self.query_id] = self
         return self
 
     def __exit__(self, exc_type, exc, tb):
@@ -212,6 +242,154 @@ class QueryExecution:
     def events(self) -> List[EV.Event]:
         return self.ring.events()
 
+    # -- live console view ---------------------------------------------------
+    def span_names(self) -> Dict[int, str]:
+        """span_id -> operator name for every span of this query (the
+        console joins BufferCatalog attribution tags through this)."""
+        with self._lock:
+            return {sid: sp.name for sid, sp in self._span_index.items()}
+
+    def _cost_predictions_locked(self) -> Optional[List[Dict]]:
+        """Pre-order per-node prediction rows for the attached plan,
+        cached per plan identity (attach_plan builds exec spans in the
+        same pre-order, so row i describes exec span i).  With a
+        configured machine profile the rows carry ``predicted_s`` from
+        the calibrated fit (the cost model's first live consumer);
+        without one they still carry ``estimate_rows`` so per-node
+        progress fractions work profile-free.  Caller holds _lock."""
+        plan = self._plan
+        if plan is None:
+            return None
+        if self._live_cost_key == id(plan):
+            return self._live_cost
+        rows: Optional[List[Dict]] = None
+        try:
+            from spark_rapids_tpu import config as C
+            from spark_rapids_tpu.plan import cost as PC
+            path = self.conf_snapshot.get(
+                C.HISTORY_MACHINE_PROFILE_PATH.key)
+            enabled = self.conf_snapshot.get(
+                C.HISTORY_COST_MODEL_ENABLED.key,
+                C.HISTORY_COST_MODEL_ENABLED.default)
+            profile = (PC.load_machine_profile(str(path))
+                       if path and enabled else None)
+            if profile is not None:
+                rows = PC.predict_plan_costs(plan, profile, live=True)
+            else:
+                rows = []
+
+                def walk(node) -> None:
+                    rows.append({"node": type(node).__name__,
+                                 "rows": PC.estimate_rows(node),
+                                 "predicted_s": None})
+                    for c in node.children:
+                        walk(c)
+
+                walk(plan)
+        except Exception:   # noqa: BLE001 - console view, never fails a query
+            rows = None
+        self._live_cost = rows
+        self._live_cost_key = id(plan)
+        return rows
+
+    def live_snapshot(self) -> dict:
+        """Point-in-time JSON view of this query for the console
+        /queries endpoint: the exec-span tree with rows/batches so far
+        (summed from the live partition child spans — OpMetric values
+        only harvest into exec spans at finish), plus a progress
+        fraction and an ETA joined against the machine-profile cost
+        predictions.  Reads only this query's own lock."""
+        now = time.monotonic()
+        with self._lock:
+            execs = self._exec_spans()
+            preds = self._cost_predictions_locked()
+            if preds is not None and len(preds) != len(execs):
+                preds = None    # replay attached a different-shape plan
+            finished = self.finished
+            summary = self.summary_dict
+            nodes = []
+            weighted_total = 0.0
+            weighted_done = 0.0
+            profiled = False
+            for i, sp in enumerate(execs):
+                parts = [c for c in sp.children if c.kind == "partition"]
+                live_rows = sum(c.rows for c in parts)
+                live_batches = sum(c.batches for c in parts)
+                if finished and sp.metrics:
+                    live_rows = int(sp.metrics.get("numOutputRows",
+                                                   live_rows) or 0)
+                    live_batches = int(sp.metrics.get("numOutputBatches",
+                                                      live_batches) or 0)
+                pred = preds[i] if preds is not None else None
+                pred_rows = int(pred["rows"]) if pred else None
+                pred_s = pred.get("predicted_s") if pred else None
+                if pred_s is not None:
+                    profiled = True
+                done = len(parts) > 0 and all(c.end is not None
+                                              for c in parts)
+                if finished or done:
+                    frac = 1.0
+                elif pred_rows:
+                    frac = min(1.0, live_rows / max(1, pred_rows))
+                else:
+                    frac = 0.0
+                weight = max(float(pred_s), 1e-9) \
+                    if pred_s is not None else 1.0
+                weighted_total += weight
+                weighted_done += weight * frac
+                nodes.append({
+                    "span_id": sp.span_id, "parent_id": sp.parent_id,
+                    "node": sp.name, "desc": sp.desc[:120],
+                    "device": sp.device,
+                    "rows": live_rows, "batches": live_batches,
+                    "partitions": len(parts),
+                    "partitions_done": sum(1 for c in parts
+                                           if c.end is not None),
+                    "predicted_rows": pred_rows,
+                    "predicted_s": pred_s,
+                    "frac": round(frac, 6),
+                })
+            if finished:
+                progress = 1.0
+            elif weighted_total > 0:
+                progress = weighted_done / weighted_total
+            else:
+                progress = 0.0
+            # high-water mark: a fresh partition wave lowers a node's
+            # raw fraction; the reported number must stay monotone
+            progress = max(progress, self._progress_hwm)
+            self._progress_hwm = progress
+            elapsed = ((self.root.end if self.root.end is not None
+                        else now) - self.root.start)
+            eta_s: Optional[float] = None
+            eta_source: Optional[str] = None
+            if finished:
+                eta_s, eta_source = 0.0, "finished"
+            elif profiled and weighted_done > 0:
+                # calibrate the profile's absolute scale to this run:
+                # remaining predicted seconds x (elapsed / completed
+                # predicted seconds)
+                eta_s = ((weighted_total - weighted_done)
+                         * (elapsed / weighted_done))
+                eta_source = "machine_profile"
+            elif progress > 0:
+                eta_s = elapsed * (1.0 - progress) / progress
+                eta_source = "elapsed_extrapolation"
+            snap = {
+                "query_id": self.query_id,
+                "description": self.description,
+                "status": "finished" if finished else "running",
+                "elapsed_s": round(elapsed, 6),
+                "progress": round(progress, 6),
+                "eta_s": (None if eta_s is None else round(eta_s, 6)),
+                "eta_source": eta_source,
+                "nodes": nodes,
+            }
+            if finished and summary is not None:
+                snap["status"] = summary.get("status", "finished")
+                snap["duration_s"] = summary.get("duration_s")
+            return snap
+
     # -- event funnel --------------------------------------------------------
     def record_event(self, kind: str, payload: dict,
                      span_id: Optional[int] = None) -> None:
@@ -226,6 +404,9 @@ class QueryExecution:
             self.ring.emit(ev)
             for s in self._sinks:
                 s.emit(ev)
+            tap = EV.console_tap()
+            if tap is not None:
+                tap.emit(ev)
 
     def _attribute_events(self) -> Dict[int, dict]:
         """Folds layer events onto their exec span (partition spans roll
@@ -389,6 +570,9 @@ class QueryExecution:
         global _LAST_SUMMARY
         with _LAST_LOCK:
             _LAST_SUMMARY = summary
+        with _LIVE_LOCK:
+            _LIVE.pop(self.query_id, None)
+            _RECENT.append(summary)
         return summary
 
     def _cost_crosscheck(self, plan, measured_s: float):

@@ -1035,6 +1035,17 @@ SERVING_PLAN_CACHE_MAX = conf_int(
     64,
     checker=lambda v: int(v) >= 0)
 
+SERVING_PLAN_CACHE_MAX_BYTES = conf_bytes(
+    "spark.rapids.serving.planCache.maxBytes",
+    "Byte budget for the physical plans the cross-query plan cache "
+    "retains (estimated per-variant from the plan tree; compiled "
+    "executables are process-wide jit caches and are not counted).  "
+    "Acts alongside the planCache.maxPlans count bound — whichever "
+    "trips first evicts LRU non-leased variants, counted in the "
+    "cache's evictions stat and visible on the console /server "
+    "endpoint.  0 = unbounded (count bound only).",
+    "0")
+
 SERVING_RESULT_CACHE_MAX_BYTES = conf_bytes(
     "spark.rapids.serving.resultCache.maxBytes",
     "In-memory budget for the deterministic query/CTE result cache "
@@ -1110,6 +1121,40 @@ HISTORY_REGRESS_MAD_BANDS = conf_float(
     "instead of flagging every run (1.4826 scales the median absolute "
     "deviation to a Gaussian sigma).",
     3.0)
+
+
+# ---------------------------------------------------------------------------
+# live engine console (spark_rapids_tpu/aux/console.py)
+# ---------------------------------------------------------------------------
+
+CONSOLE_ENABLED = conf_bool(
+    "spark.rapids.console.enabled",
+    "Serve the embedded live-engine console over HTTP (stdlib "
+    "ThreadingHTTPServer, no dependencies): /metrics (Prometheus "
+    "exposition), /queries (live span trees with progress/ETA), "
+    "/memory (pool gauges + per-query byte attribution), /server "
+    "(QueryServer admission/cache/latency stats), /debug/dump "
+    "(on-demand watchdog ladder) and /events (ring tail).  All "
+    "handlers read lock-protected snapshots only.  Off by default "
+    "with zero overhead when disabled.  Reference: the Spark UI / "
+    "PrometheusServlet sink.",
+    False)
+
+CONSOLE_PORT = conf_int(
+    "spark.rapids.console.port",
+    "TCP port the console binds.  0 picks an ephemeral port (the "
+    "bound port is logged in the consoleLifecycle event and exposed "
+    "via active_console().port for tests/bench).  Validated >= 0 at "
+    "set_conf.",
+    0,
+    checker=lambda v: 0 <= int(v) <= 65535)
+
+CONSOLE_BIND_ADDRESS = conf_str(
+    "spark.rapids.console.bindAddress",
+    "Interface the console listens on.  Defaults to loopback; set "
+    "0.0.0.0 deliberately to scrape from another host — the console "
+    "is unauthenticated diagnostics, not a public API.",
+    "127.0.0.1")
 
 
 class TpuConf:

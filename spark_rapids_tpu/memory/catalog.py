@@ -27,7 +27,7 @@ import itertools
 import os
 import tempfile
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, HostColumnarBatch
 from spark_rapids_tpu.memory import arbiter as _ARB
@@ -76,10 +76,17 @@ class BufferHandle:
 class _Buffer:
     __slots__ = ("handle", "tier", "device_batch", "host_batch", "disk_path",
                  "device_nbytes", "host_nbytes", "disk_nbytes",
-                 "disk_logical_nbytes", "spillable", "owned")
+                 "disk_logical_nbytes", "spillable", "owned",
+                 "query_id", "span_id")
 
     def __init__(self, handle: BufferHandle):
         self.handle = handle
+        #: attribution tags stamped at registration from the emitting
+        #: thread's query/span context (aux/events.py); -1 outside any
+        #: query.  The console /memory endpoint aggregates bytes by
+        #: these through ``attribution()``.
+        self.query_id = -1
+        self.span_id = -1
         self.tier = StorageTier.DEVICE
         self.device_batch: Optional[ColumnarBatch] = None
         self.host_batch: Optional[HostColumnarBatch] = None
@@ -100,6 +107,18 @@ class _Buffer:
         #: Python reference does.  In-flight pipeline prefetch registers
         #: this way (exec/pipeline.py).
         self.owned = True
+
+
+def _attribution_tags() -> tuple:
+    """(query_id, span_id) of the registering thread's context, -1/-1
+    outside any query.  Contextvar + thread-local reads only — no lock,
+    negligible cost on the registration path."""
+    from spark_rapids_tpu.aux import events as EV
+    q = EV.active_query()
+    if q is None:
+        return -1, -1
+    sid = EV.current_span_id()
+    return q.query_id, (sid if sid is not None else -1)
 
 
 def _delete_device_batch(batch: ColumnarBatch) -> None:
@@ -207,9 +226,11 @@ class BufferCatalog:
                          owned: bool = True) -> BufferHandle:
         nbytes = batch.nbytes()
         self.reserve(nbytes)
+        qid, sid = _attribution_tags()
         with self._lock:
             handle = BufferHandle(priority)
             buf = _Buffer(handle)
+            buf.query_id, buf.span_id = qid, sid
             buf.device_batch = batch
             buf.device_nbytes = nbytes
             buf.spillable = spillable
@@ -226,9 +247,11 @@ class BufferCatalog:
 
     def add_host_batch(self, batch: HostColumnarBatch,
                        priority: int = SpillPriority.HOST_MEMORY) -> BufferHandle:
+        qid, sid = _attribution_tags()
         with self._lock:
             handle = BufferHandle(priority)
             buf = _Buffer(handle)
+            buf.query_id, buf.span_id = qid, sid
             buf.host_batch = batch
             buf.host_nbytes = batch.nbytes()
             buf.tier = StorageTier.HOST
@@ -473,6 +496,28 @@ class BufferCatalog:
                 "buffers": len(self._buffers),
                 "spill_count": self.spill_count,
             }
+
+    def attribution(self) -> List[dict]:
+        """Per-(query, operator-span) byte attribution of live buffers,
+        aggregated from the registration tags (console /memory).  One
+        row per (query_id, span_id) with per-tier byte sums; query_id
+        -1 collects buffers registered outside any query (caches,
+        exchange stores).  Snapshot under the catalog lock only."""
+        with self._lock:
+            agg: Dict[tuple, dict] = {}
+            for b in self._buffers.values():
+                row = agg.setdefault((b.query_id, b.span_id), {
+                    "query_id": b.query_id, "span_id": b.span_id,
+                    "buffers": 0, "device_bytes": 0, "host_bytes": 0,
+                    "disk_bytes": 0, "spillable_bytes": 0,
+                })
+                row["buffers"] += 1
+                row["device_bytes"] += b.device_nbytes
+                row["host_bytes"] += b.host_nbytes
+                row["disk_bytes"] += b.disk_nbytes
+                if b.tier == StorageTier.DEVICE and b.spillable:
+                    row["spillable_bytes"] += b.device_nbytes
+            return [agg[k] for k in sorted(agg)]
 
     def close(self) -> None:
         with self._lock:

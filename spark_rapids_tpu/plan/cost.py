@@ -226,22 +226,39 @@ def _row_width(plan: Exec) -> int:
         return 8
 
 
-def _est_batches(plan: Exec) -> int:
+def _lazy_partitions_pending(plan: Exec) -> bool:
+    """True when the subtree holds an adaptive shuffle reader whose
+    specs are not yet materialized: its ``num_partitions`` EXECUTES the
+    child exchange under the node's exec lock, so a live-console scrape
+    (which holds the query lock) must never reach it — the executing
+    query holds that exec lock and needs the query lock to record
+    metrics."""
+    if plan.__dict__.get("_specs", False) is None:
+        return True
+    return any(_lazy_partitions_pending(c) for c in plan.children)
+
+
+def _est_batches(plan: Exec, live: bool = False) -> int:
     try:
+        if live and _lazy_partitions_pending(plan):
+            return 1
         return max(1, int(plan.num_partitions))
     except Exception:    # noqa: BLE001
         return 1
 
 
-def predict_plan_costs(plan: Exec, profile: MachineProfile) -> List[Dict]:
+def predict_plan_costs(plan: Exec, profile: MachineProfile,
+                       live: bool = False) -> List[Dict]:
     """Pre-order rows: one per plan node, ``predicted_s`` None when the
-    profile has no calibration for the node's family."""
+    profile has no calibration for the node's family.  ``live=True``
+    restricts the walk to non-blocking reads (cached partition specs
+    only) so it is safe WHILE the plan executes."""
     out: List[Dict] = []
 
     def walk(node: Exec, depth: int) -> None:
         name = type(node).__name__
         rows = estimate_rows(node)
-        batches = _est_batches(node)
+        batches = _est_batches(node, live)
         family = node_family(name)
         pred = None
         if family in ("transfer.pack", "transfer.unpack"):

@@ -39,7 +39,7 @@ from spark_rapids_tpu.plan.base import Exec
 
 class _PlanVariant:
     __slots__ = ("plan", "fingerprints", "lock", "last_used",
-                 "lit_values", "key")
+                 "lit_values", "key", "nbytes")
 
     def __init__(self, plan: Exec, fingerprints, lit_values, key=None):
         self.plan = plan
@@ -48,6 +48,27 @@ class _PlanVariant:
         self.key = key          # (conf_digest, norm) — discard needs it
         self.lock = threading.Lock()
         self.last_used = time.monotonic()
+        self.nbytes = _estimate_plan_bytes(plan)
+
+
+def _estimate_plan_bytes(plan: Exec) -> int:
+    """Shallow retained-size estimate of a physical plan tree: node
+    shells + their attribute dicts/values, NOT the data they reference
+    (scan partitions / device caches are shared with the session, not
+    retained by the cache).  Sizes the planCache.maxBytes bound."""
+    import sys
+    total = 0
+    try:
+        for node in plan.collect_nodes():
+            total += sys.getsizeof(node)
+            d = getattr(node, "__dict__", None)
+            if d:
+                total += sys.getsizeof(d)
+                for v in d.values():
+                    total += sys.getsizeof(v)
+    except Exception:   # noqa: BLE001 - sizing guess, never fatal
+        return 1024
+    return max(1, total)
 
 
 class PlanLease:
@@ -79,19 +100,31 @@ class PlanLease:
 class PlanCache:
     """norm-structure -> {literal vector -> leased physical plan}."""
 
-    def __init__(self, max_plans: int = 64):
+    def __init__(self, max_plans: int = 64, max_bytes: int = 0):
         self.max_plans = int(max_plans)
+        #: estimated-byte budget over retained variants, alongside the
+        #: count bound — whichever trips first evicts.  0 = unbounded.
+        self.max_bytes = int(max_bytes or 0)
         self._lock = threading.Lock()
         #: (conf_digest, norm) -> {lit_values: _PlanVariant}; LRU over
         #: VARIANTS (the leasable unit)
         self._entries: "collections.OrderedDict[Tuple[str, str], Dict]" = \
             collections.OrderedDict()
+        #: estimated bytes across retained variants (gauge)
+        self.total_bytes = 0
         self.stats = {"hits": 0, "norm_hits": 0, "misses": 0,
                       "busy_bypass": 0, "inserts": 0, "invalidations": 0,
                       "evictions": 0}
 
     def _variant_count(self) -> int:
         return sum(len(v) for v in self._entries.values())
+
+    def leased_count(self) -> int:
+        """Variants currently checked out to an executor (console
+        /server)."""
+        with self._lock:
+            return sum(1 for e in self._entries.values()
+                       for v in e.values() if v.lock.locked())
 
     def lookup(self, conf_digest: str, sig, fingerprints
                ) -> Optional[PlanLease]:
@@ -120,6 +153,7 @@ class PlanCache:
                 # an input file changed under this plan: every variant
                 # of the structure scanned the same files — drop them all
                 self.stats["invalidations"] += len(entry)
+                self.total_bytes -= sum(v.nbytes for v in entry.values())
                 del self._entries[key]
                 emit("planCache", op="invalidate", norm=sig.norm[:12],
                      variants=len(entry))
@@ -146,11 +180,18 @@ class PlanCache:
         variant.lock.acquire()
         with self._lock:
             entry = self._entries.setdefault(key, {})
+            old = entry.get(sig.lit_values)
+            if old is not None:
+                self.total_bytes -= old.nbytes
             entry[sig.lit_values] = variant
+            self.total_bytes += variant.nbytes
             self._entries.move_to_end(key)
             self.stats["inserts"] += 1
-            # evict least-recently-used UNLEASED variants past the bound
-            while self._variant_count() > self.max_plans:
+            # evict least-recently-used UNLEASED variants past either
+            # bound (variant count OR retained-byte estimate)
+            while self._variant_count() > self.max_plans or \
+                    (self.max_bytes > 0
+                     and self.total_bytes > self.max_bytes):
                 evicted = False
                 for k in list(self._entries):
                     ent = self._entries[k]
@@ -158,6 +199,7 @@ class PlanCache:
                         if v is variant or v.lock.locked():
                             continue
                         del ent[lv]
+                        self.total_bytes -= v.nbytes
                         self.stats["evictions"] += 1
                         evicted = True
                         break
@@ -184,6 +226,7 @@ class PlanCache:
             entry = self._entries.get(v.key)
             if entry is not None and entry.get(v.lit_values) is v:
                 del entry[v.lit_values]
+                self.total_bytes -= v.nbytes
                 if not entry:
                     del self._entries[v.key]
                 self.stats["invalidations"] += 1
@@ -194,6 +237,7 @@ class PlanCache:
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self.total_bytes = 0
 
 
 class _ResultEntry:

@@ -1,0 +1,50 @@
+"""Serving-side console routes: the /server endpoint payload.
+
+The console (aux/console.py) is engine-generic; what the serving layer
+exposes — admission queue depth, per-stage latency histogram snapshots,
+plan/result-cache hit rates and leased variants — lives here, next to
+the structures it reads.  Live QueryServers are discovered through the
+``serving.server.live_servers()`` weak registry; everything read is a
+lock-protected snapshot (``stats()`` copies, ``live_stats()``,
+``LatencyHistogram.snapshot()``), never a structure an executing query
+holds a lock on.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List
+
+
+def histogram_json(snap: Dict) -> Dict:
+    """A ``LatencyHistogram.snapshot()`` made JSON-safe: the +Inf bucket
+    bound becomes the Prometheus-style string ``"+Inf"``."""
+    return {
+        "buckets": [["+Inf" if math.isinf(le) else le, n]
+                    for le, n in snap["buckets"]],
+        "sum": round(snap["sum"], 6),
+        "count": snap["count"],
+    }
+
+
+def _hit_rate(stats: Dict) -> float:
+    hits = int(stats.get("hits", 0))
+    misses = int(stats.get("misses", 0))
+    total = hits + misses
+    return round(hits / total, 6) if total else 0.0
+
+
+def server_payload() -> dict:
+    """The /server endpoint body: one row per live QueryServer plus the
+    process-wide per-stage latency histogram snapshots."""
+    from spark_rapids_tpu.serving import server as SRV
+    servers: List[dict] = []
+    for s in SRV.live_servers():
+        st = s.stats()
+        st.update(s.live_stats())
+        st["plan_cache_hit_rate"] = _hit_rate(st["plan_cache"])
+        st["result_cache_hit_rate"] = _hit_rate(st["result_cache"])
+        servers.append(st)
+    hists = {stage: histogram_json(snap)
+             for stage, snap in SRV.latency_histograms().items()}
+    return {"servers": servers, "latency_histograms": hists}

@@ -35,6 +35,7 @@ import hashlib
 import queue
 import threading
 import time
+import weakref
 from typing import Callable, Dict, List, Optional, Union
 
 from spark_rapids_tpu import config as C
@@ -123,6 +124,18 @@ def latency_histograms() -> Dict[str, Dict]:
     with _HIST_LOCK:
         items = list(_HISTOGRAMS.items())
     return {stage: h.snapshot() for stage, h in items}
+
+
+#: process-wide registry of running QueryServers (weak: a dropped,
+#: never-stopped server must not leak here).  The console's /server
+#: endpoint discovers live servers through it.
+_SERVERS: "weakref.WeakSet" = weakref.WeakSet()
+_SERVERS_LOCK = threading.Lock()
+
+
+def live_servers() -> List["QueryServer"]:
+    with _SERVERS_LOCK:
+        return [s for s in _SERVERS if not s._stopped]
 
 
 class AdmissionController:
@@ -311,7 +324,9 @@ class QueryServer:
             int(cf.get(C.SERVING_QUEUE_TIMEOUT_MS.key)),
             int(cf.get(C.SERVING_QUEUE_BACKOFF_MS.key)))
         self.plan_cache = PlanCache(
-            int(cf.get(C.SERVING_PLAN_CACHE_MAX.key)))
+            int(cf.get(C.SERVING_PLAN_CACHE_MAX.key)),
+            max_bytes=C.parse_bytes(
+                cf.get(C.SERVING_PLAN_CACHE_MAX_BYTES.key)))
         self.result_cache = ResultCache(
             C.parse_bytes(cf.get(C.SERVING_RESULT_CACHE_MAX_BYTES.key)),
             spill=cf.get(C.SERVING_RESULT_CACHE_SPILL.key))
@@ -340,6 +355,8 @@ class QueryServer:
                                  name=f"tpu-serve-{i}", daemon=True)
             t.start()
             self._workers.append(t)
+        with _SERVERS_LOCK:
+            _SERVERS.add(self)
 
     # -- conf ----------------------------------------------------------------
     @property
@@ -382,6 +399,8 @@ class QueryServer:
         # honor 0-disables immediately)
         self.plan_cache.max_plans = int(
             cf.get(C.SERVING_PLAN_CACHE_MAX.key))
+        self.plan_cache.max_bytes = C.parse_bytes(
+            cf.get(C.SERVING_PLAN_CACHE_MAX_BYTES.key))
         self.admission.timeout_ms = int(
             cf.get(C.SERVING_QUEUE_TIMEOUT_MS.key))
         self.admission.backoff_ms = int(
@@ -447,13 +466,33 @@ class QueryServer:
         self._sync_ring_sink()      # _stopped -> always deregisters
         self.result_cache.clear()
         self.plan_cache.clear()
+        with _SERVERS_LOCK:
+            _SERVERS.discard(self)
 
     def stats(self) -> Dict:
+        pc = dict(self.plan_cache.stats)
+        pc["bytes"] = self.plan_cache.total_bytes
+        pc["max_bytes"] = self.plan_cache.max_bytes
+        pc["leased"] = self.plan_cache.leased_count()
         return {
             "admission": dict(self.admission.stats),
-            "plan_cache": dict(self.plan_cache.stats),
+            "plan_cache": pc,
             "result_cache": dict(self.result_cache.stats),
             "autotune_applied": len(self.autotune_applied),
+        }
+
+    def live_stats(self) -> Dict:
+        """Point-in-time serving state for the console /server endpoint
+        (the cumulative ``stats()`` counters tell rates, not depth)."""
+        with self.admission._cond:
+            admitted_now = len(self.admission._admitted)
+            reserved = sum(self.admission._admitted.values())
+        return {
+            "queue_depth": self._queue.qsize(),
+            "admitted_now": admitted_now,
+            "reserved_bytes": reserved,
+            "max_concurrent": self.admission.max_concurrent,
+            "stopped": self._stopped,
         }
 
     # -- worker --------------------------------------------------------------

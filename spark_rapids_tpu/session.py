@@ -68,6 +68,11 @@ class TpuSession:
         from spark_rapids_tpu.parallel.mesh import sync_from_conf \
             as sync_mesh
         sync_mesh(self.conf)
+        # live engine console (spark.rapids.console.*): the HTTP
+        # metrics/status endpoint, same process-singleton lifecycle
+        from spark_rapids_tpu.aux.console import sync_from_conf \
+            as sync_console
+        sync_console(self.conf)
         #: temp views for the SQL front-end (name -> DataFrame)
         self._views: Dict[str, "DataFrame"] = {}
         #: row-based Hive UDF passthrough (name -> (fn, return_type));
@@ -113,6 +118,10 @@ class TpuSession:
             from spark_rapids_tpu.parallel.mesh import sync_from_conf \
                 as sync_mesh
             sync_mesh(self.conf, allow_disable=True)
+        elif key.startswith("spark.rapids.console."):
+            from spark_rapids_tpu.aux.console import sync_from_conf \
+                as sync_console
+            sync_console(self.conf)
         return self
 
     # -- SQL ----------------------------------------------------------------
@@ -266,6 +275,8 @@ class TpuSession:
         return TpuSession._Reader(self)
 
     def stop(self):
+        from spark_rapids_tpu.aux.console import stop_console
+        stop_console()
         from spark_rapids_tpu.aux.sampler import stop_sampler
         stop_sampler()
         from spark_rapids_tpu.memory.arbiter import stop_watchdog

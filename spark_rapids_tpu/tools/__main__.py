@@ -109,6 +109,11 @@ def _build_parser() -> argparse.ArgumentParser:
                             "conf spark.rapids.history.path)")
     h_ing.add_argument("--label", default="",
                        help="free-form tag recorded on each run")
+    h_ing.add_argument("--force", action="store_true",
+                       help="always insert a new run, even when the "
+                            "same path + content digest was already "
+                            "ingested (default: update that run in "
+                            "place)")
     h_rep = hsub.add_parser("report", help="warehouse inventory")
     h_rep.add_argument("--db", default=None)
     h_rep.add_argument("--json", action="store_true")
@@ -287,7 +292,8 @@ def _run_history(args) -> int:
         with HistoryWarehouse(args.db) as wh:
             total = []
             for p in args.paths:
-                total.extend(wh.ingest(p, label=args.label))
+                total.extend(wh.ingest(p, label=args.label,
+                                       force=args.force))
         for r in total:
             extra = (f"{r.get('queries', 0)} query(ies), "
                      f"{r.get('spans', 0)} span(s), "
@@ -296,7 +302,9 @@ def _run_history(args) -> int:
                      else f"{r.get('metrics', 0)} metric(s)"
                      + (f" [FAILED RUN: {r['failure']}]"
                         if r.get("failure") else ""))
-            print(f"run {r['run_id']}: {r['kind']} "
+            verb = "updated (same content)" if r.get("updated") \
+                else r["kind"]
+            print(f"run {r['run_id']}: {verb} "
                   f"{r['source']} -> {extra}")
         return 0
     if args.action == "report":

@@ -20,6 +20,7 @@ operator's measured time.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
@@ -42,7 +43,8 @@ CREATE TABLE IF NOT EXISTS runs(
     schema_versions TEXT NOT NULL DEFAULT '',
     queries INTEGER NOT NULL DEFAULT 0,
     truncated_lines INTEGER NOT NULL DEFAULT 0,
-    dropped_events INTEGER NOT NULL DEFAULT 0);
+    dropped_events INTEGER NOT NULL DEFAULT 0,
+    content_digest TEXT NOT NULL DEFAULT '');
 CREATE TABLE IF NOT EXISTS queries(
     run_id INTEGER NOT NULL, query_id INTEGER NOT NULL,
     run_gen INTEGER NOT NULL DEFAULT 0, ordinal INTEGER NOT NULL,
@@ -111,6 +113,13 @@ class HistoryWarehouse:
             os.makedirs(d, exist_ok=True)
         self._db = sqlite3.connect(path)
         self._db.executescript(_TABLES)
+        try:
+            # pre-digest warehouses migrate in place; their existing
+            # runs keep '' (never matched, so never deduped against)
+            self._db.execute("ALTER TABLE runs ADD COLUMN content_digest"
+                             " TEXT NOT NULL DEFAULT ''")
+        except sqlite3.OperationalError:
+            pass        # column already exists (fresh DDL or migrated)
         self._db.execute(
             "INSERT OR IGNORE INTO meta(key, value) VALUES (?, ?)",
             ("history_schema_version", str(HISTORY_SCHEMA_VERSION)))
@@ -127,11 +136,18 @@ class HistoryWarehouse:
         return False
 
     # -- ingestion -----------------------------------------------------------
-    def ingest(self, path: str, label: str = "") -> List[Dict]:
+    def ingest(self, path: str, label: str = "",
+               force: bool = False) -> List[Dict]:
         """Path-level entry: a file ingests as one run (sniffed event
         log vs bench payload); a directory ingests every non-rotated
         file inside it, each as its own run (rotated ``.N`` siblings
-        ride with their base log, like the reader)."""
+        ride with their base log, like the reader).
+
+        Idempotent by content: re-ingesting the same path with the same
+        content digest UPDATES the existing run row (child rows purged
+        and re-inserted under the same run_id) instead of inserting a
+        duplicate that would skew the regress baseline.  ``force=True``
+        always inserts a new run."""
         if os.path.isdir(path):
             out = []
             names = sorted(os.listdir(path))
@@ -143,31 +159,68 @@ class HistoryWarehouse:
                 fp = os.path.join(path, name)
                 if not os.path.isfile(fp):
                     continue
-                out.append(self.ingest_file(fp, label=label))
+                out.append(self.ingest_file(fp, label=label, force=force))
             return out
-        return [self.ingest_file(path, label=label)]
+        return [self.ingest_file(path, label=label, force=force)]
 
-    def ingest_file(self, path: str, label: str = "") -> Dict:
+    def ingest_file(self, path: str, label: str = "",
+                    force: bool = False) -> Dict:
         if _sniff_event_log(path):
-            return self.ingest_log(path, label=label)
-        return self.ingest_payload(path, label=label)
+            return self.ingest_log(path, label=label, force=force)
+        return self.ingest_payload(path, label=label, force=force)
 
-    def ingest_log(self, path: str, label: str = "") -> Dict:
+    #: run-scoped child tables, purged on an idempotent re-ingest
+    _CHILD_TABLES = ("queries", "spans", "stage_programs", "transitions",
+                     "spills", "ici", "compiles", "confs", "serving",
+                     "bench_metrics")
+
+    def _existing_run(self, src: str, digest: str) -> Optional[int]:
+        if not digest:
+            return None
+        row = self._db.execute(
+            "SELECT run_id FROM runs WHERE source = ? AND"
+            " content_digest = ? ORDER BY run_id LIMIT 1",
+            (src, digest)).fetchone()
+        return row[0] if row else None
+
+    def _purge_children(self, cur, run_id: int) -> None:
+        for table in self._CHILD_TABLES:
+            cur.execute(f"DELETE FROM {table} WHERE run_id = ?",
+                        (run_id,))
+
+    def ingest_log(self, path: str, label: str = "",
+                   force: bool = False) -> Dict:
         """One event log (rotated/gzip set) -> one run."""
         from spark_rapids_tpu.tools.profile import attribute
         from spark_rapids_tpu.tools.reader import (profiles_from_events,
                                                    read_events)
         events, diag = read_events(path)
         profiles, diag = profiles_from_events(events, diag)
+        src = os.path.abspath(path)
+        digest = _content_digest(path, rotated=True)
+        versions = ",".join(str(v)
+                            for v in sorted(set(diag.header_versions)))
         cur = self._db.cursor()
-        cur.execute(
-            "INSERT INTO runs(kind, source, label, status, ingested_at,"
-            " schema_versions, queries, truncated_lines, dropped_events)"
-            " VALUES ('event_log', ?, ?, 'ok', ?, ?, ?, ?, ?)",
-            (os.path.abspath(path), label, time.time(),
-             ",".join(str(v) for v in sorted(set(diag.header_versions))),
-             len(profiles), diag.truncated_lines, diag.dropped_events))
-        run_id = cur.lastrowid
+        run_id = None if force else self._existing_run(src, digest)
+        updated = run_id is not None
+        if updated:
+            self._purge_children(cur, run_id)
+            cur.execute(
+                "UPDATE runs SET label = ?, status = 'ok',"
+                " ingested_at = ?, schema_versions = ?, queries = ?,"
+                " truncated_lines = ?, dropped_events = ?"
+                " WHERE run_id = ?",
+                (label, time.time(), versions, len(profiles),
+                 diag.truncated_lines, diag.dropped_events, run_id))
+        else:
+            cur.execute(
+                "INSERT INTO runs(kind, source, label, status,"
+                " ingested_at, schema_versions, queries,"
+                " truncated_lines, dropped_events, content_digest)"
+                " VALUES ('event_log', ?, ?, 'ok', ?, ?, ?, ?, ?, ?)",
+                (src, label, time.time(), versions, len(profiles),
+                 diag.truncated_lines, diag.dropped_events, digest))
+            run_id = cur.lastrowid
         counts = {"queries": 0, "spans": 0, "programs": 0,
                   "transitions": 0, "spills": 0, "ici": 0,
                   "compiles": 0, "confs": 0, "serving": 0}
@@ -195,7 +248,7 @@ class HistoryWarehouse:
                 counts["serving"] += 1
         self._db.commit()
         return {"run_id": run_id, "kind": "event_log",
-                "source": os.path.abspath(path),
+                "source": src, "updated": updated,
                 "schema_versions": sorted(set(diag.header_versions)),
                 **counts}
 
@@ -282,26 +335,42 @@ class HistoryWarehouse:
                 (run_id, qp.query_id, str(key), str(value)))
             counts["confs"] += 1
 
-    def ingest_payload(self, source, label: str = "") -> Dict:
+    def ingest_payload(self, source, label: str = "",
+                       force: bool = False) -> Dict:
         """One BENCH/MULTICHIP payload (path or already-loaded dict)
         -> one run of metric rows.  A failed run (placeholder zeros) is
-        recorded with ``status='failed'`` and no metric rows."""
+        recorded with ``status='failed'`` and no metric rows.  Path
+        sources dedupe by content digest like event logs; an
+        already-loaded dict (bench.py's in-process auto-ingest) always
+        inserts — there is no stable source identity to match."""
         from spark_rapids_tpu.tools.compare import METRICS, _dig, load_bench
         from spark_rapids_tpu.tools.regression import run_failure
         if isinstance(source, str):
             payload = load_bench(source)
             src = os.path.abspath(source)
+            digest = _content_digest(source)
         else:
             payload = dict(source or {})
             src = "<payload>"
+            digest = ""
         why = run_failure(payload)
+        status = "failed" if why is not None else "ok"
         cur = self._db.cursor()
-        cur.execute(
-            "INSERT INTO runs(kind, source, label, status, ingested_at)"
-            " VALUES ('bench', ?, ?, ?, ?)",
-            (src, label, "failed" if why is not None else "ok",
-             time.time()))
-        run_id = cur.lastrowid
+        run_id = None if force else self._existing_run(src, digest)
+        updated = run_id is not None
+        if updated:
+            self._purge_children(cur, run_id)
+            cur.execute(
+                "UPDATE runs SET label = ?, status = ?, ingested_at = ?"
+                " WHERE run_id = ?",
+                (label, status, time.time(), run_id))
+        else:
+            cur.execute(
+                "INSERT INTO runs(kind, source, label, status,"
+                " ingested_at, content_digest)"
+                " VALUES ('bench', ?, ?, ?, ?, ?)",
+                (src, label, status, time.time(), digest))
+            run_id = cur.lastrowid
         metrics = 0
         if why is None:
             for mlabel, dotted, higher in METRICS:
@@ -333,7 +402,7 @@ class HistoryWarehouse:
                         metrics += 1
         self._db.commit()
         return {"run_id": run_id, "kind": "bench", "source": src,
-                "status": "failed" if why is not None else "ok",
+                "status": status, "updated": updated,
                 "failure": why, "metrics": metrics}
 
     # -- queries over the warehouse -----------------------------------------
@@ -371,6 +440,38 @@ def render_report(report: Dict) -> str:
                      f"{(r['label'] or '-'):<14}{r['queries']:>8}  "
                      f"{os.path.basename(r['source'])}")
     return "\n".join(lines) + "\n"
+
+
+def _content_digest(path: str, rotated: bool = False) -> str:
+    """sha256 of the file's bytes — the idempotency key alongside the
+    absolute path.  For event logs, rotated ``.N`` siblings fold in
+    (numeric order): the ingested run covers the whole set, so its
+    identity must too.  Unreadable files digest as '' (never matched)."""
+    h = hashlib.sha256()
+    paths = [path]
+    if rotated:
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        base = os.path.basename(path)
+        sibs = []
+        try:
+            for name in os.listdir(d):
+                m = _ROTATED.match(name)
+                if m and m.group("base") == base:
+                    sibs.append((int(name.rsplit(".", 1)[1]),
+                                 os.path.join(d, name)))
+        except OSError:
+            pass
+        paths.extend(p for _, p in sorted(sibs))
+    read_any = False
+    for p in paths:
+        try:
+            with open(p, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            read_any = True
+        except OSError:
+            continue
+    return h.hexdigest() if read_any else ""
 
 
 def _sniff_event_log(path: str) -> bool:

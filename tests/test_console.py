@@ -1,0 +1,448 @@
+"""Live engine console tier-1 tests (spark_rapids_tpu/aux/console.py +
+serving/console_routes.py):
+
+- endpoint JSON schemas: /, /queries, /memory, /server, /events,
+  /debug/dump over a real ephemeral-port HTTP socket;
+- /metrics byte-identical to ``render_prometheus()`` under concurrent
+  scrapes, with the Prometheus 0.0.4 exposition content-type;
+- progress/ETA monotonicity polled over HTTP while a query is LIVE,
+  with the ETA sourced from the calibrated machine profile when
+  ``spark.rapids.history.machineProfilePath`` is configured (the cost
+  model's first live consumer);
+- /debug/dump during an injected ``memory.block`` hang: the on-demand
+  watchdog ladder shows the parked holder and its live stack while the
+  query is wedged, and the query still recovers bit-identically;
+- disabled conf = no socket at all; conf-driven start/stop/rebind
+  through the session sync (the sampler singleton lifecycle);
+- trimodal bit-identity: console on/off changes no query results;
+- the lock-order validator is armed across this whole suite (autouse)
+  and must observe ZERO violations — every handler reads snapshots
+  only, never an engine lock an executing query holds.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.aux import events as EV
+from spark_rapids_tpu.aux import lockorder as LO
+from spark_rapids_tpu.aux.console import (PROMETHEUS_CONTENT_TYPE,
+                                          EngineConsole, active_console,
+                                          stop_console)
+from spark_rapids_tpu.expressions.base import Alias, col
+
+from tests.asserts import tpu_session
+
+CONSOLE_CONF = {"spark.rapids.sql.test.enabled": "false",
+                "spark.rapids.console.enabled": "true",
+                "spark.rapids.console.port": "0"}
+
+_DATA = {"k": np.arange(24_000, dtype=np.int64) % 37,
+         "v": np.linspace(0.0, 1.0, 24_000)}
+
+
+@pytest.fixture(autouse=True)
+def _lockorder_armed():
+    """The suite-wide proof: console scrapes racing live queries must
+    never create a lock-order violation (handlers read snapshots only).
+    ``force_enabled`` wins over every incidental session construction."""
+    LO.force_enabled(True)
+    LO.reset_observations()
+    yield
+    total = LO.violations_total()
+    pairs = LO.violation_pairs()
+    LO.force_enabled(None)
+    assert total == 0, f"lock-order violations from console suite: {pairs}"
+
+
+@pytest.fixture(autouse=True)
+def _console_down_after():
+    yield
+    stop_console()
+
+
+def _get(con, path):
+    with urllib.request.urlopen(con.url(path), timeout=10) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def _get_json(con, path):
+    status, headers, body = _get(con, path)
+    assert status == 200
+    assert headers["Content-Type"] == "application/json"
+    return json.loads(body.decode("utf-8"))
+
+
+def _query(s, parts=4):
+    df = s.create_dataframe(_DATA, num_partitions=parts)
+    return df.group_by("k").agg(Alias(F.sum(col("v")), "sv")) \
+        .order_by("k").collect()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: conf-driven singleton, disabled = no socket
+# ---------------------------------------------------------------------------
+
+class TestLifecycle:
+    def test_disabled_conf_means_no_socket(self):
+        s = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+        try:
+            assert active_console() is None
+            assert EV.console_tap() is None      # zero emit-path overhead
+            _query(s, parts=2)
+            assert active_console() is None
+        finally:
+            s.stop()
+
+    def test_set_conf_starts_stops_and_rebinds(self):
+        s = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+        try:
+            s.set_conf("spark.rapids.console.enabled", "true")
+            con = active_console()
+            assert con is not None and con.running
+            url = con.url("/")
+            assert _get_json(con, "/")["service"] \
+                == "spark-rapids-tpu console"
+            # same conf -> same instance (idempotent sync)
+            s.set_conf("spark.rapids.console.bindAddress", "127.0.0.1")
+            assert active_console() is con
+            # disable -> socket actually closed
+            s.set_conf("spark.rapids.console.enabled", "false")
+            assert active_console() is None
+            with pytest.raises(urllib.error.URLError):
+                urllib.request.urlopen(url, timeout=2)
+        finally:
+            s.stop()
+
+    def test_session_stop_tears_console_down(self):
+        s = tpu_session(CONSOLE_CONF)
+        con = active_console()
+        assert con is not None and con.running
+        s.stop()
+        assert active_console() is None
+        assert not con.running
+
+    def test_unknown_path_404_with_index(self):
+        con = EngineConsole(port=0)
+        con.start()
+        try:
+            req = urllib.request.Request(con.url("/nope"))
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 404
+            body = json.loads(ei.value.read().decode("utf-8"))
+            assert "/metrics" in body["endpoints"]
+        finally:
+            con.stop()
+
+
+# ---------------------------------------------------------------------------
+# endpoint schemas
+# ---------------------------------------------------------------------------
+
+class TestEndpointSchemas:
+    def test_queries_memory_events_schemas(self):
+        s = tpu_session(CONSOLE_CONF)
+        try:
+            _query(s)
+            con = active_console()
+
+            q = _get_json(con, "/queries")
+            assert set(q) == {"live", "recent"}
+            assert q["recent"], "finished query must appear in recent"
+            row = q["recent"][-1]
+            assert set(row) >= {"query_id", "description", "status",
+                                "duration_s", "progress"}
+            assert row["progress"] == 1.0 and row["status"] == "ok"
+
+            m = _get_json(con, "/memory")
+            assert set(m) == {"pool", "attribution"}
+            assert m["pool"] is not None
+            assert isinstance(m["attribution"], list)
+            for arow in m["attribution"]:
+                assert set(arow) >= {"query_id", "span_id", "buffers",
+                                     "device_bytes", "host_bytes",
+                                     "disk_bytes", "spillable_bytes"}
+
+            ev = _get_json(con, "/events")
+            assert ev["events"], "query events must reach the console tap"
+            assert set(ev["events"][-1]) == {"event", "query_id",
+                                             "span_id", "ts", "payload"}
+            kinds = {e["event"] for e in ev["events"]}
+            assert "queryEnd" in kinds
+
+            only = _get_json(con, "/events?kind=queryEnd")
+            assert only["events"]
+            assert {e["event"] for e in only["events"]} == {"queryEnd"}
+            assert len(_get_json(con, "/events?n=1")["events"]) == 1
+
+            d = _get_json(con, "/debug/dump")
+            assert set(d) >= {"arbiter", "serving", "dump"}
+            assert any("== arbiter:" in ln for ln in d["dump"])
+        finally:
+            s.stop()
+
+    def test_server_endpoint_schema(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        from spark_rapids_tpu.serving import QueryServer
+        rng = np.random.default_rng(5)
+        t = pa.table({"k": rng.integers(0, 9, 1000).astype(np.int64),
+                      "v": rng.standard_normal(1000)})
+        path = str(tmp_path / "t.parquet")
+        pq.write_table(t, path)
+        s = tpu_session({**CONSOLE_CONF,
+                         "spark.rapids.serving.planCache.maxBytes": "1m"})
+        s.create_or_replace_temp_view("t", s.read.parquet(path))
+        srv = QueryServer(session=s)
+        try:
+            q = "SELECT k, SUM(v) AS sv FROM t GROUP BY k ORDER BY k"
+            srv.submit(q, tag="a").result(timeout=60)
+            srv.submit(q, tag="b").result(timeout=60)
+            con = active_console()
+            p = _get_json(con, "/server")
+            assert set(p) == {"servers", "latency_histograms"}
+            assert len(p["servers"]) == 1
+            row = p["servers"][0]
+            assert set(row) >= {"plan_cache", "result_cache", "admission",
+                                "queue_depth", "admitted_now",
+                                "reserved_bytes", "max_concurrent",
+                                "plan_cache_hit_rate",
+                                "result_cache_hit_rate"}
+            pc = row["plan_cache"]
+            assert set(pc) >= {"hits", "misses", "evictions", "bytes",
+                               "max_bytes", "leased"}
+            assert pc["max_bytes"] == 1024 * 1024
+            assert pc["bytes"] > 0           # a cached plan has a size
+            assert row["result_cache_hit_rate"] > 0  # the exact repeat
+            assert p["latency_histograms"]
+            for snap in p["latency_histograms"].values():
+                assert set(snap) == {"buckets", "sum", "count"}
+                assert snap["buckets"][-1][0] == "+Inf"
+        finally:
+            srv.stop()
+            s.stop()
+        # a stopped server leaves the live view (weak registry)
+        s2 = tpu_session(CONSOLE_CONF)
+        try:
+            assert _get_json(active_console(), "/server")["servers"] == []
+        finally:
+            s2.stop()
+
+
+# ---------------------------------------------------------------------------
+# /metrics: byte-identical to render_prometheus() under concurrent scrapes
+# ---------------------------------------------------------------------------
+
+class TestMetrics:
+    def test_metrics_byte_identical_concurrent_scrapes(self):
+        s = tpu_session(CONSOLE_CONF)
+        try:
+            _query(s, parts=2)
+            con = active_console()
+            bodies, errors = [], []
+            block = threading.Barrier(8)
+
+            def scrape():
+                try:
+                    block.wait(timeout=10)
+                    for _ in range(5):
+                        status, headers, body = _get(con, "/metrics")
+                        assert status == 200
+                        assert headers["Content-Type"] \
+                            == PROMETHEUS_CONTENT_TYPE
+                        bodies.append(body)
+                except Exception as e:   # noqa: BLE001 - surfaced below
+                    errors.append(e)
+
+            # quiescent engine: every concurrent scrape must serve the
+            # SAME exposition, byte-for-byte what the renderer produces
+            ref = EV.render_prometheus().encode("utf-8")
+            threads = [threading.Thread(target=scrape) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert not errors, errors
+            assert len(bodies) == 40
+            assert set(bodies) == {ref}
+            text = ref.decode("utf-8")
+            assert "# TYPE" in text and "# HELP" in text
+        finally:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# live progress + ETA from the calibrated machine profile
+# ---------------------------------------------------------------------------
+
+def _build_machine_profile(tmp_path):
+    from spark_rapids_tpu.tools.history import HistoryWarehouse, calibrate
+    log = tmp_path / "prof_ev.jsonl"
+    s = tpu_session({"spark.rapids.sql.test.enabled": "false",
+                     "spark.rapids.sql.eventLog.path": str(log)})
+    try:
+        _query(s)
+        _query(s)
+    finally:
+        s.stop()
+    with HistoryWarehouse(str(tmp_path / "prof.db")) as wh:
+        wh.ingest(str(log), label="cal")
+        profile = calibrate(wh)
+    prof = tmp_path / "machine.json"
+    prof.write_text(json.dumps(profile))
+    return str(prof)
+
+
+class TestLiveProgress:
+    def test_progress_monotone_and_eta_from_machine_profile(self, tmp_path):
+        prof_path = _build_machine_profile(tmp_path)
+        s = tpu_session({**CONSOLE_CONF,
+                         "spark.rapids.history.machineProfilePath":
+                             prof_path})
+        con = active_console()
+        samples = []
+        stop = threading.Event()
+
+        def poll():
+            while not stop.is_set():
+                try:
+                    p = _get_json(con, "/queries")
+                except Exception:   # noqa: BLE001 - race with teardown
+                    continue
+                samples.extend(q for q in p["live"]
+                               if q["status"] == "running")
+
+        poller = threading.Thread(target=poll, daemon=True)
+        poller.start()
+        try:
+            # repeat until the poller catches the query mid-flight with
+            # a profile-sourced ETA (first runs compile, so the window
+            # is wide; later runs still take several batches)
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                _query(s)
+                if any(q["eta_source"] == "machine_profile"
+                       and 0.0 < q["progress"] < 1.0 for q in samples):
+                    break
+        finally:
+            stop.set()
+            poller.join(timeout=10)
+        mid = [q for q in samples if 0.0 < q["progress"] < 1.0]
+        assert mid, "poller never observed the query mid-flight"
+        assert any(q["eta_source"] == "machine_profile" and q["eta_s"] > 0
+                   for q in mid), \
+            "configured machine profile must source the live ETA"
+        # monotone per query: a fresh partition wave may lower raw
+        # node fractions, but reported progress never regresses
+        by_qid = {}
+        for q in samples:
+            by_qid.setdefault(q["query_id"], []).append(q["progress"])
+        for qid, seq in by_qid.items():
+            assert all(a <= b for a, b in zip(seq, seq[1:])), \
+                f"query {qid} progress regressed: {seq}"
+        # every node row carries the per-operator live counters
+        node = samples[0]["nodes"][0]
+        assert set(node) >= {"span_id", "parent_id", "node", "rows",
+                             "batches", "partitions", "partitions_done",
+                             "predicted_rows", "predicted_s", "frac"}
+        try:
+            # and the finished query reports progress 1.0
+            recent = _get_json(con, "/queries")["recent"]
+            assert recent and recent[-1]["progress"] == 1.0
+        finally:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# /debug/dump during an injected memory.block hang
+# ---------------------------------------------------------------------------
+
+class TestDebugDump:
+    def test_dump_shows_holder_stacks_during_injected_hang(self):
+        data = {"k": list(range(100)) * 4,
+                "v": [float(i) for i in range(400)]}
+        s0 = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+        expected = s0.create_dataframe(data, num_partitions=2) \
+            .group_by("k").sum("v").order_by("k").collect()
+        s0.stop()
+        s = tpu_session({**CONSOLE_CONF,
+                         "spark.rapids.watchdog.enabled": "true",
+                         "spark.rapids.watchdog.timeoutMs": "800",
+                         "spark.rapids.watchdog.pollMs": "50",
+                         "spark.rapids.chaos.memory.block": "1"})
+        con = active_console()
+        result, errors = [], []
+
+        def run():
+            try:
+                result.append(
+                    s.create_dataframe(data, num_partitions=2)
+                    .group_by("k").sum("v").order_by("k").collect())
+            except Exception as e:   # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        t = threading.Thread(target=run)
+        t.start()
+        held_dump = None
+        try:
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline and t.is_alive():
+                d = _get_json(con, "/debug/dump")
+                if any("(injected hold)" in ln for ln in d["dump"]):
+                    held_dump = d
+                    break
+                time.sleep(0.01)
+            assert held_dump is not None, \
+                "/debug/dump never showed the injected hold"
+            # the ladder: parked holder line + a live Python stack
+            assert any("File \"" in ln for ln in held_dump["dump"]), \
+                "holder dump must include live stacks"
+            assert held_dump["arbiter"]["tasks"], \
+                "the wedged task must be registered with the arbiter"
+            # the on-demand dump leaves a lifecycle trail in the tap
+            ops = [e["payload"].get("op")
+                   for e in _get_json(con,
+                                      "/events?kind=consoleLifecycle")
+                   ["events"]]
+            assert "dump" in ops
+        finally:
+            t.join(timeout=60)
+        try:
+            assert not t.is_alive(), "query never recovered from the hang"
+            assert not errors, errors
+            # watchdog recovery: results identical to the fault-free run
+            assert result and result[0] == expected
+        finally:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# trimodal: console on/off changes no results
+# ---------------------------------------------------------------------------
+
+class TestTrimodal:
+    def test_results_bit_identical_console_on_off(self):
+        s_off = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+        try:
+            baseline = _query(s_off, parts=4)
+        finally:
+            s_off.stop()
+        s_on = tpu_session(CONSOLE_CONF)
+        try:
+            assert active_console() is not None
+            assert _query(s_on, parts=4) == baseline
+        finally:
+            s_on.stop()
+        s_again = tpu_session({"spark.rapids.sql.test.enabled": "false"})
+        try:
+            assert active_console() is None
+            assert _query(s_again, parts=4) == baseline
+        finally:
+            s_again.stop()

@@ -323,3 +323,55 @@ def test_history_conf_keys_registered_and_evented():
         assert entry.key in reg
     # and the cross-check event kind is cataloged
     assert "costModel" in EV.EVENT_KINDS
+
+
+# ---------------------------------------------------------------------------
+# ingest idempotency (content digest)
+# ---------------------------------------------------------------------------
+
+def test_ingest_idempotent_by_content_digest(tmp_path):
+    log = tmp_path / "ev.jsonl"
+    db = str(tmp_path / "h.db")
+    _run_logged_query(log)
+    with HistoryWarehouse(db) as wh:
+        r1 = wh.ingest(str(log), label="first")[0]
+        assert not r1["updated"]
+        n_queries = wh.query("SELECT COUNT(*) FROM queries")[0][0]
+        # same path + same content: the run row UPDATES in place
+        r2 = wh.ingest(str(log), label="second")[0]
+        assert r2["updated"] and r2["run_id"] == r1["run_id"]
+        runs = wh.runs()
+        assert len(runs) == 1 and runs[0]["label"] == "second"
+        # child rows purged and re-inserted, never doubled
+        assert wh.query("SELECT COUNT(*) FROM queries")[0][0] == n_queries
+        # changed content (one more query appended) -> a NEW run
+        _run_logged_query(log)
+        r3 = wh.ingest(str(log), label="third")[0]
+        assert not r3["updated"] and r3["run_id"] != r1["run_id"]
+        assert len(wh.runs()) == 2
+        # force=True always inserts, identical content or not
+        r4 = wh.ingest(str(log), label="forced", force=True)[0]
+        assert not r4["updated"]
+        assert r4["run_id"] not in (r1["run_id"], r3["run_id"])
+        assert len(wh.runs()) == 3
+        # dict payloads have no path identity: they always insert
+        p1 = wh.ingest_payload({"value": 10, "tpu_s": 1.0})
+        p2 = wh.ingest_payload({"value": 10, "tpu_s": 1.0})
+        assert p1["run_id"] != p2["run_id"]
+
+
+def test_history_cli_ingest_force_flag(tmp_path, capsys):
+    log = tmp_path / "ev.jsonl"
+    db = str(tmp_path / "h.db")
+    _run_logged_query(log)
+    assert CLI.main(["history", "ingest", str(log), "--db", db,
+                     "--label", "a"]) == 0
+    assert CLI.main(["history", "ingest", str(log), "--db", db,
+                     "--label", "b"]) == 0
+    assert "updated (same content)" in capsys.readouterr().out
+    with HistoryWarehouse(db) as wh:
+        assert len(wh.runs()) == 1
+    assert CLI.main(["history", "ingest", str(log), "--db", db,
+                     "--label", "c", "--force"]) == 0
+    with HistoryWarehouse(db) as wh:
+        assert len(wh.runs()) == 2

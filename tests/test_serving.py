@@ -191,6 +191,38 @@ def test_plan_cache_lease_busy_bypass_and_eviction():
     assert pc.stats["invalidations"] >= 1
 
 
+def test_plan_cache_byte_bound_evicts_and_accounts():
+    """spark.rapids.serving.planCache.maxBytes: retention is bounded by
+    estimated plan bytes alongside the variant count — whichever trips
+    first evicts — and the byte gauge tracks every mutation path."""
+    fp = (("f", 1.0, 10),)
+    # find the per-variant estimate so the bound can be set to ~2 plans
+    probe = PlanCache(max_plans=8)
+    probe.insert("cd", _FakeSig("n0"), fp, plan="P0").release()
+    per = probe.total_bytes
+    assert per > 0
+    pc = PlanCache(max_plans=8, max_bytes=int(per * 2.5))
+    for i in range(4):
+        pc.insert("cd", _FakeSig(f"n{i}"), fp, plan=f"P{i}").release()
+    # count bound (8) never tripped; the byte bound held retention at 2
+    assert pc._variant_count() == 2
+    assert pc.stats["evictions"] == 2
+    assert pc.total_bytes == pc._variant_count() * per
+    assert 0 < pc.total_bytes <= pc.max_bytes
+    # discard of a leased variant returns its bytes
+    lease = pc.lookup("cd", _FakeSig("n3"), fp)
+    assert lease is not None
+    pc.discard(lease)
+    assert pc.total_bytes == pc._variant_count() * per
+    pc.clear()
+    assert pc.total_bytes == 0
+    # 0 = unbounded: the seed behavior is unchanged
+    pc2 = PlanCache(max_plans=8, max_bytes=0)
+    for i in range(6):
+        pc2.insert("cd", _FakeSig(f"m{i}"), fp, plan=f"P{i}").release()
+    assert pc2._variant_count() == 6 and pc2.stats["evictions"] == 0
+
+
 def test_result_cache_spill_round_trip():
     from spark_rapids_tpu.columnar.batch import batch_from_pydict
     b1 = batch_from_pydict({"x": np.arange(512, dtype=np.int64),
